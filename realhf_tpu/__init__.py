@@ -1,0 +1,24 @@
+"""realhf_tpu: a TPU-native (JAX/XLA/Pallas) RLHF training framework.
+
+Re-designed from scratch with the capabilities of ReaLHF
+(openpsi-project/ReaLHF): dataflow-graph RLHF algorithms (SFT / RW /
+DPO / PPO / GRPO / generation), per-model-function-call device meshes,
+and dynamic parameter reallocation between training and generation
+layouts -- expressed TPU-first via ``jax.sharding`` meshes, pjit/GSPMD
+sharding, and Pallas kernels instead of NCCL/Megatron/CUDA-graphs.
+
+Layer map (mirrors reference ``docs/source/arch.rst``):
+  base/       -- logging, name-resolve, time/frequency control, packing
+  api/        -- config, dataflow graph (MFCs), SequenceSample data model
+  parallel/   -- mesh construction, sharding rules, cross-mesh resharding
+  ops/        -- Pallas/XLA kernels: flash attention, GAE, sampling
+  models/     -- the single transformer implementation + HF conversion
+  engine/     -- train/inference/generation engines (pjit + jit)
+  interfaces/ -- algorithm interfaces (SFT/RW/DPO/PPO/gen)
+  datasets/   -- prompt / prompt-answer / paired-reward datasets
+  system/     -- runtime: master/model workers, buffers, inline runner
+  experiments/-- experiment configs translating CLI to worker configs
+  apps/       -- entry points (quickstart CLI)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,86 @@
+"""Core configuration data model.
+
+Parity with reference ``realhf/api/core/config.py``: model identities
+(`ModelName`, `ModelShardID`), model family specs, interface types, and
+the registry-resolved "abstraction" configs (``type_`` + ``args``) used
+to instantiate datasets/models/interfaces/backends at runtime.
+"""
+
+import dataclasses
+import enum
+from typing import Any, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelName:
+    """Unique identity of one LLM instance in the dataflow graph.
+
+    Multiple MFCs may refer to the same *role* (e.g. "actor"); replicas
+    with different parallelism layouts get distinct ``replica_id``s
+    (reference ``config.py`` + ``experiments/common/utils.py:126``).
+    """
+    role: str
+    replica_id: int = 0
+
+    def __repr__(self):
+        return f"{self.role}@{self.replica_id}"
+
+
+class ModelInterfaceType(enum.Enum):
+    GENERATE = "generate"
+    TRAIN_STEP = "train_step"
+    EVALUATE = "evaluate"
+    INFERENCE = "inference"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    """LLM architecture family + size tag, e.g. llama-7b (actor) or a
+    critic variant. Used for HF conversion dispatch and the search
+    engine's cost model."""
+    _class: str
+    size: int = 0
+    is_critic: bool = False
+
+    def __repr__(self):
+        return f"{self._class}-{self.size}{'-critic' if self.is_critic else ''}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelShardID:
+    """Identity of one shard of a model: which (dp, tp, pp) coordinate
+    of which ModelName. On TPU a "shard" maps to a contiguous slice of
+    the model's device mesh owned by one host process."""
+    model_name: ModelName
+    dp_rank: int = 0
+    tp_rank: int = 0
+    pp_rank: int = 0
+
+    def __repr__(self):
+        return (f"{self.model_name}:d{self.dp_rank}t{self.tp_rank}"
+                f"p{self.pp_rank}")
+
+
+@dataclasses.dataclass
+class ModelInterfaceAbstraction:
+    """Registry-resolved interface config (reference ``config.py:9-44``)."""
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelBackendAbstraction:
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModelAbstraction:
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DatasetAbstraction:
+    type_: str
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)

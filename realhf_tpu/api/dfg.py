@@ -1,0 +1,231 @@
+"""Dataflow graph of model function calls (MFCs).
+
+Parity with reference ``realhf/api/core/dfg.py``: an algorithm (PPO,
+DPO, ...) is a DAG whose nodes are MFCs -- generate / inference /
+train_step on a named model -- and whose edges are resolved
+automatically from input/output data keys. The graph is
+framework-agnostic; the runtime walks it and dispatches each MFC onto
+that MFC's device mesh.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple, Union
+
+import networkx as nx
+
+from realhf_tpu.api.config import (
+    ModelFamily,
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+    ModelName,
+)
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("dfg", "benchmark")
+
+
+@dataclasses.dataclass
+class OffloadHook:
+    """Post-hook: offload the model's weights to host memory after the
+    MFC completes (reference ``dfg.py:19``)."""
+
+
+@dataclasses.dataclass
+class ParamReallocHook:
+    """Pre/post-hook: reshard weights between model replicas.
+
+    Exactly one of ``source``/``target`` is set; the other side is the
+    hooked MFC's own model. ``target = eta * source + (1-eta) * target``
+    (eta=1 is plain overwrite; eta<1 implements EMA reference models).
+    Reference ``dfg.py:24-46``.
+    """
+    source: Optional[ModelName] = None
+    target: Optional[ModelName] = None
+    eta: float = 1.0
+
+
+RPCHook = Union[OffloadHook, ParamReallocHook]
+
+
+@dataclasses.dataclass
+class MFCDef:
+    """One model function call node (reference ``dfg.py:52``).
+
+    :param name: unique node name.
+    :param n_seqs: batch size in sequences pulled from the buffer.
+    :param interface_type: generate / inference / train_step.
+    :param interface_impl: registry config of the algorithm interface.
+    :param model_name: which model executes this call (str role is
+        promoted to ``ModelName(role, 0)``).
+    :param input_keys / output_keys: data keys for dependency edges.
+    :param input_key_remap / output_key_remap: rename keys between the
+        graph-level naming and the interface implementation's naming.
+    :param n_mbs: number of microbatches when executing.
+    :param balanced_dp: if True split exactly n_seqs/dp sequences per DP
+        shard; otherwise balance by token count.
+    """
+
+    name: str
+    n_seqs: int
+    interface_type: ModelInterfaceType
+    interface_impl: ModelInterfaceAbstraction
+    model_name: Union[str, ModelName]
+
+    input_keys: Tuple = dataclasses.field(default_factory=tuple)
+    input_key_remap: Dict[str, str] = dataclasses.field(default_factory=dict)
+    output_keys: Tuple = dataclasses.field(default_factory=tuple)
+    output_key_remap: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    n_mbs: Optional[int] = None
+    balanced_dp: bool = False
+    log_return_value: bool = False
+
+    model_type: Optional[ModelFamily] = None
+    model_path: Optional[str] = None
+
+    # Filled by build_graph; not user-set.
+    _G: Optional[nx.DiGraph] = None
+    _pre_hooks: List[RPCHook] = dataclasses.field(default_factory=list)
+    _post_hooks: List[RPCHook] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if isinstance(self.model_name, str):
+            self.model_name = ModelName(role=self.model_name, replica_id=0)
+
+    def __repr__(self):
+        return f"MFCDef[{self.name}]"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def role(self) -> str:
+        return self.model_name.role
+
+    def add_pre_hook(self, h: RPCHook):
+        if isinstance(h, OffloadHook):
+            raise ValueError("Offload can only be a post hook.")
+        if isinstance(h, ParamReallocHook):
+            assert (h.source is None) != (h.target is None)
+        self._pre_hooks.append(h)
+
+    def add_post_hook(self, h: RPCHook):
+        if isinstance(h, ParamReallocHook):
+            assert (h.source is None) != (h.target is None)
+        self._post_hooks.append(h)
+
+    @property
+    def is_src(self) -> bool:
+        return len(list(self._G.predecessors(self.name))) == 0
+
+    @property
+    def is_dst(self) -> bool:
+        return len(list(self._G.successors(self.name))) == 0
+
+    @property
+    def data_producers(self) -> Dict[str, "MFCDef"]:
+        return self._G.graph["data_producers"]
+
+    @property
+    def data_consumers(self) -> Dict[str, List["MFCDef"]]:
+        return self._G.graph["data_consumers"]
+
+    @property
+    def parents(self) -> List["MFCDef"]:
+        return [self._G.nodes[x]["object"] for x in self._G.predecessors(self.name)]
+
+    @property
+    def children(self) -> List["MFCDef"]:
+        return [self._G.nodes[x]["object"] for x in self._G.successors(self.name)]
+
+    def all_successors(self) -> List["MFCDef"]:
+        names = list(nx.dfs_preorder_nodes(self._G, self.name))
+        names.remove(self.name)
+        return [self._G.nodes[x]["object"] for x in names]
+
+    @property
+    def is_dst_of_model_role(self) -> bool:
+        """True iff no (transitive) successor runs on the same model
+        role -- i.e. this MFC is the last user of these weights in a
+        step, so realloc/offload hooks may follow it."""
+        return not any(r.role == self.role for r in self.all_successors())
+
+
+def build_graph(nodes: List[MFCDef], verbose: bool = False) -> nx.DiGraph:
+    """Resolve edges from data keys (reference ``dfg.py:238``).
+
+    An edge A->B exists iff some output key of A is an input key of B.
+    Keys produced by no node are assumed to come from the dataset.
+    """
+    if len({n.name for n in nodes}) != len(nodes):
+        raise ValueError(f"Duplicate MFC names: {[n.name for n in nodes]}")
+
+    G = nx.DiGraph()
+    G.add_nodes_from([(n.name, dict(object=n)) for n in nodes])
+
+    data_producers: Dict[str, MFCDef] = {}
+    data_consumers: Dict[str, List[MFCDef]] = {}
+    for node in nodes:
+        for k in node.output_keys:
+            if k in data_producers:
+                raise ValueError(
+                    f"Data key `{k}` produced by both "
+                    f"{data_producers[k].name} and {node.name}.")
+            data_producers[k] = node
+        for k in node.input_keys:
+            data_consumers.setdefault(k, []).append(node)
+
+    for node in nodes:
+        for k in node.input_keys:
+            if k in data_producers:
+                G.add_edge(data_producers[k].name, node.name, key=k)
+
+    G.graph["data_producers"] = data_producers
+    G.graph["data_consumers"] = data_consumers
+    for node in nodes:
+        node._G = G
+    if not nx.is_directed_acyclic_graph(G):
+        raise ValueError("The MFC graph contains a cycle.")
+    if verbose:
+        for node in nodes:
+            logger.info("%s: parents=%s children=%s", node.name,
+                        [p.name for p in node.parents],
+                        [c.name for c in node.children])
+    return G
+
+
+class DFG:
+    """Convenience wrapper bundling nodes + resolved graph."""
+
+    def __init__(self, nodes: List[MFCDef]):
+        self.nodes = list(nodes)
+        self.G = build_graph(self.nodes)
+
+    def __iter__(self):
+        return iter(self.nodes)
+
+    def find(self, name: str) -> MFCDef:
+        return self.G.nodes[name]["object"]
+
+    @property
+    def sources(self) -> List[MFCDef]:
+        return [n for n in self.nodes if n.is_src]
+
+    @property
+    def sinks(self) -> List[MFCDef]:
+        return [n for n in self.nodes if n.is_dst]
+
+    def topological_order(self) -> List[MFCDef]:
+        return [self.G.nodes[x]["object"] for x in nx.topological_sort(self.G)]
+
+    @property
+    def dataset_keys(self) -> List[str]:
+        """Input keys that no MFC produces -- they must come from the
+        dataset (reference master_worker data loading)."""
+        produced = set(self.G.graph["data_producers"])
+        needed = []
+        for n in self.nodes:
+            for k in n.input_keys:
+                if k not in produced and k not in needed:
+                    needed.append(k)
+        return needed

@@ -1,0 +1,56 @@
+"""Cluster specification (reference ``realhf/base/cluster.py:17-121``).
+
+Describes the TPU fleet: hosts, chips per host, slice topology, and
+filesystem roots, loaded from a JSON file pointed to by
+``CLUSTER_SPEC_PATH`` or constructed for a local single-host run.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    cluster_type: str = "local"  # local | tpu_pod | slurm
+    cluster_name: str = "local"
+    n_hosts: int = 1
+    n_chips_per_host: int = 1
+    # ICI topology of one slice, e.g. "4x4" for v5e-16; informational.
+    slice_topology: Optional[str] = None
+    fileroot: str = ""
+    node_type_from_node_name: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def n_chips(self) -> int:
+        return self.n_hosts * self.n_chips_per_host
+
+    @classmethod
+    def from_json(cls, path: str) -> "ClusterSpec":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(**{k: v for k, v in d.items()
+                      if k in {f.name for f in dataclasses.fields(cls)}})
+
+    @classmethod
+    def local(cls) -> "ClusterSpec":
+        import jax
+        return cls(cluster_type="local", n_hosts=1,
+                   n_chips_per_host=jax.local_device_count())
+
+
+_spec: Optional[ClusterSpec] = None
+
+
+def spec() -> ClusterSpec:
+    global _spec
+    if _spec is None:
+        path = os.environ.get("CLUSTER_SPEC_PATH", "")
+        _spec = ClusterSpec.from_json(path) if path else ClusterSpec.local()
+    return _spec
+
+
+def set_spec(s: ClusterSpec):
+    global _spec
+    _spec = s

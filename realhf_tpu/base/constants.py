@@ -1,0 +1,80 @@
+"""Per-run global constants: experiment/trial names and filesystem roots.
+
+Parity with reference ``realhf/base/constants.py`` (the non-parallelism
+half: experiment metadata and directory layout). The parallelism state
+("model_scope", grids, groups) lives in ``realhf_tpu.parallel.mesh`` as
+an explicit context object instead of ambient process globals -- on TPU
+the ambient state is a `jax.sharding.Mesh`, not torch process groups.
+"""
+
+import getpass
+import os
+from pathlib import Path
+from typing import Optional
+
+# Filesystem roots. Overridable via env so tests can redirect to tmpdirs.
+ROOT_DIR = os.environ.get("REALHF_TPU_ROOT", os.path.join(os.path.expanduser("~"), ".cache", "realhf_tpu"))
+
+_experiment_name: Optional[str] = None
+_trial_name: Optional[str] = None
+
+
+def set_experiment_trial_names(experiment_name: str, trial_name: str):
+    global _experiment_name, _trial_name
+    if "_" in experiment_name or "/" in experiment_name:
+        raise ValueError(f"Invalid experiment name: {experiment_name}")
+    if "_" in trial_name or "/" in trial_name:
+        raise ValueError(f"Invalid trial name: {trial_name}")
+    _experiment_name = experiment_name
+    _trial_name = trial_name
+
+
+def experiment_name() -> str:
+    if _experiment_name is None:
+        raise RuntimeError("Experiment name is not set.")
+    return _experiment_name
+
+
+def trial_name() -> str:
+    if _trial_name is None:
+        raise RuntimeError("Trial name is not set.")
+    return _trial_name
+
+
+def get_user() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:  # pragma: no cover - some containers lack a passwd entry
+        return os.environ.get("USER", "unknown")
+
+
+def log_root() -> str:
+    return os.path.join(ROOT_DIR, "logs", get_user())
+
+
+def model_save_root() -> str:
+    return os.path.join(ROOT_DIR, "checkpoints", get_user())
+
+
+def run_log_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    e = experiment or experiment_name()
+    t = trial or trial_name()
+    p = os.path.join(log_root(), e, t)
+    Path(p).mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def run_save_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    e = experiment or experiment_name()
+    t = trial or trial_name()
+    p = os.path.join(model_save_root(), e, t)
+    Path(p).mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def recover_root(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    e = experiment or experiment_name()
+    t = trial or trial_name()
+    p = os.path.join(ROOT_DIR, "recover", get_user(), e, t)
+    Path(p).mkdir(parents=True, exist_ok=True)
+    return p

@@ -1,0 +1,144 @@
+"""Sequence-packing arithmetic: balanced contiguous partitioning and
+batch reordering.
+
+Behavioral parity with reference ``realhf/base/datapack.py``:
+- ``min_abs_diff_partition(lens, k, min_size)``: split a 1D array of
+  sequence lengths into k contiguous, non-empty chunks with balanced
+  token sums (used for DP dispatch of packed batches).
+- ``reorder_to_balanced_batches``: greedy longest-first binning so that
+  consecutive fixed-size batches have near-equal token counts.
+- ``flat2d``: flatten a list of lists.
+
+Implementation is NumPy-vectorized dynamic programming (the reference
+uses numba; numba is not assumed here).
+"""
+
+import itertools
+from typing import Any, List, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def flat2d(arr: Sequence[Sequence[Any]]) -> List[Any]:
+    return list(itertools.chain(*arr))
+
+
+def partition_balanced(nums: np.ndarray, k: int, min_size: int = 1) -> List[int]:
+    """Contiguously partition ``nums`` into ``k`` chunks minimizing the
+    maximum chunk sum, each chunk containing >= min_size elements.
+
+    Returns k+1 boundary indices including 0 and len(nums). Minimizing
+    the max chunk sum also produces small max-min spread, matching the
+    balancing contract of the reference DP (``datapack.py:13``).
+    """
+    nums = np.asarray(nums, dtype=np.int64)
+    n = len(nums)
+    assert n >= k * min_size, (n, k, min_size)
+    prefix = np.concatenate([[0], np.cumsum(nums)])
+
+    INF = np.int64(1 << 60)
+    # dp[j, i]: minimal max-chunk-sum partitioning nums[:i] into j chunks.
+    dp = np.full((k + 1, n + 1), INF, dtype=np.int64)
+    split = np.zeros((k + 1, n + 1), dtype=np.int64)
+    for i in range(min_size, n + 1):
+        dp[1, i] = prefix[i]
+    for j in range(2, k + 1):
+        lo = (j - 1) * min_size  # minimal split point
+        for i in range(j * min_size, n + 1):
+            x = np.arange(lo, i - min_size + 1)
+            # cost = max(best of first j-1 chunks over nums[:x], sum of nums[x:i])
+            cost = np.maximum(dp[j - 1, lo:i - min_size + 1], prefix[i] - prefix[lo:i - min_size + 1])
+            b = int(np.argmin(cost))
+            dp[j, i] = cost[b]
+            split[j, i] = x[b]
+    bounds = [n]
+    idx = n
+    for j in range(k, 1, -1):
+        idx = int(split[j, idx])
+        bounds.append(idx)
+    bounds.append(0)
+    return bounds[::-1]
+
+
+def partition_balanced_tuples(nums: np.ndarray, k: int,
+                              min_size: int = 1) -> List[Tuple[int, int]]:
+    b = partition_balanced(nums, k, min_size)
+    return [(b[i], b[i + 1]) for i in range(k)]
+
+
+def min_abs_diff_partition(arr: Union[np.ndarray, List[int]], k: int,
+                           min_size: int = 1) -> List[Tuple[int, int]]:
+    """Validated balanced partition (reference ``datapack.py:76``)."""
+    if isinstance(arr, list):
+        arr = np.array(arr)
+    if arr.ndim != 1:
+        raise ValueError(f"The array to be partitioned must be 1D, got shape {arr.shape}.")
+    if len(arr) < k:
+        raise ValueError(f"Array length {len(arr)} < number of partitions {k}.")
+    if len(arr) < k * min_size:
+        raise ValueError(
+            f"Array length {len(arr)} < k * min_size = {k} * {min_size}.")
+    partitions = partition_balanced_tuples(arr, k, min_size)
+    last_end = 0
+    for start, end in partitions:
+        if start != last_end or end <= start:
+            raise ValueError(
+                f"Invalid partition {partitions} of lengths {arr} into k={k}.")
+        last_end = end
+    return partitions
+
+
+def reorder_to_balanced_batches(seqlens: np.ndarray,
+                                n_seqs_per_batch: int) -> Tuple[np.ndarray, int]:
+    """Greedy longest-first binning into ceil(n / n_seqs_per_batch) bins
+    balanced by token count; bins emitted largest-total first
+    (reference ``datapack.py:116``). Returns (reordered indices, max
+    pairwise bin token-count difference)."""
+    seqlens = np.asarray(seqlens)
+    n_bins = (len(seqlens) + n_seqs_per_batch - 1) // n_seqs_per_batch
+    bins: List[List[int]] = [[] for _ in range(n_bins)]
+    bin_counts = np.zeros(n_bins, dtype=np.int64)
+    bin_tokens = np.zeros(n_bins, dtype=np.int64)
+    for i in np.argsort(seqlens)[::-1]:
+        eligible = np.where(bin_counts < n_seqs_per_batch, bin_tokens,
+                            np.iinfo(np.int64).max)
+        b = int(eligible.argmin())
+        bins[b].append(int(i))
+        bin_counts[b] += 1
+        bin_tokens[b] += seqlens[i]
+    max_diff = int(bin_tokens.max() - bin_tokens.min()) if n_bins > 1 else 0
+    order = []
+    for b in np.argsort(bin_tokens)[::-1]:
+        order.extend(bins[b])
+    return np.array(order, dtype=np.int64), max_diff
+
+
+def ffd_allocate(values: Sequence[int], capacity: int,
+                 min_groups: int = 1) -> List[List[int]]:
+    """First-fit-decreasing bin packing of ``values`` into bins of
+    ``capacity``; returns index groups. Used to build packed microbatches
+    bounded by a token budget."""
+    order = np.argsort(values)[::-1]
+    groups: List[List[int]] = []
+    sums: List[int] = []
+    for i in order:
+        v = values[i]
+        placed = False
+        for g, s in enumerate(sums):
+            if s + v <= capacity:
+                groups[g].append(int(i))
+                sums[g] += v
+                placed = True
+                break
+        if not placed:
+            groups.append([int(i)])
+            sums.append(int(v))
+    while len(groups) < min_groups:
+        # Split the largest group to reach the minimum count.
+        g = int(np.argmax([len(g) for g in groups]))
+        if len(groups[g]) <= 1:
+            raise ValueError("Cannot split further to reach min_groups.")
+        half = len(groups[g]) // 2
+        groups.append(groups[g][half:])
+        groups[g] = groups[g][:half]
+    return groups

@@ -1,0 +1,70 @@
+"""Logging setup with colored console output and benchmark loggers.
+
+Behavioral parity with reference ``realhf/base/logging.py``: named
+loggers, a separate "benchmark" log level namespace used by the master
+worker for per-step metrics, and environment-controlled verbosity.
+No external colorlog dependency; ANSI codes are emitted directly when
+the stream is a TTY.
+"""
+
+import logging as _logging
+import os
+import sys
+from typing import Optional
+
+LOG_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",  # cyan
+    "INFO": "\033[32m",  # green
+    "WARNING": "\033[33m",  # yellow
+    "ERROR": "\033[31m",  # red
+    "CRITICAL": "\033[41m",  # red background
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(_logging.Formatter):
+
+    def format(self, record):
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelname)
+            if color:
+                msg = f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured = False
+
+
+def _configure_root():
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("REALHF_TPU_LOG_LEVEL", "INFO").upper()
+    handler = _logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(_ColorFormatter(fmt=LOG_FORMAT, datefmt=DATE_FORMAT))
+    root = _logging.getLogger("realhf_tpu")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: Optional[str] = None,
+              type_: Optional[str] = None) -> _logging.Logger:
+    """Get a logger under the framework namespace.
+
+    ``type_`` may be "benchmark" or "system"; benchmark loggers can be
+    silenced separately via REALHF_TPU_SILENCE_BENCHMARK=1 (mirrors the
+    reference's benchmark logger split).
+    """
+    _configure_root()
+    if name is None:
+        return _logging.getLogger("realhf_tpu")
+    logger = _logging.getLogger(f"realhf_tpu.{name}")
+    if type_ == "benchmark" and os.environ.get("REALHF_TPU_SILENCE_BENCHMARK") == "1":
+        logger.setLevel(_logging.WARNING)
+    return logger

@@ -1,0 +1,145 @@
+"""FLOPs accounting, timing marks, and device memory statistics.
+
+Parity with reference ``realhf/base/monitor.py``: the FLOP formulas
+(:277-353) used by the master to log per-step TFLOP/s, a lightweight
+span-timing facility (the reference uses CUDA events; here spans wrap
+blocking host calls since XLA dispatch is async -- callers must
+`jax.block_until_ready` the result inside the span for true timings),
+and accelerator memory stats via JAX device APIs.
+"""
+
+import contextlib
+import dataclasses
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def attn_flops(q_len: int, kv_len: int, n_q_heads: int, head_dim: int,
+               causal: bool = True) -> int:
+    """FLOPs of QK^T + PV for one sequence (forward)."""
+    full = 4 * q_len * kv_len * n_q_heads * head_dim
+    return full // 2 if causal else full
+
+
+def transformer_forward_flops(
+    n_layers: int,
+    hidden_dim: int,
+    n_q_heads: int,
+    n_kv_heads: int,
+    head_dim: int,
+    intermediate_dim: int,
+    vocab_size: int,
+    seqlens: List[int],
+    gated_mlp: bool = True,
+) -> int:
+    """Dense-transformer forward FLOPs over packed sequences.
+
+    Mirrors the accounting of reference ``base/monitor.py:277-353``
+    (per-projection matmul FLOPs + causal attention + head).
+    """
+    T = sum(seqlens)
+    sum_sq = sum(l * l for l in seqlens)
+    qkv = 2 * T * hidden_dim * (n_q_heads + 2 * n_kv_heads) * head_dim
+    attn_o = 2 * T * n_q_heads * head_dim * hidden_dim
+    attn = 2 * sum_sq * n_q_heads * head_dim  # QK^T + PV with causal 1/2 factor
+    n_mlp_mats = 3 if gated_mlp else 2
+    mlp = 2 * T * hidden_dim * intermediate_dim * n_mlp_mats
+    per_layer = qkv + attn_o + attn + mlp
+    head = 2 * T * hidden_dim * vocab_size
+    return n_layers * per_layer + head
+
+
+def transformer_train_flops(**kw) -> int:
+    """Backward is ~2x forward; total train step ~3x forward."""
+    return 3 * transformer_forward_flops(**kw)
+
+
+def generation_flops(
+    n_layers: int,
+    hidden_dim: int,
+    n_q_heads: int,
+    n_kv_heads: int,
+    head_dim: int,
+    intermediate_dim: int,
+    vocab_size: int,
+    prompt_lens: List[int],
+    gen_len: int,
+    gated_mlp: bool = True,
+) -> int:
+    """Prefill + decode FLOPs for a generation MFC."""
+    prefill = transformer_forward_flops(
+        n_layers=n_layers, hidden_dim=hidden_dim, n_q_heads=n_q_heads,
+        n_kv_heads=n_kv_heads, head_dim=head_dim,
+        intermediate_dim=intermediate_dim, vocab_size=vocab_size,
+        seqlens=prompt_lens, gated_mlp=gated_mlp)
+    decode = 0
+    for pl in prompt_lens:
+        # Each decoded token attends to the whole prefix.
+        dense = transformer_forward_flops(
+            n_layers=n_layers, hidden_dim=hidden_dim, n_q_heads=n_q_heads,
+            n_kv_heads=n_kv_heads, head_dim=head_dim,
+            intermediate_dim=intermediate_dim, vocab_size=vocab_size,
+            seqlens=[1] * gen_len, gated_mlp=gated_mlp)
+        kv_attn = sum(2 * 2 * (pl + t) * n_q_heads * head_dim
+                      for t in range(gen_len))
+        decode += dense + kv_attn
+    return prefill + decode
+
+
+@dataclasses.dataclass
+class TimeMark:
+    name: str
+    start: float
+    end: float
+
+    @property
+    def elapsed(self):
+        return self.end - self.start
+
+
+class TimeMarkDB:
+    """Process-local span recorder (reference cuda_tmark, :375-427)."""
+
+    def __init__(self):
+        self.marks: Dict[str, List[TimeMark]] = defaultdict(list)
+
+    @contextlib.contextmanager
+    def mark(self, name: str):
+        st = time.monotonic()
+        try:
+            yield
+        finally:
+            self.marks[name].append(TimeMark(name, st, time.monotonic()))
+
+    def total(self, name: str) -> float:
+        return sum(m.elapsed for m in self.marks[name])
+
+    def summary(self) -> Dict[str, float]:
+        return {k: self.total(k) for k in self.marks}
+
+    def clear(self):
+        self.marks.clear()
+
+
+_tmark_db = TimeMarkDB()
+
+
+def tmark(name: str):
+    return _tmark_db.mark(name)
+
+
+def tmark_db() -> TimeMarkDB:
+    return _tmark_db
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """Per-chip HBM stats (replaces nvml polling, reference :255)."""
+    import jax
+    d = device or jax.local_devices()[0]
+    stats = d.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }

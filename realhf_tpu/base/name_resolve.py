@@ -1,0 +1,310 @@
+"""Distributed key-value rendezvous store ("name resolve").
+
+Parity with reference ``realhf/base/name_resolve.py``: an abstract
+add/get/delete/wait/get_subtree API with in-memory and shared-filesystem
+(NFS) backends. Workers publish addresses/status under keys from
+``realhf_tpu.base.names``; peers poll or wait on them. The NFS backend
+is the default for multi-host TPU pods (any shared FS works); the
+memory backend serves single-process tests and the inline runner.
+"""
+
+import dataclasses
+import os
+import shutil
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+from typing import Callable, Dict, List, Optional
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameRecordRepository(ABC):
+
+    @abstractmethod
+    def add(self, name: str, value: str, delete_on_exit: bool = True,
+            keepalive_ttl: Optional[float] = None, replace: bool = False):
+        """Add a key-value entry. Raises NameEntryExistsError unless replace."""
+
+    @abstractmethod
+    def delete(self, name: str):
+        """Delete an entry; raises NameEntryNotFoundError if absent."""
+
+    @abstractmethod
+    def clear_subtree(self, name_root: str):
+        """Delete all entries under the given prefix."""
+
+    @abstractmethod
+    def get(self, name: str) -> str:
+        """Get the value of an entry; raises NameEntryNotFoundError."""
+
+    @abstractmethod
+    def get_subtree(self, name_root: str) -> List[str]:
+        """Values of all entries under the prefix (sorted by key)."""
+
+    @abstractmethod
+    def find_subtree(self, name_root: str) -> List[str]:
+        """Keys of all entries under the prefix (sorted)."""
+
+    def add_subentry(self, name: str, value: str, **kwargs) -> str:
+        """Add an entry with a random unique suffix under ``name``."""
+        sub = f"{name}/{uuid.uuid4().hex[:8]}"
+        self.add(sub, value, **kwargs)
+        return sub
+
+    def wait(self, name: str, timeout: Optional[float] = None,
+             poll_frequency: float = 0.1) -> str:
+        """Block until the entry exists, then return its value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"Timeout waiting for name_resolve key: {name}")
+                time.sleep(poll_frequency)
+
+    def watch_names(self, names: List[str], call_back: Callable[[], None],
+                    poll_frequency: float = 5.0, wait_timeout: float = 60.0):
+        """Spawn a daemon thread invoking ``call_back`` once any of the
+        names disappears (used for peer-death detection)."""
+        names = list(names)
+
+        def _watch():
+            for n in names:
+                self.wait(n, timeout=wait_timeout)
+            while True:
+                try:
+                    for n in names:
+                        self.get(n)
+                except NameEntryNotFoundError:
+                    call_back()
+                    return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+        return t
+
+    def reset(self):
+        """Delete every entry this repository instance created."""
+
+    def __del__(self):
+        try:
+            self.reset()
+        except Exception:
+            pass
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    """Single-process in-memory backend (reference :181)."""
+
+    def __init__(self):
+        self.__store: Dict[str, str] = {}
+        self.__lock = threading.Lock()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        with self.__lock:
+            if name in self.__store and not replace:
+                raise NameEntryExistsError(name)
+            self.__store[name] = str(value)
+
+    def delete(self, name):
+        with self.__lock:
+            if name not in self.__store:
+                raise NameEntryNotFoundError(name)
+            del self.__store[name]
+
+    def clear_subtree(self, name_root):
+        with self.__lock:
+            for k in [k for k in self.__store if k.startswith(name_root)]:
+                del self.__store[k]
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self.__lock:
+            if name not in self.__store:
+                raise NameEntryNotFoundError(name)
+            return self.__store[name]
+
+    def get_subtree(self, name_root):
+        with self.__lock:
+            return [v for k, v in sorted(self.__store.items())
+                    if k.startswith(name_root)]
+
+    def find_subtree(self, name_root):
+        with self.__lock:
+            return sorted(k for k in self.__store if k.startswith(name_root))
+
+    def reset(self):
+        self.__store = {}
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """Shared-filesystem backend (reference :265): one file per key.
+
+    Works on any POSIX FS visible to all hosts (NFS, GCS-fuse, local FS
+    for single-host runs).
+    """
+
+    def __init__(self, record_root: Optional[str] = None):
+        from realhf_tpu.base import constants
+        self.record_root = record_root or os.path.join(constants.ROOT_DIR, "name_resolve")
+        self.__to_delete = set()
+
+    def __dir_path(self, name: str) -> str:
+        return os.path.join(self.record_root, name)
+
+    def __file_path(self, name: str) -> str:
+        return os.path.join(self.__dir_path(name), "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        path = self.__file_path(name)
+        if os.path.isfile(path) and not replace:
+            raise NameEntryExistsError(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)  # atomic on POSIX
+        if delete_on_exit:
+            self.__to_delete.add(name)
+
+    def delete(self, name):
+        path = self.__file_path(name)
+        if not os.path.isfile(path):
+            raise NameEntryNotFoundError(name)
+        os.remove(path)
+        self.__to_delete.discard(name)
+        # Prune now-empty parent dirs for tidiness.
+        d = os.path.dirname(path)
+        while d != self.record_root and os.path.isdir(d) and not os.listdir(d):
+            os.rmdir(d)
+            d = os.path.dirname(d)
+
+    def clear_subtree(self, name_root):
+        d = self.__dir_path(name_root)
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        path = self.__file_path(name)
+        try:
+            with open(path, "r") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name)
+
+    def _walk_entries(self, name_root):
+        d = self.__dir_path(name_root)
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for root, _, files in os.walk(d):
+            if "ENTRY" in files:
+                key = os.path.relpath(root, self.record_root)
+                out.append(key)
+        return sorted(out)
+
+    def get_subtree(self, name_root):
+        return [self.get(k) for k in self._walk_entries(name_root)]
+
+    def find_subtree(self, name_root):
+        return self._walk_entries(name_root)
+
+    def reset(self):
+        for name in list(self.__to_delete):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self.__to_delete = set()
+
+
+DEFAULT_REPOSITORY_TYPE = os.environ.get("REALHF_TPU_NAME_RESOLVE", "nfs")
+
+
+def make_repository(type_: Optional[str] = None, **kwargs) -> NameRecordRepository:
+    type_ = type_ or DEFAULT_REPOSITORY_TYPE
+    if type_ == "memory":
+        return MemoryNameRecordRepository(**kwargs)
+    if type_ == "nfs":
+        return NfsNameRecordRepository(**kwargs)
+    raise NotImplementedError(f"Unknown name_resolve repository type: {type_}")
+
+
+# Module-level default instance mirroring the reference's module API.
+_default: Optional[NameRecordRepository] = None
+_default_lock = threading.Lock()
+
+
+def default() -> NameRecordRepository:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = make_repository()
+        return _default
+
+
+def reconfigure(type_: Optional[str] = None, **kwargs):
+    global _default
+    with _default_lock:
+        if _default is not None:
+            _default.reset()
+        _default = make_repository(type_, **kwargs)
+
+
+def add(name, value, **kwargs):
+    return default().add(name, value, **kwargs)
+
+
+def add_subentry(name, value, **kwargs):
+    return default().add_subentry(name, value, **kwargs)
+
+
+def delete(name):
+    return default().delete(name)
+
+
+def clear_subtree(name_root):
+    return default().clear_subtree(name_root)
+
+
+def get(name):
+    return default().get(name)
+
+
+def get_subtree(name_root):
+    return default().get_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return default().find_subtree(name_root)
+
+
+def wait(name, **kwargs):
+    return default().wait(name, **kwargs)
+
+
+def watch_names(names, call_back, **kwargs):
+    return default().watch_names(names, call_back, **kwargs)
+
+
+def reset():
+    return default().reset()

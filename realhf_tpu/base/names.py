@@ -1,0 +1,52 @@
+"""Key schema for the distributed name-resolve store.
+
+Parity with reference ``realhf/base/names.py:7-59``: a single place
+defining the hierarchical key layout so that master, workers, and the
+launcher agree on rendezvous paths.
+"""
+
+USER_NAMESPACE = "realhf_tpu"
+
+
+def _root(experiment_name: str, trial_name: str) -> str:
+    return f"{USER_NAMESPACE}/{experiment_name}/{trial_name}"
+
+
+def trial_registry(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/trial_registry"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return _root(experiment_name, trial_name)
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/status/{worker_name}"
+
+
+def worker_root(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/status/"
+
+
+def worker_key(experiment_name: str, trial_name: str, key: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/worker_key/{key}"
+
+
+def request_reply_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/request_reply_stream/{stream_name}"
+
+
+def distributed_peer(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/distributed_peer/{model_name}"
+
+
+def distributed_master(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/distributed_master/{model_name}"
+
+
+def model_version(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/experiment_status"

@@ -1,0 +1,51 @@
+"""Recovery bookkeeping (reference ``realhf/base/recover.py``).
+
+The master dumps a small ``RecoverInfo`` (epoch/step counters + data ids
+already consumed this epoch) so a restarted run can skip processed data
+and resume step accounting. Model weights are recovered from the latest
+checkpoint separately.
+"""
+
+import dataclasses
+import os
+import pickle
+from typing import Hashable, List, Optional
+
+from realhf_tpu.base import constants
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    recover_start: StepInfo = dataclasses.field(default_factory=StepInfo)
+    last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
+    hash_vals_to_ignore: List[Hashable] = dataclasses.field(default_factory=list)
+
+
+def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    return os.path.join(constants.recover_root(experiment, trial), "recover_info.pkl")
+
+
+def dump(info: RecoverInfo, experiment: Optional[str] = None,
+         trial: Optional[str] = None):
+    path = dump_path(experiment, trial)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(info, f)
+    os.replace(tmp, path)
+
+
+def load(experiment: Optional[str] = None,
+         trial: Optional[str] = None) -> RecoverInfo:
+    with open(dump_path(experiment, trial), "rb") as f:
+        return pickle.load(f)
+
+
+def exists(experiment: Optional[str] = None, trial: Optional[str] = None) -> bool:
+    return os.path.isfile(dump_path(experiment, trial))

@@ -1,0 +1,38 @@
+"""Deterministic seeding (reference ``realhf/base/seeding.py``).
+
+JAX is functional: randomness flows through explicit `jax.random` keys.
+This module derives per-component keys from one experiment-level seed so
+every worker/model derives reproducible, non-colliding streams.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+
+_base_seed = None
+
+
+def set_random_seed(seed: int):
+    global _base_seed
+    _base_seed = int(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2 ** 32))
+
+
+def get_seed() -> int:
+    if _base_seed is None:
+        raise RuntimeError("Seed not set; call set_random_seed first.")
+    return _base_seed
+
+
+def derive_seed(*names: str) -> int:
+    """Derive a stable 63-bit seed for a named component, e.g.
+    ``derive_seed('model_worker', 'actor', '3')``."""
+    h = hashlib.sha256(("/".join(map(str, names)) + f"@{get_seed()}").encode())
+    return int.from_bytes(h.digest()[:8], "little") & ((1 << 63) - 1)
+
+
+def derive_key(*names: str):
+    import jax
+    return jax.random.PRNGKey(derive_seed(*names) % (2 ** 31))

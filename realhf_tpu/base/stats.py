@@ -1,0 +1,42 @@
+"""Side-channel statistics tracker.
+
+Parity with reference ``realhf/base/constants.py:479-513``: modules deep
+inside the model (e.g. MoE aux losses) record scalars here; the
+algorithm interface drains them after each step and merges them into
+returned stats. In JAX these are traced scalars returned from jitted
+functions, so the tracker stores host-side values post-step.
+"""
+
+import threading
+from collections import defaultdict
+from typing import Dict, List
+
+
+class StatsTracker:
+
+    def __init__(self):
+        self._stats: Dict[str, List[float]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def record(self, **kwargs: float):
+        with self._lock:
+            for k, v in kwargs.items():
+                self._stats[k].append(float(v))
+
+    def export(self, clear: bool = True) -> Dict[str, float]:
+        with self._lock:
+            out = {k: sum(v) / len(v) for k, v in self._stats.items() if v}
+            if clear:
+                self._stats.clear()
+        return out
+
+
+_tracker = StatsTracker()
+
+
+def record(**kwargs):
+    _tracker.record(**kwargs)
+
+
+def export(clear: bool = True):
+    return _tracker.export(clear=clear)

@@ -1,0 +1,87 @@
+"""Frequency control and wall-clock utilities.
+
+Parity with reference ``realhf/base/timeutil.py``: `FrequencyControl`
+(trigger every N steps / T seconds) and `EpochStepTimeFreqCtl`
+combining epoch-, step-, and time-frequency triggers for save/eval
+scheduling in the master worker.
+"""
+
+import dataclasses
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class FrequencyControl:
+    """Triggers when either the step count or elapsed seconds exceeds
+    its configured frequency (reference ``timeutil.py:11``).
+
+    frequency_steps=None disables step triggering; frequency_seconds=None
+    disables time triggering. If both are None, `check()` never fires
+    unless initial_value was True for the first call.
+    """
+
+    frequency_steps: Optional[int] = None
+    frequency_seconds: Optional[float] = None
+    initial_value: bool = False
+
+    def __post_init__(self):
+        self._last_time = time.monotonic()
+        self._steps = 0
+        self._first = True
+        self.total_checks = 0
+
+    def check(self, steps: int = 1) -> bool:
+        self.total_checks += 1
+        self._steps += steps
+        now = time.monotonic()
+        if self._first and self.initial_value:
+            self._first = False
+            self._last_time = now
+            self._steps = 0
+            return True
+        self._first = False
+        hit = False
+        if self.frequency_steps is not None and self._steps >= self.frequency_steps:
+            hit = True
+        if (self.frequency_seconds is not None
+                and now - self._last_time >= self.frequency_seconds):
+            hit = True
+        if hit:
+            self._last_time = now
+            self._steps = 0
+        return hit
+
+
+@dataclasses.dataclass
+class EpochStepTimeFreqCtl:
+    """Composite control over epoch boundaries, global steps, and time
+    (reference ``timeutil.py:98``), used for save/eval triggers."""
+
+    freq_epoch: Optional[int] = None
+    freq_step: Optional[int] = None
+    freq_sec: Optional[float] = None
+
+    def __post_init__(self):
+        self._epoch_ctl = FrequencyControl(frequency_steps=self.freq_epoch)
+        self._step_ctl = FrequencyControl(frequency_steps=self.freq_step)
+        self._time_ctl = FrequencyControl(frequency_seconds=self.freq_sec)
+
+    def check(self, epochs: int, steps: int) -> bool:
+        # Evaluate all three so their internal counters advance together.
+        e = self._epoch_ctl.check(epochs) if self.freq_epoch is not None else False
+        s = self._step_ctl.check(steps) if self.freq_step is not None else False
+        t = self._time_ctl.check() if self.freq_sec is not None else False
+        return e or s or t
+
+
+class Timer:
+    """Context-manager wall-clock timer."""
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.monotonic() - self.start
+        return False

@@ -1,0 +1,67 @@
+"""Prompt + answer dataset for SFT.
+
+Parity with reference ``realhf/impl/dataset/prompt_answer_dataset.py``:
+JSONL records with "id", "prompt", "answer". Items yield
+``packed_input_ids`` (prompt+answer+eos) and a boolean ``prompt_mask``
+(True over prompt tokens, excluded from the SFT loss).
+"""
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from realhf_tpu.api import data as data_api
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("PromptAnswerDataset")
+
+
+class PromptAnswerDataset:
+
+    def __init__(self, util: data_api.DatasetUtility, max_length: int,
+                 dataset_path: Optional[str] = None,
+                 dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+                 pad_to_max_length: bool = False):
+        self._util = util
+        tokenizer = util.tokenizer
+
+        records = data_api.load_shuffle_split_dataset(
+            util, dataset_path, dataset_builder)
+        self.ids = [x["id"] for x in records]
+        seqs = [x["prompt"] + x["answer"] + tokenizer.eos_token for x in records]
+        self.tokens = tokenizer(
+            seqs, truncation=True, max_length=max_length, return_length=True,
+            return_attention_mask=False,
+            padding="max_length" if pad_to_max_length else False)
+        prompt_tokens = tokenizer(
+            [x["prompt"] for x in records], truncation=True,
+            max_length=max_length, return_length=True,
+            return_attention_mask=False, padding=False)
+
+        self.prompt_masks = []
+        for plen, slen in zip(prompt_tokens["length"], self.tokens["length"]):
+            plen, slen = int(plen), int(slen)
+            assert slen >= plen, (slen, plen)
+            self.prompt_masks.append(
+                np.array([True] * plen + [False] * (slen - plen)))
+        logger.info("Loaded %d prompt-answer sequences.", len(self.ids))
+
+    @property
+    def util(self):
+        return self._util
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        ids = np.asarray(self.tokens["input_ids"][idx], dtype=np.int32)
+        mask = self.prompt_masks[idx]
+        assert len(ids) == len(mask)
+        return data_api.SequenceSample.from_default(
+            ids=[self.ids[idx]],
+            seqlens=[len(ids)],
+            data=dict(packed_input_ids=ids, prompt_mask=mask),
+        )
+
+
+data_api.register_dataset("prompt_answer", PromptAnswerDataset)

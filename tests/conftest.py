@@ -1,0 +1,36 @@
+"""Test configuration: run all tests on a virtual 8-device CPU mesh.
+
+Mirrors the reference's `LocalMultiProcessTest` harness
+(`realhf/base/testing.py:112`) -- multi-device parallelism is emulated
+without hardware. On TPU this is trivial: JAX exposes N virtual CPU
+devices in one process via XLA flags, so sharded code paths (dp/tp/sp)
+compile and run in CI.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_name_resolve(tmp_path, monkeypatch):
+    """Isolate name_resolve and file roots per test."""
+    import realhf_tpu.base.constants as constants
+    import realhf_tpu.base.name_resolve as name_resolve
+    monkeypatch.setattr(constants, "ROOT_DIR", str(tmp_path / "realhf_tpu_root"))
+    name_resolve.reconfigure("memory")
+    yield
+
+
+@pytest.fixture
+def seeded():
+    from realhf_tpu.base import seeding
+    seeding.set_random_seed(1)
+    yield

@@ -1,0 +1,97 @@
+"""DFG construction tests, mirroring reference ``tests/data/test_dfg.py``:
+build PPO-like and SFT graphs and check parents/children/edges."""
+
+import pytest
+
+from realhf_tpu.api.config import ModelInterfaceAbstraction, ModelInterfaceType
+from realhf_tpu.api.dfg import DFG, MFCDef, OffloadHook, ParamReallocHook
+
+
+def ppo_nodes():
+    itf = ModelInterfaceAbstraction("ppo")
+    rw_itf = ModelInterfaceAbstraction("paired_rw")
+    return [
+        MFCDef(name="actor_gen", n_seqs=32,
+               interface_type=ModelInterfaceType.GENERATE, interface_impl=itf,
+               model_name="actor", input_keys=("packed_prompts",),
+               output_keys=("seq_no_eos_mask", "packed_input_ids",
+                            "packed_logprobs", "prompt_mask")),
+        MFCDef(name="rew_inf", n_seqs=32,
+               interface_type=ModelInterfaceType.INFERENCE, interface_impl=rw_itf,
+               model_name="reward", input_keys=("packed_input_ids",),
+               output_keys=("rewards",)),
+        MFCDef(name="ref_inf", n_seqs=32,
+               interface_type=ModelInterfaceType.INFERENCE, interface_impl=itf,
+               model_name="ref", input_keys=("packed_input_ids",),
+               output_keys=("packed_ref_logprobs",)),
+        MFCDef(name="critic_inf", n_seqs=32,
+               interface_type=ModelInterfaceType.INFERENCE, interface_impl=itf,
+               model_name="critic", input_keys=("packed_input_ids", "seq_no_eos_mask"),
+               output_keys=("values",)),
+        MFCDef(name="actor_train", n_seqs=32,
+               interface_type=ModelInterfaceType.TRAIN_STEP, interface_impl=itf,
+               model_name="actor",
+               input_keys=("packed_input_ids", "packed_logprobs",
+                           "packed_ref_logprobs", "rewards", "values",
+                           "prompt_mask", "seq_no_eos_mask")),
+        MFCDef(name="critic_train", n_seqs=32,
+               interface_type=ModelInterfaceType.TRAIN_STEP, interface_impl=itf,
+               model_name="critic",
+               input_keys=("packed_input_ids", "packed_logprobs",
+                           "packed_ref_logprobs", "rewards", "values",
+                           "prompt_mask", "seq_no_eos_mask")),
+    ]
+
+
+class TestDFG:
+
+    def test_ppo_graph_structure(self):
+        g = DFG(ppo_nodes())
+        gen = g.find("actor_gen")
+        assert gen.is_src and not gen.is_dst
+        assert {c.name for c in gen.children} == {
+            "rew_inf", "ref_inf", "critic_inf", "actor_train", "critic_train"}
+        at = g.find("actor_train")
+        assert at.is_dst
+        assert {p.name for p in at.parents} == {
+            "actor_gen", "rew_inf", "ref_inf", "critic_inf"}
+        assert set(g.dataset_keys) == {"packed_prompts"}
+        assert {n.name for n in g.sinks} == {"actor_train", "critic_train"}
+        # actor_gen is not the last actor-role MFC; actor_train is.
+        assert not gen.is_dst_of_model_role
+        assert at.is_dst_of_model_role
+
+    def test_topological_order(self):
+        g = DFG(ppo_nodes())
+        order = [n.name for n in g.topological_order()]
+        assert order.index("actor_gen") < order.index("rew_inf")
+        assert order.index("rew_inf") < order.index("actor_train")
+
+    def test_single_node_graph(self):
+        sft = MFCDef(name="trainDefault", n_seqs=8,
+                     interface_type=ModelInterfaceType.TRAIN_STEP,
+                     interface_impl=ModelInterfaceAbstraction("sft"),
+                     model_name="default",
+                     input_keys=("packed_input_ids", "prompt_mask"))
+        g = DFG([sft])
+        assert g.find("trainDefault").is_src and g.find("trainDefault").is_dst
+        assert set(g.dataset_keys) == {"packed_input_ids", "prompt_mask"}
+
+    def test_duplicate_names_rejected(self):
+        n = ppo_nodes()
+        n[1] = MFCDef(name="actor_gen", n_seqs=1,
+                      interface_type=ModelInterfaceType.INFERENCE,
+                      interface_impl=ModelInterfaceAbstraction("x"),
+                      model_name="y")
+        with pytest.raises(ValueError):
+            DFG(n)
+
+    def test_hooks(self):
+        nodes = ppo_nodes()
+        g = DFG(nodes)
+        at = g.find("actor_train")
+        at.add_pre_hook(ParamReallocHook(source=nodes[0].model_name))
+        at.add_post_hook(OffloadHook())
+        assert len(at._pre_hooks) == 1 and len(at._post_hooks) == 1
+        with pytest.raises(ValueError):
+            at.add_pre_hook(OffloadHook())

@@ -1,0 +1,112 @@
+"""SequenceSample gather/split round-trips, mirroring reference
+``tests/data/test_sequence_gather_split.py`` (incl. nested seqlens and
+dp splits 1..16)."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.api.data import SequenceSample, SequenceSplitSpec
+
+
+def make_sample(rng, n, nested=False):
+    samples = []
+    for i in range(n):
+        if nested:
+            # e.g. multiple responses per prompt
+            lens = [int(rng.integers(2, 10)) for _ in range(int(rng.integers(1, 4)))]
+            data = dict(packed_input_ids=rng.integers(
+                0, 100, size=(sum(lens),)).astype(np.int32))
+            s = SequenceSample(
+                keys=["packed_input_ids"],
+                trailing_shapes=dict(packed_input_ids=()),
+                dtypes=dict(packed_input_ids=np.int32),
+                ids=[i],
+                seqlens=dict(packed_input_ids=[lens]),
+                data=data)
+        else:
+            l = int(rng.integers(2, 20))
+            s = SequenceSample.from_default(
+                seqlens=[l], ids=[i],
+                data=dict(
+                    packed_input_ids=rng.integers(0, 100, size=(l,)).astype(np.int32),
+                    rewards=rng.standard_normal((1,)).astype(np.float32),
+                ))
+        samples.append(s)
+    return samples
+
+
+class TestSequenceSample:
+
+    def test_gather_unpack_roundtrip(self):
+        rng = np.random.default_rng(0)
+        samples = make_sample(rng, 8)
+        batch = SequenceSample.gather(samples)
+        assert batch.bs == 8
+        back = batch.unpack()
+        for a, b in zip(samples, back):
+            assert a.ids == b.ids
+            assert a.seqlens == b.seqlens
+            for k in a.keys:
+                np.testing.assert_array_equal(a.data[k], b.data[k])
+
+    @pytest.mark.parametrize("dp", [1, 2, 3, 4, 8, 16])
+    def test_split_balance_and_consistency(self, dp):
+        rng = np.random.default_rng(1)
+        batch = SequenceSample.gather(make_sample(rng, 32))
+        parts = batch.split(dp)
+        assert len(parts) == dp
+        assert sum(p.bs for p in parts) == 32
+        regather = SequenceSample.gather(parts)
+        for k in batch.keys:
+            np.testing.assert_array_equal(batch.data[k], regather.data[k])
+        assert regather.ids == batch.ids
+
+    def test_nested_seqlens(self):
+        rng = np.random.default_rng(2)
+        batch = SequenceSample.gather(make_sample(rng, 16, nested=True))
+        parts = batch.split(4)
+        regather = SequenceSample.gather(parts)
+        np.testing.assert_array_equal(
+            batch.data["packed_input_ids"], regather.data["packed_input_ids"])
+        assert regather.seqlens == batch.seqlens
+
+    def test_meta_and_update(self):
+        rng = np.random.default_rng(3)
+        batch = SequenceSample.gather(make_sample(rng, 4))
+        meta = batch.meta()
+        assert meta.data is None
+        assert meta.ids == batch.ids
+        # amend new key
+        lens = [sum(l) for l in batch.seqlens["packed_input_ids"]]
+        new = SequenceSample.from_default(
+            seqlens=lens, ids=batch.ids,
+            data=dict(seq_no_eos_mask=np.zeros(4, dtype=np.bool_)))
+        batch.update_(new)
+        assert "seq_no_eos_mask" in batch.keys
+
+    def test_remap_and_select(self):
+        rng = np.random.default_rng(4)
+        batch = SequenceSample.gather(make_sample(rng, 4))
+        sel = batch.select(["rewards"])
+        assert sel.keys == {"rewards"}
+        batch.remap_keys_({"packed_input_ids": "packed_prompts"})
+        assert "packed_prompts" in batch.keys
+        assert "packed_input_ids" not in batch.keys
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            SequenceSample(
+                keys=["a"], trailing_shapes=dict(a=()), dtypes=dict(a=np.int32),
+                ids=[0, 0], seqlens=dict(a=[[1], [1]]),
+                data=dict(a=np.zeros(2, dtype=np.int32)))
+        with pytest.raises(ValueError):
+            SequenceSample(
+                keys=["a"], trailing_shapes=dict(a=()), dtypes=dict(a=np.int32),
+                ids=[0], seqlens=dict(a=[[3]]),
+                data=dict(a=np.zeros(2, dtype=np.int32)))  # wrong shape
+
+    def test_split_with_spec_uneven(self):
+        rng = np.random.default_rng(5)
+        batch = SequenceSample.gather(make_sample(rng, 6))
+        parts = batch.split_with_spec(SequenceSplitSpec([(0, 1), (1, 6)]))
+        assert parts[0].bs == 1 and parts[1].bs == 5
