@@ -1,0 +1,19 @@
+"""HuggingFace checkpoint conversion; importing registers families.
+
+Parity with reference ``realhf/api/from_hf/__init__.py`` +
+``impl/model/conversion/hf_registry.py``.
+"""
+
+import realhf_tpu.models.hf.llama  # noqa: F401
+import realhf_tpu.models.hf.gpt2  # noqa: F401
+
+from realhf_tpu.models.hf.registry import (  # noqa: F401
+    HF_FAMILIES,
+    config_from_hf,
+    config_to_hf,
+    load_hf_checkpoint,
+    params_from_hf,
+    params_to_hf,
+    register_hf_family,
+    save_hf_checkpoint,
+)

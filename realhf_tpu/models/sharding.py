@@ -1,0 +1,117 @@
+"""GSPMD sharding rules for the transformer.
+
+TPU-native replacement for the reference's Megatron-derived TP/SP
+modules (``realhf/impl/model/parallelism/model_parallel/modules.py``,
+``mappings.py``): instead of hand-written column/row-parallel linears
+and scatter/gather autograd functions, every parameter gets a
+`PartitionSpec` and XLA inserts the collectives.
+
+Mapping (reference module -> spec here):
+- ParallelEmbedding (vocab-partitioned, modules.py:53)  -> wte P("model", None)
+- ColumnParallelLinear (modules.py:727)                 -> wq/wk/wv/wg/wu P(..., "model")
+- RowParallelLinear (modules.py:875)                    -> wo/wd P(..., "model", None)
+- parallel_lm_logits + _VocabParallelCrossEntropy       -> head P(None, "model") + fused CE in ops/ce.py
+- sequence parallel scatter/gather (mappings.py:207-294)-> residual-stream
+  constraint P("data", "model", None): XLA materializes the
+  all-gather before attention/MLP and reduce-scatter after, which is
+  exactly Megatron-SP's communication pattern.
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+
+
+def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree congruent with ``init_params`` output."""
+    col = P(None, None, MODEL_AXIS)      # [nl, H, out_sharded]
+    row = P(None, MODEL_AXIS, None)      # [nl, in_sharded, H]
+    col_b = P(None, MODEL_AXIS)          # bias of a column-parallel linear
+    rep2 = P(None, None)                 # [nl, H] replicated
+    specs: Dict[str, Any] = {
+        "embed": {"wte": P(MODEL_AXIS, None)},
+        "blocks": {
+            "ln1": {"scale": rep2},
+            "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+            "ln2": {"scale": rep2},
+            "mlp": {},
+        },
+        "ln_f": {"scale": P(None)},
+    }
+    if cfg.uses_absolute_position:
+        specs["embed"]["wpe"] = P(None, None)
+    mlp = specs["blocks"]["mlp"]
+    if cfg.mlp_type == "moe":
+        # Experts TP-sharded (reference behavior: each expert's MLP is
+        # column/row-parallel, experts.py:26).
+        mlp["router"] = P(None, None, None)
+        mlp["wg"] = P(None, None, None, MODEL_AXIS)
+        mlp["wu"] = P(None, None, None, MODEL_AXIS)
+        mlp["wd"] = P(None, None, MODEL_AXIS, None)
+    elif cfg.gated_mlp:
+        mlp["wg"] = col
+        mlp["wu"] = col
+        mlp["wd"] = row
+    else:
+        mlp["wu"] = col
+        mlp["wd"] = row
+    if cfg.use_attention_bias:
+        a = specs["blocks"]["attn"]
+        a["bq"], a["bk"], a["bv"] = col_b, col_b, col_b
+    if cfg.use_attn_proj_bias:
+        specs["blocks"]["attn"]["bo"] = rep2
+    if cfg.use_mlp_bias and cfg.mlp_type is None:
+        mlp["bu"] = col_b
+        mlp["bd"] = rep2
+    if cfg.layer_norm_type is None:
+        specs["blocks"]["ln1"]["bias"] = rep2
+        specs["blocks"]["ln2"]["bias"] = rep2
+        specs["ln_f"]["bias"] = P(None)
+    if cfg.is_critic:
+        specs["head"] = {"w": P(None, None)}
+    elif not cfg.tied_embedding:
+        specs["head"] = {"w": P(None, MODEL_AXIS)}
+    return specs
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_pspec() -> P:
+    """[B, L] token/segment arrays: DP over streams."""
+    return P(DATA_AXIS, None)
+
+
+def residual_pspec(sequence_parallel: bool) -> P:
+    """[B, L, H] residual stream; with SP the sequence dim is also
+    sharded over the TP axis (Megatron-SP analog)."""
+    if sequence_parallel:
+        return P(DATA_AXIS, MODEL_AXIS, None)
+    return P(DATA_AXIS, None, None)
+
+
+def activation_constraint(mesh: Mesh, sequence_parallel: bool):
+    """The per-block residual-stream constraint fed to
+    ``transformer.forward(activation_constraint=...)``."""
+    sharding = NamedSharding(mesh, residual_pspec(sequence_parallel))
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return constrain
+
+
+def kv_cache_pspecs() -> Dict[str, P]:
+    """KV cache: [nl, B, S, nkv, hd] -- DP over streams, TP over heads."""
+    return {
+        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "valid": P(DATA_AXIS, None),
+        "length": P(DATA_AXIS),
+    }

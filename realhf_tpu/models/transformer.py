@@ -1,0 +1,438 @@
+"""The single transformer implementation used for every model role.
+
+TPU-native counterpart of reference ``realhf/impl/model/nn/
+real_llm_api.py`` (ReaLModel) + ``real_llm_base.py`` + ``modules/``:
+one decoder-only transformer covering actor / critic / reference /
+reward roles (critic mode swaps the LM head for a scalar value head).
+
+Design (idiomatic JAX, not a torch translation):
+- Parameters are a plain dict pytree with **stacked** block weights
+  (leading axis = layer). The whole stack is scanned with
+  ``jax.lax.scan``, which keeps compile time O(1) in depth and makes
+  resharding between meshes a single device_put of the pytree.
+- Batches are packed streams ``[B, L]`` with segment ids (0 = pad);
+  positions are derived per segment. DP shards B; TP shards heads and
+  MLP; Megatron-style sequence parallelism falls out of GSPMD sharding
+  constraints (see models/sharding.py).
+- Generation uses a per-layer KV cache pytree and a single-token
+  decode step; the jitted decode loop replaces CUDA-graph capture
+  (reference ``nn/real_llm_generate.py:214``).
+
+Layer indexing convention matches the reference (real_llm_base.py:394):
+0 = embedding, 1..n_layers = blocks, n_layers+1 = head -- used by HF
+conversion and (later) pipeline splitting.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.attention import decode_attention, packed_attention
+from realhf_tpu.ops.rotary import apply_rotary, rotary_freqs
+
+Params = Dict[str, Any]
+KVCache = Dict[str, jnp.ndarray]
+
+
+# ----------------------------------------------------------------------
+# Initialization
+# ----------------------------------------------------------------------
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    """Random-normal init (std 0.02, projection layers scaled by
+    1/sqrt(2*n_layers) as in GPT-2/llama lineage)."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    h, f, v = cfg.hidden_dim, cfg.intermediate_dim, cfg.vocab_size
+    nl, hd = cfg.n_layers, cfg.head_dim
+    nq, nkv = cfg.n_q_heads, cfg.n_kv_heads
+    std = 0.02
+    proj_std = std / (2 * nl) ** 0.5
+
+    keys = jax.random.split(key, 16)
+
+    def norm(shape, k, s=std):
+        return (s * jax.random.normal(k, shape)).astype(pdt)
+
+    def zeros(shape):
+        return jnp.zeros(shape, dtype=pdt)
+
+    def ones(shape):
+        return jnp.ones(shape, dtype=pdt)
+
+    params: Params = {
+        "embed": {"wte": norm((v, h), keys[0])},
+        "blocks": {
+            "ln1": {"scale": ones((nl, h))},
+            "attn": {
+                "wq": norm((nl, h, nq * hd), keys[1]),
+                "wk": norm((nl, h, nkv * hd), keys[2]),
+                "wv": norm((nl, h, nkv * hd), keys[3]),
+                "wo": norm((nl, nq * hd, h), keys[4], proj_std),
+            },
+            "ln2": {"scale": ones((nl, h))},
+            "mlp": {},
+        },
+        "ln_f": {"scale": ones((h,))},
+    }
+    if cfg.uses_absolute_position:
+        assert cfg.n_positions is not None
+        params["embed"]["wpe"] = norm(
+            (cfg.n_positions + cfg.abs_position_embedding_offset, h), keys[5])
+
+    mlp = params["blocks"]["mlp"]
+    if cfg.mlp_type == "moe":
+        ne = cfg.moe.num_experts
+        mlp["router"] = norm((nl, h, ne), keys[6])
+        mlp["wg"] = norm((nl, ne, h, f), keys[7])
+        mlp["wu"] = norm((nl, ne, h, f), keys[8])
+        mlp["wd"] = norm((nl, ne, f, h), keys[9], proj_std)
+    elif cfg.gated_mlp:
+        mlp["wg"] = norm((nl, h, f), keys[7])
+        mlp["wu"] = norm((nl, h, f), keys[8])
+        mlp["wd"] = norm((nl, f, h), keys[9], proj_std)
+    else:
+        mlp["wu"] = norm((nl, h, f), keys[8])
+        mlp["wd"] = norm((nl, f, h), keys[9], proj_std)
+
+    if cfg.use_attention_bias:
+        a = params["blocks"]["attn"]
+        a["bq"], a["bk"], a["bv"] = (zeros((nl, nq * hd)),
+                                     zeros((nl, nkv * hd)),
+                                     zeros((nl, nkv * hd)))
+    if cfg.use_attn_proj_bias:
+        params["blocks"]["attn"]["bo"] = zeros((nl, h))
+    if cfg.use_mlp_bias and cfg.mlp_type is None:
+        mlp["bu"] = zeros((nl, f))
+        mlp["bd"] = zeros((nl, h))
+    if cfg.layer_norm_type is None:  # LayerNorm has bias; RMSNorm none
+        params["blocks"]["ln1"]["bias"] = zeros((nl, h))
+        params["blocks"]["ln2"]["bias"] = zeros((nl, h))
+        params["ln_f"]["bias"] = zeros((h,))
+
+    if cfg.is_critic:
+        params["head"] = {"w": norm((h, 1), keys[10])}
+    elif not cfg.tied_embedding:
+        params["head"] = {"w": norm((h, v), keys[10])}
+    return params
+
+
+# ----------------------------------------------------------------------
+# Building blocks
+# ----------------------------------------------------------------------
+def _norm(cfg: TransformerConfig, x: jnp.ndarray, scale: jnp.ndarray,
+          bias: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """LayerNorm / RMSNorm / gemma-RMSNorm with fp32 accumulation."""
+    xf = x.astype(jnp.float32)
+    if cfg.layer_norm_type is None:
+        mean = xf.mean(-1, keepdims=True)
+        var = jnp.mean((xf - mean) ** 2, -1, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+        out = out * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+    elif cfg.layer_norm_type == "rms":
+        var = jnp.mean(xf ** 2, -1, keepdims=True)
+        out = xf * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+        out = out * scale.astype(jnp.float32)
+    elif cfg.layer_norm_type == "gemma":
+        var = jnp.mean(xf ** 2, -1, keepdims=True)
+        out = xf * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+        out = out * (1.0 + scale.astype(jnp.float32))
+    else:
+        raise NotImplementedError(cfg.layer_norm_type)
+    return out.astype(x.dtype)
+
+
+def _activation(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation_function == "silu":
+        return jax.nn.silu(x)
+    if cfg.activation_function == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if cfg.activation_function == "gelu_new":
+        return jax.nn.gelu(x, approximate=True)
+    raise NotImplementedError(cfg.activation_function)
+
+
+def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    cdt = jnp.dtype(cfg.compute_dtype)
+    m = lp["mlp"]
+    if cfg.mlp_type == "moe":
+        try:
+            from realhf_tpu.ops.moe import moe_mlp
+        except ImportError as e:
+            raise NotImplementedError(
+                "MoE forward requires realhf_tpu.ops.moe (not yet built in "
+                "this checkout).") from e
+        return moe_mlp(cfg, m, x)
+    if cfg.gated_mlp:
+        gate = x @ m["wg"].astype(cdt)
+        up = x @ m["wu"].astype(cdt)
+        return _activation(cfg, gate) * up @ m["wd"].astype(cdt)
+    up = x @ m["wu"].astype(cdt)
+    if "bu" in m:
+        up = up + m["bu"].astype(cdt)
+    out = _activation(cfg, up) @ m["wd"].astype(cdt)
+    if "bd" in m:
+        out = out + m["bd"].astype(cdt)
+    return out
+
+
+def _qkv(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
+    cdt = jnp.dtype(cfg.compute_dtype)
+    a = lp["attn"]
+    *lead, _ = x.shape
+    q = x @ a["wq"].astype(cdt)
+    k = x @ a["wk"].astype(cdt)
+    v = x @ a["wv"].astype(cdt)
+    if "bq" in a:
+        q = q + a["bq"].astype(cdt)
+        k = k + a["bk"].astype(cdt)
+        v = v + a["bv"].astype(cdt)
+    q = q.reshape(*lead, cfg.n_q_heads, cfg.head_dim)
+    k = k.reshape(*lead, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(*lead, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _attn_scale(cfg: TransformerConfig, layer_idx: jnp.ndarray) -> jnp.ndarray:
+    scale = cfg.head_dim ** -0.5 if cfg.scale_attn_weights else 1.0
+    if cfg.scale_attn_by_inverse_layer_idx:
+        scale = scale / (layer_idx.astype(jnp.float32) + 1.0)
+    return scale
+
+
+def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
+           x: jnp.ndarray, seg_ids: jnp.ndarray, cos: jnp.ndarray,
+           sin: jnp.ndarray, constrain) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One transformer block over packed streams [B, L, H]; returns
+    (residual output, (k, v)) -- k/v feed prefill KV caches."""
+    ln1 = _norm(cfg, x, lp["ln1"]["scale"], lp["ln1"].get("bias"))
+    q, k, v = _qkv(cfg, lp, ln1)
+    if cfg.apply_rotary:
+        q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
+        k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+    attn = packed_attention(q, k, v, seg_ids, causal=True,
+                            scale=_attn_scale(cfg, layer_idx))
+    attn = attn.reshape(*x.shape[:-1], cfg.n_q_heads * cfg.head_dim)
+    proj = attn @ lp["attn"]["wo"].astype(x.dtype)
+    if "bo" in lp["attn"]:
+        proj = proj + lp["attn"]["bo"].astype(x.dtype)
+    x = constrain(x + proj)
+    ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
+    x = constrain(x + _mlp(cfg, lp, ln2))
+    return x, (k, v)
+
+
+def positions_from_segments(seg_ids: jnp.ndarray) -> jnp.ndarray:
+    """Position of each token within its segment for packed streams.
+
+    [B, L] int32 -> [B, L] int32. Pad tokens get position 0.
+    """
+    idx = jnp.arange(seg_ids.shape[1], dtype=jnp.int32)[None, :]
+    new_seg = jnp.concatenate(
+        [jnp.ones_like(seg_ids[:, :1], dtype=bool),
+         seg_ids[:, 1:] != seg_ids[:, :-1]], axis=1)
+    seg_start = jax.lax.cummax(jnp.where(new_seg, idx, 0), axis=1)
+    return (idx - seg_start).astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# Forward (training / prefill)
+# ----------------------------------------------------------------------
+def forward(
+    cfg: TransformerConfig,
+    params: Params,
+    input_ids: jnp.ndarray,  # [B, L] int32
+    seg_ids: jnp.ndarray,    # [B, L] int32; 0 = padding
+    positions: Optional[jnp.ndarray] = None,  # [B, L]; default from seg_ids
+    *,
+    return_kv: bool = False,
+    activation_constraint=None,
+) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
+    """Packed forward pass -> final hidden states [B, L, H] (after the
+    final norm). Heads are applied separately (`lm_logits`,
+    `critic_values`, or fused ops in `realhf_tpu.ops.ce`).
+
+    ``activation_constraint`` is an optional fn applied to the residual
+    stream each block (sharding constraints; see models/sharding.py).
+    """
+    cdt = jnp.dtype(cfg.compute_dtype)
+    constrain = activation_constraint or (lambda t: t)
+    if positions is None:
+        positions = positions_from_segments(seg_ids)
+
+    x = params["embed"]["wte"].astype(cdt)[input_ids]
+    if cfg.uses_absolute_position:
+        x = x + params["embed"]["wpe"].astype(cdt)[
+            positions + cfg.abs_position_embedding_offset]
+    if cfg.normalize_embed:
+        x = x * jnp.asarray(cfg.hidden_dim ** 0.5, dtype=cdt)
+    x = constrain(x)
+
+    if cfg.apply_rotary:
+        cos, sin = rotary_freqs(positions, cfg.head_dim, cfg.rotary_base,
+                                cfg.rotary_scaling, cfg.rotary_scaling_type,
+                                cfg.n_positions)
+    else:
+        half = cfg.head_dim // 2
+        cos = jnp.ones((*positions.shape, half), jnp.float32)
+        sin = jnp.zeros((*positions.shape, half), jnp.float32)
+
+    def block_fn(lp, layer_idx, carry):
+        # cfg/constrain are non-array closures; seg_ids/cos/sin are
+        # array closures -- jax.checkpoint differentiates through
+        # closed-over arrays correctly.
+        return _block(cfg, lp, layer_idx, carry, seg_ids, cos, sin, constrain)
+
+    if cfg.gradient_checkpointing:
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer):
+        lp, layer_idx = layer
+        y, kv = block_fn(lp, layer_idx, carry)
+        return y, kv if return_kv else None
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, kvs = jax.lax.scan(scan_body, x, (params["blocks"], layer_ids))
+    x = _norm(cfg, x, params["ln_f"]["scale"], params["ln_f"].get("bias"))
+    return x, kvs
+
+
+def lm_logits(cfg: TransformerConfig, params: Params,
+              hidden: jnp.ndarray) -> jnp.ndarray:
+    """[..., H] -> [..., V] logits in fp32."""
+    w = head_weight(cfg, params)
+    return jnp.einsum("...h,hv->...v", hidden, w.astype(hidden.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def head_weight(cfg: TransformerConfig, params: Params) -> jnp.ndarray:
+    if cfg.is_critic:
+        return params["head"]["w"]
+    if cfg.tied_embedding:
+        return params["embed"]["wte"].T
+    return params["head"]["w"]
+
+
+def critic_values(cfg: TransformerConfig, params: Params,
+                  hidden: jnp.ndarray) -> jnp.ndarray:
+    """[..., H] -> [...] scalar values in fp32."""
+    assert cfg.is_critic
+    w = params["head"]["w"]
+    return jnp.einsum("...h,ho->...o", hidden, w.astype(hidden.dtype),
+                      preferred_element_type=jnp.float32)[..., 0]
+
+
+# ----------------------------------------------------------------------
+# KV cache + decode step (generation)
+# ----------------------------------------------------------------------
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
+                  dtype=None) -> KVCache:
+    """Padded KV cache sized max_prompt_len + max_new_tokens, matching
+    reference `prepare_generate_inputs` (real_llm_generate.py:179)."""
+    dtype = dtype or jnp.dtype(cfg.compute_dtype)
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "valid": jnp.zeros((batch, max_len), bool),
+        "length": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(cfg: TransformerConfig, params: Params, input_ids: jnp.ndarray,
+            seg_ids: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
+            *, activation_constraint=None) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the packed forward and materialize a KV cache whose first
+    L slots hold the prompt keys/values."""
+    hidden, kvs = forward(cfg, params, input_ids, seg_ids, positions,
+                          return_kv=True,
+                          activation_constraint=activation_constraint)
+    k, v = kvs  # [nl, B, L, nkv, hd]
+    cache = {
+        "k": k,
+        "v": v,
+        "valid": seg_ids != 0,
+        "length": jnp.full((input_ids.shape[0],), input_ids.shape[1],
+                           jnp.int32),
+    }
+    return hidden, cache
+
+
+def extend_kv_cache(cache: KVCache, extra: int) -> KVCache:
+    """Grow the cache along the slot axis by `extra` zero slots."""
+    nl, b, s, nkv, hd = cache["k"].shape
+    pad = lambda a: jnp.concatenate(
+        [a, jnp.zeros((nl, b, extra, nkv, hd), a.dtype)], axis=2)
+    return {
+        "k": pad(cache["k"]),
+        "v": pad(cache["v"]),
+        "valid": jnp.concatenate(
+            [cache["valid"], jnp.zeros((b, extra), bool)], axis=1),
+        "length": cache["length"],
+    }
+
+
+def decode_step(
+    cfg: TransformerConfig,
+    params: Params,
+    cache: KVCache,
+    token: jnp.ndarray,      # [B] int32 -- the token to feed
+    positions: jnp.ndarray,  # [B] int32 -- its position in the sequence
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One decode step: feed `token`, return hidden [B, H] for the next
+    token's logits and the updated cache. The jitted decode loop built
+    on this replaces CUDA-graph decoding (reference
+    real_llm_generate.py:214, cuda_graph.py)."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    b = token.shape[0]
+    slot = cache["length"]  # write position per stream
+
+    x = params["embed"]["wte"].astype(cdt)[token]
+    if cfg.uses_absolute_position:
+        x = x + params["embed"]["wpe"].astype(cdt)[
+            positions + cfg.abs_position_embedding_offset]
+    if cfg.normalize_embed:
+        x = x * jnp.asarray(cfg.hidden_dim ** 0.5, dtype=cdt)
+
+    if cfg.apply_rotary:
+        cos, sin = rotary_freqs(positions, cfg.head_dim, cfg.rotary_base,
+                                cfg.rotary_scaling, cfg.rotary_scaling_type,
+                                cfg.n_positions)
+    else:
+        half = cfg.head_dim // 2
+        cos = jnp.ones((b, half), jnp.float32)
+        sin = jnp.zeros((b, half), jnp.float32)
+
+    valid = cache["valid"].at[jnp.arange(b), slot].set(True)
+    new_len = slot + 1
+
+    def body(x, layer):
+        lp, layer_idx, k_cache, v_cache = layer
+        ln1 = _norm(cfg, x, lp["ln1"]["scale"], lp["ln1"].get("bias"))
+        q, k, v = _qkv(cfg, lp, ln1)  # q: [B, nq, hd]; k/v: [B, nkv, hd]
+        if cfg.apply_rotary:
+            q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
+            k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+        k_cache = k_cache.at[jnp.arange(b), slot].set(k)
+        v_cache = v_cache.at[jnp.arange(b), slot].set(v)
+        attn = decode_attention(q, k_cache, v_cache, valid,
+                                scale=_attn_scale(cfg, layer_idx))
+        proj = attn.reshape(b, -1) @ lp["attn"]["wo"].astype(x.dtype)
+        if "bo" in lp["attn"]:
+            proj = proj + lp["attn"]["bo"].astype(x.dtype)
+        x = x + proj
+        ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
+        x = x + _mlp(cfg, lp, ln2)
+        return x, (k_cache, v_cache)
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], layer_ids, cache["k"], cache["v"]))
+    x = _norm(cfg, x, params["ln_f"]["scale"], params["ln_f"].get("bias"))
+    new_cache = {"k": new_k, "v": new_v, "valid": valid, "length": new_len}
+    return x, new_cache

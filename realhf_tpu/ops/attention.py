@@ -1,0 +1,127 @@
+"""Attention over packed variable-length sequences.
+
+TPU-native replacement for the reference's flash-attn usage
+(``realhf/impl/model/modules/attn.py:20-23``): packed batches carry
+segment ids instead of cu_seqlens -- tokens attend only within their
+own segment, causally. Two paths:
+
+- ``packed_attention``: training/prefill attention on ``[B, L]``
+  packed streams. Default implementation is pure XLA (einsum + fp32
+  softmax with segment masking); a Pallas flash kernel
+  (``realhf_tpu.ops.flash_attention``) is used on TPU for long L.
+- ``decode_attention``: single-token decode against a padded KV cache
+  (replaces ``flash_attn_with_kvcache``).
+
+Segment id 0 marks padding; valid segments are >= 1.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0 ** 30  # large finite value; -inf breaks softmax for all-masked rows
+
+
+def _segment_mask(seg_q: jnp.ndarray, seg_k: jnp.ndarray,
+                  causal: bool) -> jnp.ndarray:
+    """[B, Lq, Lk] bool mask: same non-zero segment (+ causality)."""
+    mask = (seg_q[:, :, None] == seg_k[:, None, :]) & (seg_q[:, :, None] != 0)
+    if causal:
+        lq, lk = seg_q.shape[1], seg_k.shape[1]
+        idx_q = jnp.arange(lq)[:, None]
+        idx_k = jnp.arange(lk)[None, :]
+        mask = mask & (idx_q >= idx_k)[None]
+    return mask
+
+
+def packed_attention_xla(
+    q: jnp.ndarray,  # [B, L, nq, hd]
+    k: jnp.ndarray,  # [B, L, nkv, hd]
+    v: jnp.ndarray,  # [B, L, nkv, hd]
+    seg_ids: jnp.ndarray,  # [B, L] int32, 0 = padding
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Reference XLA implementation; O(L^2) scores in fp32.
+
+    GQA is expressed by grouping query heads over each KV head so the
+    einsum keeps a single contraction (MXU-friendly).
+    """
+    b, l, nq, hd = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    scale = scale if scale is not None else hd ** -0.5
+
+    qg = q.reshape(b, l, nkv, group, hd)
+    # [B, nkv, g, Lq, Lk]
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap is not None:
+        scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
+    mask = _segment_mask(seg_ids, seg_ids, causal)[:, None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, l, nq, hd).astype(q.dtype)
+
+
+def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
+                     logits_soft_cap=None, use_flash: Optional[bool] = None):
+    """Dispatch between the Pallas flash kernel (TPU) and the XLA path.
+
+    ``use_flash=None`` auto-selects: flash on TPU backends when shapes
+    meet the kernel's tiling constraints, XLA otherwise (CPU tests).
+    """
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu"
+                     and q.shape[1] % 128 == 0 and q.shape[3] >= 64)
+    if use_flash:
+        try:
+            from realhf_tpu.ops.flash_attention import flash_attention
+        except ImportError:
+            flash_attention = None
+        if flash_attention is not None:
+            return flash_attention(q, k, v, seg_ids, causal=causal,
+                                   scale=scale,
+                                   logits_soft_cap=logits_soft_cap)
+    return packed_attention_xla(q, k, v, seg_ids, causal=causal, scale=scale,
+                                logits_soft_cap=logits_soft_cap)
+
+
+def decode_attention(
+    q: jnp.ndarray,        # [B, nq, hd] -- one new token per stream
+    k_cache: jnp.ndarray,  # [B, S, nkv, hd]
+    v_cache: jnp.ndarray,  # [B, S, nkv, hd]
+    valid_mask: jnp.ndarray,  # [B, S] bool: which cache slots hold real
+                              # tokens (left-padded prompts leave invalid
+                              # low slots, so a prefix length is not enough)
+    *,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-step decode attention against a padded KV cache.
+
+    The caller has already written the new token's K/V (and marked its
+    slot valid). Replaces `flash_attn_with_kvcache`
+    (reference ``attn.py:238``).
+    """
+    b, nq, hd = q.shape
+    s, nkv = k_cache.shape[1], k_cache.shape[2]
+    group = nq // nkv
+    scale = scale if scale is not None else hd ** -0.5
+
+    qg = q.reshape(b, nkv, group, hd)
+    scores = jnp.einsum("bhgd,bkhd->bhgk", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap is not None:
+        scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
+    scores = jnp.where(valid_mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgk,bkhd->bhgd", probs.astype(v_cache.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, nq, hd).astype(q.dtype)

@@ -1,0 +1,63 @@
+"""Rotary position embeddings.
+
+Parity with reference ``realhf/impl/model/modules/rotary.py``
+(RotaryEmbedding:121 + linear/dynamic-NTK scaling :175-242), computed
+functionally: frequencies are derived from explicit position ids, so
+packed sequences and KV-cache decode use the same code path.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rotary_freqs(positions: jnp.ndarray, head_dim: int, base: float,
+                 scaling: Optional[float] = None,
+                 scaling_type: Optional[str] = None,
+                 max_positions: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given integer positions.
+
+    positions: any integer array shape ``S``; returns cos/sin of shape
+    ``S + (head_dim // 2,)`` in fp32.
+    """
+    if scaling_type is not None and scaling is None:
+        raise ValueError("rotary scaling_type set but scaling factor is None")
+    if scaling_type == "linear":
+        positions = positions / scaling
+    elif scaling_type == "dynamic":
+        if max_positions is None:
+            raise ValueError("dynamic NTK rotary scaling requires max_positions")
+        # Dynamic NTK: enlarge the base when sequences exceed the
+        # trained context (reference rotary.py:206-242).
+        seq_len = positions.max() + 1
+        ratio = jnp.maximum(seq_len / max_positions, 1.0)
+        dim = head_dim
+        base = base * (scaling * ratio - (scaling - 1)) ** (dim / (dim - 2))
+    elif scaling_type is not None:
+        raise NotImplementedError(f"rotary scaling type {scaling_type}")
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                 interleaved: bool = False) -> jnp.ndarray:
+    """Rotate q or k. x: [..., n_heads, head_dim]; cos/sin broadcast over
+    the head axis: [..., head_dim//2]."""
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    if interleaved:
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    else:
+        half = x.shape[-1] // 2
+        x1 = x[..., :half]
+        x2 = x[..., half:]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.concatenate([o1, o2], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,153 @@
+"""Device meshes and the per-model parallelism context.
+
+TPU-native replacement for reference ``realhf/base/topology.py``
+(`ProcessTopology`/`ParallelGrid`) and the ambient parallelism globals
+in ``realhf/base/constants.py:170-513``: each model (one node of the
+dataflow graph) owns a `jax.sharding.Mesh` over a slice of the
+device fleet plus a `ParallelismConfig`. GSPMD + pjit derive all
+collectives from shardings, so there are no explicit communication
+groups to build -- the mesh IS the topology.
+
+Axis convention (stable across the framework):
+  - "pipe":  pipeline stages (size 1 until PP lands; specs may
+             reference it safely).
+  - "data":  data parallelism over packed sequence streams.
+  - "model": tensor parallelism; with ``sequence_parallel`` the
+             sequence dim of activations is also sharded over this
+             axis in norm/residual regions (Megatron-SP analog,
+             free under GSPMD).
+"""
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from realhf_tpu.api.config import ModelName
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelismConfig:
+    """3D parallelism degrees of one model, mirroring reference
+    ``api/quickstart/model.py:15`` (ParallelismConfig)."""
+    data_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    sequence_parallel: bool = False
+    gradient_checkpointing: bool = False
+
+    def __post_init__(self):
+        if self.sequence_parallel and self.tensor_parallel_size == 1:
+            object.__setattr__(self, "sequence_parallel", False)
+
+    @property
+    def world_size(self) -> int:
+        return (self.data_parallel_size * self.tensor_parallel_size *
+                self.pipeline_parallel_size)
+
+    def __str__(self):
+        return (f"d{self.data_parallel_size}t{self.tensor_parallel_size}"
+                f"p{self.pipeline_parallel_size}")
+
+
+def default_devices() -> List:
+    """Device fleet used when no explicit slice is given.
+
+    ``REALHF_TPU_BACKEND`` overrides the platform (tests set it to
+    "cpu" to get the virtual 8-device CPU mesh even when a TPU plugin
+    is registered as the default backend).
+    """
+    backend = os.environ.get("REALHF_TPU_BACKEND")
+    return list(jax.devices(backend) if backend else jax.devices())
+
+
+def make_mesh(parallel: ParallelismConfig,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build the (pipe, data, model) mesh for one model over the given
+    device slice (defaults to all local devices).
+
+    Device ordering follows jax's default enumeration, which on real
+    TPU slices keeps ICI neighbors adjacent -- the "model" (innermost)
+    axis therefore rides the fastest links, matching the reference's
+    placement of TP on NVLink (`docs/source/impl.rst`).
+    """
+    devices = list(devices) if devices is not None else default_devices()
+    if parallel.world_size != len(devices):
+        raise ValueError(
+            f"Parallelism {parallel} needs {parallel.world_size} devices, "
+            f"got {len(devices)}.")
+    arr = np.array(devices).reshape(
+        parallel.pipeline_parallel_size,
+        parallel.data_parallel_size,
+        parallel.tensor_parallel_size)
+    return Mesh(arr, MESH_AXES)
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """Everything parallelism-related about one model instance:
+    replaces the reference's `ParallelGrid` + `constants.model_scope`
+    ambient state with an explicit object."""
+    model_name: ModelName
+    mesh: Mesh
+    parallel: ParallelismConfig
+
+    @property
+    def dp_size(self) -> int:
+        return self.parallel.data_parallel_size
+
+    @property
+    def tp_size(self) -> int:
+        return self.parallel.tensor_parallel_size
+
+    @property
+    def pp_size(self) -> int:
+        return self.parallel.pipeline_parallel_size
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+
+# ----------------------------------------------------------------------
+# Optional ambient registry. The runtime registers one MeshContext per
+# model and switches scope around interface calls, mirroring
+# `constants.model_scope` (reference constants.py:170) for code that
+# cannot take the context as an argument.
+# ----------------------------------------------------------------------
+_local = threading.local()
+_contexts: Dict[ModelName, MeshContext] = {}
+
+
+def register_context(ctx: MeshContext):
+    _contexts[ctx.model_name] = ctx
+
+
+def clear_contexts():
+    _contexts.clear()
+
+
+@contextlib.contextmanager
+def model_scope(model_name: ModelName):
+    prev = getattr(_local, "active", None)
+    _local.active = _contexts[model_name]
+    try:
+        yield _local.active
+    finally:
+        _local.active = prev
+
+
+def current_context() -> MeshContext:
+    ctx = getattr(_local, "active", None)
+    if ctx is None:
+        raise RuntimeError("No active model scope; use model_scope(...).")
+    return ctx
