@@ -1,0 +1,88 @@
+"""Model API: the Model wrapper, ModelInterface ABC, and registries.
+
+Parity with reference ``realhf/api/core/model_api.py``: a `Model`
+bundles one LLM instance (engine + tokenizer + version counters); a
+`ModelInterface` implements the algorithm-specific handlers
+(generate / inference / train_step / evaluate / save) that MFC nodes
+reference by registry name (register_interface, model_api.py:641-658).
+"""
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from realhf_tpu.api.config import (
+    ModelAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+)
+from realhf_tpu.api.data import SequenceSample
+
+
+@dataclasses.dataclass
+class ModelVersion:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+    def inc(self):
+        self.epoch_step += 1
+        self.global_step += 1
+
+
+@dataclasses.dataclass
+class Model:
+    """One LLM instance living on a mesh (reference model_api.py:470)."""
+    name: ModelName
+    engine: Any  # realhf_tpu.engine.engine.Engine
+    tokenizer: Any
+    hf_family: str = "llama"
+    version: ModelVersion = dataclasses.field(default_factory=ModelVersion)
+
+    @property
+    def config(self):
+        return self.engine.cfg
+
+    def inc_version(self):
+        self.version.inc()
+
+
+class ModelInterface(abc.ABC):
+    """Algorithm handlers; all default to unimplemented
+    (reference model_api.py:605-640)."""
+
+    def save(self, model: Model, save_dir: str):
+        pass
+
+    def evaluate(self, model: Model, eval_dataloader) -> Dict:
+        return {}
+
+    def inference(self, model: Model, input_: SequenceSample,
+                  n_mbs: Optional[int] = None) -> SequenceSample:
+        raise NotImplementedError()
+
+    def generate(self, model: Model, input_: SequenceSample,
+                 n_mbs: Optional[int] = None) -> SequenceSample:
+        raise NotImplementedError()
+
+    def train_step(self, model: Model, input_: SequenceSample,
+                   n_mbs: Optional[int] = None) -> Dict:
+        raise NotImplementedError()
+
+    # Profiler hook (reference model_api.py:619): build synthetic inputs.
+    def mock(self, interface_type: str, model: Model,
+             input_: SequenceSample) -> SequenceSample:
+        raise NotImplementedError()
+
+
+ALL_INTERFACE_CLASSES: Dict[str, Callable[..., ModelInterface]] = {}
+
+
+def register_interface(name: str, cls):
+    if name in ALL_INTERFACE_CLASSES:
+        raise ValueError(f"Interface {name} already registered.")
+    ALL_INTERFACE_CLASSES[name] = cls
+
+
+def make_interface(cfg: ModelInterfaceAbstraction) -> ModelInterface:
+    return ALL_INTERFACE_CLASSES[cfg.type_](**cfg.args)

@@ -1,0 +1,220 @@
+"""The per-model execution engine: train / inference / generate.
+
+TPU-native replacement for the reference's `PipelinableEngine` ABC
+(``realhf/api/core/model_api.py:305-463``) and its implementations
+(``backend/inference.py:21``, ``backend/megatron.py:702``,
+``backend/pipe_runner.py:779``): one class wraps a sharded parameter
+pytree on the model's mesh and exposes
+
+  - ``train_batch(microbatches, loss_fn)``: jitted value_and_grad with
+    gradient accumulation over a scanned microbatch stack, global-norm
+    clipping, optax update (AdamW + schedule). Grad accumulation over
+    a scan replaces Megatron's DDP no_sync loop (megatron.py:726-797);
+    mixed precision is bf16 compute over fp32 master params, so the
+    loss-scaler machinery disappears.
+  - ``forward(fn_name, ...)``: jitted inference helpers (logprobs,
+    values, scores, hidden).
+  - ``generate(...)``: the jitted KV-cache decode loop.
+
+All methods consume/produce device arrays in [S, L] stream layout;
+the algorithm interfaces do SequenceSample <-> stream packing.
+"""
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from realhf_tpu.base import logging
+from realhf_tpu.engine import generation as gen_mod
+from realhf_tpu.engine.optim import OptimizerConfig, make_optimizer
+from realhf_tpu.models import sharding as shard_rules
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops import functional as F
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+from realhf_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger("engine")
+
+LossFn = Callable[[Any, Dict[str, jnp.ndarray]],
+                  Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+class Engine:
+
+    def __init__(self,
+                 cfg: TransformerConfig,
+                 ctx: MeshContext,
+                 params: Any,
+                 optimizer: Optional[OptimizerConfig] = None,
+                 total_train_steps: Optional[int] = None):
+        self.cfg = cfg
+        self.ctx = ctx
+        self.mesh = ctx.mesh
+        self.version = 0
+
+        self._param_shardings = shard_rules.param_shardings(cfg, self.mesh)
+        self.params = jax.device_put(params, self._param_shardings)
+        self._constrain = shard_rules.activation_constraint(
+            self.mesh, ctx.parallel.sequence_parallel)
+
+        self.optimizer_config = optimizer
+        if optimizer is not None and optimizer.type != "empty":
+            self._tx = make_optimizer(optimizer, total_train_steps)
+            init = jax.jit(self._tx.init)
+            self.opt_state = init(self.params)
+        else:
+            self._tx = None
+            self.opt_state = None
+
+        self._train_step_cache: Dict[Any, Callable] = {}
+        self._generate_cache: Dict[Any, Callable] = {}
+        self._jit_forward_hidden = None
+        self._jit_logprobs = None
+        self._jit_values = None
+
+    # ------------------------------------------------------------------
+    # Training
+    # ------------------------------------------------------------------
+    def _build_train_step(self, loss_fn: LossFn) -> Callable:
+
+        def step(params, opt_state, mbs: Dict[str, jnp.ndarray],
+                 mb_weights: jnp.ndarray):
+            """mbs: dict of stacked arrays with leading dim n_mbs;
+            mb_weights: [n_mbs] relative weight (e.g. token counts) used
+            to average gradients exactly as one large batch would."""
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def accum(carry, x):
+                gsum = carry
+                mb, w = x
+                (loss, stats), grads = grad_fn(params, mb)
+                gsum = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32) * w, gsum, grads)
+                return gsum, (loss, stats)
+
+            wsum = mb_weights.sum()
+            gsum, (losses, stats) = jax.lax.scan(
+                accum, zero, (mbs, mb_weights / wsum))
+            updates, new_opt = self._tx.update(gsum, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            gnorm = optax.global_norm(gsum)
+            mean_stats = jax.tree.map(
+                lambda s: (s * mb_weights / wsum).sum(), stats)
+            mean_loss = (losses * mb_weights / wsum).sum()
+            return new_params, new_opt, mean_loss, mean_stats, gnorm
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_batch(self, microbatches: List[Dict[str, np.ndarray]],
+                    loss_fn: LossFn,
+                    loss_weights: Optional[List[float]] = None,
+                    loss_fn_key: Optional[str] = None) -> Dict[str, float]:
+        """Run one optimizer step over the microbatches.
+
+        All microbatches must share array shapes (the packer pads them
+        to a common bucket); they are stacked and scanned on-device.
+        """
+        if self._tx is None:
+            raise RuntimeError("Engine has no optimizer (inference-only).")
+        key = loss_fn_key or loss_fn
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = self._build_train_step(loss_fn)
+        step = self._train_step_cache[key]
+
+        stacked = {
+            k: jnp.stack([jnp.asarray(mb[k]) for mb in microbatches])
+            for k in microbatches[0]
+        }
+        if loss_weights is None:
+            loss_weights = [1.0] * len(microbatches)
+        weights = jnp.asarray(loss_weights, jnp.float32)
+
+        self.params, self.opt_state, loss, stats, gnorm = step(
+            self.params, self.opt_state, stacked, weights)
+        self.version += 1
+        out = {k: float(v) for k, v in stats.items()}
+        out["loss"] = float(loss)
+        out["grad_norm"] = float(gnorm)
+        return out
+
+    # ------------------------------------------------------------------
+    # Inference
+    # ------------------------------------------------------------------
+    def forward_hidden(self, input_ids, seg_ids):
+        if self._jit_forward_hidden is None:
+            @jax.jit
+            def f(params, ids, seg):
+                h, _ = T.forward(self.cfg, params, ids, seg,
+                                 activation_constraint=self._constrain)
+                return h
+            self._jit_forward_hidden = f
+        return self._jit_forward_hidden(self.params, jnp.asarray(input_ids),
+                                        jnp.asarray(seg_ids))
+
+    def forward_logprobs(self, input_ids, seg_ids, temperature: float = 1.0,
+                         logits_mask=None):
+        """Next-token logprobs [S, L] (the reference's `inference` MFC
+        on actor/ref models, ppo_interface.py:255)."""
+        if self._jit_logprobs is None:
+            @functools.partial(jax.jit, static_argnames=("temp", "has_mask"))
+            def f(params, ids, seg, mask, temp, has_mask):
+                h, _ = T.forward(self.cfg, params, ids, seg,
+                                 activation_constraint=self._constrain)
+                return F.shifted_logprobs_from_hidden(
+                    self.cfg, params, h, ids, seg, temperature=temp,
+                    logits_mask=mask if has_mask else None)
+            self._jit_logprobs = f
+        mask = jnp.asarray(logits_mask) if logits_mask is not None else \
+            jnp.zeros((1,), bool)
+        return self._jit_logprobs(self.params, jnp.asarray(input_ids),
+                                  jnp.asarray(seg_ids), mask,
+                                  temp=temperature,
+                                  has_mask=logits_mask is not None)
+
+    def forward_values(self, input_ids, seg_ids):
+        """Critic/reward scalar outputs [S, L]."""
+        assert self.cfg.is_critic
+        if self._jit_values is None:
+            @jax.jit
+            def f(params, ids, seg):
+                h, _ = T.forward(self.cfg, params, ids, seg,
+                                 activation_constraint=self._constrain)
+                return T.critic_values(self.cfg, params, h)
+            self._jit_values = f
+        return self._jit_values(self.params, jnp.asarray(input_ids),
+                                jnp.asarray(seg_ids))
+
+    # ------------------------------------------------------------------
+    # Generation
+    # ------------------------------------------------------------------
+    def generate(self, prompt_ids, prompt_seg, prompt_pos, key,
+                 gconfig: GenerationHyperparameters,
+                 eos_token_id: Optional[int], pad_token_id: int
+                 ) -> gen_mod.GenerationOutput:
+        cache_key = (gconfig, eos_token_id, pad_token_id)
+        if cache_key not in self._generate_cache:
+            self._generate_cache[cache_key] = gen_mod.build_generate_fn(
+                self.cfg, gconfig, eos_token_id, pad_token_id,
+                activation_constraint=self._constrain)
+        fn = self._generate_cache[cache_key]
+        return fn(self.params, jnp.asarray(prompt_ids),
+                  jnp.asarray(prompt_seg), jnp.asarray(prompt_pos), key)
+
+    # ------------------------------------------------------------------
+    def set_params(self, params, already_sharded: bool = False):
+        """Install new weights (parameter reallocation landing point)."""
+        self.params = params if already_sharded else jax.device_put(
+            params, self._param_shardings)
+
+    def params_numpy(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def inc_version(self):
+        self.version += 1

@@ -1,0 +1,142 @@
+"""Jitted autoregressive generation with KV cache + sampling.
+
+TPU-native replacement for reference ``realhf/impl/model/nn/
+real_llm_generate.py`` (generate:252) and its CUDA-graph decode
+(cuda_graph.py): prefill + a `lax.scan` decode loop compiled once per
+(batch, prompt-bucket, max_new_tokens) shape -- the XLA executable IS
+the captured graph. Supports temperature / top-k / top-p, greedy,
+min/max new tokens, EOS+pad handling, per-step sampled logprobs, and
+the logits-mask output PPO replays later (genstep:131-136).
+"""
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.sampling import (
+    NEG_INF,
+    GenerationHyperparameters,
+    top_k_top_p_logits,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GenerationOutput:
+    """Results in [B, max_new_tokens] layout; `lengths` counts the
+    generated tokens per stream (including the EOS if emitted)."""
+    tokens: jnp.ndarray          # int32 [B, T], pad_id beyond lengths
+    logprobs: jnp.ndarray        # fp32 [B, T] of the sampled tokens
+    logits_mask: Optional[jnp.ndarray]  # bool [B, T, V], True = allowed
+    lengths: jnp.ndarray         # int32 [B]
+    no_eos_mask: jnp.ndarray     # bool [B]: True if never emitted EOS
+
+
+def generate(
+    cfg: TransformerConfig,
+    params,
+    prompt_ids: jnp.ndarray,   # [B, Lp] left-padded
+    prompt_seg: jnp.ndarray,   # [B, Lp] 1 over content, 0 over pads
+    prompt_pos: jnp.ndarray,   # [B, Lp]
+    key: jax.Array,
+    gconfig: GenerationHyperparameters,
+    *,
+    eos_token_id: Optional[int],
+    pad_token_id: int,
+    activation_constraint=None,
+) -> GenerationOutput:
+    """Functional generation; wrap in jax.jit with gconfig/eos/pad
+    static. See `build_generate_fn` for the cached jitted wrapper."""
+    b, lp = prompt_ids.shape
+    prompt_lens = (prompt_seg != 0).sum(-1).astype(jnp.int32)
+
+    hidden, cache = T.prefill(cfg, params, prompt_ids, prompt_seg, prompt_pos,
+                              activation_constraint=activation_constraint)
+    cache = T.extend_kv_cache(cache, gconfig.max_new_tokens)
+    last_hidden = hidden[:, -1]  # left padding => last column is last token
+
+    def sample_step(logits, step_idx, unfinished, k):
+        logits = logits.astype(jnp.float32)
+        eos_suppress = None
+        if eos_token_id is not None and gconfig.min_new_tokens > 0:
+            eos_suppress = (
+                (step_idx < gconfig.min_new_tokens)
+                & (jnp.arange(logits.shape[-1])[None, :] == eos_token_id))
+            logits = jnp.where(eos_suppress, NEG_INF, logits)
+        if gconfig.greedy:
+            warped = logits
+            tokens = jnp.argmax(warped, -1).astype(jnp.int32)
+        else:
+            warped = top_k_top_p_logits(logits / gconfig.temperature,
+                                        gconfig.top_k, gconfig.top_p)
+            if eos_suppress is not None:
+                # Re-pin after temperature scaling so the mask threshold
+                # below classifies the suppressed EOS as disallowed.
+                warped = jnp.where(eos_suppress, NEG_INF, warped)
+            tokens = jax.random.categorical(k, warped, -1).astype(jnp.int32)
+        logp = jax.nn.log_softmax(warped, -1)
+        logprob = jnp.take_along_axis(logp, tokens[:, None], -1)[:, 0]
+        mask = warped > NEG_INF / 2
+        tokens = jnp.where(unfinished, tokens, pad_token_id)
+        if eos_token_id is not None:
+            unfinished = unfinished & (tokens != eos_token_id)
+        return tokens, logprob, mask, unfinished
+
+    keys = jax.random.split(key, gconfig.max_new_tokens)
+
+    def body(carry, x):
+        last_hidden, cache, unfinished, emitted = carry
+        step_idx, k = x
+        logits = T.lm_logits(cfg, params, last_hidden)
+        was_unfinished = unfinished
+        tokens, logprob, mask, unfinished = sample_step(
+            logits, step_idx, unfinished, k)
+        emitted = emitted + was_unfinished.astype(jnp.int32)
+        pos = prompt_lens + step_idx
+        new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos)
+        out = (tokens, logprob, mask) if not gconfig.force_no_logits_mask \
+            else (tokens, logprob)
+        return (new_hidden, cache, unfinished, emitted), out
+
+    init = (last_hidden, cache, jnp.ones((b,), bool), jnp.zeros((b,), jnp.int32))
+    (_, _, unfinished, emitted), outs = jax.lax.scan(
+        body, init, (jnp.arange(gconfig.max_new_tokens), keys))
+
+    if gconfig.force_no_logits_mask:
+        tokens, logprobs = outs
+        logits_mask = None
+    else:
+        tokens, logprobs, logits_mask = outs
+        logits_mask = logits_mask.swapaxes(0, 1)  # [B, T, V]
+    tokens = tokens.T  # [B, T]
+    logprobs = logprobs.T
+    return GenerationOutput(
+        tokens=tokens,
+        logprobs=logprobs,
+        logits_mask=logits_mask,
+        lengths=emitted,
+        no_eos_mask=unfinished,
+    )
+
+
+def build_generate_fn(cfg: TransformerConfig,
+                      gconfig: GenerationHyperparameters,
+                      eos_token_id: Optional[int], pad_token_id: int,
+                      activation_constraint=None):
+    """Jitted generate closure; XLA caches compilations per
+    batch/bucket shape. Engines build this once and reuse it."""
+    fn = functools.partial(generate, cfg, gconfig=gconfig,
+                           eos_token_id=eos_token_id,
+                           pad_token_id=pad_token_id,
+                           activation_constraint=activation_constraint)
+
+    @jax.jit
+    def run(params, prompt_ids, prompt_seg, prompt_pos, key):
+        return fn(params, prompt_ids, prompt_seg, prompt_pos, key)
+
+    return run

@@ -1,0 +1,74 @@
+"""Optimizer construction (optax) + LR schedules.
+
+Parity with reference ``realhf/api/quickstart/model.py:62``
+(OptimizerConfig) and ``base/timeutil.py:118-216`` (LR schedulers) +
+Megatron's OptimizerParamScheduler usage (backend/megatron.py:158).
+The reference's ZeRO-1 DistributedOptimizer is unnecessary machinery
+here: optimizer state is a pytree that shards exactly like params
+(GSPMD), and can additionally be sharded over the DP axis.
+"""
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Mirrors reference OptimizerConfig field-by-field (type "empty"
+    means no optimizer -- inference-only model)."""
+    type: str = "adam"  # adam | empty
+    lr: float = 1e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "cosine"  # linear | cosine | constant
+    warmup_steps_proportion: float = 0.02
+    gradient_clipping: float = 1.0
+    # fp16 loss scaling is irrelevant on TPU (bf16 training); kept for
+    # config-surface parity and ignored.
+    initial_loss_scale: float = 2 ** 32
+    offload: bool = False
+
+
+def lr_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
+    warmup = max(1, int(cfg.warmup_steps_proportion * total_steps))
+    decay_steps = max(1, total_steps - warmup)
+    end = cfg.lr * cfg.min_lr_ratio
+    if cfg.lr_scheduler_type == "constant":
+        decay = optax.constant_schedule(cfg.lr)
+    elif cfg.lr_scheduler_type == "linear":
+        decay = optax.linear_schedule(cfg.lr, end, decay_steps)
+    elif cfg.lr_scheduler_type == "cosine":
+        alpha = cfg.min_lr_ratio
+        decay = optax.cosine_decay_schedule(cfg.lr, decay_steps, alpha=alpha)
+    else:
+        raise NotImplementedError(cfg.lr_scheduler_type)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, cfg.lr, warmup), decay], [warmup])
+
+
+def make_optimizer(cfg: OptimizerConfig,
+                   total_steps: Optional[int] = None
+                   ) -> optax.GradientTransformation:
+    if cfg.type == "empty":
+        return optax.identity()
+    if cfg.type != "adam":
+        raise NotImplementedError(f"Optimizer type {cfg.type}")
+    sched = lr_schedule(cfg, total_steps or 10 ** 9)
+    chain = []
+    if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+        chain.append(optax.clip_by_global_norm(cfg.gradient_clipping))
+    # Decay only matrix-shaped params (norm scales/biases excluded),
+    # matching Megatron's no-weight-decay param groups.
+    def decay_mask(params):
+        import jax
+        return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+    chain.append(optax.adamw(
+        learning_rate=sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+        weight_decay=cfg.weight_decay, mask=decay_mask))
+    return optax.chain(*chain)

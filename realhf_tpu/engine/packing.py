@@ -1,0 +1,132 @@
+"""Packing variable-length sequences into static-shaped streams.
+
+The bridge between the host data plane (SequenceSample: ragged packed
+1D arrays) and XLA's static shapes: sequences are binned into
+``n_streams`` token-balanced streams (first-fit decreasing, the same
+balancing contract as reference ``datapack.min_abs_diff_partition``),
+each stream is one row of a [S, L] matrix with segment ids, and L is
+rounded up to a bucket multiple so recompilation is bounded.
+
+The reference needs no such step because flash-attn consumes ragged
+cu_seqlens directly (``docs/source/arch.rst`` "Data Packing"); on TPU
+the segment-id matrix is the idiomatic equivalent (same zero-padding
+waste bound: at most one bucket per stream).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKET = 128
+
+
+@dataclasses.dataclass
+class PackInfo:
+    """Where each sequence landed: parallel lists over sequences."""
+    stream: List[int]
+    offset: List[int]
+    length: List[int]
+    n_streams: int
+    max_len: int
+
+    @property
+    def n_seqs(self) -> int:
+        return len(self.stream)
+
+
+def plan_packing(seqlens: Sequence[int], n_streams: int,
+                 bucket: int = DEFAULT_BUCKET,
+                 min_len: Optional[int] = None) -> PackInfo:
+    """Assign sequences to streams, longest-first onto the emptiest
+    stream (balanced token counts)."""
+    seqlens = np.asarray(seqlens)
+    if len(seqlens) == 0:
+        raise ValueError("Cannot pack an empty sequence list.")
+    # Fewer sequences than streams is fine: surplus streams stay
+    # all-padding (seg_ids 0) and are masked out everywhere.
+    stream_tokens = np.zeros(n_streams, np.int64)
+    stream_of = np.zeros(len(seqlens), np.int32)
+    offset_of = np.zeros(len(seqlens), np.int32)
+    for i in np.argsort(seqlens)[::-1]:
+        s = int(stream_tokens.argmin())
+        stream_of[i] = s
+        offset_of[i] = stream_tokens[s]
+        stream_tokens[s] += seqlens[i]
+    max_len = int(stream_tokens.max())
+    max_len = ((max_len + bucket - 1) // bucket) * bucket
+    if min_len is not None:
+        max_len = max(max_len, min_len)
+    return PackInfo(stream=stream_of.tolist(), offset=offset_of.tolist(),
+                    length=[int(x) for x in seqlens], n_streams=n_streams,
+                    max_len=max_len)
+
+
+def pack_tokens(info: PackInfo, flat: np.ndarray,
+                seqlens: Optional[Sequence[int]] = None,
+                fill=0) -> np.ndarray:
+    """Scatter a 1D packed per-token array (concatenated in sequence
+    order) into the [S, L] stream layout. ``seqlens`` defaults to
+    info.length; pass shorter ones for keys like logprobs (l-1)."""
+    lens = list(seqlens) if seqlens is not None else info.length
+    assert len(lens) == info.n_seqs
+    out_shape = (info.n_streams, info.max_len) + flat.shape[1:]
+    out = np.full(out_shape, fill, dtype=flat.dtype)
+    src = 0
+    for i, ln in enumerate(lens):
+        s, off = info.stream[i], info.offset[i]
+        out[s, off:off + ln] = flat[src:src + ln]
+        src += ln
+    assert src == len(flat), (src, len(flat))
+    return out
+
+
+def segment_ids(info: PackInfo) -> np.ndarray:
+    """[S, L] int32 segment matrix: sequence i gets id i+1; pads 0."""
+    out = np.zeros((info.n_streams, info.max_len), np.int32)
+    for i, ln in enumerate(info.length):
+        s, off = info.stream[i], info.offset[i]
+        out[s, off:off + ln] = i + 1
+    return out
+
+
+def unpack_tokens(info: PackInfo, arr: np.ndarray,
+                  seqlens: Optional[Sequence[int]] = None) -> np.ndarray:
+    """Gather [S, L, ...] back into the flat packed 1D layout."""
+    lens = list(seqlens) if seqlens is not None else info.length
+    parts = []
+    for i, ln in enumerate(lens):
+        s, off = info.stream[i], info.offset[i]
+        parts.append(arr[s, off:off + ln])
+    return np.concatenate(parts, axis=0)
+
+
+def per_seq_gather(info: PackInfo, arr: np.ndarray,
+                   index_in_seq: Sequence[int]) -> np.ndarray:
+    """Gather one element per sequence (e.g. the last token's value)."""
+    out = []
+    for i, idx in enumerate(index_in_seq):
+        s, off = info.stream[i], info.offset[i]
+        out.append(arr[s, off + idx])
+    return np.stack(out, axis=0)
+
+
+def left_padded_prompts(prompts: List[np.ndarray], pad_id: int,
+                        bucket: int = DEFAULT_BUCKET
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build the generation prefill batch: [B, Lp] left-padded token
+    matrix + segment ids (1 over content) + positions. Left padding
+    keeps every stream's last prompt token at column Lp-1 so decode
+    appends uniformly (reference pads KV likewise,
+    real_llm_generate.py:179)."""
+    b = len(prompts)
+    lp = max(len(p) for p in prompts)
+    lp = ((lp + bucket - 1) // bucket) * bucket
+    ids = np.full((b, lp), pad_id, np.int32)
+    seg = np.zeros((b, lp), np.int32)
+    pos = np.zeros((b, lp), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, lp - len(p):] = p
+        seg[i, lp - len(p):] = 1
+        pos[i, lp - len(p):] = np.arange(len(p))
+    return ids, seg, pos

@@ -1,0 +1,91 @@
+"""Shared interface plumbing: SequenceSample <-> stream-array batches.
+
+Each interface packs its minibatch of ragged sequences into [S, L]
+stream arrays (see engine/packing.py) before handing them to the
+jitted engine, and unpacks engine outputs back into flat packed
+arrays for the data plane.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base.datapack import flat2d
+from realhf_tpu.engine import packing
+
+
+def seqlens_of(input_: SequenceSample, key: str = "packed_input_ids") -> List[int]:
+    """Total sequence length per batch element for a key (elements may
+    hold several sequences, e.g. reward pairs)."""
+    return [sum(l) for l in input_.seqlens[key]]
+
+
+def flat_seqlens(input_: SequenceSample, key: str = "packed_input_ids") -> List[int]:
+    """Per-sequence lengths, flattened over batch elements."""
+    return flat2d(input_.seqlens[key])
+
+
+@dataclasses.dataclass
+class StreamBatch:
+    """One packed minibatch ready for the engine."""
+    info: packing.PackInfo
+    arrays: Dict[str, np.ndarray]
+    n_tokens: int
+
+
+def build_stream_batch(
+    seqlens: Sequence[int],
+    token_keys: Dict[str, np.ndarray],
+    shifted_keys: Optional[Dict[str, np.ndarray]] = None,
+    n_streams: int = 1,
+    bucket: int = packing.DEFAULT_BUCKET,
+    min_len: Optional[int] = None,
+) -> StreamBatch:
+    """Pack flat per-token arrays into stream layout.
+
+    ``token_keys`` have per-sequence length l; ``shifted_keys`` have
+    length l-1 (logprobs/advantages/...) and are aligned to the
+    sequence start so that index t corresponds to predicting token t+1.
+    """
+    info = packing.plan_packing(seqlens, n_streams, bucket, min_len)
+    arrays = {"seg_ids": packing.segment_ids(info)}
+    for k, v in token_keys.items():
+        arrays[k] = packing.pack_tokens(info, v)
+    if shifted_keys:
+        short = [l - 1 for l in seqlens]
+        for k, v in shifted_keys.items():
+            arrays[k] = packing.pack_tokens(info, v, seqlens=short)
+    return StreamBatch(info=info, arrays=arrays,
+                       n_tokens=int(np.sum(seqlens)))
+
+
+def split_minibatches(input_: SequenceSample, n: int,
+                      min_size: int = 1) -> List[SequenceSample]:
+    """Token-balanced minibatch split (SequenceSample.split), clamped
+    so tiny batches still work."""
+    n = max(1, min(n, input_.bs // max(1, min_size)))
+    if n <= 1:
+        return [input_]
+    return input_.split(n, min_size=min_size)
+
+
+def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:
+    """Pad a list of stream batches to a common [S, L] so they can be
+    stacked and scanned as microbatches in one jitted step."""
+    s = max(b.arrays["seg_ids"].shape[0] for b in batches)
+    l = max(b.arrays["seg_ids"].shape[1] for b in batches)
+    out = []
+    for b in batches:
+        arrays = {}
+        for k, v in b.arrays.items():
+            if v.ndim < 2:  # per-pair/per-seq vectors, not [S, L] grids
+                arrays[k] = v
+                continue
+            pad = [(0, s - v.shape[0]), (0, l - v.shape[1])] + \
+                [(0, 0)] * (v.ndim - 2)
+            arrays[k] = np.pad(v, pad)
+        out.append(StreamBatch(info=b.info, arrays=arrays,
+                               n_tokens=b.n_tokens))
+    return out

@@ -1,0 +1,85 @@
+"""Batch generation interface: generate and dump to JSONL.
+
+Parity with reference ``realhf/impl/model/interface/gen_interface.py``
+(GenerationInterface:39) including the locked append-only output file.
+"""
+
+import dataclasses
+import fcntl
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import logging
+from realhf_tpu.base.datapack import flat2d
+from realhf_tpu.engine import packing
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+
+logger = logging.getLogger("GenerationInterface")
+
+
+@dataclasses.dataclass
+class GenerationInterface(model_api.ModelInterface):
+    output_file: Optional[str] = None
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters)
+
+    def __post_init__(self):
+        if isinstance(self.gconfig, dict):
+            self.gconfig = GenerationHyperparameters(**self.gconfig)
+        self._calls = 0
+
+    def generate(self, model: model_api.Model, input_: SequenceSample,
+                 n_mbs: Optional[int] = None) -> SequenceSample:
+        tok = model.tokenizer
+        prompt_lens = flat2d(input_.seqlens["packed_prompts"])
+        flat = input_.data["packed_prompts"]
+        prompts, off = [], 0
+        for l in prompt_lens:
+            prompts.append(np.asarray(flat[off:off + l]))
+            off += l
+        ids, seg, pos = packing.left_padded_prompts(
+            prompts, pad_id=tok.pad_token_id)
+        self._calls += 1
+        from realhf_tpu.interfaces.ppo import _base_key
+        key = jax.random.fold_in(_base_key(), self._calls)
+        out = model.engine.generate(ids, seg, pos, key, self.gconfig,
+                                    eos_token_id=tok.eos_token_id,
+                                    pad_token_id=tok.pad_token_id)
+        gen_tokens = np.asarray(out.tokens)
+        lengths = np.asarray(out.lengths)
+
+        if self.output_file is not None:
+            path = self.output_file
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            records = []
+            for i, p in enumerate(prompts):
+                g = int(lengths[i])
+                records.append(dict(
+                    id=str(input_.ids[i]),
+                    prompt=tok.decode(p.tolist()),
+                    answer=tok.decode(gen_tokens[i, :g].tolist(),
+                                      skip_special_tokens=True)))
+            with open(path, "a") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                for r in records:
+                    f.write(json.dumps(r, ensure_ascii=False) + "\n")
+                fcntl.flock(f, fcntl.LOCK_UN)
+
+        seqlens, in_ids = [], []
+        for i, p in enumerate(prompts):
+            g = int(lengths[i])
+            seqlens.append(len(p) + g)
+            in_ids.append(np.concatenate([p, gen_tokens[i, :g]]))
+        return SequenceSample.from_default(
+            ids=input_.ids, seqlens=seqlens,
+            data=dict(packed_input_ids=np.concatenate(in_ids)
+                      .astype(np.int32)))
+
+
+model_api.register_interface("generation", GenerationInterface)

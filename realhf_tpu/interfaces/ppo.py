@@ -1,0 +1,478 @@
+"""PPO actor and critic interfaces.
+
+Parity with reference ``realhf/impl/model/interface/ppo_interface.py``
+(PPOActorInterface:110, PPOCriticInterface:639): the actor's three
+handlers (generate / inference / train_step) and the critic's two
+(inference / train_step), including KL-penalized rewards, GAE,
+advantage/value normalization, dual-clip PPO losses, adaptive KL
+control, logits-mask replay, and early stopping.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import logging
+from realhf_tpu.base.datapack import flat2d
+from realhf_tpu.engine import packing
+from realhf_tpu.interfaces import common, ppo_functional
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.hf import save_hf_checkpoint
+from realhf_tpu.ops import functional as F
+from realhf_tpu.ops.gae import gae_packed_numpy
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+
+logger = logging.getLogger("PPOInterface")
+
+
+def _base_key() -> jax.Array:
+    """Deterministic PRNG root: the experiment seed when set, else 0.
+    (Python hash() is process-salted and must not feed SPMD RNG.)"""
+    from realhf_tpu.base import seeding
+    try:
+        seed = seeding.get_seed()
+    except RuntimeError:
+        seed = 0
+    return jax.random.PRNGKey(seed % (2 ** 31))
+
+
+def _shifted_loss_mask(prompt_mask: np.ndarray,
+                       seqlens: List[int]) -> np.ndarray:
+    """Flat l-1 mask per sequence: True where the *predicted* token is
+    a non-prompt token (reference ppo_interface.py:330-344)."""
+    out, off = [], 0
+    for l in seqlens:
+        pm = prompt_mask[off:off + l]
+        out.append(~pm[1:])
+        off += l
+    return np.concatenate(out)
+
+
+def _make_rms(norm_type: str, beta: float, eps: float):
+    if norm_type == "exp":
+        return ppo_functional.ExponentialRunningMeanStd(beta=beta,
+                                                        epsilon=eps)
+    if norm_type == "ma":
+        return ppo_functional.MovingAverageRunningMeanStd(epsilon=eps)
+    raise NotImplementedError(norm_type)
+
+
+@dataclasses.dataclass
+class PPOActorInterface(model_api.ModelInterface):
+    n_minibatches: int = 4
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters)
+    kl_ctl: float = 0.1
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    eps_clip: float = 0.2
+    max_reward_clip: float = 20.0
+    early_stop_kl: Optional[float] = None
+    early_stop_imp_ratio: Optional[float] = None
+    adv_norm: bool = True
+    use_adaptive_kl_ctl: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+    value_norm: bool = False
+    value_norm_type: str = "exp"
+    value_norm_beta: float = 0.99995
+    value_norm_eps: float = 1e-5
+    enable_save: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.gconfig, dict):
+            self.gconfig = GenerationHyperparameters(**self.gconfig)
+        if self.use_adaptive_kl_ctl:
+            self.kl_adapter = ppo_functional.AdaptiveKLController(
+                self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon)
+        else:
+            self.kl_adapter = ppo_functional.FixedKLController(self.kl_ctl)
+        if self.value_norm:
+            self.rms = _make_rms(self.value_norm_type, self.value_norm_beta,
+                                 self.value_norm_eps)
+        self._gen_calls = 0
+
+    # ------------------------------------------------------------------
+    def generate(self, model: model_api.Model, input_: SequenceSample,
+                 n_mbs: Optional[int] = None) -> SequenceSample:
+        engine = model.engine
+        tok = model.tokenizer
+        prompt_lens = flat2d(input_.seqlens["packed_prompts"])
+        flat = input_.data["packed_prompts"]
+        prompts, off = [], 0
+        for l in prompt_lens:
+            prompts.append(np.asarray(flat[off:off + l]))
+            off += l
+
+        ids, seg, pos = packing.left_padded_prompts(
+            prompts, pad_id=tok.pad_token_id)
+        self._gen_calls += 1
+        key = jax.random.fold_in(_base_key(), self._gen_calls)
+        out = engine.generate(ids, seg, pos, key, self.gconfig,
+                              eos_token_id=tok.eos_token_id,
+                              pad_token_id=tok.pad_token_id)
+        gen_tokens = np.asarray(out.tokens)
+        gen_lp = np.asarray(out.logprobs)
+        gen_lens = np.asarray(out.lengths)
+        no_eos = np.asarray(out.no_eos_mask)
+        mask = None
+        if out.logits_mask is not None:
+            mask = np.asarray(out.logits_mask)  # [B, T, V], True=allowed
+
+        seqlens, in_ids, logprobs, prompt_mask, logits_masks = [], [], [], [], []
+        vocab = model.config.vocab_size
+        for i, p in enumerate(prompts):
+            g = int(gen_lens[i])
+            l = len(p) + g
+            seqlens.append(l)
+            in_ids.append(np.concatenate([p, gen_tokens[i, :g]]))
+            lp = np.zeros(l - 1, np.float32)
+            lp[len(p) - 1:] = gen_lp[i, :g]
+            logprobs.append(lp)
+            prompt_mask.append(np.concatenate(
+                [np.ones(len(p), bool), np.zeros(g, bool)]))
+            if mask is not None:
+                # True = masked out (reference convention, genstep:131)
+                m = np.zeros((l, vocab), bool)
+                m[len(p) - 1:len(p) - 1 + g] = ~mask[i, :g]
+                logits_masks.append(m)
+
+        data = dict(
+            seq_no_eos_mask=no_eos,
+            packed_input_ids=np.concatenate(in_ids).astype(np.int32),
+            packed_logprobs=np.concatenate(logprobs).astype(np.float32),
+            prompt_mask=np.concatenate(prompt_mask),
+        )
+        if mask is not None and not self.gconfig.force_no_logits_mask:
+            data["packed_logits_mask"] = np.concatenate(logits_masks)
+        return SequenceSample.from_default(
+            ids=input_.ids, seqlens=seqlens, data=data)
+
+    # ------------------------------------------------------------------
+    def inference(self, model: model_api.Model, input_: SequenceSample,
+                  n_mbs: Optional[int] = None) -> SequenceSample:
+        """Recompute logprobs under this model (used for ref_inf and
+        actor_inf MFCs; reference ppo_interface.py:255)."""
+        seqlens = common.flat_seqlens(input_)
+        token_keys = dict(input_ids=input_.data["packed_input_ids"])
+        sb = common.build_stream_batch(
+            seqlens, token_keys=token_keys,
+            n_streams=model.engine.ctx.dp_size)
+        lmask = None
+        if "packed_logits_mask" in input_.keys and \
+                input_.data.get("packed_logits_mask") is not None:
+            # stored True=masked-out; engine wants True=allowed
+            allowed = ~input_.data["packed_logits_mask"]
+            lmask = packing.pack_tokens(sb.info, allowed, fill=True)
+        lp = np.asarray(model.engine.forward_logprobs(
+            sb.arrays["input_ids"], sb.arrays["seg_ids"],
+            temperature=self.gconfig.temperature, logits_mask=lmask))
+        flat_lp = packing.unpack_tokens(sb.info, lp,
+                                        seqlens=[l - 1 for l in seqlens])
+        return SequenceSample.from_default(
+            ids=input_.ids,
+            seqlens=seqlens,
+            data=dict(packed_ref_logprobs=flat_lp.astype(np.float32)))
+
+    # ------------------------------------------------------------------
+    def train_step(self, model: model_api.Model, input_: SequenceSample,
+                   n_mbs: Optional[int] = None) -> Dict:
+        engine = model.engine
+        seqlens = common.flat_seqlens(input_)
+        n_seqs = len(seqlens)
+        cu = np.concatenate([[0], np.cumsum(seqlens)]).astype(np.int64)
+        short1 = cu - np.arange(n_seqs + 1)
+
+        old_logp = np.asarray(input_.data["packed_logprobs"], np.float32)
+        ref_logp = np.asarray(input_.data["packed_ref_logprobs"], np.float32)
+        prompt_mask = np.asarray(input_.data["prompt_mask"], bool)
+        reward_score = np.asarray(input_.data["rewards"], np.float32)
+        values = np.asarray(input_.data["values"], np.float32).copy()
+        seq_no_eos = np.asarray(input_.data["seq_no_eos_mask"], bool)
+
+        if self.value_norm:
+            denorm_values = self.rms.denormalize(values)
+        else:
+            denorm_values = values.copy()
+        # zero the value at EOS of terminated sequences (reference :321)
+        ends = cu[1:] - 1
+        denorm_values[ends] = np.where(seq_no_eos, denorm_values[ends], 0.0)
+
+        loss_mask = _shifted_loss_mask(prompt_mask, seqlens)
+        old_logp = old_logp * loss_mask
+        ref_logp = ref_logp * loss_mask
+
+        kl_rewards, rewards = ppo_functional.get_packed_rewards(
+            kl_ctl=self.kl_adapter.value,
+            clip_reward_value=self.max_reward_clip,
+            log_probs=old_logp, ref_log_probs=ref_logp,
+            reward_score=reward_score, short1cu_seqlens=short1,
+            seq_no_eos_mask=seq_no_eos)
+        advantages, returns = gae_packed_numpy(
+            rewards, denorm_values, short1, seq_no_eos.astype(np.float32),
+            gamma=self.discount, lam=self.gae_lambda)
+
+        if self.value_norm:
+            self.rms.update(returns, mask=loss_mask)
+        if self.adv_norm:
+            m = loss_mask.astype(np.float64)
+            mean = (advantages * m).sum() / m.sum()
+            var = ((advantages - mean) ** 2 * m).sum() / m.sum()
+            advantages = ((advantages - mean) /
+                          np.sqrt(var + 1e-5)).astype(np.float32) * loss_mask
+
+        n_tokens = int(loss_mask.sum())
+        mean_ref_kl = float((kl_rewards * loss_mask).sum())
+        self.kl_adapter.update(mean_ref_kl / max(n_tokens, 1),
+                               n_steps=n_seqs)
+
+        global_stats = dict(
+            task_reward=float(reward_score.mean()),
+            kl_reward=mean_ref_kl / max(n_tokens, 1),
+            advantage=float(advantages.sum() / max(n_tokens, 1)),
+            avg_seq_len=float(np.mean(seqlens)),
+            avg_prompt_len=float(prompt_mask.sum() / n_seqs),
+            n_tokens=n_tokens,
+            n_seqs=n_seqs,
+        )
+
+        train_data = dict(
+            advantages=advantages,
+            old_logp=old_logp,
+            ppo_loss_mask=loss_mask,
+            packed_input_ids=input_.data["packed_input_ids"],
+            kl_rewards=kl_rewards,
+        )
+        has_mask = ("packed_logits_mask" in input_.keys and
+                    input_.data.get("packed_logits_mask") is not None)
+        if has_mask:
+            train_data["packed_logits_mask"] = \
+                input_.data["packed_logits_mask"]
+        sample = SequenceSample.from_default(
+            ids=input_.ids, seqlens=[[l] for l in
+                                     common.seqlens_of(input_)],
+            data=train_data)
+
+        mbs = common.split_minibatches(sample, self.n_minibatches)
+        cfg = model.config
+        temperature = self.gconfig.temperature
+        eps_clip = self.eps_clip
+        early_kl = self.early_stop_kl
+        early_imp = self.early_stop_imp_ratio
+
+        def loss_fn(params, mb):
+            h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+            lmask = mb.get("logits_mask")
+            lp = F.shifted_logprobs_from_hidden(
+                cfg, params, h, mb["input_ids"], mb["seg_ids"],
+                temperature=temperature, logits_mask=lmask)
+            loss, stats = ppo_functional.actor_loss_fn(
+                logprobs=lp, old_logprobs=mb["old_logp"],
+                advantages=mb["advantages"], eps_clip=eps_clip,
+                loss_mask=mb["loss_mask"] > 0)
+            scale = jnp.ones(())
+            if early_imp is not None:
+                scale = scale * (stats["importance_weight"] <= early_imp)
+            if early_kl is not None:
+                scale = scale * (stats["approx_kl"] <= early_kl)
+            return loss * scale, dict(
+                actor_loss=loss,
+                ppo_approx_kl=stats["approx_kl"],
+                actor_clip_ratio=stats["clip_ratio"],
+                importance_weight=stats["importance_weight"])
+
+        all_stats = []
+        for minibatch in mbs:
+            mb_lens = common.flat_seqlens(minibatch)
+            token_keys = dict(input_ids=minibatch.data["packed_input_ids"])
+            sb = common.build_stream_batch(
+                mb_lens, token_keys=token_keys,
+                shifted_keys=dict(
+                    advantages=minibatch.data["advantages"],
+                    old_logp=minibatch.data["old_logp"],
+                    loss_mask=minibatch.data["ppo_loss_mask"]
+                    .astype(np.float32)),
+                n_streams=engine.ctx.dp_size)
+            if has_mask:
+                sb.arrays["logits_mask"] = packing.pack_tokens(
+                    sb.info, ~minibatch.data["packed_logits_mask"],
+                    fill=True)
+            stats = engine.train_batch(
+                [sb.arrays], loss_fn, loss_weights=[sb.n_tokens],
+                loss_fn_key=f"ppo_actor-{has_mask}")
+            all_stats.append(stats)
+        model.inc_version()
+
+        agg = {k: float(np.mean([s[k] for s in all_stats]))
+               for k in all_stats[0]}
+        agg.update(global_stats)
+        return agg
+
+    def save(self, model: model_api.Model, save_dir: str):
+        if not self.enable_save:
+            return
+        save_hf_checkpoint(save_dir, model.hf_family, model.config,
+                           model.engine.params_numpy(),
+                           tokenizer=model.tokenizer)
+
+
+@dataclasses.dataclass
+class PPOCriticInterface(model_api.ModelInterface):
+    n_minibatches: int = 4
+    kl_ctl: float = 0.1
+    discount: float = 1.0
+    gae_lambda: float = 0.95
+    value_eps_clip: float = 0.2
+    max_reward_clip: float = 20.0
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+    use_adaptive_kl_ctl: bool = False
+    value_norm: bool = False
+    value_norm_type: str = "exp"
+    value_norm_beta: float = 0.99995
+    value_norm_eps: float = 1e-5
+    enable_save: bool = True
+
+    def __post_init__(self):
+        if self.use_adaptive_kl_ctl:
+            self.kl_adapter = ppo_functional.AdaptiveKLController(
+                self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon)
+        else:
+            self.kl_adapter = ppo_functional.FixedKLController(self.kl_ctl)
+        if self.value_norm:
+            self.rms = _make_rms(self.value_norm_type, self.value_norm_beta,
+                                 self.value_norm_eps)
+
+    def inference(self, model: model_api.Model, input_: SequenceSample,
+                  n_mbs: Optional[int] = None) -> SequenceSample:
+        """Produce values for every token (reference
+        PPOCriticInterface.inference)."""
+        seqlens = common.flat_seqlens(input_)
+        sb = common.build_stream_batch(
+            seqlens,
+            token_keys=dict(input_ids=input_.data["packed_input_ids"]),
+            n_streams=model.engine.ctx.dp_size)
+        values = np.asarray(model.engine.forward_values(
+            sb.arrays["input_ids"], sb.arrays["seg_ids"]))
+        flat = packing.unpack_tokens(sb.info, values)
+        return SequenceSample.from_default(
+            ids=input_.ids, seqlens=seqlens,
+            data=dict(values=flat.astype(np.float32)))
+
+    def train_step(self, model: model_api.Model, input_: SequenceSample,
+                   n_mbs: Optional[int] = None) -> Dict:
+        engine = model.engine
+        seqlens = common.flat_seqlens(input_)
+        n_seqs = len(seqlens)
+        cu = np.concatenate([[0], np.cumsum(seqlens)]).astype(np.int64)
+        short1 = cu - np.arange(n_seqs + 1)
+
+        old_logp = np.asarray(input_.data["packed_logprobs"], np.float32)
+        ref_logp = np.asarray(input_.data["packed_ref_logprobs"], np.float32)
+        prompt_mask = np.asarray(input_.data["prompt_mask"], bool)
+        reward_score = np.asarray(input_.data["rewards"], np.float32)
+        values = np.asarray(input_.data["values"], np.float32).copy()
+        seq_no_eos = np.asarray(input_.data["seq_no_eos_mask"], bool)
+
+        if self.value_norm:
+            denorm_values = self.rms.denormalize(values)
+        else:
+            denorm_values = values.copy()
+        ends = cu[1:] - 1
+        denorm_values[ends] = np.where(seq_no_eos, denorm_values[ends], 0.0)
+        values[ends] = np.where(seq_no_eos, values[ends], 0.0)
+
+        loss_mask = _shifted_loss_mask(prompt_mask, seqlens)
+        old_logp = old_logp * loss_mask
+        ref_logp = ref_logp * loss_mask
+
+        kl_rewards, rewards = ppo_functional.get_packed_rewards(
+            kl_ctl=self.kl_adapter.value,
+            clip_reward_value=self.max_reward_clip,
+            log_probs=old_logp, ref_log_probs=ref_logp,
+            reward_score=reward_score, short1cu_seqlens=short1,
+            seq_no_eos_mask=seq_no_eos)
+        # Keep the critic's adaptive KL coefficient in sync with the
+        # actor's (reference updates it inside the critic loss too,
+        # ppo_interface.py:629).
+        n_tokens = max(int(loss_mask.sum()), 1)
+        self.kl_adapter.update(float((kl_rewards * loss_mask).sum())
+                               / n_tokens, n_steps=n_seqs)
+        _, returns = gae_packed_numpy(
+            rewards, denorm_values, short1, seq_no_eos.astype(np.float32),
+            gamma=self.discount, lam=self.gae_lambda)
+
+        if self.value_norm:
+            self.rms.update(returns, mask=loss_mask)
+            target = self.rms.normalize(returns)
+        else:
+            target = returns
+
+        # per-position old values: values[t] for t in 0..l-2 (flat l-1)
+        old_values_short = np.concatenate(
+            [values[cu[i]:cu[i + 1] - 1] for i in range(n_seqs)])
+
+        sample = SequenceSample.from_default(
+            ids=input_.ids,
+            seqlens=[[l] for l in common.seqlens_of(input_)],
+            data=dict(
+                packed_input_ids=input_.data["packed_input_ids"],
+                returns=target.astype(np.float32),
+                # note: "values"-style keys resolve to length l; these
+                # are l-1, so reuse minus-1 key names
+                old_logp=old_values_short.astype(np.float32),
+                ppo_loss_mask=loss_mask,
+            ))
+        mbs = common.split_minibatches(sample, self.n_minibatches)
+
+        cfg = model.config
+        eps = self.value_eps_clip
+
+        def loss_fn(params, mb):
+            h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+            new_values = T.critic_values(cfg, params, h)
+            loss, stats = ppo_functional.critic_loss_fn(
+                value=new_values, old_value=mb["old_values"],
+                target_value=mb["returns"], value_eps_clip=eps,
+                loss_mask=mb["loss_mask"] > 0)
+            return loss, dict(value_loss=loss,
+                              value_clip_ratio=stats["value_clip_ratio"])
+
+        all_stats = []
+        for minibatch in mbs:
+            mb_lens = common.flat_seqlens(minibatch)
+            sb = common.build_stream_batch(
+                mb_lens,
+                token_keys=dict(input_ids=minibatch.data["packed_input_ids"]),
+                shifted_keys=dict(
+                    returns=minibatch.data["returns"],
+                    old_values=minibatch.data["old_logp"],
+                    loss_mask=minibatch.data["ppo_loss_mask"]
+                    .astype(np.float32)),
+                n_streams=engine.ctx.dp_size)
+            stats = engine.train_batch(
+                [sb.arrays], loss_fn, loss_weights=[sb.n_tokens],
+                loss_fn_key="ppo_critic")
+            all_stats.append(stats)
+        model.inc_version()
+
+        agg = {k: float(np.mean([s[k] for s in all_stats]))
+               for k in all_stats[0]}
+        agg["returns"] = float(returns.mean())
+        return agg
+
+    def save(self, model: model_api.Model, save_dir: str):
+        if not self.enable_save:
+            return
+        save_hf_checkpoint(save_dir, model.hf_family, model.config,
+                           model.engine.params_numpy(),
+                           tokenizer=model.tokenizer)
+
+
+model_api.register_interface("ppo_actor", PPOActorInterface)
+model_api.register_interface("ppo_critic", PPOCriticInterface)

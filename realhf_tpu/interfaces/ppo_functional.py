@@ -1,0 +1,200 @@
+"""PPO math: KL controllers, losses, rewards, value normalization.
+
+Parity with reference ``realhf/impl/model/utils/ppo_functional.py``
+(actor_loss_fn:49, critic_loss_fn:135, compute/get_packed_rewards:206/
+291, KL controllers:21-46) and ``modules/rms.py`` (running mean-std
+for value/return normalization). Losses are jittable over [S, L]
+stream arrays; reward/GAE prep runs host-side on flat packed arrays.
+"""
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ----------------------------------------------------------------------
+# KL controllers (host-side state)
+# ----------------------------------------------------------------------
+class KLController:
+    value: float
+
+    def update(self, current: float, n_steps: int):
+        raise NotImplementedError()
+
+
+class FixedKLController(KLController):
+
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current, n_steps):
+        pass
+
+
+class AdaptiveKLController(KLController):
+    """arXiv 1909.08593 adaptive controller (reference :21)."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: float):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current, n_steps):
+        proportional_error = float(np.clip(current / self.target - 1,
+                                           -0.2, 0.2))
+        self.value = self.value * (1 + proportional_error * n_steps /
+                                   self.horizon)
+
+
+# ----------------------------------------------------------------------
+# Losses (jittable, [.,.] shapes with a boolean loss mask)
+# ----------------------------------------------------------------------
+def actor_loss_fn(logprobs: jnp.ndarray, old_logprobs: jnp.ndarray,
+                  advantages: jnp.ndarray, eps_clip: float,
+                  loss_mask: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped PPO surrogate (reference actor_loss_fn:49)."""
+    m = loss_mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    ratio = jnp.where(loss_mask, jnp.exp(logprobs - old_logprobs), 0.0)
+    clipped = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+    pg1 = -advantages * ratio
+    pg2 = -advantages * clipped
+    loss = (jnp.where(loss_mask, jnp.maximum(pg1, pg2), 0.0)).sum() / denom
+    clip_mask = (jax.lax.stop_gradient(pg1) < jax.lax.stop_gradient(pg2))
+    stats = {
+        "importance_weight": (jax.lax.stop_gradient(ratio) * m).sum() / denom,
+        "clip_ratio": (clip_mask & loss_mask).sum() / denom,
+        "approx_kl": (jax.lax.stop_gradient(logprobs - old_logprobs)
+                      * m).sum() / denom,
+    }
+    return loss, stats
+
+
+def critic_loss_fn(value: jnp.ndarray, old_value: jnp.ndarray,
+                   target_value: jnp.ndarray, value_eps_clip: float,
+                   loss_mask: jnp.ndarray, loss_fn_type: str = "mse"
+                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Value loss with clipping (reference critic_loss_fn:135)."""
+    if loss_fn_type == "mse":
+        f = lambda x, y: 0.5 * (x - y) ** 2
+    elif loss_fn_type == "huber":
+        delta = 10.0
+        f = lambda x, y: jnp.where(
+            jnp.abs(x - y) < delta, 0.5 * (x - y) ** 2,
+            delta * (jnp.abs(x - y) - 0.5 * delta))
+    else:
+        raise NotImplementedError(loss_fn_type)
+    m = loss_mask.astype(jnp.float32)
+    denom = jnp.maximum(m.sum(), 1.0)
+    orig = f(value, target_value)
+    value_clipped = old_value + jnp.clip(value - old_value, -value_eps_clip,
+                                         value_eps_clip)
+    clip = f(value_clipped, target_value)
+    loss = (jnp.where(loss_mask, jnp.maximum(orig, clip), 0.0)).sum() / denom
+    clip_mask = jax.lax.stop_gradient(clip) > jax.lax.stop_gradient(orig)
+    return loss, {"value_clip_ratio": (clip_mask & loss_mask).sum() / denom}
+
+
+# ----------------------------------------------------------------------
+# Rewards over flat packed arrays (host-side numpy; O(T) trivial work)
+# ----------------------------------------------------------------------
+def get_packed_rewards(
+    kl_ctl: float,
+    clip_reward_value: float,
+    log_probs: np.ndarray,      # flat, per-seq length l-1
+    ref_log_probs: np.ndarray,
+    reward_score: np.ndarray,   # [n_seqs]
+    short1cu_seqlens: np.ndarray,  # [n_seqs+1] boundaries of the l-1 arrays
+    seq_no_eos_mask: np.ndarray,   # [n_seqs] bool
+) -> Tuple[np.ndarray, np.ndarray]:
+    """KL penalty + terminal score at each sequence's last reward slot
+    (reference get_packed_rewards:291)."""
+    kl_rewards = -kl_ctl * (log_probs - ref_log_probs)
+    tot = kl_rewards.copy()
+    score = np.clip(reward_score, -clip_reward_value, clip_reward_value)
+    ends = short1cu_seqlens[1:] - 1
+    tot[ends] += np.where(seq_no_eos_mask, 0.0, score)
+    return kl_rewards, tot
+
+
+# ----------------------------------------------------------------------
+# Running mean-std (value normalization, reference modules/rms.py)
+# ----------------------------------------------------------------------
+class ExponentialRunningMeanStd:
+
+    def __init__(self, beta: float = 0.999, epsilon: float = 1e-5,
+                 high_precision: bool = True):
+        self.beta = beta
+        self.eps = epsilon
+        self._mean = 0.0
+        self._mean_sq = 0.0
+        self._debias = 0.0
+
+    def update(self, x: np.ndarray, mask: Optional[np.ndarray] = None):
+        x = np.asarray(x, np.float64)
+        if mask is not None:
+            mask = np.asarray(mask, np.float64)
+            factor = max(mask.sum(), 1.0)
+            mean = (x * mask).sum() / factor
+            mean_sq = (x ** 2 * mask).sum() / factor
+        else:
+            mean = x.mean()
+            mean_sq = (x ** 2).mean()
+        self._mean = self.beta * self._mean + (1 - self.beta) * mean
+        self._mean_sq = self.beta * self._mean_sq + (1 - self.beta) * mean_sq
+        self._debias = self.beta * self._debias + (1 - self.beta)
+
+    def mean_std(self) -> Tuple[float, float]:
+        if self._debias == 0:
+            return 0.0, 1.0
+        mean = self._mean / self._debias
+        var = max(self._mean_sq / self._debias - mean ** 2, 0.0)
+        return mean, float(np.sqrt(var + self.eps))
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        mean, std = self.mean_std()
+        return ((np.asarray(x, np.float64) - mean) / std).astype(np.float32)
+
+    def denormalize(self, x: np.ndarray) -> np.ndarray:
+        mean, std = self.mean_std()
+        return (np.asarray(x, np.float64) * std + mean).astype(np.float32)
+
+
+class MovingAverageRunningMeanStd:
+
+    def __init__(self, epsilon: float = 1e-5):
+        self.eps = epsilon
+        self._sum = 0.0
+        self._sum_sq = 0.0
+        self._count = 0.0
+
+    def update(self, x: np.ndarray, mask: Optional[np.ndarray] = None):
+        x = np.asarray(x, np.float64)
+        if mask is not None:
+            mask = np.asarray(mask, np.float64)
+            self._sum += (x * mask).sum()
+            self._sum_sq += (x ** 2 * mask).sum()
+            self._count += mask.sum()
+        else:
+            self._sum += x.sum()
+            self._sum_sq += (x ** 2).sum()
+            self._count += x.size
+
+    def mean_std(self) -> Tuple[float, float]:
+        if self._count == 0:
+            return 0.0, 1.0
+        mean = self._sum / self._count
+        var = max(self._sum_sq / self._count - mean ** 2, 0.0)
+        return mean, float(np.sqrt(var + self.eps))
+
+    def normalize(self, x):
+        mean, std = self.mean_std()
+        return ((np.asarray(x, np.float64) - mean) / std).astype(np.float32)
+
+    def denormalize(self, x):
+        mean, std = self.mean_std()
+        return (np.asarray(x, np.float64) * std + mean).astype(np.float32)

@@ -179,7 +179,7 @@ def save_hf_checkpoint(path: str, family: str, cfg: TransformerConfig,
         safetensors.numpy.save_file(
             {"value_head.weight": value_head},
             os.path.join(path, _VALUE_HEAD_NAME))
-    if tokenizer is not None:
+    if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):
         tokenizer.save_pretrained(path)
     logger.info("Saved %s checkpoint to %s", family, path)
 

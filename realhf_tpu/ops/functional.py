@@ -1,0 +1,144 @@
+"""Shared tensor ops over packed streams.
+
+Parity with reference ``realhf/impl/model/utils/functional.py``:
+next-token logprob gathering (:165), masked normalization (:227),
+logits masking (:214) -- expressed on the framework's [S, L] packed
+stream layout. The vocab-parallel cross entropy of the reference
+(``modules.py:1050``) is unnecessary: the head matmul + log_softmax
+under GSPMD shard the vocab dim and XLA inserts the reductions.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.models.transformer import head_weight
+
+
+def shifted_logprobs_from_hidden(
+    cfg: TransformerConfig,
+    params,
+    hidden: jnp.ndarray,      # [S, L, H] final hidden states
+    input_ids: jnp.ndarray,   # [S, L]
+    seg_ids: jnp.ndarray,     # [S, L]
+    *,
+    chunk: int = 1024,
+    temperature: float = 1.0,
+    logits_mask: Optional[jnp.ndarray] = None,  # [S, L, V] bool, True=allowed
+) -> jnp.ndarray:
+    """Log p(input_ids[t+1] | ...) at every position t, zero where t+1
+    starts a different segment or is padding.
+
+    Computed in chunks along L so the full [S, L, V] logits tensor is
+    never materialized (the fused-CE trick; reference gathers shifted
+    logprobs after a full logits pass, functional.py:165).
+
+    Returns [S, L] fp32; position t holds the logprob of token t+1.
+    The last position of each segment (and pads) hold 0.
+    """
+    s, l, h = hidden.shape
+    w = head_weight(cfg, params).astype(hidden.dtype)
+
+    labels = jnp.concatenate(
+        [input_ids[:, 1:], jnp.zeros((s, 1), input_ids.dtype)], axis=1)
+    valid = jnp.concatenate(
+        [(seg_ids[:, 1:] == seg_ids[:, :-1]) & (seg_ids[:, 1:] != 0),
+         jnp.zeros((s, 1), bool)], axis=1)
+
+    n_chunks = max(1, (l + chunk - 1) // chunk)
+    pad_l = n_chunks * chunk - l
+    if pad_l:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad_l), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad_l)))
+        if logits_mask is not None:
+            logits_mask = jnp.pad(logits_mask, ((0, 0), (0, pad_l), (0, 0)),
+                                  constant_values=True)
+
+    hidden_c = hidden.reshape(s, n_chunks, chunk, h).swapaxes(0, 1)
+    labels_c = labels.reshape(s, n_chunks, chunk).swapaxes(0, 1)
+    if logits_mask is not None:
+        mask_c = logits_mask.reshape(s, n_chunks, chunk, -1).swapaxes(0, 1)
+        xs = (hidden_c, labels_c, mask_c)
+    else:
+        xs = (hidden_c, labels_c)
+
+    def body(_, x):
+        if logits_mask is not None:
+            hc, lc, mc = x
+        else:
+            hc, lc = x
+            mc = None
+        logits = jnp.einsum("slh,hv->slv", hc, w,
+                            preferred_element_type=jnp.float32)
+        if temperature != 1.0:
+            logits = logits / temperature
+        if mc is not None:
+            logits = jnp.where(mc, logits, -1e30)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return None, jnp.take_along_axis(logp, lc[..., None], axis=-1)[..., 0]
+
+    _, lp = jax.lax.scan(body, None, xs)
+    lp = lp.swapaxes(0, 1).reshape(s, n_chunks * chunk)[:, :l]
+    return jnp.where(valid, lp, 0.0)
+
+
+def masked_normalization(
+    x: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    unbiased: bool = False,
+    eps: float = 1e-5,
+    high_precision: bool = True,
+) -> jnp.ndarray:
+    """Normalize x to zero mean / unit std over masked entries.
+
+    Under pjit the arrays are global, so the "all-reduce over DP+TP"
+    of the reference (functional.py:227) is implicit.
+    """
+    dtype = jnp.float64 if (high_precision and
+                            jax.config.read("jax_enable_x64")) else jnp.float32
+    xf = x.astype(dtype)
+    if mask is None:
+        factor = jnp.asarray(x.size, dtype)
+        mean = xf.sum() / factor
+        mean_sq = (xf ** 2).sum() / factor
+    else:
+        m = mask.astype(dtype)
+        factor = m.sum()
+        mean = (xf * m).sum() / factor
+        mean_sq = (xf ** 2 * m).sum() / factor
+    var = mean_sq - mean ** 2
+    if unbiased:
+        var = var * factor / (factor - 1)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if mask is not None:
+        out = out * m
+    return out.astype(x.dtype)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    m = mask.astype(jnp.float32)
+    return (x.astype(jnp.float32) * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def entropy_from_hidden(cfg, params, hidden, *, chunk: int = 1024,
+                        temperature: float = 1.0) -> jnp.ndarray:
+    """Per-position policy entropy, chunked like shifted logprobs."""
+    s, l, h = hidden.shape
+    w = head_weight(cfg, params).astype(hidden.dtype)
+    n_chunks = max(1, (l + chunk - 1) // chunk)
+    pad_l = n_chunks * chunk - l
+    if pad_l:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad_l), (0, 0)))
+    hidden_c = hidden.reshape(s, n_chunks, chunk, h).swapaxes(0, 1)
+
+    def body(_, hc):
+        logits = jnp.einsum("slh,hv->slv", hc, w,
+                            preferred_element_type=jnp.float32) / temperature
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return None, -(jnp.exp(logp) * logp).sum(-1)
+
+    _, ent = jax.lax.scan(body, None, hidden_c)
+    return ent.swapaxes(0, 1).reshape(s, n_chunks * chunk)[:, :l]

@@ -1,0 +1,89 @@
+"""Generalized Advantage Estimation over packed sequences.
+
+TPU-native replacement for the reference CUDA kernel ``csrc/cugae/
+gae.cu`` (gae_1d_nolp_misalign:10) and its python fallback
+``ppo_functional.pygae1d_nolp_misalign:337``: a vectorized reverse
+`lax.scan` over a padded [n_seqs, L] view of the packed data. GAE is
+O(T) and runs fused under jit -- no native kernel needed.
+
+Semantics (misaligned packing, identical to the reference):
+- ``rewards`` is 1D packed with per-sequence lengths ``l_i``;
+- ``values`` is 1D packed with lengths ``l_i + 1`` (bootstrap value
+  appended per sequence);
+- ``bootstrap[i]`` (the `seq_no_eos_mask`) keeps the bootstrap value
+  for truncated sequences and zeroes it for EOS-terminated ones.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gae_padded(
+    rewards: jnp.ndarray,    # [B, L] (entries beyond l_i are ignored)
+    values: jnp.ndarray,     # [B, L + 1] (values[i, l_i] = bootstrap)
+    lengths: jnp.ndarray,    # [B] int32 reward lengths l_i
+    bootstrap: jnp.ndarray,  # [B] float/bool: 1 keeps bootstrap value
+    gamma: float,
+    lam: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Padded-layout GAE; returns (advantages, returns) of shape [B, L]
+    with zeros beyond each sequence."""
+    b, l = rewards.shape
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    t_idx = jnp.arange(l)[None, :]
+    valid = t_idx < lengths[:, None]
+    # factor applied to V(t+1): 1 inside the sequence, `bootstrap` at
+    # the final step, 0 beyond.
+    nv_factor = jnp.where(
+        t_idx == lengths[:, None] - 1,
+        bootstrap.astype(jnp.float32)[:, None],
+        valid.astype(jnp.float32))
+    delta = rewards + gamma * values[:, 1:] * nv_factor - values[:, :-1]
+    delta = jnp.where(valid, delta, 0.0)
+
+    def body(gae, x):
+        d, m = x
+        gae = d + gamma * lam * m * gae
+        return gae, gae
+
+    # reverse scan over time, vectorized over batch
+    _, adv_rev = jax.lax.scan(
+        body, jnp.zeros((b,), jnp.float32),
+        (delta.T[::-1], valid.astype(jnp.float32).T[::-1]))
+    adv = adv_rev[::-1].T
+    adv = jnp.where(valid, adv, 0.0)
+    returns = adv + jnp.where(valid, values[:, :-1], 0.0)
+    return adv, returns
+
+
+def gae_packed_numpy(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    cu_seqlens: np.ndarray,  # [B+1] boundaries of `rewards`
+    bootstrap: np.ndarray,   # [B]
+    gamma: float,
+    lam: float,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """1D-packed misaligned GAE with the exact reference signature
+    (cugae1d_nolp_misalign_func, gae.cu:10). Host-side convenience:
+    pads, runs the jitted padded kernel, re-packs."""
+    lens = np.diff(cu_seqlens).astype(np.int32)
+    b, lmax = len(lens), int(lens.max())
+    r_pad = np.zeros((b, lmax), np.float32)
+    v_pad = np.zeros((b, lmax + 1), np.float32)
+    v_off = 0
+    for i, ln in enumerate(lens):
+        r_pad[i, :ln] = rewards[cu_seqlens[i]:cu_seqlens[i + 1]]
+        v_pad[i, :ln + 1] = values[v_off:v_off + ln + 1]
+        v_off += ln + 1
+    adv_p, ret_p = jax.jit(gae_padded, static_argnames=("gamma", "lam"))(
+        jnp.asarray(r_pad), jnp.asarray(v_pad), jnp.asarray(lens),
+        jnp.asarray(np.asarray(bootstrap, np.float32)), gamma=gamma, lam=lam)
+    adv_p, ret_p = np.asarray(adv_p), np.asarray(ret_p)
+    adv = np.concatenate([adv_p[i, :ln] for i, ln in enumerate(lens)])
+    ret = np.concatenate([ret_p[i, :ln] for i, ln in enumerate(lens)])
+    return adv, ret

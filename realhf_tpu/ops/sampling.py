@@ -1,0 +1,85 @@
+"""Token sampling: temperature, top-k, top-p, greedy, logits mask.
+
+Parity with reference ``realhf/impl/model/utils/logits_warper.py``
+(top_k_top_p_logits:203) and the sampling step of
+``nn/real_llm_generate.py:genstep:26``, including the logits-mask
+output that PPO replays during inference for numerical consistency
+(reference model_api.py:57-67).
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationHyperparameters:
+    """Sampling configuration (reference ``model_api.py:57`` /
+    GenerationHyperparameters)."""
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    greedy: bool = False
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    temperature: float = 1.0
+    # Whether generate() returns the per-step logits mask so that later
+    # inference passes can reproduce exactly the sampled distribution.
+    force_no_logits_mask: bool = False
+
+    def __post_init__(self):
+        if not self.greedy and self.temperature <= 0.0:
+            raise ValueError(
+                "temperature must be > 0 for sampling; use greedy=True "
+                "for deterministic decoding.")
+
+
+def top_k_top_p_logits(logits: jnp.ndarray, top_k: int = 0,
+                       top_p: float = 1.0) -> jnp.ndarray:
+    """Mask logits outside the top-k / top-p nucleus to -inf.
+
+    Fully vectorized: sorts once, derives both cutoffs from the sorted
+    order (XLA sort is efficient on TPU; no python branching on data).
+    """
+    v = logits.shape[-1]
+    if (top_k <= 0 or top_k >= v) and top_p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    keep = jnp.ones_like(logits, dtype=bool)
+    if 0 < top_k < v:
+        kth = sorted_logits[..., top_k - 1:top_k]
+        keep &= logits >= kth
+    if top_p < 1.0:
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # number of tokens needed to reach top_p mass (at least 1)
+        include = cum - probs < top_p
+        cutoff_idx = include.sum(-1) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[..., None],
+                                     axis=-1)
+        keep &= logits >= cutoff
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample_from_logits(
+    logits: jnp.ndarray,  # [B, V] fp32
+    key: jax.Array,
+    gconfig: GenerationHyperparameters,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One sampling step -> (tokens [B], logits_mask [B, V] bool).
+
+    The mask marks tokens that were sample-able after warping; replayed
+    by PPO's inference pass (reference genstep:131-136).
+    """
+    if gconfig.greedy:
+        tokens = jnp.argmax(logits, axis=-1)
+        mask = jnp.ones_like(logits, dtype=bool)
+        return tokens.astype(jnp.int32), mask
+    warped = top_k_top_p_logits(logits / gconfig.temperature,
+                                gconfig.top_k, gconfig.top_p)
+    tokens = jax.random.categorical(key, warped, axis=-1)
+    mask = warped > NEG_INF / 2
+    return tokens.astype(jnp.int32), mask

@@ -1,0 +1,161 @@
+"""Kernel-level tests mirroring reference ``tests/cpp_extensions/
+test_cugae.py`` (GAE vs naive python) plus sampling warpers, masked
+normalization, and fused shifted-logprob checks."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops import functional as F
+from realhf_tpu.ops.gae import gae_packed_numpy, gae_padded
+from realhf_tpu.ops.sampling import top_k_top_p_logits
+
+
+def naive_gae_1d(rewards, values, cu_seqlens, bootstrap, gamma, lam):
+    """Direct port of the reference python fallback semantics
+    (ppo_functional.pygae1d_nolp_misalign:337) as the test oracle."""
+    bs = len(cu_seqlens) - 1
+    adv_all, ret_all = [], []
+    v_off = 0
+    for i in range(bs):
+        r = rewards[cu_seqlens[i]:cu_seqlens[i + 1]]
+        l = len(r)
+        v = values[v_off:v_off + l + 1]
+        v_off += l + 1
+        adv = np.zeros(l)
+        lastgaelam = 0.0
+        for t in reversed(range(l)):
+            nextv = v[t + 1]
+            if t == l - 1:
+                nextv *= bootstrap[i]
+            delta = r[t] + gamma * nextv - v[t]
+            lastgaelam = delta + gamma * lam * lastgaelam
+            adv[t] = lastgaelam
+        adv_all.append(adv)
+        ret_all.append(adv + v[:l])
+    return np.concatenate(adv_all), np.concatenate(ret_all)
+
+
+class TestGAE:
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_naive(self, seed):
+        rng = np.random.default_rng(seed)
+        lens = rng.integers(1, 30, size=(9,))
+        cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+        rewards = rng.standard_normal(cu[-1]).astype(np.float32)
+        values = rng.standard_normal(cu[-1] + len(lens)).astype(np.float32)
+        bootstrap = rng.integers(0, 2, size=(len(lens),)).astype(np.float32)
+        adv, ret = gae_packed_numpy(rewards, values, cu, bootstrap,
+                                    gamma=0.99, lam=0.95)
+        adv_ref, ret_ref = naive_gae_1d(rewards, values, cu, bootstrap,
+                                        0.99, 0.95)
+        np.testing.assert_allclose(adv, adv_ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ret, ret_ref, rtol=1e-4, atol=1e-5)
+
+    def test_padded_masks_tail(self):
+        rewards = jnp.ones((2, 8))
+        values = jnp.ones((2, 9))
+        lengths = jnp.array([3, 8], jnp.int32)
+        adv, ret = gae_padded(rewards, values, lengths,
+                              jnp.array([0.0, 1.0]), 1.0, 1.0)
+        assert (np.asarray(adv)[0, 3:] == 0).all()
+        assert (np.asarray(ret)[0, 3:] == 0).all()
+
+
+class TestSampling:
+
+    def test_top_k(self):
+        logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 50)))
+        out = np.asarray(top_k_top_p_logits(logits, top_k=5))
+        assert ((out > -1e29).sum(-1) == 5).all()
+        # surviving entries are the top-5
+        ref = np.asarray(logits)
+        for b in range(4):
+            top5 = set(np.argsort(ref[b])[-5:])
+            assert set(np.where(out[b] > -1e29)[0]) == top5
+
+    def test_top_p(self):
+        rng = np.random.default_rng(1)
+        logits = jnp.asarray(rng.standard_normal((8, 100)) * 3)
+        out = np.asarray(top_k_top_p_logits(logits, top_p=0.9))
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        for b in range(8):
+            kept = out[b] > -1e29
+            assert kept.sum() >= 1
+            # kept mass >= 0.9; dropping the smallest kept token goes below
+            assert probs[b][kept].sum() >= 0.9 - 1e-5
+            if kept.sum() > 1:
+                smallest = probs[b][kept].min()
+                assert probs[b][kept].sum() - smallest < 0.9 + 1e-5
+
+    def test_noop(self):
+        logits = jnp.asarray(np.random.default_rng(2).standard_normal((2, 10)))
+        np.testing.assert_array_equal(
+            np.asarray(top_k_top_p_logits(logits, top_k=0, top_p=1.0)),
+            np.asarray(logits))
+
+
+class TestFunctional:
+
+    def test_masked_normalization(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32) * 5 + 2)
+        mask = jnp.asarray(rng.integers(0, 2, size=(4, 16)).astype(np.float32))
+        out = np.asarray(F.masked_normalization(x, mask))
+        sel = out[np.asarray(mask) > 0]
+        assert abs(sel.mean()) < 1e-4
+        assert abs(sel.std() - 1) < 1e-2
+        assert (out[np.asarray(mask) == 0] == 0).all()
+
+    def test_shifted_logprobs_match_naive(self):
+        cfg = TransformerConfig(
+            n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+            intermediate_dim=64, vocab_size=50, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu",
+            compute_dtype="float32")
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 50, size=(2, 24)), jnp.int32)
+        seg = jnp.asarray(np.concatenate(
+            [np.full((2, 10), 1), np.full((2, 10), 2), np.zeros((2, 4))],
+            axis=1), jnp.int32)
+        h, _ = T.forward(cfg, params, ids, seg)
+        lp = np.asarray(F.shifted_logprobs_from_hidden(
+            cfg, params, h, ids, seg, chunk=8))
+        logits = np.asarray(T.lm_logits(cfg, params, h))
+        naive = jax.nn.log_softmax(jnp.asarray(logits), -1)
+        naive = np.asarray(naive)
+        for b in range(2):
+            for t in range(23):
+                same_seg = (np.asarray(seg)[b, t + 1] == np.asarray(seg)[b, t]
+                            and np.asarray(seg)[b, t + 1] != 0)
+                if same_seg:
+                    expect = naive[b, t, np.asarray(ids)[b, t + 1]]
+                    np.testing.assert_allclose(lp[b, t], expect, rtol=1e-4,
+                                               atol=1e-5)
+                else:
+                    assert lp[b, t] == 0.0
+        # boundary between segment 1 and 2 and at padding must be zero
+        assert lp[0, 9] == 0.0 and lp[0, 19] == 0.0
+
+    def test_entropy(self):
+        cfg = TransformerConfig(
+            n_layers=1, n_kv_heads=2, n_q_heads=2, hidden_dim=16,
+            intermediate_dim=32, vocab_size=30, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu",
+            compute_dtype="float32")
+        params = T.init_params(cfg, jax.random.PRNGKey(1))
+        ids = jnp.ones((1, 8), jnp.int32)
+        h, _ = T.forward(cfg, params, ids, jnp.ones_like(ids))
+        ent = np.asarray(F.entropy_from_hidden(cfg, params, h, chunk=4))
+        assert ent.shape == (1, 8)
+        assert (ent > 0).all() and (ent <= np.log(30) + 1e-5).all()
