@@ -1,0 +1,59 @@
+"""Experiment specification: what the launcher materializes.
+
+Parity with the reference's two-level config system
+(``realhf/api/core/system_api.py`` ExperimentConfig +
+``api/quickstart/model.py`` ModelTrainEvalConfig): an experiment names
+its models (role -> spec), the dataflow graph of MFCs, the dataset,
+and run control (epochs, save/eval frequency, seed).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from realhf_tpu.api.config import DatasetAbstraction, ModelName
+from realhf_tpu.api.dfg import MFCDef
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """One model role (reference ModelTrainEvalConfig,
+    quickstart/model.py:114)."""
+    hf_family: str = "llama"
+    path: Optional[str] = None  # HF checkpoint dir; None = random init
+    # Used when path is None (testing / benchmarking):
+    random_init_config: Optional[dict] = None
+    is_critic: bool = False
+    init_critic_from_actor: bool = False
+    optimizer: Optional[OptimizerConfig] = None
+    parallel: ParallelismConfig = dataclasses.field(
+        default_factory=ParallelismConfig)
+    gradient_checkpointing: bool = True
+    bf16: bool = True
+
+
+@dataclasses.dataclass
+class SaveEvalControl:
+    """Reference ExperimentSaveEvalControl (system_api.py:157)."""
+    save_freq_epochs: Optional[int] = None
+    save_freq_steps: Optional[int] = None
+    save_freq_secs: Optional[float] = None
+    eval_freq_epochs: Optional[int] = None
+    eval_freq_steps: Optional[int] = None
+    benchmark_steps: Optional[int] = None  # stop early after N steps
+
+
+@dataclasses.dataclass
+class ExperimentSpec:
+    experiment_name: str
+    trial_name: str
+    models: Dict[str, ModelSpec]
+    mfcs: List[MFCDef]
+    dataset: DatasetAbstraction
+    tokenizer_path: Optional[str] = None
+    tokenizer: Optional[object] = None  # direct object (tests)
+    total_train_epochs: int = 1
+    seed: int = 1
+    ctl: SaveEvalControl = dataclasses.field(default_factory=SaveEvalControl)
+    eval_dataset: Optional[DatasetAbstraction] = None

@@ -1,0 +1,59 @@
+"""Quickstart CLI: ``python -m realhf_tpu.apps.quickstart <algo> a.b=c ...``
+
+Parity with reference ``realhf/apps/quickstart.py:22``: one subcommand
+per registered experiment, configured by dotted key=value overrides
+(the reference's Hydra override syntax), e.g.::
+
+    python -m realhf_tpu.apps.quickstart sft \
+        experiment_name=my-sft trial_name=t0 \
+        model.path=/path/to/llama dataset.path=data.jsonl \
+        dataset.train_bs_n_seqs=128 model.optimizer.lr=1e-5 \
+        model.parallel.data_parallel_size=4 \
+        model.parallel.tensor_parallel_size=2
+"""
+
+import argparse
+import sys
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("quickstart")
+
+
+def parse_overrides(tokens):
+    out = {}
+    for t in tokens:
+        if "=" not in t:
+            raise ValueError(f"Override `{t}` is not of the form key=value.")
+        k, v = t.split("=", 1)
+        out[k] = v
+    return out
+
+
+def main(argv=None):
+    import realhf_tpu.experiments as experiments
+
+    argv = argv if argv is not None else sys.argv[1:]
+    parser = argparse.ArgumentParser("realhf_tpu quickstart")
+    parser.add_argument(
+        "experiment", choices=sorted(experiments.ALL_EXPERIMENT_CLASSES))
+    parser.add_argument("overrides", nargs="*",
+                        help="dotted key=value config overrides")
+    args = parser.parse_args(argv)
+
+    from realhf_tpu.experiments.common import apply_overrides
+    cfg = experiments.ALL_EXPERIMENT_CLASSES[args.experiment]()
+    apply_overrides(cfg, parse_overrides(args.overrides))
+
+    logger.info("Running experiment %s: %s", args.experiment, cfg)
+    spec = cfg.build()
+
+    from realhf_tpu.system.inline import InlineRunner
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    logger.info("Experiment complete. Last step stats: %s", stats)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,9 @@ class Engine:
         self.version = 0
 
         self._param_shardings = shard_rules.param_shardings(cfg, self.mesh)
+        # Megatron-style vocab padding so wte/head shard over tp even
+        # when vocab_size is not a tp multiple.
+        params = shard_rules.pad_vocab(cfg, params, ctx.tp_size)
         self.params = jax.device_put(params, self._param_shardings)
         self._constrain = shard_rules.activation_constraint(
             self.mesh, ctx.parallel.sequence_parallel)
@@ -210,11 +213,17 @@ class Engine:
     # ------------------------------------------------------------------
     def set_params(self, params, already_sharded: bool = False):
         """Install new weights (parameter reallocation landing point)."""
-        self.params = params if already_sharded else jax.device_put(
-            params, self._param_shardings)
+        if already_sharded:
+            self.params = params
+        else:
+            params = shard_rules.pad_vocab(self.cfg, params,
+                                           self.ctx.tp_size)
+            self.params = jax.device_put(params, self._param_shardings)
 
     def params_numpy(self):
-        return jax.tree.map(np.asarray, self.params)
+        """Host copy with vocab padding stripped (checkpoint layout)."""
+        return shard_rules.unpad_vocab(
+            self.cfg, jax.tree.map(np.asarray, self.params))
 
     def inc_version(self):
         self.version += 1

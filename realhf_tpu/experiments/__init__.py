@@ -1,0 +1,12 @@
+"""Experiment configurations; importing registers them for the CLI."""
+
+import realhf_tpu.experiments.sft_exp  # noqa: F401
+import realhf_tpu.experiments.rw_exp  # noqa: F401
+import realhf_tpu.experiments.dpo_exp  # noqa: F401
+import realhf_tpu.experiments.ppo_exp  # noqa: F401
+import realhf_tpu.experiments.gen_exp  # noqa: F401
+
+from realhf_tpu.experiments.common import (  # noqa: F401
+    ALL_EXPERIMENT_CLASSES,
+    register_experiment,
+)

@@ -78,6 +78,49 @@ def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
     return specs
 
 
+def padded_vocab_size(cfg: TransformerConfig, tp: int) -> int:
+    """Vocab padded up to a tp multiple (Megatron's VocabUtility,
+    reference model_parallel/utils.py:154)."""
+    return ((cfg.vocab_size + tp - 1) // tp) * tp
+
+
+def pad_vocab(cfg: TransformerConfig, params: Dict[str, Any],
+              tp: int) -> Dict[str, Any]:
+    """Zero-pad the vocab dim of wte/head so it shards over tp.
+    Consumers slice logits back to cfg.vocab_size (lm_logits etc.), so
+    padded entries are never sampled or normalized over."""
+    import numpy as np
+    vp = padded_vocab_size(cfg, tp)
+    v = cfg.vocab_size
+    if vp == v or params["embed"]["wte"].shape[0] == vp:  # already padded
+        return params
+    xp = jax.numpy if hasattr(params["embed"]["wte"], "devices") else np
+
+    def _pad(a, axis):
+        width = [(0, 0)] * a.ndim
+        width[axis] = (0, vp - v)
+        return xp.pad(a, width)
+
+    params = {**params, "embed": {**params["embed"]}}
+    params["embed"]["wte"] = _pad(params["embed"]["wte"], 0)
+    if not cfg.is_critic and not cfg.tied_embedding:
+        params = {**params, "head": {"w": _pad(params["head"]["w"], 1)}}
+    return params
+
+
+def unpad_vocab(cfg: TransformerConfig, params: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    """Inverse of pad_vocab (checkpoint saving)."""
+    v = cfg.vocab_size
+    if params["embed"]["wte"].shape[0] == v:
+        return params
+    params = {**params, "embed": {**params["embed"]}}
+    params["embed"]["wte"] = params["embed"]["wte"][:v]
+    if not cfg.is_critic and not cfg.tied_embedding:
+        params = {**params, "head": {"w": params["head"]["w"][:, :v]}}
+    return params
+
+
 def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg),
                         is_leaf=lambda x: isinstance(x, P))

@@ -304,10 +304,14 @@ def forward(
 
 def lm_logits(cfg: TransformerConfig, params: Params,
               hidden: jnp.ndarray) -> jnp.ndarray:
-    """[..., H] -> [..., V] logits in fp32."""
+    """[..., H] -> [..., V] logits in fp32 (tp-padded vocab entries,
+    if any, are sliced away so they are never sampled)."""
     w = head_weight(cfg, params)
-    return jnp.einsum("...h,hv->...v", hidden, w.astype(hidden.dtype),
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum("...h,hv->...v", hidden, w.astype(hidden.dtype),
+                        preferred_element_type=jnp.float32)
+    if logits.shape[-1] != cfg.vocab_size:
+        logits = logits[..., :cfg.vocab_size]
+    return logits
 
 
 def head_weight(cfg: TransformerConfig, params: Params) -> jnp.ndarray:

@@ -72,6 +72,8 @@ def shifted_logprobs_from_hidden(
             mc = None
         logits = jnp.einsum("slh,hv->slv", hc, w,
                             preferred_element_type=jnp.float32)
+        if logits.shape[-1] != cfg.vocab_size:  # tp-padded vocab
+            logits = logits[..., :cfg.vocab_size]
         if temperature != 1.0:
             logits = logits / temperature
         if mc is not None:
@@ -137,6 +139,8 @@ def entropy_from_hidden(cfg, params, hidden, *, chunk: int = 1024,
     def body(_, hc):
         logits = jnp.einsum("slh,hv->slv", hc, w,
                             preferred_element_type=jnp.float32) / temperature
+        if logits.shape[-1] != cfg.vocab_size:  # tp-padded vocab
+            logits = logits[..., :cfg.vocab_size]
         logp = jax.nn.log_softmax(logits, axis=-1)
         return None, -(jnp.exp(logp) * logp).sum(-1)
 

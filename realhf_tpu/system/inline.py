@@ -1,0 +1,201 @@
+"""Single-process experiment runner: the minimum end-to-end slice.
+
+Executes an ExperimentSpec's dataflow graph in one process with all
+models sharing the local device fleet in "symmetric allocation" (every
+MFC on the same mesh), which is the reference's
+``allocation_mode=d$Np$Pm$M`` global-hybrid mode
+(``experiments/common/common.py:319``). The distributed
+master/model-worker runtime adds disjoint sub-meshes and parameter
+reallocation on top of the exact same interface calls.
+
+Responsibilities mirrored from the reference master worker
+(``system/master_worker.py``): dataset loading and epoch accounting,
+topological MFC execution with key remapping, amending results into
+the step's data buffer, save/eval frequency control, per-step
+throughput logging (tokens + TFLOP/s), and benchmark early exit.
+"""
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from realhf_tpu.api import data as data_api
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.config import ModelInterfaceType, ModelName
+from realhf_tpu.api.dfg import DFG
+from realhf_tpu.api.experiment import ExperimentSpec
+from realhf_tpu.base import constants, logging, seeding, timeutil
+from realhf_tpu.engine.engine import Engine
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.models.hf import load_hf_checkpoint
+from realhf_tpu.parallel.mesh import MeshContext, make_mesh
+
+logger = logging.getLogger("InlineRunner", "benchmark")
+
+
+def _build_model(role: str, spec, tokenizer, total_steps: int,
+                 devices=None) -> model_api.Model:
+    import jax
+
+    if spec.path:
+        cfg, params = load_hf_checkpoint(
+            spec.path, spec.hf_family,
+            is_critic=spec.is_critic or spec.init_critic_from_actor)
+    else:
+        cfg = TransformerConfig(**spec.random_init_config,
+                                is_critic=spec.is_critic)
+        params = None
+    cfg.gradient_checkpointing = spec.gradient_checkpointing
+    cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
+    if params is None:
+        params = T.init_params(
+            cfg, seeding.derive_key("model_init", role))
+
+    mesh = make_mesh(spec.parallel, devices=devices)
+    ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
+    engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
+                    total_train_steps=total_steps)
+    return model_api.Model(ModelName(role, 0), engine, tokenizer,
+                           hf_family=spec.hf_family)
+
+
+class InlineRunner:
+
+    def __init__(self, spec: ExperimentSpec):
+        self.spec = spec
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+        seeding.set_random_seed(spec.seed)
+
+        import realhf_tpu.datasets  # noqa: F401 - register datasets
+        import realhf_tpu.interfaces  # noqa: F401 - register interfaces
+
+        self.dfg = DFG(spec.mfcs)
+        self.tokenizer = spec.tokenizer or (
+            data_api.load_hf_tokenizer(spec.tokenizer_path)
+            if spec.tokenizer_path else None)
+
+        src = self.dfg.sources[0]
+        self.dataset = data_api.make_dataset(
+            spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
+            tokenizer_or_path=self.tokenizer)
+        self.dataloader = data_api.PackedDataLoader(
+            self.dataset, batch_size=src.n_seqs, seed=spec.seed)
+        self.eval_dataloader = None
+        if spec.eval_dataset is not None:
+            eval_ds = data_api.make_dataset(
+                spec.eval_dataset, seed=spec.seed, dp_rank=0, world_size=1,
+                tokenizer_or_path=self.tokenizer)
+            self.eval_dataloader = data_api.PackedDataLoader(
+                eval_ds, batch_size=src.n_seqs, shuffle=False)
+
+        steps_per_epoch = len(self.dataloader)
+        total_steps = steps_per_epoch * spec.total_train_epochs
+        self.models: Dict[str, model_api.Model] = {}
+        for role, mspec in spec.models.items():
+            self.models[role] = _build_model(
+                role, mspec, self.tokenizer, total_steps)
+        self.interfaces = {}
+        for node in self.dfg.nodes:
+            self.interfaces[node.name] = model_api.make_interface(
+                node.interface_impl)
+
+        ctl = spec.ctl
+        self.save_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctl.save_freq_epochs, freq_step=ctl.save_freq_steps,
+            freq_sec=ctl.save_freq_secs)
+        self.eval_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctl.eval_freq_epochs, freq_step=ctl.eval_freq_steps,
+            freq_sec=None)
+        self.global_step = 0
+
+    # ------------------------------------------------------------------
+    def run_step(self, batch: data_api.SequenceSample) -> Dict[str, Dict]:
+        """Execute the full DFG once over one batch; returns per-MFC
+        stats (mirrors one master-worker _poll iteration)."""
+        stats: Dict[str, Dict] = {}
+        data = batch
+        for node in self.dfg.topological_order():
+            model = self.models[node.role]
+            itf = self.interfaces[node.name]
+            inp = data.select([k for k in node.input_keys if k in data.keys])
+            if node.input_key_remap:
+                inp.remap_keys_(node.input_key_remap)
+            if node.interface_type == ModelInterfaceType.GENERATE:
+                out = itf.generate(model, inp, n_mbs=node.n_mbs)
+            elif node.interface_type == ModelInterfaceType.INFERENCE:
+                out = itf.inference(model, inp, n_mbs=node.n_mbs)
+            elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                out = itf.train_step(model, inp, n_mbs=node.n_mbs)
+            else:
+                raise NotImplementedError(node.interface_type)
+            if isinstance(out, data_api.SequenceSample):
+                if node.output_key_remap:
+                    out.remap_keys_(node.output_key_remap)
+                data.update_(out)
+            elif isinstance(out, dict):
+                stats[node.name] = out
+                if node.log_return_value:
+                    logger.info("MFC %s stats: %s", node.name, out)
+        return stats
+
+    def _maybe_save(self, epochs: int = 0, steps: int = 0, force=False):
+        if not force and not self.save_ctl.check(epochs=epochs, steps=steps):
+            return
+        for node in self.dfg.nodes:
+            if node.interface_type != ModelInterfaceType.TRAIN_STEP:
+                continue
+            model = self.models[node.role]
+            path = f"{constants.run_save_path()}/{node.role}"
+            self.interfaces[node.name].save(model, path)
+            logger.info("Saved %s to %s", node.role, path)
+
+    def _maybe_eval(self, epochs: int = 0, steps: int = 0):
+        if self.eval_dataloader is None:
+            return
+        if not self.eval_ctl.check(epochs=epochs, steps=steps):
+            return
+        for node in self.dfg.nodes:
+            if node.interface_type != ModelInterfaceType.TRAIN_STEP:
+                continue
+            ev = self.interfaces[node.name].evaluate(
+                self.models[node.role], self.eval_dataloader)
+            if ev:
+                logger.info("Eval %s: %s", node.role, ev)
+
+    def run(self) -> Dict[str, Dict]:
+        """Train for the configured epochs; returns the last step stats."""
+        spec = self.spec
+        last_stats = {}
+        done = False
+        for epoch in range(spec.total_train_epochs):
+            for step, batch in enumerate(self.dataloader):
+                t0 = time.monotonic()
+                last_stats = self.run_step(batch)
+                dt = time.monotonic() - t0
+                self.global_step += 1
+                token_key = next(
+                    (k for k in ("packed_input_ids", "packed_prompts")
+                     if k in batch.keys),
+                    max(batch.keys, key=batch.total_len))
+                n_tokens = batch.total_len(token_key)
+                logger.info(
+                    "epoch %d step %d (global %d): %.2fs, #tokens %d, %s",
+                    epoch, step, self.global_step, dt, n_tokens,
+                    {k: {kk: round(vv, 4) for kk, vv in v.items()
+                         if isinstance(vv, float)}
+                     for k, v in last_stats.items()})
+                self._maybe_save(steps=1)
+                self._maybe_eval(steps=1)
+                if (spec.ctl.benchmark_steps is not None
+                        and self.global_step >= spec.ctl.benchmark_steps):
+                    done = True
+                    break
+            if done:
+                break
+            self._maybe_save(epochs=1)
+            self._maybe_eval(epochs=1)
+        self._maybe_save(force=True)
+        return last_stats

@@ -1,0 +1,182 @@
+"""End-to-end system tests: experiment configs -> inline runner, for
+SFT and the 6-MFC PPO graph, on the virtual 8-device mesh. Mirrors the
+role of the reference's profile/mock system tests
+(``experiments/benchmark/profile_exp.py``)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.experiments.common import apply_overrides
+from realhf_tpu.experiments.dpo_exp import DPOConfig
+from realhf_tpu.experiments.ppo_exp import PPOConfig
+from realhf_tpu.experiments.sft_exp import SFTConfig
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu")
+
+
+class FakeTokenizer:
+    pad_token_id = 0
+    eos_token_id = 1
+    eos_token = " zEOSz"
+    padding_side = "left"
+
+    def __call__(self, texts, truncation=False, max_length=None,
+                 padding=False, return_length=False,
+                 return_attention_mask=False, **kw):
+        ids = [[2 + (hash(w) % 1000) for w in t.split()] for t in texts]
+        if truncation and max_length:
+            ids = [x[:max_length] for x in ids]
+        out = {"input_ids": ids}
+        if return_length:
+            out["length"] = [len(x) for x in ids]
+        return out
+
+    def decode(self, ids, **kw):
+        return " ".join(map(str, ids))
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+@pytest.fixture
+def sft_data(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "sft.jsonl"
+    _write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
+         "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
+        for i in range(16)])
+    return str(path)
+
+
+@pytest.fixture
+def prompt_data(tmp_path):
+    rng = np.random.default_rng(1)
+    path = tmp_path / "prompts.jsonl"
+    _write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
+        for i in range(16)])
+    return str(path)
+
+
+def _patch_random_models(spec, tokenizer):
+    for role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        mspec.parallel = ParallelismConfig(
+            data_parallel_size=2, tensor_parallel_size=4)
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = tokenizer
+
+
+def test_apply_overrides_nested_and_frozen():
+    cfg = SFTConfig()
+    apply_overrides(cfg, {
+        "experiment_name": "exp1",
+        "model.optimizer.lr": "3e-4",
+        "model.parallel.data_parallel_size": "4",
+        "dataset.train_bs_n_seqs": "32",
+        "save_freq_steps": "none",
+    })
+    assert cfg.experiment_name == "exp1"
+    assert cfg.model.optimizer.lr == 3e-4
+    assert cfg.model.parallel.data_parallel_size == 4  # frozen replaced
+    assert cfg.dataset.train_bs_n_seqs == 32
+    assert cfg.save_freq_steps is None
+    with pytest.raises(AttributeError):
+        apply_overrides(cfg, {"model.nonexistent": "1"})
+
+
+def test_sft_end_to_end(sft_data, tmp_path):
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = SFTConfig(experiment_name="sfttest", trial_name="t0",
+                    total_train_epochs=2)
+    apply_overrides(cfg, {"dataset.path": sft_data,
+                          "dataset.train_bs_n_seqs": "8",
+                          "dataset.max_seqlen": "32"})
+    spec = cfg.build()
+    _patch_random_models(spec, FakeTokenizer())
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    assert np.isfinite(stats["trainDefault"]["loss"])
+    # final save happened
+    import os
+    from realhf_tpu.base import constants
+    assert os.path.exists(os.path.join(constants.run_save_path(),
+                                       "default", "config.json"))
+
+
+def test_ppo_end_to_end(prompt_data):
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = PPOConfig(experiment_name="ppotest", trial_name="t0",
+                    total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.top_k": "16",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    assert len(spec.mfcs) == 6
+    _patch_random_models(spec, FakeTokenizer())
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    assert "actor_train" in stats and "critic_train" in stats
+    assert np.isfinite(stats["actor_train"]["actor_loss"])
+    assert np.isfinite(stats["critic_train"]["value_loss"])
+    assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
+
+
+def test_dpo_end_to_end(tmp_path):
+    from realhf_tpu.system.inline import InlineRunner
+
+    rng = np.random.default_rng(2)
+    path = tmp_path / "pairs.jsonl"
+    _write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
+         "pos_answers": [" good answer here"],
+         "neg_answers": [" bad reply instead"]}
+        for i in range(8)])
+    cfg = DPOConfig(experiment_name="dpotest", trial_name="t0",
+                    total_train_epochs=1)
+    apply_overrides(cfg, {"dataset.path": str(path),
+                          "dataset.train_bs_n_seqs": "8",
+                          "dataset.max_seqlen": "24"})
+    spec = cfg.build()
+    _patch_random_models(spec, FakeTokenizer())
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    assert np.isfinite(stats["actor_train"]["loss"])
+
+
+def test_quickstart_cli(sft_data, monkeypatch):
+    """Drive the argparse CLI surface itself (config path errors)."""
+    from realhf_tpu.apps import quickstart
+
+    with pytest.raises(ValueError):
+        quickstart.parse_overrides(["no_equals_sign"])
+    assert quickstart.parse_overrides(["a.b=1", "c=x"]) == {
+        "a.b": "1", "c": "x"}
