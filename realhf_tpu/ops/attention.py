@@ -79,7 +79,11 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
     """
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu"
-                     and q.shape[1] % 128 == 0 and q.shape[3] >= 64)
+                     and q.shape[1] % 128 == 0 and q.shape[3] >= 64
+                     # the flash kernel requires a static python scale
+                     # and has no soft-cap support
+                     and logits_soft_cap is None
+                     and (scale is None or isinstance(scale, (int, float))))
     if use_flash:
         try:
             from realhf_tpu.ops.flash_attention import flash_attention
