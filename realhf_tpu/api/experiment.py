@@ -51,6 +51,12 @@ class ExperimentSpec:
     models: Dict[str, ModelSpec]
     mfcs: List[MFCDef]
     dataset: DatasetAbstraction
+    # Per-MFC parallelism overrides (MFC name -> layout). An MFC whose
+    # layout differs from its role's primary creates a weight replica
+    # kept fresh by parameter reallocation (the reference's
+    # RPCAllocation, quickstart/device_mesh.py:269).
+    allocations: Dict[str, ParallelismConfig] = dataclasses.field(
+        default_factory=dict)
     tokenizer_path: Optional[str] = None
     tokenizer: Optional[object] = None  # direct object (tests)
     total_train_epochs: int = 1

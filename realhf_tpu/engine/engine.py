@@ -59,8 +59,10 @@ class Engine:
 
         self._param_shardings = shard_rules.param_shardings(cfg, self.mesh)
         # Megatron-style vocab padding so wte/head shard over tp even
-        # when vocab_size is not a tp multiple.
-        params = shard_rules.pad_vocab(cfg, params, ctx.tp_size)
+        # when vocab_size is not a tp multiple (re-padded if the source
+        # carried another tp's padding).
+        params = shard_rules.normalize_vocab_padding(cfg, params,
+                                                     ctx.tp_size)
         self.params = jax.device_put(params, self._param_shardings)
         self._constrain = shard_rules.activation_constraint(
             self.mesh, ctx.parallel.sequence_parallel)
@@ -216,8 +218,8 @@ class Engine:
         if already_sharded:
             self.params = params
         else:
-            params = shard_rules.pad_vocab(self.cfg, params,
-                                           self.ctx.tp_size)
+            params = shard_rules.normalize_vocab_padding(
+                self.cfg, params, self.ctx.tp_size)
             self.params = jax.device_put(params, self._param_shardings)
 
     def params_numpy(self):

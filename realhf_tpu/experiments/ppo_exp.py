@@ -69,6 +69,12 @@ class PPOConfig(CommonExperimentConfig):
     critic_train_n_mbs: int = 1
     rew_inf_n_mbs: int = 1
     ref_inf_n_mbs: int = 1
+    # Per-MFC layout overrides in the reference's "d4t2"-style shorthand
+    # (decoupled allocation => weight replicas + parameter reallocation).
+    actor_gen_alloc: Optional[str] = None
+    rew_inf_alloc: Optional[str] = None
+    ref_inf_alloc: Optional[str] = None
+    critic_inf_alloc: Optional[str] = None
 
     def build(self) -> ExperimentSpec:
         p = self.ppo
@@ -157,7 +163,16 @@ class PPOConfig(CommonExperimentConfig):
         dataset = DatasetAbstraction(
             "prompt", args=dict(max_length=self.dataset.max_seqlen,
                                 dataset_path=self.dataset.path))
+        from realhf_tpu.parallel.mesh import parse_parallelism
+        allocations = {}
+        for mfc_name, alloc in (("actor_gen", self.actor_gen_alloc),
+                                ("rew_inf", self.rew_inf_alloc),
+                                ("ref_inf", self.ref_inf_alloc),
+                                ("critic_inf", self.critic_inf_alloc)):
+            if alloc:
+                allocations[mfc_name] = parse_parallelism(alloc)
         return ExperimentSpec(
+            allocations=allocations,
             experiment_name=self.experiment_name,
             trial_name=self.trial_name,
             models={

@@ -108,6 +108,13 @@ def pad_vocab(cfg: TransformerConfig, params: Dict[str, Any],
     return params
 
 
+def normalize_vocab_padding(cfg: TransformerConfig, params: Dict[str, Any],
+                            tp: int) -> Dict[str, Any]:
+    """Re-pad params (possibly padded for a different tp) to the
+    padding this tp needs."""
+    return pad_vocab(cfg, unpad_vocab(cfg, params), tp)
+
+
 def unpad_vocab(cfg: TransformerConfig, params: Dict[str, Any]
                 ) -> Dict[str, Any]:
     """Inverse of pad_vocab (checkpoint saving)."""

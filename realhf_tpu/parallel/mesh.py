@@ -55,9 +55,46 @@ class ParallelismConfig:
         return (self.data_parallel_size * self.tensor_parallel_size *
                 self.pipeline_parallel_size)
 
+    def same_layout(self, other: "ParallelismConfig") -> bool:
+        """Same device-placement layout (ignores flags like
+        gradient_checkpointing that do not affect weight sharding)."""
+        return (self.data_parallel_size == other.data_parallel_size
+                and self.tensor_parallel_size == other.tensor_parallel_size
+                and self.pipeline_parallel_size == other.pipeline_parallel_size
+                and self.sequence_parallel == other.sequence_parallel)
+
     def __str__(self):
         return (f"d{self.data_parallel_size}t{self.tensor_parallel_size}"
                 f"p{self.pipeline_parallel_size}")
+
+
+def parse_parallelism(name: str) -> ParallelismConfig:
+    """Parse the reference's ``d$Np$Pm$M`` allocation shorthand
+    (``experiments/common/utils.py:201``), e.g. "d4t2" or "d2t2p2".
+    Axis letters: d = data, t = tensor (m also accepted), p = pipeline;
+    trailing "s" enables sequence parallelism.
+    """
+    import re
+    s = name.strip()
+    tokens = re.findall(r"([dtmp])(\d+)|(s)(?!\d)", s)
+    consumed = "".join(t[0] + t[1] + t[2] for t in tokens)
+    sizes = {"d": 1, "t": 1, "p": 1}
+    seq_par = False
+    for axis, num, sp in tokens:
+        if sp:
+            seq_par = True
+            continue
+        key = "t" if axis == "m" else axis  # m = model = tensor
+        sizes[key] = int(num)
+    if consumed != s or not tokens:
+        raise ValueError(f"Cannot parse parallelism spec `{name}`; "
+                         "expected e.g. d4t2, d4p1m2, d2t2p1, d1t8s "
+                         "(any axis order; m is an alias for t).")
+    return ParallelismConfig(
+        data_parallel_size=sizes["d"],
+        tensor_parallel_size=sizes["t"],
+        pipeline_parallel_size=sizes["p"],
+        sequence_parallel=seq_par)
 
 
 def default_devices() -> List:

@@ -1,0 +1,116 @@
+"""Parameter reallocation: reshard live weights between meshes.
+
+TPU-native replacement for the reference's signature feature
+(``realhf/impl/model/comm/param_realloc.py`` + ``nn/flatten_param.py``
++ ``nn/real_llm_parallel.py``): there, every (layer range, TP shard)
+pair is sliced out of a flat buffer and NCCL-broadcast between groups.
+Here a model's weights are one sharded pytree, and moving them between
+two `jax.sharding.Mesh`es -- different dp/tp degrees, overlapping or
+disjoint device sets -- is a single `jax.device_put` onto the target
+shardings: XLA computes the minimal device-to-device transfer plan
+(the interval arithmetic the reference implements by hand in
+``param_intervals_from_keys``, flatten_param.py:301).
+
+EMA reallocation (``target = eta*src + (1-eta)*target``, reference
+``patch_reparallelization``, real_llm_api.py:762) runs as a jitted
+lerp on the target mesh after resharding.
+
+Only the vocab dimension needs host arithmetic: replicas with
+different tp degrees carry different Megatron-style vocab padding,
+so wte/head are unpadded/repadded in transit.
+"""
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.base import logging
+from realhf_tpu.models import sharding as shard_rules
+from realhf_tpu.models.config import TransformerConfig
+
+logger = logging.getLogger("param_realloc", "benchmark")
+
+
+def _repad_for_target(cfg: TransformerConfig, params: Any,
+                      target_tp: int) -> Any:
+    """Adjust vocab padding from the source tp to the target tp."""
+    vp_target = shard_rules.padded_vocab_size(cfg, target_tp)
+    if params["embed"]["wte"].shape[0] == vp_target:
+        return params
+    params = shard_rules.unpad_vocab(cfg, params)
+    return shard_rules.pad_vocab(cfg, params, target_tp)
+
+
+@jax.jit
+def _ema_lerp(src, dst, eta):
+    return jax.tree.map(
+        lambda x, y: (eta * x.astype(jnp.float32)
+                      + (1.0 - eta) * y.astype(jnp.float32)).astype(y.dtype),
+        src, dst)
+
+
+def reallocate(
+    cfg: TransformerConfig,
+    src_params: Any,
+    dst_engine,
+    eta: float = 1.0,
+) -> float:
+    """Move (or EMA-merge) src weights onto dst_engine's mesh.
+
+    Returns the wall-clock seconds of the resharding transfer (the
+    north-star reshard-latency metric).
+    """
+    t0 = time.monotonic()
+    params = _repad_for_target(cfg, src_params, dst_engine.ctx.tp_size)
+    moved = jax.device_put(params, dst_engine._param_shardings)
+    if eta != 1.0:
+        moved = _ema_lerp(moved, dst_engine.params,
+                          jnp.asarray(eta, jnp.float32))
+    jax.block_until_ready(moved)
+    dt = time.monotonic() - t0
+    dst_engine.set_params(moved, already_sharded=True)
+    return dt
+
+
+def offload_to_host(params: Any) -> Any:
+    """Move a pytree to host memory (reference async_offload,
+    real_llm_api.py:274 -- pinned-CPU offload)."""
+    cpu = jax.devices("cpu")[0]
+    return jax.device_put(params, cpu)
+
+
+class ReplicaManager:
+    """Keeps secondary engines (replicas with different meshes) of a
+    role in sync with the trainable primary.
+
+    Mirrors reference ``resolve_replica_ids`` + ``resolve_rpc_hooks``
+    (experiments/common/utils.py:126,143): the trainable replica is
+    the source of truth; stale replicas are refreshed by reallocation
+    before executing their MFC.
+    """
+
+    def __init__(self):
+        # role -> replica engine id -> version of last sync
+        self._synced: Dict[str, Dict[int, int]] = {}
+        self.last_reshard_secs: Optional[float] = None
+
+    def ensure_fresh(self, role: str, primary_model, replica_model,
+                     eta: float = 1.0):
+        if replica_model is primary_model:
+            return
+        pv = primary_model.version.global_step
+        synced = self._synced.setdefault(role, {})
+        rid = id(replica_model)
+        if synced.get(rid) == pv:
+            return
+        dt = reallocate(primary_model.config,
+                        primary_model.engine.params,
+                        replica_model.engine, eta=eta)
+        self.last_reshard_secs = dt
+        synced[rid] = pv
+        logger.info(
+            "Reallocated %s %s -> %s in %.3fs", role,
+            primary_model.engine.ctx.parallel,
+            replica_model.engine.ctx.parallel, dt)

@@ -36,10 +36,16 @@ logger = logging.getLogger("InlineRunner", "benchmark")
 
 
 def _build_model(role: str, spec, tokenizer, total_steps: int,
-                 devices=None) -> model_api.Model:
-    import jax
+                 devices=None, params_override=None,
+                 cfg_override=None) -> model_api.Model:
+    from realhf_tpu.parallel.mesh import default_devices
 
-    if spec.path:
+    if params_override is not None:
+        # Replica path: reuse the primary's live weights (device_put in
+        # Engine.__init__ reshards them) instead of re-reading the
+        # checkpoint.
+        cfg, params = cfg_override, params_override
+    elif spec.path:
         cfg, params = load_hf_checkpoint(
             spec.path, spec.hf_family,
             is_critic=spec.is_critic or spec.init_critic_from_actor)
@@ -47,12 +53,15 @@ def _build_model(role: str, spec, tokenizer, total_steps: int,
         cfg = TransformerConfig(**spec.random_init_config,
                                 is_critic=spec.is_critic)
         params = None
-    cfg.gradient_checkpointing = spec.gradient_checkpointing
-    cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
+    if params_override is None:
+        cfg.gradient_checkpointing = spec.gradient_checkpointing
+        cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
     if params is None:
         params = T.init_params(
             cfg, seeding.derive_key("model_init", role))
 
+    if devices is None:
+        devices = default_devices()[:spec.parallel.world_size]
     mesh = make_mesh(spec.parallel, devices=devices)
     ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
     engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
@@ -97,6 +106,36 @@ class InlineRunner:
         for role, mspec in spec.models.items():
             self.models[role] = _build_model(
                 role, mspec, self.tokenizer, total_steps)
+
+        # Replica engines for MFCs allocated on a different layout than
+        # their role's primary (reference resolve_replica_ids,
+        # experiments/common/utils.py:126). Replicas never own an
+        # optimizer; weights flow from the primary via reallocation.
+        from realhf_tpu.parallel.realloc import ReplicaManager
+        import dataclasses as _dc
+        self.replicas: Dict[str, model_api.Model] = {}
+        self.replica_mgr = ReplicaManager()
+        for node in self.dfg.nodes:
+            alloc = spec.allocations.get(node.name)
+            if alloc is None:
+                continue
+            role = node.role
+            primary = self.models[role]
+            if alloc.same_layout(primary.engine.ctx.parallel):
+                continue
+            if node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                raise ValueError(
+                    f"MFC {node.name}: train MFCs must run on the "
+                    "role's primary layout (replicas have no optimizer).")
+            mspec = _dc.replace(spec.models[role], parallel=alloc,
+                                optimizer=None)
+            self.replicas[node.name] = _build_model(
+                f"{role}-{node.name}", mspec, self.tokenizer, total_steps,
+                params_override=primary.engine.params,
+                cfg_override=primary.config)
+            logger.info("Created replica for %s: %s (primary %s)",
+                        node.name, alloc, primary.engine.ctx.parallel)
+
         self.interfaces = {}
         for node in self.dfg.nodes:
             self.interfaces[node.name] = model_api.make_interface(
@@ -118,7 +157,12 @@ class InlineRunner:
         stats: Dict[str, Dict] = {}
         data = batch
         for node in self.dfg.topological_order():
-            model = self.models[node.role]
+            primary = self.models[node.role]
+            model = self.replicas.get(node.name, primary)
+            if model is not primary:
+                # param-realloc pre-hook: refresh the replica's weights
+                # from the trainable primary if it has stepped since.
+                self.replica_mgr.ensure_fresh(node.role, primary, model)
             itf = self.interfaces[node.name]
             inp = data.select([k for k in node.input_keys if k in data.keys])
             if node.input_key_remap:

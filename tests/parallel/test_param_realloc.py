@@ -1,0 +1,195 @@
+"""Parameter reallocation round-trip tests -- the TPU analog of the
+reference's crown-jewel suite ``tests/comm/test_param_realloc.py``
+(:515-528): world of 8 virtual devices, parameterized over source and
+target (dp, tp) layouts on overlapping and disjoint device subsets,
+checking bit-equality after round-trips, inference consistency across
+layouts, that training updates propagate through reallocation, and
+EMA merging.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.api.config import ModelName
+from realhf_tpu.engine.engine import Engine
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.parallel.mesh import MeshContext, ParallelismConfig, make_mesh
+from realhf_tpu.parallel.realloc import offload_to_host, reallocate
+
+VOCAB = 107  # deliberately prime: vocab padding differs per tp
+
+
+def tiny_cfg(is_critic=False):
+    return TransformerConfig(
+        n_layers=2, n_kv_heads=4, n_q_heads=4, hidden_dim=32,
+        intermediate_dim=64, vocab_size=VOCAB, apply_rotary=True,
+        layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+        use_attn_proj_bias=False, use_mlp_bias=False,
+        activation_function="silu", compute_dtype="float32",
+        is_critic=is_critic)
+
+
+def build_engine(cfg, dp, tp, devices=None, lr=None, name="m", seed=0):
+    parallel = ParallelismConfig(data_parallel_size=dp,
+                                 tensor_parallel_size=tp)
+    if devices is None:
+        devices = jax.devices("cpu")[:parallel.world_size]
+    ctx = MeshContext(ModelName(name, 0), make_mesh(parallel, devices),
+                      parallel)
+    params = T.init_params(cfg, jax.random.PRNGKey(seed))
+    opt = None if lr is None else OptimizerConfig(
+        lr=lr, warmup_steps_proportion=0.0, lr_scheduler_type="constant")
+    return Engine(cfg, ctx, params, optimizer=opt, total_train_steps=100)
+
+
+LAYOUTS = [(4, 1), (2, 2), (1, 4), (8, 1), (2, 4), (1, 8)]
+
+
+def _canonical(engine):
+    """Host pytree with padding stripped, for comparison."""
+    return engine.params_numpy()
+
+
+@pytest.mark.parametrize("src", LAYOUTS[:4])
+@pytest.mark.parametrize("dst", LAYOUTS[:4])
+def test_roundtrip_equality(src, dst):
+    cfg = tiny_cfg()
+    devs = jax.devices("cpu")
+    e_src = build_engine(cfg, *src, devices=devs[:src[0] * src[1]], seed=3)
+    e_dst = build_engine(cfg, *dst, devices=devs[-dst[0] * dst[1]:], seed=7)
+
+    before = _canonical(e_src)
+    reallocate(cfg, e_src.params, e_dst)
+    mid = _canonical(e_dst)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(mid)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # round-trip back
+    reallocate(cfg, e_dst.params, e_src)
+    after = _canonical(e_src)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_inference_consistent_across_layouts():
+    cfg = tiny_cfg()
+    devs = jax.devices("cpu")
+    e1 = build_engine(cfg, 4, 2, devices=devs, seed=1)
+    e2 = build_engine(cfg, 2, 2, devices=devs[:4], seed=2)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, VOCAB, size=(4, 16)).astype(np.int32)
+    seg = np.ones_like(ids)
+    lp1 = np.asarray(e1.forward_logprobs(ids, seg))
+    reallocate(cfg, e1.params, e2)
+    lp2 = np.asarray(e2.forward_logprobs(ids, seg))
+    np.testing.assert_allclose(lp1, lp2, rtol=1e-5, atol=1e-6)
+
+
+def test_training_updates_propagate():
+    """Train on layout A, realloc to B: B must produce the updated
+    outputs (reference test_param_realloc:381-512)."""
+    cfg = tiny_cfg()
+    devs = jax.devices("cpu")
+    train_e = build_engine(cfg, 2, 2, devices=devs[:4], lr=1e-2, seed=5)
+    gen_e = build_engine(cfg, 1, 4, devices=devs[4:], seed=9)
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, VOCAB, size=(2, 16)).astype(np.int32)
+    seg = np.ones_like(ids)
+
+    def loss_fn(p, mb):
+        h, _ = T.forward(cfg, p, mb["input_ids"], mb["seg_ids"])
+        from realhf_tpu.ops import functional as F
+        lp = F.shifted_logprobs_from_hidden(cfg, p, h, mb["input_ids"],
+                                            mb["seg_ids"])
+        return -lp.mean(), {}
+
+    reallocate(cfg, train_e.params, gen_e)
+    lp_before = np.asarray(gen_e.forward_logprobs(ids, seg))
+    for _ in range(3):
+        train_e.train_batch([dict(input_ids=ids, seg_ids=seg)], loss_fn,
+                            loss_fn_key="t")
+    reallocate(cfg, train_e.params, gen_e)
+    lp_after = np.asarray(gen_e.forward_logprobs(ids, seg))
+    assert np.abs(lp_after - lp_before).max() > 1e-3  # updates visible
+    # and match the trainable layout's own outputs exactly
+    lp_train = np.asarray(train_e.forward_logprobs(ids, seg))
+    np.testing.assert_allclose(lp_after, lp_train, rtol=1e-5, atol=1e-6)
+
+
+def test_ema_reallocation():
+    cfg = tiny_cfg()
+    devs = jax.devices("cpu")
+    src = build_engine(cfg, 2, 2, devices=devs[:4], seed=11)
+    dst = build_engine(cfg, 2, 2, devices=devs[4:], seed=12)
+    a = _canonical(src)
+    b = _canonical(dst)
+    reallocate(cfg, src.params, dst, eta=0.3)
+    merged = _canonical(dst)
+    for x, y, z in zip(jax.tree.leaves(a), jax.tree.leaves(b),
+                       jax.tree.leaves(merged)):
+        np.testing.assert_allclose(
+            np.asarray(z), 0.3 * np.asarray(x) + 0.7 * np.asarray(y),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_offload_roundtrip():
+    cfg = tiny_cfg()
+    e = build_engine(cfg, 2, 2, seed=13)
+    before = _canonical(e)
+    host = offload_to_host(e.params)
+    assert all(not d.platform == "tpu"
+               for leaf in jax.tree.leaves(host)
+               for d in leaf.devices())
+    e.set_params(host, already_sharded=False)
+    after = _canonical(e)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_critic_roundtrip():
+    cfg = tiny_cfg(is_critic=True)
+    devs = jax.devices("cpu")
+    e1 = build_engine(cfg, 4, 1, devices=devs[:4], seed=20)
+    e2 = build_engine(cfg, 1, 2, devices=devs[4:6], seed=21)
+    before = _canonical(e1)
+    reallocate(cfg, e1.params, e2)
+    reallocate(cfg, e2.params, e1)
+    after = _canonical(e1)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_parse_parallelism_permutations():
+    from realhf_tpu.parallel.mesh import parse_parallelism
+    a = parse_parallelism("d4t2")
+    assert (a.data_parallel_size, a.tensor_parallel_size,
+            a.pipeline_parallel_size) == (4, 2, 1)
+    b = parse_parallelism("d4p1m2")  # reference's documented order
+    assert (b.data_parallel_size, b.tensor_parallel_size,
+            b.pipeline_parallel_size) == (4, 2, 1)
+    c = parse_parallelism("m2d4")
+    assert c.tensor_parallel_size == 2 and c.data_parallel_size == 4
+    d = parse_parallelism("d1t8s")
+    assert d.sequence_parallel
+    import pytest as _pytest
+    for bad in ("x9z", "", "d", "d4q2"):
+        with _pytest.raises(ValueError):
+            parse_parallelism(bad)
+
+
+def test_sub_fleet_replica_layouts():
+    """Runner-style build of engines whose world size is smaller than
+    the fleet must work (review regression)."""
+    cfg = tiny_cfg()
+    e_small = build_engine(cfg, 2, 2)  # 4 of 8 devices
+    e_full = build_engine(cfg, 2, 4)
+    reallocate(cfg, e_full.params, e_small)
+    a = _canonical(e_full)
+    b = _canonical(e_small)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -180,3 +180,37 @@ def test_quickstart_cli(sft_data, monkeypatch):
         quickstart.parse_overrides(["no_equals_sign"])
     assert quickstart.parse_overrides(["a.b=1", "c=x"]) == {
         "a.b": "1", "c": "x"}
+
+
+def test_ppo_decoupled_allocation(prompt_data):
+    """PPO with actor_gen and ref_inf on different layouts than the
+    trainable models: weight replicas must stay in sync through
+    parameter reallocation (importance ratio ~= 1 proves the generation
+    replica carried the current actor weights)."""
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = PPOConfig(experiment_name="ppodec", trial_name="t0",
+                    total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.ppo_n_minibatches": "2",
+        "ppo.force_no_logits_mask": "true",
+        "ppo.top_k": "0",   # no warping: sampled logprobs must equal
+        "ppo.top_p": "1.0",  # the recomputed ones without mask replay
+        "actor_gen_alloc": "d8t1",   # generation layout: pure DP
+        "ref_inf_alloc": "d1t8",     # ref inference: pure TP
+    })
+    spec = cfg.build()
+    assert set(spec.allocations) == {"actor_gen", "ref_inf"}
+    _patch_random_models(spec, FakeTokenizer())
+    runner = InlineRunner(spec)
+    assert set(runner.replicas) == {"actor_gen", "ref_inf"}
+    stats = runner.run()
+    # ratio ~= 1 on each step's first minibatch requires the gen
+    # replica to hold the freshly trained actor weights every step
+    assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
+    assert runner.replica_mgr.last_reshard_secs is not None
