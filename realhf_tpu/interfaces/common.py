@@ -71,6 +71,21 @@ def split_minibatches(input_: SequenceSample, n: int,
     return input_.split(n, min_size=min_size)
 
 
+def forward_with_aux(cfg, params, input_ids, seg_ids):
+    """Model forward returning (hidden, aux-loss dict). For MoE models
+    the dict carries router load-balancing/z losses that MUST be added
+    to the training objective (the reference applies them automatically
+    via MoEAuxLossAutoScaler, utils/moe.py:395); dense models return
+    an empty dict."""
+    from realhf_tpu.models import transformer as _T
+    if cfg.mlp_type == "moe":
+        h, _, aux = _T.forward(cfg, params, input_ids, seg_ids,
+                               return_aux=True)
+        return h, aux
+    h, _ = _T.forward(cfg, params, input_ids, seg_ids)
+    return h, {}
+
+
 def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:
     """Pad a list of stream batches to a common [S, L] so they can be
     stacked and scanned as microbatches in one jitted step."""

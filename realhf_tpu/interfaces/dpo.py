@@ -41,7 +41,8 @@ def _answer_masks(sb: common.StreamBatch, seqlens: List[int],
 def _make_loss_fn(cfg, n_seqs: int, beta: float):
 
     def loss_fn(params, mb):
-        h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+        h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
+                                         mb["seg_ids"])
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         masked = (lp * mb["answer_mask"]).reshape(-1)
@@ -58,8 +59,9 @@ def _make_loss_fn(cfg, n_seqs: int, beta: float):
         pos_score = (beta * (pi_pos - ref_pos) * valid).sum() / denom
         neg_score = (beta * (pi_neg - ref_neg) * valid).sum() / denom
         kl = (-(pi_pos - ref_pos + pi_neg - ref_neg) * valid).sum() / denom
-        return loss, {"loss": loss, "pos_score": pos_score,
-                      "neg_score": neg_score, "kl": kl}
+        return loss + sum(aux.values()), {
+            "loss": loss, "pos_score": pos_score,
+            "neg_score": neg_score, "kl": kl, **aux}
 
     return loss_fn
 

@@ -266,7 +266,8 @@ class PPOActorInterface(model_api.ModelInterface):
         early_imp = self.early_stop_imp_ratio
 
         def loss_fn(params, mb):
-            h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+            h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
+                                             mb["seg_ids"])
             lmask = mb.get("logits_mask")
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
@@ -280,11 +281,11 @@ class PPOActorInterface(model_api.ModelInterface):
                 scale = scale * (stats["importance_weight"] <= early_imp)
             if early_kl is not None:
                 scale = scale * (stats["approx_kl"] <= early_kl)
-            return loss * scale, dict(
+            return loss * scale + sum(aux.values()), dict(
                 actor_loss=loss,
                 ppo_approx_kl=stats["approx_kl"],
                 actor_clip_ratio=stats["clip_ratio"],
-                importance_weight=stats["importance_weight"])
+                importance_weight=stats["importance_weight"], **aux)
 
         all_stats = []
         for minibatch in mbs:
@@ -434,14 +435,16 @@ class PPOCriticInterface(model_api.ModelInterface):
         eps = self.value_eps_clip
 
         def loss_fn(params, mb):
-            h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+            h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
+                                             mb["seg_ids"])
             new_values = T.critic_values(cfg, params, h)
             loss, stats = ppo_functional.critic_loss_fn(
                 value=new_values, old_value=mb["old_values"],
                 target_value=mb["returns"], value_eps_clip=eps,
                 loss_mask=mb["loss_mask"] > 0)
-            return loss, dict(value_loss=loss,
-                              value_clip_ratio=stats["value_clip_ratio"])
+            return loss + sum(aux.values()), dict(
+                value_loss=loss,
+                value_clip_ratio=stats["value_clip_ratio"], **aux)
 
         all_stats = []
         for minibatch in mbs:

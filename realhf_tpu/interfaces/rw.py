@@ -29,7 +29,8 @@ logger = logging.getLogger("PairedRewardInterface")
 def _make_loss_fn(cfg):
 
     def loss_fn(params, mb):
-        h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+        h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
+                                         mb["seg_ids"])
         values = T.critic_values(cfg, params, h)  # [S, L]
         # Gather per-pair (pos, neg) end-of-sequence scores via (row,
         # col) coordinates (stable under stream padding), plus a pair
@@ -41,11 +42,12 @@ def _make_loss_fn(cfg):
         losses = -jax.nn.log_sigmoid(pos - neg)
         loss = (losses * valid).sum() / denom
         acc = ((pos > neg) & (valid > 0)).sum() / denom
-        return loss, {
+        return loss + sum(aux.values()), {
             "loss": loss,
             "acc": acc.astype(jnp.float32),
             "pos_score": (pos * valid).sum() / denom,
             "neg_score": (neg * valid).sum() / denom,
+            **aux,
         }
 
     return loss_fn

@@ -25,7 +25,8 @@ logger = logging.getLogger("SFTInterface")
 def _make_loss_fn(cfg):
 
     def loss_fn(params, mb):
-        h, _ = T.forward(cfg, params, mb["input_ids"], mb["seg_ids"])
+        h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
+                                         mb["seg_ids"])
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         # loss_mask[t] gates predicting token t+1: valid next-token
@@ -40,8 +41,10 @@ def _make_loss_fn(cfg):
             axis=1)
         mask = next_same & ~next_is_prompt
         denom = jnp.maximum(mask.sum(), 1)
-        loss = -(lp * mask).sum() / denom
-        return loss, {"nll": loss, "n_tokens": denom.astype(jnp.float32)}
+        nll = -(lp * mask).sum() / denom
+        loss = nll + sum(aux.values())
+        return loss, {"nll": nll, "n_tokens": denom.astype(jnp.float32),
+                      **aux}
 
     return loss_fn
 

@@ -6,6 +6,8 @@ Parity with reference ``realhf/api/from_hf/__init__.py`` +
 
 import realhf_tpu.models.hf.llama  # noqa: F401
 import realhf_tpu.models.hf.gpt2  # noqa: F401
+import realhf_tpu.models.hf.mixtral  # noqa: F401
+import realhf_tpu.models.hf.gemma  # noqa: F401
 
 from realhf_tpu.models.hf.registry import (  # noqa: F401
     HF_FAMILIES,

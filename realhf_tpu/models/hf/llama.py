@@ -77,81 +77,97 @@ def _config_to_hf_llama(cfg: TransformerConfig,
     return d
 
 
-def _params_from_hf_llama(state: StateDict,
-                          cfg: TransformerConfig) -> Dict[str, Any]:
+_PRE = "model.layers.{}."
+
+
+def llama_backbone_from_hf(state: StateDict,
+                           cfg: TransformerConfig) -> Dict[str, Any]:
+    """Embedding + attention + norms + head shared by every
+    llama-attention family (llama/qwen2/mistral/gemma/mixtral);
+    ``blocks.mlp`` is left for the family converter to fill."""
     nl = cfg.n_layers
-    pre = "model.layers.{}."
     params: Dict[str, Any] = {
         "embed": {"wte": state["model.embed_tokens.weight"]},
         "blocks": {
             "ln1": {"scale": stack_layers(
-                state, pre + "input_layernorm.weight", nl)},
+                state, _PRE + "input_layernorm.weight", nl)},
             "attn": {
-                "wq": stack_layers(state, pre + "self_attn.q_proj.weight",
+                "wq": stack_layers(state, _PRE + "self_attn.q_proj.weight",
                                    nl, transpose=True),
-                "wk": stack_layers(state, pre + "self_attn.k_proj.weight",
+                "wk": stack_layers(state, _PRE + "self_attn.k_proj.weight",
                                    nl, transpose=True),
-                "wv": stack_layers(state, pre + "self_attn.v_proj.weight",
+                "wv": stack_layers(state, _PRE + "self_attn.v_proj.weight",
                                    nl, transpose=True),
-                "wo": stack_layers(state, pre + "self_attn.o_proj.weight",
+                "wo": stack_layers(state, _PRE + "self_attn.o_proj.weight",
                                    nl, transpose=True),
             },
             "ln2": {"scale": stack_layers(
-                state, pre + "post_attention_layernorm.weight", nl)},
-            "mlp": {
-                "wg": stack_layers(state, pre + "mlp.gate_proj.weight",
-                                   nl, transpose=True),
-                "wu": stack_layers(state, pre + "mlp.up_proj.weight",
-                                   nl, transpose=True),
-                "wd": stack_layers(state, pre + "mlp.down_proj.weight",
-                                   nl, transpose=True),
-            },
+                state, _PRE + "post_attention_layernorm.weight", nl)},
+            "mlp": {},
         },
         "ln_f": {"scale": state["model.norm.weight"]},
     }
     if cfg.use_attention_bias:
         a = params["blocks"]["attn"]
-        a["bq"] = stack_layers(state, pre + "self_attn.q_proj.bias", nl)
-        a["bk"] = stack_layers(state, pre + "self_attn.k_proj.bias", nl)
-        a["bv"] = stack_layers(state, pre + "self_attn.v_proj.bias", nl)
-    if cfg.is_critic or cfg.tied_embedding:
-        pass  # value head handled by registry; tied head uses wte
-    else:
+        a["bq"] = stack_layers(state, _PRE + "self_attn.q_proj.bias", nl)
+        a["bk"] = stack_layers(state, _PRE + "self_attn.k_proj.bias", nl)
+        a["bv"] = stack_layers(state, _PRE + "self_attn.v_proj.bias", nl)
+    if not cfg.is_critic and not cfg.tied_embedding:
         params["head"] = {"w": state["lm_head.weight"].T.copy()}
+    return params
+
+
+def llama_backbone_to_hf(params: Dict[str, Any], cfg: TransformerConfig,
+                         out: StateDict):
+    out["model.embed_tokens.weight"] = np.ascontiguousarray(
+        params["embed"]["wte"])
+    b = params["blocks"]
+    unstack_layers(b["ln1"]["scale"], _PRE + "input_layernorm.weight", out)
+    unstack_layers(b["attn"]["wq"], _PRE + "self_attn.q_proj.weight", out,
+                   transpose=True)
+    unstack_layers(b["attn"]["wk"], _PRE + "self_attn.k_proj.weight", out,
+                   transpose=True)
+    unstack_layers(b["attn"]["wv"], _PRE + "self_attn.v_proj.weight", out,
+                   transpose=True)
+    unstack_layers(b["attn"]["wo"], _PRE + "self_attn.o_proj.weight", out,
+                   transpose=True)
+    unstack_layers(b["ln2"]["scale"],
+                   _PRE + "post_attention_layernorm.weight", out)
+    if cfg.use_attention_bias:
+        unstack_layers(b["attn"]["bq"], _PRE + "self_attn.q_proj.bias", out)
+        unstack_layers(b["attn"]["bk"], _PRE + "self_attn.k_proj.bias", out)
+        unstack_layers(b["attn"]["bv"], _PRE + "self_attn.v_proj.bias", out)
+    out["model.norm.weight"] = np.ascontiguousarray(params["ln_f"]["scale"])
+    if not cfg.is_critic and not cfg.tied_embedding:
+        out["lm_head.weight"] = np.ascontiguousarray(params["head"]["w"].T)
+
+
+def _params_from_hf_llama(state: StateDict,
+                          cfg: TransformerConfig) -> Dict[str, Any]:
+    params = llama_backbone_from_hf(state, cfg)
+    nl = cfg.n_layers
+    params["blocks"]["mlp"] = {
+        "wg": stack_layers(state, _PRE + "mlp.gate_proj.weight", nl,
+                           transpose=True),
+        "wu": stack_layers(state, _PRE + "mlp.up_proj.weight", nl,
+                           transpose=True),
+        "wd": stack_layers(state, _PRE + "mlp.down_proj.weight", nl,
+                           transpose=True),
+    }
     return params
 
 
 def _params_to_hf_llama(params: Dict[str, Any],
                         cfg: TransformerConfig) -> StateDict:
     out: StateDict = {}
-    pre = "model.layers.{}."
-    out["model.embed_tokens.weight"] = np.ascontiguousarray(
-        params["embed"]["wte"])
+    llama_backbone_to_hf(params, cfg, out)
     b = params["blocks"]
-    unstack_layers(b["ln1"]["scale"], pre + "input_layernorm.weight", out)
-    unstack_layers(b["attn"]["wq"], pre + "self_attn.q_proj.weight", out,
+    unstack_layers(b["mlp"]["wg"], _PRE + "mlp.gate_proj.weight", out,
                    transpose=True)
-    unstack_layers(b["attn"]["wk"], pre + "self_attn.k_proj.weight", out,
+    unstack_layers(b["mlp"]["wu"], _PRE + "mlp.up_proj.weight", out,
                    transpose=True)
-    unstack_layers(b["attn"]["wv"], pre + "self_attn.v_proj.weight", out,
+    unstack_layers(b["mlp"]["wd"], _PRE + "mlp.down_proj.weight", out,
                    transpose=True)
-    unstack_layers(b["attn"]["wo"], pre + "self_attn.o_proj.weight", out,
-                   transpose=True)
-    unstack_layers(b["ln2"]["scale"], pre + "post_attention_layernorm.weight",
-                   out)
-    unstack_layers(b["mlp"]["wg"], pre + "mlp.gate_proj.weight", out,
-                   transpose=True)
-    unstack_layers(b["mlp"]["wu"], pre + "mlp.up_proj.weight", out,
-                   transpose=True)
-    unstack_layers(b["mlp"]["wd"], pre + "mlp.down_proj.weight", out,
-                   transpose=True)
-    if cfg.use_attention_bias:
-        unstack_layers(b["attn"]["bq"], pre + "self_attn.q_proj.bias", out)
-        unstack_layers(b["attn"]["bk"], pre + "self_attn.k_proj.bias", out)
-        unstack_layers(b["attn"]["bv"], pre + "self_attn.v_proj.bias", out)
-    out["model.norm.weight"] = np.ascontiguousarray(params["ln_f"]["scale"])
-    if not cfg.is_critic and not cfg.tied_embedding:
-        out["lm_head.weight"] = np.ascontiguousarray(params["head"]["w"].T)
     return out
 
 

@@ -157,16 +157,25 @@ def _activation(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    out, _ = _mlp_with_aux(cfg, lp, x)
+    return out
+
+
+def _mlp_with_aux(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
+    """MLP returning (output, aux-loss dict) -- non-empty only for MoE
+    (router load-balancing / z losses, reference utils/moe.py:395)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     m = lp["mlp"]
     if cfg.mlp_type == "moe":
-        try:
-            from realhf_tpu.ops.moe import moe_mlp
-        except ImportError as e:
-            raise NotImplementedError(
-                "MoE forward requires realhf_tpu.ops.moe (not yet built in "
-                "this checkout).") from e
-        return moe_mlp(cfg, m, x)
+        from realhf_tpu.ops.moe import moe_mlp_with_losses
+        squeeze = x.ndim == 2  # decode step: [B, H]
+        x3 = x[:, None, :] if squeeze else x
+        out, aux = moe_mlp_with_losses(cfg, m, x3)
+        return (out[:, 0] if squeeze else out), aux
+    return _dense_mlp(cfg, m, x, cdt), {}
+
+
+def _dense_mlp(cfg, m, x, cdt):
     if cfg.gated_mlp:
         gate = x @ m["wg"].astype(cdt)
         up = x @ m["wu"].astype(cdt)
@@ -206,9 +215,10 @@ def _attn_scale(cfg: TransformerConfig, layer_idx: jnp.ndarray) -> jnp.ndarray:
 
 def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
            x: jnp.ndarray, seg_ids: jnp.ndarray, cos: jnp.ndarray,
-           sin: jnp.ndarray, constrain) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+           sin: jnp.ndarray, constrain):
     """One transformer block over packed streams [B, L, H]; returns
-    (residual output, (k, v)) -- k/v feed prefill KV caches."""
+    (residual output, (k, v), aux-losses) -- k/v feed prefill KV
+    caches; aux is non-empty for MoE."""
     ln1 = _norm(cfg, x, lp["ln1"]["scale"], lp["ln1"].get("bias"))
     q, k, v = _qkv(cfg, lp, ln1)
     if cfg.apply_rotary:
@@ -222,8 +232,9 @@ def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
         proj = proj + lp["attn"]["bo"].astype(x.dtype)
     x = constrain(x + proj)
     ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
-    x = constrain(x + _mlp(cfg, lp, ln2))
-    return x, (k, v)
+    mlp_out, aux = _mlp_with_aux(cfg, lp, ln2)
+    x = constrain(x + mlp_out)
+    return x, (k, v), aux
 
 
 def positions_from_segments(seg_ids: jnp.ndarray) -> jnp.ndarray:
@@ -250,8 +261,9 @@ def forward(
     positions: Optional[jnp.ndarray] = None,  # [B, L]; default from seg_ids
     *,
     return_kv: bool = False,
+    return_aux: bool = False,
     activation_constraint=None,
-) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
+):
     """Packed forward pass -> final hidden states [B, L, H] (after the
     final norm). Heads are applied separately (`lm_logits`,
     `critic_values`, or fused ops in `realhf_tpu.ops.ce`).
@@ -293,12 +305,17 @@ def forward(
 
     def scan_body(carry, layer):
         lp, layer_idx = layer
-        y, kv = block_fn(lp, layer_idx, carry)
-        return y, kv if return_kv else None
+        y, kv, aux = block_fn(lp, layer_idx, carry)
+        return y, (kv if return_kv else None,
+                   aux if return_aux else None)
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    x, kvs = jax.lax.scan(scan_body, x, (params["blocks"], layer_ids))
+    x, (kvs, auxs) = jax.lax.scan(scan_body, x,
+                                  (params["blocks"], layer_ids))
     x = _norm(cfg, x, params["ln_f"]["scale"], params["ln_f"].get("bias"))
+    if return_aux:
+        aux = {k: v.sum() for k, v in (auxs or {}).items()}
+        return x, kvs, aux
     return x, kvs
 
 
