@@ -66,6 +66,30 @@ class Engine:
         self.params = jax.device_put(params, self._param_shardings)
         self._constrain = shard_rules.activation_constraint(
             self.mesh, ctx.parallel.sequence_parallel)
+        # Context parallelism: attention becomes a ring over the "ctx"
+        # mesh axis; the rest of the model shards L via GSPMD.
+        if ctx.parallel.context_parallel_size > 1:
+            from realhf_tpu.ops.ring_attention import ring_attention
+            mesh = self.mesh
+
+            def _ring(q, k, v, seg, causal=True, scale=None):
+                return ring_attention(q, k, v, seg, mesh, "ctx",
+                                      causal=causal, scale=scale)
+
+            self.attention_fn = _ring
+        else:
+            self.attention_fn = None
+
+        if (cfg.mlp_type == "moe" and cfg.moe is not None
+                and cfg.moe.capacity_factor is None
+                and cfg.moe.num_experts > 4):
+            logger.warning(
+                "MoE model running in dense dispatch (capacity_factor "
+                "unset): every expert processes every token -- "
+                "%dx the FLOPs of top-%d routing. Set "
+                "MoEConfig.capacity_factor (e.g. 1.25) for capacity "
+                "dispatch.", cfg.moe.num_experts // cfg.moe.top_k,
+                cfg.moe.top_k)
 
         self.optimizer_config = optimizer
         if optimizer is not None and optimizer.type != "empty":
@@ -157,7 +181,8 @@ class Engine:
             @jax.jit
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
-                                 activation_constraint=self._constrain)
+                                 activation_constraint=self._constrain,
+                                 attention_fn=self.attention_fn)
                 return h
             self._jit_forward_hidden = f
         return self._jit_forward_hidden(self.params, jnp.asarray(input_ids),
@@ -171,7 +196,8 @@ class Engine:
             @functools.partial(jax.jit, static_argnames=("temp", "has_mask"))
             def f(params, ids, seg, mask, temp, has_mask):
                 h, _ = T.forward(self.cfg, params, ids, seg,
-                                 activation_constraint=self._constrain)
+                                 activation_constraint=self._constrain,
+                                 attention_fn=self.attention_fn)
                 return F.shifted_logprobs_from_hidden(
                     self.cfg, params, h, ids, seg, temperature=temp,
                     logits_mask=mask if has_mask else None)
@@ -190,7 +216,8 @@ class Engine:
             @jax.jit
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
-                                 activation_constraint=self._constrain)
+                                 activation_constraint=self._constrain,
+                                 attention_fn=self.attention_fn)
                 return T.critic_values(self.cfg, params, h)
             self._jit_values = f
         return self._jit_values(self.params, jnp.asarray(input_ids),
@@ -203,6 +230,11 @@ class Engine:
                  gconfig: GenerationHyperparameters,
                  eos_token_id: Optional[int], pad_token_id: int
                  ) -> gen_mod.GenerationOutput:
+        if self.ctx.parallel.context_parallel_size > 1:
+            raise NotImplementedError(
+                "Generation on a context-parallel mesh is not supported; "
+                "allocate the generation MFC on a dp/tp layout (decoupled "
+                "allocation, e.g. actor_gen_alloc=d8t1).")
         cache_key = (gconfig, eos_token_id, pad_token_id)
         if cache_key not in self._generate_cache:
             self._generate_cache[cache_key] = gen_mod.build_generate_fn(

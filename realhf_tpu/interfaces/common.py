@@ -71,7 +71,7 @@ def split_minibatches(input_: SequenceSample, n: int,
     return input_.split(n, min_size=min_size)
 
 
-def forward_with_aux(cfg, params, input_ids, seg_ids):
+def forward_with_aux(cfg, params, input_ids, seg_ids, attention_fn=None):
     """Model forward returning (hidden, aux-loss dict). For MoE models
     the dict carries router load-balancing/z losses that MUST be added
     to the training objective (the reference applies them automatically
@@ -80,9 +80,10 @@ def forward_with_aux(cfg, params, input_ids, seg_ids):
     from realhf_tpu.models import transformer as _T
     if cfg.mlp_type == "moe":
         h, _, aux = _T.forward(cfg, params, input_ids, seg_ids,
-                               return_aux=True)
+                               return_aux=True, attention_fn=attention_fn)
         return h, aux
-    h, _ = _T.forward(cfg, params, input_ids, seg_ids)
+    h, _ = _T.forward(cfg, params, input_ids, seg_ids,
+                      attention_fn=attention_fn)
     return h, {}
 
 

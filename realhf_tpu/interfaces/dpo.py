@@ -38,11 +38,11 @@ def _answer_masks(sb: common.StreamBatch, seqlens: List[int],
     return mask
 
 
-def _make_loss_fn(cfg, n_seqs: int, beta: float):
+def _make_loss_fn(cfg, n_seqs: int, beta: float, attention_fn=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"])
+                                         mb["seg_ids"], attention_fn)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         masked = (lp * mb["answer_mask"]).reshape(-1)
@@ -155,7 +155,8 @@ class DPOInterface(model_api.ModelInterface):
                 b.arrays[k] = np.pad(v, (0, npair - v.shape[0]))
         stats = engine.train_batch(
             [b.arrays for b in batches],
-            _make_loss_fn(model.config, n_seqs_max, self.beta),
+            _make_loss_fn(model.config, n_seqs_max, self.beta,
+                          engine.attention_fn),
             loss_weights=weights, loss_fn_key=f"dpo-{n_seqs_max}")
         model.inc_version()
         return stats

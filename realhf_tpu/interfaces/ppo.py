@@ -265,9 +265,11 @@ class PPOActorInterface(model_api.ModelInterface):
         early_kl = self.early_stop_kl
         early_imp = self.early_stop_imp_ratio
 
+        attention_fn = engine.attention_fn
+
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"])
+                                             mb["seg_ids"], attention_fn)
             lmask = mb.get("logits_mask")
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
@@ -434,9 +436,11 @@ class PPOCriticInterface(model_api.ModelInterface):
         cfg = model.config
         eps = self.value_eps_clip
 
+        attention_fn = engine.attention_fn
+
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"])
+                                             mb["seg_ids"], attention_fn)
             new_values = T.critic_values(cfg, params, h)
             loss, stats = ppo_functional.critic_loss_fn(
                 value=new_values, old_value=mb["old_values"],

@@ -26,11 +26,11 @@ from realhf_tpu.models.hf import save_hf_checkpoint
 logger = logging.getLogger("PairedRewardInterface")
 
 
-def _make_loss_fn(cfg):
+def _make_loss_fn(cfg, attention_fn=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"])
+                                         mb["seg_ids"], attention_fn)
         values = T.critic_values(cfg, params, h)  # [S, L]
         # Gather per-pair (pos, neg) end-of-sequence scores via (row,
         # col) coordinates (stable under stream padding), plus a pair
@@ -132,7 +132,7 @@ class PairedRewardInterface(model_api.ModelInterface):
                 b.arrays[k] = np.pad(v, (0, npair - v.shape[0]))
         stats = engine.train_batch(
             [b.arrays for b in batches],
-            _make_loss_fn(model.config),
+            _make_loss_fn(model.config, engine.attention_fn),
             loss_weights=weights, loss_fn_key="paired_rw")
         model.inc_version()
         return stats

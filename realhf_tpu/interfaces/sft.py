@@ -22,11 +22,11 @@ from realhf_tpu.ops import functional as F
 logger = logging.getLogger("SFTInterface")
 
 
-def _make_loss_fn(cfg):
+def _make_loss_fn(cfg, attention_fn=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"])
+                                         mb["seg_ids"], attention_fn)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         # loss_mask[t] gates predicting token t+1: valid next-token
@@ -69,7 +69,8 @@ class SFTInterface(model_api.ModelInterface):
                 n_streams=engine.ctx.dp_size))
         batches = common.pad_stream_batches(batches)
         stats = engine.train_batch(
-            [b.arrays for b in batches], _make_loss_fn(model.config),
+            [b.arrays for b in batches],
+            _make_loss_fn(model.config, engine.attention_fn),
             loss_weights=[b.n_tokens for b in batches], loss_fn_key="sft")
         model.inc_version()
         return stats

@@ -23,7 +23,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from realhf_tpu.models.config import TransformerConfig
-from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from realhf_tpu.parallel.mesh import CTX_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 
 
 def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
@@ -134,16 +134,17 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
 
 
 def batch_pspec() -> P:
-    """[B, L] token/segment arrays: DP over streams."""
-    return P(DATA_AXIS, None)
+    """[B, L] token/segment arrays: DP over streams, context
+    parallelism over the sequence dim."""
+    return P(DATA_AXIS, CTX_AXIS)
 
 
 def residual_pspec(sequence_parallel: bool) -> P:
     """[B, L, H] residual stream; with SP the sequence dim is also
     sharded over the TP axis (Megatron-SP analog)."""
     if sequence_parallel:
-        return P(DATA_AXIS, MODEL_AXIS, None)
-    return P(DATA_AXIS, None, None)
+        return P(DATA_AXIS, (CTX_AXIS, MODEL_AXIS), None)
+    return P(DATA_AXIS, CTX_AXIS, None)
 
 
 def activation_constraint(mesh: Mesh, sequence_parallel: bool):

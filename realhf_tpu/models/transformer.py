@@ -157,20 +157,23 @@ def _activation(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    out, _ = _mlp_with_aux(cfg, lp, x)
+    out, _ = _mlp_with_aux(cfg, lp, x, None)
     return out
 
 
-def _mlp_with_aux(cfg: TransformerConfig, lp: Params, x: jnp.ndarray):
+def _mlp_with_aux(cfg: TransformerConfig, lp: Params, x: jnp.ndarray,
+                  seg_ids: Optional[jnp.ndarray] = None):
     """MLP returning (output, aux-loss dict) -- non-empty only for MoE
-    (router load-balancing / z losses, reference utils/moe.py:395)."""
+    (router load-balancing / z losses, reference utils/moe.py:395).
+    ``seg_ids`` masks padding out of MoE routing/capacity/losses."""
     cdt = jnp.dtype(cfg.compute_dtype)
     m = lp["mlp"]
     if cfg.mlp_type == "moe":
         from realhf_tpu.ops.moe import moe_mlp_with_losses
         squeeze = x.ndim == 2  # decode step: [B, H]
         x3 = x[:, None, :] if squeeze else x
-        out, aux = moe_mlp_with_losses(cfg, m, x3)
+        valid = None if seg_ids is None else (seg_ids != 0)
+        out, aux = moe_mlp_with_losses(cfg, m, x3, valid_mask=valid)
         return (out[:, 0] if squeeze else out), aux
     return _dense_mlp(cfg, m, x, cdt), {}
 
@@ -215,7 +218,7 @@ def _attn_scale(cfg: TransformerConfig, layer_idx: jnp.ndarray) -> jnp.ndarray:
 
 def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
            x: jnp.ndarray, seg_ids: jnp.ndarray, cos: jnp.ndarray,
-           sin: jnp.ndarray, constrain):
+           sin: jnp.ndarray, constrain, attention_fn=None):
     """One transformer block over packed streams [B, L, H]; returns
     (residual output, (k, v), aux-losses) -- k/v feed prefill KV
     caches; aux is non-empty for MoE."""
@@ -224,15 +227,16 @@ def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
     if cfg.apply_rotary:
         q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
         k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
-    attn = packed_attention(q, k, v, seg_ids, causal=True,
-                            scale=_attn_scale(cfg, layer_idx))
+    attn_impl = attention_fn or packed_attention
+    attn = attn_impl(q, k, v, seg_ids, causal=True,
+                     scale=_attn_scale(cfg, layer_idx))
     attn = attn.reshape(*x.shape[:-1], cfg.n_q_heads * cfg.head_dim)
     proj = attn @ lp["attn"]["wo"].astype(x.dtype)
     if "bo" in lp["attn"]:
         proj = proj + lp["attn"]["bo"].astype(x.dtype)
     x = constrain(x + proj)
     ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
-    mlp_out, aux = _mlp_with_aux(cfg, lp, ln2)
+    mlp_out, aux = _mlp_with_aux(cfg, lp, ln2, seg_ids)
     x = constrain(x + mlp_out)
     return x, (k, v), aux
 
@@ -263,6 +267,7 @@ def forward(
     return_kv: bool = False,
     return_aux: bool = False,
     activation_constraint=None,
+    attention_fn=None,
 ):
     """Packed forward pass -> final hidden states [B, L, H] (after the
     final norm). Heads are applied separately (`lm_logits`,
@@ -297,7 +302,8 @@ def forward(
         # cfg/constrain are non-array closures; seg_ids/cos/sin are
         # array closures -- jax.checkpoint differentiates through
         # closed-over arrays correctly.
-        return _block(cfg, lp, layer_idx, carry, seg_ids, cos, sin, constrain)
+        return _block(cfg, lp, layer_idx, carry, seg_ids, cos, sin,
+                      constrain, attention_fn)
 
     if cfg.gradient_checkpointing:
         block_fn = jax.checkpoint(

@@ -82,20 +82,29 @@ def sinkhorn(logits: jnp.ndarray, n_iters: int = 8,
 
 
 def load_balancing_loss(probs: jnp.ndarray, top_idx: jnp.ndarray,
-                        n_experts: int, top_k: int) -> jnp.ndarray:
+                        n_experts: int, top_k: int,
+                        valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Switch-transformer aux loss (reference
-    switch_load_balancing_loss_func, utils/moe.py:13)."""
+    switch_load_balancing_loss_func, utils/moe.py:13), over valid
+    tokens only."""
     t = probs.shape[0]
-    counts = jnp.zeros(n_experts, jnp.float32).at[top_idx.reshape(-1)].add(1.0)
-    fraction_tokens = counts / jnp.maximum(t * top_k, 1)
-    fraction_probs = probs.mean(axis=0)
+    if valid is None:
+        valid = jnp.ones((t,), jnp.float32)
+    n = jnp.maximum(valid.sum(), 1.0)
+    counts = jnp.zeros(n_experts, jnp.float32).at[top_idx.reshape(-1)].add(
+        jnp.repeat(valid, top_idx.shape[1]))
+    fraction_tokens = counts / (n * top_k)
+    fraction_probs = (probs * valid[:, None]).sum(axis=0) / n
     return n_experts * (fraction_tokens * fraction_probs).sum()
 
 
-def z_loss(logits: jnp.ndarray) -> jnp.ndarray:
+def z_loss(logits: jnp.ndarray,
+           valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Router z-loss (reference z_loss_func, utils/moe.py:54)."""
-    return (jax.scipy.special.logsumexp(
-        logits.astype(jnp.float32), axis=-1) ** 2).mean()
+    z = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1) ** 2
+    if valid is None:
+        return z.mean()
+    return (z * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
 
 def _expert_ffn(cfg: TransformerConfig, m: Dict, xs: jnp.ndarray
@@ -110,19 +119,13 @@ def _expert_ffn(cfg: TransformerConfig, m: Dict, xs: jnp.ndarray
                       m["wd"].astype(cdt))
 
 
-def moe_mlp(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
-            rng: Optional[jax.Array] = None) -> jnp.ndarray:
-    """The MoE feed-forward over [B, L, H] activations; returns the
-    combined output plus records aux losses in the global stats
-    tracker leaf-free (losses are returned via a side dict when called
-    from the loss path -- see `moe_mlp_with_losses`)."""
-    out, _ = moe_mlp_with_losses(cfg, m, x, rng)
-    return out
-
-
 def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
-                        rng: Optional[jax.Array] = None
+                        rng: Optional[jax.Array] = None,
+                        valid_mask: Optional[jnp.ndarray] = None
                         ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """MoE feed-forward over [B, L, H]; ``valid_mask`` [B, L] excludes
+    padding tokens from routing, expert capacity, and the aux losses
+    (pad positions carry real hidden states in the packed layout)."""
     moe = cfg.moe
     if moe.input_jitter_eps and rng is None:
         raise NotImplementedError(
@@ -131,10 +134,17 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
     b, l, h = x.shape
     t = b * l
     xt = x.reshape(t, h)
+    if valid_mask is None:
+        valid = jnp.ones((t,), jnp.float32)
+    else:
+        valid = valid_mask.reshape(t).astype(jnp.float32)
+    n_valid = jnp.maximum(valid.sum(), 1.0)
     logits = (xt.astype(jnp.float32)
               @ m["router"].astype(jnp.float32))  # [T, E]
     probs_full = jax.nn.softmax(logits, axis=-1)
     top_probs, top_idx = router_probs(moe, logits, rng)
+    # pads contribute nothing: zero their gates everywhere below
+    top_probs = top_probs * valid[:, None]
 
     e = moe.num_experts
     if moe.capacity_factor is None:
@@ -147,8 +157,10 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
         out = jnp.einsum("eth,te->th", expert_out.astype(jnp.float32), gates)
     else:
         cap = max(1, int(moe.capacity_factor * t * moe.top_k / e))
-        # position of each (token, k) within its expert's capacity
+        # position of each (token, k) within its expert's capacity;
+        # pads removed from the one-hot so they never occupy slots
         onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)  # [T, k, E]
+        onehot = onehot * valid.astype(jnp.int32)[:, None, None]
         flat = onehot.reshape(t * moe.top_k, e)
         pos = jnp.cumsum(flat, axis=0) * flat - 1  # [T*k, E]
         pos = pos.reshape(t, moe.top_k, e)
@@ -169,7 +181,7 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
     losses = {}
     if moe.routing_type == "aux_loss" and moe.aux_loss_coeff:
         losses["moe_aux_loss"] = moe.aux_loss_coeff * load_balancing_loss(
-            probs_full, top_idx, e, moe.top_k)
+            probs_full, top_idx, e, moe.top_k, valid=valid)
     if moe.z_loss_coeff:
-        losses["moe_z_loss"] = moe.z_loss_coeff * z_loss(logits)
+        losses["moe_z_loss"] = moe.z_loss_coeff * z_loss(logits, valid=valid)
     return out.reshape(b, l, h).astype(x.dtype), losses

@@ -1,0 +1,141 @@
+"""Ring attention: context parallelism over a mesh axis.
+
+Fills the reference's explicitly-missing capability (context
+parallelism is a TODO at ``realhf/impl/model/backend/megatron.py:60``;
+max sequence length there is bounded by one TP group's activation
+memory). Here the sequence dim is sharded over a "ctx" mesh axis:
+each device holds L/ctx tokens of every stream, K/V shards rotate
+around the ring with `lax.ppermute`, and partial attention results
+merge with the online-softmax combine -- so attention memory and
+compute scale 1/ctx per device while packed-segment and causal
+semantics are preserved via global position offsets.
+
+The per-round partial attention is blockwise XLA (einsum + fp32
+softmax pieces); fusing the rounds into a Pallas kernel with
+overlapped RDMA (pltpu.make_async_remote_copy) is the planned
+optimization.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -2.0 ** 30
+
+
+def _partial_attention(q, k, v, seg_q, seg_k, q_off, k_off, scale, causal):
+    """One ring step: q [B, Lq, nq, hd] vs k/v [B, Lk, nkv, hd] with
+    global offsets; returns (m [B, nq, Lq], l, acc [B, nq, Lq, hd])."""
+    b, lq, nq, hd = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    qg = (q * scale).reshape(b, lq, nkv, group, hd)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    s = s.reshape(b, nq, lq, -1)
+    mask = (seg_q[:, :, None] == seg_k[:, None, :]) & (seg_q[:, :, None] != 0)
+    if causal:
+        qi = q_off + jnp.arange(lq)
+        ki = k_off + jnp.arange(k.shape[1])
+        mask = mask & (qi[:, None] >= ki[None, :])[None]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    m = s.max(axis=-1)  # [B, nq, Lq]
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    pv = p.reshape(b, nkv, group, lq, -1)
+    acc = jnp.einsum("bhgqk,bkhd->bhgqd", pv, v.astype(jnp.float32))
+    acc = acc.reshape(b, nq, lq, hd)
+    return m, l, acc
+
+
+def _combine(state, new):
+    m0, l0, a0 = state
+    m1, l1, a1 = new
+    m = jnp.maximum(m0, m1)
+    w0 = jnp.exp(m0 - m)
+    w1 = jnp.exp(m1 - m)
+    return m, l0 * w0 + l1 * w1, a0 * w0[..., None] + a1 * w1[..., None]
+
+
+def ring_attention(
+    q: jnp.ndarray,        # [B, L, nq, hd] -- L sharded over `axis`
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seg_ids: jnp.ndarray,  # [B, L]
+    mesh: Mesh,
+    axis: str = "ctx",
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Sequence-parallel attention over the given mesh axis.
+
+    Call with GLOBAL arrays under jit; shard_map splits L over `axis`
+    internally. Differentiable (shard_map + ppermute autodiff).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = mesh.shape[axis]
+    lc = q.shape[1] // n
+    # Keep the batch and head dims sharded over their own mesh axes
+    # (when present) instead of replicating them into the shard_map.
+    data_ax = "data" if "data" in mesh.axis_names and mesh.shape["data"] > 1 \
+        else None
+    model_ax = "model" if ("model" in mesh.axis_names
+                           and mesh.shape["model"] > 1
+                           and q.shape[2] % mesh.shape["model"] == 0
+                           and k.shape[2] % mesh.shape["model"] == 0) \
+        else None
+
+    def local_fn(q, k, v, seg):
+        # local shapes: q [b_loc, Lc, nq_loc, hd], seg [b_loc, Lc]
+        b, _, nq, hd = q.shape
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * lc
+
+        def _vary(x):
+            # Mark as device-varying over every sharded axis so the
+            # fori_loop carry type stays stable (shard_map vma tracking):
+            # the loop body mixes in q/k/v, which vary over all of them.
+            axes = tuple(a for a in (axis, data_ax, model_ax)
+                         if a is not None)
+            if hasattr(jax.lax, "pvary"):
+                return jax.lax.pvary(x, axes)
+            return x
+
+        m = _vary(jnp.full((b, nq, lc), NEG_INF, jnp.float32))
+        lsum = _vary(jnp.zeros((b, nq, lc), jnp.float32))
+        acc = _vary(jnp.zeros((b, nq, lc, hd), jnp.float32))
+
+        def body(r, carry):
+            m, lsum, acc, k, v, seg_k = carry
+            src = (idx - r) % n  # whose KV shard we currently hold
+            part = _partial_attention(q, k, v, seg, seg_k, q_off,
+                                      src * lc, scale, causal)
+            m, lsum, acc = _combine((m, lsum, acc), part)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+            seg_k = jax.lax.ppermute(seg_k, axis, perm)
+            return m, lsum, acc, k, v, seg_k
+
+        m, lsum, acc, _, _, _ = jax.lax.fori_loop(
+            0, n, body, (m, lsum, acc, k, v, seg))
+        safe = jnp.where(lsum > 0, lsum, 1.0)
+        out = jnp.where((m > NEG_INF / 2)[..., None], acc / safe[..., None],
+                        0.0)
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, Lc, nq, hd]
+
+    spec4 = P(data_ax, axis, model_ax, None)
+    spec2 = P(data_ax, axis)
+    return shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(spec4, spec4, spec4, spec2),
+        out_specs=spec4,
+    )(q, k, v, seg_ids)

@@ -32,8 +32,9 @@ from realhf_tpu.api.config import ModelName
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+CTX_AXIS = "ctx"  # context parallelism (ring attention over sequence)
 MODEL_AXIS = "model"
-MESH_AXES = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+MESH_AXES = (PIPE_AXIS, DATA_AXIS, CTX_AXIS, MODEL_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +44,9 @@ class ParallelismConfig:
     data_parallel_size: int = 1
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1
+    # ring attention over the sequence dim (the reference's missing
+    # context parallelism, megatron.py:60-61 TODO)
+    context_parallel_size: int = 1
     sequence_parallel: bool = False
     gradient_checkpointing: bool = False
 
@@ -53,7 +57,7 @@ class ParallelismConfig:
     @property
     def world_size(self) -> int:
         return (self.data_parallel_size * self.tensor_parallel_size *
-                self.pipeline_parallel_size)
+                self.pipeline_parallel_size * self.context_parallel_size)
 
     def same_layout(self, other: "ParallelismConfig") -> bool:
         """Same device-placement layout (ignores flags like
@@ -61,11 +65,17 @@ class ParallelismConfig:
         return (self.data_parallel_size == other.data_parallel_size
                 and self.tensor_parallel_size == other.tensor_parallel_size
                 and self.pipeline_parallel_size == other.pipeline_parallel_size
+                and self.context_parallel_size == other.context_parallel_size
                 and self.sequence_parallel == other.sequence_parallel)
 
     def __str__(self):
-        return (f"d{self.data_parallel_size}t{self.tensor_parallel_size}"
-                f"p{self.pipeline_parallel_size}")
+        s = (f"d{self.data_parallel_size}t{self.tensor_parallel_size}"
+             f"p{self.pipeline_parallel_size}")
+        if self.context_parallel_size > 1:
+            s += f"c{self.context_parallel_size}"
+        if self.sequence_parallel:
+            s += "s"
+        return s
 
 
 def parse_parallelism(name: str) -> ParallelismConfig:
@@ -76,9 +86,9 @@ def parse_parallelism(name: str) -> ParallelismConfig:
     """
     import re
     s = name.strip()
-    tokens = re.findall(r"([dtmp])(\d+)|(s)(?!\d)", s)
+    tokens = re.findall(r"([dtmpc])(\d+)|(s)(?!\d)", s)
     consumed = "".join(t[0] + t[1] + t[2] for t in tokens)
-    sizes = {"d": 1, "t": 1, "p": 1}
+    sizes = {"d": 1, "t": 1, "p": 1, "c": 1}
     seq_par = False
     for axis, num, sp in tokens:
         if sp:
@@ -94,6 +104,7 @@ def parse_parallelism(name: str) -> ParallelismConfig:
         data_parallel_size=sizes["d"],
         tensor_parallel_size=sizes["t"],
         pipeline_parallel_size=sizes["p"],
+        context_parallel_size=sizes["c"],
         sequence_parallel=seq_par)
 
 
@@ -126,6 +137,7 @@ def make_mesh(parallel: ParallelismConfig,
     arr = np.array(devices).reshape(
         parallel.pipeline_parallel_size,
         parallel.data_parallel_size,
+        parallel.context_parallel_size,
         parallel.tensor_parallel_size)
     return Mesh(arr, MESH_AXES)
 
