@@ -49,7 +49,8 @@ def main(argv=None):
     spec = cfg.build()
 
     from realhf_tpu.system.inline import InlineRunner
-    runner = InlineRunner(spec)
+    runner = InlineRunner(spec, recover_mode=getattr(cfg, "recover_mode",
+                                                     "disabled"))
     stats = runner.run()
     logger.info("Experiment complete. Last step stats: %s", stats)
     return stats

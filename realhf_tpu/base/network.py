@@ -1,0 +1,15 @@
+"""Host networking helpers (reference ``realhf/base/network.py``)."""
+
+import socket
+
+
+def gethostip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+

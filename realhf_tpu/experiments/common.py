@@ -66,6 +66,9 @@ class CommonExperimentConfig:
     eval_freq_epochs: Optional[int] = None
     eval_freq_steps: Optional[int] = None
     benchmark_steps: Optional[int] = None
+    # disabled | resume (reference recover_mode, common.py:70-82; "save"
+    # behavior -- dumping recover info -- is implied by resume)
+    recover_mode: str = "disabled"
 
     def ctl(self) -> SaveEvalControl:
         return SaveEvalControl(

@@ -15,6 +15,7 @@ the step's data buffer, save/eval frequency control, per-step
 throughput logging (tokens + TFLOP/s), and benchmark early exit.
 """
 
+import os
 import time
 from typing import Dict, Optional
 
@@ -25,7 +26,7 @@ from realhf_tpu.api import model as model_api
 from realhf_tpu.api.config import ModelInterfaceType, ModelName
 from realhf_tpu.api.dfg import DFG
 from realhf_tpu.api.experiment import ExperimentSpec
-from realhf_tpu.base import constants, logging, seeding, timeutil
+from realhf_tpu.base import constants, logging, recover, seeding, timeutil
 from realhf_tpu.engine.engine import Engine
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
@@ -72,11 +73,28 @@ def _build_model(role: str, spec, tokenizer, total_steps: int,
 
 class InlineRunner:
 
-    def __init__(self, spec: ExperimentSpec):
+    def __init__(self, spec: ExperimentSpec, recover_mode: str = "disabled"):
         self.spec = spec
         constants.set_experiment_trial_names(spec.experiment_name,
                                              spec.trial_name)
         seeding.set_random_seed(spec.seed)
+
+        # Recovery (reference recover_mode resume, base/recover.py +
+        # master_worker.__recover_save:1541): restore step counters and
+        # the set of data ids consumed in the interrupted epoch, and
+        # redirect trainable models to their latest checkpoints.
+        self.recover_mode = recover_mode
+        self._recover_info = None
+        if recover_mode == "resume" and recover.exists():
+            self._recover_info = recover.load()
+            logger.info("Resuming from recover info: %s",
+                        self._recover_info.recover_start)
+            for role, mspec in spec.models.items():
+                ckpt = os.path.join(constants.run_save_path(), role)
+                if os.path.exists(os.path.join(ckpt, "config.json")):
+                    mspec.path = ckpt
+                    mspec.random_init_config = None
+                    logger.info("Recovered %s from %s", role, ckpt)
 
         import realhf_tpu.datasets  # noqa: F401 - register datasets
         import realhf_tpu.interfaces  # noqa: F401 - register interfaces
@@ -149,6 +167,12 @@ class InlineRunner:
             freq_epoch=ctl.eval_freq_epochs, freq_step=ctl.eval_freq_steps,
             freq_sec=None)
         self.global_step = 0
+        self._start_epoch = 0
+        self._ids_to_skip = set()
+        if self._recover_info is not None:
+            self.global_step = self._recover_info.last_step_info.global_step
+            self._start_epoch = self._recover_info.recover_start.epoch
+            self._ids_to_skip = set(self._recover_info.hash_vals_to_ignore)
 
     # ------------------------------------------------------------------
     def run_step(self, batch: data_api.SequenceSample) -> Dict[str, Dict]:
@@ -195,6 +219,20 @@ class InlineRunner:
             path = f"{constants.run_save_path()}/{node.role}"
             self.interfaces[node.name].save(model, path)
             logger.info("Saved %s to %s", node.role, path)
+        # Recover info is only valid paired with the checkpoint it
+        # describes (reference couples them in __recover_save), so it
+        # is dumped here, never on unsaved steps.
+        if self.recover_mode != "disabled":
+            recover.dump(recover.RecoverInfo(
+                recover_start=recover.StepInfo(
+                    epoch=self._cur_epoch,
+                    epoch_step=self._cur_epoch_step + 1,
+                    global_step=self.global_step),
+                last_step_info=recover.StepInfo(
+                    epoch=self._cur_epoch,
+                    epoch_step=self._cur_epoch_step,
+                    global_step=self.global_step),
+                hash_vals_to_ignore=list(self._consumed_ids)))
 
     def _maybe_eval(self, epochs: int = 0, steps: int = 0):
         if self.eval_dataloader is None:
@@ -214,8 +252,25 @@ class InlineRunner:
         spec = self.spec
         last_stats = {}
         done = False
-        for epoch in range(spec.total_train_epochs):
+        self._consumed_ids = list(self._ids_to_skip)
+        self._cur_epoch = self._start_epoch
+        self._cur_epoch_step = 0
+        for epoch in range(self._start_epoch, spec.total_train_epochs):
+            self._cur_epoch = epoch
             for step, batch in enumerate(self.dataloader):
+                self._cur_epoch_step = step
+                if self._ids_to_skip:
+                    # first epoch after recovery: drop already-consumed
+                    # data (reference master_worker.py:762-768)
+                    keep = [i for i, x in enumerate(batch.ids)
+                            if x not in self._ids_to_skip]
+                    if not keep:
+                        continue
+                    if len(keep) < batch.bs:
+                        parts = batch.unpack()
+                        from realhf_tpu.api.data import SequenceSample
+                        batch = SequenceSample.gather(
+                            [parts[i] for i in keep])
                 t0 = time.monotonic()
                 last_stats = self.run_step(batch)
                 dt = time.monotonic() - t0
@@ -231,6 +286,7 @@ class InlineRunner:
                     {k: {kk: round(vv, 4) for kk, vv in v.items()
                          if isinstance(vv, float)}
                      for k, v in last_stats.items()})
+                self._consumed_ids.extend(batch.ids)
                 self._maybe_save(steps=1)
                 self._maybe_eval(steps=1)
                 if (spec.ctl.benchmark_steps is not None
@@ -239,6 +295,8 @@ class InlineRunner:
                     break
             if done:
                 break
+            self._ids_to_skip = set()
+            self._consumed_ids = []
             self._maybe_save(epochs=1)
             self._maybe_eval(epochs=1)
         self._maybe_save(force=True)

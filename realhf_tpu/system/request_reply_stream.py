@@ -1,0 +1,219 @@
+"""Master <-> worker control plane: the request-reply stream.
+
+Parity with reference ``realhf/system/request_reply_stream.py``: the
+master holds one PUB socket (broadcast requests, subscriber-filtered
+by handler name) and one PULL socket (replies); each worker holds a
+SUB + PUSH pair. Addresses rendezvous through name_resolve. Payloads
+carry metadata only (pickled) -- tensors move on the device data plane
+(ICI/DCN), never through here. The TCP-like syn -> ack -> request
+protocol guarantees every addressed worker has received a request
+before any of them starts executing it (reference
+``model_worker.py:891-896``), which keeps collective-issuing workers
+in lockstep without a barrier on the data plane.
+"""
+
+import collections
+import dataclasses
+import pickle
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from realhf_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("request_reply_stream")
+
+PUBSUB_BARRIER_NAME = "__pubsub_barrier__"
+
+
+@dataclasses.dataclass
+class Payload:
+    """One control-plane message (reference Payload:33)."""
+    handler: str = ""          # addressed worker, e.g. "model_worker/3"
+    handle_name: str = ""      # request type: inference/train_step/...
+    request_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex)
+    syn_reply_id: str = ""
+    ack_reply_id: str = ""
+    no_syn: bool = True        # skip the syn-ack handshake
+    data: Any = None           # pickled metadata (SequenceSample.meta() etc.)
+    # pre/post hook descriptors (param_realloc / offload / data_transfer)
+    pre_hooks: List[Any] = dataclasses.field(default_factory=list)
+    post_hooks: List[Any] = dataclasses.field(default_factory=list)
+
+
+class NameResolvingRequestClient:
+    """Master side (reference NameResolvingRequestClient:62)."""
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 stream_name: str = "master"):
+        self._ctx = zmq.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        host = network.gethostip()
+        pub_port = self._pub.bind_to_random_port(f"tcp://*")
+        self._pull = self._ctx.socket(zmq.PULL)
+        pull_port = self._pull.bind_to_random_port(f"tcp://*")
+        key = names.request_reply_stream(experiment_name, trial_name,
+                                         stream_name)
+        name_resolve.add(f"{key}/pub", f"tcp://{host}:{pub_port}",
+                         replace=True)
+        name_resolve.add(f"{key}/pull", f"tcp://{host}:{pull_port}",
+                         replace=True)
+        logger.info("Request client bound pub=%s pull=%s", pub_port,
+                    pull_port)
+
+    def wait_subscribers(self, handlers: List[str], timeout: float = 60.0):
+        """ZMQ PUB drops messages sent before SUB connects; workers ack
+        a barrier message until all confirm (the pubsub barrier)."""
+        pending = set(handlers)
+        deadline = time.monotonic() + timeout
+        while pending:
+            for h in list(pending):
+                self.post(Payload(handler=h,
+                                  handle_name=PUBSUB_BARRIER_NAME))
+            t_end = min(deadline, time.monotonic() + 0.2)
+            for p in self.poll_batch(timeout=max(0.0, t_end -
+                                                 time.monotonic())):
+                if p.handle_name == PUBSUB_BARRIER_NAME:
+                    pending.discard(p.handler)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Subscribers never connected: {pending}")
+
+    def post(self, payload: Payload) -> str:
+        self._pub.send_multipart([
+            payload.handler.encode(), pickle.dumps(payload)])
+        return payload.request_id
+
+    def request(self, handlers: List[str], handle_name: str,
+                datas: Optional[List[Any]] = None,
+                no_syn: bool = True,
+                syn_timeout: float = 300.0) -> List[str]:
+        """Send one request to several workers; with syn-ack, all
+        workers hold until everyone acked (reference
+        master_worker.py:438-451). Raises TimeoutError naming the
+        workers whose syn never arrived."""
+        datas = datas or [None] * len(handlers)
+        payloads = [
+            Payload(handler=h, handle_name=handle_name, data=d,
+                    no_syn=no_syn,
+                    syn_reply_id=uuid.uuid4().hex if not no_syn else "")
+            for h, d in zip(handlers, datas)
+        ]
+        for p in payloads:
+            self.post(p)
+        if not no_syn:
+            want = {p.syn_reply_id: p.handler for p in payloads}
+            deadline = time.monotonic() + syn_timeout
+            while want:
+                try:
+                    r = self.poll(timeout=max(
+                        0.01, deadline - time.monotonic()))
+                except TimeoutError:
+                    raise TimeoutError(
+                        "No syn from workers: "
+                        f"{sorted(want.values())}") from None
+                want.pop(r.request_id, None)
+            for p in payloads:
+                self.post(Payload(handler=p.handler, handle_name="ack",
+                                  request_id=p.syn_reply_id,
+                                  ack_reply_id=p.request_id))
+        return [p.request_id for p in payloads]
+
+    def poll(self, timeout: Optional[float] = None) -> Payload:
+        if timeout is not None:
+            if not self._pull.poll(timeout * 1000):
+                raise TimeoutError("No reply within timeout.")
+        return pickle.loads(self._pull.recv())
+
+    def poll_batch(self, timeout: float = 0.0) -> List[Payload]:
+        """All immediately-available replies; `timeout` bounds the wait
+        for the FIRST one only."""
+        out = []
+        if self._pull.poll(timeout * 1000):
+            out.append(pickle.loads(self._pull.recv()))
+            while self._pull.poll(0):
+                out.append(pickle.loads(self._pull.recv()))
+        return out
+
+    def gather_replies(self, request_ids: List[str],
+                       timeout: float = 600.0) -> List[Payload]:
+        got: Dict[str, Payload] = {}
+        deadline = time.monotonic() + timeout
+        while len(got) < len(request_ids):
+            p = self.poll(timeout=max(0.1, deadline - time.monotonic()))
+            if p.request_id in request_ids:
+                got[p.request_id] = p
+        return [got[r] for r in request_ids]
+
+    def close(self):
+        self._pub.close(0)
+        self._pull.close(0)
+
+
+class NameResolvingReplyServer:
+    """Worker side (reference NameResolvingReplyServer:206)."""
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 handler_name: str, stream_name: str = "master"):
+        self.handler_name = handler_name
+        self._backlog = collections.deque()
+        key = names.request_reply_stream(experiment_name, trial_name,
+                                         stream_name)
+        pub_addr = name_resolve.wait(f"{key}/pub", timeout=120)
+        pull_addr = name_resolve.wait(f"{key}/pull", timeout=120)
+        self._ctx = zmq.Context.instance()
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(pub_addr)
+        self._sub.setsockopt(zmq.SUBSCRIBE, handler_name.encode())
+        self._push = self._ctx.socket(zmq.PUSH)
+        self._push.connect(pull_addr)
+
+    def poll(self, timeout: Optional[float] = None) -> Payload:
+        """Receive the next request; answers syn-ack and pubsub-barrier
+        bookkeeping transparently."""
+        while True:
+            if self._backlog:
+                payload = self._backlog.popleft()
+            else:
+                if timeout is not None and not self._sub.poll(timeout * 1000):
+                    raise TimeoutError("No request within timeout.")
+                _, raw = self._sub.recv_multipart()
+                payload = pickle.loads(raw)
+            if payload.handle_name == PUBSUB_BARRIER_NAME:
+                self.reply(Payload(handler=self.handler_name,
+                                   handle_name=PUBSUB_BARRIER_NAME,
+                                   request_id=payload.request_id))
+                continue
+            if payload.handle_name == "ack":
+                return payload
+            if not payload.no_syn:
+                # reply syn, then wait for the broadcast ack before
+                # handing the request to the worker
+                self.reply(Payload(handler=self.handler_name,
+                                   handle_name="syn",
+                                   request_id=payload.syn_reply_id))
+                while True:
+                    _, raw2 = self._sub.recv_multipart()
+                    ack: Payload = pickle.loads(raw2)
+                    if (ack.handle_name == "ack"
+                            and ack.request_id == payload.syn_reply_id):
+                        break
+                    # interleaved broadcasts must not be dropped --
+                    # buffer them for subsequent poll() calls
+                    self._backlog.append(ack)
+            return payload
+
+    def reply(self, payload: Payload):
+        self._push.send(pickle.dumps(payload))
+
+    def respond(self, request: Payload, data: Any = None):
+        self.reply(Payload(handler=self.handler_name,
+                           handle_name=request.handle_name,
+                           request_id=request.request_id, data=data))
+
+    def close(self):
+        self._sub.close(0)
+        self._push.close(0)

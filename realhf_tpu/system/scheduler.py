@@ -1,0 +1,146 @@
+"""Job schedulers: launch and supervise worker processes.
+
+Parity with reference ``realhf/scheduler/client.py`` (SchedulerClient
+ABC :44-111) + ``scheduler/local/client.py`` (subprocess spawner). The
+SLURM backend (reference ``scheduler/slurm/``) is a planned addition
+for GPU-style clusters; TPU pods typically launch one process per host
+via their own orchestrator (GKE/xmanager), for which this local client
+doubles as the per-host bootstrapper.
+"""
+
+import dataclasses
+import enum
+import os
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("scheduler")
+
+
+class JobState(str, enum.Enum):
+    NOT_FOUND = "NOT_FOUND"
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+
+@dataclasses.dataclass
+class JobInfo:
+    name: str
+    state: JobState
+    pid: Optional[int] = None
+    returncode: Optional[int] = None
+
+
+class JobException(Exception):
+
+    def __init__(self, name: str, state: JobState):
+        super().__init__(f"Job {name} ended in state {state}.")
+        self.name = name
+        self.state = state
+
+
+class SchedulerClient:
+
+    def submit(self, name: str, cmd: List[str],
+               env: Optional[Dict[str, str]] = None):
+        raise NotImplementedError()
+
+    def submit_array(self, name: str, cmd_template: List[str], count: int,
+                     env: Optional[Dict[str, str]] = None):
+        for i in range(count):
+            cmd = [c.format(index=i) for c in cmd_template]
+            self.submit(f"{name}/{i}", cmd, env)
+
+    def stop_all(self):
+        raise NotImplementedError()
+
+    def find(self, name: str) -> JobInfo:
+        raise NotImplementedError()
+
+    def wait(self, timeout: Optional[float] = None,
+             check_status: bool = True,
+             remove_failed: bool = False) -> None:
+        raise NotImplementedError()
+
+
+class LocalSchedulerClient(SchedulerClient):
+    """Subprocess scheduler (reference local/client.py:66). On a TPU
+    host, each worker process sees the full local chip fleet; device
+    isolation happens through per-model meshes, not env masking (the
+    reference instead isolates via CUDA_VISIBLE_DEVICES,
+    gpu_utils.py:64)."""
+
+    def __init__(self):
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def submit(self, name, cmd, env=None):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        logger.info("Launching job %s: %s", name, " ".join(cmd))
+        self._procs[name] = subprocess.Popen(
+            cmd, env=full_env, start_new_session=True)
+
+    def find(self, name) -> JobInfo:
+        p = self._procs.get(name)
+        if p is None:
+            return JobInfo(name, JobState.NOT_FOUND)
+        rc = p.poll()
+        if rc is None:
+            return JobInfo(name, JobState.RUNNING, pid=p.pid)
+        state = JobState.COMPLETED if rc == 0 else JobState.FAILED
+        return JobInfo(name, state, pid=p.pid, returncode=rc)
+
+    def stop_all(self):
+        for name, p in self._procs.items():
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + 10
+        try:
+            for name, p in self._procs.items():
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass  # exited during the grace period
+        finally:
+            self._procs.clear()
+
+    def wait(self, timeout=None, check_status=True, remove_failed=False):
+        """Block until all jobs finish; raise JobException on the first
+        failure (triggers the launcher's recover path, reference
+        apps/main.py:195-230)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            states = {n: self.find(n) for n in list(self._procs)}
+            if check_status:
+                for n, info in states.items():
+                    if info.state == JobState.FAILED:
+                        if remove_failed:
+                            del self._procs[n]
+                        raise JobException(n, info.state)
+            if all(i.state in (JobState.COMPLETED, JobState.FAILED,
+                               JobState.NOT_FOUND)
+                   for i in states.values()):
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("Scheduler wait timed out.")
+            time.sleep(0.2)
+
+
+def make_scheduler(mode: str = "local") -> SchedulerClient:
+    if mode == "local":
+        return LocalSchedulerClient()
+    raise NotImplementedError(f"Scheduler mode {mode}")

@@ -1,0 +1,181 @@
+"""Worker process skeleton + the controller's control panel.
+
+Parity with reference ``realhf/system/worker_base.py``: a Worker runs a
+poll loop, obeys configure/start/pause/exit commands, and publishes its
+status through name_resolve; the controller's WorkerControlPanel issues
+group commands over per-worker ZMQ REQ/REP sockets and monitors
+statuses for failure detection (reference controller ``wait:275``).
+"""
+
+import dataclasses
+import enum
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from realhf_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("worker_base")
+
+
+class WorkerServerStatus(str, enum.Enum):
+    READY = "READY"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    COMPLETED = "COMPLETED"
+    ERROR = "ERROR"
+    LOST = "LOST"
+
+
+@dataclasses.dataclass
+class PollResult:
+    sample_count: int = 0
+    batch_count: int = 0
+
+
+class WorkerServer:
+    """Per-worker command endpoint (REP socket registered in
+    name_resolve; reference WorkerServer:77)."""
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 worker_name: str):
+        self.worker_name = worker_name
+        self._exp, self._trial = experiment_name, trial_name
+        ctx = zmq.Context.instance()
+        self._sock = ctx.socket(zmq.REP)
+        port = self._sock.bind_to_random_port("tcp://*")
+        host = network.gethostip()
+        name_resolve.add(
+            names.worker_key(experiment_name, trial_name, worker_name),
+            f"tcp://{host}:{port}", replace=True)
+        self.set_status(WorkerServerStatus.READY)
+
+    def set_status(self, status: WorkerServerStatus):
+        name_resolve.add(
+            names.worker_status(self._exp, self._trial, self.worker_name),
+            status.value, replace=True, delete_on_exit=False)
+
+    def poll_command(self, timeout: float = 0.0):
+        """Returns (command, kwargs) or None; caller must respond via
+        the returned responder before polling again."""
+        if not self._sock.poll(timeout * 1000):
+            return None
+        cmd, kwargs = pickle.loads(self._sock.recv())
+        return cmd, kwargs
+
+    def respond(self, data: Any = None):
+        self._sock.send(pickle.dumps(data))
+
+
+class WorkerControlPanel:
+    """Controller side: group commands + status monitoring
+    (reference WorkerControlPanel:217)."""
+
+    def __init__(self, experiment_name: str, trial_name: str):
+        self._exp, self._trial = experiment_name, trial_name
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+
+    def connect(self, worker_names: List[str], timeout: float = 120.0):
+        for w in worker_names:
+            addr = name_resolve.wait(
+                names.worker_key(self._exp, self._trial, w), timeout=timeout)
+            s = self._ctx.socket(zmq.REQ)
+            s.connect(addr)
+            self._socks[w] = s
+
+    def group_request(self, command: str,
+                      worker_names: Optional[List[str]] = None,
+                      kwargs: Optional[Dict] = None,
+                      timeout: float = 600.0) -> Dict[str, Any]:
+        targets = worker_names or list(self._socks)
+        for w in targets:
+            self._socks[w].send(pickle.dumps((command, kwargs or {})))
+        out = {}
+        for w in targets:
+            if not self._socks[w].poll(timeout * 1000):
+                raise TimeoutError(f"Worker {w} did not respond to "
+                                   f"`{command}`.")
+            out[w] = pickle.loads(self._socks[w].recv())
+        return out
+
+    def get_worker_status(self, worker_name: str) -> WorkerServerStatus:
+        try:
+            return WorkerServerStatus(name_resolve.get(
+                names.worker_status(self._exp, self._trial, worker_name)))
+        except name_resolve.NameEntryNotFoundError:
+            return WorkerServerStatus.LOST
+
+    def all_statuses(self, worker_names: List[str]
+                     ) -> Dict[str, WorkerServerStatus]:
+        return {w: self.get_worker_status(w) for w in worker_names}
+
+
+class Worker:
+    """Poll-loop worker (reference Worker:468). Subclasses implement
+    `_configure(config)` and `_poll() -> PollResult`; `run()` drives the
+    state machine until exit."""
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 worker_name: str):
+        self.worker_name = worker_name
+        self.server = WorkerServer(experiment_name, trial_name, worker_name)
+        self._running = False
+        self._exiting = False
+        self.config = None
+
+    # -- subclass API ---------------------------------------------------
+    def _configure(self, config: Any):
+        raise NotImplementedError()
+
+    def _poll(self) -> PollResult:
+        raise NotImplementedError()
+
+    def _exit_hook(self):
+        """Last-chance cleanup/checkpoint on exit (reference
+        model_worker.py:953 recover save)."""
+
+    # -------------------------------------------------------------------
+    def _handle_command(self, cmd: str, kwargs: Dict) -> Any:
+        if cmd == "configure":
+            self.config = kwargs.get("config")
+            result = self._configure(self.config)
+            self.server.set_status(WorkerServerStatus.READY)
+            return result
+        if cmd == "start":
+            self._running = True
+            self.server.set_status(WorkerServerStatus.RUNNING)
+            return "ok"
+        if cmd == "pause":
+            self._running = False
+            self.server.set_status(WorkerServerStatus.PAUSED)
+            return "ok"
+        if cmd == "exit":
+            self._exiting = True
+            return "ok"
+        if cmd == "ping":
+            return "pong"
+        raise ValueError(f"Unknown worker command {cmd}")
+
+    def run(self):
+        logger.info("Worker %s starting poll loop.", self.worker_name)
+        try:
+            while not self._exiting:
+                cmd = self.server.poll_command(
+                    timeout=0.05 if not self._running else 0.0)
+                if cmd is not None:
+                    try:
+                        self.server.respond(self._handle_command(*cmd))
+                    except Exception as e:  # noqa: BLE001
+                        self.server.respond(e)
+                        raise
+                if self._running:
+                    self._poll()
+            self._exit_hook()
+            self.server.set_status(WorkerServerStatus.COMPLETED)
+        except Exception:
+            self.server.set_status(WorkerServerStatus.ERROR)
+            raise

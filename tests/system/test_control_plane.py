@@ -1,0 +1,140 @@
+"""Distributed control-plane tests: real OS processes exchanging
+commands and requests over ZMQ with name_resolve rendezvous -- the
+multiprocess-local harness pattern of the reference
+(``base/testing.py:112`` LocalMultiProcessTest), no accelerators
+involved."""
+
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import pytest
+
+
+def _worker_proc(record_root, exp, trial, widx):
+    # runs in a separate OS process: no jax, fresh name_resolve
+    os.environ["REALHF_TPU_NAME_RESOLVE"] = "nfs"
+    from realhf_tpu.base import name_resolve
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingReplyServer,
+    )
+    from realhf_tpu.system.worker_base import PollResult, Worker
+
+    class EchoWorker(Worker):
+
+        def _configure(self, config):
+            self.stream = NameResolvingReplyServer(
+                exp, trial, f"echo/{widx}")
+            self.scale = config["scale"]
+            return f"configured-{widx}"
+
+        def _poll(self):
+            try:
+                req = self.stream.poll(timeout=0.05)
+            except TimeoutError:
+                return PollResult(0, 0)
+            if req.handle_name == "compute":
+                self.stream.respond(req, data=req.data * self.scale)
+            elif req.handle_name == "whoami":
+                self.stream.respond(req, data=f"echo/{widx}")
+            return PollResult(1, 1)
+
+    EchoWorker(exp, trial, f"echo/{widx}").run()
+
+
+@pytest.fixture
+def record_root(tmp_path):
+    return str(tmp_path / "nr")
+
+
+def test_controller_and_stream_roundtrip(record_root):
+    """Controller configures/starts 2 worker processes; the master
+    stream sends a syn-ack group request and gathers replies; workers
+    exit cleanly with COMPLETED status."""
+    from realhf_tpu.base import name_resolve
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingRequestClient,
+    )
+    from realhf_tpu.system.worker_base import (
+        WorkerControlPanel,
+        WorkerServerStatus,
+    )
+
+    exp, trial = "cptest", "t0"
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_worker_proc,
+                         args=(record_root, exp, trial, i), daemon=True)
+             for i in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        master = NameResolvingRequestClient(exp, trial)
+        panel = WorkerControlPanel(exp, trial)
+        panel.connect(["echo/0", "echo/1"], timeout=60)
+
+        out = panel.group_request(
+            "configure", kwargs={"config": {"scale": 3}})
+        assert out == {"echo/0": "configured-0", "echo/1": "configured-1"}
+        panel.group_request("start")
+        assert panel.get_worker_status("echo/0") == \
+            WorkerServerStatus.RUNNING
+
+        master.wait_subscribers(["echo/0", "echo/1"], timeout=30)
+
+        # syn-ack group request: both workers receive before any starts
+        rids = master.request(["echo/0", "echo/1"], "compute",
+                              datas=[10, 20], no_syn=False)
+        replies = master.gather_replies(rids, timeout=30)
+        assert [r.data for r in replies] == [30, 60]
+
+        # plain (no-syn) request to one worker
+        rid = master.request(["echo/1"], "whoami")[0]
+        reply = master.gather_replies([rid], timeout=30)[0]
+        assert reply.data == "echo/1"
+
+        panel.group_request("exit")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            statuses = panel.all_statuses(["echo/0", "echo/1"])
+            if all(s == WorkerServerStatus.COMPLETED
+                   for s in statuses.values()):
+                break
+            time.sleep(0.1)
+        assert all(s == WorkerServerStatus.COMPLETED
+                   for s in panel.all_statuses(["echo/0", "echo/1"]).values())
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
+def test_local_scheduler(tmp_path):
+    from realhf_tpu.system.scheduler import (
+        JobException,
+        JobState,
+        LocalSchedulerClient,
+    )
+
+    sched = LocalSchedulerClient()
+    marker = tmp_path / "ok.txt"
+    sched.submit("okjob", [sys.executable, "-c",
+                           f"open({str(marker)!r}, 'w').write('done')"])
+    sched.wait(timeout=30)
+    assert marker.read_text() == "done"
+    assert sched.find("okjob").state == JobState.COMPLETED
+
+    sched2 = LocalSchedulerClient()
+    sched2.submit("bad", [sys.executable, "-c", "raise SystemExit(3)"])
+    with pytest.raises(JobException):
+        sched2.wait(timeout=30)
+
+    sched3 = LocalSchedulerClient()
+    sched3.submit_array("sleepers", [sys.executable, "-c",
+                                     "import time; time.sleep(60)"], 2)
+    time.sleep(0.5)
+    assert sched3.find("sleepers/0").state == JobState.RUNNING
+    sched3.stop_all()

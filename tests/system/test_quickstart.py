@@ -214,3 +214,42 @@ def test_ppo_decoupled_allocation(prompt_data):
     # replica to hold the freshly trained actor weights every step
     assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
     assert runner.replica_mgr.last_reshard_secs is not None
+
+
+def test_recover_resume(sft_data):
+    """Interrupt an SFT run, then resume: step counters restore, the
+    model reloads from the checkpoint, and already-consumed data ids
+    are skipped in the interrupted epoch."""
+    from realhf_tpu.base import recover
+    from realhf_tpu.system.inline import InlineRunner
+
+    def make_spec():
+        cfg = SFTConfig(experiment_name="rectest", trial_name="t0",
+                        total_train_epochs=2, save_freq_steps=1)
+        apply_overrides(cfg, {"dataset.path": sft_data,
+                              "dataset.train_bs_n_seqs": "8",
+                              "dataset.max_seqlen": "32"})
+        spec = cfg.build()
+        _patch_random_models(spec, FakeTokenizer())
+        return spec
+
+    spec = make_spec()
+    spec.ctl.benchmark_steps = 1  # simulate dying after step 1
+    r1 = InlineRunner(spec, recover_mode="resume")
+    r1.run()
+    assert recover.exists()
+    info = recover.load()
+    assert info.last_step_info.global_step == 1
+    consumed = set(info.hash_vals_to_ignore)
+    assert len(consumed) == 8
+
+    spec2 = make_spec()
+    r2 = InlineRunner(spec2, recover_mode="resume")
+    assert r2.global_step == 1
+    # the recovered model came from the checkpoint (path set)
+    assert spec2.models["default"].path is not None
+    stats = r2.run()
+    assert np.isfinite(stats["trainDefault"]["loss"])
+    # epoch 0's remaining batch skipped the consumed ids
+    final = recover.load()
+    assert len(set(final.hash_vals_to_ignore) | consumed) >= 8
