@@ -1,7 +1,8 @@
 """Algorithm interfaces; importing registers them by name.
 
 Registered names match the reference (``impl/model/interface/``):
-"sft", "paired_rw", "dpo", "ppo_actor", "ppo_critic", "generation".
+"sft", "paired_rw", "dpo", "ppo_actor", "ppo_critic", "generation",
+"grpo".
 """
 
 import realhf_tpu.interfaces.sft  # noqa: F401
@@ -9,3 +10,4 @@ import realhf_tpu.interfaces.rw  # noqa: F401
 import realhf_tpu.interfaces.dpo  # noqa: F401
 import realhf_tpu.interfaces.ppo  # noqa: F401
 import realhf_tpu.interfaces.gen  # noqa: F401
+import realhf_tpu.interfaces.grpo  # noqa: F401

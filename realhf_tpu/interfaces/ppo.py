@@ -174,10 +174,19 @@ class PPOActorInterface(model_api.ModelInterface):
             temperature=self.gconfig.temperature, logits_mask=lmask))
         flat_lp = packing.unpack_tokens(sb.info, lp,
                                         seqlens=[l - 1 for l in seqlens])
-        return SequenceSample.from_default(
-            ids=input_.ids,
-            seqlens=seqlens,
-            data=dict(packed_ref_logprobs=flat_lp.astype(np.float32)))
+        # Preserve per-element nesting (GRPO groups several sequences
+        # inside one batch element).
+        nested_m1 = [[l - 1 for l in lens]
+                     for lens in input_.seqlens["packed_input_ids"]]
+        with SequenceSample.disable_validation():
+            return SequenceSample(
+                keys=["packed_ref_logprobs"],
+                trailing_shapes=dict(packed_ref_logprobs=()),
+                dtypes=dict(packed_ref_logprobs=np.float32),
+                ids=list(input_.ids),
+                seqlens=dict(packed_ref_logprobs=nested_m1),
+                data=dict(packed_ref_logprobs=flat_lp.astype(np.float32)),
+                metadata={})
 
     # ------------------------------------------------------------------
     def train_step(self, model: model_api.Model, input_: SequenceSample,
