@@ -1,0 +1,36 @@
+"""Backend selection helpers.
+
+The ordering contract, in one place: a TPU PJRT plugin may be
+registered PROGRAMMATICALLY at interpreter startup (sitecustomize), in
+which case the ``JAX_PLATFORMS`` env var alone cannot exclude it --
+merely requesting ``jax.devices("cpu")`` still initializes the TPU
+plugin first and can block indefinitely when the chip is unavailable
+or held by another client. Forcing the CPU backend therefore requires
+flipping ``jax.config``'s ``jax_platforms`` BEFORE any backend
+initialization, and the virtual-device XLA flag must be in the
+environment before the CPU backend first initializes.
+"""
+
+import os
+from typing import Optional
+
+
+def force_cpu_backend(n_devices: Optional[int] = None) -> None:
+    """Pin this process's JAX to the CPU backend, optionally with
+    ``n_devices`` virtual devices. Call before any jax computation;
+    safe to call if jax is already imported, best-effort if a backend
+    was already initialized."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - backends already up; env set
+        pass

@@ -9,17 +9,13 @@ compile and run in CI.
 
 import os
 
-# Must be set before jax is imported anywhere. Note: this image's
-# sitecustomize registers the axon TPU plugin at interpreter startup
-# and pins JAX_PLATFORMS=axon, so the TPU backend cannot be excluded;
-# we instead register 8 virtual CPU devices alongside it and pin all
-# test computation to them below.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["REALHF_TPU_BACKEND"] = "cpu"  # meshes built from CPU devices
+
+from realhf_tpu.base.backend import force_cpu_backend  # noqa: E402
+
+# See force_cpu_backend's docstring for why the env var alone cannot
+# exclude a TPU plugin registered at interpreter startup.
+force_cpu_backend(n_devices=8)
 
 import jax  # noqa: E402
 

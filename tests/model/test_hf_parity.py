@@ -149,8 +149,8 @@ def test_fp64_exact_parity(saved_hf_model):
     if family != "llama":
         pytest.skip("fp64 pinning uses llama only")
     code = f"""
-import os
-os.environ["JAX_PLATFORMS"] = "cpu"
+from realhf_tpu.base.backend import force_cpu_backend
+force_cpu_backend()
 import jax
 jax.config.update("jax_enable_x64", True)
 import numpy as np, torch, transformers, jax.numpy as jnp
