@@ -10,7 +10,7 @@ Mapping (reference module -> spec here):
 - ParallelEmbedding (vocab-partitioned, modules.py:53)  -> wte P("model", None)
 - ColumnParallelLinear (modules.py:727)                 -> wq/wk/wv/wg/wu P(..., "model")
 - RowParallelLinear (modules.py:875)                    -> wo/wd P(..., "model", None)
-- parallel_lm_logits + _VocabParallelCrossEntropy       -> head P(None, "model") + fused CE in ops/ce.py
+- parallel_lm_logits + _VocabParallelCrossEntropy       -> head P(None, "model") + chunked CE in ops/functional.py
 - sequence parallel scatter/gather (mappings.py:207-294)-> residual-stream
   constraint P("data", "model", None): XLA materializes the
   all-gather before attention/MLP and reduce-scatter after, which is

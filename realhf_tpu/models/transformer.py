@@ -229,7 +229,8 @@ def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
         k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
     attn_impl = attention_fn or packed_attention
     attn = attn_impl(q, k, v, seg_ids, causal=True,
-                     scale=_attn_scale(cfg, layer_idx))
+                     scale=_attn_scale(cfg, layer_idx),
+                     sliding_window=cfg.sliding_window)
     attn = attn.reshape(*x.shape[:-1], cfg.n_q_heads * cfg.head_dim)
     proj = attn @ lp["attn"]["wo"].astype(x.dtype)
     if "bo" in lp["attn"]:
@@ -271,7 +272,7 @@ def forward(
 ):
     """Packed forward pass -> final hidden states [B, L, H] (after the
     final norm). Heads are applied separately (`lm_logits`,
-    `critic_values`, or fused ops in `realhf_tpu.ops.ce`).
+    `critic_values`, or fused ops in `realhf_tpu.ops.functional`).
 
     ``activation_constraint`` is an optional fn applied to the residual
     stream each block (sharding constraints; see models/sharding.py).
@@ -448,7 +449,9 @@ def decode_step(
         k_cache = k_cache.at[jnp.arange(b), slot].set(k)
         v_cache = v_cache.at[jnp.arange(b), slot].set(v)
         attn = decode_attention(q, k_cache, v_cache, valid,
-                                scale=_attn_scale(cfg, layer_idx))
+                                scale=_attn_scale(cfg, layer_idx),
+                                sliding_window=cfg.sliding_window,
+                                slot=slot)
         proj = attn.reshape(b, -1) @ lp["attn"]["wo"].astype(x.dtype)
         if "bo" in lp["attn"]:
             proj = proj + lp["attn"]["bo"].astype(x.dtype)

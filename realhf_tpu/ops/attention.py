@@ -25,14 +25,23 @@ NEG_INF = -2.0 ** 30  # large finite value; -inf breaks softmax for all-masked r
 
 
 def _segment_mask(seg_q: jnp.ndarray, seg_k: jnp.ndarray,
-                  causal: bool) -> jnp.ndarray:
-    """[B, Lq, Lk] bool mask: same non-zero segment (+ causality)."""
+                  causal: bool,
+                  sliding_window: Optional[int] = None) -> jnp.ndarray:
+    """[B, Lq, Lk] bool mask: same non-zero segment (+ causality,
+    + optional sliding window).
+
+    Within a packed stream, positions inside a segment are contiguous,
+    so the stream-index difference equals the in-segment position
+    difference and the (q_idx - k_idx) < window test is exact.
+    """
     mask = (seg_q[:, :, None] == seg_k[:, None, :]) & (seg_q[:, :, None] != 0)
+    lq, lk = seg_q.shape[1], seg_k.shape[1]
+    idx_q = jnp.arange(lq)[:, None]
+    idx_k = jnp.arange(lk)[None, :]
     if causal:
-        lq, lk = seg_q.shape[1], seg_k.shape[1]
-        idx_q = jnp.arange(lq)[:, None]
-        idx_k = jnp.arange(lk)[None, :]
         mask = mask & (idx_q >= idx_k)[None]
+    if sliding_window is not None:
+        mask = mask & ((idx_q - idx_k) < sliding_window)[None]
     return mask
 
 
@@ -45,6 +54,7 @@ def packed_attention_xla(
     causal: bool = True,
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Reference XLA implementation; O(L^2) scores in fp32.
 
@@ -62,7 +72,8 @@ def packed_attention_xla(
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
-    mask = _segment_mask(seg_ids, seg_ids, causal)[:, None, None]
+    mask = _segment_mask(seg_ids, seg_ids, causal,
+                         sliding_window)[:, None, None]
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
@@ -71,7 +82,8 @@ def packed_attention_xla(
 
 
 def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
-                     logits_soft_cap=None, use_flash: Optional[bool] = None):
+                     logits_soft_cap=None, sliding_window=None,
+                     use_flash: Optional[bool] = None):
     """Dispatch between the Pallas flash kernel (TPU) and the XLA path.
 
     ``use_flash=None`` auto-selects: flash on TPU backends when shapes
@@ -81,10 +93,13 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
         use_flash = (jax.default_backend() == "tpu"
                      and q.shape[1] % 128 == 0 and q.shape[3] >= 64
                      # the flash kernel requires a static python scale
-                     # and has no soft-cap support
+                     # and has no soft-cap / sliding-window support
                      and logits_soft_cap is None
+                     and sliding_window is None
                      and (scale is None or isinstance(scale, (int, float))))
     if use_flash:
+        assert sliding_window is None, \
+            "flash kernel has no sliding-window support yet"
         try:
             from realhf_tpu.ops.flash_attention import flash_attention
         except ImportError:
@@ -94,7 +109,8 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
                                    scale=scale,
                                    logits_soft_cap=logits_soft_cap)
     return packed_attention_xla(q, k, v, seg_ids, causal=causal, scale=scale,
-                                logits_soft_cap=logits_soft_cap)
+                                logits_soft_cap=logits_soft_cap,
+                                sliding_window=sliding_window)
 
 
 def decode_attention(
@@ -107,12 +123,17 @@ def decode_attention(
     *,
     scale: Optional[float] = None,
     logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    slot: Optional[jnp.ndarray] = None,  # [B] int32 current write index,
+                                         # required with sliding_window
 ) -> jnp.ndarray:
     """Single-step decode attention against a padded KV cache.
 
     The caller has already written the new token's K/V (and marked its
     slot valid). Replaces `flash_attn_with_kvcache`
-    (reference ``attn.py:238``).
+    (reference ``attn.py:238``). Cache slot indices are sequential
+    stream positions, so the sliding window keeps slots in
+    ``(slot - window, slot]``.
     """
     b, nq, hd = q.shape
     s, nkv = k_cache.shape[1], k_cache.shape[2]
@@ -124,7 +145,12 @@ def decode_attention(
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
-    scores = jnp.where(valid_mask[:, None, None, :], scores, NEG_INF)
+    keep = valid_mask
+    if sliding_window is not None:
+        assert slot is not None, "sliding_window decode needs slot indices"
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        keep = keep & ((slot[:, None] - idx) < sliding_window)
+    scores = jnp.where(keep[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgk,bkhd->bhgd", probs.astype(v_cache.dtype), v_cache,
                      preferred_element_type=jnp.float32)

@@ -30,7 +30,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -2.0 ** 30
 
 
-def _partial_attention(q, k, v, seg_q, seg_k, q_off, k_off, scale, causal):
+def _partial_attention(q, k, v, seg_q, seg_k, q_off, k_off, scale, causal,
+                       sliding_window=None):
     """One ring step: q [B, Lq, nq, hd] vs k/v [B, Lk, nkv, hd] with
     global offsets; returns (m [B, nq, Lq], l, acc [B, nq, Lq, hd])."""
     b, lq, nq, hd = q.shape
@@ -41,10 +42,13 @@ def _partial_attention(q, k, v, seg_q, seg_k, q_off, k_off, scale, causal):
                    k.astype(jnp.float32))
     s = s.reshape(b, nq, lq, -1)
     mask = (seg_q[:, :, None] == seg_k[:, None, :]) & (seg_q[:, :, None] != 0)
+    qi = q_off + jnp.arange(lq)
+    ki = k_off + jnp.arange(k.shape[1])
     if causal:
-        qi = q_off + jnp.arange(lq)
-        ki = k_off + jnp.arange(k.shape[1])
         mask = mask & (qi[:, None] >= ki[None, :])[None]
+    if sliding_window is not None:
+        # global stream indices make the window exact across ring steps
+        mask = mask & ((qi[:, None] - ki[None, :]) < sliding_window)[None]
     s = jnp.where(mask[:, None], s, NEG_INF)
     m = s.max(axis=-1)  # [B, nq, Lq]
     p = jnp.exp(s - m[..., None])
@@ -74,6 +78,7 @@ def ring_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over the given mesh axis.
 
@@ -117,7 +122,8 @@ def ring_attention(
             m, lsum, acc, k, v, seg_k = carry
             src = (idx - r) % n  # whose KV shard we currently hold
             part = _partial_attention(q, k, v, seg, seg_k, q_off,
-                                      src * lc, scale, causal)
+                                      src * lc, scale, causal,
+                                      sliding_window)
             m, lsum, acc = _combine((m, lsum, acc), part)
             perm = [(i, (i + 1) % n) for i in range(n)]
             k = jax.lax.ppermute(k, axis, perm)

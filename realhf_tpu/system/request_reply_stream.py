@@ -49,6 +49,7 @@ class NameResolvingRequestClient:
 
     def __init__(self, experiment_name: str, trial_name: str,
                  stream_name: str = "master"):
+        self._reply_backlog = collections.deque()
         self._ctx = zmq.Context.instance()
         self._pub = self._ctx.socket(zmq.PUB)
         host = network.gethostip()
@@ -83,8 +84,10 @@ class NameResolvingRequestClient:
                     f"Subscribers never connected: {pending}")
 
     def post(self, payload: Payload) -> str:
+        # NUL-terminated topic: ZMQ SUB matches by prefix, so a bare
+        # "x/1" subscription would also receive "x/10".."x/19".
         self._pub.send_multipart([
-            payload.handler.encode(), pickle.dumps(payload)])
+            payload.handler.encode() + b"\0", pickle.dumps(payload)])
         return payload.request_id
 
     def request(self, handlers: List[str], handle_name: str,
@@ -123,6 +126,8 @@ class NameResolvingRequestClient:
         return [p.request_id for p in payloads]
 
     def poll(self, timeout: Optional[float] = None) -> Payload:
+        if self._reply_backlog:
+            return self._reply_backlog.popleft()
         if timeout is not None:
             if not self._pull.poll(timeout * 1000):
                 raise TimeoutError("No reply within timeout.")
@@ -131,8 +136,9 @@ class NameResolvingRequestClient:
     def poll_batch(self, timeout: float = 0.0) -> List[Payload]:
         """All immediately-available replies; `timeout` bounds the wait
         for the FIRST one only."""
-        out = []
-        if self._pull.poll(timeout * 1000):
+        out = list(self._reply_backlog)
+        self._reply_backlog.clear()
+        if self._pull.poll(0 if out else timeout * 1000):
             out.append(pickle.loads(self._pull.recv()))
             while self._pull.poll(0):
                 out.append(pickle.loads(self._pull.recv()))
@@ -140,12 +146,36 @@ class NameResolvingRequestClient:
 
     def gather_replies(self, request_ids: List[str],
                        timeout: float = 600.0) -> List[Payload]:
+        """Blocking gather of specific replies. Replies to OTHER
+        requests arriving meanwhile are buffered for later
+        poll/poll_batch calls, never dropped (the master interleaves
+        blocking save/eval gathers with in-flight MFC replies).
+
+        Reads the SOCKET directly -- going through poll() would
+        re-consume the very payloads this method just backlogged and
+        spin forever.
+        """
         got: Dict[str, Payload] = {}
+        # a matching reply may already sit in the backlog
+        for p in list(self._reply_backlog):
+            if p.request_id in request_ids and p.request_id not in got:
+                got[p.request_id] = p
+                self._reply_backlog.remove(p)
         deadline = time.monotonic() + timeout
         while len(got) < len(request_ids):
-            p = self.poll(timeout=max(0.1, deadline - time.monotonic()))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # checked every iteration: steady unrelated traffic
+                # must not postpone the timeout indefinitely
+                missing = [r for r in request_ids if r not in got]
+                raise TimeoutError(f"No reply for requests {missing}.")
+            if not self._pull.poll(min(remaining, 0.1) * 1000):
+                continue
+            p: Payload = pickle.loads(self._pull.recv())
             if p.request_id in request_ids:
                 got[p.request_id] = p
+            else:
+                self._reply_backlog.append(p)
         return [got[r] for r in request_ids]
 
     def close(self):
@@ -167,7 +197,7 @@ class NameResolvingReplyServer:
         self._ctx = zmq.Context.instance()
         self._sub = self._ctx.socket(zmq.SUB)
         self._sub.connect(pub_addr)
-        self._sub.setsockopt(zmq.SUBSCRIBE, handler_name.encode())
+        self._sub.setsockopt(zmq.SUBSCRIBE, handler_name.encode() + b"\0")
         self._push = self._ctx.socket(zmq.PUSH)
         self._push.connect(pull_addr)
 
@@ -182,6 +212,9 @@ class NameResolvingReplyServer:
                     raise TimeoutError("No request within timeout.")
                 _, raw = self._sub.recv_multipart()
                 payload = pickle.loads(raw)
+            if payload.handler != self.handler_name:
+                # belt-and-braces against topic prefix collisions
+                continue
             if payload.handle_name == PUBSUB_BARRIER_NAME:
                 self.reply(Payload(handler=self.handler_name,
                                    handle_name=PUBSUB_BARRIER_NAME,

@@ -138,3 +138,49 @@ def test_local_scheduler(tmp_path):
     time.sleep(0.5)
     assert sched3.find("sleepers/0").state == JobState.RUNNING
     sched3.stop_all()
+
+
+def test_sub_topic_no_prefix_collision(record_root):
+    """A worker named 'w/1' must not receive requests addressed to
+    'w/10' (ZMQ SUB matches topics by prefix; the stream terminates
+    topics with NUL to prevent this)."""
+    from realhf_tpu.base import name_resolve
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingReplyServer,
+        NameResolvingRequestClient,
+    )
+
+    exp, trial = "cptopic", "t0"
+    master = NameResolvingRequestClient(exp, trial)
+    w1 = NameResolvingReplyServer(exp, trial, "w/1")
+    w10 = NameResolvingReplyServer(exp, trial, "w/10")
+    try:
+        # SUB connection is asynchronous: ping each worker until its
+        # subscription is live.
+        for server, name in ((w1, "w/1"), (w10, "w/10")):
+            for _ in range(200):
+                master.request([name], "ping")
+                try:
+                    server.poll(timeout=0.05)
+                    break
+                except TimeoutError:
+                    continue
+            else:
+                pytest.fail(f"subscription for {name} never became live")
+        for server in (w1, w10):  # drain queued pings
+            try:
+                while True:
+                    server.poll(timeout=0.2)
+            except TimeoutError:
+                pass
+
+        rid = master.request(["w/10"], "compute", datas=[33])[0]
+        got = w10.poll(timeout=5)
+        assert got.request_id == rid and got.data == 33
+        with pytest.raises(TimeoutError):
+            w1.poll(timeout=0.5)
+    finally:
+        w1.close()
+        w10.close()
+        master.close()
