@@ -72,9 +72,11 @@ class Engine:
             from realhf_tpu.ops.ring_attention import ring_attention
             mesh = self.mesh
 
-            def _ring(q, k, v, seg, causal=True, scale=None):
+            def _ring(q, k, v, seg, causal=True, scale=None,
+                      sliding_window=None):
                 return ring_attention(q, k, v, seg, mesh, "ctx",
-                                      causal=causal, scale=scale)
+                                      causal=causal, scale=scale,
+                                      sliding_window=sliding_window)
 
             self.attention_fn = _ring
         else:
@@ -136,6 +138,23 @@ class Engine:
             gnorm = optax.global_norm(gsum)
             mean_stats = jax.tree.map(
                 lambda s: (s * mb_weights / wsum).sum(), stats)
+            # Reserved stat "__skip_update__": when any microbatch sets
+            # it > 0, the whole optimizer step is discarded -- params,
+            # optimizer moments, and step count stay untouched (PPO
+            # early stopping must SKIP the update, not step with a
+            # zeroed loss: AdamW weight decay and MoE aux grads would
+            # otherwise still apply).
+            skip = mean_stats.pop("__skip_update__", None)
+            if skip is not None:
+                keep_old = skip > 0
+                new_params = jax.tree.map(
+                    lambda n, o: jnp.where(keep_old, o, n),
+                    new_params, params)
+                new_opt = jax.tree.map(
+                    lambda n, o: jnp.where(keep_old, o, n),
+                    new_opt, opt_state)
+                mean_stats["early_stop_skipped"] = keep_old.astype(
+                    jnp.float32)
             mean_loss = (losses * mb_weights / wsum).sum()
             return new_params, new_opt, mean_loss, mean_stats, gnorm
 
@@ -149,6 +168,16 @@ class Engine:
 
         All microbatches must share array shapes (the packer pads them
         to a common bucket); they are stacked and scanned on-device.
+
+        ``loss_fn_key`` caches the compiled step: it MUST uniquely
+        identify the loss closure INCLUDING every hyperparameter the
+        closure captures (temperature, clip ranges, ...) -- use a tuple
+        like ("ppo_actor", temp, eps_clip). Two closures sharing a key
+        silently reuse the first compilation.
+
+        ``loss_fn`` may return the reserved stat ``__skip_update__``
+        (0/1 scalar); if any microbatch sets it, the optimizer update
+        is discarded for this call (see _build_train_step).
         """
         if self._tx is None:
             raise RuntimeError("Engine has no optimizer (inference-only).")
@@ -261,3 +290,29 @@ class Engine:
 
     def inc_version(self):
         self.version += 1
+
+    # ------------------------------------------------------------------
+    # Offload (reference async_offload/wait_for_offload,
+    # real_llm_api.py:274-308: pinned-CPU weight offload between uses)
+    # ------------------------------------------------------------------
+    @property
+    def offloaded(self) -> bool:
+        return getattr(self, "_offloaded", False)
+
+    def offload(self):
+        """Move weights to host memory, freeing HBM until the next use."""
+        if self.offloaded:
+            return
+        cpu = jax.devices("cpu")[0]
+        self.params = jax.device_put(self.params, cpu)
+        jax.block_until_ready(self.params)
+        self._offloaded = True
+
+    def ensure_on_device(self):
+        """Reload offloaded weights onto this engine's mesh shardings
+        (the pre-use reload the reference runs in
+        model_worker.handle_all_pre_hooks)."""
+        if not self.offloaded:
+            return
+        self.params = jax.device_put(self.params, self._param_shardings)
+        self._offloaded = False

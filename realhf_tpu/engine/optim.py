@@ -35,7 +35,7 @@ class OptimizerConfig:
 
 
 def lr_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
-    warmup = max(1, int(cfg.warmup_steps_proportion * total_steps))
+    warmup = int(cfg.warmup_steps_proportion * total_steps)
     decay_steps = max(1, total_steps - warmup)
     end = cfg.lr * cfg.min_lr_ratio
     if cfg.lr_scheduler_type == "constant":
@@ -47,6 +47,10 @@ def lr_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
         decay = optax.cosine_decay_schedule(cfg.lr, decay_steps, alpha=alpha)
     else:
         raise NotImplementedError(cfg.lr_scheduler_type)
+    if warmup <= 0:
+        # no warmup: the FIRST step must already use the full lr
+        # (linear_schedule(0, lr, 1) would silently zero it out)
+        return decay
     return optax.join_schedules(
         [optax.linear_schedule(0.0, cfg.lr, warmup), decay], [warmup])
 

@@ -87,6 +87,27 @@ def forward_with_aux(cfg, params, input_ids, seg_ids, attention_fn=None):
     return h, {}
 
 
+def run_train_microbatched(engine, sample: SequenceSample, build_sb,
+                           loss_fn, loss_fn_key, n_mbs: Optional[int],
+                           weight_key: str = "loss_mask") -> Dict:
+    """One optimizer step over ``n_mbs`` memory microbatches of
+    ``sample`` (MFCDef.n_mbs; reference model_api.py:305-463).
+
+    Gradients are combined with weights equal to each microbatch's
+    LOSS-MASK token count, which makes the accumulated gradient exactly
+    the one-big-batch gradient (each microbatch loss is a mean over its
+    own masked tokens). Weighting by total tokens would over-weight
+    response tokens in prompt-heavy microbatches.
+    """
+    sbs = pad_stream_batches(
+        [build_sb(m) for m in split_minibatches(sample, n_mbs or 1)])
+    weights = [float(np.asarray(sb.arrays[weight_key]).sum()) for sb in sbs]
+    if not any(w > 0 for w in weights):  # degenerate batch: avoid 0/0
+        weights = [float(sb.n_tokens) for sb in sbs]
+    return engine.train_batch([sb.arrays for sb in sbs], loss_fn,
+                              loss_weights=weights, loss_fn_key=loss_fn_key)
+
+
 def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:
     """Pad a list of stream batches to a common [S, L] so they can be
     stacked and scanned as microbatches in one jitted step."""

@@ -157,7 +157,7 @@ class DPOInterface(model_api.ModelInterface):
             [b.arrays for b in batches],
             _make_loss_fn(model.config, n_seqs_max, self.beta,
                           engine.attention_fn),
-            loss_weights=weights, loss_fn_key=f"dpo-{n_seqs_max}")
+            loss_weights=weights, loss_fn_key=("dpo", n_seqs_max, self.beta))
         model.inc_version()
         return stats
 

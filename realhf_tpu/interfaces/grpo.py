@@ -98,22 +98,33 @@ class GRPOInterface(PPOActorInterface):
         old_logp = np.asarray(input_.data["packed_logprobs"], np.float32)
         ref_logp = np.asarray(input_.data["packed_ref_logprobs"], np.float32)
         prompt_mask = np.asarray(input_.data["prompt_mask"], bool)
-        rewards = np.clip(
-            np.asarray(input_.data["rewards"], np.float32),
-            -self.max_reward_clip, self.max_reward_clip)
+        rewards = np.asarray(input_.data["rewards"], np.float32)
 
         loss_mask = _shifted_loss_mask(prompt_mask, seqlens)
         old_logp = old_logp * loss_mask
         ref_logp = ref_logp * loss_mask
 
         # group-relative advantages: one scalar per sequence, broadcast
-        # over its response tokens (unbiased std, reference parity)
+        # over its response tokens (unbiased std, reference parity).
+        # Clipping applies to the NORMALIZED advantage (reference
+        # grpo_interface.py:379), not the raw reward.
         grp = rewards.reshape(-1, g)
         adv_seq = ((grp - grp.mean(axis=1, keepdims=True))
                    / (grp.std(axis=1, ddof=1, keepdims=True)
                       + 1e-5)).reshape(-1)
-        advantages = np.repeat(
-            adv_seq, np.asarray(seqlens) - 1).astype(np.float32) * loss_mask
+        adv_seq = np.clip(adv_seq, -self.max_reward_clip,
+                          self.max_reward_clip)
+        lens_m1 = np.asarray(seqlens) - 1
+        advantages = np.repeat(adv_seq, lens_m1).astype(np.float32)
+        if self.discount != 1.0:
+            # spread the terminal advantage backwards with
+            # discount^(T-1-t) decay (the reference reuses its GAE
+            # spreader with lam=discount on a terminal-only reward)
+            decay = np.concatenate([
+                self.discount ** np.arange(l - 1, -1, -1, dtype=np.float32)
+                for l in lens_m1])
+            advantages = advantages * decay
+        advantages = advantages * loss_mask
         if self.adv_norm:
             m = loss_mask.astype(np.float64)
             mean = (advantages * m).sum() / max(m.sum(), 1)
@@ -181,12 +192,12 @@ class GRPOInterface(PPOActorInterface):
                 importance_weight=stats["importance_weight"],
                 clip_ratio=stats["clip_ratio"], **aux)
 
-        all_stats = []
-        for minibatch in mbs:
+        def build_sb(minibatch):
             mb_lens = common.flat_seqlens(minibatch)
-            sb = common.build_stream_batch(
+            return common.build_stream_batch(
                 mb_lens,
-                token_keys=dict(input_ids=minibatch.data["packed_input_ids"]),
+                token_keys=dict(
+                    input_ids=minibatch.data["packed_input_ids"]),
                 shifted_keys=dict(
                     advantages=minibatch.data["advantages"],
                     old_logp=minibatch.data["old_logp"],
@@ -194,9 +205,13 @@ class GRPOInterface(PPOActorInterface):
                     loss_mask=minibatch.data["ppo_loss_mask"]
                     .astype(np.float32)),
                 n_streams=engine.ctx.dp_size)
-            all_stats.append(engine.train_batch(
-                [sb.arrays], loss_fn, loss_weights=[sb.n_tokens],
-                loss_fn_key="grpo"))
+
+        all_stats = [
+            common.run_train_microbatched(
+                engine, minibatch, build_sb, loss_fn,
+                ("grpo", temperature, eps_clip, kl_coef), n_mbs)
+            for minibatch in mbs
+        ]
         model.inc_version()
         agg = {k: float(np.mean([s[k] for s in all_stats]))
                for k in all_stats[0]}

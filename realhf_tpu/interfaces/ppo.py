@@ -157,23 +157,31 @@ class PPOActorInterface(model_api.ModelInterface):
     def inference(self, model: model_api.Model, input_: SequenceSample,
                   n_mbs: Optional[int] = None) -> SequenceSample:
         """Recompute logprobs under this model (used for ref_inf and
-        actor_inf MFCs; reference ppo_interface.py:255)."""
-        seqlens = common.flat_seqlens(input_)
-        token_keys = dict(input_ids=input_.data["packed_input_ids"])
-        sb = common.build_stream_batch(
-            seqlens, token_keys=token_keys,
-            n_streams=model.engine.ctx.dp_size)
-        lmask = None
-        if "packed_logits_mask" in input_.keys and \
-                input_.data.get("packed_logits_mask") is not None:
-            # stored True=masked-out; engine wants True=allowed
-            allowed = ~input_.data["packed_logits_mask"]
-            lmask = packing.pack_tokens(sb.info, allowed, fill=True)
-        lp = np.asarray(model.engine.forward_logprobs(
-            sb.arrays["input_ids"], sb.arrays["seg_ids"],
-            temperature=self.gconfig.temperature, logits_mask=lmask))
-        flat_lp = packing.unpack_tokens(sb.info, lp,
-                                        seqlens=[l - 1 for l in seqlens])
+        actor_inf MFCs; reference ppo_interface.py:255). ``n_mbs``
+        chunks the batch so a ref_inf that does not fit HBM at once
+        still runs (reference microbatch contract)."""
+        has_mask = ("packed_logits_mask" in input_.keys and
+                    input_.data.get("packed_logits_mask") is not None)
+        pieces = []
+        # split() is contiguous and order-preserving: chunk outputs
+        # concatenate back into the input order.
+        for chunk in common.split_minibatches(input_, n_mbs or 1):
+            seqlens = common.flat_seqlens(chunk)
+            sb = common.build_stream_batch(
+                seqlens,
+                token_keys=dict(input_ids=chunk.data["packed_input_ids"]),
+                n_streams=model.engine.ctx.dp_size)
+            lmask = None
+            if has_mask:
+                # stored True=masked-out; engine wants True=allowed
+                allowed = ~chunk.data["packed_logits_mask"]
+                lmask = packing.pack_tokens(sb.info, allowed, fill=True)
+            lp = np.asarray(model.engine.forward_logprobs(
+                sb.arrays["input_ids"], sb.arrays["seg_ids"],
+                temperature=self.gconfig.temperature, logits_mask=lmask))
+            pieces.append(packing.unpack_tokens(
+                sb.info, lp, seqlens=[l - 1 for l in seqlens]))
+        flat_lp = np.concatenate(pieces)
         # Preserve per-element nesting (GRPO groups several sequences
         # inside one batch element).
         nested_m1 = [[l - 1 for l in lens]
@@ -287,23 +295,37 @@ class PPOActorInterface(model_api.ModelInterface):
                 logprobs=lp, old_logprobs=mb["old_logp"],
                 advantages=mb["advantages"], eps_clip=eps_clip,
                 loss_mask=mb["loss_mask"] > 0)
-            scale = jnp.ones(())
+            # Early stop SKIPS the whole optimizer update (reference
+            # semantics) via the engine's reserved stat -- a zeroed
+            # loss would still apply AdamW weight decay and MoE aux
+            # gradients.
+            skip = jnp.zeros(())
             if early_imp is not None:
-                scale = scale * (stats["importance_weight"] <= early_imp)
+                skip = jnp.maximum(
+                    skip, (stats["importance_weight"] > early_imp)
+                    .astype(jnp.float32))
             if early_kl is not None:
-                scale = scale * (stats["approx_kl"] <= early_kl)
-            return loss * scale + sum(aux.values()), dict(
+                skip = jnp.maximum(
+                    skip, (stats["approx_kl"] > early_kl)
+                    .astype(jnp.float32))
+            out_stats = dict(
                 actor_loss=loss,
                 ppo_approx_kl=stats["approx_kl"],
                 actor_clip_ratio=stats["clip_ratio"],
                 importance_weight=stats["importance_weight"], **aux)
+            if early_imp is not None or early_kl is not None:
+                out_stats["__skip_update__"] = skip
+            return loss + sum(aux.values()), out_stats
 
-        all_stats = []
-        for minibatch in mbs:
+        loss_key = ("ppo_actor", has_mask, temperature, eps_clip,
+                    early_kl, early_imp)
+
+        def build_sb(minibatch):
             mb_lens = common.flat_seqlens(minibatch)
-            token_keys = dict(input_ids=minibatch.data["packed_input_ids"])
             sb = common.build_stream_batch(
-                mb_lens, token_keys=token_keys,
+                mb_lens,
+                token_keys=dict(
+                    input_ids=minibatch.data["packed_input_ids"]),
                 shifted_keys=dict(
                     advantages=minibatch.data["advantages"],
                     old_logp=minibatch.data["old_logp"],
@@ -314,10 +336,16 @@ class PPOActorInterface(model_api.ModelInterface):
                 sb.arrays["logits_mask"] = packing.pack_tokens(
                     sb.info, ~minibatch.data["packed_logits_mask"],
                     fill=True)
-            stats = engine.train_batch(
-                [sb.arrays], loss_fn, loss_weights=[sb.n_tokens],
-                loss_fn_key=f"ppo_actor-{has_mask}")
-            all_stats.append(stats)
+            return sb
+
+        # MFCDef.n_mbs: memory microbatching WITHIN each PPO minibatch
+        # -- gradients accumulate over n_mbs scanned microbatches in a
+        # single optimizer step.
+        all_stats = [
+            common.run_train_microbatched(engine, minibatch, build_sb,
+                                          loss_fn, loss_key, n_mbs)
+            for minibatch in mbs
+        ]
         model.inc_version()
 
         agg = {k: float(np.mean([s[k] for s in all_stats]))
@@ -363,17 +391,21 @@ class PPOCriticInterface(model_api.ModelInterface):
     def inference(self, model: model_api.Model, input_: SequenceSample,
                   n_mbs: Optional[int] = None) -> SequenceSample:
         """Produce values for every token (reference
-        PPOCriticInterface.inference)."""
-        seqlens = common.flat_seqlens(input_)
-        sb = common.build_stream_batch(
-            seqlens,
-            token_keys=dict(input_ids=input_.data["packed_input_ids"]),
-            n_streams=model.engine.ctx.dp_size)
-        values = np.asarray(model.engine.forward_values(
-            sb.arrays["input_ids"], sb.arrays["seg_ids"]))
-        flat = packing.unpack_tokens(sb.info, values)
+        PPOCriticInterface.inference). ``n_mbs`` chunks the batch for
+        HBM headroom."""
+        pieces = []
+        for chunk in common.split_minibatches(input_, n_mbs or 1):
+            seqlens = common.flat_seqlens(chunk)
+            sb = common.build_stream_batch(
+                seqlens,
+                token_keys=dict(input_ids=chunk.data["packed_input_ids"]),
+                n_streams=model.engine.ctx.dp_size)
+            values = np.asarray(model.engine.forward_values(
+                sb.arrays["input_ids"], sb.arrays["seg_ids"]))
+            pieces.append(packing.unpack_tokens(sb.info, values))
+        flat = np.concatenate(pieces)
         return SequenceSample.from_default(
-            ids=input_.ids, seqlens=seqlens,
+            ids=input_.ids, seqlens=common.flat_seqlens(input_),
             data=dict(values=flat.astype(np.float32)))
 
     def train_step(self, model: model_api.Model, input_: SequenceSample,
@@ -459,22 +491,25 @@ class PPOCriticInterface(model_api.ModelInterface):
                 value_loss=loss,
                 value_clip_ratio=stats["value_clip_ratio"], **aux)
 
-        all_stats = []
-        for minibatch in mbs:
+        def build_sb(minibatch):
             mb_lens = common.flat_seqlens(minibatch)
-            sb = common.build_stream_batch(
+            return common.build_stream_batch(
                 mb_lens,
-                token_keys=dict(input_ids=minibatch.data["packed_input_ids"]),
+                token_keys=dict(
+                    input_ids=minibatch.data["packed_input_ids"]),
                 shifted_keys=dict(
                     returns=minibatch.data["returns"],
                     old_values=minibatch.data["old_logp"],
                     loss_mask=minibatch.data["ppo_loss_mask"]
                     .astype(np.float32)),
                 n_streams=engine.ctx.dp_size)
-            stats = engine.train_batch(
-                [sb.arrays], loss_fn, loss_weights=[sb.n_tokens],
-                loss_fn_key="ppo_critic")
-            all_stats.append(stats)
+
+        all_stats = [
+            common.run_train_microbatched(engine, minibatch, build_sb,
+                                          loss_fn, ("ppo_critic", eps),
+                                          n_mbs)
+            for minibatch in mbs
+        ]
         model.inc_version()
 
         agg = {k: float(np.mean([s[k] for s in all_stats]))

@@ -68,10 +68,17 @@ class SFTInterface(model_api.ModelInterface):
                     prompt_mask=mb.data["prompt_mask"]),
                 n_streams=engine.ctx.dp_size))
         batches = common.pad_stream_batches(batches)
+        # weight by ANSWER tokens (what each microbatch loss averages
+        # over), so grad accumulation equals the one-big-batch gradient
+        weights = [float((~b.arrays["prompt_mask"].astype(bool)
+                          & (b.arrays["seg_ids"] != 0)).sum())
+                   for b in batches]
+        if not any(w > 0 for w in weights):
+            weights = [float(b.n_tokens) for b in batches]
         stats = engine.train_batch(
             [b.arrays for b in batches],
             _make_loss_fn(model.config, engine.attention_fn),
-            loss_weights=[b.n_tokens for b in batches], loss_fn_key="sft")
+            loss_weights=weights, loss_fn_key="sft")
         model.inc_version()
         return stats
 

@@ -301,3 +301,128 @@ class TestGRPO:
         assert abs(stats["importance_weight"] - 1.0) < 0.05
         assert stats["grpo_kl"] >= -1e-5  # unbiased KL estimate >= 0
         assert actor.version.global_step == 1
+
+
+def _ppo_sample(actor_itf, actor, critic_itf, critic, ref, rw, rw_itf, rng):
+    """Run the PPO data-collection phase and return the train sample."""
+    batch = prompt_batch(rng)
+    sample = actor_itf.generate(actor, batch)
+    sample.update_(rw_itf.inference(rw, sample.select(["packed_input_ids"])))
+    sample.update_(actor_itf.inference(ref, sample.select(
+        ["packed_input_ids"])))
+    sample.update_(critic_itf.inference(critic, sample.select(
+        ["packed_input_ids"])))
+    return sample
+
+
+class TestPPOMicrobatching:
+    """MFCDef.n_mbs memory-microbatching on the RLHF path (reference
+    model_api.py:305-463 microbatch contract)."""
+
+    def _run(self, n_mbs, seed=0):
+        gconfig = GenerationHyperparameters(
+            max_new_tokens=8, min_new_tokens=1, force_no_logits_mask=True)
+        actor = build_model("actor", lr=1e-4, seed=0)
+        critic = build_model("critic", is_critic=True, lr=1e-4, seed=1)
+        ref = build_model("ref", seed=0)
+        rw = build_model("rw", is_critic=True, seed=2)
+        actor_itf = PPOActorInterface(n_minibatches=2, gconfig=gconfig,
+                                      adv_norm=True)
+        critic_itf = PPOCriticInterface(n_minibatches=2)
+        rng = np.random.default_rng(seed)
+        sample = _ppo_sample(actor_itf, actor, critic_itf, critic, ref,
+                             rw, PairedRewardInterface(), rng)
+        a = actor_itf.train_step(actor, sample, n_mbs=n_mbs)
+        c = critic_itf.train_step(critic, sample.select(
+            ["packed_input_ids", "packed_logprobs", "packed_ref_logprobs",
+             "prompt_mask", "rewards", "values", "seq_no_eos_mask"]),
+            n_mbs=n_mbs)
+        return a, c
+
+    def test_n_mbs_4_close_to_1(self):
+        a1, c1 = self._run(n_mbs=1)
+        a4, c4 = self._run(n_mbs=4)
+        # grad accumulation over 4 scanned microbatches ~ one big batch
+        assert np.isclose(a1["actor_loss"], a4["actor_loss"],
+                          rtol=0.05, atol=5e-3), (a1, a4)
+        assert np.isclose(c1["value_loss"], c4["value_loss"],
+                          rtol=0.05, atol=5e-3), (c1, c4)
+        assert np.isclose(a1["importance_weight"], a4["importance_weight"],
+                          rtol=0.02)
+
+
+class TestPPOEarlyStop:
+
+    def test_tripped_early_stop_skips_update(self):
+        gconfig = GenerationHyperparameters(
+            max_new_tokens=6, min_new_tokens=1, force_no_logits_mask=True)
+        actor = build_model("actor", lr=1e-2, seed=0)
+        critic = build_model("critic", is_critic=True, seed=1)
+        ref = build_model("ref", seed=0)
+        rw = build_model("rw", is_critic=True, seed=2)
+        # importance ratio ~= 1 on the first update, so a tiny
+        # threshold always trips
+        actor_itf = PPOActorInterface(
+            n_minibatches=1, gconfig=gconfig,
+            early_stop_imp_ratio=1e-6)
+        rng = np.random.default_rng(0)
+        sample = _ppo_sample(actor_itf, actor, PPOCriticInterface(),
+                             critic, ref, rw, PairedRewardInterface(), rng)
+        before = jax.tree.map(lambda x: np.array(x, copy=True), actor.engine.params)
+        stats = actor_itf.train_step(actor, sample)
+        after = jax.tree.map(lambda x: np.array(x, copy=True), actor.engine.params)
+        assert stats["early_stop_skipped"] == 1.0
+        # the optimizer update was DISCARDED: weights bit-identical
+        # (a zeroed loss would still have applied weight decay)
+        for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(b, a)
+
+    def test_untripped_early_stop_updates(self):
+        gconfig = GenerationHyperparameters(
+            max_new_tokens=6, min_new_tokens=1, force_no_logits_mask=True)
+        actor = build_model("actor", lr=1e-2, seed=0)
+        critic = build_model("critic", is_critic=True, seed=1)
+        ref = build_model("ref", seed=0)
+        rw = build_model("rw", is_critic=True, seed=2)
+        actor_itf = PPOActorInterface(
+            n_minibatches=1, gconfig=gconfig,
+            early_stop_imp_ratio=1e6)
+        rng = np.random.default_rng(0)
+        sample = _ppo_sample(actor_itf, actor, PPOCriticInterface(),
+                             critic, ref, rw, PairedRewardInterface(), rng)
+        before = jax.tree.map(lambda x: np.array(x, copy=True), actor.engine.params)
+        stats = actor_itf.train_step(actor, sample)
+        after = jax.tree.map(lambda x: np.array(x, copy=True), actor.engine.params)
+        assert stats["early_stop_skipped"] == 0.0
+        changed = any(
+            not np.array_equal(b, a)
+            for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)))
+        assert changed
+
+
+class TestGRPOSemantics:
+
+    def test_discount_and_clip(self):
+        """GRPO honors `discount` (per-token decay) and clips the
+        NORMALIZED advantage (reference grpo_interface.py:379)."""
+        from realhf_tpu.interfaces.grpo import GRPOInterface
+
+        gconfig = GenerationHyperparameters(
+            max_new_tokens=6, min_new_tokens=1, force_no_logits_mask=True)
+        actor = build_model("actor", lr=1e-4, seed=0)
+        ref = build_model("ref", seed=0)
+        rw = build_model("rw", is_critic=True, seed=2)
+        itf = GRPOInterface(n_minibatches=1, gconfig=gconfig,
+                            group_size=4, discount=0.9,
+                            max_reward_clip=0.5, adv_norm=False)
+        rng = np.random.default_rng(0)
+        batch = prompt_batch(rng, n=4)
+        sample = itf.generate(actor, batch)
+        sample.update_(PairedRewardInterface().inference(
+            rw, sample.select(["packed_input_ids"])))
+        sample.update_(itf.inference(ref, sample.select(
+            ["packed_input_ids"])))
+        stats = itf.train_step(actor, sample, n_mbs=2)
+        assert np.isfinite(stats["grpo_loss"])
+        assert abs(stats["importance_weight"] - 1.0) < 0.05
+        assert actor.version.global_step == 1
