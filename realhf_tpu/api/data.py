@@ -292,6 +292,22 @@ class SequenceSample:
                 f"meta_only={self.data is None})")
 
 
+def drop_ids(batch: "SequenceSample", skip_ids) -> Optional["SequenceSample"]:
+    """Remove the batch elements whose id is in ``skip_ids`` (resume:
+    data already consumed in the interrupted epoch, reference
+    master_worker.py:762-768). Returns None when nothing survives."""
+    skip = set(skip_ids)
+    if not skip:
+        return batch
+    keep = [i for i, x in enumerate(batch.ids) if x not in skip]
+    if not keep:
+        return None
+    if len(keep) == batch.bs:
+        return batch
+    parts = batch.unpack()
+    return SequenceSample.gather([parts[i] for i in keep])
+
+
 # ----------------------------------------------------------------------
 # Dataset registry and loading utilities.
 # ----------------------------------------------------------------------

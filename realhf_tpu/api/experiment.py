@@ -63,3 +63,27 @@ class ExperimentSpec:
     seed: int = 1
     ctl: SaveEvalControl = dataclasses.field(default_factory=SaveEvalControl)
     eval_dataset: Optional[DatasetAbstraction] = None
+    # --- distributed runtime (mode=distributed) -----------------------
+    # Number of model-worker processes; each owns its own device set
+    # and the roles assigned to it (reference: ModelWorker per GPU;
+    # on TPU one worker per host-slice).
+    n_model_workers: int = 1
+    # role -> model worker index; unassigned roles land on worker 0.
+    worker_assignment: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    # Buffer capacity: how many dataset batches may be in flight at
+    # once (>=2 lets MFCs of consecutive steps overlap on disjoint
+    # meshes; reference AsyncIOSequenceBuffer pipelining).
+    max_concurrent_batches: int = 2
+    # How many steps a non-train MFC may run ahead of its role's train
+    # MFC (reference master_worker.py:503-509 staleness guard).
+    max_head_offpolicyness: int = 0
+    # Auto-resolve OffloadHooks: non-trainable roles (ref/reward) move
+    # their weights to host after their last MFC of a step, freeing
+    # HBM for the train MFCs, and reload on next use (reference
+    # resolve_rpc_hooks, experiments/common/utils.py:143 +
+    # model_worker.py:542-552).
+    auto_offload: bool = False
+
+    def worker_of_role(self, role: str) -> int:
+        return self.worker_assignment.get(role, 0)

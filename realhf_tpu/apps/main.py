@@ -1,0 +1,170 @@
+"""Distributed launcher: spawn, configure, supervise, and recover the
+master + model-worker fleet.
+
+Parity with reference ``realhf/apps/main.py`` (main_start:74,
+main_stop:233, auto-recover recursion :205-230) and the controller
+state machine (``system/controller.py:118``): the launcher process
+doubles as the controller -- it submits worker processes through a
+scheduler, pushes configs over the WorkerControlPanel, starts
+everyone, watches the master's experiment status, and on worker
+failure relaunches the whole trial with ``recover_count + 1`` (up to
+``recover_retries``) in resume mode.
+"""
+
+import os
+import pickle
+import sys
+import time
+from typing import Dict, Optional
+
+from realhf_tpu.api.experiment import ExperimentSpec
+from realhf_tpu.base import constants, logging, name_resolve, names
+from realhf_tpu.system.scheduler import JobException, make_scheduler
+from realhf_tpu.system.worker_base import (
+    WorkerControlPanel,
+    WorkerServerStatus,
+)
+
+logger = logging.getLogger("main", "benchmark")
+
+
+def _worker_cmd(worker_type: str, index: int, spec: ExperimentSpec):
+    return [
+        sys.executable, "-m", "realhf_tpu.apps.remote", "worker",
+        "--worker_type", worker_type, "--index", str(index),
+        "--experiment_name", spec.experiment_name,
+        "--trial_name", spec.trial_name,
+    ]
+
+
+def _spec_path(spec: ExperimentSpec) -> str:
+    d = constants.run_log_path()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "experiment_spec.pkl")
+
+
+def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
+              env: Optional[Dict[str, str]] = None,
+              timeout: float = 3600.0) -> Dict:
+    """One trial attempt: spawn workers, run to completion, tear down.
+    Raises JobException/TimeoutError on worker failure (the caller's
+    recover loop relaunches)."""
+    constants.set_experiment_trial_names(spec.experiment_name,
+                                         spec.trial_name)
+    path = _spec_path(spec)
+    with open(path, "wb") as f:
+        pickle.dump(spec, f)
+
+    # Cross-process rendezvous: the launcher and every worker share an
+    # NFS name_resolve root (reference main.py name_resolve setup).
+    record_root = os.path.join(constants.run_log_path(), "name_resolve")
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    env = dict(env or {})
+    env.setdefault("REALHF_TPU_NAME_RESOLVE_ROOT", record_root)
+    env.setdefault("REALHF_TPU_ROOT", constants.ROOT_DIR)
+
+    worker_names = ([f"model_worker/{i}"
+                     for i in range(spec.n_model_workers)]
+                    + ["master_worker/0"])
+    sched = make_scheduler("local")
+    status_key = names.experiment_status(spec.experiment_name,
+                                         spec.trial_name)
+    try:
+        name_resolve.delete(status_key)
+    except Exception:  # noqa: BLE001 - fresh trial, nothing to delete
+        pass
+
+    try:
+        for i in range(spec.n_model_workers):
+            sched.submit(f"model_worker/{i}",
+                         _worker_cmd("model_worker", i, spec), env=env)
+        sched.submit("master_worker/0",
+                     _worker_cmd("master_worker", 0, spec), env=env)
+
+        panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
+        panel.connect(worker_names, timeout=120)
+        # Master FIRST: model workers' configure blocks waiting for the
+        # master's request-reply stream address in name_resolve.
+        panel.group_request(
+            "configure", worker_names=["master_worker/0"],
+            kwargs=dict(config=dict(spec_path=path,
+                                    recover_mode=recover_mode)))
+        for i in range(spec.n_model_workers):
+            panel.group_request(
+                "configure", worker_names=[f"model_worker/{i}"],
+                kwargs=dict(config=dict(spec_path=path, worker_index=i)))
+        panel.group_request("start")
+        logger.info("All %d workers started.", len(worker_names))
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                status = name_resolve.get(status_key)
+            except name_resolve.NameEntryNotFoundError:
+                status = None
+            if status == "done":
+                break
+            # failure detection: a dead/errored worker fails the trial
+            # (reference scheduler poll -> JobException, main.py:195)
+            for w in worker_names:
+                info = sched.find(w)
+                if info.state.value == "FAILED":
+                    raise JobException(w, info.state)
+                if panel.get_worker_status(w) == WorkerServerStatus.ERROR:
+                    raise JobException(w, info.state)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Trial did not complete within {timeout}s.")
+            time.sleep(0.2)
+
+        stats = panel.group_request("stats",
+                                    worker_names=["master_worker/0"])
+        panel.group_request("exit")
+        sched.wait(timeout=60, check_status=False)
+        return stats["master_worker/0"]
+    finally:
+        sched.stop_all()
+
+
+def main_start(spec: ExperimentSpec, recover_mode: str = "disabled",
+               recover_retries: int = 1,
+               env: Optional[Dict[str, str]] = None,
+               timeout: float = 3600.0) -> Dict:
+    """Launch with the auto-recover loop (reference main.py:205-230):
+    recover_mode=auto relaunches a failed trial in resume mode up to
+    ``recover_retries`` times."""
+    attempt_mode = recover_mode if recover_mode in ("resume", "save") \
+        else ("save" if recover_mode == "auto" else "disabled")
+    recover_count = 0
+    while True:
+        try:
+            return run_trial(spec, recover_mode=attempt_mode, env=env,
+                             timeout=timeout)
+        except (JobException, TimeoutError) as e:
+            recover_count += 1
+            if recover_mode != "auto" or recover_count > recover_retries:
+                raise
+            logger.warning(
+                "Trial failed (%s); auto-recover relaunch %d/%d in "
+                "resume mode.", e, recover_count, recover_retries)
+            attempt_mode = "resume"
+            time.sleep(2)
+
+
+def main_stop(experiment_name: str, trial_name: str):
+    """Best-effort teardown of a running trial (reference
+    main_stop:233): ask every registered worker to exit."""
+    panel = WorkerControlPanel(experiment_name, trial_name)
+    # find_subtree returns KEYS (get_subtree returns values)
+    keys = name_resolve.find_subtree(
+        names.worker_root(experiment_name, trial_name))
+    workers = [k.rsplit("/status/", 1)[-1] for k in keys] if keys else []
+    if not workers:
+        logger.info("No live workers found for %s/%s.", experiment_name,
+                    trial_name)
+        return
+    try:
+        panel.connect(workers, timeout=5)
+        panel.group_request("exit", timeout=10)
+    except Exception as e:  # noqa: BLE001 - best effort
+        logger.warning("main_stop: %s", e)

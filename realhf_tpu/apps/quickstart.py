@@ -47,11 +47,40 @@ def main(argv=None):
 
     logger.info("Running experiment %s: %s", args.experiment, cfg)
     spec = cfg.build()
+    spec.n_model_workers = cfg.n_model_workers
+    spec.worker_assignment = cfg.parsed_worker_assignment()
+    if cfg.allocation_mode == "heuristic":
+        from realhf_tpu.experiments.heuristic import (
+            apply_heuristic_allocations,
+        )
+        # default_devices respects REALHF_TPU_BACKEND and never probes
+        # the default (TPU) backend from the launcher process -- TPU
+        # init here could block and would hold the chip the spawned
+        # workers need.
+        if cfg.n_devices is not None:
+            n = cfg.n_devices
+        elif cfg.mode == "distributed":
+            raise ValueError(
+                "allocation_mode=heuristic with mode=distributed "
+                "requires n_devices=<per-worker chip count> (the "
+                "launcher must not initialize the workers' backend).")
+        else:
+            from realhf_tpu.parallel.mesh import default_devices
+            n = len(default_devices())
+        apply_heuristic_allocations(spec, n)
+        logger.info("Heuristic allocations on %d devices: %s", n,
+                    {k: str(v) for k, v in spec.allocations.items()})
 
-    from realhf_tpu.system.inline import InlineRunner
-    runner = InlineRunner(spec, recover_mode=getattr(cfg, "recover_mode",
-                                                     "disabled"))
-    stats = runner.run()
+    if cfg.mode == "distributed":
+        # master + model-worker processes, concurrent MFCs on disjoint
+        # meshes (reference multi-worker runtime)
+        from realhf_tpu.apps.main import main_start
+        stats = main_start(spec, recover_mode=cfg.recover_mode,
+                           recover_retries=cfg.recover_retries)
+    else:
+        from realhf_tpu.system.inline import InlineRunner
+        runner = InlineRunner(spec, recover_mode=cfg.recover_mode)
+        stats = runner.run()
     logger.info("Experiment complete. Last step stats: %s", stats)
     return stats
 

@@ -1,0 +1,52 @@
+"""Worker bootstrap entrypoint (reference ``realhf/apps/remote.py``):
+the scheduler launches ``python -m realhf_tpu.apps.remote worker
+--worker_type {master_worker|model_worker} --index I ...`` processes;
+each runs its Worker poll loop until the controller sends exit.
+"""
+
+import argparse
+import os
+
+
+def main_worker(args):
+    # Backend selection must happen before jax initializes. Workers in
+    # CPU tests are spawned with REALHF_TPU_BACKEND=cpu.
+    if os.environ.get("REALHF_TPU_BACKEND") == "cpu":
+        from realhf_tpu.base.backend import force_cpu_backend
+        force_cpu_backend()
+
+    from realhf_tpu.base import name_resolve
+
+    if os.environ.get("REALHF_TPU_NAME_RESOLVE_ROOT"):
+        name_resolve.reconfigure(
+            "nfs", record_root=os.environ["REALHF_TPU_NAME_RESOLVE_ROOT"])
+
+    if args.worker_type == "model_worker":
+        from realhf_tpu.system.model_worker import ModelWorker
+        cls = ModelWorker
+        name = f"model_worker/{args.index}"
+    elif args.worker_type == "master_worker":
+        from realhf_tpu.system.master_worker import MasterWorker
+        cls = MasterWorker
+        name = "master_worker/0"
+    else:
+        raise ValueError(args.worker_type)
+    cls(args.experiment_name, args.trial_name, name).run()
+
+
+def main():
+    parser = argparse.ArgumentParser("realhf_tpu remote entry")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    w = sub.add_parser("worker")
+    w.add_argument("--worker_type", required=True,
+                   choices=["model_worker", "master_worker"])
+    w.add_argument("--index", type=int, default=0)
+    w.add_argument("--experiment_name", required=True)
+    w.add_argument("--trial_name", required=True)
+    args = parser.parse_args()
+    if args.cmd == "worker":
+        main_worker(args)
+
+
+if __name__ == "__main__":
+    main()

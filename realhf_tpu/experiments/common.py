@@ -66,9 +66,30 @@ class CommonExperimentConfig:
     eval_freq_epochs: Optional[int] = None
     eval_freq_steps: Optional[int] = None
     benchmark_steps: Optional[int] = None
-    # disabled | resume (reference recover_mode, common.py:70-82; "save"
-    # behavior -- dumping recover info -- is implied by resume)
+    # disabled | resume | auto (reference recover_mode, common.py:70-82;
+    # "save" behavior -- dumping recover info -- is implied by resume;
+    # "auto" additionally relaunches failed distributed trials)
     recover_mode: str = "disabled"
+    recover_retries: int = 1
+    # inline (single process) | distributed (master + model workers)
+    mode: str = "inline"
+    # manual (per-MFC *_alloc flags / role parallel configs) |
+    # heuristic (size-based decoupled layouts, reference
+    # ppo_exp.py:419; requires n_devices)
+    allocation_mode: str = "manual"
+    n_devices: Optional[int] = None
+    n_model_workers: int = 1
+    # "role:workerIdx,role:workerIdx" -- which model worker hosts each
+    # role in distributed mode (unlisted roles land on worker 0)
+    worker_assignment: str = ""
+
+    def parsed_worker_assignment(self) -> Dict[str, int]:
+        out = {}
+        if self.worker_assignment:
+            for part in self.worker_assignment.split(","):
+                role, idx = part.split(":")
+                out[role.strip()] = int(idx)
+        return out
 
     def ctl(self) -> SaveEvalControl:
         return SaveEvalControl(

@@ -1,0 +1,149 @@
+"""Heuristic per-MFC allocation (allocation_mode=heuristic).
+
+TPU-native counterpart of the reference's heuristic allocation
+(``realhf/experiments/common/ppo_exp.py:419``): given the device count
+and each role's model size, choose a decoupled layout per MFC without
+running the MCMC search. The reference's rules-of-thumb translated to
+TPU terms:
+
+- **train MFCs** run on the role's primary layout: TP just big enough
+  that weights + optimizer state (Adam: ~16 bytes/param fp32 m/v +
+  master copy) fit comfortably in one chip's HBM, all remaining
+  devices go to DP (grad accumulation handles batch; DP maximizes MXU
+  utilization on TPU -- PP is intentionally not chosen, SURVEY §7).
+- **generate MFCs** prefer wide DP with minimal TP (decode is
+  HBM-bandwidth bound and batch-parallel; TP collectives per token are
+  pure overhead at small per-chip batch): TP = weights-fit minimum.
+- **inference MFCs** (reward/ref scoring) size TP to fit weights in
+  bf16 (no optimizer), rest DP.
+
+All sizes are derived from ``TransformerConfig.n_params()``; the
+layout is returned as {mfc_name: ParallelismConfig} plus the per-role
+primary, mirroring the (RPCAllocation, MFCConfig) output of the
+reference.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from realhf_tpu.api.config import ModelInterfaceType
+from realhf_tpu.api.dfg import MFCDef
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+# Per-chip HBM budget in bytes (v5e: 16 GiB; leave headroom for
+# activations and XLA workspace).
+DEFAULT_HBM_BUDGET = int(16 * 1024 ** 3 * 0.6)
+
+
+def _model_config_of(spec) -> TransformerConfig:
+    """Config WITHOUT loading weights (sizes only)."""
+    if spec.random_init_config is not None:
+        return TransformerConfig(**spec.random_init_config,
+                                 is_critic=spec.is_critic)
+    from realhf_tpu.models.hf.registry import config_from_hf, detect_family
+    family = spec.hf_family or detect_family(spec.path)
+    with open(os.path.join(spec.path, "config.json")) as f:
+        hf_config = json.load(f)
+    return config_from_hf(family, hf_config, is_critic=spec.is_critic)
+
+
+def _pow2_up_to(n: int) -> List[int]:
+    out, p = [], 1
+    while p <= n:
+        out.append(p)
+        p *= 2
+    return out
+
+
+def _min_tp(param_bytes: float, n_devices: int,
+            hbm_budget: int) -> int:
+    for tp in _pow2_up_to(n_devices):
+        if param_bytes / tp <= hbm_budget:
+            return tp
+    return n_devices
+
+
+def choose_layout(cfg: TransformerConfig, n_devices: int,
+                  interface_type: ModelInterfaceType,
+                  trainable: bool,
+                  hbm_budget: int = DEFAULT_HBM_BUDGET
+                  ) -> ParallelismConfig:
+    """One MFC's layout on ``n_devices`` chips."""
+    n_params = cfg.n_params()
+    if trainable:
+        # bf16 weights + fp32 master + Adam m/v (fp32): ~18 B/param
+        bytes_needed = n_params * 18
+    elif interface_type == ModelInterfaceType.GENERATE:
+        # bf16 weights + KV cache headroom
+        bytes_needed = n_params * 2 * 1.5
+    else:
+        bytes_needed = n_params * 2 * 1.2
+    tp = _min_tp(bytes_needed, n_devices, hbm_budget)
+    dp = max(1, n_devices // tp)
+    return ParallelismConfig(
+        data_parallel_size=dp, tensor_parallel_size=tp,
+        sequence_parallel=tp > 1 and trainable)
+
+
+def heuristic_allocations(
+    spec, n_devices: int,
+    hbm_budget: int = DEFAULT_HBM_BUDGET,
+) -> Tuple[Dict[str, ParallelismConfig], Dict[str, ParallelismConfig]]:
+    """(per-role primary layouts, per-MFC overrides) for an
+    ExperimentSpec on ``n_devices`` chips.
+
+    The primary layout of a role is its train MFC's layout when one
+    exists (replicas have no optimizer), else its widest-TP MFC.
+    MFC overrides are emitted only when they differ from the primary
+    (each override creates a weight replica + realloc, reference
+    resolve_replica_ids).
+    """
+    cfgs = {role: _model_config_of(ms) for role, ms in spec.models.items()}
+    trainable_roles = {
+        n.role for n in spec.mfcs
+        if n.interface_type == ModelInterfaceType.TRAIN_STEP}
+
+    mfc_layouts: Dict[str, ParallelismConfig] = {}
+    for node in spec.mfcs:
+        trainable = (node.interface_type == ModelInterfaceType.TRAIN_STEP)
+        mfc_layouts[node.name] = choose_layout(
+            cfgs[node.role], n_devices, node.interface_type,
+            trainable, hbm_budget)
+
+    primaries: Dict[str, ParallelismConfig] = {}
+    for role in spec.models:
+        role_nodes = [n for n in spec.mfcs if n.role == role]
+        train = [n for n in role_nodes
+                 if n.interface_type == ModelInterfaceType.TRAIN_STEP]
+        if train:
+            primaries[role] = mfc_layouts[train[0].name]
+        elif role_nodes:
+            primaries[role] = max(
+                (mfc_layouts[n.name] for n in role_nodes),
+                key=lambda p: p.tensor_parallel_size)
+        else:
+            primaries[role] = ParallelismConfig(
+                data_parallel_size=n_devices)
+
+    overrides = {
+        n.name: mfc_layouts[n.name] for n in spec.mfcs
+        if not mfc_layouts[n.name].same_layout(primaries[n.role])
+    }
+    return primaries, overrides
+
+
+def apply_heuristic_allocations(spec, n_devices: int,
+                                hbm_budget: int = DEFAULT_HBM_BUDGET):
+    """Mutate an ExperimentSpec in place: set each role's primary
+    parallelism and the per-MFC allocation overrides."""
+    primaries, overrides = heuristic_allocations(spec, n_devices,
+                                                 hbm_budget)
+    for role, par in primaries.items():
+        spec.models[role] = dataclasses.replace(spec.models[role],
+                                                parallel=par)
+    spec.allocations = dict(spec.allocations)
+    spec.allocations.update(overrides)
+    return spec

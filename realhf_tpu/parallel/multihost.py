@@ -1,0 +1,114 @@
+"""Multi-host bootstrap: one JAX distributed runtime per worker fleet.
+
+TPU-native counterpart of reference ``impl/model/comm/global_comm.py``
+(setup_global_comm:44): there, peers discover each other through
+name_resolve, rank 0 publishes a ``tcp://ip:port``, and
+``torch.distributed.init_process_group`` builds the NCCL world. Here
+the same rendezvous feeds ``jax.distributed.initialize``: every host
+process registers under ``names.distributed_peer``, ranks are the
+sorted registration order, rank 0 publishes the coordinator address
+under ``names.distributed_master``, and after initialize()
+``jax.devices()`` spans every host -- a single Mesh over ICI+DCN, with
+XLA inserting cross-host collectives (SURVEY §5.8).
+
+Emulated multi-host testing works on CPU: N OS processes each with
+``xla_force_host_platform_device_count`` virtual devices form one
+2N-device world over gRPC (the ``LocalMultiProcessTest`` pattern,
+reference base/testing.py:112).
+"""
+
+import socket
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+from realhf_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("multihost")
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _peer_root(experiment_name: str, trial_name: str, group: str) -> str:
+    return names.distributed_peer(experiment_name, trial_name, group)
+
+
+def rendezvous(experiment_name: str, trial_name: str, n_processes: int,
+               group: str = "global", timeout: float = 300.0
+               ) -> Tuple[int, str]:
+    """Register this process; return (process_id, coordinator_address).
+
+    Mirrors the reference's peer discovery (global_comm.py:56-101):
+    ranks are the sorted order of registered peer keys; rank 0 binds a
+    free port and publishes the coordinator address.
+    """
+    root = _peer_root(experiment_name, trial_name, group)
+    my_token = uuid.uuid4().hex
+    name_resolve.add(f"{root}/{my_token}", network.gethostip(),
+                     delete_on_exit=True)
+
+    deadline = time.monotonic() + timeout
+    while True:
+        peers: List[str] = name_resolve.find_subtree(root)
+        if len(peers) >= n_processes:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"Only {len(peers)}/{n_processes} peers registered "
+                f"under {root}.")
+        time.sleep(0.1)
+    if len(peers) > n_processes:
+        raise RuntimeError(
+            f"{len(peers)} peers registered for a {n_processes}-process "
+            f"group {group} -- stale trial state? clear_subtree first.")
+
+    process_id = sorted(peers).index(f"{root}/{my_token}")
+    master_key = names.distributed_master(experiment_name, trial_name,
+                                          group)
+    if process_id == 0:
+        addr = f"{network.gethostip()}:{find_free_port()}"
+        name_resolve.add(master_key, addr, replace=True,
+                         delete_on_exit=True)
+    else:
+        addr = name_resolve.wait(master_key, timeout=timeout)
+    return process_id, addr
+
+
+def initialize_multihost(experiment_name: str, trial_name: str,
+                         n_processes: int, group: str = "global",
+                         local_device_count: Optional[int] = None,
+                         timeout: float = 300.0) -> int:
+    """Join the distributed runtime; returns this process's id.
+
+    After this call ``jax.devices()`` lists every host's devices and
+    Meshes may span hosts (collectives ride ICI within a host-slice
+    and DCN across; reference NCCL world, global_comm.py:124-127).
+    """
+    import jax
+
+    if n_processes <= 1:
+        return 0
+    process_id, addr = rendezvous(experiment_name, trial_name,
+                                  n_processes, group, timeout)
+    kwargs = dict(coordinator_address=addr, num_processes=n_processes,
+                  process_id=process_id)
+    if local_device_count is not None:
+        kwargs["local_device_ids"] = list(range(local_device_count))
+    jax.distributed.initialize(**kwargs)
+    logger.info("jax.distributed initialized: process %d/%d, "
+                "coordinator %s, %d global devices.", process_id,
+                n_processes, addr, jax.device_count())
+    return process_id
+
+
+def shutdown_multihost():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # noqa: BLE001 - best effort on teardown
+        logger.warning("jax.distributed.shutdown: %s", e)

@@ -1,0 +1,146 @@
+"""Host-level data plane between model workers.
+
+TPU-native counterpart of reference ``impl/model/comm/
+data_transfer.py``: there, MFC outputs move producer->consumer over
+NCCL broadcast groups. Here every worker runs a small threaded data
+server; a consumer worker fetches the per-sequence pieces it needs by
+(ids, keys) over ZMQ (the host/DCN relay of SURVEY §5.8 -- device
+tensors were already pulled to host as numpy when the producing MFC
+stored its output). Device-to-device transfer inside one worker's mesh
+never touches this path; cross-host device meshes use
+``jax.distributed`` (``parallel/multihost.py``).
+
+The server thread only ever reads the store; writes happen in the
+worker's poll thread. A lock guards the dict itself (values are
+immutable once inserted).
+"""
+
+import pickle
+import threading
+from typing import Dict, Hashable, List, Tuple
+
+import zmq
+
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("data_plane")
+
+
+def data_server_key(experiment_name: str, trial_name: str,
+                    worker_name: str) -> str:
+    return (names.trial_root(experiment_name, trial_name)
+            + f"/data_server/{worker_name}")
+
+
+class DataStore:
+    """id -> single-sequence SequenceSample (all keys merged in).
+
+    The worker's storage of MFC inputs/outputs (reference
+    ``model_worker.__data_storage``, model_worker.py:368-399).
+    """
+
+    def __init__(self):
+        self._store: Dict[Hashable, SequenceSample] = {}
+        self._lock = threading.Lock()
+
+    def put(self, sample: SequenceSample):
+        """Merge a (possibly multi-sequence) sample into the store."""
+        for piece in sample.unpack():
+            sid = piece.ids[0]
+            with self._lock:
+                cur = self._store.get(sid)
+                if cur is None:
+                    self._store[sid] = piece
+                else:
+                    cur.update_(piece)
+
+    def get(self, ids: List[Hashable], keys: List[str]
+            ) -> SequenceSample:
+        with self._lock:
+            pieces = [self._store[i].select(list(keys)) for i in ids]
+        return SequenceSample.gather(pieces)
+
+    def has(self, sid: Hashable, keys: List[str]) -> bool:
+        with self._lock:
+            s = self._store.get(sid)
+            return s is not None and all(k in s.keys for k in keys)
+
+    def clear(self, ids: List[Hashable]):
+        with self._lock:
+            for i in ids:
+                self._store.pop(i, None)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._store)
+
+
+class DataServer(threading.Thread):
+    """Replies to (ids, keys) fetches from the worker's DataStore."""
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 worker_name: str, store: DataStore):
+        super().__init__(daemon=True, name=f"data-server-{worker_name}")
+        self.store = store
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        port = self._sock.bind_to_random_port("tcp://*")
+        self.address = f"tcp://{network.gethostip()}:{port}"
+        name_resolve.add(
+            data_server_key(experiment_name, trial_name, worker_name),
+            self.address, replace=True)
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.is_set():
+            if not self._sock.poll(100):
+                continue
+            ids, keys = pickle.loads(self._sock.recv())
+            try:
+                payload = self.store.get(ids, keys)
+                self._sock.send(pickle.dumps(("ok", payload)))
+            except Exception as e:  # noqa: BLE001 - reply, don't die
+                self._sock.send(pickle.dumps(("error", repr(e))))
+
+    def stop(self):
+        self._stop_evt.set()
+        self.join(timeout=2)
+        self._sock.close(0)
+
+
+class DataClient:
+    """Fetch-side cache of connections to peer data servers."""
+
+    def __init__(self, experiment_name: str, trial_name: str):
+        self._exp, self._trial = experiment_name, trial_name
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+
+    def _sock_for(self, worker_name: str) -> zmq.Socket:
+        if worker_name not in self._socks:
+            addr = name_resolve.wait(
+                data_server_key(self._exp, self._trial, worker_name),
+                timeout=60)
+            s = self._ctx.socket(zmq.REQ)
+            s.connect(addr)
+            self._socks[worker_name] = s
+        return self._socks[worker_name]
+
+    def fetch(self, worker_name: str, ids: List[Hashable],
+              keys: List[str], timeout: float = 300.0) -> SequenceSample:
+        s = self._sock_for(worker_name)
+        s.send(pickle.dumps((list(ids), list(keys))))
+        if not s.poll(timeout * 1000):
+            raise TimeoutError(
+                f"Data fetch from {worker_name} timed out "
+                f"({len(ids)} ids, keys={keys}).")
+        status, payload = pickle.loads(s.recv())
+        if status != "ok":
+            raise RuntimeError(
+                f"Data fetch from {worker_name} failed: {payload}")
+        return payload
+
+    def close(self):
+        for s in self._socks.values():
+            s.close(0)

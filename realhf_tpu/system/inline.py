@@ -27,48 +27,9 @@ from realhf_tpu.api.config import ModelInterfaceType, ModelName
 from realhf_tpu.api.dfg import DFG
 from realhf_tpu.api.experiment import ExperimentSpec
 from realhf_tpu.base import constants, logging, recover, seeding, timeutil
-from realhf_tpu.engine.engine import Engine
-from realhf_tpu.models import transformer as T
-from realhf_tpu.models.config import TransformerConfig
-from realhf_tpu.models.hf import load_hf_checkpoint
-from realhf_tpu.parallel.mesh import MeshContext, make_mesh
+from realhf_tpu.system.model_host import ModelHost
 
 logger = logging.getLogger("InlineRunner", "benchmark")
-
-
-def _build_model(role: str, spec, tokenizer, total_steps: int,
-                 devices=None, params_override=None,
-                 cfg_override=None) -> model_api.Model:
-    from realhf_tpu.parallel.mesh import default_devices
-
-    if params_override is not None:
-        # Replica path: reuse the primary's live weights (device_put in
-        # Engine.__init__ reshards them) instead of re-reading the
-        # checkpoint.
-        cfg, params = cfg_override, params_override
-    elif spec.path:
-        cfg, params = load_hf_checkpoint(
-            spec.path, spec.hf_family,
-            is_critic=spec.is_critic or spec.init_critic_from_actor)
-    else:
-        cfg = TransformerConfig(**spec.random_init_config,
-                                is_critic=spec.is_critic)
-        params = None
-    if params_override is None:
-        cfg.gradient_checkpointing = spec.gradient_checkpointing
-        cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
-    if params is None:
-        params = T.init_params(
-            cfg, seeding.derive_key("model_init", role))
-
-    if devices is None:
-        devices = default_devices()[:spec.parallel.world_size]
-    mesh = make_mesh(spec.parallel, devices=devices)
-    ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
-    engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
-                    total_train_steps=total_steps)
-    return model_api.Model(ModelName(role, 0), engine, tokenizer,
-                           hf_family=spec.hf_family)
 
 
 class InlineRunner:
@@ -120,44 +81,8 @@ class InlineRunner:
 
         steps_per_epoch = len(self.dataloader)
         total_steps = steps_per_epoch * spec.total_train_epochs
-        self.models: Dict[str, model_api.Model] = {}
-        for role, mspec in spec.models.items():
-            self.models[role] = _build_model(
-                role, mspec, self.tokenizer, total_steps)
-
-        # Replica engines for MFCs allocated on a different layout than
-        # their role's primary (reference resolve_replica_ids,
-        # experiments/common/utils.py:126). Replicas never own an
-        # optimizer; weights flow from the primary via reallocation.
-        from realhf_tpu.parallel.realloc import ReplicaManager
-        import dataclasses as _dc
-        self.replicas: Dict[str, model_api.Model] = {}
-        self.replica_mgr = ReplicaManager()
-        for node in self.dfg.nodes:
-            alloc = spec.allocations.get(node.name)
-            if alloc is None:
-                continue
-            role = node.role
-            primary = self.models[role]
-            if alloc.same_layout(primary.engine.ctx.parallel):
-                continue
-            if node.interface_type == ModelInterfaceType.TRAIN_STEP:
-                raise ValueError(
-                    f"MFC {node.name}: train MFCs must run on the "
-                    "role's primary layout (replicas have no optimizer).")
-            mspec = _dc.replace(spec.models[role], parallel=alloc,
-                                optimizer=None)
-            self.replicas[node.name] = _build_model(
-                f"{role}-{node.name}", mspec, self.tokenizer, total_steps,
-                params_override=primary.engine.params,
-                cfg_override=primary.config)
-            logger.info("Created replica for %s: %s (primary %s)",
-                        node.name, alloc, primary.engine.ctx.parallel)
-
-        self.interfaces = {}
-        for node in self.dfg.nodes:
-            self.interfaces[node.name] = model_api.make_interface(
-                node.interface_impl)
+        self.host = ModelHost(spec, list(spec.models), self.dfg.nodes,
+                              self.tokenizer, total_steps)
 
         ctl = spec.ctl
         self.save_ctl = timeutil.EpochStepTimeFreqCtl(
@@ -174,6 +99,23 @@ class InlineRunner:
             self._start_epoch = self._recover_info.recover_start.epoch
             self._ids_to_skip = set(self._recover_info.hash_vals_to_ignore)
 
+    # -- compat accessors (tests + callers use these) -------------------
+    @property
+    def models(self):
+        return self.host.models
+
+    @property
+    def replicas(self):
+        return self.host.replicas
+
+    @property
+    def replica_mgr(self):
+        return self.host.replica_mgr
+
+    @property
+    def interfaces(self):
+        return self.host.interfaces
+
     # ------------------------------------------------------------------
     def run_step(self, batch: data_api.SequenceSample) -> Dict[str, Dict]:
         """Execute the full DFG once over one batch; returns per-MFC
@@ -181,27 +123,9 @@ class InlineRunner:
         stats: Dict[str, Dict] = {}
         data = batch
         for node in self.dfg.topological_order():
-            primary = self.models[node.role]
-            model = self.replicas.get(node.name, primary)
-            if model is not primary:
-                # param-realloc pre-hook: refresh the replica's weights
-                # from the trainable primary if it has stepped since.
-                self.replica_mgr.ensure_fresh(node.role, primary, model)
-            itf = self.interfaces[node.name]
             inp = data.select([k for k in node.input_keys if k in data.keys])
-            if node.input_key_remap:
-                inp.remap_keys_(node.input_key_remap)
-            if node.interface_type == ModelInterfaceType.GENERATE:
-                out = itf.generate(model, inp, n_mbs=node.n_mbs)
-            elif node.interface_type == ModelInterfaceType.INFERENCE:
-                out = itf.inference(model, inp, n_mbs=node.n_mbs)
-            elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
-                out = itf.train_step(model, inp, n_mbs=node.n_mbs)
-            else:
-                raise NotImplementedError(node.interface_type)
+            out = self.host.execute(node.name, inp)
             if isinstance(out, data_api.SequenceSample):
-                if node.output_key_remap:
-                    out.remap_keys_(node.output_key_remap)
                 data.update_(out)
             elif isinstance(out, dict):
                 stats[node.name] = out
@@ -262,15 +186,9 @@ class InlineRunner:
                 if self._ids_to_skip:
                     # first epoch after recovery: drop already-consumed
                     # data (reference master_worker.py:762-768)
-                    keep = [i for i, x in enumerate(batch.ids)
-                            if x not in self._ids_to_skip]
-                    if not keep:
+                    batch = data_api.drop_ids(batch, self._ids_to_skip)
+                    if batch is None:
                         continue
-                    if len(keep) < batch.bs:
-                        parts = batch.unpack()
-                        from realhf_tpu.api.data import SequenceSample
-                        batch = SequenceSample.gather(
-                            [parts[i] for i in keep])
                 t0 = time.monotonic()
                 last_stats = self.run_step(batch)
                 dt = time.monotonic() - t0

@@ -1,0 +1,317 @@
+"""Master worker: drives the dataflow graph across model workers.
+
+TPU-native counterpart of reference ``realhf/system/master_worker.py``
+(MasterWorker:841). The reference runs one asyncio coroutine per MFC
+against an AsyncIOSequenceBuffer; here the same dataflow is an explicit
+event-driven state machine stepped from ``_poll``: dispatch data
+fetches and every input-ready MFC (requests carry metadata only), poll
+replies, amend the buffer, account epochs/steps, trigger save/eval,
+and record recover info. MFCs of the same or consecutive steps whose
+models live on different workers execute CONCURRENTLY -- the decoupled
+allocation concurrency that is the reference's core throughput claim.
+
+Off-policyness guard (reference master_worker.py:503-509): an MFC for
+batch k may only dispatch once every train MFC of the same role has
+completed batch k-1-max_head_offpolicyness and earlier.
+"""
+
+import pickle
+import time
+from typing import Dict, Optional
+
+from realhf_tpu.api.config import ModelInterfaceType
+from realhf_tpu.api.dfg import DFG
+from realhf_tpu.base import (
+    constants,
+    logging,
+    name_resolve,
+    names,
+    recover,
+    timeutil,
+)
+from realhf_tpu.system import worker_base
+from realhf_tpu.system.buffer import SequenceBuffer
+from realhf_tpu.system.request_reply_stream import NameResolvingRequestClient
+
+logger = logging.getLogger("master_worker", "benchmark")
+
+
+class MasterWorker(worker_base.Worker):
+    """Config dict: {spec_path | spec, recover_mode}."""
+
+    def _configure(self, config: Dict):
+        spec = config.get("spec")
+        if spec is None:
+            with open(config["spec_path"], "rb") as f:
+                spec = pickle.load(f)
+        self.spec = spec
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+
+        self.dfg = DFG(spec.mfcs)
+        self.input_keys_of = {n.name: tuple(n.input_keys)
+                              for n in self.dfg.nodes}
+        self.node_worker = {
+            n.name: f"model_worker/{spec.worker_of_role(n.role)}"
+            for n in self.dfg.nodes}
+        self.all_workers = sorted(
+            {w for w in self.node_worker.values()})
+        src = self.dfg.sources[0]
+        self.data_owner = self.node_worker[src.name]
+        # roles with a train MFC -> that MFC name (staleness guard)
+        self.train_nodes_of_role: Dict[str, list] = {}
+        for n in self.dfg.nodes:
+            if n.interface_type == ModelInterfaceType.TRAIN_STEP:
+                self.train_nodes_of_role.setdefault(n.role, []).append(
+                    n.name)
+
+        self.buffer = SequenceBuffer(
+            [n.name for n in self.dfg.nodes],
+            capacity=max(1, spec.max_concurrent_batches))
+
+        self.stream = NameResolvingRequestClient(
+            spec.experiment_name, spec.trial_name)
+
+        ctl = spec.ctl
+        self.save_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctl.save_freq_epochs, freq_step=ctl.save_freq_steps,
+            freq_sec=ctl.save_freq_secs)
+        self.eval_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctl.eval_freq_epochs, freq_step=ctl.eval_freq_steps,
+            freq_sec=None)
+
+        self.recover_mode = config.get("recover_mode", "disabled")
+        self.global_step = 0
+        self._start_epoch = 0
+        self._ids_to_skip = set()
+        if self.recover_mode == "resume" and recover.exists():
+            info = recover.load()
+            self.global_step = info.last_step_info.global_step
+            self._start_epoch = info.recover_start.epoch
+            self._ids_to_skip = set(info.hash_vals_to_ignore)
+            logger.info("Master resuming at global step %d (epoch %d, "
+                        "%d consumed ids).", self.global_step,
+                        self._start_epoch, len(self._ids_to_skip))
+
+        # runtime state
+        self._subscribed = False
+        self._fetch_inflight = False
+        self._inflight: Dict[str, tuple] = {}  # request_id -> (bid, mfc)
+        self._consumed_ids = list(self._ids_to_skip)
+        self._cur_epoch = self._start_epoch
+        self._epochs_fetched = 0  # epoch boundary accounting
+        self._done_fetching = False
+        self._complete = False
+        self._step_t0 = None
+        self._step_stats: Dict[str, Dict] = {}
+        # batch_id -> highest batch whose train MFCs finished, per role
+        self._train_done_upto: Dict[str, Dict[int, set]] = {
+            role: {} for role in self.train_nodes_of_role}
+        self._min_live_bid = 0
+        return "master-configured"
+
+    # ------------------------------------------------------------------
+    def _publish_status(self, status: str):
+        name_resolve.add(
+            names.experiment_status(self.spec.experiment_name,
+                                    self.spec.trial_name),
+            status, replace=True, delete_on_exit=False)
+
+    def _train_caught_up(self, bid: int, role: str) -> bool:
+        """All train MFCs of `role` finished every batch older than
+        bid - max_head_offpolicyness (live batches only)."""
+        horizon = bid - self.spec.max_head_offpolicyness
+        done = self._train_done_upto[role]
+        for old_bid in range(self._min_live_bid, horizon):
+            if old_bid >= bid:
+                break
+            finished = done.get(old_bid, set())
+            if not finished >= set(self.train_nodes_of_role[role]):
+                return False
+        return True
+
+    def _dispatchable(self, bid: int, mfc_name: str) -> bool:
+        node = self.dfg.find(mfc_name)
+        if node.role in self.train_nodes_of_role:
+            return self._train_caught_up(bid, node.role)
+        return True
+
+    def _dispatch_mfc(self, bid: int, mfc_name: str):
+        e = self.buffer.get(bid)
+        node = self.dfg.find(mfc_name)
+        worker = self.node_worker[mfc_name]
+        fetch_plan = {k: e.key_owner[k] for k in node.input_keys
+                      if k in e.key_owner}
+        rid = self.stream.request(
+            [worker], node.interface_type.value,
+            datas=[dict(node=mfc_name, ids=list(e.ids),
+                        fetch_plan=fetch_plan)])[0]
+        self._inflight[rid] = (bid, mfc_name)
+        self.buffer.mark_dispatched(bid, mfc_name)
+        logger.debug("Dispatched %s (batch %d) to %s.", mfc_name, bid,
+                     worker)
+
+    def _dispatch_fetch(self):
+        rid = self.stream.request(
+            [self.data_owner], "fetch_data",
+            datas=[dict(skip_ids=list(self._ids_to_skip))])[0]
+        self._inflight[rid] = (None, "__fetch__")
+        self._fetch_inflight = True
+
+    # ------------------------------------------------------------------
+    def _on_fetch_reply(self, data: Dict):
+        self._fetch_inflight = False
+        epoch = self._start_epoch + data["epoch"]
+        if data["is_epoch_last"]:
+            self._epochs_fetched += 1
+            # consumed-id skipping only applies to the resumed epoch
+            self._ids_to_skip.clear()
+            if self._start_epoch + self._epochs_fetched >= \
+                    self.spec.total_train_epochs:
+                self._done_fetching = True
+        if data["empty"]:
+            return
+        self.buffer.put_batch(data["meta"], self.data_owner, epoch,
+                              data["is_epoch_last"])
+
+    def _on_mfc_reply(self, bid: int, mfc_name: str, data: Dict):
+        node = self.dfg.find(mfc_name)
+        worker = self.node_worker[mfc_name]
+        self.buffer.amend_batch(bid, data.get("meta"), worker, mfc_name)
+        stats = data.get("stats")
+        if stats:
+            self._step_stats.setdefault(mfc_name, {}).update(stats)
+            if node.log_return_value:
+                logger.info("MFC %s (batch %d) stats: %s", mfc_name, bid,
+                            {k: round(v, 4) if isinstance(v, float) else v
+                             for k, v in stats.items()})
+        if node.interface_type == ModelInterfaceType.TRAIN_STEP:
+            self._train_done_upto[node.role].setdefault(bid, set()).add(
+                mfc_name)
+
+    def _finish_batches(self):
+        for e in self.buffer.pop_finished():
+            self._min_live_bid = max(self._min_live_bid, e.batch_id + 1)
+            self.global_step += 1
+            self._cur_epoch = e.epoch
+            self._consumed_ids.extend(e.ids)
+            dt = (time.monotonic() - self._step_t0
+                  if self._step_t0 else 0.0)
+            self._step_t0 = time.monotonic()
+            logger.info(
+                "Master: batch %d done (global step %d, epoch %d) "
+                "%.2fs since last; stats keys: %s", e.batch_id,
+                self.global_step, e.epoch, dt,
+                sorted(self._step_stats))
+            # free worker-side storage for this batch
+            rids = self.stream.request(
+                self.all_workers, "clear_data_cache",
+                datas=[dict(ids=list(e.ids))] * len(self.all_workers))
+            for r in rids:
+                self._inflight[r] = (None, "__clear__")
+            self._maybe_save_eval(e)
+            if e.is_epoch_last:
+                self._consumed_ids = []
+            if (self.spec.ctl.benchmark_steps is not None
+                    and self.global_step >= self.spec.ctl.benchmark_steps):
+                self._complete = True
+
+    def _maybe_save_eval(self, entry, force=False):
+        train_nodes = [m for ms in self.train_nodes_of_role.values()
+                       for m in ms]
+        if not train_nodes:
+            return
+        epochs = 1 if entry is not None and entry.is_epoch_last else 0
+        if force or self.save_ctl.check(epochs=epochs, steps=1):
+            by_worker: Dict[str, list] = {}
+            for m in train_nodes:
+                by_worker.setdefault(self.node_worker[m], []).append(m)
+            for w, nodes in by_worker.items():
+                self.stream.gather_replies([self.stream.request(
+                    [w], "save", datas=[dict(nodes=nodes)])[0]],
+                    timeout=600)
+            if self.recover_mode != "disabled":
+                recover.dump(recover.RecoverInfo(
+                    recover_start=recover.StepInfo(
+                        epoch=self._cur_epoch, epoch_step=0,
+                        global_step=self.global_step),
+                    last_step_info=recover.StepInfo(
+                        epoch=self._cur_epoch, epoch_step=0,
+                        global_step=self.global_step),
+                    hash_vals_to_ignore=list(self._consumed_ids)))
+        if self.spec.eval_dataset is not None and not force and \
+                self.eval_ctl.check(epochs=epochs, steps=1):
+            by_worker = {}
+            for m in train_nodes:
+                by_worker.setdefault(self.node_worker[m], []).append(m)
+            for w, nodes in by_worker.items():
+                out = self.stream.gather_replies([self.stream.request(
+                    [w], "evaluate", datas=[dict(nodes=nodes)])[0]],
+                    timeout=600)[0].data
+                if out:
+                    logger.info("Eval results: %s", out)
+
+    # ------------------------------------------------------------------
+    def _poll(self) -> worker_base.PollResult:
+        if self._complete:
+            time.sleep(0.05)
+            return worker_base.PollResult(0, 0)
+        if not self._subscribed:
+            self.stream.wait_subscribers(self.all_workers, timeout=300)
+            self._subscribed = True
+            self._publish_status("running")
+            self._step_t0 = time.monotonic()
+
+        n = 0
+        # 1. keep the buffer fed
+        if (self.buffer.has_space and not self._fetch_inflight
+                and not self._done_fetching):
+            self._dispatch_fetch()
+            n += 1
+
+        # 2. dispatch every input-ready MFC (subject to staleness)
+        for bid, mfc_name in self.buffer.ready_mfcs(self.input_keys_of):
+            if self._dispatchable(bid, mfc_name):
+                self._dispatch_mfc(bid, mfc_name)
+                n += 1
+
+        # 3. collect replies
+        for p in self.stream.poll_batch(timeout=0.05):
+            if p.handle_name == "error":
+                raise RuntimeError(
+                    f"Model worker reported error: {p.data}")
+            ref = self._inflight.pop(p.request_id, None)
+            if ref is None:
+                continue
+            bid, mfc_name = ref
+            if mfc_name == "__fetch__":
+                self._on_fetch_reply(p.data)
+            elif mfc_name != "__clear__":
+                self._on_mfc_reply(bid, mfc_name, p.data)
+            n += 1
+
+        # 4. batch completion accounting
+        self._finish_batches()
+        # Checked OUTSIDE the pop loop: when every remaining fetch
+        # returns empty (e.g. resume where the final epoch was fully
+        # consumed) no batch ever finishes, yet the trial is done.
+        if (not self._complete and self._done_fetching
+                and len(self.buffer) == 0 and not self._fetch_inflight):
+            self._complete = True
+        if self._complete:
+            self._maybe_save_eval(None, force=True)
+            self._publish_status("done")
+            logger.info("Master: experiment complete at global step %d.",
+                        self.global_step)
+        return worker_base.PollResult(n, n)
+
+    def _handle_command(self, cmd, kwargs):
+        if cmd == "stats":
+            return dict(stats=self._step_stats,
+                        global_step=self.global_step,
+                        complete=self._complete)
+        return super()._handle_command(cmd, kwargs)
+
+    def _exit_hook(self):
+        if getattr(self, "stream", None) is not None:
+            self.stream.close()

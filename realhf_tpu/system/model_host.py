@@ -1,0 +1,204 @@
+"""Model hosting shared by the inline runner and the model worker.
+
+Owns, for a set of model roles on the local device fleet: the primary
+engines (with optimizers for trainable roles), per-MFC weight replicas
+on alternative layouts (reference ``resolve_replica_ids``,
+experiments/common/utils.py:126), algorithm interfaces, and MFC
+execution including the replica-refresh (param-realloc) and offload
+hooks around each call (reference ``model_worker.handle_all_pre_hooks``
+/ post hooks, model_worker.py:483-552).
+"""
+
+import dataclasses as _dc
+import os
+from typing import Dict, List, Optional
+
+from realhf_tpu.api import data as data_api
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.config import ModelInterfaceType, ModelName
+from realhf_tpu.api.dfg import MFCDef, OffloadHook, ParamReallocHook
+from realhf_tpu.base import constants, logging, seeding
+from realhf_tpu.engine.engine import Engine
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.models.hf import load_hf_checkpoint
+from realhf_tpu.parallel.mesh import MeshContext, make_mesh
+from realhf_tpu.parallel.realloc import ReplicaManager
+
+logger = logging.getLogger("model_host", "benchmark")
+
+
+def build_model(role: str, spec, tokenizer, total_steps: int,
+                devices=None, params_override=None,
+                cfg_override=None) -> model_api.Model:
+    """Instantiate one model role on the local devices (reference
+    ReaLModel instantiation in model_worker.__lazy_setup:294-337)."""
+    from realhf_tpu.parallel.mesh import default_devices
+
+    if params_override is not None:
+        # Replica path: reuse the primary's live weights (device_put in
+        # Engine.__init__ reshards them) instead of re-reading the
+        # checkpoint.
+        cfg, params = cfg_override, params_override
+    elif spec.path:
+        cfg, params = load_hf_checkpoint(
+            spec.path, spec.hf_family,
+            is_critic=spec.is_critic or spec.init_critic_from_actor)
+    else:
+        cfg = TransformerConfig(**spec.random_init_config,
+                                is_critic=spec.is_critic)
+        params = None
+    if params_override is None:
+        cfg.gradient_checkpointing = spec.gradient_checkpointing
+        cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
+    if params is None:
+        params = T.init_params(
+            cfg, seeding.derive_key("model_init", role))
+
+    if devices is None:
+        devices = default_devices()[:spec.parallel.world_size]
+    mesh = make_mesh(spec.parallel, devices=devices)
+    ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
+    engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
+                    total_train_steps=total_steps)
+    return model_api.Model(ModelName(role, 0), engine, tokenizer,
+                           hf_family=spec.hf_family)
+
+
+class ModelHost:
+    """All models of some roles + MFC execution with hooks."""
+
+    def __init__(self, spec, roles: List[str], nodes: List[MFCDef],
+                 tokenizer, total_steps: int):
+        self.spec = spec
+        self.roles = list(roles)
+        self.nodes = {n.name: n for n in nodes}
+        self.tokenizer = tokenizer
+
+        self.models: Dict[str, model_api.Model] = {}
+        for role in self.roles:
+            self.models[role] = build_model(
+                role, spec.models[role], tokenizer, total_steps)
+
+        # Replica engines for MFCs allocated on a different layout than
+        # their role's primary. Replicas never own an optimizer;
+        # weights flow from the primary via reallocation.
+        self.replicas: Dict[str, model_api.Model] = {}
+        self.replica_mgr = ReplicaManager()
+        for node in nodes:
+            alloc = spec.allocations.get(node.name)
+            if alloc is None:
+                continue
+            role = node.role
+            primary = self.models[role]
+            if alloc.same_layout(primary.engine.ctx.parallel):
+                continue
+            if node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                raise ValueError(
+                    f"MFC {node.name}: train MFCs must run on the "
+                    "role's primary layout (replicas have no optimizer).")
+            mspec = _dc.replace(spec.models[role], parallel=alloc,
+                                optimizer=None)
+            self.replicas[node.name] = build_model(
+                f"{role}-{node.name}", mspec, tokenizer, total_steps,
+                params_override=primary.engine.params,
+                cfg_override=primary.config)
+            logger.info("Created replica for %s: %s (primary %s)",
+                        node.name, alloc, primary.engine.ctx.parallel)
+
+        self.interfaces = {
+            n.name: model_api.make_interface(n.interface_impl)
+            for n in nodes
+        }
+
+        if getattr(spec, "auto_offload", False):
+            self._resolve_offload_hooks(nodes)
+
+    @staticmethod
+    def _resolve_offload_hooks(nodes: List[MFCDef]):
+        """Attach OffloadHook post-hooks to the LAST MFC of every
+        non-trainable role (reference resolve_rpc_hooks,
+        experiments/common/utils.py:143): the role's weights live on
+        host between steps, freeing HBM for training."""
+        graph_nodes = [nodes[0]._G.nodes[x]["object"]
+                       for x in nodes[0]._G.nodes] if nodes else []
+        trainable_roles = {
+            n.role for n in graph_nodes
+            if n.interface_type == ModelInterfaceType.TRAIN_STEP}
+        for node in nodes:
+            if node.role in trainable_roles:
+                continue
+            if not node.is_dst_of_model_role:
+                continue
+            if any(isinstance(h, OffloadHook) for h in node._post_hooks):
+                continue
+            node.add_post_hook(OffloadHook())
+            logger.info("Auto-resolved offload post-hook on %s (%s).",
+                        node.name, node.role)
+
+    # ------------------------------------------------------------------
+    def engines_of_node(self, node: MFCDef):
+        primary = self.models[node.role]
+        model = self.replicas.get(node.name, primary)
+        return primary, model
+
+    def execute(self, node_name: str, inp: data_api.SequenceSample):
+        """Run one MFC: pre-hooks (reload offloaded weights, refresh
+        replica), the interface call, post-hooks (offload)."""
+        node = self.nodes[node_name]
+        primary, model = self.engines_of_node(node)
+
+        # pre-hooks -----------------------------------------------------
+        primary.engine.ensure_on_device()
+        model.engine.ensure_on_device()
+        eta = 1.0
+        for h in node._pre_hooks:
+            if isinstance(h, ParamReallocHook) and h.eta is not None:
+                eta = h.eta
+        if model is not primary:
+            # param-realloc pre-hook: refresh the replica's weights
+            # from the trainable primary if it has stepped since.
+            self.replica_mgr.ensure_fresh(node.role, primary, model,
+                                          eta=eta)
+
+        if node.input_key_remap:
+            inp = inp.select([k for k in inp.keys])
+            inp.remap_keys_(node.input_key_remap)
+
+        itf = self.interfaces[node_name]
+        if node.interface_type == ModelInterfaceType.GENERATE:
+            out = itf.generate(model, inp, n_mbs=node.n_mbs)
+        elif node.interface_type == ModelInterfaceType.INFERENCE:
+            out = itf.inference(model, inp, n_mbs=node.n_mbs)
+        elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
+            out = itf.train_step(model, inp, n_mbs=node.n_mbs)
+        else:
+            raise NotImplementedError(node.interface_type)
+
+        if isinstance(out, data_api.SequenceSample) and node.output_key_remap:
+            out.remap_keys_(node.output_key_remap)
+
+        # post-hooks ----------------------------------------------------
+        for h in node._post_hooks:
+            if isinstance(h, OffloadHook):
+                model.engine.offload()
+                if model is not primary:
+                    # the role's primary holds a full weight copy too;
+                    # leaving it resident would defeat the offload
+                    primary.engine.offload()
+                logger.info("Offloaded %s weights to host after %s.",
+                            node.role, node_name)
+        return out
+
+    # ------------------------------------------------------------------
+    def save_role(self, role: str, train_node_name: str):
+        model = self.models[role]
+        path = os.path.join(constants.run_save_path(), role)
+        self.interfaces[train_node_name].save(model, path)
+        logger.info("Saved %s to %s", role, path)
+        return path
+
+    def evaluate_role(self, role: str, train_node_name: str,
+                      eval_dataloader) -> Optional[dict]:
+        return self.interfaces[train_node_name].evaluate(
+            self.models[role], eval_dataloader)

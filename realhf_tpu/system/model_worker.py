@@ -1,0 +1,232 @@
+"""Model worker: executes MFCs on its local device fleet.
+
+TPU-native counterpart of reference ``realhf/system/model_worker.py``
+(ModelWorker:85). One worker process per device slice (reference: one
+per GPU; on TPU one per host-slice) hosts the model roles assigned to
+it, stores MFC inputs/outputs locally (tensors never travel through
+the master -- replies carry ``SequenceSample.meta()`` only,
+model_worker.py:766-779), fetches missing input keys from peer
+workers over the host data plane, and runs the dataset shard when it
+owns the source MFC's role.
+
+Request handlers mirror model_poll_step (model_worker.py:505):
+fetch_data / generate / inference / train_step / evaluate / save /
+clear_data_cache / offload.
+"""
+
+import os
+import pickle
+import queue
+import threading
+from typing import Dict, Optional
+
+from realhf_tpu.api import data as data_api
+from realhf_tpu.api.config import ModelInterfaceType
+from realhf_tpu.api.dfg import DFG
+from realhf_tpu.base import constants, logging, seeding
+from realhf_tpu.system import worker_base
+from realhf_tpu.system.data_plane import DataClient, DataServer, DataStore
+from realhf_tpu.system.model_host import ModelHost
+from realhf_tpu.system.request_reply_stream import (
+    NameResolvingReplyServer,
+    Payload,
+)
+
+logger = logging.getLogger("model_worker", "benchmark")
+
+
+class ModelWorker(worker_base.Worker):
+    """Config dict: {spec_path | spec, worker_index}."""
+
+    def _configure(self, config: Dict):
+        spec = config.get("spec")
+        if spec is None:
+            with open(config["spec_path"], "rb") as f:
+                spec = pickle.load(f)
+        self.spec = spec
+        self.worker_index = int(config["worker_index"])
+
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+        seeding.set_random_seed(spec.seed + self.worker_index + 1)
+
+        import realhf_tpu.datasets  # noqa: F401 - register datasets
+        import realhf_tpu.interfaces  # noqa: F401 - register interfaces
+
+        self.dfg = DFG(spec.mfcs)
+        my_roles = [r for r in spec.models
+                    if spec.worker_of_role(r) == self.worker_index]
+        my_nodes = [n for n in self.dfg.nodes if n.role in my_roles]
+        self.my_nodes = {n.name for n in my_nodes}
+
+        self.tokenizer = spec.tokenizer or (
+            data_api.load_hf_tokenizer(spec.tokenizer_path)
+            if spec.tokenizer_path else None)
+
+        # Dataset lives with the worker hosting the source MFC's role
+        # (reference: datasets on src-RPC DP-head model workers,
+        # model_worker.py:256-292).
+        src = self.dfg.sources[0]
+        self.owns_data = src.name in self.my_nodes
+        self.dataloader_iter = None
+        self.steps_per_epoch = 1
+        self._epoch = 0
+        if self.owns_data:
+            dataset = data_api.make_dataset(
+                spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
+                tokenizer_or_path=self.tokenizer)
+            self.dataloader = data_api.PackedDataLoader(
+                dataset, batch_size=src.n_seqs, seed=spec.seed)
+            self.steps_per_epoch = len(self.dataloader)
+            self.dataloader_iter = iter(self.dataloader)
+
+        self.eval_dataloader = None
+        if spec.eval_dataset is not None and any(
+                n.interface_type == ModelInterfaceType.TRAIN_STEP
+                for n in my_nodes):
+            eval_ds = data_api.make_dataset(
+                spec.eval_dataset, seed=spec.seed, dp_rank=0,
+                world_size=1, tokenizer_or_path=self.tokenizer)
+            self.eval_dataloader = data_api.PackedDataLoader(
+                eval_ds, batch_size=src.n_seqs, shuffle=False)
+
+        total_steps = (self.steps_per_epoch or 1) * spec.total_train_epochs
+        self.host = ModelHost(spec, my_roles, my_nodes, self.tokenizer,
+                              total_steps)
+
+        # data plane: store + threaded server + peer-fetch client
+        self.store = DataStore()
+        self.data_server = DataServer(spec.experiment_name,
+                                      spec.trial_name, self.worker_name,
+                                      self.store)
+        self.data_server.start()
+        self.data_client = DataClient(spec.experiment_name,
+                                      spec.trial_name)
+
+        self.stream = NameResolvingReplyServer(
+            spec.experiment_name, spec.trial_name, self.worker_name)
+        logger.info("ModelWorker %s configured: roles=%s nodes=%s "
+                    "owns_data=%s", self.worker_name, my_roles,
+                    sorted(self.my_nodes), self.owns_data)
+        return dict(roles=my_roles, nodes=sorted(self.my_nodes),
+                    owns_data=self.owns_data,
+                    steps_per_epoch=self.steps_per_epoch)
+
+    # ------------------------------------------------------------------
+    def _handle_fetch_data(self, req: Payload):
+        """Load the next dataset batch, keep tensors locally, reply
+        metadata (ids/seqlens/keys) + epoch accounting."""
+        assert self.owns_data
+        try:
+            batch = next(self.dataloader_iter)
+            is_epoch_last = False
+        except StopIteration:
+            self.dataloader_iter = iter(self.dataloader)
+            self._epoch += 1
+            batch = next(self.dataloader_iter)
+            is_epoch_last = False
+        # Peek whether this batch ends the epoch by position.
+        self._step_in_epoch = getattr(self, "_step_in_epoch", -1) + 1
+        if self._step_in_epoch >= self.steps_per_epoch - 1:
+            is_epoch_last = True
+            self._step_in_epoch = -1
+        batch = data_api.drop_ids(batch,
+                                  req.data.get("skip_ids") or ())
+        if batch is None:
+            self.stream.respond(req, data=dict(
+                empty=True, epoch=self._epoch,
+                is_epoch_last=is_epoch_last))
+            return
+        self.store.put(batch)
+        self.stream.respond(req, data=dict(
+            empty=False, meta=batch.meta(), epoch=self._epoch,
+            is_epoch_last=is_epoch_last))
+
+    def _assemble_input(self, ids, keys, fetch_plan) -> data_api.SequenceSample:
+        """Gather the MFC input from local storage, fetching missing
+        keys from their owner workers (the data_transfer pre-hook,
+        reference model_worker.py:782-814)."""
+        # owner -> key -> ids actually missing locally; fetch only the
+        # union of missing ids per owner (cached pieces never re-ship)
+        missing: Dict[str, Dict[str, list]] = {}
+        for k in keys:
+            owner = fetch_plan.get(k, self.worker_name)
+            if owner == self.worker_name:
+                continue
+            need = [i for i in ids if not self.store.has(i, [k])]
+            if need:
+                missing.setdefault(owner, {})[k] = need
+        for owner, by_key in missing.items():
+            need_union = sorted({i for v in by_key.values() for i in v},
+                                key=lambda x: ids.index(x))
+            fetched = self.data_client.fetch(owner, need_union,
+                                             list(by_key))
+            self.store.put(fetched)
+        return self.store.get(ids, list(keys))
+
+    def _handle_mfc(self, req: Payload):
+        d = req.data
+        node_name = d["node"]
+        assert node_name in self.my_nodes, (node_name, self.my_nodes)
+        node = self.dfg.find(node_name)
+        keys = [k for k in node.input_keys]
+        inp = self._assemble_input(d["ids"], keys, d.get("fetch_plan", {}))
+        out = self.host.execute(node_name, inp)
+        if isinstance(out, data_api.SequenceSample):
+            self.store.put(out)
+            self.stream.respond(req, data=dict(meta=out.meta(), stats=None))
+        else:
+            self.stream.respond(req, data=dict(meta=None, stats=out))
+
+    def _handle_save(self, req: Payload):
+        saved = {}
+        for node_name in req.data["nodes"]:
+            node = self.dfg.find(node_name)
+            saved[node.role] = self.host.save_role(node.role, node_name)
+        self.stream.respond(req, data=saved)
+
+    def _handle_evaluate(self, req: Payload):
+        out = {}
+        for node_name in req.data["nodes"]:
+            node = self.dfg.find(node_name)
+            ev = self.host.evaluate_role(node.role, node_name,
+                                         self.eval_dataloader)
+            if ev:
+                out[node.role] = ev
+        self.stream.respond(req, data=out)
+
+    # ------------------------------------------------------------------
+    def _poll(self) -> worker_base.PollResult:
+        try:
+            req = self.stream.poll(timeout=0.05)
+        except TimeoutError:
+            return worker_base.PollResult(0, 0)
+        handle = req.handle_name
+        try:
+            if handle == "fetch_data":
+                self._handle_fetch_data(req)
+            elif handle in ("generate", "inference", "train_step"):
+                self._handle_mfc(req)
+            elif handle == "save":
+                self._handle_save(req)
+            elif handle == "evaluate":
+                self._handle_evaluate(req)
+            elif handle == "clear_data_cache":
+                self.store.clear(req.data["ids"])
+                self.stream.respond(req, data="ok")
+            elif handle == "ping":
+                self.stream.respond(req, data="pong")
+            else:
+                raise ValueError(f"Unknown request {handle}")
+        except Exception as e:  # noqa: BLE001 - report, then re-raise
+            logger.error("ModelWorker %s failed handling %s: %s",
+                         self.worker_name, handle, e, exc_info=True)
+            self.stream.reply(Payload(
+                handler=self.worker_name, handle_name="error",
+                request_id=req.request_id, data=repr(e)))
+            raise
+        return worker_base.PollResult(1, 1)
+
+    def _exit_hook(self):
+        if getattr(self, "data_server", None) is not None:
+            self.data_server.stop()

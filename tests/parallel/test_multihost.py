@@ -1,0 +1,121 @@
+"""Emulated multi-host: 2 OS processes x 4 virtual CPU devices form
+one 8-device jax.distributed world via the name_resolve rendezvous
+(reference global_comm.py:44 setup_global_comm), run a pjit
+computation over a cross-host mesh, and reshard a model pytree
+between two layouts spanning both processes -- the cross-process
+parameter-reallocation round trip (VERDICT round-1 item 3)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+WORKER_CODE = """
+import os, sys, time
+from realhf_tpu.base.backend import force_cpu_backend
+force_cpu_backend(n_devices=4)
+from realhf_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=os.environ["NR_ROOT"])
+
+from realhf_tpu.parallel.multihost import initialize_multihost
+
+pid = initialize_multihost("mhtest", "t0", n_processes=2,
+                           local_device_count=4, timeout=120)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+# 1. pjit computation over a mesh spanning both processes
+devs = np.array(jax.devices()).reshape(2, 4)
+mesh = Mesh(devs, ("data", "model"))
+
+@jax.jit
+def global_sum(x):
+    return x.sum()
+
+sharding = NamedSharding(mesh, P("data", "model"))
+x = jax.make_array_from_callback(
+    (8, 8), sharding,
+    lambda idx: np.arange(64, dtype=np.float32).reshape(8, 8)[idx])
+total = float(global_sum(x))
+assert total == float(np.arange(64).sum()), total
+
+# 2. cross-process parameter reallocation round trip: a transformer
+# param pytree resharded dp-major -> tp-major -> back, latency timed
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models import sharding as shard_rules
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.parallel.mesh import ParallelismConfig, make_mesh
+
+cfg = TransformerConfig(
+    n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+    intermediate_dim=64, vocab_size=64, apply_rotary=True,
+    layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+    use_attn_proj_bias=False, use_mlp_bias=False,
+    activation_function="silu", compute_dtype="float32")
+params = T.init_params(cfg, jax.random.PRNGKey(0))
+
+mesh_dp = make_mesh(ParallelismConfig(data_parallel_size=8),
+                    devices=list(jax.devices()))
+mesh_tp = make_mesh(ParallelismConfig(data_parallel_size=2,
+                                      tensor_parallel_size=4),
+                    devices=list(jax.devices()))
+sh_dp = shard_rules.param_shardings(cfg, mesh_dp)
+sh_tp = shard_rules.param_shardings(cfg, mesh_tp)
+
+p0 = jax.device_put(params, sh_dp)
+ref_sum = float(jnp.sum(p0["embed"]["wte"]))
+
+t0 = time.monotonic()
+p1 = jax.device_put(p0, sh_tp)          # dp-major -> tp-major (cross-host)
+jax.block_until_ready(p1)
+dt1 = time.monotonic() - t0
+t0 = time.monotonic()
+p2 = jax.device_put(p1, sh_dp)          # and back
+jax.block_until_ready(p2)
+dt2 = time.monotonic() - t0
+
+# sums under different shardings reduce in different orders
+assert abs(float(jnp.sum(p1["embed"]["wte"])) - pytest_approx_ref) < 1e-2
+assert abs(float(jnp.sum(p2["embed"]["wte"])) - pytest_approx_ref) < 1e-2
+print(f"MULTIHOST_OK pid={pid} reshard_to_tp={dt1:.3f}s "
+      f"reshard_back={dt2:.3f}s", flush=True)
+""".replace("pytest_approx_ref", "ref_sum")
+
+
+def test_two_process_multihost(tmp_path):
+    env = dict(
+        os.environ,
+        NR_ROOT=str(tmp_path / "nr"),
+        PYTHONPATH="/root/repo",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    procs = [
+        subprocess.Popen([sys.executable, "-c", WORKER_CODE], env=env,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, cwd="/root/repo")
+        for _ in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost processes timed out:\n"
+                    + "\n".join(o or "" for o in outs))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+        assert "MULTIHOST_OK" in out, out
+    # both ranks participated
+    assert any("pid=0" in o for o in outs)
+    assert any("pid=1" in o for o in outs)

@@ -1,0 +1,132 @@
+"""Distributed runtime end-to-end: master + model workers as real OS
+processes, the DFG dispatched over ZMQ with metadata-only requests and
+the host data plane moving tensors between workers (the VERDICT round-1
+acceptance test: the 6-MFC PPO graph across >=2 worker processes with
+actor and reward on different meshes)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.testing import IntegerTokenizer
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.experiments.common import apply_overrides
+from realhf_tpu.experiments.ppo_exp import PPOConfig
+from realhf_tpu.experiments.sft_exp import SFTConfig
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu")
+
+WORKER_ENV = {
+    # spawned workers must run on the virtual CPU mesh and never touch
+    # the TPU plugin; PYTHONPATH also displaces the image's TPU
+    # sitecustomize
+    "REALHF_TPU_BACKEND": "cpu",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": "/root/repo",
+}
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def _patch_random_models(spec, dp=2, tp=4):
+    for role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        mspec.parallel = ParallelismConfig(
+            data_parallel_size=dp, tensor_parallel_size=tp)
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = IntegerTokenizer()
+
+
+@pytest.fixture
+def sft_data(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "sft.jsonl"
+    _write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
+         "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
+        for i in range(16)])
+    return str(path)
+
+
+@pytest.fixture
+def prompt_data(tmp_path):
+    rng = np.random.default_rng(1)
+    path = tmp_path / "prompts.jsonl"
+    _write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
+        for i in range(16)])
+    return str(path)
+
+
+def test_sft_distributed_one_worker(sft_data):
+    from realhf_tpu.apps.main import main_start
+    from realhf_tpu.base import constants
+
+    cfg = SFTConfig(experiment_name="dsft", trial_name="t0",
+                    total_train_epochs=1)
+    apply_overrides(cfg, {"dataset.path": sft_data,
+                          "dataset.train_bs_n_seqs": "8",
+                          "dataset.max_seqlen": "32"})
+    spec = cfg.build()
+    _patch_random_models(spec)
+    spec.n_model_workers = 1
+    out = main_start(spec, env=WORKER_ENV, timeout=600)
+    assert out["complete"]
+    assert out["global_step"] == 2  # 16 samples / bs 8
+    assert np.isfinite(out["stats"]["trainDefault"]["loss"])
+    assert os.path.exists(os.path.join(constants.run_save_path(),
+                                       "default", "config.json"))
+
+
+def test_ppo_distributed_two_workers(prompt_data):
+    """The 6-MFC PPO graph across 2 OS worker processes: actor+critic
+    on worker 0, ref+reward on worker 1 (different processes => truly
+    concurrent meshes). Data produced by actor_gen on worker 0 flows to
+    rew_inf/ref_inf on worker 1 over the host data plane; their outputs
+    flow back for the train MFCs."""
+    from realhf_tpu.apps.main import main_start
+
+    cfg = PPOConfig(experiment_name="dppo", trial_name="t0",
+                    total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.top_k": "16",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    assert len(spec.mfcs) == 6
+    _patch_random_models(spec)
+    spec.n_model_workers = 2
+    spec.worker_assignment = {"actor": 0, "critic": 0, "ref": 1,
+                              "reward": 1}
+    out = main_start(spec, env=WORKER_ENV, timeout=1200)
+    assert out["complete"]
+    assert out["global_step"] == 2
+    stats = out["stats"]
+    assert "actor_train" in stats and "critic_train" in stats
+    assert np.isfinite(stats["actor_train"]["actor_loss"])
+    assert np.isfinite(stats["critic_train"]["value_loss"])
+    assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1

@@ -253,3 +253,35 @@ def test_recover_resume(sft_data):
     # epoch 0's remaining batch skipped the consumed ids
     final = recover.load()
     assert len(set(final.hash_vals_to_ignore) | consumed) >= 8
+
+
+def test_ppo_auto_offload(prompt_data):
+    """auto_offload: ref/reward weights live on HOST between steps
+    (offload post-hook after their last MFC), and reload transparently
+    on the next step's use (reference model_worker.py:542-552)."""
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = PPOConfig(experiment_name="ppooff", trial_name="t0",
+                    total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.top_k": "16",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    _patch_random_models(spec, FakeTokenizer())
+    spec.auto_offload = True
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    # both steps finished with offload/reload cycles in between
+    assert np.isfinite(stats["actor_train"]["actor_loss"])
+    # non-trainable roles ended the step offloaded to host
+    assert runner.models["ref"].engine.offloaded
+    assert runner.models["reward"].engine.offloaded
+    # trainable roles never offload
+    assert not runner.models["actor"].engine.offloaded
+    assert not runner.models["critic"].engine.offloaded
