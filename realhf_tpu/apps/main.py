@@ -49,6 +49,12 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
     """One trial attempt: spawn workers, run to completion, tear down.
     Raises JobException/TimeoutError on worker failure (the caller's
     recover loop relaunches)."""
+    bad = {r: w for r, w in spec.worker_assignment.items()
+           if not 0 <= w < spec.n_model_workers}
+    if bad:
+        raise ValueError(
+            f"worker_assignment indices out of range for "
+            f"n_model_workers={spec.n_model_workers}: {bad}")
     constants.set_experiment_trial_names(spec.experiment_name,
                                          spec.trial_name)
     path = _spec_path(spec)

@@ -8,6 +8,7 @@ blocking host calls since XLA dispatch is async -- callers must
 and accelerator memory stats via JAX device APIs.
 """
 
+import os
 import contextlib
 import dataclasses
 import time
@@ -143,3 +144,51 @@ def device_memory_stats(device=None) -> Dict[str, int]:
         "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
         "bytes_limit": stats.get("bytes_limit", 0),
     }
+
+
+# ----------------------------------------------------------------------
+# Profiling / tracing (reference model_worker.py:664-721 per-MFC
+# profiler + REAL_DUMP_TRACE/REAL_DUMP_MEMORY, monitor.py:375-427)
+# ----------------------------------------------------------------------
+DUMP_TRACE_ENV = "REALHF_TPU_DUMP_TRACE"
+DUMP_MEMORY_ENV = "REALHF_TPU_DUMP_MEMORY"
+
+
+def trace_dir(sub: str = "") -> str:
+    from realhf_tpu.base import constants
+    d = os.path.join(constants.run_log_path(), "trace", sub)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+@contextlib.contextmanager
+def mfc_profile_region(name: str):
+    """Wrap one MFC execution:
+
+    - always: a wall-clock span in the TimeMarkDB and an XLA trace
+      annotation (shows up as a named region in any enclosing profile);
+    - REALHF_TPU_DUMP_TRACE=1: a full ``jax.profiler.trace`` dumped to
+      ``{log}/trace/{name}/`` (TensorBoard/perfetto-readable -- the
+      reference's per-MFC chrome traces);
+    - REALHF_TPU_DUMP_MEMORY=1: a device-memory profile (pprof) saved
+      after the MFC completes (the reference's CUDA memory snapshots).
+    """
+    import jax
+
+    dump_trace = os.environ.get(DUMP_TRACE_ENV, "") == "1"
+    dump_memory = os.environ.get(DUMP_MEMORY_ENV, "") == "1"
+    safe = name.replace("/", "_")
+    ctx = contextlib.ExitStack()
+    with ctx:
+        if dump_trace:
+            ctx.enter_context(jax.profiler.trace(trace_dir(safe)))
+        ctx.enter_context(jax.profiler.TraceAnnotation(f"mfc:{name}"))
+        ctx.enter_context(_tmark_db.mark(f"mfc/{name}"))
+        yield
+    if dump_memory:
+        path = os.path.join(trace_dir(safe),
+                            f"memory_{int(time.time())}.prof")
+        try:
+            jax.profiler.save_device_memory_profile(path)
+        except Exception:  # noqa: BLE001 - profiling must never kill a run
+            pass

@@ -1,9 +1,11 @@
 """Dataset implementations; importing this module registers them.
 
 Parity with reference ``realhf/impl/dataset/__init__.py``: registered
-names are "prompt", "prompt_answer", and "rw_pair".
+names are "prompt", "prompt_answer", "rw_pair", and "random_prompt"
+(synthetic data for profile/mock mode).
 """
 
 import realhf_tpu.datasets.prompt  # noqa: F401
 import realhf_tpu.datasets.prompt_answer  # noqa: F401
 import realhf_tpu.datasets.rw_paired  # noqa: F401
+import realhf_tpu.datasets.random_prompt  # noqa: F401

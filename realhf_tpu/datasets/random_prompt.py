@@ -1,0 +1,47 @@
+"""Synthetic prompt dataset for profile/mock mode.
+
+The reference's profile experiment feeds MFCs synthetic data through
+the full runtime (``experiments/benchmark/profile_exp.py:61`` +
+``ModelInterface.mock``, model_api.py:619); this dataset is the
+TPU-native data side of that: random token prompts with configurable
+size distribution, no files or tokenizer involved.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from realhf_tpu.api import data as data_api
+
+
+class RandomPromptDataset:
+
+    def __init__(self, util: data_api.DatasetUtility, n_prompts: int = 256,
+                 prompt_len_min: int = 32, prompt_len_max: int = 256,
+                 vocab_size: int = 32000, max_length: Optional[int] = None):
+        self._util = util
+        rng = np.random.default_rng(util.seed + util.dp_rank)
+        hi = min(prompt_len_max, max_length or prompt_len_max)
+        lo = min(prompt_len_min, hi)
+        self.lengths = rng.integers(lo, hi + 1,
+                                    size=n_prompts).astype(int)
+        # ids >= 2: 0/1 are conventionally pad/eos
+        self.prompts = [rng.integers(2, vocab_size, size=l)
+                        .astype(np.int32) for l in self.lengths]
+
+    @property
+    def util(self):
+        return self._util
+
+    def __len__(self):
+        return len(self.prompts)
+
+    def __getitem__(self, idx):
+        return data_api.SequenceSample.from_default(
+            ids=[idx],
+            seqlens=[int(self.lengths[idx])],
+            data=dict(packed_prompts=self.prompts[idx]),
+        )
+
+
+data_api.register_dataset("random_prompt", RandomPromptDataset)

@@ -138,6 +138,22 @@ def decode_attention(
     b, nq, hd = q.shape
     s, nkv = k_cache.shape[1], k_cache.shape[2]
     group = nq // nkv
+
+    # Pallas flash-decode on TPU: single tiled pass over the cache, no
+    # [B, nq, S] score tensor (ops/decode_attention.py).
+    if (jax.default_backend() == "tpu" and hd >= 64
+            and logits_soft_cap is None
+            and (scale is None or isinstance(scale, (int, float)))):
+        try:
+            from realhf_tpu.ops.decode_attention import (
+                flash_decode_attention,
+            )
+            return flash_decode_attention(
+                q, k_cache, v_cache, valid_mask, scale=scale,
+                sliding_window=sliding_window, slot=slot)
+        except ImportError:
+            pass
+
     scale = scale if scale is not None else hd ** -0.5
 
     qg = q.reshape(b, nkv, group, hd)

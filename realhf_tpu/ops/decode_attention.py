@@ -1,0 +1,124 @@
+"""Pallas flash-decode attention against a padded KV cache (TPU).
+
+Replaces the plain-XLA ``ops.attention.decode_attention`` on the hot
+decode path (reference ``flash_attn_with_kvcache``, attn.py:238): one
+query token per stream attends over the whole cache with a tiled
+online softmax, never materializing the ``[B, nq, S]`` score tensor.
+Decode is HBM-bandwidth bound -- the kernel makes a single pass over
+K/V per step, with all query heads of a KV group (GQA) sharing each
+loaded block.
+
+Layout contract: q [B, nq, hd], k/v caches [B, S, nkv, hd],
+keep-mask [B, S] (validity AND the sliding window -- precomputed in
+XLA, it is O(B*S) elementwise). The query-group axis is padded up to
+the fp32 sublane count (8); hd should be a multiple of 128 on real
+TPUs. S is padded to the K block.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -2.0 ** 30
+SUBLANES = 8
+DEFAULT_BK = 512
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
+    gp, hd = q_ref.shape[-2], q_ref.shape[-1]
+    s = k_ref.shape[-2]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [gp, hd]
+
+    def body(j, carry):
+        m, l_sum, acc = carry
+        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bk, bk), :]
+        keep = keep_ref[0, 0, pl.ds(j * bk, bk)]  # [bk] int32
+
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [gp, bk]
+        sc = jnp.where((keep > 0)[None, :], sc, NEG_INF)
+
+        m_new = jnp.maximum(m, sc.max(axis=1))
+        p = jnp.exp(sc - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l_sum * alpha + p.sum(axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((gp,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((gp,), jnp.float32)
+    acc0 = jnp.zeros((gp, hd), jnp.float32)
+    m, l_sum, acc = jax.lax.fori_loop(0, s // bk, body, (m0, l0, acc0))
+
+    row_valid = m > NEG_INF / 2  # streams whose cache is still empty
+    safe_l = jnp.where(l_sum > 0, l_sum, 1.0)
+    out = jnp.where(row_valid[:, None], acc / safe_l[:, None], 0.0)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,        # [B, nq, hd]
+    k_cache: jnp.ndarray,  # [B, S, nkv, hd]
+    v_cache: jnp.ndarray,
+    valid_mask: jnp.ndarray,  # [B, S] bool
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    slot: Optional[jnp.ndarray] = None,  # [B] int32, with sliding_window
+    block_k: int = DEFAULT_BK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, nq, hd = q.shape
+    s, nkv = k_cache.shape[1], k_cache.shape[2]
+    group = nq // nkv
+    scale = float(scale) if scale is not None else hd ** -0.5
+
+    keep = valid_mask
+    if sliding_window is not None:
+        assert slot is not None, "sliding_window decode needs slot indices"
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        keep = keep & ((slot[:, None] - idx) < sliding_window)
+    keep = keep.astype(jnp.int32)
+
+    bk = min(block_k, s)
+    pad_s = (-s) % bk
+    if pad_s:
+        zpad = jnp.zeros((b, pad_s, nkv, hd), k_cache.dtype)
+        k_cache = jnp.concatenate([k_cache, zpad], axis=1)
+        v_cache = jnp.concatenate([v_cache, zpad], axis=1)
+        keep = jnp.concatenate(
+            [keep, jnp.zeros((b, pad_s), jnp.int32)], axis=1)
+        s += pad_s
+
+    gp = max(SUBLANES, group)  # pad query group to the sublane tile
+    qg = q.reshape(b, nkv, group, hd)
+    if gp != group:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((b, nkv, gp - group, hd), q.dtype)], axis=2)
+    kt = k_cache.transpose(0, 2, 1, 3)  # [B, nkv, S, hd]
+    vt = v_cache.transpose(0, 2, 1, 3)
+    keep_b = jnp.broadcast_to(keep[:, None, :], (b, SUBLANES, s))
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, hd), lambda bi, h: (bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
+            pl.BlockSpec((1, SUBLANES, s), lambda bi, h: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, hd),
+                               lambda bi, h: (bi, h, 0, 0)),
+        interpret=interpret,
+    )(qg, kt, vt, keep_b)
+    return out[:, :, :group, :].reshape(b, nq, hd)

@@ -96,12 +96,18 @@ class DataServer(threading.Thread):
         while not self._stop_evt.is_set():
             if not self._sock.poll(100):
                 continue
-            ids, keys = pickle.loads(self._sock.recv())
+            raw = self._sock.recv()
+            # once recv'd, the REP socket MUST send before the next
+            # recv -- reply with an error rather than dying silently
+            # (a dead server turns every peer fetch into a timeout)
             try:
+                ids, keys = pickle.loads(raw)
                 payload = self.store.get(ids, keys)
-                self._sock.send(pickle.dumps(("ok", payload)))
+                reply = ("ok", payload)
             except Exception as e:  # noqa: BLE001 - reply, don't die
-                self._sock.send(pickle.dumps(("error", repr(e))))
+                logger.error("Data server request failed: %r", e)
+                reply = ("error", repr(e))
+            self._sock.send(pickle.dumps(reply))
 
     def stop(self):
         self._stop_evt.set()

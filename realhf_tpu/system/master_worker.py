@@ -226,10 +226,12 @@ class MasterWorker(worker_base.Worker):
             by_worker: Dict[str, list] = {}
             for m in train_nodes:
                 by_worker.setdefault(self.node_worker[m], []).append(m)
-            for w, nodes in by_worker.items():
-                self.stream.gather_replies([self.stream.request(
-                    [w], "save", datas=[dict(nodes=nodes)])[0]],
-                    timeout=600)
+            # post ALL save requests first, then gather: workers
+            # checkpoint concurrently instead of one at a time
+            rids = [self.stream.request(
+                [w], "save", datas=[dict(nodes=nodes)])[0]
+                for w, nodes in by_worker.items()]
+            self.stream.gather_replies(rids, timeout=600)
             if self.recover_mode != "disabled":
                 recover.dump(recover.RecoverInfo(
                     recover_start=recover.StepInfo(
@@ -244,12 +246,12 @@ class MasterWorker(worker_base.Worker):
             by_worker = {}
             for m in train_nodes:
                 by_worker.setdefault(self.node_worker[m], []).append(m)
-            for w, nodes in by_worker.items():
-                out = self.stream.gather_replies([self.stream.request(
-                    [w], "evaluate", datas=[dict(nodes=nodes)])[0]],
-                    timeout=600)[0].data
-                if out:
-                    logger.info("Eval results: %s", out)
+            rids = [self.stream.request(
+                [w], "evaluate", datas=[dict(nodes=nodes)])[0]
+                for w, nodes in by_worker.items()]
+            for p in self.stream.gather_replies(rids, timeout=600):
+                if p.data:
+                    logger.info("Eval results: %s", p.data)
 
     # ------------------------------------------------------------------
     def _poll(self) -> worker_base.PollResult:

@@ -166,14 +166,16 @@ class ModelHost:
             inp.remap_keys_(node.input_key_remap)
 
         itf = self.interfaces[node_name]
-        if node.interface_type == ModelInterfaceType.GENERATE:
-            out = itf.generate(model, inp, n_mbs=node.n_mbs)
-        elif node.interface_type == ModelInterfaceType.INFERENCE:
-            out = itf.inference(model, inp, n_mbs=node.n_mbs)
-        elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
-            out = itf.train_step(model, inp, n_mbs=node.n_mbs)
-        else:
-            raise NotImplementedError(node.interface_type)
+        from realhf_tpu.base import monitor
+        with monitor.mfc_profile_region(node_name):
+            if node.interface_type == ModelInterfaceType.GENERATE:
+                out = itf.generate(model, inp, n_mbs=node.n_mbs)
+            elif node.interface_type == ModelInterfaceType.INFERENCE:
+                out = itf.inference(model, inp, n_mbs=node.n_mbs)
+            elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                out = itf.train_step(model, inp, n_mbs=node.n_mbs)
+            else:
+                raise NotImplementedError(node.interface_type)
 
         if isinstance(out, data_api.SequenceSample) and node.output_key_remap:
             out.remap_keys_(node.output_key_remap)

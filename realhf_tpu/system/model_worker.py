@@ -69,15 +69,18 @@ class ModelWorker(worker_base.Worker):
         src = self.dfg.sources[0]
         self.owns_data = src.name in self.my_nodes
         self.dataloader_iter = None
-        self.steps_per_epoch = 1
         self._epoch = 0
+        # EVERY worker loads the dataset to learn steps_per_epoch --
+        # total optimizer steps feed the lr schedule, and a trainable
+        # role hosted away from the data owner must see the same
+        # schedule. Only the owner keeps the iterator.
+        dataset = data_api.make_dataset(
+            spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
+            tokenizer_or_path=self.tokenizer)
+        self.dataloader = data_api.PackedDataLoader(
+            dataset, batch_size=src.n_seqs, seed=spec.seed)
+        self.steps_per_epoch = len(self.dataloader)
         if self.owns_data:
-            dataset = data_api.make_dataset(
-                spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
-                tokenizer_or_path=self.tokenizer)
-            self.dataloader = data_api.PackedDataLoader(
-                dataset, batch_size=src.n_seqs, seed=spec.seed)
-            self.steps_per_epoch = len(self.dataloader)
             self.dataloader_iter = iter(self.dataloader)
 
         self.eval_dataloader = None

@@ -285,3 +285,45 @@ def test_ppo_auto_offload(prompt_data):
     # trainable roles never offload
     assert not runner.models["actor"].engine.offloaded
     assert not runner.models["critic"].engine.offloaded
+
+
+def test_profile_mode_end_to_end():
+    """Profile/mock mode (reference profile_exp.py:61): the 6-MFC PPO
+    graph runs on fully synthetic data (random models + random
+    prompts) through the real runtime, recording per-MFC timings."""
+    from realhf_tpu.base import monitor
+    from realhf_tpu.experiments.profile_exp import (
+        ProfileConfig,
+        mfc_timing_summary,
+    )
+    from realhf_tpu.system.inline import InlineRunner
+
+    monitor.tmark_db().clear()
+    cfg = ProfileConfig(experiment_name="proftest", trial_name="t0",
+                        benchmark_steps=1)
+    apply_overrides(cfg, {
+        "model_size": "tiny",
+        "n_prompts": "8",
+        "prompt_len_min": "4",
+        "prompt_len_max": "8",
+        "bf16": "false",
+        "dataset.train_bs_n_seqs": "8",
+        "ppo.max_new_tokens": "4",
+        "ppo.min_new_tokens": "1",
+        "ppo.force_no_logits_mask": "true",
+        "ppo.top_k": "0",
+        "ppo.top_p": "1.0",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    assert len(spec.mfcs) == 6
+    for mspec in spec.models.values():
+        mspec.parallel = ParallelismConfig(data_parallel_size=2,
+                                           tensor_parallel_size=4)
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    assert np.isfinite(stats["actor_train"]["actor_loss"])
+    timings = mfc_timing_summary()
+    # every MFC of the graph was timed by the profiler spans
+    assert {f"mfc/{n.name}" for n in spec.mfcs} <= set(timings)
+    assert all(v > 0 for v in timings.values())

@@ -1,0 +1,98 @@
+"""SLURM scheduler client: sbatch script generation + state mapping,
+tested with an injected command runner (no slurm installation;
+reference scheduler/slurm/utils.py:167 SlurmLaunchInfo)."""
+
+import pytest
+
+from realhf_tpu.system.scheduler import (
+    JobException,
+    JobState,
+    SlurmSchedulerClient,
+)
+
+
+class FakeSlurm:
+    def __init__(self):
+        self.submitted = {}
+        self.states = {}
+        self.cancelled = []
+        self._next = 100
+
+    def __call__(self, argv):
+        if argv[0] == "sbatch":
+            jid = str(self._next)
+            self._next += 1
+            self.submitted[jid] = open(argv[-1]).read()
+            self.states[jid] = "PENDING"
+            return jid + "\n"
+        if argv[0] == "squeue":
+            jid = argv[argv.index("-j") + 1]
+            s = self.states.get(jid, "")
+            return (s + "\n") if s in ("PENDING", "RUNNING",
+                                       "COMPLETING") else ""
+        if argv[0] == "sacct":
+            jid = argv[argv.index("-j") + 1]
+            return self.states.get(jid, "") + "\n"
+        if argv[0] == "scancel":
+            self.cancelled.append(argv[1])
+            return ""
+        raise AssertionError(argv)
+
+
+@pytest.fixture
+def sched(tmp_path):
+    fake = FakeSlurm()
+    c = SlurmSchedulerClient(
+        "exp1", "t0", partition="tpu", account="team",
+        cpus_per_task=16, mem_gb=64, script_dir=str(tmp_path),
+        runner=fake)
+    return c, fake
+
+
+def test_sbatch_script_rendering(sched):
+    c, _ = sched
+    script = c.render_sbatch_script(
+        "model_worker/3",
+        ["python", "-m", "realhf_tpu.apps.remote", "worker",
+         "--index", "3"],
+        env={"JAX_PLATFORMS": "tpu", "B": "2"})
+    assert script.startswith("#!/bin/bash\n")
+    assert "#SBATCH --job-name=exp1_t0_model_worker-3" in script
+    assert "#SBATCH --partition=tpu" in script
+    assert "#SBATCH --account=team" in script
+    assert "#SBATCH --cpus-per-task=16" in script
+    assert "#SBATCH --mem=64G" in script
+    # env exports are sorted and precede the srun line
+    assert script.index("export B=2") < script.index("export JAX_PLATFORMS")
+    assert script.index("export JAX_PLATFORMS=tpu") < script.index("srun ")
+    assert "srun --ntasks=1 --kill-on-bad-exit=1 'python' '-m' " \
+        "'realhf_tpu.apps.remote' 'worker' '--index' '3'" in script
+
+
+def test_submit_find_states(sched):
+    c, fake = sched
+    c.submit("w/0", ["echo", "hi"])
+    jid = next(iter(fake.submitted))
+    assert "#SBATCH" in fake.submitted[jid]
+    assert c.find("w/0").state == JobState.PENDING
+    fake.states[jid] = "RUNNING"
+    assert c.find("w/0").state == JobState.RUNNING
+    fake.states[jid] = "COMPLETED"
+    assert c.find("w/0").state == JobState.COMPLETED
+    # CANCELLED+ suffix from sacct maps too
+    fake.states[jid] = "CANCELLED+"
+    assert c.find("w/0").state == JobState.CANCELLED
+    assert c.find("nonexistent").state == JobState.NOT_FOUND
+
+
+def test_wait_raises_on_failure_and_stop_all_cancels(sched):
+    c, fake = sched
+    c.submit("w/0", ["echo", "hi"])
+    c.submit("w/1", ["echo", "ho"])
+    jids = list(fake.submitted)
+    fake.states[jids[0]] = "COMPLETED"
+    fake.states[jids[1]] = "NODE_FAIL"
+    with pytest.raises(JobException):
+        c.wait(timeout=10)
+    c.stop_all()
+    assert set(fake.cancelled) == set(jids)
