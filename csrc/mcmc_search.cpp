@@ -1,0 +1,187 @@
+// MCMC search over per-MFC (device slice x parallel layout) assignments.
+//
+// Native counterpart of the reference's C++ search module
+// (csrc/search/search.cpp: mdm_search/multi_mcmc_search) rebuilt for a
+// TPU cost model: Python enumerates, per MFC, candidate placements
+// (contiguous chip slice + layout) with pre-estimated execution times
+// and pairwise parameter-reallocation times per role; this module runs
+// simulated annealing over candidate indices, scoring each assignment
+// by simulating the dataflow graph (list scheduling: an MFC starts
+// when its dependencies finished AND its chips are free; same-role
+// layout changes pay the realloc cost), and returns the best
+// assignment found.
+//
+// Exposed through a plain C ABI for ctypes (no pybind11 in the image).
+//
+// Layout of the flattened inputs (n = #MFCs, m = #candidates total):
+//   cand_offsets[n+1]       : MFC i's candidates are [cand_offsets[i],
+//                             cand_offsets[i+1]) in the arrays below
+//   cand_dev_lo / dev_hi[m] : chip slice [lo, hi) of each candidate
+//   cand_time[m]            : execution seconds of each candidate
+//   roles[n]                : role id per MFC (realloc accounting)
+//   trainable[n]            : 1 if the MFC trains its role
+//   deps[n*n]               : deps[i*n+j] = 1 iff j must finish before i
+//   realloc_time[m*m]       : seconds to move role weights between the
+//                             layouts of candidates a and b (0 = free);
+//                             only consulted for same-role transitions
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Problem {
+  int n_mfcs;
+  int n_devices;
+  const int64_t* cand_offsets;
+  const int32_t* cand_dev_lo;
+  const int32_t* cand_dev_hi;
+  const double* cand_time;
+  const int32_t* roles;
+  const int32_t* trainable;
+  const int8_t* deps;
+  const double* realloc_time;
+  int64_t n_cands;
+};
+
+// Simulate one training step of the DFG under an assignment.
+// Greedy list scheduling in topological order of ready times.
+double simulate(const Problem& p, const std::vector<int64_t>& pick) {
+  const int n = p.n_mfcs;
+  std::vector<double> finish(n, -1.0);
+  std::vector<double> dev_free(p.n_devices, 0.0);
+  // Where each role's weights currently live (candidate index of the
+  // last MFC that used them); -1 = resident at the trainable layout.
+  std::vector<int> done(n, 0);
+  int n_done = 0;
+
+  // role -> candidate index of its trainable ("home") layout, if any
+  std::vector<int64_t> home(n, -1);
+  for (int i = 0; i < n; ++i) {
+    if (p.trainable[i]) {
+      for (int j = 0; j < n; ++j)
+        if (p.roles[j] == p.roles[i]) home[j] = pick[i];
+    }
+  }
+
+  while (n_done < n) {
+    // pick the ready MFC with the earliest possible start
+    int best = -1;
+    double best_start = 1e30;
+    for (int i = 0; i < n; ++i) {
+      if (done[i]) continue;
+      bool ready = true;
+      double dep_t = 0.0;
+      for (int j = 0; j < n; ++j) {
+        if (p.deps[(size_t)i * n + j]) {
+          if (!done[j]) { ready = false; break; }
+          dep_t = std::max(dep_t, finish[j]);
+        }
+      }
+      if (!ready) continue;
+      const int64_t c = pick[i];
+      double dev_t = 0.0;
+      for (int d = p.cand_dev_lo[c]; d < p.cand_dev_hi[c]; ++d)
+        dev_t = std::max(dev_t, dev_free[d]);
+      const double start = std::max(dep_t, dev_t);
+      if (start < best_start) { best_start = start; best = i; }
+    }
+    if (best < 0) return 1e30;  // cyclic deps: reject
+    const int64_t c = pick[best];
+    double cost = p.cand_time[c];
+    // weights arrive from the role's home layout when they differ
+    if (home[best] >= 0 && home[best] != c)
+      cost += p.realloc_time[(size_t)home[best] * p.n_cands + c];
+    // a trained role must return its weights home afterwards; the
+    // reverse realloc is charged to the consumer side above, so only
+    // charge the forward move here.
+    const double end = best_start + cost;
+    finish[best] = end;
+    for (int d = p.cand_dev_lo[c]; d < p.cand_dev_hi[c]; ++d)
+      dev_free[d] = end;
+    done[best] = 1;
+    ++n_done;
+  }
+  double mk = 0.0;
+  for (int i = 0; i < n; ++i) mk = std::max(mk, finish[i]);
+  return mk;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns the best simulated step time; writes the chosen candidate
+// index per MFC into out_pick[n_mfcs].
+double mcmc_search(
+    int n_mfcs, int n_devices,
+    const int64_t* cand_offsets,
+    const int32_t* cand_dev_lo, const int32_t* cand_dev_hi,
+    const double* cand_time,
+    const int32_t* roles, const int32_t* trainable,
+    const int8_t* deps,
+    const double* realloc_time, int64_t n_cands,
+    int64_t n_steps, double beta0, double beta1, uint64_t seed,
+    int64_t* out_pick) {
+  Problem p{n_mfcs, n_devices, cand_offsets, cand_dev_lo, cand_dev_hi,
+            cand_time, roles, trainable, deps, realloc_time, n_cands};
+  std::mt19937_64 rng(seed);
+
+  std::vector<int64_t> pick(n_mfcs);
+  for (int i = 0; i < n_mfcs; ++i) pick[i] = cand_offsets[i];
+  // Trainable MFCs of a role and their home layout interact; start
+  // from the first candidate everywhere, then anneal.
+  double cur = simulate(p, pick);
+  std::vector<int64_t> best_pick = pick;
+  double best = cur;
+
+  std::uniform_int_distribution<int> pick_mfc(0, n_mfcs - 1);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+
+  for (int64_t step = 0; step < n_steps; ++step) {
+    const int i = pick_mfc(rng);
+    const int64_t lo = cand_offsets[i], hi = cand_offsets[i + 1];
+    if (hi - lo <= 1) continue;
+    std::uniform_int_distribution<int64_t> pick_cand(lo, hi - 1);
+    const int64_t old = pick[i];
+    int64_t next = pick_cand(rng);
+    if (next == old) continue;
+    pick[i] = next;
+    const double trial = simulate(p, pick);
+    // linear annealing beta0 -> beta1 (inverse temperature)
+    const double beta =
+        beta0 + (beta1 - beta0) * (double)step / (double)n_steps;
+    if (trial <= cur ||
+        unif(rng) < std::exp(-beta * (trial - cur))) {
+      cur = trial;
+      if (cur < best) { best = cur; best_pick = pick; }
+    } else {
+      pick[i] = old;
+    }
+  }
+  std::memcpy(out_pick, best_pick.data(),
+              sizeof(int64_t) * (size_t)n_mfcs);
+  return best;
+}
+
+// Simulate a single explicit assignment (cost-model introspection).
+double simulate_assignment(
+    int n_mfcs, int n_devices,
+    const int64_t* cand_offsets,
+    const int32_t* cand_dev_lo, const int32_t* cand_dev_hi,
+    const double* cand_time,
+    const int32_t* roles, const int32_t* trainable,
+    const int8_t* deps,
+    const double* realloc_time, int64_t n_cands,
+    const int64_t* pick) {
+  Problem p{n_mfcs, n_devices, cand_offsets, cand_dev_lo, cand_dev_hi,
+            cand_time, roles, trainable, deps, realloc_time, n_cands};
+  std::vector<int64_t> v(pick, pick + n_mfcs);
+  return simulate(p, v);
+}
+
+}  // extern "C"

@@ -49,10 +49,7 @@ def main(argv=None):
     spec = cfg.build()
     spec.n_model_workers = cfg.n_model_workers
     spec.worker_assignment = cfg.parsed_worker_assignment()
-    if cfg.allocation_mode == "heuristic":
-        from realhf_tpu.experiments.heuristic import (
-            apply_heuristic_allocations,
-        )
+    if cfg.allocation_mode in ("heuristic", "search"):
         # default_devices respects REALHF_TPU_BACKEND and never probes
         # the default (TPU) backend from the launcher process -- TPU
         # init here could block and would hold the chip the spawned
@@ -61,14 +58,25 @@ def main(argv=None):
             n = cfg.n_devices
         elif cfg.mode == "distributed":
             raise ValueError(
-                "allocation_mode=heuristic with mode=distributed "
-                "requires n_devices=<per-worker chip count> (the "
-                "launcher must not initialize the workers' backend).")
+                f"allocation_mode={cfg.allocation_mode} with "
+                "mode=distributed requires n_devices=<per-worker chip "
+                "count> (the launcher must not initialize the workers' "
+                "backend).")
         else:
             from realhf_tpu.parallel.mesh import default_devices
             n = len(default_devices())
-        apply_heuristic_allocations(spec, n)
-        logger.info("Heuristic allocations on %d devices: %s", n,
+        if cfg.allocation_mode == "heuristic":
+            from realhf_tpu.experiments.heuristic import (
+                apply_heuristic_allocations,
+            )
+            apply_heuristic_allocations(spec, n)
+        else:
+            # C++ MCMC search over (device slice x layout) assignments
+            from realhf_tpu.search import apply_searched_allocations
+            res = apply_searched_allocations(spec, n)
+            logger.info("Search: best simulated step %.3fs", res.time)
+        logger.info("%s allocations on %d devices: %s",
+                    cfg.allocation_mode, n,
                     {k: str(v) for k, v in spec.allocations.items()})
 
     if cfg.mode == "distributed":

@@ -240,12 +240,117 @@ class NfsNameRecordRepository(NameRecordRepository):
 DEFAULT_REPOSITORY_TYPE = os.environ.get("REALHF_TPU_NAME_RESOLVE", "nfs")
 
 
+class RedisNameRecordRepository(NameRecordRepository):
+    """Redis backend (reference :357): keys with a TTL refreshed by a
+    keepalive thread, so entries of dead processes expire on their own
+    (the liveness signal NFS cannot give).
+
+    The ``redis`` package is not part of the base image; pass a
+    constructed ``client`` (any object with the used subset of the
+    redis-py API -- get/set/delete/scan_iter/expire) or install redis.
+    """
+
+    KEEPALIVE_POLL_FREQUENCY = 2.0
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 db: int = 0, password: Optional[str] = None,
+                 client=None):
+        if client is None:
+            try:
+                import redis
+            except ImportError as e:
+                raise RuntimeError(
+                    "name_resolve type 'redis' needs the redis package "
+                    "(not in this image) or an injected client=..."
+                ) from e
+            client = redis.Redis(host=host, port=port, db=db,
+                                 password=password,
+                                 decode_responses=True)
+        self.__client = client
+        self.__to_delete = set()
+        self.__keepalive_ttl: Dict[str, float] = {}
+        self.__stop = threading.Event()
+        self.__keepalive_thread = threading.Thread(
+            target=self.__keepalive_loop, daemon=True)
+        self.__keepalive_thread.start()
+
+    def __keepalive_loop(self):
+        # refresh TTLs so only live processes keep their entries
+        # (reference keepalive thread, name_resolve.py:476)
+        while not self.__stop.wait(self.KEEPALIVE_POLL_FREQUENCY):
+            for name, ttl in list(self.__keepalive_ttl.items()):
+                try:
+                    self.__client.expire(name, int(max(1, ttl)))
+                except Exception:  # noqa: BLE001 - retry next tick
+                    pass
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        if not replace and self.__client.get(name) is not None:
+            raise NameEntryExistsError(name)
+        if keepalive_ttl is not None:
+            self.__client.set(name, str(value),
+                              ex=int(max(1, keepalive_ttl)))
+            self.__keepalive_ttl[name] = keepalive_ttl
+        else:
+            self.__client.set(name, str(value))
+            # re-registering without a TTL must stop the keepalive
+            # thread from re-arming expiry on the now-persistent entry
+            self.__keepalive_ttl.pop(name, None)
+        if delete_on_exit:
+            self.__to_delete.add(name)
+
+    def delete(self, name):
+        if self.__client.delete(name) == 0:
+            raise NameEntryNotFoundError(name)
+        self.__to_delete.discard(name)
+        self.__keepalive_ttl.pop(name, None)
+
+    def clear_subtree(self, name_root):
+        for key in list(self.__client.scan_iter(
+                match=name_root.rstrip("/") + "/*")):
+            self.__client.delete(key)
+            self.__keepalive_ttl.pop(key, None)
+
+    def get(self, name):
+        v = self.__client.get(name.rstrip("/"))
+        if v is None:
+            raise NameEntryNotFoundError(name)
+        return v
+
+    def find_subtree(self, name_root):
+        return sorted(self.__client.scan_iter(
+            match=name_root.rstrip("/") + "/*"))
+
+    def get_subtree(self, name_root):
+        # keys may TTL-expire between scan and get (that auto-expiry
+        # of dead workers is the point of this backend): skip them
+        out = []
+        for k in self.find_subtree(name_root):
+            v = self.__client.get(k)
+            if v is not None:
+                out.append(v)
+        return out
+
+    def reset(self):
+        self.__stop.set()
+        for name in list(self.__to_delete):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self.__to_delete = set()
+
+
 def make_repository(type_: Optional[str] = None, **kwargs) -> NameRecordRepository:
     type_ = type_ or DEFAULT_REPOSITORY_TYPE
     if type_ == "memory":
         return MemoryNameRecordRepository(**kwargs)
     if type_ == "nfs":
         return NfsNameRecordRepository(**kwargs)
+    if type_ == "redis":
+        return RedisNameRecordRepository(**kwargs)
     raise NotImplementedError(f"Unknown name_resolve repository type: {type_}")
 
 

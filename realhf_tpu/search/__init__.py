@@ -1,0 +1,7 @@
+from realhf_tpu.search.engine import (  # noqa: F401
+    MFCWorkload,
+    SearchResult,
+    apply_searched_allocations,
+    search_rpc_allocations,
+    suggest_worker_assignment,
+)

@@ -23,7 +23,13 @@ from typing import Dict, Optional
 from realhf_tpu.api import data as data_api
 from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import DFG
-from realhf_tpu.base import constants, logging, seeding
+from realhf_tpu.base import (
+    constants,
+    logging,
+    name_resolve,
+    names,
+    seeding,
+)
 from realhf_tpu.system import worker_base
 from realhf_tpu.system.data_plane import DataClient, DataServer, DataStore
 from realhf_tpu.system.model_host import ModelHost
@@ -70,18 +76,33 @@ class ModelWorker(worker_base.Worker):
         self.owns_data = src.name in self.my_nodes
         self.dataloader_iter = None
         self._epoch = 0
-        # EVERY worker loads the dataset to learn steps_per_epoch --
-        # total optimizer steps feed the lr schedule, and a trainable
-        # role hosted away from the data owner must see the same
-        # schedule. Only the owner keeps the iterator.
-        dataset = data_api.make_dataset(
-            spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
-            tokenizer_or_path=self.tokenizer)
-        self.dataloader = data_api.PackedDataLoader(
-            dataset, batch_size=src.n_seqs, seed=spec.seed)
-        self.steps_per_epoch = len(self.dataloader)
+        # steps_per_epoch feeds every trainable role's lr schedule, so
+        # all workers must agree on it. The data owner loads the
+        # dataset and publishes the count; other workers read it (or
+        # fall back to loading the dataset themselves when they
+        # configure before the owner).
+        steps_key = (names.trial_root(spec.experiment_name,
+                                      spec.trial_name)
+                     + "/steps_per_epoch")
         if self.owns_data:
+            dataset = data_api.make_dataset(
+                spec.dataset, seed=spec.seed, dp_rank=0, world_size=1,
+                tokenizer_or_path=self.tokenizer)
+            self.dataloader = data_api.PackedDataLoader(
+                dataset, batch_size=src.n_seqs, seed=spec.seed)
+            self.steps_per_epoch = len(self.dataloader)
             self.dataloader_iter = iter(self.dataloader)
+            name_resolve.add(steps_key, str(self.steps_per_epoch),
+                             replace=True, delete_on_exit=False)
+        else:
+            try:
+                self.steps_per_epoch = int(name_resolve.get(steps_key))
+            except name_resolve.NameEntryNotFoundError:
+                dataset = data_api.make_dataset(
+                    spec.dataset, seed=spec.seed, dp_rank=0,
+                    world_size=1, tokenizer_or_path=self.tokenizer)
+                self.steps_per_epoch = len(data_api.PackedDataLoader(
+                    dataset, batch_size=src.n_seqs, seed=spec.seed))
 
         self.eval_dataloader = None
         if spec.eval_dataset is not None and any(

@@ -11,6 +11,7 @@ doubles as the per-host bootstrapper.
 import dataclasses
 import enum
 import os
+import shlex
 import signal
 import subprocess
 import time
@@ -204,8 +205,8 @@ class SlurmSchedulerClient(SchedulerClient):
             lines.append(f"#SBATCH --container-image="
                          f"{self.container_image}")
         for k, v in sorted((env or {}).items()):
-            lines.append(f"export {k}={v}")
-        quoted = " ".join(f"'{c}'" for c in cmd)
+            lines.append(f"export {k}={shlex.quote(str(v))}")
+        quoted = " ".join(shlex.quote(c) for c in cmd)
         lines.append(f"srun --ntasks={n_tasks} --kill-on-bad-exit=1 "
                      f"{quoted}")
         return "\n".join(lines) + "\n"

@@ -1,0 +1,94 @@
+"""Redis name_resolve backend against an in-memory fake client
+(reference base/name_resolve.py:357; no redis server in CI)."""
+
+import fnmatch
+import time
+
+import pytest
+
+from realhf_tpu.base.name_resolve import (
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    RedisNameRecordRepository,
+    make_repository,
+)
+
+
+class FakeRedis:
+    def __init__(self):
+        self.store = {}
+        self.ttls = {}
+
+    def get(self, k):
+        return self.store.get(k)
+
+    def set(self, k, v, ex=None):
+        self.store[k] = v
+        if ex is not None:
+            self.ttls[k] = ex
+
+    def delete(self, k):
+        self.ttls.pop(k, None)
+        return 1 if self.store.pop(k, None) is not None else 0
+
+    def scan_iter(self, match="*"):
+        return [k for k in self.store if fnmatch.fnmatch(k, match)]
+
+    def expire(self, k, ttl):
+        if k in self.store:
+            self.ttls[k] = ttl
+
+
+@pytest.fixture
+def repo():
+    fake = FakeRedis()
+    r = RedisNameRecordRepository(client=fake)
+    yield r, fake
+    r.reset()
+
+
+def test_add_get_delete(repo):
+    r, fake = repo
+    r.add("a/b/c", "v1")
+    assert r.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        r.add("a/b/c", "v2")
+    r.add("a/b/c", "v2", replace=True)
+    assert r.get("a/b/c") == "v2"
+    r.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        r.get("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        r.delete("a/b/c")
+
+
+def test_subtree_and_reset(repo):
+    r, fake = repo
+    r.add("root/x/1", "a")
+    r.add("root/x/2", "b")
+    r.add("other/y", "c")
+    assert r.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    assert r.get_subtree("root/x") == ["a", "b"]
+    r.clear_subtree("root")
+    assert r.find_subtree("root") == []
+    assert r.get("other/y") == "c"
+    r.reset()  # delete_on_exit entries removed
+    assert fake.get("other/y") is None
+
+
+def test_keepalive_ttl_refresh(repo):
+    r, fake = repo
+    r.KEEPALIVE_POLL_FREQUENCY = 0.05
+    r.add("live/worker", "up", keepalive_ttl=7.0)
+    assert fake.ttls["live/worker"] == 7
+    fake.ttls["live/worker"] = 0  # simulate decay
+    deadline = time.monotonic() + 3
+    while fake.ttls["live/worker"] == 0:
+        assert time.monotonic() < deadline, "keepalive never refreshed"
+        time.sleep(0.05)
+    assert fake.ttls["live/worker"] == 7
+
+
+def test_make_repository_without_redis_package():
+    with pytest.raises(RuntimeError, match="redis"):
+        make_repository("redis")

@@ -65,8 +65,13 @@ def test_sbatch_script_rendering(sched):
     # env exports are sorted and precede the srun line
     assert script.index("export B=2") < script.index("export JAX_PLATFORMS")
     assert script.index("export JAX_PLATFORMS=tpu") < script.index("srun ")
-    assert "srun --ntasks=1 --kill-on-bad-exit=1 'python' '-m' " \
-        "'realhf_tpu.apps.remote' 'worker' '--index' '3'" in script
+    assert "srun --ntasks=1 --kill-on-bad-exit=1 python -m " \
+        "realhf_tpu.apps.remote worker --index 3" in script
+    # shell metacharacters are quoted (shlex)
+    risky = c.render_sbatch_script(
+        "w", ["echo", "a b"], env={"X": "p q; rm -rf /"})
+    assert "export X='p q; rm -rf /'" in risky
+    assert "echo 'a b'" in risky
 
 
 def test_submit_find_states(sched):
