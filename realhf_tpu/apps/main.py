@@ -73,12 +73,13 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
                      for i in range(spec.n_model_workers)]
                     + ["master_worker/0"])
     sched = make_scheduler("local")
+    # Stale keys from a previous run of the same trial (worker
+    # addresses, steps_per_epoch, experiment status) must not leak
+    # into this one (reference main.py:138-147 clear_subtree).
+    name_resolve.clear_subtree(
+        names.trial_root(spec.experiment_name, spec.trial_name))
     status_key = names.experiment_status(spec.experiment_name,
                                          spec.trial_name)
-    try:
-        name_resolve.delete(status_key)
-    except Exception:  # noqa: BLE001 - fresh trial, nothing to delete
-        pass
 
     try:
         for i in range(spec.n_model_workers):

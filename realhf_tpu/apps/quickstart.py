@@ -75,6 +75,18 @@ def main(argv=None):
             from realhf_tpu.search import apply_searched_allocations
             res = apply_searched_allocations(spec, n)
             logger.info("Search: best simulated step %.3fs", res.time)
+            if (cfg.mode == "distributed" and not spec.worker_assignment
+                    and cfg.n_model_workers == 1
+                    and res.worker_assignment):
+                # realize the simulator's slice concurrency: disjoint
+                # role groups become separate worker processes
+                spec.worker_assignment = res.worker_assignment
+                spec.n_model_workers = (
+                    max(res.worker_assignment.values()) + 1)
+                logger.info(
+                    "Search-derived worker assignment: %s "
+                    "(%d model workers)", spec.worker_assignment,
+                    spec.n_model_workers)
         logger.info("%s allocations on %d devices: %s",
                     cfg.allocation_mode, n,
                     {k: str(v) for k, v in spec.allocations.items()})

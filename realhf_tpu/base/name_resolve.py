@@ -276,8 +276,15 @@ class RedisNameRecordRepository(NameRecordRepository):
 
     def __keepalive_loop(self):
         # refresh TTLs so only live processes keep their entries
-        # (reference keepalive thread, name_resolve.py:476)
-        while not self.__stop.wait(self.KEEPALIVE_POLL_FREQUENCY):
+        # (reference keepalive thread, name_resolve.py:476); poll at
+        # least 3x faster than the shortest TTL or the entry would
+        # expire before its first refresh
+        while True:
+            ttls = list(self.__keepalive_ttl.values())
+            poll = min([self.KEEPALIVE_POLL_FREQUENCY]
+                       + [t / 3.0 for t in ttls])
+            if self.__stop.wait(max(0.05, poll)):
+                return
             for name, ttl in list(self.__keepalive_ttl.items()):
                 try:
                     self.__client.expire(name, int(max(1, ttl)))
@@ -287,14 +294,17 @@ class RedisNameRecordRepository(NameRecordRepository):
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
             replace=False):
         name = name.rstrip("/")
-        if not replace and self.__client.get(name) is not None:
-            raise NameEntryExistsError(name)
+        ex = None if keepalive_ttl is None else int(max(1, keepalive_ttl))
+        if replace:
+            self.__client.set(name, str(value), ex=ex)
+        else:
+            # atomic create (SET NX): a get-then-set race would let two
+            # processes both claim the same rendezvous key
+            if not self.__client.set(name, str(value), ex=ex, nx=True):
+                raise NameEntryExistsError(name)
         if keepalive_ttl is not None:
-            self.__client.set(name, str(value),
-                              ex=int(max(1, keepalive_ttl)))
             self.__keepalive_ttl[name] = keepalive_ttl
         else:
-            self.__client.set(name, str(value))
             # re-registering without a TTL must stop the keepalive
             # thread from re-arming expiry on the now-persistent entry
             self.__keepalive_ttl.pop(name, None)

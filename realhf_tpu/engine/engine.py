@@ -84,14 +84,16 @@ class Engine:
 
         if (cfg.mlp_type == "moe" and cfg.moe is not None
                 and cfg.moe.capacity_factor is None
-                and cfg.moe.num_experts > 4):
+                and cfg.moe.num_experts > 4
+                and not (cfg.moe.use_grouped_gemm
+                         and hasattr(jax.lax, "ragged_dot"))):
             logger.warning(
                 "MoE model running in dense dispatch (capacity_factor "
-                "unset): every expert processes every token -- "
-                "%dx the FLOPs of top-%d routing. Set "
-                "MoEConfig.capacity_factor (e.g. 1.25) for capacity "
-                "dispatch.", cfg.moe.num_experts // cfg.moe.top_k,
-                cfg.moe.top_k)
+                "unset, grouped GEMM disabled): every expert processes "
+                "every token -- %dx the FLOPs of top-%d routing. Set "
+                "MoEConfig.use_grouped_gemm=True (ragged_dot) or "
+                "capacity_factor (e.g. 1.25).",
+                cfg.moe.num_experts // cfg.moe.top_k, cfg.moe.top_k)
 
         self.optimizer_config = optimizer
         if optimizer is not None and optimizer.type != "empty":

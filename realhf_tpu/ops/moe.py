@@ -10,10 +10,15 @@ the stacked [E, H, F] weights, which GSPMD shards over the "model"
 axis (TP-sharded experts, the reference's layout) and can shard over
 an expert axis for true EP.
 
-Two dispatch modes:
-- ``capacity_factor=None``: dense mode -- every expert sees every
-  token, weighted by its gate (exact; cost E/topk times higher; used
-  for small models and correctness tests).
+Three dispatch modes:
+- ``capacity_factor=None`` + ``use_grouped_gemm`` (default): RAGGED
+  mode -- (token, k) pairs sorted by expert feed
+  ``jax.lax.ragged_dot`` grouped GEMMs (the true grouped-GEMM
+  equivalent of reference experts.py:98 GroupedMLP, lowered to TPU
+  ragged matmuls). Exact (no token dropping), top-k cost only.
+- ``capacity_factor=None`` + ``use_grouped_gemm=False``: dense mode --
+  every expert sees every token, weighted by its gate (exact; E/topk
+  times the FLOPs; the correctness reference for tests).
 - ``capacity_factor=c``: capacity dispatch -- each expert processes at
   most c * T * topk / E tokens; overflow tokens are dropped from that
   expert (standard Switch/GShard semantics, reference
@@ -119,6 +124,34 @@ def _expert_ffn(cfg: TransformerConfig, m: Dict, xs: jnp.ndarray
                       m["wd"].astype(cdt))
 
 
+def _ragged_moe(cfg: TransformerConfig, m: Dict, xt: jnp.ndarray,
+                top_probs: jnp.ndarray, top_idx: jnp.ndarray
+                ) -> jnp.ndarray:
+    """Grouped-GEMM dispatch: sort (token, k) pairs by expert, run
+    ``jax.lax.ragged_dot`` per projection over the stacked [E, H, F]
+    weights, scatter-add gate-weighted outputs back. Exact top-k MoE
+    (reference GroupedMLP, experts.py:98) with static shapes."""
+    from realhf_tpu.models.transformer import _activation
+    t, h = xt.shape
+    e = cfg.moe.num_experts
+    k = cfg.moe.top_k
+    cdt = xt.dtype
+
+    flat_expert = top_idx.reshape(-1)                 # [T*k]
+    order = jnp.argsort(flat_expert)                  # sort by expert
+    tok_idx = order // k
+    xs = xt[tok_idx]                                  # [T*k, H] sorted
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    gate = jax.lax.ragged_dot(xs, m["wg"].astype(cdt), group_sizes)
+    up = jax.lax.ragged_dot(xs, m["wu"].astype(cdt), group_sizes)
+    down = jax.lax.ragged_dot(_activation(cfg, gate) * up,
+                              m["wd"].astype(cdt), group_sizes)
+    gates_sorted = top_probs.reshape(-1)[order]       # pads carry 0
+    weighted = down.astype(jnp.float32) * gates_sorted[:, None]
+    return jnp.zeros((t, h), jnp.float32).at[tok_idx].add(weighted)
+
+
 def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
                         rng: Optional[jax.Array] = None,
                         valid_mask: Optional[jnp.ndarray] = None
@@ -147,7 +180,11 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
     top_probs = top_probs * valid[:, None]
 
     e = moe.num_experts
-    if moe.capacity_factor is None:
+    if moe.capacity_factor is None and moe.use_grouped_gemm \
+            and hasattr(jax.lax, "ragged_dot"):
+        out = _ragged_moe(cfg, m, xt.astype(x.dtype), top_probs,
+                          top_idx)
+    elif moe.capacity_factor is None:
         # Dense mode: every expert over all tokens, gate-weighted.
         xs = jnp.broadcast_to(xt[None], (e, t, h)).astype(x.dtype)
         expert_out = _expert_ffn(cfg, m, xs)  # [E, T, H]

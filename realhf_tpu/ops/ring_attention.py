@@ -187,7 +187,7 @@ def ring_attention(
         # the dense [Lq_loc, Lk_loc] score tensor.
         bq_fit = _fit_block(lc, block_q)
         bk_fit = _fit_block(lc, block_k)
-        blockwise = lc > bq_fit
+        blockwise = lc > bq_fit or lc > bk_fit
 
         def body(r, carry):
             m, lsum, acc, k, v, seg_k = carry

@@ -53,13 +53,22 @@ def load_native():
     global _lib
     if _lib is not None:
         return _lib
-    so = os.path.join(_build_dir(), "libmcmc_search.so")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(_CSRC)):
+    # cache key = source content hash (mtime is meaningless after a
+    # fresh clone, and the .so is never committed -- platform-specific)
+    import hashlib
+    with open(_CSRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_build_dir(), f"libmcmc_search-{digest}.so")
+    if not os.path.exists(so):
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                _CSRC, "-o", so]
         logger.info("Building native search module: %s", " ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=True)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           text=True)
+        except subprocess.CalledProcessError as e:
+            logger.error("Native search build failed:\n%s", e.stderr)
+            raise
     lib = ctypes.CDLL(so)
     i64p = ctypes.POINTER(ctypes.c_int64)
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -211,18 +220,6 @@ def enumerate_candidates(w: MFCWorkload, n_devices: int,
     return out
 
 
-def realloc_seconds(param_bytes: float, a: Candidate, b: Candidate,
-                    cm: TPUCostModel) -> float:
-    """Move a role's weights between two placements: each
-    participating chip moves ~its shard over ICI (overlapping slices)
-    -- bounded by the smaller slice's aggregate bandwidth."""
-    if (a.parallel.same_layout(b.parallel)
-            and (a.dev_lo, a.dev_hi) == (b.dev_lo, b.dev_hi)):
-        return 0.0
-    chips = min(a.dev_hi - a.dev_lo, b.dev_hi - b.dev_lo)
-    return param_bytes / (chips * cm.ici_bandwidth)
-
-
 # ---------------------------------------------------------------------
 # Public entry points
 # ---------------------------------------------------------------------
@@ -283,9 +280,11 @@ def _flatten(workloads: List[MFCWorkload], deps: Dict[str, List[str]],
     role_ids: Dict[str, int] = {}
     cand_owner = np.concatenate(
         [np.full(len(cl), i) for i, cl in enumerate(cands)])
-    # vectorized pairwise realloc matrix (the C++ simulator reads only
-    # same-role home->candidate rows, but a dense numpy build is cheap
-    # compared with m^2 Python calls)
+    # vectorized pairwise realloc cost: moving a role's weights
+    # between two placements is bounded by the smaller slice's
+    # aggregate ICI bandwidth; identical (layout, slice) pairs are
+    # free. (The C++ simulator reads only same-role home->candidate
+    # rows, but the dense numpy build is cheap.)
     lo = np.asarray([c.dev_lo for c in flat])
     hi = np.asarray([c.dev_hi for c in flat])
     sizes = hi - lo

@@ -45,3 +45,43 @@ def test_flop_formulas_positive():
         head_dim=16, intermediate_dim=128, vocab_size=256,
         seqlens=[32, 16])
     assert f > 0
+
+
+def test_kernel_classification(tmp_path):
+    """Chrome-trace kernel classification (reference
+    kernelStatFromTrace, monitor.py:517-699) against a synthetic
+    TPU-shaped trace: device tracks aggregated by category, host
+    tracks ignored."""
+    import gzip
+    import json
+
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "python host"}},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "fusion.12",
+         "ts": 1000, "dur": 500},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "dot_general.3",
+         "ts": 1500, "dur": 300},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce.1",
+         "ts": 1600, "dur": 200},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "copy.7",
+         "ts": 1900, "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 0, "name": "weird-op",
+         "ts": 2000, "dur": 50},
+        # host event must be ignored
+        {"ph": "X", "pid": 9, "tid": 0, "name": "fusion.fake",
+         "ts": 0, "dur": 99999},
+    ]}
+    p = tmp_path / "host.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump(trace, f)
+
+    stats = monitor.kernel_stats_from_trace(str(tmp_path))
+    assert stats["compute"] == pytest.approx(800e-6)
+    assert stats["comm"] == pytest.approx(200e-6)
+    assert stats["mem"] == pytest.approx(100e-6)
+    assert stats["misc"] == pytest.approx(50e-6)
+    assert stats["total_busy"] == pytest.approx(1150e-6)
+    assert stats["span"] == pytest.approx((2050 - 1000) * 1e-6)

@@ -22,10 +22,13 @@ class FakeRedis:
     def get(self, k):
         return self.store.get(k)
 
-    def set(self, k, v, ex=None):
+    def set(self, k, v, ex=None, nx=False):
+        if nx and k in self.store:
+            return None
         self.store[k] = v
         if ex is not None:
             self.ttls[k] = ex
+        return True
 
     def delete(self, k):
         self.ttls.pop(k, None)

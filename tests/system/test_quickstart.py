@@ -327,3 +327,30 @@ def test_profile_mode_end_to_end():
     # every MFC of the graph was timed by the profiler spans
     assert {f"mfc/{n.name}" for n in spec.mfcs} <= set(timings)
     assert all(v > 0 for v in timings.values())
+
+
+def test_grpo_end_to_end(prompt_data):
+    """Critic-free GRPO experiment: 4-MFC graph (no value model),
+    group sampling nested in batch elements, runs end to end."""
+    from realhf_tpu.experiments.grpo_exp import GRPOConfig
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = GRPOConfig(experiment_name="grpotest", trial_name="t0",
+                     total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "4",
+        "dataset.max_seqlen": "16",
+        "grpo.max_new_tokens": "6",
+        "grpo.min_new_tokens": "1",
+        "grpo.group_size": "4",
+        "grpo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    assert len(spec.mfcs) == 4
+    assert "critic" not in spec.models
+    _patch_random_models(spec, FakeTokenizer())
+    runner = InlineRunner(spec)
+    stats = runner.run()
+    assert np.isfinite(stats["actor_train"]["grpo_loss"])
+    assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
