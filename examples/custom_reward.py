@@ -1,0 +1,65 @@
+"""Example: a custom rule-based reward interface.
+
+TPU-native counterpart of the reference's customized-experiment
+example (``examples/customized_exp/ppo_sentiment.py``): instead of a
+reward MODEL, score sequences with arbitrary Python (here: fraction of
+response tokens equal to a target token). Register it under a name and
+point any experiment's reward MFC at it -- no framework fork needed.
+
+Use from the CLI via user-code injection::
+
+    REALHF_TPU_PACKAGE_PATH=examples/custom_reward.py \
+        python -m realhf_tpu.apps.quickstart ppo ... \
+        # then override the reward MFC interface in a custom experiment
+"""
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base.datapack import flat2d
+
+
+@dataclasses.dataclass
+class TokenCountReward(model_api.ModelInterface):
+    """Reward = fraction of non-prompt tokens equal to ``target_token``
+    (a stand-in for any rule-based / external scorer: sentiment
+    classifier, verifier, unit-test runner, ...). Needs no model
+    forward at all -- the reward "model" role can be a tiny stub."""
+
+    target_token: int = 10
+    scale: float = 1.0
+
+    def inference(self, model: model_api.Model, input_: SequenceSample,
+                  n_mbs: Optional[int] = None) -> SequenceSample:
+        seqlens = flat2d(input_.seqlens["packed_input_ids"])
+        ids = np.asarray(input_.data["packed_input_ids"])
+        pm = input_.data.get("prompt_mask")
+        pm = (np.asarray(pm, bool) if pm is not None
+              else np.zeros_like(ids, bool))
+        rewards, off = [], 0
+        for l in seqlens:
+            tok = ids[off:off + l]
+            keep = ~pm[off:off + l]
+            denom = max(int(keep.sum()), 1)
+            rewards.append(
+                self.scale * float((tok[keep] == self.target_token).sum())
+                / denom)
+            off += l
+        nested = [[1] * len(lens)
+                  for lens in input_.seqlens["packed_input_ids"]]
+        with SequenceSample.disable_validation():
+            return SequenceSample(
+                keys=["rewards"],
+                trailing_shapes=dict(rewards=()),
+                dtypes=dict(rewards=np.float32),
+                ids=list(input_.ids),
+                seqlens=dict(rewards=nested),
+                data=dict(rewards=np.asarray(rewards, np.float32)),
+                metadata={})
+
+
+model_api.register_interface("token_count_reward", TokenCountReward)

@@ -32,6 +32,9 @@ def parse_overrides(tokens):
 
 def main(argv=None):
     import realhf_tpu.experiments as experiments
+    from realhf_tpu.base.importing import import_usercode
+
+    import_usercode()  # REALHF_TPU_PACKAGE_PATH custom registrations
 
     argv = argv if argv is not None else sys.argv[1:]
     parser = argparse.ArgumentParser("realhf_tpu quickstart")

@@ -16,6 +16,9 @@ def main_worker(args):
         force_cpu_backend()
 
     from realhf_tpu.base import name_resolve
+    from realhf_tpu.base.importing import import_usercode
+
+    import_usercode()  # custom interfaces must register in workers too
 
     if os.environ.get("REALHF_TPU_NAME_RESOLVE_ROOT"):
         name_resolve.reconfigure(

@@ -1,0 +1,55 @@
+"""User-code injection (reference ``base/importing.py`` +
+``apps/remote.py:25-45`` _patch_external_impl): the env var
+``REALHF_TPU_PACKAGE_PATH`` names one or more Python files or package
+directories (colon-separated) imported at startup by the quickstart
+CLI and every spawned worker, so custom datasets / interfaces /
+experiments register themselves into the framework registries without
+forking the repo."""
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("importing")
+
+PACKAGE_PATH_ENV = "REALHF_TPU_PACKAGE_PATH"
+
+
+def import_module_from_path(path: str):
+    """Import a .py file or a package directory by filesystem path."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        init = os.path.join(path, "__init__.py")
+        if not os.path.exists(init):
+            raise FileNotFoundError(
+                f"{path} is a directory without __init__.py")
+        base = os.path.basename(path.rstrip("/"))
+        target = init
+    else:
+        base = os.path.splitext(os.path.basename(path))[0]
+        target = path
+    # mangled module name: a user file called logging.py/redis.py must
+    # not shadow stdlib/installed modules in sys.modules
+    name = f"realhf_tpu_usercode_{base}"
+    spec = importlib.util.spec_from_file_location(name, target)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"Cannot import user code from {path}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    logger.info("Imported user code %s from %s", name, path)
+    return mod
+
+
+def import_usercode() -> List[str]:
+    """Import everything named by REALHF_TPU_PACKAGE_PATH; returns the
+    list of imported paths (empty when unset)."""
+    raw = os.environ.get(PACKAGE_PATH_ENV, "")
+    out = []
+    for path in filter(None, raw.split(":")):
+        import_module_from_path(path)
+        out.append(path)
+    return out

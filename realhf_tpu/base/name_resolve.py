@@ -270,6 +270,7 @@ class RedisNameRecordRepository(NameRecordRepository):
         self.__to_delete = set()
         self.__keepalive_ttl: Dict[str, float] = {}
         self.__stop = threading.Event()
+        self.__wake = threading.Event()
         self.__keepalive_thread = threading.Thread(
             target=self.__keepalive_loop, daemon=True)
         self.__keepalive_thread.start()
@@ -283,7 +284,11 @@ class RedisNameRecordRepository(NameRecordRepository):
             ttls = list(self.__keepalive_ttl.values())
             poll = min([self.KEEPALIVE_POLL_FREQUENCY]
                        + [t / 3.0 for t in ttls])
-            if self.__stop.wait(max(0.05, poll)):
+            # add() sets __wake so a new short-TTL key re-times the
+            # loop immediately instead of after an in-flight long sleep
+            self.__wake.wait(timeout=max(0.05, poll))
+            self.__wake.clear()
+            if self.__stop.is_set():
                 return
             for name, ttl in list(self.__keepalive_ttl.items()):
                 try:
@@ -304,6 +309,7 @@ class RedisNameRecordRepository(NameRecordRepository):
                 raise NameEntryExistsError(name)
         if keepalive_ttl is not None:
             self.__keepalive_ttl[name] = keepalive_ttl
+            self.__wake.set()
         else:
             # re-registering without a TTL must stop the keepalive
             # thread from re-arming expiry on the now-persistent entry
@@ -345,6 +351,7 @@ class RedisNameRecordRepository(NameRecordRepository):
 
     def reset(self):
         self.__stop.set()
+        self.__wake.set()
         for name in list(self.__to_delete):
             try:
                 self.delete(name)

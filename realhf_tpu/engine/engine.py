@@ -82,11 +82,11 @@ class Engine:
         else:
             self.attention_fn = None
 
+        from realhf_tpu.ops.moe import ragged_dispatch_enabled
         if (cfg.mlp_type == "moe" and cfg.moe is not None
                 and cfg.moe.capacity_factor is None
                 and cfg.moe.num_experts > 4
-                and not (cfg.moe.use_grouped_gemm
-                         and hasattr(jax.lax, "ragged_dot"))):
+                and not ragged_dispatch_enabled(cfg)):
             logger.warning(
                 "MoE model running in dense dispatch (capacity_factor "
                 "unset, grouped GEMM disabled): every expert processes "

@@ -127,7 +127,7 @@ class GRPOConfig(CommonExperimentConfig):
             trial_name=self.trial_name,
             models={
                 "actor": self.actor.to_spec(train=True),
-                "ref": dataclasses.replace(self.ref.to_spec(train=False)),
+                "ref": self.ref.to_spec(train=False),
                 "reward": dataclasses.replace(
                     self.rew.to_spec(train=False), is_critic=True),
             },

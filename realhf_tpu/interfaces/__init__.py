@@ -11,3 +11,4 @@ import realhf_tpu.interfaces.dpo  # noqa: F401
 import realhf_tpu.interfaces.ppo  # noqa: F401
 import realhf_tpu.interfaces.gen  # noqa: F401
 import realhf_tpu.interfaces.grpo  # noqa: F401
+import realhf_tpu.interfaces.reinforce  # noqa: F401

@@ -124,6 +124,15 @@ def _expert_ffn(cfg: TransformerConfig, m: Dict, xs: jnp.ndarray
                       m["wd"].astype(cdt))
 
 
+def ragged_dispatch_enabled(cfg: TransformerConfig) -> bool:
+    """Single source of truth for whether the grouped-GEMM (ragged)
+    dispatch path is active for this config."""
+    return (cfg.mlp_type == "moe" and cfg.moe is not None
+            and cfg.moe.capacity_factor is None
+            and cfg.moe.use_grouped_gemm
+            and hasattr(jax.lax, "ragged_dot"))
+
+
 def _ragged_moe(cfg: TransformerConfig, m: Dict, xt: jnp.ndarray,
                 top_probs: jnp.ndarray, top_idx: jnp.ndarray
                 ) -> jnp.ndarray:
@@ -180,8 +189,7 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
     top_probs = top_probs * valid[:, None]
 
     e = moe.num_experts
-    if moe.capacity_factor is None and moe.use_grouped_gemm \
-            and hasattr(jax.lax, "ragged_dot"):
+    if ragged_dispatch_enabled(cfg):
         out = _ragged_moe(cfg, m, xt.astype(x.dtype), top_probs,
                           top_idx)
     elif moe.capacity_factor is None:

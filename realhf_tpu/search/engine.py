@@ -60,6 +60,13 @@ def load_native():
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     so = os.path.join(_build_dir(), f"libmcmc_search-{digest}.so")
     if not os.path.exists(so):
+        import glob
+        for stale in glob.glob(
+                os.path.join(_build_dir(), "libmcmc_search*.so")):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                _CSRC, "-o", so]
         logger.info("Building native search module: %s", " ".join(cmd))

@@ -189,6 +189,12 @@ class ModelWorker(worker_base.Worker):
         return self.store.get(ids, list(keys))
 
     def _handle_mfc(self, req: Payload):
+        # fault injection for recovery tests: die ONCE when the poison
+        # file exists (removed before raising so the relaunch survives)
+        poison = os.environ.get("REALHF_TPU_TEST_POISON")
+        if poison and os.path.exists(poison):
+            os.remove(poison)
+            raise RuntimeError("induced worker failure (test poison)")
         d = req.data
         node_name = d["node"]
         assert node_name in self.my_nodes, (node_name, self.my_nodes)

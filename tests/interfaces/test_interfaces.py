@@ -426,3 +426,39 @@ class TestGRPOSemantics:
         assert np.isfinite(stats["grpo_loss"])
         assert abs(stats["importance_weight"] - 1.0) < 0.05
         assert actor.version.global_step == 1
+
+
+class TestReinforce:
+
+    def test_remax_round(self):
+        """ReMax: sampled + greedy rollouts per prompt; advantage =
+        r_sampled - r_greedy on sampled tokens only; REINFORCE loss."""
+        from realhf_tpu.interfaces.reinforce import ReinforceInterface
+
+        gconfig = GenerationHyperparameters(
+            max_new_tokens=6, min_new_tokens=1, force_no_logits_mask=True)
+        actor = build_model("actor", lr=1e-3, seed=0)
+        rw = build_model("rw", is_critic=True, seed=2)
+        itf = ReinforceInterface(n_minibatches=1, gconfig=gconfig)
+        rw_itf = PairedRewardInterface()
+
+        rng = np.random.default_rng(0)
+        batch = prompt_batch(rng, n=4)
+        sample = itf.generate(actor, batch)
+        # each element nests [sampled, greedy]
+        assert sample.bs == 4
+        assert sample.ids == batch.ids
+        assert all(len(l) == 2 for l in sample.seqlens["packed_input_ids"])
+        sample.update_(rw_itf.inference(rw, sample.select(
+            ["packed_input_ids"])))
+        stats = itf.train_step(actor, sample, n_mbs=2)
+        assert np.isfinite(stats["reinforce_loss"])
+        assert "greedy_reward" in stats
+        assert actor.version.global_step == 1
+
+    def test_greedy_gconfig_rejected(self):
+        from realhf_tpu.interfaces.reinforce import ReinforceInterface
+
+        with pytest.raises(ValueError):
+            ReinforceInterface(gconfig=GenerationHyperparameters(
+                greedy=True))

@@ -130,3 +130,32 @@ def test_ppo_distributed_two_workers(prompt_data):
     assert np.isfinite(stats["actor_train"]["actor_loss"])
     assert np.isfinite(stats["critic_train"]["value_loss"])
     assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
+
+
+def test_auto_recover_relaunch(sft_data, tmp_path):
+    """recover_mode=auto (reference main.py:205-230): a model worker
+    dies mid-trial; the launcher catches the failure, tears the fleet
+    down, and relaunches in resume mode -- the retried trial restores
+    counters from recover info and completes."""
+    from realhf_tpu.apps.main import main_start
+    from realhf_tpu.base import recover
+
+    poison = tmp_path / "poison"
+    poison.touch()
+
+    cfg = SFTConfig(experiment_name="drec", trial_name="t0",
+                    total_train_epochs=1, save_freq_steps=1,
+                    recover_mode="auto")
+    apply_overrides(cfg, {"dataset.path": sft_data,
+                          "dataset.train_bs_n_seqs": "8",
+                          "dataset.max_seqlen": "32"})
+    spec = cfg.build()
+    _patch_random_models(spec)
+    spec.n_model_workers = 1
+    env = dict(WORKER_ENV, REALHF_TPU_TEST_POISON=str(poison))
+    out = main_start(spec, recover_mode="auto", recover_retries=2,
+                     env=env, timeout=600)
+    assert out["complete"]
+    assert not poison.exists()  # the failure really fired
+    assert out["global_step"] == 2
+    assert recover.exists()

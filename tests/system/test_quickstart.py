@@ -354,3 +354,31 @@ def test_grpo_end_to_end(prompt_data):
     stats = runner.run()
     assert np.isfinite(stats["actor_train"]["grpo_loss"])
     assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
+
+
+def test_usercode_injection_custom_reward(monkeypatch):
+    """REALHF_TPU_PACKAGE_PATH (reference REAL_PACKAGE_PATH +
+    import_usercode): a user .py registers a custom rule-based reward
+    interface that experiments can reference by name."""
+    from realhf_tpu.api import model as model_api
+    from realhf_tpu.api.config import ModelInterfaceAbstraction
+    from realhf_tpu.api.data import SequenceSample
+    from realhf_tpu.base.importing import import_usercode
+
+    model_api.ALL_INTERFACE_CLASSES.pop("token_count_reward", None)
+    monkeypatch.setenv("REALHF_TPU_PACKAGE_PATH",
+                       "/root/repo/examples/custom_reward.py")
+    assert import_usercode() == ["/root/repo/examples/custom_reward.py"]
+    assert "token_count_reward" in model_api.ALL_INTERFACE_CLASSES
+
+    itf = model_api.make_interface(ModelInterfaceAbstraction(
+        "token_count_reward", dict(target_token=7, scale=2.0)))
+    ids = np.asarray([7, 7, 1, 2, 7, 3, 5, 7, 7], np.int32)
+    pm = np.asarray([1, 1, 0, 0, 0, 1, 0, 0, 0], bool)
+    inp = SequenceSample.from_default(
+        ids=["a", "b"], seqlens=[5, 4],
+        data=dict(packed_input_ids=ids, prompt_mask=pm))
+    out = itf.inference(None, inp)
+    # seq a: non-prompt tokens [1, 2, 7] -> 1/3 * 2; seq b: [5, 7, 7] -> 2/3 * 2
+    np.testing.assert_allclose(out.data["rewards"],
+                               [2.0 / 3, 4.0 / 3], rtol=1e-6)
