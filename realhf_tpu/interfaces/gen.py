@@ -28,11 +28,17 @@ class GenerationInterface(model_api.ModelInterface):
     output_file: Optional[str] = None
     gconfig: GenerationHyperparameters = dataclasses.field(
         default_factory=GenerationHyperparameters)
+    # Continuous batching: slots refill from the prompt queue as
+    # sequences finish (engine/inflight.py) -- higher throughput for
+    # length-skewed batches; requires force_no_logits_mask.
+    use_inflight_batching: bool = False
+    inflight_slots: int = 0  # 0 = batch size
 
     def __post_init__(self):
         if isinstance(self.gconfig, dict):
             self.gconfig = GenerationHyperparameters(**self.gconfig)
         self._calls = 0
+        self._inflight = None
 
     def generate(self, model: model_api.Model, input_: SequenceSample,
                  n_mbs: Optional[int] = None) -> SequenceSample:
@@ -43,16 +49,43 @@ class GenerationInterface(model_api.ModelInterface):
         for l in prompt_lens:
             prompts.append(np.asarray(flat[off:off + l]))
             off += l
-        ids, seg, pos = packing.left_padded_prompts(
-            prompts, pad_id=tok.pad_token_id)
         self._calls += 1
         from realhf_tpu.interfaces.ppo import _base_key
         key = jax.random.fold_in(_base_key(), self._calls)
-        out = model.engine.generate(ids, seg, pos, key, self.gconfig,
-                                    eos_token_id=tok.eos_token_id,
-                                    pad_token_id=tok.pad_token_id)
-        gen_tokens = np.asarray(out.tokens)
-        lengths = np.asarray(out.lengths)
+
+        if self.use_inflight_batching:
+            from realhf_tpu.engine.inflight import (
+                InflightBatchingGenerator,
+            )
+            need = max(64, max(len(p) for p in prompts))
+            if (self._inflight is None
+                    or self._inflight.cache_len
+                    - self.gconfig.max_new_tokens < need):
+                # (re)build: a later batch may carry longer prompts
+                # than the first one sized the cache for
+                self._inflight = InflightBatchingGenerator(
+                    model.config, model.engine.params, self.gconfig,
+                    n_slots=self.inflight_slots or len(prompts),
+                    max_prompt_len=need,
+                    eos_token_id=tok.eos_token_id,
+                    pad_token_id=tok.pad_token_id)
+            self._inflight.params = model.engine.params  # fresh weights
+            finished = self._inflight.generate_all(prompts, key)
+            lengths = np.asarray([len(f.tokens) for f in finished])
+            maxg = max(1, int(lengths.max()))
+            gen_tokens = np.full((len(prompts), maxg),
+                                 tok.pad_token_id, np.int32)
+            for i, f in enumerate(finished):
+                gen_tokens[i, :len(f.tokens)] = f.tokens
+        else:
+            ids, seg, pos = packing.left_padded_prompts(
+                prompts, pad_id=tok.pad_token_id)
+            out = model.engine.generate(
+                ids, seg, pos, key, self.gconfig,
+                eos_token_id=tok.eos_token_id,
+                pad_token_id=tok.pad_token_id)
+            gen_tokens = np.asarray(out.tokens)
+            lengths = np.asarray(out.lengths)
 
         if self.output_file is not None:
             path = self.output_file

@@ -39,6 +39,16 @@ class ReinforceInterface(PPOActorInterface):
             raise ValueError(
                 "ReinforceInterface needs a SAMPLED rollout; the greedy "
                 "baseline decode is issued internally.")
+        if not self.gconfig.force_no_logits_mask:
+            # the greedy baseline has no logits mask, so the sampled
+            # half's mask cannot ride the interleaved layout; without
+            # replay, warped sampling would make recomputed logprobs
+            # inconsistent with the rollout distribution
+            raise ValueError(
+                "ReinforceInterface does not replay the sampling "
+                "logits mask; set force_no_logits_mask=True (and "
+                "disable top-k/top-p if exact logprob consistency "
+                "matters).")
 
     # ------------------------------------------------------------------
     def generate(self, model: model_api.Model, input_: SequenceSample,
@@ -110,7 +120,6 @@ class ReinforceInterface(PPOActorInterface):
         loss_mask = loss_mask & keep
         advantages = advantages * loss_mask
 
-        n_tokens = max(int(loss_mask.sum()), 1)
         global_stats = dict(
             task_reward=float(pairs[:, 0].mean()),
             greedy_reward=float(pairs[:, 1].mean()),

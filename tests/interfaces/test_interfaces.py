@@ -462,3 +462,23 @@ class TestReinforce:
         with pytest.raises(ValueError):
             ReinforceInterface(gconfig=GenerationHyperparameters(
                 greedy=True))
+
+
+class TestGenInflight:
+
+    def test_dumps_jsonl_with_inflight(self, tmp_path):
+        """GenerationInterface with continuous batching: same JSONL
+        contract as the batch path."""
+        model = build_model()
+        itf = GenerationInterface(
+            output_file=str(tmp_path / "gen.jsonl"),
+            gconfig=GenerationHyperparameters(max_new_tokens=4,
+                                              min_new_tokens=1,
+                                              force_no_logits_mask=True),
+            use_inflight_batching=True, inflight_slots=2)
+        rng = np.random.default_rng(0)
+        out = itf.generate(model, prompt_batch(rng, n=5))
+        assert out.bs == 5
+        import json
+        lines = [json.loads(l) for l in open(tmp_path / "gen.jsonl")]
+        assert len(lines) == 5 and all("answer" in l for l in lines)
