@@ -57,6 +57,26 @@ class Engine:
         self.mesh = ctx.mesh
         self.version = 0
 
+        # Pipeline parallelism: blocks layer-sharded over "pipe",
+        # GPipe microbatch rotation inside every forward/backward
+        # (parallel/pipeline.py).
+        if ctx.pp_size > 1:
+            from realhf_tpu.parallel.pipeline import PipelineContext
+            if cfg.n_layers % ctx.pp_size != 0:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by "
+                    f"pipeline_parallel_size={ctx.pp_size}")
+            if ctx.parallel.context_parallel_size > 1:
+                raise NotImplementedError(
+                    "pipeline parallelism cannot be combined with "
+                    "context parallelism (ring attention) yet; use "
+                    "pp x tp x dp or cp x tp x dp.")
+            n_mb = ctx.parallel.pipeline_microbatches or 2 * ctx.pp_size
+            self.pipeline_ctx = PipelineContext(
+                mesh=self.mesh, n_stages=ctx.pp_size, n_microbatches=n_mb)
+        else:
+            self.pipeline_ctx = None
+
         self._param_shardings = shard_rules.param_shardings(cfg, self.mesh)
         # Megatron-style vocab padding so wte/head shard over tp even
         # when vocab_size is not a tp multiple (re-padded if the source
@@ -109,6 +129,15 @@ class Engine:
         self._jit_forward_hidden = None
         self._jit_logprobs = None
         self._jit_values = None
+
+    @property
+    def n_streams(self) -> int:
+        """Preferred [S, L] stream-batch rows: one per dp rank, times
+        the pipeline microbatch count when pp > 1 (each pipeline
+        microbatch then carries dp streams)."""
+        if self.pipeline_ctx is not None:
+            return self.ctx.dp_size * self.pipeline_ctx.n_microbatches
+        return self.ctx.dp_size
 
     # ------------------------------------------------------------------
     # Training
@@ -213,7 +242,8 @@ class Engine:
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn)
+                                 attention_fn=self.attention_fn,
+                                 pipeline=self.pipeline_ctx)
                 return h
             self._jit_forward_hidden = f
         return self._jit_forward_hidden(self.params, jnp.asarray(input_ids),
@@ -228,7 +258,8 @@ class Engine:
             def f(params, ids, seg, mask, temp, has_mask):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn)
+                                 attention_fn=self.attention_fn,
+                                 pipeline=self.pipeline_ctx)
                 return F.shifted_logprobs_from_hidden(
                     self.cfg, params, h, ids, seg, temperature=temp,
                     logits_mask=mask if has_mask else None)
@@ -248,7 +279,8 @@ class Engine:
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn)
+                                 attention_fn=self.attention_fn,
+                                 pipeline=self.pipeline_ctx)
                 return T.critic_values(self.cfg, params, h)
             self._jit_values = f
         return self._jit_values(self.params, jnp.asarray(input_ids),
@@ -261,11 +293,15 @@ class Engine:
                  gconfig: GenerationHyperparameters,
                  eos_token_id: Optional[int], pad_token_id: int
                  ) -> gen_mod.GenerationOutput:
-        if self.ctx.parallel.context_parallel_size > 1:
+        if self.ctx.parallel.context_parallel_size > 1 or \
+                self.ctx.pp_size > 1:
             raise NotImplementedError(
-                "Generation on a context-parallel mesh is not supported; "
-                "allocate the generation MFC on a dp/tp layout (decoupled "
-                "allocation, e.g. actor_gen_alloc=d8t1).")
+                "Generation on a context- or pipeline-parallel mesh is "
+                "not supported; allocate the generation MFC on a dp/tp "
+                "layout (decoupled allocation, e.g. actor_gen_alloc="
+                "d8t1). The reference's token-streaming GenerateSchedule "
+                "has no efficient XLA analogue (SURVEY.md §7 risk "
+                "register).")
         cache_key = (gconfig, eos_token_id, pad_token_id)
         if cache_key not in self._generate_cache:
             self._generate_cache[cache_key] = gen_mod.build_generate_fn(

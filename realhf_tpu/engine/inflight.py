@@ -50,7 +50,9 @@ class FinishedSequence:
     request_id: int
     tokens: np.ndarray     # [len] generated ids (incl. EOS if emitted)
     logprobs: np.ndarray   # [len]
-    no_eos: bool
+    no_eos: bool           # True iff the sequence never emitted EOS
+                           # (hit max_new_tokens), matching the batch
+                           # path's seq_no_eos_mask semantics.
 
 
 class InflightBatchingGenerator:
@@ -74,7 +76,10 @@ class InflightBatchingGenerator:
         self.pad = pad_token_id
         self.chunk = chunk_size
         self.cache_len = max_prompt_len + gconfig.max_new_tokens
-        self._prefill_cache: Dict[int, callable] = {}
+        # jax.jit retraces per prompt-bucket shape on its own; one
+        # jitted function covers every bucket.
+        self._prefill = jax.jit(functools.partial(
+            _prefill_into_slot, self.cfg, self.cache_len))
 
         nm = gconfig.max_new_tokens
         self.state = dict(
@@ -85,6 +90,7 @@ class InflightBatchingGenerator:
             emitted=jnp.zeros((n_slots,), jnp.int32),
             active=jnp.zeros((n_slots,), bool),
             unfinished=jnp.zeros((n_slots,), bool),
+            hit_eos=jnp.zeros((n_slots,), bool),
             out_tokens=jnp.full((n_slots, nm), pad_token_id, jnp.int32),
             out_logprobs=jnp.zeros((n_slots, nm), jnp.float32),
         )
@@ -95,12 +101,6 @@ class InflightBatchingGenerator:
             chunk_size))
 
     # ------------------------------------------------------------------
-    def _prefill_fn(self, lp: int):
-        if lp not in self._prefill_cache:
-            self._prefill_cache[lp] = jax.jit(functools.partial(
-                _prefill_into_slot, self.cfg, self.cache_len))
-        return self._prefill_cache[lp]
-
     def _fill_slot(self, slot: int, request_id: int,
                    prompt: np.ndarray):
         max_prompt = self.cache_len - self.g.max_new_tokens
@@ -114,7 +114,7 @@ class InflightBatchingGenerator:
         ids[0, lp - len(prompt):] = prompt          # left padding
         seg[0, lp - len(prompt):] = 1
         pos[0, lp - len(prompt):] = np.arange(len(prompt))
-        self.state = self._prefill_fn(lp)(
+        self.state = self._prefill(
             self.params, self.state, jnp.asarray(slot),
             jnp.asarray(ids), jnp.asarray(seg), jnp.asarray(pos))
         self._slot_req[slot] = request_id
@@ -150,7 +150,8 @@ class InflightBatchingGenerator:
                         self.state["out_tokens"][slot, :n]),
                     logprobs=np.asarray(
                         self.state["out_logprobs"][slot, :n]),
-                    no_eos=bool(unfinished[slot]))
+                    no_eos=not bool(
+                        np.asarray(self.state["hit_eos"][slot])))
                 self._slot_req[slot] = -1
                 self.state["active"] = \
                     self.state["active"].at[slot].set(False)
@@ -188,6 +189,7 @@ def _prefill_into_slot(cfg, cache_len, params, state, slot, ids, seg, pos):
     new["emitted"] = state["emitted"].at[slot].set(0)
     new["active"] = state["active"].at[slot].set(True)
     new["unfinished"] = state["unfinished"].at[slot].set(True)
+    new["hit_eos"] = state["hit_eos"].at[slot].set(False)
     new["out_tokens"] = state["out_tokens"].at[slot].set(
         jnp.full((state["out_tokens"].shape[1],), 0, jnp.int32))
     new["out_logprobs"] = state["out_logprobs"].at[slot].set(0.0)
@@ -232,7 +234,9 @@ def _decode_chunk(cfg, g, eos, pad, chunk, params, state, key):
             st["out_logprobs"])
         emitted = st["emitted"] + live.astype(jnp.int32)
         unfinished = st["unfinished"]
+        hit_eos = st["hit_eos"]
         if eos is not None:
+            hit_eos = hit_eos | (live & (tokens == eos))
             unfinished = unfinished & (~live | (tokens != eos))
         unfinished = unfinished & (emitted < nm)
 
@@ -241,7 +245,8 @@ def _decode_chunk(cfg, g, eos, pad, chunk, params, state, key):
                                           tokens, pos)
         st = dict(st, cache=cache, last_hidden=new_hidden,
                   emitted=emitted, unfinished=unfinished,
-                  out_tokens=out_tokens, out_logprobs=out_logprobs)
+                  hit_eos=hit_eos, out_tokens=out_tokens,
+                  out_logprobs=out_logprobs)
         return st, None
 
     keys = jax.random.split(key, chunk)

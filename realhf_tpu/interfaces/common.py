@@ -71,19 +71,22 @@ def split_minibatches(input_: SequenceSample, n: int,
     return input_.split(n, min_size=min_size)
 
 
-def forward_with_aux(cfg, params, input_ids, seg_ids, attention_fn=None):
+def forward_with_aux(cfg, params, input_ids, seg_ids, attention_fn=None,
+                     pipeline=None):
     """Model forward returning (hidden, aux-loss dict). For MoE models
     the dict carries router load-balancing/z losses that MUST be added
     to the training objective (the reference applies them automatically
     via MoEAuxLossAutoScaler, utils/moe.py:395); dense models return
-    an empty dict."""
+    an empty dict. ``pipeline`` is the engine's PipelineContext when
+    the model mesh is pipeline-parallel."""
     from realhf_tpu.models import transformer as _T
     if cfg.mlp_type == "moe":
         h, _, aux = _T.forward(cfg, params, input_ids, seg_ids,
-                               return_aux=True, attention_fn=attention_fn)
+                               return_aux=True, attention_fn=attention_fn,
+                               pipeline=pipeline)
         return h, aux
     h, _ = _T.forward(cfg, params, input_ids, seg_ids,
-                      attention_fn=attention_fn)
+                      attention_fn=attention_fn, pipeline=pipeline)
     return h, {}
 
 

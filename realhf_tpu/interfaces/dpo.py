@@ -38,11 +38,13 @@ def _answer_masks(sb: common.StreamBatch, seqlens: List[int],
     return mask
 
 
-def _make_loss_fn(cfg, n_seqs: int, beta: float, attention_fn=None):
+def _make_loss_fn(cfg, n_seqs: int, beta: float, attention_fn=None,
+                  pipeline=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"], attention_fn)
+                                         mb["seg_ids"], attention_fn,
+                                         pipeline)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         masked = (lp * mb["answer_mask"]).reshape(-1)
@@ -84,7 +86,7 @@ class DPOInterface(model_api.ModelInterface):
         sb = common.build_stream_batch(
             seqlens,
             token_keys=dict(input_ids=input_.data["packed_input_ids"]),
-            n_streams=model.engine.ctx.dp_size)
+            n_streams=model.engine.n_streams)
         lp = np.asarray(model.engine.forward_logprobs(
             sb.arrays["input_ids"], sb.arrays["seg_ids"]))
         mask = _answer_masks(sb, seqlens, self._prompt_lens_per_seq(input_))
@@ -121,7 +123,7 @@ class DPOInterface(model_api.ModelInterface):
             sb = common.build_stream_batch(
                 seqlens,
                 token_keys=dict(input_ids=mb.data["packed_input_ids"]),
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
             sb.arrays["answer_mask"] = _answer_masks(
                 sb, seqlens, self._prompt_lens_per_seq(mb))
             # map pads to index n_seqs_max (one shared dustbin segment)
@@ -156,7 +158,8 @@ class DPOInterface(model_api.ModelInterface):
         stats = engine.train_batch(
             [b.arrays for b in batches],
             _make_loss_fn(model.config, n_seqs_max, self.beta,
-                          engine.attention_fn),
+                          engine.attention_fn,
+                          engine.pipeline_ctx),
             loss_weights=weights, loss_fn_key=("dpo", n_seqs_max, self.beta))
         model.inc_version()
         return stats

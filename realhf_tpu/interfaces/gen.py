@@ -54,10 +54,25 @@ class GenerationInterface(model_api.ModelInterface):
         key = jax.random.fold_in(_base_key(), self._calls)
 
         if self.use_inflight_batching:
+            if (model.engine.pipeline_ctx is not None
+                    or model.engine.ctx.parallel.context_parallel_size > 1):
+                # same restriction Engine.generate enforces on the
+                # batch path: decode with pipe-layer-sharded or
+                # ctx-sharded weights would silently all-gather the
+                # stack every step instead of erroring
+                raise NotImplementedError(
+                    "Inflight-batching generation on a pipeline- or "
+                    "context-parallel mesh is not supported; allocate "
+                    "the generation MFC on a dp/tp layout (decoupled "
+                    "allocation).")
             from realhf_tpu.engine.inflight import (
                 InflightBatchingGenerator,
             )
-            need = max(64, max(len(p) for p in prompts))
+            from realhf_tpu.engine.inflight import _bucket
+            # bucket the cache size so slowly-growing prompt lengths
+            # reuse the compiled decode/prefill programs instead of
+            # rebuilding the generator every batch
+            need = _bucket(max(64, max(len(p) for p in prompts)))
             if (self._inflight is None
                     or self._inflight.cache_len
                     - self.gconfig.max_new_tokens < need):
@@ -71,6 +86,10 @@ class GenerationInterface(model_api.ModelInterface):
                     pad_token_id=tok.pad_token_id)
             self._inflight.params = model.engine.params  # fresh weights
             finished = self._inflight.generate_all(prompts, key)
+            # do not pin the weights pytree (train_batch donates its
+            # buffers; a stale reference would keep a second full model
+            # resident in HBM between calls)
+            self._inflight.params = None
             lengths = np.asarray([len(f.tokens) for f in finished])
             maxg = max(1, int(lengths.max()))
             gen_tokens = np.full((len(prompts), maxg),

@@ -167,12 +167,14 @@ class GRPOInterface(PPOActorInterface):
         eps_clip = self.eps_clip
         kl_coef = self.kl_coef
         attention_fn = engine.attention_fn
+        pipeline = engine.pipeline_ctx
 
         def loss_fn(params, mb):
             import jax.numpy as jnp
             from realhf_tpu.ops import functional as F
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"], attention_fn)
+                                             mb["seg_ids"], attention_fn,
+                                             pipeline)
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
                 temperature=temperature)
@@ -204,7 +206,7 @@ class GRPOInterface(PPOActorInterface):
                     ref_logp=minibatch.data["ref_logp"],
                     loss_mask=minibatch.data["ppo_loss_mask"]
                     .astype(np.float32)),
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
 
         all_stats = [
             common.run_train_microbatched(

@@ -170,7 +170,7 @@ class PPOActorInterface(model_api.ModelInterface):
             sb = common.build_stream_batch(
                 seqlens,
                 token_keys=dict(input_ids=chunk.data["packed_input_ids"]),
-                n_streams=model.engine.ctx.dp_size)
+                n_streams=model.engine.n_streams)
             lmask = None
             if has_mask:
                 # stored True=masked-out; engine wants True=allowed
@@ -283,10 +283,12 @@ class PPOActorInterface(model_api.ModelInterface):
         early_imp = self.early_stop_imp_ratio
 
         attention_fn = engine.attention_fn
+        pipeline = engine.pipeline_ctx
 
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"], attention_fn)
+                                             mb["seg_ids"], attention_fn,
+                                             pipeline)
             lmask = mb.get("logits_mask")
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
@@ -331,7 +333,7 @@ class PPOActorInterface(model_api.ModelInterface):
                     old_logp=minibatch.data["old_logp"],
                     loss_mask=minibatch.data["ppo_loss_mask"]
                     .astype(np.float32)),
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
             if has_mask:
                 sb.arrays["logits_mask"] = packing.pack_tokens(
                     sb.info, ~minibatch.data["packed_logits_mask"],
@@ -399,7 +401,7 @@ class PPOCriticInterface(model_api.ModelInterface):
             sb = common.build_stream_batch(
                 seqlens,
                 token_keys=dict(input_ids=chunk.data["packed_input_ids"]),
-                n_streams=model.engine.ctx.dp_size)
+                n_streams=model.engine.n_streams)
             values = np.asarray(model.engine.forward_values(
                 sb.arrays["input_ids"], sb.arrays["seg_ids"]))
             pieces.append(packing.unpack_tokens(sb.info, values))
@@ -478,10 +480,12 @@ class PPOCriticInterface(model_api.ModelInterface):
         eps = self.value_eps_clip
 
         attention_fn = engine.attention_fn
+        pipeline = engine.pipeline_ctx
 
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"], attention_fn)
+                                             mb["seg_ids"], attention_fn,
+                                             pipeline)
             new_values = T.critic_values(cfg, params, h)
             loss, stats = ppo_functional.critic_loss_fn(
                 value=new_values, old_value=mb["old_values"],
@@ -502,7 +506,7 @@ class PPOCriticInterface(model_api.ModelInterface):
                     old_values=minibatch.data["old_logp"],
                     loss_mask=minibatch.data["ppo_loss_mask"]
                     .astype(np.float32)),
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
 
         all_stats = [
             common.run_train_microbatched(engine, minibatch, build_sb,

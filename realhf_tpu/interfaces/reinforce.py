@@ -160,13 +160,15 @@ class ReinforceInterface(PPOActorInterface):
         temperature = self.gconfig.temperature
         kl_coef = self.kl_coef
         attention_fn = engine.attention_fn
+        pipeline = engine.pipeline_ctx
 
         def loss_fn(params, mb):
             import jax.numpy as jnp
 
             from realhf_tpu.ops import functional as F
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                             mb["seg_ids"], attention_fn)
+                                             mb["seg_ids"], attention_fn,
+                                             pipeline)
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
                 temperature=temperature)
@@ -196,7 +198,7 @@ class ReinforceInterface(PPOActorInterface):
                 token_keys=dict(
                     input_ids=minibatch.data["packed_input_ids"]),
                 shifted_keys=shifted,
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
 
         all_stats = [
             common.run_train_microbatched(

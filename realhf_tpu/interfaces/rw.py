@@ -26,11 +26,12 @@ from realhf_tpu.models.hf import save_hf_checkpoint
 logger = logging.getLogger("PairedRewardInterface")
 
 
-def _make_loss_fn(cfg, attention_fn=None):
+def _make_loss_fn(cfg, attention_fn=None, pipeline=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"], attention_fn)
+                                         mb["seg_ids"], attention_fn,
+                                         pipeline)
         values = T.critic_values(cfg, params, h)  # [S, L]
         # Gather per-pair (pos, neg) end-of-sequence scores via (row,
         # col) coordinates (stable under stream padding), plus a pair
@@ -65,7 +66,7 @@ class PairedRewardInterface(model_api.ModelInterface):
         sb = common.build_stream_batch(
             seqlens,
             token_keys=dict(input_ids=input_.data["packed_input_ids"]),
-            n_streams=model.engine.ctx.dp_size)
+            n_streams=model.engine.n_streams)
         values = np.asarray(model.engine.forward_values(
             sb.arrays["input_ids"], sb.arrays["seg_ids"]))
         scores = packing.per_seq_gather(
@@ -98,7 +99,7 @@ class PairedRewardInterface(model_api.ModelInterface):
             sb = common.build_stream_batch(
                 seqlens,
                 token_keys=dict(input_ids=mb.data["packed_input_ids"]),
-                n_streams=engine.ctx.dp_size)
+                n_streams=engine.n_streams)
             # (row, col) of each sequence's final token
             ends = [(sb.info.stream[i], sb.info.offset[i] + ln - 1)
                     for i, ln in enumerate(seqlens)]
@@ -132,7 +133,8 @@ class PairedRewardInterface(model_api.ModelInterface):
                 b.arrays[k] = np.pad(v, (0, npair - v.shape[0]))
         stats = engine.train_batch(
             [b.arrays for b in batches],
-            _make_loss_fn(model.config, engine.attention_fn),
+            _make_loss_fn(model.config, engine.attention_fn,
+                          engine.pipeline_ctx),
             loss_weights=weights, loss_fn_key="paired_rw")
         model.inc_version()
         return stats

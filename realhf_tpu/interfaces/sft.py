@@ -22,11 +22,12 @@ from realhf_tpu.ops import functional as F
 logger = logging.getLogger("SFTInterface")
 
 
-def _make_loss_fn(cfg, attention_fn=None):
+def _make_loss_fn(cfg, attention_fn=None, pipeline=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
-                                         mb["seg_ids"], attention_fn)
+                                         mb["seg_ids"], attention_fn,
+                                         pipeline)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         # loss_mask[t] gates predicting token t+1: valid next-token
@@ -66,7 +67,7 @@ class SFTInterface(model_api.ModelInterface):
                 token_keys=dict(
                     input_ids=mb.data["packed_input_ids"],
                     prompt_mask=mb.data["prompt_mask"]),
-                n_streams=engine.ctx.dp_size))
+                n_streams=engine.n_streams))
         batches = common.pad_stream_batches(batches)
         # weight by ANSWER tokens (what each microbatch loss averages
         # over), so grad accumulation equals the one-big-batch gradient
@@ -77,7 +78,8 @@ class SFTInterface(model_api.ModelInterface):
             weights = [float(b.n_tokens) for b in batches]
         stats = engine.train_batch(
             [b.arrays for b in batches],
-            _make_loss_fn(model.config, engine.attention_fn),
+            _make_loss_fn(model.config, engine.attention_fn,
+                          engine.pipeline_ctx),
             loss_weights=weights, loss_fn_key="sft")
         model.inc_version()
         return stats
@@ -91,7 +93,7 @@ class SFTInterface(model_api.ModelInterface):
                 token_keys=dict(
                     input_ids=batch.data["packed_input_ids"],
                     prompt_mask=batch.data["prompt_mask"]),
-                n_streams=model.engine.ctx.dp_size)
+                n_streams=model.engine.n_streams)
             lp = np.asarray(model.engine.forward_logprobs(
                 sb.arrays["input_ids"], sb.arrays["seg_ids"]))
             seg = sb.arrays["seg_ids"]

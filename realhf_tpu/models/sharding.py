@@ -26,12 +26,21 @@ from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.parallel.mesh import CTX_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 
 
-def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
-    """PartitionSpec pytree congruent with ``init_params`` output."""
-    col = P(None, None, MODEL_AXIS)      # [nl, H, out_sharded]
-    row = P(None, MODEL_AXIS, None)      # [nl, in_sharded, H]
-    col_b = P(None, MODEL_AXIS)          # bias of a column-parallel linear
-    rep2 = P(None, None)                 # [nl, H] replicated
+def param_pspecs(cfg: TransformerConfig,
+                 pipeline_parallel: bool = False) -> Dict[str, Any]:
+    """PartitionSpec pytree congruent with ``init_params`` output.
+
+    With ``pipeline_parallel`` the stacked-block leading (layer) dim is
+    sharded over the "pipe" axis -- each stage owns a contiguous
+    n_layers/pp slab (the reference's partition_pipeline_layers split,
+    real_llm_parallel.py:342); embedding/head/final-norm stay
+    pipe-replicated and run outside the pipeline loop.
+    """
+    lead = PIPE_AXIS if pipeline_parallel else None
+    col = P(lead, None, MODEL_AXIS)      # [nl, H, out_sharded]
+    row = P(lead, MODEL_AXIS, None)      # [nl, in_sharded, H]
+    col_b = P(lead, MODEL_AXIS)          # bias of a column-parallel linear
+    rep2 = P(lead, None)                 # [nl, H] replicated over tp
     specs: Dict[str, Any] = {
         "embed": {"wte": P(MODEL_AXIS, None)},
         "blocks": {
@@ -48,10 +57,10 @@ def param_pspecs(cfg: TransformerConfig) -> Dict[str, Any]:
     if cfg.mlp_type == "moe":
         # Experts TP-sharded (reference behavior: each expert's MLP is
         # column/row-parallel, experts.py:26).
-        mlp["router"] = P(None, None, None)
-        mlp["wg"] = P(None, None, None, MODEL_AXIS)
-        mlp["wu"] = P(None, None, None, MODEL_AXIS)
-        mlp["wd"] = P(None, None, MODEL_AXIS, None)
+        mlp["router"] = P(lead, None, None)
+        mlp["wg"] = P(lead, None, None, MODEL_AXIS)
+        mlp["wu"] = P(lead, None, None, MODEL_AXIS)
+        mlp["wd"] = P(lead, None, MODEL_AXIS, None)
     elif cfg.gated_mlp:
         mlp["wg"] = col
         mlp["wu"] = col
@@ -129,7 +138,9 @@ def unpad_vocab(cfg: TransformerConfig, params: Dict[str, Any]
 
 
 def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg),
+    pp = mesh.shape.get(PIPE_AXIS, 1)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        param_pspecs(cfg, pipeline_parallel=pp > 1),
                         is_leaf=lambda x: isinstance(x, P))
 
 

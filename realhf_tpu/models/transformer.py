@@ -269,6 +269,7 @@ def forward(
     return_aux: bool = False,
     activation_constraint=None,
     attention_fn=None,
+    pipeline=None,  # parallel.pipeline.PipelineContext when pp > 1
 ):
     """Packed forward pass -> final hidden states [B, L, H] (after the
     final norm). Heads are applied separately (`lm_logits`,
@@ -298,6 +299,43 @@ def forward(
         half = cfg.head_dim // 2
         cos = jnp.ones((*positions.shape, half), jnp.float32)
         sin = jnp.zeros((*positions.shape, half), jnp.float32)
+
+    if pipeline is not None and pipeline.n_stages > 1:
+        # Pipeline parallelism: blocks are stage-sharded over the
+        # "pipe" mesh axis and run as a microbatch-rotation schedule
+        # (parallel/pipeline.py). Embedding/rotary above and head/norm
+        # below stay GSPMD with pipe-replicated weights.
+        assert not return_kv, (
+            "KV-cache prefill on a pipeline-parallel mesh is not "
+            "supported; allocate generation MFCs on a dp/tp layout "
+            "(decoupled allocation).")
+        from realhf_tpu.parallel.pipeline import pipeline_blocks
+
+        def pblock(lp, layer_idx, carry, seg, cos_, sin_):
+            y, _, aux = _block(cfg, lp, layer_idx, carry, seg, cos_,
+                               sin_, constrain, attention_fn)
+            return y, aux
+
+        if cfg.gradient_checkpointing:
+            pblock = jax.checkpoint(
+                pblock, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def block_step(slab, layer_ids, xc, segc, cosc, sinc):
+            def body(carry, layer):
+                lp, li = layer
+                y, aux = pblock(lp, li, carry, segc, cosc, sinc)
+                return y, aux
+            y, auxs = jax.lax.scan(body, xc, (slab, layer_ids))
+            return y, {k: v.sum() for k, v in auxs.items()}
+
+        x, aux = pipeline_blocks(
+            pipeline, params["blocks"], cfg.n_layers, x, seg_ids, cos,
+            sin, block_step, return_aux=return_aux)
+        x = _norm(cfg, x, params["ln_f"]["scale"],
+                  params["ln_f"].get("bias"))
+        if return_aux:
+            return x, None, aux
+        return x, None
 
     def block_fn(lp, layer_idx, carry):
         # cfg/constrain are non-array closures; seg_ids/cos/sin are

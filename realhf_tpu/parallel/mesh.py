@@ -9,8 +9,8 @@ collectives from shardings, so there are no explicit communication
 groups to build -- the mesh IS the topology.
 
 Axis convention (stable across the framework):
-  - "pipe":  pipeline stages (size 1 until PP lands; specs may
-             reference it safely).
+  - "pipe":  pipeline stages (GPipe microbatch rotation, see
+             parallel/pipeline.py; blocks are layer-sharded over it).
   - "data":  data parallelism over packed sequence streams.
   - "model": tensor parallelism; with ``sequence_parallel`` the
              sequence dim of activations is also sharded over this
@@ -49,6 +49,10 @@ class ParallelismConfig:
     context_parallel_size: int = 1
     sequence_parallel: bool = False
     gradient_checkpointing: bool = False
+    # GPipe microbatch count when pipeline_parallel_size > 1
+    # (0 = auto: 2*pp, bubble fraction (pp-1)/(3*pp-1)); not part of
+    # the weight layout (same_layout ignores it).
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         if self.sequence_parallel and self.tensor_parallel_size == 1:

@@ -1,0 +1,164 @@
+"""Pipeline parallelism: GPipe-style microbatch rotation over the
+"pipe" mesh axis.
+
+TPU-native replacement for the reference's pipeline machinery
+(``realhf/impl/model/parallelism/pipeline_parallel/static_schedule.py``
+InferenceSchedule:155 / TrainSchedule:319, ``backend/pipe_runner.py:148``
+instruction executor, and the p2p send/recv in ``p2p.py``): instead of
+an interpreted per-step instruction list with explicit NCCL p2p, the
+schedule is a single ``lax.scan`` over pipeline ticks inside a
+partial-manual ``shard_map`` (manual over "pipe" only -- data/ctx/model
+axes stay under GSPMD, so tensor parallelism inside each stage is
+unchanged). Microbatch rotation between stages is one
+``lax.ppermute`` per tick, which XLA lowers to ICI neighbor transfers;
+reverse-mode autodiff through the scan+ppermute yields the backward
+pipeline (the 1F1B equivalent of TrainSchedule) for free -- there is no
+hand-written BackwardPass/SendGrad/RecvGrad instruction set.
+
+Schedule shape: with S stages and M microbatches the loop runs
+T = M + S - 1 ticks; stage s processes microbatch m at tick t = m + s.
+The bubble fraction is (S-1)/T, so callers should use M >= S (default
+2*S) microbatches.
+
+Layer placement: the transformer's stacked-block pytree (leading dim
+``n_layers``) is sharded ``P("pipe")`` on its leading axis, so each
+stage holds a contiguous ``n_layers / S`` slab -- the same
+even-contiguous split as the reference's
+``partition_pipeline_layers`` (real_llm_parallel.py:342). Embedding
+and LM/critic heads run OUTSIDE the pipeline under plain GSPMD with
+pipe-replicated weights (the reference puts them on the first/last
+stage instead; replication costs n_vocab*H per extra stage but keeps
+head/embedding math entirely in XLA's hands).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from realhf_tpu.parallel.mesh import PIPE_AXIS
+
+# block_step(blocks_slab, layer_ids, x, seg, cos, sin)
+#   -> (y, aux_scalars_dict)
+BlockStep = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineContext:
+    """Static pipeline execution plan for one model."""
+    mesh: Mesh
+    n_stages: int
+    n_microbatches: int
+
+    def __post_init__(self):
+        assert self.n_stages > 1, "PipelineContext needs >= 2 stages"
+        assert self.n_microbatches >= 1
+
+
+def pad_streams(arrs, n_streams_multiple: int, pad_value=0):
+    """Pad the leading (stream) dim of each array to a multiple of
+    ``n_streams_multiple``. Padded streams carry seg_id 0 everywhere =
+    all-padding, so they are masked out of attention and losses."""
+    b = arrs[0].shape[0]
+    m = n_streams_multiple
+    pad = (m - b % m) % m
+    if pad == 0:
+        return arrs, b
+    out = []
+    for a in arrs:
+        width = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        out.append(jnp.pad(a, width, constant_values=pad_value))
+    return out, b
+
+
+def pipeline_blocks(
+    pipe: PipelineContext,
+    blocks: Any,                    # stacked pytree, leading dim n_layers
+    n_layers: int,
+    x: jnp.ndarray,                 # [B, L, H] residual after embedding
+    seg_ids: jnp.ndarray,           # [B, L]
+    cos: jnp.ndarray,               # [B, L, hd/2]
+    sin: jnp.ndarray,               # [B, L, hd/2]
+    block_step: BlockStep,
+    return_aux: bool = False,
+):
+    """Run the block stack as a pipeline; returns (hidden, aux).
+
+    ``blocks`` must be sharded P("pipe") on the leading layer dim (see
+    models/sharding.param_pspecs with pipeline=True); x/seg/cos/sin are
+    pipe-replicated. Streams are padded to a multiple of
+    ``n_microbatches`` internally.
+    """
+    S, M = pipe.n_stages, pipe.n_microbatches
+    assert n_layers % S == 0, (n_layers, S)
+    per_stage = n_layers // S
+
+    (x, seg_ids, cos, sin), b_orig = pad_streams(
+        [x, seg_ids, cos, sin], M)
+    B, L, H = x.shape
+    Bm = B // M
+    T = M + S - 1
+
+    @partial(jax.shard_map, mesh=pipe.mesh, axis_names={PIPE_AXIS},
+             in_specs=(P(PIPE_AXIS), P(None), P(None), P(None), P(None)),
+             out_specs=(P(PIPE_AXIS), P()))
+    def run(blocks_local, x, seg, cos, sin):
+        idx = jax.lax.axis_index(PIPE_AXIS)
+        layer_ids = idx * per_stage + jnp.arange(per_stage,
+                                                 dtype=jnp.int32)
+
+        def mb(a):
+            # pipe-varying so stages can index their own microbatch
+            return jax.lax.pcast(a.reshape(M, Bm, *a.shape[1:]),
+                                 (PIPE_AXIS,), to="varying")
+
+        mbs_x, mbs_seg, mbs_cos, mbs_sin = mb(x), mb(seg), mb(cos), mb(sin)
+        state = jax.lax.pcast(jnp.zeros((Bm, L, H), x.dtype),
+                              (PIPE_AXIS,), to="varying")
+
+        def tick(state, t):
+            # Stage `idx` processes microbatch m = t - idx at tick t
+            # (clamped during bubble ticks, which compute on garbage
+            # and are discarded below). Activations arrive via the
+            # rotation; per-microbatch metadata (segments, rotary
+            # phases) is indexed locally instead of rotated -- it is
+            # pipe-replicated, so indexing costs no communication.
+            m = jnp.clip(t - idx, 0, M - 1)
+            pick = lambda a: jax.lax.dynamic_index_in_dim(
+                a, m, 0, keepdims=False)
+            inj = pick(mbs_x)
+            xc = jnp.where(idx == 0, inj, state)
+            y, aux = block_step(blocks_local, layer_ids, xc, pick(mbs_seg),
+                                pick(mbs_cos), pick(mbs_sin))
+            # Bubble ticks (stage s active only for s <= t < s + M):
+            # their aux must not count; their outputs are never
+            # consumed (see collection below), so they contribute zero
+            # gradient.
+            valid = (((t - idx) >= 0) & ((t - idx) < M)).astype(
+                jnp.float32)
+            aux = {k: v * valid for k, v in aux.items()}
+            nxt = jax.lax.ppermute(
+                y, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])
+            return nxt, (y, aux)
+
+        _, (ys, auxs) = jax.lax.scan(tick, state, jnp.arange(T))
+        # Microbatch m leaves the LAST stage at tick m + S - 1; on every
+        # other stage this slice is bubble garbage that the caller
+        # discards by indexing stage S-1 of the stacked output.
+        outs = ys[S - 1:]                       # [M, Bm, L, H]
+        # Aux losses are per-token means inside each (layer,
+        # microbatch) evaluation; average them over the M microbatches
+        # (the reference likewise applies MoE aux per forward
+        # microbatch, utils/moe.py:395-416) and sum over stages.
+        aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS) / M
+                   for k, v in auxs.items()}
+        return outs[None], aux_tot
+
+    outs, aux = run(blocks, x, seg_ids, cos, sin)
+    hidden = outs[S - 1].reshape(B, L, H)[:b_orig]
+    if return_aux:
+        return hidden, aux
+    return hidden, {}

@@ -89,3 +89,36 @@ def test_logits_mask_mode_rejected():
         InflightBatchingGenerator(
             CFG, params, gconfig, n_slots=2, max_prompt_len=64,
             eos_token_id=1, pad_token_id=0)
+
+
+def test_no_eos_flag_semantics():
+    """no_eos must be True exactly when the sequence hit
+    max_new_tokens without emitting EOS (batch path's seq_no_eos_mask
+    semantics, generation.py), not whenever a slot was harvested."""
+    rng = np.random.default_rng(3)
+    params = T.init_params(CFG, jax.random.PRNGKey(0))
+    prompts = _prompts(rng, 3)
+    g = GenerationHyperparameters(max_new_tokens=6, greedy=True,
+                                  force_no_logits_mask=True)
+
+    # eos=None: EOS can never be emitted -> every sequence truncates
+    gen = InflightBatchingGenerator(
+        CFG, params, g, n_slots=2, max_prompt_len=16,
+        eos_token_id=None, pad_token_id=0, chunk_size=4)
+    for f in gen.generate_all(prompts, jax.random.PRNGKey(0)):
+        assert f.no_eos and len(f.tokens) == 6
+
+    # eos = the greedy argmax of some sequence -> that one ends with
+    # EOS and must report no_eos=False; cross-check vs the batch path.
+    ref = _batch_reference(params, prompts, g, None)
+    eos = int(ref[0][0])
+    gen2 = InflightBatchingGenerator(
+        CFG, params, g, n_slots=2, max_prompt_len=16,
+        eos_token_id=eos, pad_token_id=0, chunk_size=4)
+    got = gen2.generate_all(prompts, jax.random.PRNGKey(0))
+    saw_eos = False
+    for f in got:
+        ends_eos = len(f.tokens) > 0 and int(f.tokens[-1]) == eos
+        assert f.no_eos == (not ends_eos)
+        saw_eos |= ends_eos
+    assert saw_eos  # the construction guarantees at least one EOS end

@@ -193,3 +193,29 @@ def test_sub_fleet_replica_layouts():
     b = _canonical(e_small)
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_through_pipeline_layout():
+    """Realloc between a tp-only layout and a pipeline-parallel layout
+    (blocks layer-sharded over "pipe"): the training layout of a large
+    model vs the dp/tp generation layout of the same role."""
+    cfg = tiny_cfg()
+    devs = jax.devices("cpu")
+    e_src = build_engine(cfg, 2, 2, devices=devs[:4], seed=3)
+
+    pparallel = ParallelismConfig(data_parallel_size=2,
+                                  tensor_parallel_size=2,
+                                  pipeline_parallel_size=2)
+    pctx = MeshContext(ModelName("pp", 0),
+                       make_mesh(pparallel, devs[:8]), pparallel)
+    e_dst = Engine(cfg, pctx, T.init_params(cfg, jax.random.PRNGKey(7)))
+
+    before = _canonical(e_src)
+    reallocate(cfg, e_src.params, e_dst)
+    for a, b in zip(jax.tree.leaves(before),
+                    jax.tree.leaves(_canonical(e_dst))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    reallocate(cfg, e_dst.params, e_src)
+    for a, b in zip(jax.tree.leaves(before),
+                    jax.tree.leaves(_canonical(e_src))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
