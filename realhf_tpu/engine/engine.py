@@ -228,6 +228,11 @@ class Engine:
         self.params, self.opt_state, loss, stats, gnorm = step(
             self.params, self.opt_state, stacked, weights)
         self.version += 1
+        # ONE batched host fetch for all scalar stats: converting each
+        # scalar with float() would issue a separate blocking D2H
+        # round trip, which dominates step time on remote-attached
+        # TPUs (measured 2078 -> 391 ms/step on a tunneled v5e).
+        loss, stats, gnorm = jax.device_get((loss, stats, gnorm))
         out = {k: float(v) for k, v in stats.items()}
         out["loss"] = float(loss)
         out["grad_norm"] = float(gnorm)

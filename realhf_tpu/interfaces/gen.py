@@ -73,14 +73,18 @@ class GenerationInterface(model_api.ModelInterface):
             # reuse the compiled decode/prefill programs instead of
             # rebuilding the generator every batch
             need = _bucket(max(64, max(len(p) for p in prompts)))
+            n_slots = self.inflight_slots or len(prompts)
             if (self._inflight is None
                     or self._inflight.cache_len
-                    - self.gconfig.max_new_tokens < need):
+                    - self.gconfig.max_new_tokens < need
+                    or self._inflight.n_slots != n_slots):
                 # (re)build: a later batch may carry longer prompts
-                # than the first one sized the cache for
+                # than the first one sized the cache for, or (with
+                # inflight_slots=0 = "track batch size") a different
+                # prompt count than the slots were built for
                 self._inflight = InflightBatchingGenerator(
                     model.config, model.engine.params, self.gconfig,
-                    n_slots=self.inflight_slots or len(prompts),
+                    n_slots=n_slots,
                     max_prompt_len=need,
                     eos_token_id=tok.eos_token_id,
                     pad_token_id=tok.pad_token_id)

@@ -76,6 +76,11 @@ class TransformerConfig:
     # Rematerialize each block in backward (jax.checkpoint over the
     # layer scan) -- the reference's gradient_checkpointing flag.
     gradient_checkpointing: bool = False
+    # jax.checkpoint_policies name used when gradient_checkpointing is
+    # on. "nothing_saveable" = full recompute (min memory);
+    # "dots_with_no_batch_dims_saveable" keeps matmul outputs (more
+    # HBM, measurably faster when the model fits).
+    remat_policy: str = "nothing_saveable"
 
     def __post_init__(self):
         if self.head_dim is None:

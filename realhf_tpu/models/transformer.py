@@ -318,7 +318,8 @@ def forward(
 
         if cfg.gradient_checkpointing:
             pblock = jax.checkpoint(
-                pblock, policy=jax.checkpoint_policies.nothing_saveable)
+                pblock,
+                policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
 
         def block_step(slab, layer_ids, xc, segc, cosc, sinc):
             def body(carry, layer):
@@ -346,7 +347,8 @@ def forward(
 
     if cfg.gradient_checkpointing:
         block_fn = jax.checkpoint(
-            block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+            block_fn,
+            policy=getattr(jax.checkpoint_policies, cfg.remat_policy))
 
     def scan_body(carry, layer):
         lp, layer_idx = layer

@@ -101,6 +101,10 @@ def pipeline_blocks(
     B, L, H = x.shape
     Bm = B // M
     T = M + S - 1
+    # Microbatches consisting entirely of internal padding streams
+    # (pad_streams appends them at the end) contribute zero aux; the
+    # per-microbatch aux mean must divide by the real count only.
+    n_real_mb = -(-b_orig // Bm)
 
     @partial(jax.shard_map, mesh=pipe.mesh, axis_names={PIPE_AXIS},
              in_specs=(P(PIPE_AXIS), P(None), P(None), P(None), P(None)),
@@ -153,7 +157,7 @@ def pipeline_blocks(
         # microbatch) evaluation; average them over the M microbatches
         # (the reference likewise applies MoE aux per forward
         # microbatch, utils/moe.py:395-416) and sum over stages.
-        aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS) / M
+        aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS) / n_real_mb
                    for k, v in auxs.items()}
         return outs[None], aux_tot
 

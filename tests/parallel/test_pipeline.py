@@ -216,3 +216,34 @@ def test_generation_raises_on_pipeline_mesh():
                         jax.random.PRNGKey(0),
                         GenerationHyperparameters(max_new_tokens=4),
                         eos_token_id=None, pad_token_id=0)
+
+
+def test_pipeline_moe_aux_ignores_padded_microbatches():
+    """Stream count not a multiple of n_microbatches: the all-padding
+    microbatch contributes nothing and the aux mean divides by the
+    real microbatch count only."""
+    from realhf_tpu.models.config import MoEConfig
+    cfg = _cfg(mlp_type="moe",
+               moe=MoEConfig(num_experts=4, top_k=2, aux_loss_coeff=0.01,
+                             z_loss_coeff=0.001))
+    params = T.init_params(cfg, jax.random.PRNGKey(1))
+    ids, seg = _batch(cfg, b=6)
+
+    fwd = jax.jit(
+        lambda p, i, s: T.forward(cfg, p, i, s, return_aux=True))
+    auxes = [fwd(params, ids[i:i + 2], seg[i:i + 2])[2]
+             for i in (0, 2, 4)]
+    aux_ref = {k: sum(a[k] for a in auxes) / 3 for k in auxes[0]}
+
+    parallel = ParallelismConfig(data_parallel_size=4,
+                                 pipeline_parallel_size=2)
+    mesh = make_mesh(parallel, devices=jax.devices("cpu")[:8])
+    pipe = PipelineContext(mesh=mesh, n_stages=2, n_microbatches=4)
+    p_sharded = jax.device_put(params,
+                               shard_rules.param_shardings(cfg, mesh))
+    _, _, aux_pipe = jax.jit(
+        lambda p, i, s: T.forward(cfg, p, i, s, return_aux=True,
+                                  pipeline=pipe))(p_sharded, ids, seg)
+    for k in aux_ref:
+        np.testing.assert_allclose(float(aux_pipe[k]), float(aux_ref[k]),
+                                   atol=1e-5, rtol=1e-4)
