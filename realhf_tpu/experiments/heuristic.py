@@ -10,7 +10,9 @@ TPU terms:
   that weights + optimizer state (Adam: ~16 bytes/param fp32 m/v +
   master copy) fit comfortably in one chip's HBM, all remaining
   devices go to DP (grad accumulation handles batch; DP maximizes MXU
-  utilization on TPU -- PP is intentionally not chosen, SURVEY §7).
+  utilization on TPU). When even TP = one ICI ring (TP_CAP) cannot
+  fit the training state, layers are additionally sharded over
+  pipeline stages (parallel/pipeline.py GPipe schedule).
 - **generate MFCs** prefer wide DP with minimal TP (decode is
   HBM-bandwidth bound and batch-parallel; TP collectives per token are
   pure overhead at small per-chip batch): TP = weights-fit minimum.
@@ -27,6 +29,10 @@ import dataclasses
 import json
 import os
 from typing import Dict, List, Optional, Tuple
+
+from realhf_tpu.base import logging as _logging
+
+logger = _logging.getLogger("heuristic")
 
 from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import MFCDef
@@ -66,6 +72,12 @@ def _min_tp(param_bytes: float, n_devices: int,
     return n_devices
 
 
+# TP beyond one ICI ring scales poorly (per-layer collectives cross
+# more hops); past this the heuristic prefers pipeline stages, whose
+# ppermute traffic is one activation per tick.
+TP_CAP = 8
+
+
 def choose_layout(cfg: TransformerConfig, n_devices: int,
                   interface_type: ModelInterfaceType,
                   trainable: bool,
@@ -82,9 +94,30 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
     else:
         bytes_needed = n_params * 2 * 1.2
     tp = _min_tp(bytes_needed, n_devices, hbm_budget)
-    dp = max(1, n_devices // tp)
+    pp = 1
+    if (tp > TP_CAP and interface_type != ModelInterfaceType.GENERATE):
+        # Very large train/inference models: hold TP at one ICI ring
+        # and shard layers over pipeline stages instead (generation
+        # cannot run on a pipeline mesh -- engine restriction).
+        tp = min(TP_CAP, n_devices)
+        for cand in _pow2_up_to(max(1, n_devices // tp)):
+            pp = cand
+            if (cfg.n_layers % cand == 0
+                    and bytes_needed / (tp * cand) <= hbm_budget):
+                break
+        while pp > 1 and cfg.n_layers % pp != 0:
+            pp //= 2
+    if bytes_needed / (tp * pp) > hbm_budget:
+        logger.warning(
+            "Heuristic layout t%dp%d leaves %.1f GB/chip for a %.1f GB "
+            "budget (n_layers=%d limits pipeline depth); expect OOM "
+            "without remat/offload headroom or more devices.",
+            tp, pp, bytes_needed / (tp * pp) / 1e9, hbm_budget / 1e9,
+            cfg.n_layers)
+    dp = max(1, n_devices // (tp * pp))
     return ParallelismConfig(
         data_parallel_size=dp, tensor_parallel_size=tp,
+        pipeline_parallel_size=pp,
         sequence_parallel=tp > 1 and trainable)
 
 

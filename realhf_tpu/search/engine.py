@@ -116,6 +116,8 @@ class MFCWorkload:
     param_bytes: float                # bf16 weight bytes
     train_state_bytes: float = 0.0    # weights+master+adam when training
     gen_tokens: int = 0               # decode steps (generate MFCs)
+    n_layers: int = 0                 # for pipeline-stage divisibility
+                                      # (0 = unknown: no pp candidates)
 
     @property
     def trainable(self) -> bool:
@@ -178,20 +180,29 @@ def _pow2s(n: int) -> List[int]:
 
 
 def exec_time(w: MFCWorkload, tp: int, dp: int,
-              cm: TPUCostModel) -> float:
-    """Seconds for one execution of the MFC on dp*tp chips."""
-    chips = tp * dp
+              cm: TPUCostModel, pp: int = 1) -> float:
+    """Seconds for one execution of the MFC on dp*tp*pp chips.
+
+    Pipeline stages add the GPipe bubble: with the engine's default
+    M = 2*pp microbatches the schedule runs M + pp - 1 ticks, a
+    (M + pp - 1) / M slowdown over perfect scaling.
+    """
+    chips = tp * dp * pp
+    bubble = (2 * pp + pp - 1) / (2 * pp) if pp > 1 else 1.0
     if w.interface_type == ModelInterfaceType.TRAIN_STEP:
         flops = 3.0 * w.fwd_flops          # fwd + bwd (2x)
-        return flops / (chips * cm.peak_flops * cm.mxu_efficiency)
+        return bubble * flops / (chips * cm.peak_flops
+                                 * cm.mxu_efficiency)
     if w.interface_type == ModelInterfaceType.GENERATE:
+        assert pp == 1, "generation does not run on pipeline meshes"
         prefill = w.fwd_flops / (chips * cm.peak_flops
                                  * cm.mxu_efficiency)
         # decode is weight-bandwidth bound: every step re-reads this
         # chip's weight shard from HBM
         decode = w.gen_tokens * (w.param_bytes / tp) / cm.hbm_bandwidth
         return prefill + decode
-    return w.fwd_flops / (chips * cm.peak_flops * cm.mxu_efficiency)
+    return bubble * w.fwd_flops / (chips * cm.peak_flops
+                                   * cm.mxu_efficiency)
 
 
 def enumerate_candidates(w: MFCWorkload, n_devices: int,
@@ -199,19 +210,26 @@ def enumerate_candidates(w: MFCWorkload, n_devices: int,
     """(slice, layout) placements whose per-chip memory fits."""
     need = w.train_state_bytes if w.trainable else w.param_bytes * 1.25
     out: List[Candidate] = []
-    for tp in _pow2s(n_devices):
-        if need / tp > cm.hbm_budget:
-            continue
-        for dp in _pow2s(n_devices // tp):
-            size = tp * dp
-            t = exec_time(w, tp, dp, cm)
-            for lo in range(0, n_devices - size + 1, size):
-                out.append(Candidate(
-                    ParallelismConfig(data_parallel_size=dp,
-                                      tensor_parallel_size=tp,
-                                      sequence_parallel=(
-                                          tp > 1 and w.trainable)),
-                    lo, lo + size, t))
+    if w.interface_type == ModelInterfaceType.GENERATE or not w.n_layers:
+        pps = [1]
+    else:
+        pps = [pp for pp in _pow2s(n_devices)
+               if w.n_layers % pp == 0]
+    for pp in pps:
+        for tp in _pow2s(n_devices // pp):
+            if need / (tp * pp) > cm.hbm_budget:
+                continue
+            for dp in _pow2s(n_devices // (tp * pp)):
+                size = tp * dp * pp
+                t = exec_time(w, tp, dp, cm, pp)
+                for lo in range(0, n_devices - size + 1, size):
+                    out.append(Candidate(
+                        ParallelismConfig(data_parallel_size=dp,
+                                          tensor_parallel_size=tp,
+                                          pipeline_parallel_size=pp,
+                                          sequence_parallel=(
+                                              tp > 1 and w.trainable)),
+                        lo, lo + size, t))
     if not out:  # nothing fits even at full TP: loud fallback
         logger.warning(
             "MFC %s does not fit the HBM budget at any layout on %d "
@@ -302,6 +320,7 @@ def _flatten(workloads: List[MFCWorkload], deps: Dict[str, List[str]],
     layout_key = np.asarray(
         [hash((c.parallel.data_parallel_size,
                c.parallel.tensor_parallel_size,
+               c.parallel.pipeline_parallel_size,
                c.parallel.context_parallel_size,
                c.dev_lo, c.dev_hi)) for c in flat])
     realloc[layout_key[:, None] == layout_key[None, :]] = 0.0
@@ -406,6 +425,7 @@ def workloads_from_spec(spec, gen_tokens: int = 256,
             interface_type=node.interface_type,
             fwd_flops=float(fwd), param_bytes=pbytes,
             train_state_bytes=cfg.n_params() * 18.0,
+            n_layers=cfg.n_layers,
             gen_tokens=(gen_tokens if node.interface_type
                         == ModelInterfaceType.GENERATE else 0)))
     deps = {n.name: [p.name for p in n.parents] for n in dfg.nodes}

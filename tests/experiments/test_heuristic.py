@@ -85,3 +85,27 @@ def test_small_model_collapses_to_dp():
         assert par.tensor_parallel_size == 1
         assert par.data_parallel_size == 8
     assert overrides == {}
+
+
+def test_choose_layout_70b_uses_pipeline():
+    """70B training on 128 chips: 18 B/param (~1.2 TB) cannot fit at
+    TP<=8 alone; the heuristic holds TP at one ICI ring and shards
+    layers over pipeline stages (generation stays pp=1)."""
+    cfg = TransformerConfig(
+        n_layers=80, n_kv_heads=8, n_q_heads=64, hidden_dim=8192,
+        intermediate_dim=28672, vocab_size=32000, n_positions=4096,
+        apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
+        use_attention_bias=False, use_attn_proj_bias=False,
+        use_mlp_bias=False, activation_function="silu")
+    train = choose_layout(cfg, 128, ModelInterfaceType.TRAIN_STEP,
+                          trainable=True)
+    assert train.tensor_parallel_size <= 8
+    assert train.pipeline_parallel_size > 1
+    assert cfg.n_layers % train.pipeline_parallel_size == 0
+    state_bytes = cfg.n_params() * 18
+    per_chip = state_bytes / (train.tensor_parallel_size
+                              * train.pipeline_parallel_size)
+    assert per_chip <= DEFAULT_HBM_BUDGET
+    gen = choose_layout(cfg, 128, ModelInterfaceType.GENERATE,
+                        trainable=False)
+    assert gen.pipeline_parallel_size == 1

@@ -113,3 +113,36 @@ def test_apply_to_spec():
     for name, par in spec.allocations.items():
         node_role = next(n.role for n in spec.mfcs if n.name == name)
         assert not par.same_layout(spec.models[node_role].parallel)
+
+
+def test_pipeline_candidates_enumerated_with_bubble_cost():
+    """Training workloads too big for TP-only HBM get pipeline
+    candidates; their time includes the GPipe bubble factor."""
+    w = MFCWorkload(
+        name="train", role="actor",
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        fwd_flops=1e15, param_bytes=140e9,
+        train_state_bytes=70e9 * 18, n_layers=80)
+    # 1.26 TB of training state: on 128 chips it only fits when layers
+    # are also sharded over pipeline stages (tp capped at 16 here)
+    cm = TPUCostModel(hbm_budget=16e9 * 0.65)
+    cands = enumerate_candidates(w, 128, cm)
+    pps = {c.parallel.pipeline_parallel_size for c in cands}
+    assert any(p > 1 for p in pps), "no pipeline candidates"
+    for c in cands:
+        par = c.parallel
+        assert w.n_layers % par.pipeline_parallel_size == 0
+        state_per_chip = w.train_state_bytes / (
+            par.tensor_parallel_size * par.pipeline_parallel_size)
+        assert state_per_chip <= cm.hbm_budget
+    t_pp2 = exec_time(w, tp=8, dp=1, cm=cm, pp=2)
+    t_flat = exec_time(w, tp=8, dp=2, cm=cm, pp=1)
+    # same 16 chips; pp=2 pays the (M+S-1)/M = 5/4 bubble
+    assert t_pp2 == pytest.approx(t_flat * 5 / 4, rel=1e-6)
+
+    gen = MFCWorkload(
+        name="gen", role="actor",
+        interface_type=ModelInterfaceType.GENERATE,
+        fwd_flops=1e15, param_bytes=14e9, gen_tokens=256, n_layers=80)
+    assert all(c.parallel.pipeline_parallel_size == 1
+               for c in enumerate_candidates(gen, 128, cm))
