@@ -77,6 +77,26 @@ class Engine:
         else:
             self.pipeline_ctx = None
 
+        # Expert parallelism: expert weights E-sharded over the data
+        # axis; this constraint turns dispatch/combine into all-to-alls
+        # (models/sharding.py moe_ep_constraint). Validated BEFORE the
+        # device_put below so invalid configs fail instantly with a
+        # clear message instead of after a full-model transfer.
+        self.moe_constraint = shard_rules.moe_ep_constraint(cfg, self.mesh)
+        if self.moe_constraint is not None:
+            from realhf_tpu.ops.moe import ragged_dispatch_enabled as _rde
+            if _rde(cfg):
+                raise ValueError(
+                    "MoEConfig.expert_parallel requires the capacity "
+                    "or dense dispatch mode (set capacity_factor, or "
+                    "use_grouped_gemm=False); ragged grouped GEMMs "
+                    "cannot shard the expert group dim.")
+            if cfg.moe.num_experts % ctx.dp_size != 0:
+                raise ValueError(
+                    f"expert_parallel needs num_experts "
+                    f"({cfg.moe.num_experts}) divisible by "
+                    f"data_parallel_size ({ctx.dp_size}).")
+
         self._param_shardings = shard_rules.param_shardings(cfg, self.mesh)
         # Megatron-style vocab padding so wte/head shard over tp even
         # when vocab_size is not a tp multiple (re-padded if the source
@@ -248,6 +268,7 @@ class Engine:
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
                                  attention_fn=self.attention_fn,
+                                 moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return h
             self._jit_forward_hidden = f
@@ -264,6 +285,7 @@ class Engine:
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
                                  attention_fn=self.attention_fn,
+                                 moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return F.shifted_logprobs_from_hidden(
                     self.cfg, params, h, ids, seg, temperature=temp,
@@ -285,6 +307,7 @@ class Engine:
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
                                  attention_fn=self.attention_fn,
+                                 moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return T.critic_values(self.cfg, params, h)
             self._jit_values = f
@@ -311,7 +334,8 @@ class Engine:
         if cache_key not in self._generate_cache:
             self._generate_cache[cache_key] = gen_mod.build_generate_fn(
                 self.cfg, gconfig, eos_token_id, pad_token_id,
-                activation_constraint=self._constrain)
+                activation_constraint=self._constrain,
+                moe_constraint=self.moe_constraint)
         fn = self._generate_cache[cache_key]
         return fn(self.params, jnp.asarray(prompt_ids),
                   jnp.asarray(prompt_seg), jnp.asarray(prompt_pos), key)

@@ -49,6 +49,7 @@ def generate(
     eos_token_id: Optional[int],
     pad_token_id: int,
     activation_constraint=None,
+    moe_constraint=None,
 ) -> GenerationOutput:
     """Functional generation; wrap in jax.jit with gconfig/eos/pad
     static. See `build_generate_fn` for the cached jitted wrapper."""
@@ -56,7 +57,8 @@ def generate(
     prompt_lens = (prompt_seg != 0).sum(-1).astype(jnp.int32)
 
     hidden, cache = T.prefill(cfg, params, prompt_ids, prompt_seg, prompt_pos,
-                              activation_constraint=activation_constraint)
+                              activation_constraint=activation_constraint,
+                              moe_constraint=moe_constraint)
     cache = T.extend_kv_cache(cache, gconfig.max_new_tokens)
     last_hidden = hidden[:, -1]  # left padding => last column is last token
 
@@ -98,7 +100,8 @@ def generate(
             logits, step_idx, unfinished, k)
         emitted = emitted + was_unfinished.astype(jnp.int32)
         pos = prompt_lens + step_idx
-        new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos)
+        new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos,
+                                          moe_constraint)
         out = (tokens, logprob, mask) if not gconfig.force_no_logits_mask \
             else (tokens, logprob)
         return (new_hidden, cache, unfinished, emitted), out
@@ -127,13 +130,14 @@ def generate(
 def build_generate_fn(cfg: TransformerConfig,
                       gconfig: GenerationHyperparameters,
                       eos_token_id: Optional[int], pad_token_id: int,
-                      activation_constraint=None):
+                      activation_constraint=None, moe_constraint=None):
     """Jitted generate closure; XLA caches compilations per
     batch/bucket shape. Engines build this once and reuse it."""
     fn = functools.partial(generate, cfg, gconfig=gconfig,
                            eos_token_id=eos_token_id,
                            pad_token_id=pad_token_id,
-                           activation_constraint=activation_constraint)
+                           activation_constraint=activation_constraint,
+                           moe_constraint=moe_constraint)
 
     @jax.jit
     def run(params, prompt_ids, prompt_seg, prompt_pos, key):

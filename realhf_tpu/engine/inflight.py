@@ -62,7 +62,7 @@ class InflightBatchingGenerator:
                  gconfig: GenerationHyperparameters,
                  *, n_slots: int, max_prompt_len: int,
                  eos_token_id: Optional[int], pad_token_id: int,
-                 chunk_size: int = 32):
+                 chunk_size: int = 32, moe_constraint=None):
         if not gconfig.force_no_logits_mask:
             raise ValueError(
                 "inflight batching does not produce the PPO logits "
@@ -79,7 +79,8 @@ class InflightBatchingGenerator:
         # jax.jit retraces per prompt-bucket shape on its own; one
         # jitted function covers every bucket.
         self._prefill = jax.jit(functools.partial(
-            _prefill_into_slot, self.cfg, self.cache_len))
+            _prefill_into_slot, self.cfg, self.cache_len,
+            moe_constraint))
 
         nm = gconfig.max_new_tokens
         self.state = dict(
@@ -98,7 +99,7 @@ class InflightBatchingGenerator:
 
         self._decode_chunk = jax.jit(functools.partial(
             _decode_chunk, cfg, gconfig, eos_token_id, pad_token_id,
-            chunk_size))
+            chunk_size, moe_constraint))
 
     # ------------------------------------------------------------------
     def _fill_slot(self, slot: int, request_id: int,
@@ -164,9 +165,11 @@ class InflightBatchingGenerator:
 # ----------------------------------------------------------------------
 # jitted pieces
 # ----------------------------------------------------------------------
-def _prefill_into_slot(cfg, cache_len, params, state, slot, ids, seg, pos):
+def _prefill_into_slot(cfg, cache_len, moe_constraint, params, state, slot,
+                       ids, seg, pos):
     """Batch-1 prefill scattered into `slot`'s cache rows + state."""
-    hidden, pcache = T.prefill(cfg, params, ids, seg, pos)
+    hidden, pcache = T.prefill(cfg, params, ids, seg, pos,
+                               moe_constraint=moe_constraint)
     lp = ids.shape[1]
     pad_s = cache_len - lp
 
@@ -196,7 +199,8 @@ def _prefill_into_slot(cfg, cache_len, params, state, slot, ids, seg, pos):
     return new
 
 
-def _decode_chunk(cfg, g, eos, pad, chunk, params, state, key):
+def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, params, state,
+                  key):
     """`chunk` decode steps over every slot (inactive/finished slots
     keep stepping on pad tokens but write nothing)."""
     nm = g.max_new_tokens
@@ -242,7 +246,7 @@ def _decode_chunk(cfg, g, eos, pad, chunk, params, state, key):
 
         pos = st["prompt_len"] + st["emitted"]
         new_hidden, cache = T.decode_step(cfg, params, st["cache"],
-                                          tokens, pos)
+                                          tokens, pos, moe_constraint)
         st = dict(st, cache=cache, last_hidden=new_hidden,
                   emitted=emitted, unfinished=unfinished,
                   hit_eos=hit_eos, out_tokens=out_tokens,

@@ -72,17 +72,19 @@ def split_minibatches(input_: SequenceSample, n: int,
 
 
 def forward_with_aux(cfg, params, input_ids, seg_ids, attention_fn=None,
-                     pipeline=None):
+                     pipeline=None, moe_constraint=None):
     """Model forward returning (hidden, aux-loss dict). For MoE models
     the dict carries router load-balancing/z losses that MUST be added
     to the training objective (the reference applies them automatically
     via MoEAuxLossAutoScaler, utils/moe.py:395); dense models return
     an empty dict. ``pipeline`` is the engine's PipelineContext when
-    the model mesh is pipeline-parallel."""
+    the model mesh is pipeline-parallel; ``moe_constraint`` is the
+    engine's expert-parallel sharding hook."""
     from realhf_tpu.models import transformer as _T
     if cfg.mlp_type == "moe":
         h, _, aux = _T.forward(cfg, params, input_ids, seg_ids,
                                return_aux=True, attention_fn=attention_fn,
+                               moe_constraint=moe_constraint,
                                pipeline=pipeline)
         return h, aux
     h, _ = _T.forward(cfg, params, input_ids, seg_ids,

@@ -39,12 +39,12 @@ def _answer_masks(sb: common.StreamBatch, seqlens: List[int],
 
 
 def _make_loss_fn(cfg, n_seqs: int, beta: float, attention_fn=None,
-                  pipeline=None):
+                  pipeline=None, moe_constraint=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                          mb["seg_ids"], attention_fn,
-                                         pipeline)
+                                         pipeline, moe_constraint)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         masked = (lp * mb["answer_mask"]).reshape(-1)
@@ -159,7 +159,7 @@ class DPOInterface(model_api.ModelInterface):
             [b.arrays for b in batches],
             _make_loss_fn(model.config, n_seqs_max, self.beta,
                           engine.attention_fn,
-                          engine.pipeline_ctx),
+                          engine.pipeline_ctx, engine.moe_constraint),
             loss_weights=weights, loss_fn_key=("dpo", n_seqs_max, self.beta))
         model.inc_version()
         return stats

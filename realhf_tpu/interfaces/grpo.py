@@ -168,13 +168,14 @@ class GRPOInterface(PPOActorInterface):
         kl_coef = self.kl_coef
         attention_fn = engine.attention_fn
         pipeline = engine.pipeline_ctx
+        moe_constraint = engine.moe_constraint
 
         def loss_fn(params, mb):
             import jax.numpy as jnp
             from realhf_tpu.ops import functional as F
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                              mb["seg_ids"], attention_fn,
-                                             pipeline)
+                                             pipeline, moe_constraint)
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
                 temperature=temperature)

@@ -284,11 +284,12 @@ class PPOActorInterface(model_api.ModelInterface):
 
         attention_fn = engine.attention_fn
         pipeline = engine.pipeline_ctx
+        moe_constraint = engine.moe_constraint
 
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                              mb["seg_ids"], attention_fn,
-                                             pipeline)
+                                             pipeline, moe_constraint)
             lmask = mb.get("logits_mask")
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
@@ -481,11 +482,12 @@ class PPOCriticInterface(model_api.ModelInterface):
 
         attention_fn = engine.attention_fn
         pipeline = engine.pipeline_ctx
+        moe_constraint = engine.moe_constraint
 
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                              mb["seg_ids"], attention_fn,
-                                             pipeline)
+                                             pipeline, moe_constraint)
             new_values = T.critic_values(cfg, params, h)
             loss, stats = ppo_functional.critic_loss_fn(
                 value=new_values, old_value=mb["old_values"],

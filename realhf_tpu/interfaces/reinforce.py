@@ -161,6 +161,7 @@ class ReinforceInterface(PPOActorInterface):
         kl_coef = self.kl_coef
         attention_fn = engine.attention_fn
         pipeline = engine.pipeline_ctx
+        moe_constraint = engine.moe_constraint
 
         def loss_fn(params, mb):
             import jax.numpy as jnp
@@ -168,7 +169,7 @@ class ReinforceInterface(PPOActorInterface):
             from realhf_tpu.ops import functional as F
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                              mb["seg_ids"], attention_fn,
-                                             pipeline)
+                                             pipeline, moe_constraint)
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
                 temperature=temperature)

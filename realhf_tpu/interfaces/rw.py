@@ -26,12 +26,13 @@ from realhf_tpu.models.hf import save_hf_checkpoint
 logger = logging.getLogger("PairedRewardInterface")
 
 
-def _make_loss_fn(cfg, attention_fn=None, pipeline=None):
+def _make_loss_fn(cfg, attention_fn=None, pipeline=None,
+                  moe_constraint=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                          mb["seg_ids"], attention_fn,
-                                         pipeline)
+                                         pipeline, moe_constraint)
         values = T.critic_values(cfg, params, h)  # [S, L]
         # Gather per-pair (pos, neg) end-of-sequence scores via (row,
         # col) coordinates (stable under stream padding), plus a pair
@@ -134,7 +135,7 @@ class PairedRewardInterface(model_api.ModelInterface):
         stats = engine.train_batch(
             [b.arrays for b in batches],
             _make_loss_fn(model.config, engine.attention_fn,
-                          engine.pipeline_ctx),
+                          engine.pipeline_ctx, engine.moe_constraint),
             loss_weights=weights, loss_fn_key="paired_rw")
         model.inc_version()
         return stats

@@ -22,12 +22,13 @@ from realhf_tpu.ops import functional as F
 logger = logging.getLogger("SFTInterface")
 
 
-def _make_loss_fn(cfg, attention_fn=None, pipeline=None):
+def _make_loss_fn(cfg, attention_fn=None, pipeline=None,
+                  moe_constraint=None):
 
     def loss_fn(params, mb):
         h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                          mb["seg_ids"], attention_fn,
-                                         pipeline)
+                                         pipeline, moe_constraint)
         lp = F.shifted_logprobs_from_hidden(
             cfg, params, h, mb["input_ids"], mb["seg_ids"])
         # loss_mask[t] gates predicting token t+1: valid next-token
@@ -79,7 +80,7 @@ class SFTInterface(model_api.ModelInterface):
         stats = engine.train_batch(
             [b.arrays for b in batches],
             _make_loss_fn(model.config, engine.attention_fn,
-                          engine.pipeline_ctx),
+                          engine.pipeline_ctx, engine.moe_constraint),
             loss_weights=weights, loss_fn_key="sft")
         model.inc_version()
         return stats

@@ -21,6 +21,16 @@ class MoEConfig:
     input_jitter_eps: Optional[float] = None
     capacity_factor: Optional[float] = None
     use_grouped_gemm: bool = True
+    # Real expert parallelism (exceeds the reference, whose dispatcher
+    # says "Currently does not support expert parallel",
+    # token_dispatcher.py:26-27): shard the expert (E) dim of the
+    # stacked expert weights over the "data" mesh axis. The GShard
+    # dispatch einsums then become all-to-alls inserted by GSPMD:
+    # tokens sharded by data are exchanged for experts sharded by
+    # data. Requires num_experts % data_parallel_size == 0 and the
+    # capacity or dense dispatch mode (ragged grouped GEMMs cannot
+    # shard the group dim).
+    expert_parallel: bool = False
 
 
 @dataclasses.dataclass

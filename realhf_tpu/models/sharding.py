@@ -56,11 +56,16 @@ def param_pspecs(cfg: TransformerConfig,
     mlp = specs["blocks"]["mlp"]
     if cfg.mlp_type == "moe":
         # Experts TP-sharded (reference behavior: each expert's MLP is
-        # column/row-parallel, experts.py:26).
+        # column/row-parallel, experts.py:26). With expert_parallel the
+        # E dim additionally shards over the data axis (real EP -- the
+        # reference's dispatcher explicitly does not support it,
+        # token_dispatcher.py:26-27).
+        ep = DATA_AXIS if (cfg.moe is not None
+                           and cfg.moe.expert_parallel) else None
         mlp["router"] = P(lead, None, None)
-        mlp["wg"] = P(lead, None, None, MODEL_AXIS)
-        mlp["wu"] = P(lead, None, None, MODEL_AXIS)
-        mlp["wd"] = P(lead, None, MODEL_AXIS, None)
+        mlp["wg"] = P(lead, ep, None, MODEL_AXIS)
+        mlp["wu"] = P(lead, ep, None, MODEL_AXIS)
+        mlp["wd"] = P(lead, ep, MODEL_AXIS, None)
     elif cfg.gated_mlp:
         mlp["wg"] = col
         mlp["wu"] = col
@@ -165,6 +170,24 @@ def activation_constraint(mesh: Mesh, sequence_parallel: bool):
 
     def constrain(x):
         return jax.lax.with_sharding_constraint(x, sharding)
+
+    return constrain
+
+
+def moe_ep_constraint(cfg: TransformerConfig, mesh: Mesh):
+    """Constraint pinning expert-major ``[E, ...]`` MoE intermediates
+    to the data axis when expert parallelism is on -- this is what
+    turns the GShard dispatch/combine einsums into all-to-alls instead
+    of letting XLA all-gather the expert weights. Returns None for
+    non-EP configs (the common case)."""
+    if not (cfg.mlp_type == "moe" and cfg.moe is not None
+            and cfg.moe.expert_parallel):
+        return None
+
+    def constrain(x):
+        spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec))
 
     return constrain
 

@@ -156,13 +156,15 @@ def _activation(cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
     raise NotImplementedError(cfg.activation_function)
 
 
-def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    out, _ = _mlp_with_aux(cfg, lp, x, None)
+def _mlp(cfg: TransformerConfig, lp: Params, x: jnp.ndarray,
+         moe_constraint=None) -> jnp.ndarray:
+    out, _ = _mlp_with_aux(cfg, lp, x, None, moe_constraint)
     return out
 
 
 def _mlp_with_aux(cfg: TransformerConfig, lp: Params, x: jnp.ndarray,
-                  seg_ids: Optional[jnp.ndarray] = None):
+                  seg_ids: Optional[jnp.ndarray] = None,
+                  moe_constraint=None):
     """MLP returning (output, aux-loss dict) -- non-empty only for MoE
     (router load-balancing / z losses, reference utils/moe.py:395).
     ``seg_ids`` masks padding out of MoE routing/capacity/losses."""
@@ -173,7 +175,8 @@ def _mlp_with_aux(cfg: TransformerConfig, lp: Params, x: jnp.ndarray,
         squeeze = x.ndim == 2  # decode step: [B, H]
         x3 = x[:, None, :] if squeeze else x
         valid = None if seg_ids is None else (seg_ids != 0)
-        out, aux = moe_mlp_with_losses(cfg, m, x3, valid_mask=valid)
+        out, aux = moe_mlp_with_losses(cfg, m, x3, valid_mask=valid,
+                                       ep_constraint=moe_constraint)
         return (out[:, 0] if squeeze else out), aux
     return _dense_mlp(cfg, m, x, cdt), {}
 
@@ -218,7 +221,8 @@ def _attn_scale(cfg: TransformerConfig, layer_idx: jnp.ndarray) -> jnp.ndarray:
 
 def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
            x: jnp.ndarray, seg_ids: jnp.ndarray, cos: jnp.ndarray,
-           sin: jnp.ndarray, constrain, attention_fn=None):
+           sin: jnp.ndarray, constrain, attention_fn=None,
+           moe_constraint=None):
     """One transformer block over packed streams [B, L, H]; returns
     (residual output, (k, v), aux-losses) -- k/v feed prefill KV
     caches; aux is non-empty for MoE."""
@@ -237,7 +241,7 @@ def _block(cfg: TransformerConfig, lp: Params, layer_idx: jnp.ndarray,
         proj = proj + lp["attn"]["bo"].astype(x.dtype)
     x = constrain(x + proj)
     ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
-    mlp_out, aux = _mlp_with_aux(cfg, lp, ln2, seg_ids)
+    mlp_out, aux = _mlp_with_aux(cfg, lp, ln2, seg_ids, moe_constraint)
     x = constrain(x + mlp_out)
     return x, (k, v), aux
 
@@ -269,6 +273,7 @@ def forward(
     return_aux: bool = False,
     activation_constraint=None,
     attention_fn=None,
+    moe_constraint=None,  # models/sharding.py moe_ep_constraint (EP)
     pipeline=None,  # parallel.pipeline.PipelineContext when pp > 1
 ):
     """Packed forward pass -> final hidden states [B, L, H] (after the
@@ -313,7 +318,8 @@ def forward(
 
         def pblock(lp, layer_idx, carry, seg, cos_, sin_):
             y, _, aux = _block(cfg, lp, layer_idx, carry, seg, cos_,
-                               sin_, constrain, attention_fn)
+                               sin_, constrain, attention_fn,
+                               moe_constraint)
             return y, aux
 
         if cfg.gradient_checkpointing:
@@ -343,7 +349,7 @@ def forward(
         # array closures -- jax.checkpoint differentiates through
         # closed-over arrays correctly.
         return _block(cfg, lp, layer_idx, carry, seg_ids, cos, sin,
-                      constrain, attention_fn)
+                      constrain, attention_fn, moe_constraint)
 
     if cfg.gradient_checkpointing:
         block_fn = jax.checkpoint(
@@ -414,12 +420,14 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
 
 def prefill(cfg: TransformerConfig, params: Params, input_ids: jnp.ndarray,
             seg_ids: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
-            *, activation_constraint=None) -> Tuple[jnp.ndarray, KVCache]:
+            *, activation_constraint=None,
+            moe_constraint=None) -> Tuple[jnp.ndarray, KVCache]:
     """Run the packed forward and materialize a KV cache whose first
     L slots hold the prompt keys/values."""
     hidden, kvs = forward(cfg, params, input_ids, seg_ids, positions,
                           return_kv=True,
-                          activation_constraint=activation_constraint)
+                          activation_constraint=activation_constraint,
+                          moe_constraint=moe_constraint)
     k, v = kvs  # [nl, B, L, nkv, hd]
     cache = {
         "k": k,
@@ -451,6 +459,7 @@ def decode_step(
     cache: KVCache,
     token: jnp.ndarray,      # [B] int32 -- the token to feed
     positions: jnp.ndarray,  # [B] int32 -- its position in the sequence
+    moe_constraint=None,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step: feed `token`, return hidden [B, H] for the next
     token's logits and the updated cache. The jitted decode loop built
@@ -497,7 +506,7 @@ def decode_step(
             proj = proj + lp["attn"]["bo"].astype(x.dtype)
         x = x + proj
         ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
-        x = x + _mlp(cfg, lp, ln2)
+        x = x + _mlp(cfg, lp, ln2, moe_constraint)
         return x, (k_cache, v_cache)
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)

@@ -163,11 +163,17 @@ def _ragged_moe(cfg: TransformerConfig, m: Dict, xt: jnp.ndarray,
 
 def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
                         rng: Optional[jax.Array] = None,
-                        valid_mask: Optional[jnp.ndarray] = None
+                        valid_mask: Optional[jnp.ndarray] = None,
+                        ep_constraint=None
                         ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """MoE feed-forward over [B, L, H]; ``valid_mask`` [B, L] excludes
     padding tokens from routing, expert capacity, and the aux losses
-    (pad positions carry real hidden states in the packed layout)."""
+    (pad positions carry real hidden states in the packed layout).
+
+    ``ep_constraint`` (models/sharding.py moe_ep_constraint) pins the
+    expert-major intermediates to the expert-parallel axis so GSPMD
+    lowers dispatch/combine to all-to-alls; requires the capacity or
+    dense dispatch mode."""
     moe = cfg.moe
     if moe.input_jitter_eps and rng is None:
         raise NotImplementedError(
@@ -189,13 +195,20 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
     top_probs = top_probs * valid[:, None]
 
     e = moe.num_experts
+    ep = ep_constraint if ep_constraint is not None else (lambda a: a)
     if ragged_dispatch_enabled(cfg):
+        if ep_constraint is not None:
+            raise ValueError(
+                "expert_parallel requires the capacity or dense "
+                "dispatch mode; ragged grouped GEMMs cannot shard the "
+                "group dim (set capacity_factor or "
+                "use_grouped_gemm=False).")
         out = _ragged_moe(cfg, m, xt.astype(x.dtype), top_probs,
                           top_idx)
     elif moe.capacity_factor is None:
         # Dense mode: every expert over all tokens, gate-weighted.
-        xs = jnp.broadcast_to(xt[None], (e, t, h)).astype(x.dtype)
-        expert_out = _expert_ffn(cfg, m, xs)  # [E, T, H]
+        xs = ep(jnp.broadcast_to(xt[None], (e, t, h)).astype(x.dtype))
+        expert_out = ep(_expert_ffn(cfg, m, xs))  # [E, T, H]
         gates = jnp.zeros((t, e), jnp.float32)
         gates = jax.vmap(lambda g, idx, p: g.at[idx].add(p))(
             gates, top_idx, top_probs)
@@ -216,8 +229,9 @@ def moe_mlp_with_losses(cfg: TransformerConfig, m: Dict, x: jnp.ndarray,
         disp = within[..., None] & (
             pos[..., None] == jnp.arange(cap)[None, None, None, :])
         disp_tec = disp.sum(axis=1).astype(x.dtype)  # [T, E, C]
-        expert_in = jnp.einsum("th,tec->ech", xt.astype(x.dtype), disp_tec)
-        expert_out = _expert_ffn(cfg, m, expert_in)  # [E, C, H]
+        expert_in = ep(jnp.einsum("th,tec->ech", xt.astype(x.dtype),
+                                  disp_tec))
+        expert_out = ep(_expert_ffn(cfg, m, expert_in))  # [E, C, H]
         combine = (disp.astype(jnp.float32)
                    * top_probs[:, :, None, None]).sum(axis=1)  # [T, E, C]
         out = jnp.einsum("ech,tec->th", expert_out.astype(jnp.float32),
