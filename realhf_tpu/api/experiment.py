@@ -68,8 +68,13 @@ class ExperimentSpec:
     # and the roles assigned to it (reference: ModelWorker per GPU;
     # on TPU one worker per host-slice).
     n_model_workers: int = 1
-    # role -> model worker index; unassigned roles land on worker 0.
-    worker_assignment: Dict[str, int] = dataclasses.field(
+    # role -> model worker index OR list of indices (a worker GROUP:
+    # the role's mesh spans every group member's devices, and the
+    # members form one jax.distributed world -- the reference's
+    # multi-node model spanning multiple ModelWorkers). Unassigned
+    # roles land on worker 0. The first index is the group LEADER: it
+    # owns the dataset/reply protocol for the role.
+    worker_assignment: Dict[str, object] = dataclasses.field(
         default_factory=dict)
     # Buffer capacity: how many dataset batches may be in flight at
     # once (>=2 lets MFCs of consecutive steps overlap on disjoint
@@ -85,5 +90,24 @@ class ExperimentSpec:
     # model_worker.py:542-552).
     auto_offload: bool = False
 
+    def workers_of_role(self, role: str) -> List[int]:
+        """Worker group of a role (leader first). Single-int
+        assignments are one-member groups."""
+        v = self.worker_assignment.get(role, 0)
+        if isinstance(v, int):
+            return [v]
+        out = list(v)
+        if len(out) != len(set(out)):
+            raise ValueError(f"duplicate workers in group of {role}: {v}")
+        return out
+
     def worker_of_role(self, role: str) -> int:
-        return self.worker_assignment.get(role, 0)
+        """The role's group leader (single worker in the common case)."""
+        return self.workers_of_role(role)[0]
+
+    @property
+    def multihost(self) -> bool:
+        """True when any role's mesh spans more than one worker
+        process -- all model workers then join one jax.distributed
+        world (the reference's single NCCL world, global_comm.py:44)."""
+        return any(len(self.workers_of_role(r)) > 1 for r in self.models)

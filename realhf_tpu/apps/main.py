@@ -49,8 +49,9 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
     """One trial attempt: spawn workers, run to completion, tear down.
     Raises JobException/TimeoutError on worker failure (the caller's
     recover loop relaunches)."""
-    bad = {r: w for r, w in spec.worker_assignment.items()
-           if not 0 <= w < spec.n_model_workers}
+    bad = {r: spec.workers_of_role(r) for r in spec.worker_assignment
+           if not all(0 <= w < spec.n_model_workers
+                      for w in spec.workers_of_role(r))}
     if bad:
         raise ValueError(
             f"worker_assignment indices out of range for "
@@ -96,10 +97,15 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
             "configure", worker_names=["master_worker/0"],
             kwargs=dict(config=dict(spec_path=path,
                                     recover_mode=recover_mode)))
-        for i in range(spec.n_model_workers):
-            panel.group_request(
-                "configure", worker_names=[f"model_worker/{i}"],
-                kwargs=dict(config=dict(spec_path=path, worker_index=i)))
+        # One send-all-then-gather round: multihost configure is a
+        # cross-worker barrier (jax.distributed world join), so the
+        # requests must all be in flight before any reply is awaited.
+        panel.group_request_varied(
+            "configure",
+            {f"model_worker/{i}": dict(config=dict(spec_path=path,
+                                                   worker_index=i))
+             for i in range(spec.n_model_workers)},
+            timeout=600)
         panel.group_request("start")
         logger.info("All %d workers started.", len(worker_names))
 

@@ -11,6 +11,7 @@ import random
 import numpy as np
 
 _base_seed = None
+_shared_seed = None
 
 
 def set_random_seed(seed: int):
@@ -18,6 +19,24 @@ def set_random_seed(seed: int):
     _base_seed = int(seed)
     random.seed(seed)
     np.random.seed(seed % (2 ** 32))
+
+
+def set_shared_seed(seed: int):
+    """Experiment-level seed shared by EVERY worker process.
+
+    Worker processes offset their ambient seed by worker index (so
+    dataset shuffles etc. differ), but randomness feeding SPMD
+    computations (generation sampling keys) must be identical on all
+    members of a multi-process mesh -- it derives from this value."""
+    global _shared_seed
+    _shared_seed = int(seed)
+
+
+def get_shared_seed() -> int:
+    """The experiment seed if set, else the ambient process seed."""
+    if _shared_seed is not None:
+        return _shared_seed
+    return get_seed()
 
 
 def get_seed() -> int:
@@ -36,3 +55,19 @@ def derive_seed(*names: str) -> int:
 def derive_key(*names: str):
     import jax
     return jax.random.PRNGKey(derive_seed(*names) % (2 ** 31))
+
+
+def derive_seed_from(base_seed: int, *names: str) -> int:
+    """Like derive_seed but from an EXPLICIT base seed instead of the
+    process-global one. Use for values that must agree across worker
+    processes (e.g. model init on a multi-host mesh) even though each
+    worker's ambient seed is offset by its index."""
+    h = hashlib.sha256(
+        ("/".join(map(str, names)) + f"@{int(base_seed)}").encode())
+    return int.from_bytes(h.digest()[:8], "little") & ((1 << 63) - 1)
+
+
+def derive_key_from(base_seed: int, *names: str):
+    import jax
+    return jax.random.PRNGKey(
+        derive_seed_from(base_seed, *names) % (2 ** 31))

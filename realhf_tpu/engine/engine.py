@@ -56,6 +56,23 @@ class Engine:
         self.ctx = ctx
         self.mesh = ctx.mesh
         self.version = 0
+        # Multi-controller operation: when the mesh spans >1 OS process
+        # (one jax.distributed world across hosts, reference NCCL world
+        # global_comm.py:44), every member process runs the SAME engine
+        # calls. Host inputs must then be global arrays (replicated;
+        # each process already holds the full batch) and array outputs
+        # are jitted back to replicated so every member can read them.
+        self._mesh_procs = sorted(
+            {d.process_index for d in self.mesh.devices.flat})
+        self._multiproc = len(self._mesh_procs) > 1
+        if self._multiproc:
+            import jax as _jax
+            mine = _jax.process_index()
+            if mine not in self._mesh_procs:
+                raise ValueError(
+                    f"Engine mesh spans processes {self._mesh_procs} "
+                    f"but this engine was built on process {mine}; "
+                    "only group members may host the model.")
 
         # Pipeline parallelism: blocks layer-sharded over "pipe",
         # GPipe microbatch rotation inside every forward/backward
@@ -147,8 +164,38 @@ class Engine:
         self._train_step_cache: Dict[Any, Callable] = {}
         self._generate_cache: Dict[Any, Callable] = {}
         self._jit_forward_hidden = None
+        self._gather_jit = None
         self._jit_logprobs = None
         self._jit_values = None
+
+    # ------------------------------------------------------------------
+    # Multi-process (worker-group) helpers
+    # ------------------------------------------------------------------
+    @property
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def _globalize(self, arr):
+        """Host array -> device array usable by this engine's jits.
+
+        Single-process: plain jnp.asarray (jit reshards under GSPMD).
+        Multi-process mesh: build a REPLICATED global jax.Array from
+        the process-local copy (every member fetched the same batch
+        from the data plane), since jit on a cross-process mesh only
+        accepts global arrays.
+        """
+        if not self._multiproc:
+            return jnp.asarray(arr)
+        a = np.asarray(arr)
+        return jax.make_array_from_callback(
+            a.shape, self._replicated_sharding, lambda idx: a[idx])
+
+    def _out_replicated(self):
+        """out_shardings making jit outputs replicated (hence fully
+        addressable on every member process); None single-process to
+        let XLA choose."""
+        return self._replicated_sharding if self._multiproc else None
 
     @property
     def n_streams(self) -> int:
@@ -238,12 +285,13 @@ class Engine:
         step = self._train_step_cache[key]
 
         stacked = {
-            k: jnp.stack([jnp.asarray(mb[k]) for mb in microbatches])
+            k: self._globalize(np.stack([np.asarray(mb[k])
+                                         for mb in microbatches]))
             for k in microbatches[0]
         }
         if loss_weights is None:
             loss_weights = [1.0] * len(microbatches)
-        weights = jnp.asarray(loss_weights, jnp.float32)
+        weights = self._globalize(np.asarray(loss_weights, np.float32))
 
         self.params, self.opt_state, loss, stats, gnorm = step(
             self.params, self.opt_state, stacked, weights)
@@ -263,7 +311,6 @@ class Engine:
     # ------------------------------------------------------------------
     def forward_hidden(self, input_ids, seg_ids):
         if self._jit_forward_hidden is None:
-            @jax.jit
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
@@ -271,16 +318,17 @@ class Engine:
                                  moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return h
-            self._jit_forward_hidden = f
-        return self._jit_forward_hidden(self.params, jnp.asarray(input_ids),
-                                        jnp.asarray(seg_ids))
+            self._jit_forward_hidden = jax.jit(
+                f, out_shardings=self._out_replicated())
+        return self._jit_forward_hidden(self.params,
+                                        self._globalize(input_ids),
+                                        self._globalize(seg_ids))
 
     def forward_logprobs(self, input_ids, seg_ids, temperature: float = 1.0,
                          logits_mask=None):
         """Next-token logprobs [S, L] (the reference's `inference` MFC
         on actor/ref models, ppo_interface.py:255)."""
         if self._jit_logprobs is None:
-            @functools.partial(jax.jit, static_argnames=("temp", "has_mask"))
             def f(params, ids, seg, mask, temp, has_mask):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
@@ -290,11 +338,13 @@ class Engine:
                 return F.shifted_logprobs_from_hidden(
                     self.cfg, params, h, ids, seg, temperature=temp,
                     logits_mask=mask if has_mask else None)
-            self._jit_logprobs = f
-        mask = jnp.asarray(logits_mask) if logits_mask is not None else \
-            jnp.zeros((1,), bool)
-        return self._jit_logprobs(self.params, jnp.asarray(input_ids),
-                                  jnp.asarray(seg_ids), mask,
+            self._jit_logprobs = jax.jit(
+                f, static_argnames=("temp", "has_mask"),
+                out_shardings=self._out_replicated())
+        mask = self._globalize(logits_mask) if logits_mask is not None \
+            else self._globalize(np.zeros((1,), bool))
+        return self._jit_logprobs(self.params, self._globalize(input_ids),
+                                  self._globalize(seg_ids), mask,
                                   temp=temperature,
                                   has_mask=logits_mask is not None)
 
@@ -302,7 +352,6 @@ class Engine:
         """Critic/reward scalar outputs [S, L]."""
         assert self.cfg.is_critic
         if self._jit_values is None:
-            @jax.jit
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
@@ -310,9 +359,10 @@ class Engine:
                                  moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return T.critic_values(self.cfg, params, h)
-            self._jit_values = f
-        return self._jit_values(self.params, jnp.asarray(input_ids),
-                                jnp.asarray(seg_ids))
+            self._jit_values = jax.jit(
+                f, out_shardings=self._out_replicated())
+        return self._jit_values(self.params, self._globalize(input_ids),
+                                self._globalize(seg_ids))
 
     # ------------------------------------------------------------------
     # Generation
@@ -335,10 +385,12 @@ class Engine:
             self._generate_cache[cache_key] = gen_mod.build_generate_fn(
                 self.cfg, gconfig, eos_token_id, pad_token_id,
                 activation_constraint=self._constrain,
-                moe_constraint=self.moe_constraint)
+                moe_constraint=self.moe_constraint,
+                out_sharding=self._out_replicated())
         fn = self._generate_cache[cache_key]
-        return fn(self.params, jnp.asarray(prompt_ids),
-                  jnp.asarray(prompt_seg), jnp.asarray(prompt_pos), key)
+        return fn(self.params, self._globalize(prompt_ids),
+                  self._globalize(prompt_seg), self._globalize(prompt_pos),
+                  self._globalize(key))
 
     # ------------------------------------------------------------------
     def set_params(self, params, already_sharded: bool = False):
@@ -351,9 +403,19 @@ class Engine:
             self.params = jax.device_put(params, self._param_shardings)
 
     def params_numpy(self):
-        """Host copy with vocab padding stripped (checkpoint layout)."""
+        """Host copy with vocab padding stripped (checkpoint layout).
+
+        On a multi-process mesh this is a COLLECTIVE: every member
+        process must call it together (it all-gathers the shards into
+        a replicated copy each process can read)."""
+        params = self.params
+        if self._multiproc:
+            if self._gather_jit is None:
+                self._gather_jit = jax.jit(
+                    lambda p: p, out_shardings=self._out_replicated())
+            params = self._gather_jit(params)
         return shard_rules.unpad_vocab(
-            self.cfg, jax.tree.map(np.asarray, self.params))
+            self.cfg, jax.tree.map(np.asarray, params))
 
     def inc_version(self):
         self.version += 1

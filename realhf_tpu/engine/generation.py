@@ -130,7 +130,8 @@ def generate(
 def build_generate_fn(cfg: TransformerConfig,
                       gconfig: GenerationHyperparameters,
                       eos_token_id: Optional[int], pad_token_id: int,
-                      activation_constraint=None, moe_constraint=None):
+                      activation_constraint=None, moe_constraint=None,
+                      out_sharding=None):
     """Jitted generate closure; XLA caches compilations per
     batch/bucket shape. Engines build this once and reuse it."""
     fn = functools.partial(generate, cfg, gconfig=gconfig,
@@ -139,8 +140,9 @@ def build_generate_fn(cfg: TransformerConfig,
                            activation_constraint=activation_constraint,
                            moe_constraint=moe_constraint)
 
-    @jax.jit
     def run(params, prompt_ids, prompt_seg, prompt_pos, key):
         return fn(params, prompt_ids, prompt_seg, prompt_pos, key)
 
-    return run
+    # out_sharding: replicated outputs on multi-process meshes so every
+    # worker-group member can read the generated tokens.
+    return jax.jit(run, out_shardings=out_sharding)

@@ -80,15 +80,19 @@ class CommonExperimentConfig:
     n_devices: Optional[int] = None
     n_model_workers: int = 1
     # "role:workerIdx,role:workerIdx" -- which model worker hosts each
-    # role in distributed mode (unlisted roles land on worker 0)
+    # role in distributed mode (unlisted roles land on worker 0).
+    # "role:0+1" assigns a worker GROUP: the role's mesh spans both
+    # workers' devices (multi-host model; leader = first index).
     worker_assignment: str = ""
 
-    def parsed_worker_assignment(self) -> Dict[str, int]:
-        out = {}
+    def parsed_worker_assignment(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
         if self.worker_assignment:
             for part in self.worker_assignment.split(","):
                 role, idx = part.split(":")
-                out[role.strip()] = int(idx)
+                members = [int(x) for x in idx.split("+")]
+                out[role.strip()] = members[0] if len(members) == 1 \
+                    else members
         return out
 
     def ctl(self) -> SaveEvalControl:

@@ -31,11 +31,13 @@ logger = logging.getLogger("PPOInterface")
 
 
 def _base_key() -> jax.Array:
-    """Deterministic PRNG root: the experiment seed when set, else 0.
-    (Python hash() is process-salted and must not feed SPMD RNG.)"""
+    """Deterministic PRNG root: the EXPERIMENT seed when set, else 0.
+    (Python hash() is process-salted and must not feed SPMD RNG; the
+    per-worker ambient seed must not either -- every member of a
+    worker group needs identical sampling keys.)"""
     from realhf_tpu.base import seeding
     try:
-        seed = seeding.get_seed()
+        seed = seeding.get_shared_seed()
     except RuntimeError:
         seed = 0
     return jax.random.PRNGKey(seed % (2 ** 31))

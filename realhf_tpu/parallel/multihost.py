@@ -105,6 +105,42 @@ def initialize_multihost(experiment_name: str, trial_name: str,
     return process_id
 
 
+def initialize_worker_world(experiment_name: str, trial_name: str,
+                            n_processes: int, process_id: int,
+                            local_device_count: Optional[int] = None,
+                            group: str = "model_workers",
+                            timeout: float = 300.0) -> None:
+    """Join the model-worker jax.distributed world with a FIXED rank.
+
+    Unlike ``rendezvous`` (ranks from sorted registration order), the
+    worker world needs rank == worker_index so the master's
+    worker-group assignments map deterministically onto
+    ``jax.devices()`` process indices. Worker 0 binds a free port and
+    publishes the coordinator address; everyone else waits for it.
+    """
+    import jax
+
+    if n_processes <= 1:
+        return
+    master_key = names.distributed_master(experiment_name, trial_name,
+                                          group)
+    if process_id == 0:
+        addr = f"{network.gethostip()}:{find_free_port()}"
+        name_resolve.add(master_key, addr, replace=True,
+                         delete_on_exit=True)
+    else:
+        addr = name_resolve.wait(master_key, timeout=timeout)
+    kwargs = dict(coordinator_address=addr, num_processes=n_processes,
+                  process_id=process_id,
+                  initialization_timeout=int(timeout))
+    if local_device_count is not None:
+        kwargs["local_device_ids"] = list(range(local_device_count))
+    jax.distributed.initialize(**kwargs)
+    logger.info("Worker world initialized: rank %d/%d, coordinator %s, "
+                "%d global devices.", process_id, n_processes, addr,
+                jax.device_count())
+
+
 def shutdown_multihost():
     import jax
 

@@ -54,8 +54,15 @@ class MasterWorker(worker_base.Worker):
         self.node_worker = {
             n.name: f"model_worker/{spec.worker_of_role(n.role)}"
             for n in self.dfg.nodes}
+        # full worker GROUP per node (multi-host roles span several
+        # worker processes; requests go to every member, the leader --
+        # first in the group -- replies with data, members ack)
+        self.node_workers = {
+            n.name: [f"model_worker/{w}"
+                     for w in spec.workers_of_role(n.role)]
+            for n in self.dfg.nodes}
         self.all_workers = sorted(
-            {w for w in self.node_worker.values()})
+            {w for ws in self.node_workers.values() for w in ws})
         src = self.dfg.sources[0]
         self.data_owner = self.node_worker[src.name]
         # roles with a train MFC -> that MFC name (staleness guard)
@@ -139,17 +146,21 @@ class MasterWorker(worker_base.Worker):
     def _dispatch_mfc(self, bid: int, mfc_name: str):
         e = self.buffer.get(bid)
         node = self.dfg.find(mfc_name)
-        worker = self.node_worker[mfc_name]
+        workers = self.node_workers[mfc_name]
+        leader = self.node_worker[mfc_name]
         fetch_plan = {k: e.key_owner[k] for k in node.input_keys
                       if k in e.key_owner}
-        rid = self.stream.request(
-            [worker], node.interface_type.value,
-            datas=[dict(node=mfc_name, ids=list(e.ids),
-                        fetch_plan=fetch_plan)])[0]
-        self._inflight[rid] = (bid, mfc_name)
+        payload = dict(node=mfc_name, ids=list(e.ids),
+                       fetch_plan=fetch_plan)
+        rids = self.stream.request(
+            workers, node.interface_type.value,
+            datas=[payload] * len(workers))
+        for w, rid in zip(workers, rids):
+            self._inflight[rid] = ((bid, mfc_name) if w == leader
+                                   else (None, "__member__"))
         self.buffer.mark_dispatched(bid, mfc_name)
         logger.debug("Dispatched %s (batch %d) to %s.", mfc_name, bid,
-                     worker)
+                     workers)
 
     def _dispatch_fetch(self):
         rid = self.stream.request(
@@ -225,7 +236,8 @@ class MasterWorker(worker_base.Worker):
         if force or self.save_ctl.check(epochs=epochs, steps=1):
             by_worker: Dict[str, list] = {}
             for m in train_nodes:
-                by_worker.setdefault(self.node_worker[m], []).append(m)
+                for w in self.node_workers[m]:
+                    by_worker.setdefault(w, []).append(m)
             # post ALL save requests first, then gather: workers
             # checkpoint concurrently instead of one at a time
             rids = [self.stream.request(
@@ -245,7 +257,8 @@ class MasterWorker(worker_base.Worker):
                 self.eval_ctl.check(epochs=epochs, steps=1):
             by_worker = {}
             for m in train_nodes:
-                by_worker.setdefault(self.node_worker[m], []).append(m)
+                for w in self.node_workers[m]:
+                    by_worker.setdefault(w, []).append(m)
             rids = [self.stream.request(
                 [w], "evaluate", datas=[dict(nodes=nodes)])[0]
                 for w, nodes in by_worker.items()]
@@ -288,7 +301,7 @@ class MasterWorker(worker_base.Worker):
             bid, mfc_name = ref
             if mfc_name == "__fetch__":
                 self._on_fetch_reply(p.data)
-            elif mfc_name != "__clear__":
+            elif mfc_name not in ("__clear__", "__member__"):
                 self._on_mfc_reply(bid, mfc_name, p.data)
             n += 1
 

@@ -30,7 +30,7 @@ logger = logging.getLogger("model_host", "benchmark")
 
 def build_model(role: str, spec, tokenizer, total_steps: int,
                 devices=None, params_override=None,
-                cfg_override=None) -> model_api.Model:
+                cfg_override=None, init_seed=None) -> model_api.Model:
     """Instantiate one model role on the local devices (reference
     ReaLModel instantiation in model_worker.__lazy_setup:294-337)."""
     from realhf_tpu.parallel.mesh import default_devices
@@ -52,8 +52,14 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
         cfg.gradient_checkpointing = spec.gradient_checkpointing
         cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
     if params is None:
-        params = T.init_params(
-            cfg, seeding.derive_key("model_init", role))
+        # Model init must be identical on every process of a worker
+        # group (the collective device_put verifies value equality), so
+        # the key derives from the EXPERIMENT seed, never the ambient
+        # per-worker seed.
+        key = (seeding.derive_key_from(init_seed, "model_init", role)
+               if init_seed is not None
+               else seeding.derive_key("model_init", role))
+        params = T.init_params(cfg, key)
 
     if devices is None:
         devices = default_devices()[:spec.parallel.world_size]
@@ -66,19 +72,32 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
 
 
 class ModelHost:
-    """All models of some roles + MFC execution with hooks."""
+    """All models of some roles + MFC execution with hooks.
+
+    ``devices_fn(role, parallel) -> device list`` lets the distributed
+    model worker place a role's mesh on its worker GROUP's devices
+    (multi-host model); None keeps the local default. ``leader_of_role``
+    marks whether THIS process is the role's group leader: non-leaders
+    participate in every collective (save gather, eval forwards) but
+    skip host-side writes and reply payloads."""
 
     def __init__(self, spec, roles: List[str], nodes: List[MFCDef],
-                 tokenizer, total_steps: int):
+                 tokenizer, total_steps: int, devices_fn=None,
+                 leader_of_role: Optional[Dict[str, bool]] = None):
         self.spec = spec
         self.roles = list(roles)
         self.nodes = {n.name: n for n in nodes}
         self.tokenizer = tokenizer
+        self.devices_fn = devices_fn
+        self.leader_of_role = leader_of_role or {}
 
         self.models: Dict[str, model_api.Model] = {}
         for role in self.roles:
             self.models[role] = build_model(
-                role, spec.models[role], tokenizer, total_steps)
+                role, spec.models[role], tokenizer, total_steps,
+                devices=(devices_fn(role, spec.models[role].parallel)
+                         if devices_fn else None),
+                init_seed=spec.seed)
 
         # Replica engines for MFCs allocated on a different layout than
         # their role's primary. Replicas never own an optimizer;
@@ -102,7 +121,9 @@ class ModelHost:
             self.replicas[node.name] = build_model(
                 f"{role}-{node.name}", mspec, tokenizer, total_steps,
                 params_override=primary.engine.params,
-                cfg_override=primary.config)
+                cfg_override=primary.config,
+                devices=(devices_fn(role, alloc) if devices_fn
+                         else None))
             logger.info("Created replica for %s: %s (primary %s)",
                         node.name, alloc, primary.engine.ctx.parallel)
 
@@ -196,11 +217,28 @@ class ModelHost:
     def save_role(self, role: str, train_node_name: str):
         model = self.models[role]
         path = os.path.join(constants.run_save_path(), role)
+        if not getattr(self.interfaces[train_node_name], "enable_save",
+                       True):
+            # The leader's interface.save() returns without touching
+            # the params; members must skip the collective gather too
+            # or they would block in an all-gather nobody else joins.
+            return None
+        if not self.leader_of_role.get(role, True):
+            # Group member, not leader: params_numpy() is a COLLECTIVE
+            # on a multi-process mesh -- participate in the gather the
+            # leader's interface.save() runs, but write nothing.
+            model.engine.params_numpy()
+            return None
         self.interfaces[train_node_name].save(model, path)
         logger.info("Saved %s to %s", role, path)
         return path
 
     def evaluate_role(self, role: str, train_node_name: str,
                       eval_dataloader) -> Optional[dict]:
-        return self.interfaces[train_node_name].evaluate(
+        out = self.interfaces[train_node_name].evaluate(
             self.models[role], eval_dataloader)
+        # non-leader members ran the (collective) eval forwards; only
+        # the leader reports
+        if not self.leader_of_role.get(role, True):
+            return None
+        return out

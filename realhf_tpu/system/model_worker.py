@@ -55,25 +55,56 @@ class ModelWorker(worker_base.Worker):
         constants.set_experiment_trial_names(spec.experiment_name,
                                              spec.trial_name)
         seeding.set_random_seed(spec.seed + self.worker_index + 1)
+        seeding.set_shared_seed(spec.seed)
 
         import realhf_tpu.datasets  # noqa: F401 - register datasets
         import realhf_tpu.interfaces  # noqa: F401 - register interfaces
 
         self.dfg = DFG(spec.mfcs)
         my_roles = [r for r in spec.models
-                    if spec.worker_of_role(r) == self.worker_index]
+                    if self.worker_index in spec.workers_of_role(r)]
         my_nodes = [n for n in self.dfg.nodes if n.role in my_roles]
         self.my_nodes = {n.name for n in my_nodes}
+        # Group leadership: the first worker of a role's group owns the
+        # dataset / reply payloads; members execute the same jitted
+        # computations (their devices are part of the role's mesh) and
+        # reply lightweight acks.
+        self.leader_of_role = {
+            r: spec.workers_of_role(r)[0] == self.worker_index
+            for r in my_roles
+        }
+        self.leader_nodes = {n.name for n in my_nodes
+                             if self.leader_of_role[n.role]}
+
+        # Multi-host: all model workers join ONE jax.distributed world
+        # (reference's single NCCL world, global_comm.py:44) with rank
+        # == worker_index, then role meshes span their group's devices.
+        self._devices_by_proc = None
+        if spec.multihost:
+            from realhf_tpu.parallel.multihost import (
+                initialize_worker_world,
+            )
+            ldc = os.environ.get("REALHF_TPU_LOCAL_DEVICE_COUNT")
+            initialize_worker_world(
+                spec.experiment_name, spec.trial_name,
+                spec.n_model_workers, self.worker_index,
+                local_device_count=int(ldc) if ldc else None)
+            from realhf_tpu.parallel.mesh import default_devices
+            by_proc: Dict[int, list] = {}
+            for d in sorted(default_devices(),
+                            key=lambda d: (d.process_index, d.id)):
+                by_proc.setdefault(d.process_index, []).append(d)
+            self._devices_by_proc = by_proc
 
         self.tokenizer = spec.tokenizer or (
             data_api.load_hf_tokenizer(spec.tokenizer_path)
             if spec.tokenizer_path else None)
 
-        # Dataset lives with the worker hosting the source MFC's role
-        # (reference: datasets on src-RPC DP-head model workers,
-        # model_worker.py:256-292).
+        # Dataset lives with the LEADER of the worker group hosting the
+        # source MFC's role (reference: datasets on src-RPC DP-head
+        # model workers, model_worker.py:256-292).
         src = self.dfg.sources[0]
-        self.owns_data = src.name in self.my_nodes
+        self.owns_data = src.name in self.leader_nodes
         self.dataloader_iter = None
         self._epoch = 0
         # steps_per_epoch feeds every trainable role's lr schedule, so
@@ -115,8 +146,10 @@ class ModelWorker(worker_base.Worker):
                 eval_ds, batch_size=src.n_seqs, shuffle=False)
 
         total_steps = (self.steps_per_epoch or 1) * spec.total_train_epochs
+        devices_fn = self._devices_for_role if spec.multihost else None
         self.host = ModelHost(spec, my_roles, my_nodes, self.tokenizer,
-                              total_steps)
+                              total_steps, devices_fn=devices_fn,
+                              leader_of_role=self.leader_of_role)
 
         # data plane: store + threaded server + peer-fetch client
         self.store = DataStore()
@@ -137,6 +170,31 @@ class ModelWorker(worker_base.Worker):
                     steps_per_epoch=self.steps_per_epoch)
 
     # ------------------------------------------------------------------
+    def _devices_for_role(self, role: str, parallel) -> list:
+        """Mesh devices for a role in the joint worker world: an equal
+        per-member slice of every group member's local devices, ordered
+        group-major so the innermost mesh axes (tensor parallel) stay
+        within one process/host (ICI) and outer axes (data) cross hosts
+        (DCN) -- the reference's TP-on-NVLink placement."""
+        group = self.spec.workers_of_role(role)
+        ws = parallel.world_size
+        if ws % len(group) != 0:
+            raise ValueError(
+                f"role {role}: layout {parallel} world_size {ws} not "
+                f"divisible by its worker group size {len(group)} "
+                f"(group {group}); every member must own an equal "
+                "slice of the mesh.")
+        per = ws // len(group)
+        devs = []
+        for w in group:
+            local = self._devices_by_proc.get(w, [])
+            if len(local) < per:
+                raise ValueError(
+                    f"role {role}: worker {w} has {len(local)} devices "
+                    f"but the layout needs {per} per member.")
+            devs.extend(local[:per])
+        return devs
+
     def _handle_fetch_data(self, req: Payload):
         """Load the next dataset batch, keep tensors locally, reply
         metadata (ids/seqlens/keys) + epoch accounting."""
@@ -202,11 +260,20 @@ class ModelWorker(worker_base.Worker):
         keys = [k for k in node.input_keys]
         inp = self._assemble_input(d["ids"], keys, d.get("fetch_plan", {}))
         out = self.host.execute(node_name, inp)
+        is_leader = node_name in self.leader_nodes
         if isinstance(out, data_api.SequenceSample):
+            # members store the (replicated) outputs too: later MFCs on
+            # this worker then hit the local cache instead of refetching
             self.store.put(out)
-            self.stream.respond(req, data=dict(meta=out.meta(), stats=None))
-        else:
+            if is_leader:
+                self.stream.respond(req, data=dict(meta=out.meta(),
+                                                   stats=None))
+            else:
+                self.stream.respond(req, data=dict(member=True))
+        elif is_leader:
             self.stream.respond(req, data=dict(meta=None, stats=out))
+        else:
+            self.stream.respond(req, data=dict(member=True))
 
     def _handle_save(self, req: Payload):
         saved = {}

@@ -92,10 +92,20 @@ class WorkerControlPanel:
                       kwargs: Optional[Dict] = None,
                       timeout: float = 600.0) -> Dict[str, Any]:
         targets = worker_names or list(self._socks)
-        for w in targets:
-            self._socks[w].send(pickle.dumps((command, kwargs or {})))
+        return self.group_request_varied(
+            command, {w: kwargs or {} for w in targets}, timeout=timeout)
+
+    def group_request_varied(self, command: str,
+                             kwargs_by_worker: Dict[str, Dict],
+                             timeout: float = 600.0) -> Dict[str, Any]:
+        """group_request with per-worker kwargs. All requests go out
+        before any reply is awaited, so command handlers that form a
+        cross-worker barrier (e.g. configure joining a jax.distributed
+        world) complete even when each worker needs different kwargs."""
+        for w, kw in kwargs_by_worker.items():
+            self._socks[w].send(pickle.dumps((command, kw or {})))
         out = {}
-        for w in targets:
+        for w in kwargs_by_worker:
             if not self._socks[w].poll(timeout * 1000):
                 raise TimeoutError(f"Worker {w} did not respond to "
                                    f"`{command}`.")
