@@ -100,8 +100,10 @@ def generate(
             logits, step_idx, unfinished, k)
         emitted = emitted + was_unfinished.astype(jnp.int32)
         pos = prompt_lens + step_idx
+        # all streams share the padded prompt length, so cache writes
+        # land in one uniform slot (dynamic_update_slice fast path)
         new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos,
-                                          moe_constraint)
+                                          moe_constraint, uniform_slot=True)
         out = (tokens, logprob, mask) if not gconfig.force_no_logits_mask \
             else (tokens, logprob)
         return (new_hidden, cache, unfinished, emitted), out

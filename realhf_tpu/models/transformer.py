@@ -460,11 +460,20 @@ def decode_step(
     token: jnp.ndarray,      # [B] int32 -- the token to feed
     positions: jnp.ndarray,  # [B] int32 -- its position in the sequence
     moe_constraint=None,
+    uniform_slot: bool = False,
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step: feed `token`, return hidden [B, H] for the next
     token's logits and the updated cache. The jitted decode loop built
     on this replaces CUDA-graph decoding (reference
-    real_llm_generate.py:214, cuda_graph.py)."""
+    real_llm_generate.py:214, cuda_graph.py).
+
+    ``uniform_slot``: promise that every stream writes the SAME cache
+    slot (true for the batch generate path, where prefill fills a
+    common padded length and all streams advance in lockstep). The
+    cache update then lowers to `dynamic_update_slice` instead of a
+    per-row scatter -- on a v5e the scatter costs ~0.25 ms per stream
+    per step, dominating decode beyond bs~16. Continuous batching
+    (per-slot lengths) keeps the scatter path."""
     cdt = jnp.dtype(cfg.compute_dtype)
     b = token.shape[0]
     slot = cache["length"]  # write position per stream
@@ -485,7 +494,12 @@ def decode_step(
         cos = jnp.ones((b, half), jnp.float32)
         sin = jnp.zeros((b, half), jnp.float32)
 
-    valid = cache["valid"].at[jnp.arange(b), slot].set(True)
+    if uniform_slot:
+        s0 = slot[0]
+        valid = jax.lax.dynamic_update_slice(
+            cache["valid"], jnp.ones((b, 1), bool), (0, s0))
+    else:
+        valid = cache["valid"].at[jnp.arange(b), slot].set(True)
     new_len = slot + 1
 
     def body(x, layer):
@@ -495,8 +509,14 @@ def decode_step(
         if cfg.apply_rotary:
             q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
             k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
-        k_cache = k_cache.at[jnp.arange(b), slot].set(k)
-        v_cache = v_cache.at[jnp.arange(b), slot].set(v)
+        if uniform_slot:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k[:, None].astype(k_cache.dtype), (0, s0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v[:, None].astype(v_cache.dtype), (0, s0, 0, 0))
+        else:
+            k_cache = k_cache.at[jnp.arange(b), slot].set(k)
+            v_cache = v_cache.at[jnp.arange(b), slot].set(v)
         attn = decode_attention(q, k_cache, v_cache, valid,
                                 scale=_attn_scale(cfg, layer_idx),
                                 sliding_window=cfg.sliding_window,

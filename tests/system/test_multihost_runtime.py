@@ -91,6 +91,65 @@ def test_sft_worker_group_spanning_two_processes(sft_data):
                                        "default", "config.json"))
 
 
+def test_ppo_actor_group_with_single_worker_roles(tmp_path):
+    """The 6-MFC PPO graph with the ACTOR spanning a 2-process worker
+    group (d2t4 over 8 global devices) while critic/ref/reward stay on
+    single workers: grouped GENERATION (identical sampling keys from
+    the shared seed on both members), data-plane flow from the group
+    leader to single-worker roles, grouped train steps, and mixed
+    group/non-group dispatch in one trial."""
+    from realhf_tpu.apps.main import main_start
+    from realhf_tpu.experiments.ppo_exp import PPOConfig
+
+    rng = np.random.default_rng(1)
+    data = tmp_path / "prompts.jsonl"
+    _write_jsonl(data, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
+        for i in range(16)])
+
+    cfg = PPOConfig(experiment_name="mhppo", trial_name="t0",
+                    total_train_epochs=1, benchmark_steps=2)
+    apply_overrides(cfg, {
+        "dataset.path": str(data),
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.top_k": "16",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    assert len(spec.mfcs) == 6
+    for role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        if role == "actor":  # spans the 2-process group
+            mspec.parallel = ParallelismConfig(
+                data_parallel_size=2, tensor_parallel_size=4)
+        else:  # single-worker roles use that worker's 4 local devices
+            mspec.parallel = ParallelismConfig(
+                data_parallel_size=2, tensor_parallel_size=2)
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = IntegerTokenizer()
+    spec.n_model_workers = 2
+    spec.worker_assignment = {"actor": [0, 1], "critic": 0, "ref": 1,
+                              "reward": 1}
+    assert spec.multihost
+
+    out = main_start(spec, env=WORKER_ENV, timeout=1800)
+    assert out["complete"]
+    assert out["global_step"] == 2
+    stats = out["stats"]
+    assert np.isfinite(stats["actor_train"]["actor_loss"])
+    assert np.isfinite(stats["critic_train"]["value_loss"])
+    assert abs(stats["actor_train"]["importance_weight"] - 1.0) < 0.1
+
+
 def test_worker_group_spec_helpers():
     from realhf_tpu.api.experiment import ExperimentSpec
 
