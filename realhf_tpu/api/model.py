@@ -51,7 +51,13 @@ class ModelInterface(abc.ABC):
     """Algorithm handlers; all default to unimplemented
     (reference model_api.py:605-640)."""
 
-    def save(self, model: Model, save_dir: str):
+    def save(self, model: Model, save_dir: str, host_params=None):
+        """``host_params``, when given, is a pre-gathered host copy of
+        the weights (``Engine.params_numpy()``). On multi-process
+        meshes the CALLER runs that collective on every group member
+        and hands the result in, so an interface's save can never
+        change the group's collective count (see
+        ModelHost.save_role)."""
         pass
 
     def evaluate(self, model: Model, eval_dataloader) -> Dict:

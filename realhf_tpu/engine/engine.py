@@ -406,14 +406,22 @@ class Engine:
         """Host copy with vocab padding stripped (checkpoint layout).
 
         On a multi-process mesh this is a COLLECTIVE: every member
-        process must call it together (it all-gathers the shards into
-        a replicated copy each process can read)."""
+        process must call it together. The gather runs LEAF BY LEAF
+        (one replicating jit per parameter, copied to host before the
+        next) so peak HBM overhead is one unsharded leaf, not the whole
+        model -- the motivating case is a model sharded across hosts
+        precisely because it does not fit one host's devices."""
         params = self.params
         if self._multiproc:
             if self._gather_jit is None:
-                self._gather_jit = jax.jit(
-                    lambda p: p, out_shardings=self._out_replicated())
-            params = self._gather_jit(params)
+                rep = jax.sharding.NamedSharding(
+                    self.ctx.mesh, jax.sharding.PartitionSpec())
+                self._gather_jit = jax.jit(lambda x: x, out_shardings=rep)
+
+            def gather_leaf(x):
+                return np.asarray(self._gather_jit(x))
+
+            params = jax.tree.map(gather_leaf, params)
         return shard_rules.unpad_vocab(
             self.cfg, jax.tree.map(np.asarray, params))
 

@@ -164,11 +164,13 @@ class DPOInterface(model_api.ModelInterface):
         model.inc_version()
         return stats
 
-    def save(self, model: model_api.Model, save_dir: str):
+    def save(self, model: model_api.Model, save_dir: str,
+             host_params=None):
         if not self.enable_save:
             return
         save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           model.engine.params_numpy(),
+                           host_params if host_params is not None
+                           else model.engine.params_numpy(),
                            tokenizer=model.tokenizer)
 
 

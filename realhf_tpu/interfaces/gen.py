@@ -54,6 +54,15 @@ class GenerationInterface(model_api.ModelInterface):
         key = jax.random.fold_in(_base_key(), self._calls)
 
         if self.use_inflight_batching:
+            if model.engine._multiproc:
+                # InflightBatchingGenerator keeps process-local jnp
+                # state and reads arrays host-side (np.asarray), both
+                # invalid when the mesh spans worker processes.
+                raise NotImplementedError(
+                    "Inflight-batching generation on a multi-process "
+                    "(worker-group) mesh is not supported; run the "
+                    "generation MFC on a single-process allocation or "
+                    "disable use_inflight_batching.")
             if (model.engine.pipeline_ctx is not None
                     or model.engine.ctx.parallel.context_parallel_size > 1):
                 # same restriction Engine.generate enforces on the

@@ -358,11 +358,13 @@ class PPOActorInterface(model_api.ModelInterface):
         agg.update(global_stats)
         return agg
 
-    def save(self, model: model_api.Model, save_dir: str):
+    def save(self, model: model_api.Model, save_dir: str,
+             host_params=None):
         if not self.enable_save:
             return
         save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           model.engine.params_numpy(),
+                           host_params if host_params is not None
+                           else model.engine.params_numpy(),
                            tokenizer=model.tokenizer)
 
 
@@ -525,11 +527,13 @@ class PPOCriticInterface(model_api.ModelInterface):
         agg["returns"] = float(returns.mean())
         return agg
 
-    def save(self, model: model_api.Model, save_dir: str):
+    def save(self, model: model_api.Model, save_dir: str,
+             host_params=None):
         if not self.enable_save:
             return
         save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           model.engine.params_numpy(),
+                           host_params if host_params is not None
+                           else model.engine.params_numpy(),
                            tokenizer=model.tokenizer)
 
 

@@ -112,9 +112,11 @@ class SFTInterface(model_api.ModelInterface):
         loss = float(np.sum(losses) / max(1, np.sum(tokens)))
         return {"loss": loss, "ppl": float(np.exp(loss))}
 
-    def save(self, model: model_api.Model, save_dir: str):
+    def save(self, model: model_api.Model, save_dir: str,
+             host_params=None):
         save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           model.engine.params_numpy(),
+                           host_params if host_params is not None
+                           else model.engine.params_numpy(),
                            tokenizer=model.tokenizer)
 
 

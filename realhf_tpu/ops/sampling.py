@@ -41,12 +41,17 @@ def top_k_top_p_logits(logits: jnp.ndarray, top_k: int = 0,
                        top_p: float = 1.0) -> jnp.ndarray:
     """Mask logits outside the top-k / top-p nucleus to -inf.
 
-    With top-k active, only a `lax.top_k` over the vocab runs (no full
-    sort) and the nucleus is computed WITHIN the k survivors -- the
-    reference's chained-warper semantics (logits_warper.py:203: top-k
-    filters first, top-p renormalizes over what remains). The full
-    vocab sort only happens for pure top-p sampling. On a v5e decode
-    step at 32k vocab, the full sort costs ~9 ms; `lax.top_k` ~0.3 ms.
+    Semantics are UNIONED, matching the reference's generate path
+    (real_llm_generate.py:82-87 calls top_k_top_p_logits with
+    ordered=False): the nucleus is computed over the FULL softmax
+    distribution, then intersected with the top-k set. With top-k
+    active only a `lax.top_k` over the vocab runs (no full sort) --
+    probabilities use the full-vocab logsumexp denominator, so the
+    prefix-mass cutoff over the k survivors reproduces full-vocab
+    top-p exactly (a nucleus needing more than k tokens is clamped to
+    k by the union with top-k anyway). The full vocab sort only
+    happens for pure top-p sampling. On a v5e decode step at 32k
+    vocab, the full sort costs ~9 ms; `lax.top_k` ~0.3 ms.
     """
     v = logits.shape[-1]
     if (top_k <= 0 or top_k >= v) and top_p >= 1.0:
@@ -54,7 +59,9 @@ def top_k_top_p_logits(logits: jnp.ndarray, top_k: int = 0,
     if 0 < top_k < v:
         topv, _ = jax.lax.top_k(logits, top_k)  # [..., k] descending
         if top_p < 1.0:
-            probs = jax.nn.softmax(topv, axis=-1)
+            # full-distribution probabilities of the k survivors
+            probs = jnp.exp(
+                topv - jax.nn.logsumexp(logits, axis=-1, keepdims=True))
             cum = jnp.cumsum(probs, axis=-1)
             # number of tokens needed to reach top_p mass (at least 1)
             include = cum - probs < top_p

@@ -99,6 +99,28 @@ class TestSampling:
             np.asarray(top_k_top_p_logits(logits, top_k=0, top_p=1.0)),
             np.asarray(logits))
 
+    def test_top_k_top_p_unioned(self):
+        # Combined top-k+top-p must use UNIONED semantics (reference
+        # real_llm_generate.py:82-87, ordered=False): the nucleus is
+        # computed over the FULL distribution, then intersected with
+        # the top-k set -- NOT renormalized within the k survivors.
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.standard_normal((16, 100)) * 3)
+        for k, p in [(5, 0.9), (50, 0.5), (20, 0.99), (3, 0.2)]:
+            both = np.asarray(top_k_top_p_logits(logits, top_k=k,
+                                                 top_p=p)) > -1e29
+            only_k = np.asarray(top_k_top_p_logits(logits,
+                                                   top_k=k)) > -1e29
+            only_p = np.asarray(top_k_top_p_logits(logits,
+                                                   top_p=p)) > -1e29
+            expect = only_k & only_p
+            # at least one token always survives
+            expect |= ~expect.any(-1, keepdims=True) & only_k \
+                & (np.asarray(logits) == np.asarray(logits).max(
+                    -1, keepdims=True))
+            np.testing.assert_array_equal(both, expect,
+                                          err_msg=f"k={k} p={p}")
+
 
 class TestFunctional:
 
