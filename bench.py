@@ -1,14 +1,32 @@
 """Benchmark entry: prints ONE JSON line with the headline metric.
 
-Round-1 metric: SFT training throughput (tokens/sec/chip) of a
-~650M-param llama-architecture model in bf16 on one TPU chip, packed
-sequences, remat on -- the dense-transformer training path that PPO's
-actor/critic train steps use. ``vs_baseline`` reports achieved MFU
-against a 40% MFU target (the efficiency class of the reference's
-A100 Megatron path); >1.0 means the TPU path beats that efficiency.
+Round-3 headline: **PPO end-to-end** -- the real 6-MFC PPO dataflow
+graph (actor_gen -> {rew_inf, ref_inf, critic_inf} -> {actor_train,
+critic_train}, reference ``experiments/common/ppo_exp.py:230-377``)
+executed by the inline runner on one TPU chip with a tiny-but-real
+llama-architecture model per role, sized so all four roles (actor +
+critic with Adam state, frozen ref + reward) fit one v5e chip's HBM.
 
-Run: python bench.py  (uses the real TPU; falls back to CPU with a
-tiny model if no TPU is present so the harness never hard-fails).
+value        = PPO tokens/sec/chip: total actor tokens of one DFG step
+               (prompts + generated, the tokens every train/inf MFC
+               consumes) divided by the end-to-end step wall-clock.
+vs_baseline  = reference-class-step-time / measured-step-time, where
+               the reference class is modeled per phase from the same
+               accounting the reference logs per step
+               (master_worker.py:1461-1485 + base/monitor.py:277-353):
+               train & inference MFCs at 40% MFU (the A100 Megatron
+               efficiency class) and decode at 40% of the bf16
+               weight+KV HBM-streaming roofline ("on par with vLLM",
+               docs/source/arch.rst:128-135). >1.0 means this stack's
+               end-to-end PPO step beats that reference class on this
+               chip's specs.
+extra        = per-phase wall-clock / MFU / roofline decomposition,
+               reshard latency (parallel/realloc.py return value),
+               decode throughput at serving batch, and the round-2 SFT
+               MFU metric (kept for continuity).
+
+Run: python bench.py  (uses the real TPU; falls back to CPU with tiny
+shapes if no TPU is present so the harness never hard-fails).
 """
 
 import json
@@ -16,6 +34,12 @@ import os
 import subprocess
 import sys
 import time
+
+# v5e per-chip peaks (public spec): bf16 matmul and HBM bandwidth.
+V5E_PEAK_FLOPS = 197e12
+V5E_HBM_BW = 819e9
+REF_MFU = 0.40          # A100 Megatron-class train/inference MFU
+REF_DECODE_ROOFLINE = 0.40  # vLLM-class fraction of HBM roofline
 
 
 def _accelerator_usable(timeout: float = 150.0) -> bool:
@@ -39,15 +63,221 @@ def _accelerator_usable(timeout: float = 150.0) -> bool:
     return bool(out) and out[-1] != "cpu"
 
 
-def main():
-    use_accel = _accelerator_usable()
+def _flops_kw(cfg):
+    return dict(n_layers=cfg.n_layers, hidden_dim=cfg.hidden_dim,
+                n_q_heads=cfg.n_q_heads, n_kv_heads=cfg.n_kv_heads,
+                head_dim=cfg.head_dim,
+                intermediate_dim=cfg.intermediate_dim,
+                vocab_size=cfg.vocab_size)
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    if not use_accel:
-        from realhf_tpu.base.backend import force_cpu_backend
-        force_cpu_backend()
+def _decode_roofline_s(cfg, batch, prompt_len, new_tokens, hbm_bw):
+    """Ideal decode seconds: every step streams the bf16 weights plus
+    each live stream's KV prefix from HBM."""
+    kv_bytes_per_tok = (2 * cfg.n_layers * cfg.n_kv_heads
+                        * cfg.head_dim * 2)
+    kv_read = sum(batch * (prompt_len + t) * kv_bytes_per_tok
+                  for t in range(new_tokens))
+    decode_bytes = new_tokens * 2 * cfg.n_params() + kv_read
+    return decode_bytes / hbm_bw
 
+
+def bench_ppo(on_tpu):
+    """Run the real 6-MFC PPO DFG; return (headline dict, extra dict)."""
+    import jax
+    import numpy as np
+    from realhf_tpu.api.config import DatasetAbstraction
+    from realhf_tpu.base import monitor, testing
+    from realhf_tpu.engine.optim import OptimizerConfig
+    from realhf_tpu.experiments.common import apply_overrides
+    from realhf_tpu.experiments.ppo_exp import PPOConfig
+    from realhf_tpu.parallel.mesh import ParallelismConfig
+    from realhf_tpu.system.inline import InlineRunner
+
+    if on_tpu:
+        model_cfg = dict(
+            n_layers=6, n_kv_heads=5, n_q_heads=10, hidden_dim=1280,
+            intermediate_dim=3456, vocab_size=32000, n_positions=4096,
+            apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu")
+        n_seqs, prompt_len, new_tokens = 64, 128, 128
+        steps, warmup = 3, 1
+        peak_flops, hbm_bw = V5E_PEAK_FLOPS, V5E_HBM_BW
+    else:
+        model_cfg = dict(
+            n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=64,
+            intermediate_dim=128, vocab_size=1000, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu")
+        n_seqs, prompt_len, new_tokens = 4, 16, 8
+        steps, warmup = 1, 1
+        peak_flops, hbm_bw = 1e12, 100e9
+
+    cfg = PPOConfig(experiment_name="benchppo", trial_name="t0",
+                    total_train_epochs=100)
+    apply_overrides(cfg, {
+        "dataset.train_bs_n_seqs": str(n_seqs),
+        "dataset.max_seqlen": str(prompt_len),
+        "ppo.max_new_tokens": str(new_tokens),
+        # fixed lengths => identical packed shapes every step, so the
+        # timed steps reuse the warm compiled programs
+        "ppo.min_new_tokens": str(new_tokens),
+        "ppo.top_k": "50",
+        "ppo.top_p": "0.95",
+        "ppo.ppo_n_minibatches": "2",
+        "ppo.force_no_logits_mask": "true",
+    })
+    spec = cfg.build()
+    spec.dataset = DatasetAbstraction(
+        "random_prompt",
+        args=dict(n_prompts=n_seqs * (steps + warmup + 1),
+                  prompt_len_min=prompt_len, prompt_len_max=prompt_len,
+                  vocab_size=model_cfg["vocab_size"]))
+    for role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(model_cfg)
+        if mspec.optimizer is None:
+            # frozen roles (ref / reward) store bf16 weights: halves
+            # their HBM footprint and read traffic
+            mspec.random_init_config["param_dtype"] = "bfloat16"
+        mspec.bf16 = True
+        mspec.parallel = ParallelismConfig()
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-6, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = testing.IntegerTokenizer(
+        vocab_size=model_cfg["vocab_size"])
+
+    runner = InlineRunner(spec)
+    acfg = runner.models["actor"].config
+    ccfg = runner.models["critic"].config
+
+    from realhf_tpu.api import data as data_api
+    batches = iter(runner.dataloader)
+
+    def timed_step(batch):
+        phase_secs = {}
+        data = batch
+        t_step = time.monotonic()
+        for node in runner.dfg.topological_order():
+            inp = data.select(
+                [k for k in node.input_keys if k in data.keys])
+            t0 = time.monotonic()
+            out = runner.host.execute(node.name, inp)
+            phase_secs[node.name] = time.monotonic() - t0
+            if isinstance(out, data_api.SequenceSample):
+                data.update_(out)
+        return time.monotonic() - t_step, phase_secs
+
+    for _ in range(warmup):
+        timed_step(next(batches))
+    per_phase = {}
+    t0 = time.monotonic()
+    for _ in range(steps):
+        dt, phases = timed_step(next(batches))
+        for k, v in phases.items():
+            per_phase[k] = per_phase.get(k, 0.0) + v
+    total = time.monotonic() - t0
+    step_time = total / steps
+    per_phase = {k: v / steps for k, v in per_phase.items()}
+
+    # ---- reference-class per-phase model --------------------------------
+    total_len = prompt_len + new_tokens
+    seqlens = [total_len] * n_seqs
+    fwd_flops = monitor.transformer_forward_flops(
+        seqlens=seqlens, **_flops_kw(acfg))
+    fwd_flops_c = monitor.transformer_forward_flops(
+        seqlens=seqlens, **_flops_kw(ccfg))
+    train_flops = 3 * fwd_flops * (4 / 3 if acfg.gradient_checkpointing
+                                   else 1)
+    train_flops_c = 3 * fwd_flops_c * (4 / 3 if ccfg.gradient_checkpointing
+                                       else 1)
+    gen_flops = monitor.generation_flops(
+        prompt_lens=[prompt_len] * n_seqs, gen_len=new_tokens,
+        **_flops_kw(acfg))
+    prefill_flops = monitor.transformer_forward_flops(
+        seqlens=[prompt_len] * n_seqs, **_flops_kw(acfg))
+
+    decode_roof_s = _decode_roofline_s(acfg, n_seqs, prompt_len,
+                                       new_tokens, hbm_bw)
+    prefill_ref_s = prefill_flops / (REF_MFU * peak_flops)
+    gen_ref_s = prefill_ref_s + decode_roof_s / REF_DECODE_ROOFLINE
+
+    ref_model = {
+        "actor_gen": gen_ref_s,
+        "rew_inf": fwd_flops_c / (REF_MFU * peak_flops),
+        "ref_inf": fwd_flops / (REF_MFU * peak_flops),
+        "critic_inf": fwd_flops_c / (REF_MFU * peak_flops),
+        "actor_train": train_flops / (REF_MFU * peak_flops),
+        "critic_train": train_flops_c / (REF_MFU * peak_flops),
+    }
+    baseline_step = sum(ref_model.values())
+    tokens_per_step = n_seqs * total_len
+    phase_detail = {}
+    for name, secs in per_phase.items():
+        d = {"secs": round(secs, 4)}
+        if name == "actor_gen":
+            d["mfu"] = round(gen_flops / secs / peak_flops, 4)
+            d["decode_roofline_frac"] = round(
+                decode_roof_s / max(secs - prefill_flops / peak_flops,
+                                    1e-9), 4)
+        elif name.endswith("_train"):
+            fl = train_flops if name.startswith("actor") else train_flops_c
+            d["mfu"] = round(fl / secs / peak_flops, 4)
+        else:
+            fl = fwd_flops if name == "ref_inf" else fwd_flops_c
+            d["mfu"] = round(fl / secs / peak_flops, 4)
+        phase_detail[name] = d
+
+    headline = {
+        "metric": "ppo_tokens_per_sec_per_chip",
+        "value": round(tokens_per_step / step_time, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(baseline_step / step_time, 4),
+    }
+    extra = {
+        "ppo_step_time_s": round(step_time, 4),
+        "ppo_baseline_model_step_s": round(baseline_step, 4),
+        "ppo_n_seqs": n_seqs,
+        "ppo_prompt_len": prompt_len,
+        "ppo_new_tokens": new_tokens,
+        "ppo_actor_params_m": round(acfg.n_params() / 1e6, 1),
+        "ppo_phases": phase_detail,
+    }
+
+    # ---- reshard latency (north-star metric) ----------------------------
+    # Move the actor's live weights onto a second engine: the
+    # ReplicaManager path every decoupled-allocation PPO run uses
+    # (parallel/realloc.py). Single-chip: a device-to-device copy.
+    from realhf_tpu.api.config import ModelName
+    from realhf_tpu.engine.engine import Engine
+    from realhf_tpu.parallel import realloc
+    from realhf_tpu.parallel.mesh import MeshContext, make_mesh
+
+    actor = runner.models["actor"]
+    mesh = make_mesh(ParallelismConfig(), devices=jax.devices()[:1])
+    rep_engine = Engine(actor.config,
+                        MeshContext(ModelName("actor_rep", 0), mesh,
+                                    ParallelismConfig()),
+                        jax.tree.map(np.copy, actor.engine.params_numpy()))
+    lat = realloc.reallocate(actor.config, actor.engine.params,
+                             rep_engine)
+    lat = min(lat, realloc.reallocate(actor.config, actor.engine.params,
+                                      rep_engine))
+    param_bytes = sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(actor.engine.params))
+    extra["reshard_latency_s"] = round(lat, 4)
+    extra["reshard_gbytes_per_s"] = round(param_bytes / lat / 1e9, 2)
+    return headline, extra
+
+
+def bench_sft(on_tpu):
+    """Round-2 metric kept for continuity: SFT train MFU + batch decode
+    throughput of a ~650M llama on one chip."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -58,15 +288,12 @@ def main():
     from realhf_tpu.models import transformer as T
     from realhf_tpu.models.config import TransformerConfig
     from realhf_tpu.ops import functional as F
-    from realhf_tpu.parallel.mesh import MeshContext, ParallelismConfig, make_mesh
+    from realhf_tpu.parallel.mesh import (
+        MeshContext,
+        ParallelismConfig,
+        make_mesh,
+    )
 
-    try:
-        on_tpu = jax.default_backend() != "cpu"
-    except Exception:
-        # Backend raised even after the probe succeeded: fall back.
-        from realhf_tpu.base.backend import force_cpu_backend
-        force_cpu_backend()
-        on_tpu = False
     if on_tpu:
         cfg = TransformerConfig(
             n_layers=10, n_kv_heads=16, n_q_heads=16, hidden_dim=2048,
@@ -76,7 +303,7 @@ def main():
             use_mlp_bias=False, activation_function="silu",
             compute_dtype="bfloat16", gradient_checkpointing=True)
         n_streams, stream_len = 8, 1024
-        peak_flops = 197e12  # v5e bf16 peak per chip
+        peak_flops = V5E_PEAK_FLOPS
         steps, warmup = 5, 2
     else:  # smoke fallback
         cfg = TransformerConfig(
@@ -131,15 +358,24 @@ def main():
     jax.block_until_ready(engine.params)
     dt = time.monotonic() - t0
 
-    # ------------------------------------------------------------------
-    # Generation benchmark (reference claims decode "on par with vLLM",
-    # docs/source/arch.rst:128-135): tokens/s/chip of the jitted
-    # prefill + scan-decode loop, the wall-clock majority of PPO.
-    # ------------------------------------------------------------------
+    tok_per_sec = tokens_per_step * steps / dt
+    half = stream_len // 2
+    step_flops = monitor.transformer_train_flops(
+        n_layers=cfg.n_layers, hidden_dim=cfg.hidden_dim,
+        n_q_heads=cfg.n_q_heads, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, intermediate_dim=cfg.intermediate_dim,
+        vocab_size=cfg.vocab_size,
+        seqlens=[half, stream_len - half] * n_streams)
+    # remat recomputes the forward pass once more in backward: 4x fwd
+    step_flops = step_flops * 4 // 3 if cfg.gradient_checkpointing \
+        else step_flops
+    mfu = step_flops * steps / dt / peak_flops
+
+    # ---- decode at serving batch (reference: "on par with vLLM") -------
     from realhf_tpu.engine import packing
     from realhf_tpu.ops.sampling import GenerationHyperparameters
 
-    gen_bs = 8 if on_tpu else 2
+    gen_bs = 64 if on_tpu else 2
     gen_prompt_len, gen_new = (256, 256) if on_tpu else (16, 16)
     gconfig = GenerationHyperparameters(
         max_new_tokens=gen_new, min_new_tokens=gen_new, greedy=False,
@@ -161,35 +397,51 @@ def main():
     gdt = time.monotonic() - g0
     gen_tok_per_sec = gen_bs * gen_new * gen_steps / gdt
 
-    tok_per_sec = tokens_per_step * steps / dt
-    half = stream_len // 2
-    step_flops = monitor.transformer_train_flops(
-        n_layers=cfg.n_layers, hidden_dim=cfg.hidden_dim,
-        n_q_heads=cfg.n_q_heads, n_kv_heads=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, intermediate_dim=cfg.intermediate_dim,
-        vocab_size=cfg.vocab_size,
-        seqlens=[half, stream_len - half] * n_streams)
-    # remat recomputes the forward pass once more in backward: 4x fwd
-    step_flops = step_flops * 4 // 3 if cfg.gradient_checkpointing \
-        else step_flops
-    mfu = step_flops * steps / dt / peak_flops
+    # HBM roofline %: each decode step streams bf16 weights + KV
+    hbm_bw = V5E_HBM_BW if on_tpu else 100e9
+    decode_roof_s = _decode_roofline_s(cfg, gen_bs, gen_prompt_len,
+                                       gen_new, hbm_bw)
+    gdt_decode = gdt / gen_steps  # prefill is <3% of this wall time
+    roofline_frac = decode_roof_s / gdt_decode
 
-    print(json.dumps({
-        "metric": "sft_train_tokens_per_sec_per_chip",
-        "value": round(tok_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.4, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "backend": jax.default_backend(),
-            "model_params_m": round(cfg.n_params() / 1e6, 1),
-            "step_time_s": round(dt / steps, 4),
-            "gen_tokens_per_sec_per_chip": round(gen_tok_per_sec, 1),
-            "gen_batch": gen_bs,
-            "gen_prompt_len": gen_prompt_len,
-            "gen_new_tokens": gen_new,
-        },
-    }))
+    return {
+        "sft_tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "sft_mfu": round(mfu, 4),
+        "sft_vs_40pct_mfu": round(mfu / REF_MFU, 4),
+        "sft_model_params_m": round(cfg.n_params() / 1e6, 1),
+        "sft_step_time_s": round(dt / steps, 4),
+        "gen_tokens_per_sec_per_chip": round(gen_tok_per_sec, 1),
+        "gen_batch": gen_bs,
+        "gen_prompt_len": gen_prompt_len,
+        "gen_new_tokens": gen_new,
+        "gen_hbm_roofline_frac": round(roofline_frac, 4),
+    }
+
+
+def main():
+    use_accel = _accelerator_usable()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    if not use_accel:
+        from realhf_tpu.base.backend import force_cpu_backend
+        force_cpu_backend()
+
+    import jax
+
+    try:
+        on_tpu = jax.default_backend() != "cpu"
+    except Exception:
+        # Backend raised even after the probe succeeded: fall back.
+        from realhf_tpu.base.backend import force_cpu_backend
+        force_cpu_backend()
+        on_tpu = False
+
+    headline, extra = bench_ppo(on_tpu)
+    extra.update(bench_sft(on_tpu))
+    extra["backend"] = jax.default_backend()
+    headline["extra"] = extra
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":
