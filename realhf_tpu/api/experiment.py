@@ -34,6 +34,31 @@ class ModelSpec:
 
 
 @dataclasses.dataclass
+class MFCAllocation:
+    """Per-MFC placement: layout + (optionally) its own worker group
+    and per-worker device subset.
+
+    The reference's RPCAllocation (quickstart/device_mesh.py:269):
+    every MFC may run on its own device subset of the cluster with its
+    own 3D-parallel strategy. ``workers=None`` keeps the MFC on its
+    role's primary worker group (same devices, different layout =>
+    same-group replica). ``workers`` different from the role's group
+    puts the MFC on OTHER processes/devices entirely; the role's
+    weights then flow to it through the host data plane after every
+    train step (same-role cross-group reallocation -- the reference's
+    param_realloc NCCL broadcast, comm/param_realloc.py:312, as a
+    DCN-class host relay per SURVEY §5.8).
+
+    ``device_ids``: local device indices each exec worker contributes
+    to this MFC's mesh (reference per-worker GPU isolation,
+    base/gpu_utils.py:64); None = the worker's default slice.
+    """
+    parallel: ParallelismConfig
+    workers: Optional[List[int]] = None
+    device_ids: Optional[List[int]] = None
+
+
+@dataclasses.dataclass
 class SaveEvalControl:
     """Reference ExperimentSaveEvalControl (system_api.py:157)."""
     save_freq_epochs: Optional[int] = None
@@ -51,11 +76,13 @@ class ExperimentSpec:
     models: Dict[str, ModelSpec]
     mfcs: List[MFCDef]
     dataset: DatasetAbstraction
-    # Per-MFC parallelism overrides (MFC name -> layout). An MFC whose
-    # layout differs from its role's primary creates a weight replica
-    # kept fresh by parameter reallocation (the reference's
-    # RPCAllocation, quickstart/device_mesh.py:269).
-    allocations: Dict[str, ParallelismConfig] = dataclasses.field(
+    # Per-MFC placement overrides (MFC name -> layout or full
+    # MFCAllocation). An MFC whose layout differs from its role's
+    # primary creates a weight replica kept fresh by parameter
+    # reallocation; an MFCAllocation with its own ``workers`` puts the
+    # replica on a different worker group / device subset entirely
+    # (the reference's RPCAllocation, quickstart/device_mesh.py:269).
+    allocations: Dict[str, object] = dataclasses.field(
         default_factory=dict)
     tokenizer_path: Optional[str] = None
     tokenizer: Optional[object] = None  # direct object (tests)
@@ -105,9 +132,50 @@ class ExperimentSpec:
         """The role's group leader (single worker in the common case)."""
         return self.workers_of_role(role)[0]
 
+    def alloc_of(self, node_name: str) -> Optional[MFCAllocation]:
+        """The MFC's allocation, normalized to MFCAllocation (bare
+        ParallelismConfig values keep the role's worker group)."""
+        v = self.allocations.get(node_name)
+        if v is None:
+            return None
+        if isinstance(v, MFCAllocation):
+            return v
+        return MFCAllocation(parallel=v)
+
+    def workers_of_node(self, node_name: str, role: str) -> List[int]:
+        """The worker group an MFC EXECUTES on (leader first): its
+        allocation's own group when set, else its role's group."""
+        alloc = self.alloc_of(node_name)
+        if alloc is not None and alloc.workers is not None:
+            out = list(alloc.workers)
+            if not out:
+                raise ValueError(
+                    f"MFCAllocation for {node_name} has an empty "
+                    "workers list; use workers=None for the role's "
+                    "own group.")
+            if len(out) != len(set(out)):
+                raise ValueError(
+                    f"duplicate workers in group of {node_name}: {out}")
+            return out
+        return self.workers_of_role(role)
+
+    def is_cross_group(self, node_name: str, role: str) -> bool:
+        """True when the MFC executes on a different worker group than
+        its role's primary -- weights then flow via the host data
+        plane (same-role cross-group reallocation)."""
+        return (set(self.workers_of_node(node_name, role))
+                != set(self.workers_of_role(role)))
+
     @property
     def multihost(self) -> bool:
-        """True when any role's mesh spans more than one worker
-        process -- all model workers then join one jax.distributed
-        world (the reference's single NCCL world, global_comm.py:44)."""
-        return any(len(self.workers_of_role(r)) > 1 for r in self.models)
+        """True when any role's (or MFC allocation's) mesh spans more
+        than one worker process -- all model workers then join one
+        jax.distributed world (the reference's single NCCL world,
+        global_comm.py:44). Cross-group single-worker placements do
+        NOT need a shared world: each group's mesh is process-local
+        and weights move over the host data plane."""
+        if any(len(self.workers_of_role(r)) > 1 for r in self.models):
+            return True
+        return any(
+            a is not None and a.workers is not None and len(a.workers) > 1
+            for a in (self.alloc_of(n) for n in self.allocations))

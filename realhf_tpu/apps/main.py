@@ -56,6 +56,15 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         raise ValueError(
             f"worker_assignment indices out of range for "
             f"n_model_workers={spec.n_model_workers}: {bad}")
+    bad_alloc = {
+        name: a.workers for name in spec.allocations
+        if (a := spec.alloc_of(name)) is not None
+        and a.workers is not None
+        and not all(0 <= w < spec.n_model_workers for w in a.workers)}
+    if bad_alloc:
+        raise ValueError(
+            f"MFCAllocation.workers indices out of range for "
+            f"n_model_workers={spec.n_model_workers}: {bad_alloc}")
     constants.set_experiment_trial_names(spec.experiment_name,
                                          spec.trial_name)
     path = _spec_path(spec)

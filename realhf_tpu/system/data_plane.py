@@ -42,7 +42,26 @@ class DataStore:
 
     def __init__(self):
         self._store: Dict[Hashable, SequenceSample] = {}
+        # name -> (version, payload): versioned blobs for cross-group
+        # parameter sync (only the latest version is kept; receivers
+        # accept any version >= the one they were told to expect)
+        self._blobs: Dict[str, Tuple[int, object]] = {}
         self._lock = threading.Lock()
+
+    def put_blob(self, name: str, version: int, payload):
+        with self._lock:
+            cur = self._blobs.get(name)
+            if cur is None or cur[0] <= version:
+                self._blobs[name] = (version, payload)
+
+    def get_blob(self, name: str, min_version: int):
+        """(version, payload) if a blob with version >= min_version is
+        stored, else (latest stored version or -1, None)."""
+        with self._lock:
+            cur = self._blobs.get(name)
+            if cur is not None and cur[0] >= min_version:
+                return cur
+            return (cur[0] if cur is not None else -1, None)
 
     def put(self, sample: SequenceSample):
         """Merge a (possibly multi-sequence) sample into the store."""
@@ -101,9 +120,19 @@ class DataServer(threading.Thread):
             # recv -- reply with an error rather than dying silently
             # (a dead server turns every peer fetch into a timeout)
             try:
-                ids, keys = pickle.loads(raw)
-                payload = self.store.get(ids, keys)
-                reply = ("ok", payload)
+                msg = pickle.loads(raw)
+                if isinstance(msg, tuple) and msg and msg[0] == "blob":
+                    _, name, min_version = msg
+                    version, payload = self.store.get_blob(name,
+                                                           min_version)
+                    if payload is None:
+                        reply = ("pending", version)
+                    else:
+                        reply = ("ok", (version, payload))
+                else:
+                    ids, keys = msg
+                    payload = self.store.get(ids, keys)
+                    reply = ("ok", payload)
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.error("Data server request failed: %r", e)
                 reply = ("error", repr(e))
@@ -138,6 +167,8 @@ class DataClient:
         s = self._sock_for(worker_name)
         s.send(pickle.dumps((list(ids), list(keys))))
         if not s.poll(timeout * 1000):
+            s.close(0)  # REQ stuck between send and recv
+            self._socks.pop(worker_name, None)
             raise TimeoutError(
                 f"Data fetch from {worker_name} timed out "
                 f"({len(ids)} ids, keys={keys}).")
@@ -146,6 +177,42 @@ class DataClient:
             raise RuntimeError(
                 f"Data fetch from {worker_name} failed: {payload}")
         return payload
+
+    def fetch_blob(self, worker_name: str, name: str, min_version: int,
+                   timeout: float = 300.0):
+        """Fetch a versioned blob, POLLING until the owner has
+        published version >= min_version (the sender may still be
+        gathering when the receiver asks -- both sides were dispatched
+        together by the master)."""
+        import time as _time
+
+        s = self._sock_for(worker_name)
+        deadline = _time.monotonic() + timeout
+        while True:
+            s.send(pickle.dumps(("blob", name, min_version)))
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0 or not s.poll(remaining * 1000):
+                # a REQ socket abandoned between send and recv is
+                # stuck in the receive state -- drop it so the next
+                # fetch through _sock_for starts clean
+                s.close(0)
+                self._socks.pop(worker_name, None)
+                raise TimeoutError(
+                    f"Blob fetch {name} v>={min_version} from "
+                    f"{worker_name} timed out.")
+            status, payload = pickle.loads(s.recv())
+            if status == "ok":
+                return payload  # (version, value)
+            if status == "error":
+                raise RuntimeError(
+                    f"Blob fetch {name} from {worker_name} failed: "
+                    f"{payload}")
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Blob {name} v>={min_version} not published by "
+                    f"{worker_name} within {timeout}s (have "
+                    f"v{payload}).")
+            _time.sleep(0.05)
 
     def close(self):
         for s in self._socks.values():

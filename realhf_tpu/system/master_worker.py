@@ -51,18 +51,32 @@ class MasterWorker(worker_base.Worker):
         self.dfg = DFG(spec.mfcs)
         self.input_keys_of = {n.name: tuple(n.input_keys)
                               for n in self.dfg.nodes}
-        self.node_worker = {
-            n.name: f"model_worker/{spec.worker_of_role(n.role)}"
-            for n in self.dfg.nodes}
-        # full worker GROUP per node (multi-host roles span several
-        # worker processes; requests go to every member, the leader --
-        # first in the group -- replies with data, members ack)
+        # EXEC worker group per node: the role's group, or the MFC
+        # allocation's own group (per-MFC device-subset placement).
+        # Requests go to every member; the leader -- first in the
+        # group -- replies with data, members ack.
         self.node_workers = {
             n.name: [f"model_worker/{w}"
-                     for w in spec.workers_of_role(n.role)]
+                     for w in spec.workers_of_node(n.name, n.role)]
             for n in self.dfg.nodes}
+        self.node_worker = {name: ws[0]
+                            for name, ws in self.node_workers.items()}
+        # Cross-group nodes: exec group != the role's primary group.
+        # Their replicas are refreshed by a param sync the master
+        # attaches to each dispatch once the role has trained
+        # (reference _attach_payloads_with_hooks,
+        # master_worker.py:296).
+        self.cross_group_nodes = {
+            n.name for n in self.dfg.nodes
+            if spec.is_cross_group(n.name, n.role)}
+        self.role_workers = {
+            r: [f"model_worker/{w}" for w in spec.workers_of_role(r)]
+            for r in spec.models}
         self.all_workers = sorted(
-            {w for ws in self.node_workers.values() for w in ws})
+            {w for ws in self.node_workers.values() for w in ws}
+            | {w for n in self.dfg.nodes
+               for w in self.role_workers[n.role]
+               if n.name in self.cross_group_nodes})
         src = self.dfg.sources[0]
         self.data_owner = self.node_worker[src.name]
         # roles with a train MFC -> that MFC name (staleness guard)
@@ -103,7 +117,13 @@ class MasterWorker(worker_base.Worker):
         # runtime state
         self._subscribed = False
         self._fetch_inflight = False
-        self._inflight: Dict[str, tuple] = {}  # request_id -> (bid, mfc)
+        # request_id -> (bid, mfc_name, worker, kind); kind in
+        # {leader, member, fetch, clear, sync}
+        self._inflight: Dict[str, tuple] = {}
+        # per-MFC per-worker execution spans + peak HBM (reference
+        # __log_gpu_stats table, model_worker.py:999-1094)
+        self._exec_log: list = []
+        self._exec_history: list = []
         self._consumed_ids = list(self._ids_to_skip)
         self._cur_epoch = self._start_epoch
         self._epochs_fetched = 0  # epoch boundary accounting
@@ -115,6 +135,13 @@ class MasterWorker(worker_base.Worker):
         self._train_done_upto: Dict[str, Dict[int, set]] = {
             role: {} for role in self.train_nodes_of_role}
         self._min_live_bid = 0
+        # cross-group param sync bookkeeping: how often each role has
+        # trained, and the last version the primary group was asked to
+        # publish (keyed per ROLE -- the blob is per-role, so N cross
+        # nodes of one role share a single gather+publish per version)
+        self._role_version: Dict[str, int] = {
+            role: 0 for role in self.train_nodes_of_role}
+        self._last_synced: Dict[str, int] = {}
         return "master-configured"
 
     # ------------------------------------------------------------------
@@ -152,21 +179,48 @@ class MasterWorker(worker_base.Worker):
                       if k in e.key_owner}
         payload = dict(node=mfc_name, ids=list(e.ids),
                        fetch_plan=fetch_plan)
+        if mfc_name in self.cross_group_nodes \
+                and node.role in self._role_version:
+            payload["param_sync"] = self._attach_param_sync(node)
         rids = self.stream.request(
             workers, node.interface_type.value,
             datas=[payload] * len(workers))
         for w, rid in zip(workers, rids):
-            self._inflight[rid] = ((bid, mfc_name) if w == leader
-                                   else (None, "__member__"))
+            self._inflight[rid] = (bid, mfc_name, w,
+                                   "leader" if w == leader else "member")
         self.buffer.mark_dispatched(bid, mfc_name)
         logger.debug("Dispatched %s (batch %d) to %s.", mfc_name, bid,
                      workers)
+
+    def _attach_param_sync(self, node) -> Dict:
+        """Cross-group weight flow (reference param_realloc hooks,
+        _attach_payloads_with_hooks master_worker.py:296): when the
+        role trained since the last sync to this node, dispatch a
+        collective host-gather to the primary group; the exec group's
+        request carries the expected version + where to fetch it."""
+        from realhf_tpu.api.dfg import ParamReallocHook
+
+        role = node.role
+        version = self._role_version[role]
+        eta = next((h.eta for h in node._pre_hooks
+                    if isinstance(h, ParamReallocHook)
+                    and h.eta is not None), 1.0)
+        if version > self._last_synced.get(role, 0):
+            senders = self.role_workers[role]
+            rids = self.stream.request(
+                senders, "param_sync_send",
+                datas=[dict(role=role, version=version)] * len(senders))
+            for w, r in zip(senders, rids):
+                self._inflight[r] = (None, None, w, "sync")
+            self._last_synced[role] = version
+        return dict(role=role, version=version,
+                    src=self.role_workers[role][0], eta=eta)
 
     def _dispatch_fetch(self):
         rid = self.stream.request(
             [self.data_owner], "fetch_data",
             datas=[dict(skip_ids=list(self._ids_to_skip))])[0]
-        self._inflight[rid] = (None, "__fetch__")
+        self._inflight[rid] = (None, None, self.data_owner, "fetch")
         self._fetch_inflight = True
 
     # ------------------------------------------------------------------
@@ -199,6 +253,7 @@ class MasterWorker(worker_base.Worker):
         if node.interface_type == ModelInterfaceType.TRAIN_STEP:
             self._train_done_upto[node.role].setdefault(bid, set()).add(
                 mfc_name)
+            self._role_version[node.role] += 1
 
     def _finish_batches(self):
         for e in self.buffer.pop_finished():
@@ -218,14 +273,39 @@ class MasterWorker(worker_base.Worker):
             rids = self.stream.request(
                 self.all_workers, "clear_data_cache",
                 datas=[dict(ids=list(e.ids))] * len(self.all_workers))
-            for r in rids:
-                self._inflight[r] = (None, "__clear__")
+            for w, r in zip(self.all_workers, rids):
+                self._inflight[r] = (None, None, w, "clear")
+            self._log_device_stats(e.batch_id)
             self._maybe_save_eval(e)
             if e.is_epoch_last:
                 self._consumed_ids = []
             if (self.spec.ctl.benchmark_steps is not None
                     and self.global_step >= self.spec.ctl.benchmark_steps):
                 self._complete = True
+
+    def _log_device_stats(self, bid: int):
+        """Per-MFC device stats table for a finished batch (reference
+        __log_gpu_stats all-gathered table, model_worker.py:999-1094)."""
+        rows = [r for r in self._exec_log if r.get("bid") == bid]
+        if not rows:
+            return
+        lines = ["MFC device stats (batch %d):" % bid,
+                 f"  {'mfc':<16} {'worker':<18} {'secs':>8} "
+                 f"{'hbm_now':>10} {'proc_peak':>10}"]
+        t0 = min(r["start"] for r in rows)
+        for r in sorted(rows, key=lambda r: r["start"]):
+            lines.append(
+                f"  {r['mfc']:<16} {r['worker']:<18} "
+                f"{r['secs']:>8.3f} "
+                f"{r['hbm_bytes_in_use'] / 2 ** 30:>9.2f}G "
+                f"{r['proc_peak_hbm_bytes'] / 2 ** 30:>9.2f}G "
+                f"[{r['start'] - t0:+.3f}s..{r['end'] - t0:+.3f}s]")
+        logger.info("\n".join(lines))
+        # keep only live batches in the working log (rows were already
+        # copied to the bounded history when their replies arrived)
+        self._exec_log = [r for r in self._exec_log
+                          if r.get("bid") is not None
+                          and r["bid"] > bid]
 
     def _maybe_save_eval(self, entry, force=False):
         train_nodes = [m for ms in self.train_nodes_of_role.values()
@@ -298,11 +378,23 @@ class MasterWorker(worker_base.Worker):
             ref = self._inflight.pop(p.request_id, None)
             if ref is None:
                 continue
-            bid, mfc_name = ref
-            if mfc_name == "__fetch__":
+            bid, mfc_name, worker, kind = ref
+            if kind == "fetch":
                 self._on_fetch_reply(p.data)
-            elif mfc_name not in ("__clear__", "__member__"):
-                self._on_mfc_reply(bid, mfc_name, p.data)
+            elif kind in ("leader", "member"):
+                info = (p.data.get("exec_info")
+                        if isinstance(p.data, dict) else None)
+                if info:
+                    row = dict(info, mfc=mfc_name, worker=worker,
+                               bid=bid)
+                    self._exec_log.append(row)
+                    # history is appended ON ARRIVAL (bounded): a
+                    # member row landing after its batch was logged
+                    # must still reach the stats command
+                    self._exec_history.append(row)
+                    del self._exec_history[:-512]
+                if kind == "leader":
+                    self._on_mfc_reply(bid, mfc_name, p.data)
             n += 1
 
         # 4. batch completion accounting
@@ -322,9 +414,12 @@ class MasterWorker(worker_base.Worker):
 
     def _handle_command(self, cmd, kwargs):
         if cmd == "stats":
+            # history receives every row on arrival, so it alone is
+            # the complete record (the working log would duplicate it)
             return dict(stats=self._step_stats,
                         global_step=self.global_step,
-                        complete=self._complete)
+                        complete=self._complete,
+                        exec_log=list(self._exec_history))
         return super()._handle_command(cmd, kwargs)
 
     def _exit_hook(self):

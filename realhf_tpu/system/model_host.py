@@ -30,9 +30,15 @@ logger = logging.getLogger("model_host", "benchmark")
 
 def build_model(role: str, spec, tokenizer, total_steps: int,
                 devices=None, params_override=None,
-                cfg_override=None, init_seed=None) -> model_api.Model:
+                cfg_override=None, init_seed=None,
+                seed_role=None) -> model_api.Model:
     """Instantiate one model role on the local devices (reference
-    ReaLModel instantiation in model_worker.__lazy_setup:294-337)."""
+    ReaLModel instantiation in model_worker.__lazy_setup:294-337).
+
+    ``seed_role``: role name to derive the random-init key from when
+    it differs from ``role`` -- a CROSS-GROUP replica must initialize
+    bit-identically to its role's primary living in another process,
+    even though its display name carries the MFC suffix."""
     from realhf_tpu.parallel.mesh import default_devices
 
     if params_override is not None:
@@ -56,9 +62,10 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
         # group (the collective device_put verifies value equality), so
         # the key derives from the EXPERIMENT seed, never the ambient
         # per-worker seed.
-        key = (seeding.derive_key_from(init_seed, "model_init", role)
+        skey = seed_role or role
+        key = (seeding.derive_key_from(init_seed, "model_init", skey)
                if init_seed is not None
-               else seeding.derive_key("model_init", role))
+               else seeding.derive_key("model_init", skey))
         params = T.init_params(cfg, key)
 
     if devices is None:
@@ -74,28 +81,55 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
 class ModelHost:
     """All models of some roles + MFC execution with hooks.
 
-    ``devices_fn(role, parallel) -> device list`` lets the distributed
-    model worker place a role's mesh on its worker GROUP's devices
-    (multi-host model); None keeps the local default. ``leader_of_role``
-    marks whether THIS process is the role's group leader: non-leaders
-    participate in every collective (save gather, eval forwards) but
-    skip host-side writes and reply payloads."""
+    ``devices_fn(workers, parallel, device_ids) -> device list`` lets
+    the distributed model worker place a mesh on a worker group's
+    devices (multi-host model); None keeps the local default.
+    ``leader_of_role`` marks whether THIS process is the role's group
+    leader: non-leaders participate in every collective (save gather,
+    eval forwards) but skip host-side writes and reply payloads.
+    ``cross_group_nodes``: MFC names executing on a DIFFERENT worker
+    group than their role's primary (reference per-MFC device subsets,
+    quickstart/device_mesh.py:269). Their replica engines initialize
+    from the same checkpoint/seed as the primary -- bit-identical
+    start -- and are refreshed after train steps via the host
+    data-plane parameter sync (``install_node_params``)."""
 
     def __init__(self, spec, roles: List[str], nodes: List[MFCDef],
                  tokenizer, total_steps: int, devices_fn=None,
-                 leader_of_role: Optional[Dict[str, bool]] = None):
+                 leader_of_role: Optional[Dict[str, bool]] = None,
+                 cross_group_nodes: Optional[set] = None):
         self.spec = spec
         self.roles = list(roles)
         self.nodes = {n.name: n for n in nodes}
         self.tokenizer = tokenizer
         self.devices_fn = devices_fn
         self.leader_of_role = leader_of_role or {}
+        self.cross_group_nodes = set(cross_group_nodes or ())
+
+        def alloc_devices(alloc, workers):
+            """Devices for a replica mesh: the worker-world slice in
+            multihost mode, the LOCAL device subset when device_ids is
+            set without a shared world (two single-process workers
+            splitting one host's chips), default otherwise."""
+            if devices_fn is not None:
+                return devices_fn(workers, alloc.parallel,
+                                  alloc.device_ids)
+            if alloc.device_ids is not None:
+                from realhf_tpu.parallel.mesh import default_devices
+                local = default_devices()
+                if any(i >= len(local) for i in alloc.device_ids):
+                    raise ValueError(
+                        f"device_ids {alloc.device_ids} out of range "
+                        f"for {len(local)} local devices.")
+                return [local[i] for i in alloc.device_ids]
+            return None
 
         self.models: Dict[str, model_api.Model] = {}
         for role in self.roles:
             self.models[role] = build_model(
                 role, spec.models[role], tokenizer, total_steps,
-                devices=(devices_fn(role, spec.models[role].parallel)
+                devices=(devices_fn(spec.workers_of_role(role),
+                                    spec.models[role].parallel, None)
                          if devices_fn else None),
                 init_seed=spec.seed)
 
@@ -104,28 +138,61 @@ class ModelHost:
         # weights flow from the primary via reallocation.
         self.replicas: Dict[str, model_api.Model] = {}
         self.replica_mgr = ReplicaManager()
+        # node -> version of the primary weights currently installed
+        # (cross-group sync protocol; 0 = initial checkpoint/seed)
+        self.node_param_version: Dict[str, int] = {}
         for node in nodes:
-            alloc = spec.allocations.get(node.name)
+            alloc = spec.alloc_of(node.name)
             if alloc is None:
                 continue
             role = node.role
-            primary = self.models[role]
-            if alloc.same_layout(primary.engine.ctx.parallel):
+            if alloc.parallel.same_layout(
+                    spec.models[role].parallel) \
+                    and alloc.workers is None \
+                    and alloc.device_ids is None:
+                # redundant entry (same layout, same group): no-op,
+                # never a replica -- accepted for generated configs
+                # that list every MFC
                 continue
             if node.interface_type == ModelInterfaceType.TRAIN_STEP:
                 raise ValueError(
                     f"MFC {node.name}: train MFCs must run on the "
                     "role's primary layout (replicas have no optimizer).")
-            mspec = _dc.replace(spec.models[role], parallel=alloc,
+            if node.name in self.cross_group_nodes:
+                # Replica on OTHER devices than the primary (which may
+                # not even live in this process). Initial weights come
+                # from the same checkpoint / deterministic seed the
+                # primary used, so no transfer is needed until the
+                # primary trains.
+                mspec = _dc.replace(spec.models[role],
+                                    parallel=alloc.parallel,
+                                    optimizer=None)
+                exec_workers = spec.workers_of_node(node.name, role)
+                self.replicas[node.name] = build_model(
+                    f"{role}-{node.name}", mspec, tokenizer, total_steps,
+                    devices=alloc_devices(alloc, exec_workers),
+                    init_seed=spec.seed, seed_role=role)
+                self.node_param_version[node.name] = 0
+                logger.info(
+                    "Created CROSS-GROUP replica for %s: %s on workers "
+                    "%s (role %s).", node.name, alloc.parallel,
+                    exec_workers, role)
+                continue
+            primary = self.models[role]
+            if alloc.parallel.same_layout(primary.engine.ctx.parallel) \
+                    and alloc.device_ids is None:
+                continue
+            mspec = _dc.replace(spec.models[role], parallel=alloc.parallel,
                                 optimizer=None)
             self.replicas[node.name] = build_model(
                 f"{role}-{node.name}", mspec, tokenizer, total_steps,
                 params_override=primary.engine.params,
                 cfg_override=primary.config,
-                devices=(devices_fn(role, alloc) if devices_fn
-                         else None))
+                devices=alloc_devices(
+                    alloc, spec.workers_of_node(node.name, role)))
             logger.info("Created replica for %s: %s (primary %s)",
-                        node.name, alloc, primary.engine.ctx.parallel)
+                        node.name, alloc.parallel,
+                        primary.engine.ctx.parallel)
 
         self.interfaces = {
             n.name: model_api.make_interface(n.interface_impl)
@@ -159,9 +226,38 @@ class ModelHost:
 
     # ------------------------------------------------------------------
     def engines_of_node(self, node: MFCDef):
-        primary = self.models[node.role]
+        """(primary, exec model). Primary is None for a cross-group
+        node whose role is not hosted in this process."""
+        primary = self.models.get(node.role)
         model = self.replicas.get(node.name, primary)
+        if model is None:
+            raise ValueError(
+                f"MFC {node.name}: neither a primary for role "
+                f"{node.role} nor a replica lives in this process.")
         return primary, model
+
+    # --- cross-group parameter sync (host data plane) -----------------
+    def gather_role_params(self, role: str):
+        """Sender side: host copy of the role's primary weights.
+        COLLECTIVE on the primary's (possibly multi-process) mesh."""
+        return self.models[role].engine.params_numpy()
+
+    def install_node_params(self, node_name: str, host_params,
+                            version: int, eta: float = 1.0):
+        """Receiver side: land a fetched host weight copy on the
+        cross-group replica's mesh (vocab repad + optional EMA merge
+        handled by the reallocator)."""
+        from realhf_tpu.parallel.realloc import reallocate
+        model = self.replicas[node_name]
+        model.engine.ensure_on_device()
+        dt = reallocate(model.config, host_params, model.engine, eta=eta)
+        self.replica_mgr.last_reshard_secs = dt
+        self.node_param_version[node_name] = version
+        logger.info("Installed params v%d on %s in %.3fs.", version,
+                    node_name, dt)
+
+    def node_version(self, node_name: str) -> int:
+        return self.node_param_version.get(node_name, 0)
 
     def execute(self, node_name: str, inp: data_api.SequenceSample):
         """Run one MFC: pre-hooks (reload offloaded weights, refresh
@@ -170,15 +266,19 @@ class ModelHost:
         primary, model = self.engines_of_node(node)
 
         # pre-hooks -----------------------------------------------------
-        primary.engine.ensure_on_device()
+        if primary is not None:
+            primary.engine.ensure_on_device()
         model.engine.ensure_on_device()
         eta = 1.0
         for h in node._pre_hooks:
             if isinstance(h, ParamReallocHook) and h.eta is not None:
                 eta = h.eta
-        if model is not primary:
+        if model is not primary and primary is not None \
+                and node_name not in self.cross_group_nodes:
             # param-realloc pre-hook: refresh the replica's weights
             # from the trainable primary if it has stepped since.
+            # (Cross-group replicas refresh via install_node_params
+            # before execute is called.)
             self.replica_mgr.ensure_fresh(node.role, primary, model,
                                           eta=eta)
 
@@ -187,7 +287,10 @@ class ModelHost:
             inp.remap_keys_(node.input_key_remap)
 
         itf = self.interfaces[node_name]
+        import time as _time
+
         from realhf_tpu.base import monitor
+        t_start = _time.time()
         with monitor.mfc_profile_region(node_name):
             if node.interface_type == ModelInterfaceType.GENERATE:
                 out = itf.generate(model, inp, n_mbs=node.n_mbs)
@@ -197,6 +300,30 @@ class ModelHost:
                 out = itf.train_step(model, inp, n_mbs=node.n_mbs)
             else:
                 raise NotImplementedError(node.interface_type)
+        t_end = _time.time()
+        # Per-MFC device stats (reference __log_gpu_stats,
+        # model_worker.py:999-1094): wall span + HBM over this
+        # process's mesh devices. JAX exposes no per-region peak
+        # reset, so the table carries the honest pair: bytes in use
+        # right after the call (attributable to what this MFC leaves
+        # resident) and the PROCESS-lifetime allocator peak.
+        import jax
+
+        now = peak = 0
+        try:
+            mine = jax.process_index()
+            for d in {d for d in model.engine.mesh.devices.flat
+                      if d.process_index == mine}:
+                stats = monitor.device_memory_stats(d)
+                now = max(now, stats.get("bytes_in_use", 0))
+                peak = max(peak, stats.get("peak_bytes_in_use", 0))
+        except Exception:  # noqa: BLE001 - stats are best-effort
+            now = peak = 0
+        self.last_exec_info = dict(node=node_name, start=t_start,
+                                   end=t_end,
+                                   secs=round(t_end - t_start, 4),
+                                   hbm_bytes_in_use=int(now),
+                                   proc_peak_hbm_bytes=int(peak))
 
         if isinstance(out, data_api.SequenceSample) and node.output_key_remap:
             out.remap_keys_(node.output_key_remap)
@@ -205,7 +332,7 @@ class ModelHost:
         for h in node._post_hooks:
             if isinstance(h, OffloadHook):
                 model.engine.offload()
-                if model is not primary:
+                if primary is not None and model is not primary:
                     # the role's primary holds a full weight copy too;
                     # leaving it resident would defeat the offload
                     primary.engine.offload()
@@ -223,13 +350,15 @@ class ModelHost:
             # the params; members must skip the collective gather too
             # or they would block in an all-gather nobody else joins.
             return None
+        # params_numpy() is a COLLECTIVE on a multi-process mesh: run
+        # it HERE on every group member and hand the host copy to the
+        # interface, so leader and member collective counts match by
+        # construction no matter what the interface's save() does.
+        host_params = model.engine.params_numpy()
         if not self.leader_of_role.get(role, True):
-            # Group member, not leader: params_numpy() is a COLLECTIVE
-            # on a multi-process mesh -- participate in the gather the
-            # leader's interface.save() runs, but write nothing.
-            model.engine.params_numpy()
             return None
-        self.interfaces[train_node_name].save(model, path)
+        self.interfaces[train_node_name].save(model, path,
+                                              host_params=host_params)
         logger.info("Saved %s to %s", role, path)
         return path
 

@@ -61,20 +61,49 @@ class ModelWorker(worker_base.Worker):
         import realhf_tpu.interfaces  # noqa: F401 - register interfaces
 
         self.dfg = DFG(spec.mfcs)
-        my_roles = [r for r in spec.models
-                    if self.worker_index in spec.workers_of_role(r)]
-        my_nodes = [n for n in self.dfg.nodes if n.role in my_roles]
+        # Roles whose primary group includes this worker.
+        my_primary_roles = [r for r in spec.models
+                            if self.worker_index
+                            in spec.workers_of_role(r)]
+        # MFCs this worker EXECUTES: its role's group by default, or
+        # the MFC allocation's own worker group (per-MFC device-subset
+        # placement, reference RPCAllocation device_mesh.py:269).
+        my_nodes = [n for n in self.dfg.nodes
+                    if self.worker_index
+                    in spec.workers_of_node(n.name, n.role)]
         self.my_nodes = {n.name for n in my_nodes}
-        # Group leadership: the first worker of a role's group owns the
+        self.cross_group_nodes = {
+            n.name for n in my_nodes
+            if spec.is_cross_group(n.name, n.role)}
+        # Roles whose primary lives here but some MFC of theirs
+        # executes on a DIFFERENT group: this worker is then the
+        # SENDER side of the cross-group parameter sync. Only
+        # trainable roles ever need syncing (frozen roles' replicas
+        # initialize bit-identically from the shared checkpoint/seed).
+        self.sync_send_roles = {
+            n.role for n in self.dfg.nodes
+            if n.role in my_primary_roles
+            and spec.is_cross_group(n.name, n.role)
+            and spec.models[n.role].optimizer is not None}
+        # Primary engines actually needed here: roles with a local
+        # exec node, plus sender roles (a frozen role whose MFCs all
+        # moved elsewhere builds NO engine in this process).
+        local_node_roles = {n.role for n in my_nodes
+                            if n.name not in self.cross_group_nodes}
+        my_roles = [r for r in my_primary_roles
+                    if r in local_node_roles or r in self.sync_send_roles]
+        # Group leadership: the first worker of a group owns the
         # dataset / reply payloads; members execute the same jitted
-        # computations (their devices are part of the role's mesh) and
+        # computations (their devices are part of the mesh) and
         # reply lightweight acks.
         self.leader_of_role = {
             r: spec.workers_of_role(r)[0] == self.worker_index
             for r in my_roles
         }
-        self.leader_nodes = {n.name for n in my_nodes
-                             if self.leader_of_role[n.role]}
+        self.leader_nodes = {
+            n.name for n in my_nodes
+            if spec.workers_of_node(n.name, n.role)[0]
+            == self.worker_index}
 
         # Multi-host: all model workers join ONE jax.distributed world
         # (reference's single NCCL world, global_comm.py:44) with rank
@@ -146,10 +175,11 @@ class ModelWorker(worker_base.Worker):
                 eval_ds, batch_size=src.n_seqs, shuffle=False)
 
         total_steps = (self.steps_per_epoch or 1) * spec.total_train_epochs
-        devices_fn = self._devices_for_role if spec.multihost else None
+        devices_fn = self._devices_for_group if spec.multihost else None
         self.host = ModelHost(spec, my_roles, my_nodes, self.tokenizer,
                               total_steps, devices_fn=devices_fn,
-                              leader_of_role=self.leader_of_role)
+                              leader_of_role=self.leader_of_role,
+                              cross_group_nodes=self.cross_group_nodes)
 
         # data plane: store + threaded server + peer-fetch client
         self.store = DataStore()
@@ -170,28 +200,41 @@ class ModelWorker(worker_base.Worker):
                     steps_per_epoch=self.steps_per_epoch)
 
     # ------------------------------------------------------------------
-    def _devices_for_role(self, role: str, parallel) -> list:
-        """Mesh devices for a role in the joint worker world: an equal
-        per-member slice of every group member's local devices, ordered
-        group-major so the innermost mesh axes (tensor parallel) stay
-        within one process/host (ICI) and outer axes (data) cross hosts
-        (DCN) -- the reference's TP-on-NVLink placement."""
-        group = self.spec.workers_of_role(role)
+    def _devices_for_group(self, group: list, parallel,
+                           device_ids=None) -> list:
+        """Mesh devices for a worker group in the joint worker world:
+        an equal per-member slice of every group member's local
+        devices, ordered group-major so the innermost mesh axes
+        (tensor parallel) stay within one process/host (ICI) and outer
+        axes (data) cross hosts (DCN) -- the reference's TP-on-NVLink
+        placement. ``device_ids`` picks specific local devices per
+        member (per-MFC device subsets)."""
         ws = parallel.world_size
-        if ws % len(group) != 0:
+        if device_ids is None and ws % len(group) != 0:
             raise ValueError(
-                f"role {role}: layout {parallel} world_size {ws} not "
-                f"divisible by its worker group size {len(group)} "
-                f"(group {group}); every member must own an equal "
-                "slice of the mesh.")
-        per = ws // len(group)
+                f"layout {parallel} world_size {ws} not divisible by "
+                f"its worker group size {len(group)} (group {group}); "
+                "every member must own an equal slice of the mesh.")
+        per = len(device_ids) if device_ids is not None \
+            else ws // len(group)
+        if device_ids is not None and per * len(group) != ws:
+            raise ValueError(
+                f"device_ids {device_ids} x group {group} != "
+                f"world_size {ws}.")
         devs = []
         for w in group:
             local = self._devices_by_proc.get(w, [])
+            if device_ids is not None:
+                if any(i >= len(local) for i in device_ids):
+                    raise ValueError(
+                        f"worker {w} has {len(local)} devices; "
+                        f"device_ids {device_ids} out of range.")
+                devs.extend(local[i] for i in device_ids)
+                continue
             if len(local) < per:
                 raise ValueError(
-                    f"role {role}: worker {w} has {len(local)} devices "
-                    f"but the layout needs {per} per member.")
+                    f"worker {w} has {len(local)} devices but the "
+                    f"layout needs {per} per member.")
             devs.extend(local[:per])
         return devs
 
@@ -257,9 +300,23 @@ class ModelWorker(worker_base.Worker):
         node_name = d["node"]
         assert node_name in self.my_nodes, (node_name, self.my_nodes)
         node = self.dfg.find(node_name)
+        ps = d.get("param_sync")
+        if ps and self.host.node_version(node_name) < ps["version"]:
+            # Cross-group parameter sync, receiver side: the primary's
+            # group was dispatched a param_sync_send alongside this
+            # request; fetch (polling until published) and install.
+            version, host_params = self.data_client.fetch_blob(
+                ps["src"], f"__params__/{ps['role']}", ps["version"])
+            self.host.install_node_params(node_name, host_params,
+                                          version,
+                                          eta=ps.get("eta", 1.0))
         keys = [k for k in node.input_keys]
         inp = self._assemble_input(d["ids"], keys, d.get("fetch_plan", {}))
         out = self.host.execute(node_name, inp)
+        info = getattr(self.host, "last_exec_info", None)
+        if info is not None and node_name in self.cross_group_nodes:
+            info = dict(info,
+                        param_version=self.host.node_version(node_name))
         is_leader = node_name in self.leader_nodes
         if isinstance(out, data_api.SequenceSample):
             # members store the (replicated) outputs too: later MFCs on
@@ -267,13 +324,33 @@ class ModelWorker(worker_base.Worker):
             self.store.put(out)
             if is_leader:
                 self.stream.respond(req, data=dict(meta=out.meta(),
-                                                   stats=None))
+                                                   stats=None,
+                                                   exec_info=info))
             else:
-                self.stream.respond(req, data=dict(member=True))
+                self.stream.respond(req, data=dict(member=True,
+                                                   exec_info=info))
         elif is_leader:
-            self.stream.respond(req, data=dict(meta=None, stats=out))
+            self.stream.respond(req, data=dict(meta=None, stats=out,
+                                               exec_info=info))
         else:
-            self.stream.respond(req, data=dict(member=True))
+            self.stream.respond(req, data=dict(member=True,
+                                               exec_info=info))
+
+    def _handle_param_sync_send(self, req: Payload):
+        """Sender side of the cross-group parameter sync: gather the
+        role's primary weights to host (COLLECTIVE over the primary
+        group -- the master dispatched this to every member) and
+        publish them on the data plane for the exec group to fetch
+        (reference param_realloc sender steps,
+        comm/param_realloc.py:279)."""
+        role = req.data["role"]
+        version = int(req.data["version"])
+        assert role in self.sync_send_roles, (role, self.sync_send_roles)
+        host_params = self.host.gather_role_params(role)
+        if self.leader_of_role.get(role, True):
+            self.store.put_blob(f"__params__/{role}", version,
+                                host_params)
+        self.stream.respond(req, data=dict(published=version))
 
     def _handle_save(self, req: Payload):
         saved = {}
@@ -293,17 +370,52 @@ class ModelWorker(worker_base.Worker):
         self.stream.respond(req, data=out)
 
     # ------------------------------------------------------------------
+    def _drain_requests(self, first: Payload) -> list:
+        """Collect every immediately-available request, then move
+        param_sync_send requests ahead of queued MFCs (the reference's
+        pre-hook priority: handle_all_pre_hooks drains and runs every
+        realloc hook before any MFC, model_worker.py:483). Reordering
+        is only safe when the sender group is THIS process alone --
+        with a multi-process primary group the gather is a collective
+        whose relative order against other collectives must match the
+        stream order on every member."""
+        batch = [first]
+        while True:
+            try:
+                batch.append(self.stream.poll(timeout=0))
+            except TimeoutError:
+                break
+        if len(batch) == 1:
+            return batch
+
+        def prio(p: Payload) -> int:
+            if p.handle_name == "param_sync_send" and len(
+                    self.spec.workers_of_role(p.data["role"])) == 1:
+                return 0
+            return 1
+
+        return sorted(batch, key=prio)  # stable: FIFO within a class
+
     def _poll(self) -> worker_base.PollResult:
         try:
-            req = self.stream.poll(timeout=0.05)
+            first = self.stream.poll(timeout=0.05)
         except TimeoutError:
             return worker_base.PollResult(0, 0)
+        n = 0
+        for req in self._drain_requests(first):
+            self._handle_request(req)
+            n += 1
+        return worker_base.PollResult(n, n)
+
+    def _handle_request(self, req: Payload):
         handle = req.handle_name
         try:
             if handle == "fetch_data":
                 self._handle_fetch_data(req)
             elif handle in ("generate", "inference", "train_step"):
                 self._handle_mfc(req)
+            elif handle == "param_sync_send":
+                self._handle_param_sync_send(req)
             elif handle == "save":
                 self._handle_save(req)
             elif handle == "evaluate":
@@ -322,7 +434,6 @@ class ModelWorker(worker_base.Worker):
                 handler=self.worker_name, handle_name="error",
                 request_id=req.request_id, data=repr(e)))
             raise
-        return worker_base.PollResult(1, 1)
 
     def _exit_hook(self):
         if getattr(self, "data_server", None) is not None:

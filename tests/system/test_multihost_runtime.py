@@ -156,6 +156,7 @@ def test_worker_group_spec_helpers():
     spec = ExperimentSpec.__new__(ExperimentSpec)
     spec.worker_assignment = {"actor": [1, 2], "ref": 0}
     spec.models = {"actor": None, "ref": None}
+    spec.allocations = {}
     assert spec.workers_of_role("actor") == [1, 2]
     assert spec.worker_of_role("actor") == 1
     assert spec.workers_of_role("ref") == [0]
@@ -166,3 +167,27 @@ def test_worker_group_spec_helpers():
     spec.worker_assignment = {"actor": [1, 1]}
     with pytest.raises(ValueError, match="duplicate"):
         spec.workers_of_role("actor")
+
+
+def test_cross_group_spec_helpers():
+    from realhf_tpu.api.experiment import ExperimentSpec, MFCAllocation
+    from realhf_tpu.parallel.mesh import ParallelismConfig
+
+    spec = ExperimentSpec.__new__(ExperimentSpec)
+    spec.worker_assignment = {"actor": 0}
+    spec.models = {"actor": None}
+    par = ParallelismConfig(data_parallel_size=2)
+    spec.allocations = {"actor_gen": MFCAllocation(par, workers=[1])}
+    assert spec.workers_of_node("actor_gen", "actor") == [1]
+    assert spec.workers_of_node("actor_train", "actor") == [0]
+    assert spec.is_cross_group("actor_gen", "actor")
+    assert not spec.is_cross_group("actor_train", "actor")
+    assert not spec.multihost  # two single-worker groups, no shared mesh
+    # bare ParallelismConfig allocations keep the role's group
+    spec.allocations = {"actor_gen": par}
+    assert spec.alloc_of("actor_gen").parallel is par
+    assert spec.workers_of_node("actor_gen", "actor") == [0]
+    assert not spec.is_cross_group("actor_gen", "actor")
+    # a multi-worker exec group does need the shared world
+    spec.allocations = {"actor_gen": MFCAllocation(par, workers=[1, 2])}
+    assert spec.multihost
