@@ -120,6 +120,7 @@ class Engine:
         # carried another tp's padding).
         params = shard_rules.normalize_vocab_padding(cfg, params,
                                                      ctx.tp_size)
+        params = self._cast_param_dtype(params)
         self.params = jax.device_put(params, self._param_shardings)
         self._constrain = shard_rules.activation_constraint(
             self.mesh, ctx.parallel.sequence_parallel)
@@ -154,12 +155,43 @@ class Engine:
 
         self.optimizer_config = optimizer
         if optimizer is not None and optimizer.type != "empty":
-            self._tx = make_optimizer(optimizer, total_train_steps)
-            init = jax.jit(self._tx.init)
-            self.opt_state = init(self.params)
+            # Mixed precision: non-fp32 params train against an fp32
+            # master copy held INSIDE the optimizer state (reference
+            # Megatron bf16 + fp32 master, megatron.py:823-940).
+            master = jnp.dtype(cfg.param_dtype) != jnp.dtype(jnp.float32)
+            self._tx = make_optimizer(optimizer, total_train_steps,
+                                      master_weights=master)
+            # ZeRO-1: Adam moments (and the fp32 master copy) shard
+            # over the DATA axis on top of the params' tp/pp specs
+            # (reference Megatron DistributedOptimizer,
+            # backend/megatron.py:823-940; DeepSpeed ZeRO-1,
+            # deepspeed.py:445). GSPMD inserts the reduce-scatter /
+            # all-gather pair around the update.
+            zero1 = getattr(optimizer, "zero1", True)
+            state_shape = jax.eval_shape(self._tx.init, self.params)
+            self._opt_shardings = shard_rules.opt_state_shardings(
+                state_shape, cfg, self.mesh, zero1=zero1)
+            self.opt_state = jax.jit(
+                self._tx.init,
+                out_shardings=self._opt_shardings)(self.params)
+            # ZeRO-2-flavored grad accumulation: the fp32 grad
+            # accumulator shards over DP too, turning the DP grad
+            # all-reduce into a reduce-scatter (Megatron
+            # DistributedOptimizer grad-buffer layout).
+            if zero1:
+                self._grad_shardings = jax.tree.map(
+                    lambda sh, p: jax.sharding.NamedSharding(
+                        self.mesh, shard_rules.zero1_moment_spec(
+                            sh.spec, p.shape,
+                            self.mesh.shape.get("data", 1))),
+                    self._param_shardings, self.params)
+            else:
+                self._grad_shardings = None
         else:
             self._tx = None
             self.opt_state = None
+            self._opt_shardings = None
+            self._grad_shardings = None
 
         self._train_step_cache: Dict[Any, Callable] = {}
         self._generate_cache: Dict[Any, Callable] = {}
@@ -219,6 +251,9 @@ class Engine:
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             zero = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            if self._grad_shardings is not None:
+                zero = jax.tree.map(jax.lax.with_sharding_constraint,
+                                    zero, self._grad_shardings)
 
             def accum(carry, x):
                 gsum = carry
@@ -226,12 +261,21 @@ class Engine:
                 (loss, stats), grads = grad_fn(params, mb)
                 gsum = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32) * w, gsum, grads)
+                if self._grad_shardings is not None:
+                    gsum = jax.tree.map(jax.lax.with_sharding_constraint,
+                                        gsum, self._grad_shardings)
                 return gsum, (loss, stats)
 
             wsum = mb_weights.sum()
             gsum, (losses, stats) = jax.lax.scan(
                 accum, zero, (mbs, mb_weights / wsum))
             updates, new_opt = self._tx.update(gsum, opt_state, params)
+            if self._opt_shardings is not None:
+                # keep the ZeRO-1 moment shardings stable across steps
+                # (donated buffers must alias exactly)
+                new_opt = jax.tree.map(
+                    lambda s, sh: jax.lax.with_sharding_constraint(s, sh),
+                    new_opt, self._opt_shardings)
             new_params = optax.apply_updates(params, updates)
             gnorm = optax.global_norm(gsum)
             mean_stats = jax.tree.map(
@@ -393,6 +437,13 @@ class Engine:
                   self._globalize(key))
 
     # ------------------------------------------------------------------
+    def _cast_param_dtype(self, params):
+        """Cast leaves to cfg.param_dtype (bf16 models may be fed fp32
+        checkpoints; the fp32 master then lives in the opt state)."""
+        pdt = jnp.dtype(self.cfg.param_dtype)
+        return jax.tree.map(
+            lambda a: a if a.dtype == pdt else a.astype(pdt), params)
+
     def set_params(self, params, already_sharded: bool = False):
         """Install new weights (parameter reallocation landing point)."""
         if already_sharded:
@@ -400,6 +451,7 @@ class Engine:
         else:
             params = shard_rules.normalize_vocab_padding(
                 self.cfg, params, self.ctx.tp_size)
+            params = self._cast_param_dtype(params)
             self.params = jax.device_put(params, self._param_shardings)
 
     def params_numpy(self):

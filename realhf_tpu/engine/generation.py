@@ -57,9 +57,9 @@ def generate(
     prompt_lens = (prompt_seg != 0).sum(-1).astype(jnp.int32)
 
     hidden, cache = T.prefill(cfg, params, prompt_ids, prompt_seg, prompt_pos,
+                              total_len=lp + gconfig.max_new_tokens,
                               activation_constraint=activation_constraint,
                               moe_constraint=moe_constraint)
-    cache = T.extend_kv_cache(cache, gconfig.max_new_tokens)
     last_hidden = hidden[:, -1]  # left padding => last column is last token
 
     def sample_step(logits, step_idx, unfinished, k):

@@ -75,7 +75,8 @@ class InflightBatchingGenerator:
         self.eos = eos_token_id
         self.pad = pad_token_id
         self.chunk = chunk_size
-        self.cache_len = max_prompt_len + gconfig.max_new_tokens
+        self.cache_len = T.round_cache_len(
+            max_prompt_len + gconfig.max_new_tokens)
         # jax.jit retraces per prompt-bucket shape on its own; one
         # jitted function covers every bucket.
         self._prefill = jax.jit(functools.partial(
@@ -168,19 +169,18 @@ class InflightBatchingGenerator:
 def _prefill_into_slot(cfg, cache_len, moe_constraint, params, state, slot,
                        ids, seg, pos):
     """Batch-1 prefill scattered into `slot`'s cache rows + state."""
+    # total_len=cache_len: the prefill cache comes back already padded
+    # to the slot's row length (cache_len is round_cache_len-aligned by
+    # the constructor, so prefill's own rounding is a no-op).
     hidden, pcache = T.prefill(cfg, params, ids, seg, pos,
+                               total_len=cache_len,
                                moe_constraint=moe_constraint)
     lp = ids.shape[1]
     pad_s = cache_len - lp
 
-    def slot_row(a):  # [nl, 1, lp, ...] -> [nl, cache_len, ...]
-        a = a[:, 0]
-        widths = [(0, 0), (0, pad_s)] + [(0, 0)] * (a.ndim - 2)
-        return jnp.pad(a, widths)
-
     cache = dict(state["cache"])
-    cache["k"] = cache["k"].at[:, slot].set(slot_row(pcache["k"]))
-    cache["v"] = cache["v"].at[:, slot].set(slot_row(pcache["v"]))
+    cache["k"] = cache["k"].at[:, slot].set(pcache["k"][:, 0])
+    cache["v"] = cache["v"].at[:, slot].set(pcache["v"][:, 0])
     cache["valid"] = cache["valid"].at[slot].set(
         jnp.pad(seg[0] != 0, (0, pad_s)))
     plen = (seg[0] != 0).sum().astype(jnp.int32)

@@ -9,8 +9,10 @@ here: optimizer state is a pytree that shards exactly like params
 """
 
 import dataclasses
-from typing import Optional
+from typing import Any, NamedTuple, Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -32,6 +34,11 @@ class OptimizerConfig:
     # config-surface parity and ignored.
     initial_loss_scale: float = 2 ** 32
     offload: bool = False
+    # ZeRO-1-equivalent optimizer-state sharding over the DP axis
+    # (reference Megatron DistributedOptimizer / DeepSpeed zero_stage=1,
+    # always on in the reference's Megatron backend). Adam moments
+    # shard as params x DATA; disable to replicate moments across DP.
+    zero1: bool = True
 
 
 def lr_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
@@ -55,8 +62,44 @@ def lr_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
         [optax.linear_schedule(0.0, cfg.lr, warmup), decay], [warmup])
 
 
+class MasterWeightsState(NamedTuple):
+    """fp32 master copy + the wrapped optimizer's state. Both live in
+    the optimizer state pytree, so ZeRO-1 shards them over DP
+    (models/sharding.py:opt_state_shardings) -- the reference's
+    Megatron DistributedOptimizer layout (megatron.py:823-940: bf16
+    weights everywhere, fp32 master + moments sharded across DP)."""
+    master: Any
+    inner: Any
+
+
+def with_master_weights(inner: optax.GradientTransformation
+                        ) -> optax.GradientTransformation:
+    """Mixed-precision wrapper: params stay in their compute dtype
+    (bf16); the update runs in fp32 against a master copy kept in the
+    state. The emitted update is the fp32 delta ``new_master - p``, so
+    ``optax.apply_updates`` (which adds in promoted fp32 then casts to
+    the param dtype) lands exactly ``round_bf16(new_master)``."""
+
+    def init(params):
+        master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32),
+                              params)
+        return MasterWeightsState(master, inner.init(master))
+
+    def update(grads, state, params=None):
+        assert params is not None, "master-weights update needs params"
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        upd, inner_state = inner.update(g32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, upd)
+        delta = jax.tree.map(
+            lambda nm, p: nm - p.astype(jnp.float32), new_master, params)
+        return delta, MasterWeightsState(new_master, inner_state)
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_optimizer(cfg: OptimizerConfig,
-                   total_steps: Optional[int] = None
+                   total_steps: Optional[int] = None,
+                   master_weights: bool = False
                    ) -> optax.GradientTransformation:
     if cfg.type == "empty":
         return optax.identity()
@@ -69,10 +112,12 @@ def make_optimizer(cfg: OptimizerConfig,
     # Decay only matrix-shaped params (norm scales/biases excluded),
     # matching Megatron's no-weight-decay param groups.
     def decay_mask(params):
-        import jax
         return jax.tree.map(lambda p: p.ndim >= 2, params)
 
     chain.append(optax.adamw(
         learning_rate=sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
         weight_decay=cfg.weight_decay, mask=decay_mask))
-    return optax.chain(*chain)
+    tx = optax.chain(*chain)
+    if master_weights:
+        tx = with_master_weights(tx)
+    return tx

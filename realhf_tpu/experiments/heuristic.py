@@ -78,6 +78,19 @@ def _min_tp(param_bytes: float, n_devices: int,
 TP_CAP = 8
 
 
+def train_state_bytes_per_chip(n_params: int, tp: int, pp: int,
+                               dp: int) -> float:
+    """Per-chip training-state bytes with bf16 weights + ZeRO-1:
+    bf16 params (2 B) shard over tp*pp; fp32 master copy (4 B), Adam
+    m/v (8 B) and the fp32 grad accumulator (4 B, live during the
+    step) additionally shard over dp (engine/optim.py
+    with_master_weights + models/sharding.py opt_state_shardings;
+    reference layout: Megatron DistributedOptimizer,
+    megatron.py:823-940 -- previously modeled as 18 B/param over tp*pp
+    only)."""
+    return n_params * (2.0 + 16.0 / max(dp, 1)) / (tp * pp)
+
+
 def choose_layout(cfg: TransformerConfig, n_devices: int,
                   interface_type: ModelInterfaceType,
                   trainable: bool,
@@ -85,10 +98,42 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
                   ) -> ParallelismConfig:
     """One MFC's layout on ``n_devices`` chips."""
     n_params = cfg.n_params()
+
     if trainable:
-        # bf16 weights + fp32 master + Adam m/v (fp32): ~18 B/param
-        bytes_needed = n_params * 18
-    elif interface_type == ModelInterfaceType.GENERATE:
+        # ZeRO-1 changes the trade-off: moments shrink with dp, so the
+        # fit check must use the dp each (tp, pp) candidate implies.
+        def fits(tp, pp):
+            dp = max(1, n_devices // (tp * pp))
+            return train_state_bytes_per_chip(
+                n_params, tp, pp, dp) <= hbm_budget
+
+        tp = next((t for t in _pow2_up_to(n_devices) if fits(t, 1)),
+                  n_devices)
+        pp = 1
+        if tp > TP_CAP:
+            # Very large models: hold TP at one ICI ring and shard
+            # layers over pipeline stages instead.
+            tp = min(TP_CAP, n_devices)
+            for cand in _pow2_up_to(max(1, n_devices // tp)):
+                pp = cand
+                if cfg.n_layers % cand == 0 and fits(tp, cand):
+                    break
+            while pp > 1 and cfg.n_layers % pp != 0:
+                pp //= 2
+        dp = max(1, n_devices // (tp * pp))
+        per_chip = train_state_bytes_per_chip(n_params, tp, pp, dp)
+        if per_chip > hbm_budget:
+            logger.warning(
+                "Heuristic layout t%dp%d leaves %.1f GB/chip for a "
+                "%.1f GB budget (n_layers=%d limits pipeline depth); "
+                "expect OOM without remat/offload headroom or more "
+                "devices.", tp, pp, per_chip / 1e9, hbm_budget / 1e9,
+                cfg.n_layers)
+        return ParallelismConfig(
+            data_parallel_size=dp, tensor_parallel_size=tp,
+            pipeline_parallel_size=pp, sequence_parallel=tp > 1)
+
+    if interface_type == ModelInterfaceType.GENERATE:
         # bf16 weights + KV cache headroom
         bytes_needed = n_params * 2 * 1.5
     else:
@@ -96,9 +141,6 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
     tp = _min_tp(bytes_needed, n_devices, hbm_budget)
     pp = 1
     if (tp > TP_CAP and interface_type != ModelInterfaceType.GENERATE):
-        # Very large train/inference models: hold TP at one ICI ring
-        # and shard layers over pipeline stages instead (generation
-        # cannot run on a pipeline mesh -- engine restriction).
         tp = min(TP_CAP, n_devices)
         for cand in _pow2_up_to(max(1, n_devices // tp)):
             pp = cand
@@ -118,7 +160,7 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
     return ParallelismConfig(
         data_parallel_size=dp, tensor_parallel_size=tp,
         pipeline_parallel_size=pp,
-        sequence_parallel=tp > 1 and trainable)
+        sequence_parallel=False)
 
 
 def heuristic_allocations(

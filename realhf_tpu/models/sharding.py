@@ -149,6 +149,67 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def zero1_moment_spec(spec: P, shape, dp: int) -> P:
+    """Extend a parameter's PartitionSpec with the DATA axis on its
+    largest free dim -- the ZeRO-1 sharding for that parameter's
+    optimizer moments (reference Megatron DistributedOptimizer,
+    backend/megatron.py:823-940: fp32 m/v sharded over DP). The
+    all-gather of the parameter update that ZeRO-1 performs is inserted
+    by GSPMD when `optax.apply_updates` output reshards to the param's
+    own spec."""
+    if dp <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        for ax in (e if isinstance(e, tuple) else (e,)):
+            used.add(ax)
+    if DATA_AXIS in used:  # e.g. expert-parallel MoE weights
+        return spec
+    best_i, best = None, 0
+    for i, (e, d) in enumerate(zip(entries, shape)):
+        if e is None and d % dp == 0 and d > best:
+            best, best_i = d, i
+    if best_i is None:
+        return spec
+    entries[best_i] = DATA_AXIS
+    return P(*entries)
+
+
+def opt_state_shardings(opt_state_shape, cfg: TransformerConfig,
+                        mesh: Mesh, zero1: bool = True):
+    """NamedSharding pytree for an optax state (from
+    ``jax.eval_shape(tx.init, params)``).
+
+    Moment leaves are recognized by path suffix: optax states embed
+    ``mu``/``nu`` (and any other per-parameter slot) as pytrees
+    congruent with the params, so a state leaf whose key-path ends with
+    a full parameter path IS that parameter's slot and gets the
+    parameter's spec -- extended over the DATA axis when ``zero1``.
+    Everything else (step counts, scalars) is replicated."""
+    pp = mesh.shape.get(PIPE_AXIS, 1)
+    dp = mesh.shape.get(DATA_AXIS, 1) if zero1 else 1
+    pspecs = param_pspecs(cfg, pipeline_parallel=pp > 1)
+    flat_p = jax.tree_util.tree_flatten_with_path(
+        pspecs, is_leaf=lambda x: isinstance(x, P))[0]
+    param_paths = [(tuple(str(k) for k in path), spec)
+                   for path, spec in flat_p]
+
+    def assign(path, leaf):
+        strs = tuple(str(k) for k in path)
+        for ppath, spec in param_paths:
+            if len(strs) >= len(ppath) and strs[-len(ppath):] == ppath:
+                if leaf.shape != ():
+                    return NamedSharding(
+                        mesh, zero1_moment_spec(spec, leaf.shape, dp))
+                break
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, opt_state_shape)
+
+
 def batch_pspec() -> P:
     """[B, L] token/segment arrays: DP over streams, context
     parallelism over the sequence dim."""
@@ -193,10 +254,10 @@ def moe_ep_constraint(cfg: TransformerConfig, mesh: Mesh):
 
 
 def kv_cache_pspecs() -> Dict[str, P]:
-    """KV cache: [nl, B, S, nkv, hd] -- DP over streams, TP over heads."""
+    """KV cache: [nl, B, nkv, S, hd] -- DP over streams, TP over heads."""
     return {
-        "k": P(None, DATA_AXIS, None, MODEL_AXIS, None),
-        "v": P(None, DATA_AXIS, None, MODEL_AXIS, None),
+        "k": P(None, DATA_AXIS, MODEL_AXIS, None, None),
+        "v": P(None, DATA_AXIS, MODEL_AXIS, None, None),
         "valid": P(DATA_AXIS, None),
         "length": P(DATA_AXIS),
     }

@@ -404,12 +404,31 @@ def critic_values(cfg: TransformerConfig, params: Params,
 # ----------------------------------------------------------------------
 # KV cache + decode step (generation)
 # ----------------------------------------------------------------------
+# Cache layout is HEAD-MAJOR: k/v are [nl, B, nkv, S, hd] so the decode
+# attention kernel streams a layer's rows straight from HBM with no
+# transpose on the hot path. The slot axis is pre-padded to a multiple
+# of the kernel's K block so per-token calls never concat-pad.
+_CACHE_LEN_MULTIPLE = 128
+# Below this depth the decode layer loop is unrolled (static layer
+# indices = free views into the stacked cache); deeper models use a
+# lax.scan with a scalar-prefetch kernel to keep compile time O(1).
+_DECODE_UNROLL_MAX_LAYERS = 48
+
+
+def round_cache_len(n: int) -> int:
+    """Round a KV-cache slot count up to the kernel-friendly multiple."""
+    if n <= _CACHE_LEN_MULTIPLE:
+        return n
+    return -(-n // _CACHE_LEN_MULTIPLE) * _CACHE_LEN_MULTIPLE
+
+
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
                   dtype=None) -> KVCache:
     """Padded KV cache sized max_prompt_len + max_new_tokens, matching
     reference `prepare_generate_inputs` (real_llm_generate.py:179)."""
     dtype = dtype or jnp.dtype(cfg.compute_dtype)
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    max_len = round_cache_len(max_len)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -420,30 +439,49 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
 
 def prefill(cfg: TransformerConfig, params: Params, input_ids: jnp.ndarray,
             seg_ids: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
-            *, activation_constraint=None,
+            *, total_len: Optional[int] = None, activation_constraint=None,
             moe_constraint=None) -> Tuple[jnp.ndarray, KVCache]:
     """Run the packed forward and materialize a KV cache whose first
-    L slots hold the prompt keys/values."""
+    L slots hold the prompt keys/values.
+
+    ``total_len``: allocate the cache at its final decode size
+    (prompt + max_new_tokens, rounded up to the kernel block) in ONE
+    pad here, instead of a post-hoc `extend_kv_cache` concat copy."""
     hidden, kvs = forward(cfg, params, input_ids, seg_ids, positions,
                           return_kv=True,
                           activation_constraint=activation_constraint,
                           moe_constraint=moe_constraint)
     k, v = kvs  # [nl, B, L, nkv, hd]
+    k = k.transpose(0, 1, 3, 2, 4)  # -> [nl, B, nkv, L, hd] head-major
+    v = v.transpose(0, 1, 3, 2, 4)
+    b, lp = input_ids.shape
+    valid = seg_ids != 0
+    total = round_cache_len(total_len if total_len is not None else lp)
+    pad = total - lp
+    if pad:
+        widths = [(0, 0), (0, 0), (0, 0), (0, pad), (0, 0)]
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+        valid = jnp.pad(valid, [(0, 0), (0, pad)])
     cache = {
         "k": k,
         "v": v,
-        "valid": seg_ids != 0,
-        "length": jnp.full((input_ids.shape[0],), input_ids.shape[1],
-                           jnp.int32),
+        "valid": valid,
+        "length": jnp.full((b,), lp, jnp.int32),
     }
     return hidden, cache
 
 
 def extend_kv_cache(cache: KVCache, extra: int) -> KVCache:
-    """Grow the cache along the slot axis by `extra` zero slots."""
-    nl, b, s, nkv, hd = cache["k"].shape
+    """Grow the cache along the slot axis by `extra` zero slots.
+
+    Prefer ``prefill(..., total_len=...)`` which allocates the final
+    size up front; this concat path remains for incremental callers."""
+    nl, b, nkv, s, hd = cache["k"].shape
+    new_s = round_cache_len(s + extra)
+    extra = new_s - s
     pad = lambda a: jnp.concatenate(
-        [a, jnp.zeros((nl, b, extra, nkv, hd), a.dtype)], axis=2)
+        [a, jnp.zeros((nl, b, nkv, extra, hd), a.dtype)], axis=3)
     return {
         "k": pad(cache["k"]),
         "v": pad(cache["v"]),
@@ -451,6 +489,27 @@ def extend_kv_cache(cache: KVCache, extra: int) -> KVCache:
             [cache["valid"], jnp.zeros((b, extra), bool)], axis=1),
         "length": cache["length"],
     }
+
+
+def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
+                              scale, sliding_window, slot):
+    """Decode attention against the FULL stacked cache at a traced
+    layer index. TPU: scalar-prefetch Pallas kernel (streams exactly
+    one layer's rows from HBM, no slice copy). Elsewhere: slice the
+    layer out and run the XLA path (CPU tests only)."""
+    hd = q.shape[-1]
+    if (jax.default_backend() == "tpu" and hd >= 64
+            and (scale is None or isinstance(scale, (int, float)))):
+        from realhf_tpu.ops.decode_attention import (
+            flash_decode_attention_stacked,
+        )
+        return flash_decode_attention_stacked(
+            q, k_all, v_all, valid, layer_idx, scale=scale,
+            sliding_window=sliding_window, slot=slot)
+    k_l = jax.lax.dynamic_index_in_dim(k_all, layer_idx, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(v_all, layer_idx, 0, keepdims=False)
+    return decode_attention(q, k_l, v_l, valid, scale=scale,
+                            sliding_window=sliding_window, slot=slot)
 
 
 def decode_step(
@@ -466,6 +525,15 @@ def decode_step(
     token's logits and the updated cache. The jitted decode loop built
     on this replaces CUDA-graph decoding (reference
     real_llm_generate.py:214, cuda_graph.py).
+
+    The stacked k/v caches stay whole through the layer loop and only
+    the new token's slot is written per layer (`dynamic_update_slice`
+    aliases in place inside the decode scan) -- threading them through
+    a `lax.scan` as xs/ys would re-materialize the entire cache as a
+    fresh stacked output every token, ~3x the roofline's intended HBM
+    traffic. Shallow models unroll the layer loop (static layer index
+    = free view of the stacked cache); deep models scan with a
+    scalar-prefetch attention kernel.
 
     ``uniform_slot``: promise that every stream writes the SAME cache
     slot (true for the batch generate path, where prefill fills a
@@ -502,36 +570,63 @@ def decode_step(
         valid = cache["valid"].at[jnp.arange(b), slot].set(True)
     new_len = slot + 1
 
-    def body(x, layer):
-        lp, layer_idx, k_cache, v_cache = layer
+    def layer_body(x, k_all, v_all, lp, layer_idx, static_l=None):
         ln1 = _norm(cfg, x, lp["ln1"]["scale"], lp["ln1"].get("bias"))
         q, k, v = _qkv(cfg, lp, ln1)  # q: [B, nq, hd]; k/v: [B, nkv, hd]
         if cfg.apply_rotary:
             q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
             k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+        l = layer_idx if static_l is None else static_l
         if uniform_slot:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k[:, None].astype(k_cache.dtype), (0, s0, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v[:, None].astype(v_cache.dtype), (0, s0, 0, 0))
+            kw = k[None, :, :, None, :].astype(k_all.dtype)  # [1,B,nkv,1,hd]
+            vw = v[None, :, :, None, :].astype(v_all.dtype)
+            k_all = jax.lax.dynamic_update_slice(k_all, kw, (l, 0, 0, s0, 0))
+            v_all = jax.lax.dynamic_update_slice(v_all, vw, (l, 0, 0, s0, 0))
         else:
-            k_cache = k_cache.at[jnp.arange(b), slot].set(k)
-            v_cache = v_cache.at[jnp.arange(b), slot].set(v)
-        attn = decode_attention(q, k_cache, v_cache, valid,
-                                scale=_attn_scale(cfg, layer_idx),
-                                sliding_window=cfg.sliding_window,
-                                slot=slot)
+            k_all = k_all.at[l, jnp.arange(b), :, slot].set(
+                k.astype(k_all.dtype))
+            v_all = v_all.at[l, jnp.arange(b), :, slot].set(
+                v.astype(v_all.dtype))
+        base = cfg.head_dim ** -0.5 if cfg.scale_attn_weights else 1.0
+        if not cfg.scale_attn_by_inverse_layer_idx:
+            scale = base
+        elif static_l is not None:
+            scale = base / (static_l + 1)
+        else:
+            scale = _attn_scale(cfg, layer_idx)  # traced scalar
+        if static_l is not None:
+            attn = decode_attention(q, k_all[static_l], v_all[static_l],
+                                    valid, scale=scale,
+                                    sliding_window=cfg.sliding_window,
+                                    slot=slot)
+        else:
+            attn = _stacked_decode_attention(
+                q, k_all, v_all, valid, layer_idx, scale=scale,
+                sliding_window=cfg.sliding_window, slot=slot)
         proj = attn.reshape(b, -1) @ lp["attn"]["wo"].astype(x.dtype)
         if "bo" in lp["attn"]:
             proj = proj + lp["attn"]["bo"].astype(x.dtype)
         x = x + proj
         ln2 = _norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
         x = x + _mlp(cfg, lp, ln2, moe_constraint)
-        return x, (k_cache, v_cache)
+        return x, k_all, v_all
 
-    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["blocks"], layer_ids, cache["k"], cache["v"]))
+    k_all, v_all = cache["k"], cache["v"]
+    if cfg.n_layers <= _DECODE_UNROLL_MAX_LAYERS:
+        for li in range(cfg.n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[li], params["blocks"])
+            x, k_all, v_all = layer_body(x, k_all, v_all, lp, li,
+                                         static_l=li)
+    else:
+        def body(carry, layer):
+            xc, kc, vc = carry
+            lp, layer_idx = layer
+            xc, kc, vc = layer_body(xc, kc, vc, lp, layer_idx)
+            return (xc, kc, vc), None
+
+        layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+        (x, k_all, v_all), _ = jax.lax.scan(
+            body, (x, k_all, v_all), (params["blocks"], layer_ids))
     x = _norm(cfg, x, params["ln_f"]["scale"], params["ln_f"].get("bias"))
-    new_cache = {"k": new_k, "v": new_v, "valid": valid, "length": new_len}
+    new_cache = {"k": k_all, "v": v_all, "valid": valid, "length": new_len}
     return x, new_cache

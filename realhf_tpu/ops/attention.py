@@ -115,8 +115,8 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
 
 def decode_attention(
     q: jnp.ndarray,        # [B, nq, hd] -- one new token per stream
-    k_cache: jnp.ndarray,  # [B, S, nkv, hd]
-    v_cache: jnp.ndarray,  # [B, S, nkv, hd]
+    k_cache: jnp.ndarray,  # [B, nkv, S, hd] (head-major)
+    v_cache: jnp.ndarray,  # [B, nkv, S, hd]
     valid_mask: jnp.ndarray,  # [B, S] bool: which cache slots hold real
                               # tokens (left-padded prompts leave invalid
                               # low slots, so a prefix length is not enough)
@@ -136,7 +136,7 @@ def decode_attention(
     ``(slot - window, slot]``.
     """
     b, nq, hd = q.shape
-    s, nkv = k_cache.shape[1], k_cache.shape[2]
+    nkv, s = k_cache.shape[1], k_cache.shape[2]
     group = nq // nkv
 
     # Pallas flash-decode on TPU: single tiled pass over the cache, no
@@ -157,7 +157,7 @@ def decode_attention(
     scale = scale if scale is not None else hd ** -0.5
 
     qg = q.reshape(b, nkv, group, hd)
-    scores = jnp.einsum("bhgd,bkhd->bhgk", qg, k_cache,
+    scores = jnp.einsum("bhgd,bhkd->bhgk", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
@@ -168,6 +168,6 @@ def decode_attention(
         keep = keep & ((slot[:, None] - idx) < sliding_window)
     scores = jnp.where(keep[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgk,bkhd->bhgd", probs.astype(v_cache.dtype), v_cache,
+    out = jnp.einsum("bhgk,bhkd->bhgd", probs.astype(v_cache.dtype), v_cache,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, nq, hd).astype(q.dtype)

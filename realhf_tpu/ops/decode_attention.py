@@ -8,11 +8,22 @@ Decode is HBM-bandwidth bound -- the kernel makes a single pass over
 K/V per step, with all query heads of a KV group (GQA) sharing each
 loaded block.
 
-Layout contract: q [B, nq, hd], k/v caches [B, S, nkv, hd],
-keep-mask [B, S] (validity AND the sliding window -- precomputed in
-XLA, it is O(B*S) elementwise). The query-group axis is padded up to
-the fp32 sublane count (8); hd should be a multiple of 128 on real
-TPUs. S is padded to the K block.
+Layout contract (HEAD-MAJOR, so no transpose sits on the hot path):
+q [B, nq, hd], per-layer caches [B, nkv, S, hd], keep-mask [B, S]
+(validity AND the sliding window -- precomputed in XLA, it is O(B*S)
+elementwise). Two entry points:
+
+- ``flash_decode_attention``: per-layer caches (unrolled decode loop;
+  a static layer index into the stacked cache is a free view).
+- ``flash_decode_attention_stacked``: the FULL stacked caches
+  [nl, B, nkv, S, hd] plus a (traced) layer index, delivered to the
+  kernel through scalar prefetch so only layer ``l``'s rows are ever
+  streamed from HBM. This keeps the `lax.scan`-over-layers decode
+  path at O(1) compile time without copying a layer's cache out per
+  token (the round-3 decode bottleneck).
+
+The query-group axis is padded up to the fp32 sublane count (8); hd
+should be a multiple of 128 on real TPUs. S is padded to the K block.
 """
 
 import functools
@@ -21,23 +32,25 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -2.0 ** 30
 SUBLANES = 8
 DEFAULT_BK = 512
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
-    gp, hd = q_ref.shape[-2], q_ref.shape[-1]
-    s = k_ref.shape[-2]
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [gp, hd]
+def _decode_body(q, k_at, v_at, keep_at, o_ref, *, scale, bk, s):
+    """Shared online-softmax body over one (stream, kv-head) cell.
+    ``q``: loaded [gp, hd]; ``k_at(j)/v_at(j)``: [bk, hd] block loads;
+    ``keep_at(j)``: [bk] int32; ``o_ref``: the output ref."""
+    gp, hd = q.shape
+    q = q.astype(jnp.float32) * scale
 
     def body(j, carry):
         m, l_sum, acc = carry
-        k = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * bk, bk), :]
-        keep = keep_ref[0, 0, pl.ds(j * bk, bk)]  # [bk] int32
+        k = k_at(j).astype(jnp.float32)
+        v = v_at(j)
+        keep = keep_at(j)  # [bk] int32
 
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -61,12 +74,66 @@ def _decode_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
     row_valid = m > NEG_INF / 2  # streams whose cache is still empty
     safe_l = jnp.where(l_sum > 0, l_sum, 1.0)
     out = jnp.where(row_valid[:, None], acc / safe_l[:, None], 0.0)
-    o_ref[0, 0] = out.astype(o_ref.dtype)
+    o_ref[...] = out.reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def _layer_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
+    s = k_ref.shape[-2]
+    _decode_body(
+        q_ref[0, 0],
+        lambda j: k_ref[0, 0, pl.ds(j * bk, bk), :],
+        lambda j: v_ref[0, 0, pl.ds(j * bk, bk), :],
+        lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
+        o_ref, scale=scale, bk=bk, s=s)
+
+
+def _stacked_kernel(lidx_ref, q_ref, k_ref, v_ref, keep_ref, o_ref, *,
+                    scale, bk):
+    # lidx_ref is the scalar-prefetch operand; the index_map already
+    # consumed it to select the layer block, so the body is identical.
+    s = k_ref.shape[-2]
+    _decode_body(
+        q_ref[0, 0],
+        lambda j: k_ref[0, 0, 0, pl.ds(j * bk, bk), :],
+        lambda j: v_ref[0, 0, 0, pl.ds(j * bk, bk), :],
+        lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
+        o_ref, scale=scale, bk=bk, s=s)
+
+
+def _pick_bk(s: int, block_k: int = DEFAULT_BK) -> int:
+    """Largest K-block <= block_k that divides s (cache lengths are
+    allocated as multiples of 128, so this normally succeeds and the
+    concat-pad fallback never runs on the hot path)."""
+    if s <= block_k:
+        return s
+    for bk in (512, 384, 256, 128):
+        if bk <= block_k and s % bk == 0:
+            return bk
+    return block_k
+
+
+def _window_keep(valid_mask, sliding_window, slot):
+    keep = valid_mask
+    if sliding_window is not None:
+        assert slot is not None, "sliding_window decode needs slot indices"
+        s = valid_mask.shape[1]
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        keep = keep & ((slot[:, None] - idx) < sliding_window)
+    return keep.astype(jnp.int32)
+
+
+def _pad_group(q, nkv, group, gp):
+    b, _, hd = q.shape
+    qg = q.reshape(b, nkv, group, hd)
+    if gp != group:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((b, nkv, gp - group, hd), q.dtype)], axis=2)
+    return qg
 
 
 def flash_decode_attention(
     q: jnp.ndarray,        # [B, nq, hd]
-    k_cache: jnp.ndarray,  # [B, S, nkv, hd]
+    k_cache: jnp.ndarray,  # [B, nkv, S, hd]
     v_cache: jnp.ndarray,
     valid_mask: jnp.ndarray,  # [B, S] bool
     *,
@@ -77,38 +144,28 @@ def flash_decode_attention(
     interpret: bool = False,
 ) -> jnp.ndarray:
     b, nq, hd = q.shape
-    s, nkv = k_cache.shape[1], k_cache.shape[2]
+    nkv, s = k_cache.shape[1], k_cache.shape[2]
     group = nq // nkv
     scale = float(scale) if scale is not None else hd ** -0.5
 
-    keep = valid_mask
-    if sliding_window is not None:
-        assert slot is not None, "sliding_window decode needs slot indices"
-        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
-        keep = keep & ((slot[:, None] - idx) < sliding_window)
-    keep = keep.astype(jnp.int32)
+    keep = _window_keep(valid_mask, sliding_window, slot)
 
-    bk = min(block_k, s)
+    bk = _pick_bk(s, block_k)
     pad_s = (-s) % bk
     if pad_s:
-        zpad = jnp.zeros((b, pad_s, nkv, hd), k_cache.dtype)
-        k_cache = jnp.concatenate([k_cache, zpad], axis=1)
-        v_cache = jnp.concatenate([v_cache, zpad], axis=1)
+        zpad = jnp.zeros((b, nkv, pad_s, hd), k_cache.dtype)
+        k_cache = jnp.concatenate([k_cache, zpad], axis=2)
+        v_cache = jnp.concatenate([v_cache, zpad], axis=2)
         keep = jnp.concatenate(
             [keep, jnp.zeros((b, pad_s), jnp.int32)], axis=1)
-        s += pad_s
+    s += pad_s
 
     gp = max(SUBLANES, group)  # pad query group to the sublane tile
-    qg = q.reshape(b, nkv, group, hd)
-    if gp != group:
-        qg = jnp.concatenate(
-            [qg, jnp.zeros((b, nkv, gp - group, hd), q.dtype)], axis=2)
-    kt = k_cache.transpose(0, 2, 1, 3)  # [B, nkv, S, hd]
-    vt = v_cache.transpose(0, 2, 1, 3)
+    qg = _pad_group(q, nkv, group, gp)
     keep_b = jnp.broadcast_to(keep[:, None, :], (b, SUBLANES, s))
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk),
+        functools.partial(_layer_kernel, scale=scale, bk=bk),
         out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
         grid=(b, nkv),
         in_specs=[
@@ -120,5 +177,63 @@ def flash_decode_attention(
         out_specs=pl.BlockSpec((1, 1, gp, hd),
                                lambda bi, h: (bi, h, 0, 0)),
         interpret=interpret,
-    )(qg, kt, vt, keep_b)
+    )(qg, k_cache, v_cache, keep_b)
+    return out[:, :, :group, :].reshape(b, nq, hd)
+
+
+def flash_decode_attention_stacked(
+    q: jnp.ndarray,        # [B, nq, hd]
+    k_all: jnp.ndarray,    # [nl, B, nkv, S, hd] -- the FULL stacked cache
+    v_all: jnp.ndarray,
+    valid_mask: jnp.ndarray,  # [B, S] bool
+    layer_index: jnp.ndarray,  # scalar int32 (traced OK)
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    slot: Optional[jnp.ndarray] = None,
+    block_k: int = DEFAULT_BK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Same math as `flash_decode_attention` but reads layer
+    ``layer_index`` of the stacked cache directly via a scalar-prefetch
+    index map -- HBM traffic is exactly one layer's K/V rows, with no
+    per-layer slice copy. S must be a multiple of ``block_k`` (the
+    generation path allocates caches pre-padded; see
+    `transformer.init_kv_cache`)."""
+    b, nq, hd = q.shape
+    nl, _, nkv, s = k_all.shape[:4]
+    group = nq // nkv
+    scale = float(scale) if scale is not None else hd ** -0.5
+
+    bk = _pick_bk(s, block_k)
+    assert s % bk == 0, (
+        f"stacked decode cache length {s} must be a multiple of the "
+        f"K block {bk}; pad the cache at allocation time")
+
+    keep = _window_keep(valid_mask, sliding_window, slot)
+    gp = max(SUBLANES, group)
+    qg = _pad_group(q, nkv, group, gp)
+    keep_b = jnp.broadcast_to(keep[:, None, :], (b, SUBLANES, s))
+    lidx = jnp.asarray(layer_index, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, hd), lambda bi, h, lr: (bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s, hd),
+                         lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, s, hd),
+                         lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
+            pl.BlockSpec((1, SUBLANES, s), lambda bi, h, lr: (bi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, hd),
+                               lambda bi, h, lr: (bi, h, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_stacked_kernel, scale=scale, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(lidx, qg, k_all, v_all, keep_b)
     return out[:, :, :group, :].reshape(b, nq, hd)

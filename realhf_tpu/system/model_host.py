@@ -57,6 +57,12 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
     if params_override is None:
         cfg.gradient_checkpointing = spec.gradient_checkpointing
         cfg.compute_dtype = "bfloat16" if spec.bf16 else "float32"
+        if spec.bf16:
+            # bf16 weights everywhere (reference bf16 training mode);
+            # trainable engines keep an fp32 master copy inside the
+            # ZeRO-sharded optimizer state (engine/optim.py
+            # with_master_weights), frozen roles halve their footprint.
+            cfg.param_dtype = "bfloat16"
     if params is None:
         # Model init must be identical on every process of a worker
         # group (the collective device_put verifies value equality), so

@@ -1,0 +1,100 @@
+"""Check flash-decode kernel numerics on the REAL chip + split timings."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from realhf_tpu.ops.attention import decode_attention
+from realhf_tpu.ops.decode_attention import (
+    flash_decode_attention, flash_decode_attention_stacked,
+)
+
+print("backend:", jax.default_backend())
+
+# --- numerics of the kernels on the real chip ------------------------
+rng = np.random.default_rng(0)
+b, s, nq, nkv, hd = 4, 256, 16, 16, 128
+q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32).astype(jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32).astype(jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32).astype(jnp.bfloat16)
+valid = np.zeros((b, s), bool)
+valid[:, :200] = True
+valid = jnp.asarray(valid)
+
+# XLA reference path (no pallas):
+qg = q.reshape(b, nkv, 1, hd)
+scores = jnp.einsum("bhgd,bhkd->bhgk", qg, k,
+                    preferred_element_type=jnp.float32) * hd ** -0.5
+scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+probs = jax.nn.softmax(scores, axis=-1)
+ref = jnp.einsum("bhgk,bhkd->bhgd", probs.astype(v.dtype), v,
+                 preferred_element_type=jnp.float32).reshape(b, nq, hd)
+
+got = flash_decode_attention(q, k, v, valid)
+err = np.abs(np.asarray(got, np.float32) - np.asarray(ref, np.float32)).max()
+print("flash per-layer max err:", err)
+
+k_all = jnp.stack([k, k * 0.5, k * 2.0])
+v_all = jnp.stack([v, v * 0.5, v * 2.0])
+got1 = flash_decode_attention_stacked(q, k_all, v_all, valid,
+                                      jnp.asarray(1, jnp.int32))
+ref1 = flash_decode_attention(q, k_all[1], v_all[1], valid)
+err1 = np.abs(np.asarray(got1, np.float32) - np.asarray(ref1, np.float32)).max()
+print("stacked layer-1 max err:", err1)
+
+# --- split prefill vs decode timing on the 650M shape ----------------
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+
+cfg = TransformerConfig(
+    n_layers=10, n_kv_heads=16, n_q_heads=16, hidden_dim=2048,
+    intermediate_dim=5632, vocab_size=32000, n_positions=4096,
+    apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
+    use_attention_bias=False, use_attn_proj_bias=False,
+    use_mlp_bias=False, activation_function="silu",
+    param_dtype="bfloat16", compute_dtype="bfloat16")
+params = T.init_params(cfg, jax.random.PRNGKey(0))
+gen_bs, lp, gn = 64, 256, 256
+ids = jnp.asarray(rng.integers(2, cfg.vocab_size, (gen_bs, lp)), jnp.int32)
+seg = jnp.ones((gen_bs, lp), jnp.int32)
+
+prefill_j = jax.jit(lambda p, i, s: T.prefill(cfg, p, i, s,
+                                              total_len=lp + gn))
+h, cache = prefill_j(params, ids, seg)
+jax.block_until_ready(h)
+t0 = time.monotonic()
+for _ in range(3):
+    h, cache = prefill_j(params, ids, seg)
+    jax.block_until_ready(h)
+print(f"prefill: {(time.monotonic()-t0)/3*1000:.1f} ms")
+
+def decode_n(p, cache, tok):
+    def body(carry, t):
+        tok, cache = carry
+        pos = cache["length"]
+        x, cache = T.decode_step(cfg, p, cache, tok, pos, uniform_slot=True)
+        ntok = jnp.argmax(T.lm_logits(cfg, p, x), -1).astype(jnp.int32)
+        return (ntok, cache), ntok
+    (tok, cache), toks = jax.lax.scan(body, (tok, cache), jnp.arange(gn))
+    return toks
+
+decode_j = jax.jit(decode_n)
+tok0 = jnp.ones((gen_bs,), jnp.int32)
+toks = decode_j(params, cache, tok0)
+jax.block_until_ready(toks)
+t0 = time.monotonic()
+for _ in range(3):
+    toks = decode_j(params, cache, tok0)
+    jax.block_until_ready(toks)
+dt = (time.monotonic() - t0) / 3
+wbytes = gn * 2 * cfg.n_params()
+kvb = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2
+kv_read = sum(gen_bs * (lp + t) * kvb for t in range(gn))
+print(f"decode {gn} steps: {dt*1000:.1f} ms "
+      f"({dt/gn*1e6:.0f} us/step), "
+      f"weightbytes={wbytes/1e9:.1f}GB kvbytes={kv_read/1e9:.1f}GB "
+      f"roof={(wbytes+kv_read)/819e9*1000:.0f}ms")

@@ -122,3 +122,24 @@ def test_no_eos_flag_semantics():
         assert f.no_eos == (not ends_eos)
         saw_eos |= ends_eos
     assert saw_eos  # the construction guarantees at least one EOS end
+
+
+def test_unaligned_cache_len_with_clamped_bucket():
+    """cache_len not a multiple of 128 with a prompt whose bucket gets
+    clamped to max_prompt: the prefill row must still match the slot's
+    cache rows (regression: prefill's round_cache_len vs the raw
+    lp-based pad diverged and the scatter crashed)."""
+    rng = np.random.default_rng(5)
+    params = T.init_params(CFG, jax.random.PRNGKey(0))
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=50, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+    gen = InflightBatchingGenerator(
+        CFG, params, gconfig, n_slots=2, max_prompt_len=200,
+        eos_token_id=None, pad_token_id=0, chunk_size=8)
+    # 150 tokens buckets to 256 and is clamped to max_prompt
+    prompts = [rng.integers(2, CFG.vocab_size, size=150).astype(np.int32),
+               rng.integers(2, CFG.vocab_size, size=10).astype(np.int32)]
+    results = gen.generate_all(prompts, jax.random.PRNGKey(1))
+    assert len(results) == 2
+    assert all(len(r.tokens) > 0 for r in results)

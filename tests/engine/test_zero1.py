@@ -1,0 +1,139 @@
+"""ZeRO-1 optimizer-state sharding over the DP axis.
+
+Reference: Megatron DistributedOptimizer
+(realhf/impl/model/backend/megatron.py:823-940) and DeepSpeed
+zero_stage=1 (backend/deepspeed.py:445). Here the Adam moments carry
+the params' tp/pp PartitionSpecs PLUS the DATA axis on their largest
+free dim (models/sharding.py:opt_state_shardings), so per-device
+optimizer bytes shrink ~1/dp.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.api.config import ModelName
+from realhf_tpu.engine.engine import Engine
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.parallel.mesh import MeshContext, ParallelismConfig, make_mesh
+
+
+def cfg_():
+    return TransformerConfig(
+        n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+        intermediate_dim=64, vocab_size=64, apply_rotary=True,
+        layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+        use_attn_proj_bias=False, use_mlp_bias=False,
+        activation_function="silu", compute_dtype="float32")
+
+
+def make_engine(dp, tp, zero1, seed=0):
+    cfg = cfg_()
+    parallel = ParallelismConfig(data_parallel_size=dp,
+                                 tensor_parallel_size=tp)
+    ctx = MeshContext(ModelName("z1", 0), make_mesh(parallel), parallel)
+    params = T.init_params(cfg, jax.random.PRNGKey(seed))
+    opt = OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0,
+                          lr_scheduler_type="constant", zero1=zero1)
+    return cfg, Engine(cfg, ctx, params, optimizer=opt,
+                       total_train_steps=100)
+
+
+def _device_opt_bytes(opt_state) -> int:
+    """Bytes of optimizer state resident on device 0."""
+    total = 0
+    for leaf in jax.tree.leaves(opt_state):
+        if not hasattr(leaf, "sharding"):
+            continue
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        total += int(np.prod(shard)) * leaf.dtype.itemsize
+    return total
+
+
+@pytest.mark.parametrize("dp,tp", [(8, 1), (4, 2)])
+def test_moments_shard_over_dp(dp, tp):
+    _, engine = make_engine(dp, tp, zero1=True)
+    _, engine_rep = make_engine(dp, tp, zero1=False)
+    sharded = _device_opt_bytes(engine.opt_state)
+    replicated = _device_opt_bytes(engine_rep.opt_state)
+    # moments dominate the state; expect ~1/dp of the replicated bytes
+    assert sharded < replicated / (dp / 2), (sharded, replicated)
+
+
+def _loss_fn(cfg):
+    def f(p, mb):
+        h, _ = T.forward(cfg, p, mb["input_ids"], mb["seg_ids"])
+        logits = T.lm_logits(cfg, p, h)
+        tgt = jnp.roll(mb["input_ids"], -1, axis=1)
+        lp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+        mask = (mb["seg_ids"] != 0).astype(jnp.float32)
+        return (nll * mask).sum() / mask.sum(), {}
+    return f
+
+
+def test_zero1_numerics_match_replicated():
+    """ZeRO-1 is a memory layout, not a different optimizer: params
+    after N steps must match the replicated-state engine's."""
+    cfg, e1 = make_engine(4, 2, zero1=True)
+    _, e2 = make_engine(4, 2, zero1=False)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(2, 60, size=(8, 16)).astype(np.int32)
+    seg = np.ones_like(ids)
+    mb = dict(input_ids=ids, seg_ids=seg)
+    for _ in range(3):
+        s1 = e1.train_batch([mb, mb], _loss_fn(cfg), loss_fn_key="z1")
+        s2 = e2.train_batch([mb, mb], _loss_fn(cfg), loss_fn_key="z1")
+    np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(e1.params), jax.tree.leaves(e2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_heuristic_budget_admits_dp_with_zero1():
+    """A 7B-shaped trainable config on 16 v5e chips: the old 18 B /
+    param / (tp*pp) model admits NO tp*pp < 16 (t8 -> 15.75 GB >
+    budget); with bf16 weights + ZeRO-1 master/moments, t8 x d2 fits
+    (1.75 + 7 = 8.75 GB), buying a 2x-dp-cheaper layout."""
+    from realhf_tpu.experiments.heuristic import (
+        DEFAULT_HBM_BUDGET,
+        train_state_bytes_per_chip,
+    )
+    n_params = 7_000_000_000
+    old_model_t8 = n_params * 18 / 8  # moments replicated over dp
+    assert old_model_t8 > DEFAULT_HBM_BUDGET
+    new_model = train_state_bytes_per_chip(n_params, tp=8, pp=1, dp=2)
+    assert new_model <= DEFAULT_HBM_BUDGET
+
+
+def test_master_weights_bf16_params():
+    """bf16 param_dtype engines keep an fp32 master in the opt state
+    and still train (loss finite, params stay bf16)."""
+    cfg = cfg_()
+    cfg.param_dtype = "bfloat16"
+    cfg.compute_dtype = "bfloat16"
+    parallel = ParallelismConfig(data_parallel_size=4,
+                                 tensor_parallel_size=2)
+    ctx = MeshContext(ModelName("mw", 0), make_mesh(parallel), parallel)
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    opt = OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0,
+                          lr_scheduler_type="constant")
+    engine = Engine(cfg, ctx, params, optimizer=opt,
+                    total_train_steps=100)
+    from realhf_tpu.engine.optim import MasterWeightsState
+    assert isinstance(engine.opt_state, MasterWeightsState)
+    master_leaf = engine.opt_state.master["blocks"]["attn"]["wq"]
+    assert master_leaf.dtype == jnp.float32
+    # master shards over DP: device 0 holds < the full leaf
+    shard = master_leaf.sharding.shard_shape(master_leaf.shape)
+    assert int(np.prod(shard)) < master_leaf.size
+    rng = np.random.default_rng(0)
+    ids = rng.integers(2, 60, size=(8, 16)).astype(np.int32)
+    mb = dict(input_ids=ids, seg_ids=np.ones_like(ids))
+    stats = engine.train_batch([mb], _loss_fn(cfg), loss_fn_key="mw")
+    assert np.isfinite(stats["loss"])
+    assert engine.params["blocks"]["attn"]["wq"].dtype == jnp.bfloat16

@@ -8,13 +8,17 @@ import jax
 import jax.numpy as jnp
 
 from realhf_tpu.ops.attention import decode_attention
-from realhf_tpu.ops.decode_attention import flash_decode_attention
+from realhf_tpu.ops.decode_attention import (
+    flash_decode_attention,
+    flash_decode_attention_stacked,
+)
 
 
 def make_inputs(rng, b=4, s=96, nq=8, nkv=2, hd=128, n_valid=None):
+    # head-major cache layout [B, nkv, S, hd]
     q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((b, s, nkv, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
     valid = np.zeros((b, s), bool)
     lens = (n_valid if n_valid is not None
             else rng.integers(1, s + 1, size=b))
@@ -75,3 +79,50 @@ def test_empty_cache_rows_zero():
     ref = decode_attention(q, k, v, valid)
     np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("layer", [0, 2])
+def test_stacked_layer_index_matches_per_layer(layer):
+    """The scalar-prefetch stacked kernel must equal the per-layer
+    kernel run on the selected layer's rows."""
+    rng = np.random.default_rng(5)
+    nl, b, s, nq, nkv, hd = 3, 2, 64, 8, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    v_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    valid = np.zeros((b, s), bool)
+    valid[:, :40] = True
+    valid = jnp.asarray(valid)
+    ref = decode_attention(q, k_all[layer], v_all[layer], valid)
+    got = flash_decode_attention_stacked(
+        q, k_all, v_all, valid, jnp.asarray(layer, jnp.int32),
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_stacked_traced_layer_under_scan():
+    """The layer index may be a traced scan value (the deep-model
+    decode path)."""
+    rng = np.random.default_rng(6)
+    nl, b, s, nq, nkv, hd = 3, 2, 32, 8, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    v_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    valid = jnp.ones((b, s), bool)
+
+    def body(carry, li):
+        out = flash_decode_attention_stacked(q, k_all, v_all, valid, li,
+                                             interpret=True)
+        return carry, out
+
+    _, outs = jax.lax.scan(body, 0,
+                           jnp.arange(nl, dtype=jnp.int32))
+    for li in range(nl):
+        ref = decode_attention(q, k_all[li], v_all[li], valid)
+        np.testing.assert_allclose(np.asarray(outs[li]), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

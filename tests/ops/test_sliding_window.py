@@ -110,10 +110,10 @@ def test_decode_matches_prefill_last_token():
 
     ref = packed_attention_xla(q, k, v, seg, sliding_window=window)
 
-    s = l + 3  # padded cache
+    s = l + 3  # padded cache (head-major [B, nkv, S, hd])
     pad = jnp.zeros((b, s - l, nkv, hd), jnp.float32)
-    k_cache = jnp.concatenate([k, pad], axis=1)
-    v_cache = jnp.concatenate([v, pad], axis=1)
+    k_cache = jnp.concatenate([k, pad], axis=1).transpose(0, 2, 1, 3)
+    v_cache = jnp.concatenate([v, pad], axis=1).transpose(0, 2, 1, 3)
     valid = jnp.concatenate(
         [jnp.ones((b, l), bool), jnp.zeros((b, s - l), bool)], axis=1)
     slot = jnp.full((b,), l - 1, jnp.int32)  # the last written token
