@@ -95,13 +95,18 @@ def bench_ppo(on_tpu):
     from realhf_tpu.system.inline import InlineRunner
 
     if on_tpu:
+        # ~262M params/role: sized so all four roles (two trainable
+        # with bf16 weights + dp-sharded fp32 master/Adam, two frozen
+        # bf16) fill the chip -- per-call work large enough that MFU
+        # reflects capability, not dispatch overhead (round-3 verdict:
+        # the 191M/256-token config measured overhead).
         model_cfg = dict(
-            n_layers=6, n_kv_heads=5, n_q_heads=10, hidden_dim=1280,
+            n_layers=10, n_kv_heads=5, n_q_heads=10, hidden_dim=1280,
             intermediate_dim=3456, vocab_size=32000, n_positions=4096,
             apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
             use_attention_bias=False, use_attn_proj_bias=False,
             use_mlp_bias=False, activation_function="silu")
-        n_seqs, prompt_len, new_tokens = 64, 128, 128
+        n_seqs, prompt_len, new_tokens = 64, 256, 256
         steps, warmup = 3, 1
         peak_flops, hbm_bw = V5E_PEAK_FLOPS, V5E_HBM_BW
     else:
@@ -203,6 +208,8 @@ def bench_ppo(on_tpu):
 
     decode_roof_s = _decode_roofline_s(acfg, n_seqs, prompt_len,
                                        new_tokens, hbm_bw)
+    # Frozen roles and (since r4) trainable roles hold bf16 weights;
+    # the decode roofline already assumes bf16 streaming.
     prefill_ref_s = prefill_flops / (REF_MFU * peak_flops)
     gen_ref_s = prefill_ref_s + decode_roof_s / REF_DECODE_ROOFLINE
 
@@ -221,9 +228,11 @@ def bench_ppo(on_tpu):
         d = {"secs": round(secs, 4)}
         if name == "actor_gen":
             d["mfu"] = round(gen_flops / secs / peak_flops, 4)
+            # decode wall = phase wall minus prefill modeled at the
+            # reference MFU (advisor r3: modeling prefill at 100% MFU
+            # overstated the decode denominator)
             d["decode_roofline_frac"] = round(
-                decode_roof_s / max(secs - prefill_flops / peak_flops,
-                                    1e-9), 4)
+                decode_roof_s / max(secs - prefill_ref_s, 1e-9), 4)
         elif name.endswith("_train"):
             fl = train_flops if name.startswith("actor") else train_flops_c
             d["mfu"] = round(fl / secs / peak_flops, 4)
@@ -241,6 +250,11 @@ def bench_ppo(on_tpu):
     extra = {
         "ppo_step_time_s": round(step_time, 4),
         "ppo_baseline_model_step_s": round(baseline_step, 4),
+        # vs_baseline divides a MODELED reference-class step (40% MFU
+        # train/inference, 40%-of-roofline decode) by the measured
+        # step -- it is not a measured reference run (advisor r3).
+        "baseline_note": "modeled reference class (40% MFU phases, "
+                         "0.40-roofline decode), not a measured run",
         "ppo_n_seqs": n_seqs,
         "ppo_prompt_len": prompt_len,
         "ppo_new_tokens": new_tokens,
@@ -249,12 +263,15 @@ def bench_ppo(on_tpu):
     }
 
     # ---- reshard latency (north-star metric) ----------------------------
-    # Move the actor's live weights onto a second engine: the
-    # ReplicaManager path every decoupled-allocation PPO run uses
-    # (parallel/realloc.py). Single-chip: a device-to-device copy.
+    # Two flavors. (a) device path: move the actor's live weights onto
+    # a second engine via device_put (ReplicaManager same-process
+    # path). (b) cross-group host path: the r4 streamed param sync --
+    # chunked blobs through a REAL loopback ZMQ data-plane
+    # server/client, installed chunk-by-chunk (the protocol
+    # cross-group PPO runs use, system/model_worker.py).
     from realhf_tpu.api.config import ModelName
     from realhf_tpu.engine.engine import Engine
-    from realhf_tpu.parallel import realloc
+    from realhf_tpu.parallel import param_stream, realloc
     from realhf_tpu.parallel.mesh import MeshContext, make_mesh
 
     actor = runner.models["actor"]
@@ -272,6 +289,44 @@ def bench_ppo(on_tpu):
         for x in jax.tree.leaves(actor.engine.params))
     extra["reshard_latency_s"] = round(lat, 4)
     extra["reshard_gbytes_per_s"] = round(param_bytes / lat / 1e9, 2)
+
+    from realhf_tpu.base import name_resolve
+    from realhf_tpu.system.data_plane import (
+        DataClient,
+        DataServer,
+        DataStore,
+    )
+
+    name_resolve.reconfigure("memory")
+    store = DataStore()
+    server = DataServer("benchxg", "t0", "bench_worker", store)
+    server.start()
+    client = DataClient("benchxg", "t0")
+    try:
+        t0 = time.monotonic()
+        host_params = actor.engine.params_numpy()  # collective gather
+        flat = param_stream.flatten_params(host_params)
+        plan = param_stream.plan_chunks(flat)
+        for i, idxs in enumerate(plan):
+            store.put_blob(f"__params__/actor/v1/chunk{i}", 1,
+                           param_stream.chunk_payload(flat, idxs))
+
+        def fetch(i):
+            _, chunk = client.fetch_blob(
+                "bench_worker", f"__params__/actor/v1/chunk{i}", 1)
+            return chunk
+
+        _, nbytes = realloc.install_param_chunks(
+            actor.config, rep_engine, len(plan), fetch)
+        sync_s = time.monotonic() - t0
+        extra["cross_group_sync_s"] = round(sync_s, 4)
+        extra["cross_group_sync_gbytes_per_s"] = round(
+            nbytes / sync_s / 1e9, 2)
+        extra["cross_group_sync_chunks"] = len(plan)
+        extra["cross_group_sync_mbytes"] = round(nbytes / 1e6, 1)
+    finally:
+        client.close()
+        server.stop()
     return headline, extra
 
 

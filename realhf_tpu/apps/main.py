@@ -65,6 +65,14 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         raise ValueError(
             f"MFCAllocation.workers indices out of range for "
             f"n_model_workers={spec.n_model_workers}: {bad_alloc}")
+    mfc_names = {n.name for n in spec.mfcs}
+    unknown = sorted(set(spec.allocations) - mfc_names)
+    if unknown:
+        # a misspelled key would otherwise be silently ignored and the
+        # MFC would run on the role's primary layout (advisor r3)
+        raise ValueError(
+            f"allocations keys {unknown} name no MFC in the dataflow "
+            f"graph (have: {sorted(mfc_names)})")
     constants.set_experiment_trial_names(spec.experiment_name,
                                          spec.trial_name)
     path = _spec_path(spec)

@@ -52,7 +52,7 @@ def main(argv=None):
     spec = cfg.build()
     spec.n_model_workers = cfg.n_model_workers
     spec.worker_assignment = cfg.parsed_worker_assignment()
-    if cfg.allocation_mode in ("heuristic", "search"):
+    if cfg.allocation_mode in ("heuristic", "search", "search_profiled"):
         # default_devices respects REALHF_TPU_BACKEND and never probes
         # the default (TPU) backend from the launcher process -- TPU
         # init here could block and would hold the chip the spawned
@@ -76,7 +76,22 @@ def main(argv=None):
         else:
             # C++ MCMC search over (device slice x layout) assignments
             from realhf_tpu.search import apply_searched_allocations
-            res = apply_searched_allocations(spec, n)
+            cost_model = None
+            if cfg.allocation_mode == "search_profiled":
+                # measured calibration (reference estimate.py:323):
+                # runs timed probes on THIS process's default backend,
+                # so it is inline/local-mode only -- in distributed
+                # mode the launcher must not claim the workers' chips.
+                if cfg.mode == "distributed":
+                    raise ValueError(
+                        "allocation_mode=search_profiled probes the "
+                        "accelerator from the launcher and cannot be "
+                        "used with mode=distributed; run the profile "
+                        "inline or use allocation_mode=search.")
+                from realhf_tpu.search.engine import calibrate_cost_model
+                cost_model = calibrate_cost_model(spec)
+            res = apply_searched_allocations(spec, n,
+                                             cost_model=cost_model)
             logger.info("Search: best simulated step %.3fs", res.time)
             if (cfg.mode == "distributed" and not spec.worker_assignment
                     and cfg.n_model_workers == 1

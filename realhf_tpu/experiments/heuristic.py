@@ -91,12 +91,31 @@ def train_state_bytes_per_chip(n_params: int, tp: int, pp: int,
     return n_params * (2.0 + 16.0 / max(dp, 1)) / (tp * pp)
 
 
+def pipeline_activation_bytes(hidden_dim: int, tokens_per_dp_rank: float,
+                              n_stages: int,
+                              n_microbatches: Optional[int] = None
+                              ) -> float:
+    """Resident pipeline activation bytes per stage under tick-level
+    remat (transformer cfg pipeline_remat="tick"): the scan saves each
+    tick's boundary activation (input carry + stacked output), bf16,
+    for T = M + S - 1 ticks of one microbatch's tokens each --
+    depth-independent (parallel/pipeline.py remat_tick; reference 1F1B
+    keeps <= S microbatch sets, static_schedule.py:319)."""
+    m = n_microbatches or 2 * n_stages
+    t = m + n_stages - 1
+    return 2.0 * t * (tokens_per_dp_rank / m) * hidden_dim * 2.0
+
+
 def choose_layout(cfg: TransformerConfig, n_devices: int,
                   interface_type: ModelInterfaceType,
                   trainable: bool,
-                  hbm_budget: int = DEFAULT_HBM_BUDGET
+                  hbm_budget: int = DEFAULT_HBM_BUDGET,
+                  tokens_per_batch: Optional[float] = None
                   ) -> ParallelismConfig:
-    """One MFC's layout on ``n_devices`` chips."""
+    """One MFC's layout on ``n_devices`` chips. ``tokens_per_batch``
+    (train batch seqs x seqlen, when known) lets the trainable fit
+    check budget pipeline activations instead of weights-only (a pp
+    allocation that ignores them can OOM on real shapes)."""
     n_params = cfg.n_params()
 
     if trainable:
@@ -104,8 +123,11 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
         # fit check must use the dp each (tp, pp) candidate implies.
         def fits(tp, pp):
             dp = max(1, n_devices // (tp * pp))
-            return train_state_bytes_per_chip(
-                n_params, tp, pp, dp) <= hbm_budget
+            need = train_state_bytes_per_chip(n_params, tp, pp, dp)
+            if pp > 1 and tokens_per_batch is not None:
+                need += pipeline_activation_bytes(
+                    cfg.hidden_dim, tokens_per_batch / dp, pp)
+            return need <= hbm_budget
 
         tp = next((t for t in _pow2_up_to(n_devices) if fits(t, 1)),
                   n_devices)
@@ -122,6 +144,9 @@ def choose_layout(cfg: TransformerConfig, n_devices: int,
                 pp //= 2
         dp = max(1, n_devices // (tp * pp))
         per_chip = train_state_bytes_per_chip(n_params, tp, pp, dp)
+        if pp > 1 and tokens_per_batch is not None:
+            per_chip += pipeline_activation_bytes(
+                cfg.hidden_dim, tokens_per_batch / dp, pp)
         if per_chip > hbm_budget:
             logger.warning(
                 "Heuristic layout t%dp%d leaves %.1f GB/chip for a "
@@ -181,12 +206,27 @@ def heuristic_allocations(
         n.role for n in spec.mfcs
         if n.interface_type == ModelInterfaceType.TRAIN_STEP}
 
+    # token estimate per train batch for the pipeline-activation
+    # budget: dataset max_length bounds the PROMPT; RLHF train MFCs
+    # consume prompt + generated tokens, so add the largest
+    # max_new_tokens any generate MFC is configured with.
+    max_len = (spec.dataset.args or {}).get("max_length") \
+        if getattr(spec, "dataset", None) is not None else None
+    gen_extra = 0
+    for n in spec.mfcs:
+        g = (n.interface_impl.args or {}).get("gconfig")
+        if isinstance(g, dict):
+            gen_extra = max(gen_extra, int(g.get("max_new_tokens", 0)))
+    seq_est = (max_len + gen_extra) if max_len else None
+
     mfc_layouts: Dict[str, ParallelismConfig] = {}
     for node in spec.mfcs:
         trainable = (node.interface_type == ModelInterfaceType.TRAIN_STEP)
+        tokens = (node.n_seqs * seq_est
+                  if trainable and seq_est else None)
         mfc_layouts[node.name] = choose_layout(
             cfgs[node.role], n_devices, node.interface_type,
-            trainable, hbm_budget)
+            trainable, hbm_budget, tokens_per_batch=tokens)
 
     primaries: Dict[str, ParallelismConfig] = {}
     for role in spec.models:

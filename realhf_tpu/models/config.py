@@ -91,6 +91,12 @@ class TransformerConfig:
     # "dots_with_no_batch_dims_saveable" keeps matmul outputs (more
     # HBM, measurably faster when the model fits).
     remat_policy: str = "nothing_saveable"
+    # Pipeline-parallel remat granularity when gradient_checkpointing:
+    # "tick" rematerializes each whole stage-slab evaluation, making
+    # resident pipeline activations depth-independent (the 1F1B-class
+    # memory profile; reference TrainSchedule static_schedule.py:319);
+    # "block" keeps the per-block checkpoint of the non-pipeline path.
+    pipeline_remat: str = "tick"
 
     def __post_init__(self):
         if self.head_dim is None:

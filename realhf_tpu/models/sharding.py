@@ -142,6 +142,26 @@ def unpad_vocab(cfg: TransformerConfig, params: Dict[str, Any]
     return params
 
 
+def repad_vocab_leaf(cfg: TransformerConfig, path, arr, target_tp: int):
+    """Per-LEAF form of unpad_vocab+pad_vocab for streamed installs
+    (parallel/realloc.py:install_param_chunks): the single place the
+    which-leaves-carry-vocab rule lives, congruent with the tree forms
+    above. ``path`` is the leaf's key tuple, e.g. ("embed", "wte")."""
+    import numpy as np
+    vp = padded_vocab_size(cfg, target_tp)
+    v = cfg.vocab_size
+    if path == ("embed", "wte"):
+        arr = arr[:v]
+        if vp != v:
+            arr = np.pad(arr, [(0, vp - v)] + [(0, 0)] * (arr.ndim - 1))
+    elif (path == ("head", "w") and not cfg.is_critic
+            and not cfg.tied_embedding):
+        arr = arr[:, :v]
+        if vp != v:
+            arr = np.pad(arr, [(0, 0), (0, vp - v)])
+    return arr
+
+
 def param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, Any]:
     pp = mesh.shape.get(PIPE_AXIS, 1)
     return jax.tree.map(lambda s: NamedSharding(mesh, s),

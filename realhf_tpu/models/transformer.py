@@ -322,6 +322,14 @@ def forward(
                                moe_constraint)
             return y, aux
 
+        # Nested remat for the 1F1B-class memory profile: each block
+        # checkpoints its internals AND (pipeline_remat="tick") each
+        # tick's whole slab evaluation checkpoints again, so the tick
+        # scan's resident residuals are single boundary activations
+        # while a tick's backward recompute holds only per-block
+        # inputs transiently.
+        remat_tick = (cfg.gradient_checkpointing
+                      and cfg.pipeline_remat == "tick")
         if cfg.gradient_checkpointing:
             pblock = jax.checkpoint(
                 pblock,
@@ -337,7 +345,8 @@ def forward(
 
         x, aux = pipeline_blocks(
             pipeline, params["blocks"], cfg.n_layers, x, seg_ids, cos,
-            sin, block_step, return_aux=return_aux)
+            sin, block_step, return_aux=return_aux,
+            remat_tick=remat_tick)
         x = _norm(cfg, x, params["ln_f"]["scale"],
                   params["ln_f"].get("bias"))
         if return_aux:
@@ -495,14 +504,20 @@ def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
                               scale, sliding_window, slot):
     """Decode attention against the FULL stacked cache at a traced
     layer index. TPU: scalar-prefetch Pallas kernel (streams exactly
-    one layer's rows from HBM, no slice copy). Elsewhere: slice the
-    layer out and run the XLA path (CPU tests only)."""
+    one layer's rows from HBM, no slice copy). A traced scale (deep
+    scale_attn_by_inverse_layer_idx models) pre-multiplies q so the
+    kernel still runs with a static scale -- falling back to slicing
+    the layer out would re-materialize a full layer-cache copy per
+    token, the very bottleneck this kernel removes. The XLA slice
+    path remains for CPU tests only."""
     hd = q.shape[-1]
-    if (jax.default_backend() == "tpu" and hd >= 64
-            and (scale is None or isinstance(scale, (int, float)))):
+    if jax.default_backend() == "tpu" and hd >= 64:
         from realhf_tpu.ops.decode_attention import (
             flash_decode_attention_stacked,
         )
+        if not (scale is None or isinstance(scale, (int, float))):
+            q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+            scale = 1.0
         return flash_decode_attention_stacked(
             q, k_all, v_all, valid, layer_idx, scale=scale,
             sliding_window=sliding_window, slot=slot)

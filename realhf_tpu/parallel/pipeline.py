@@ -84,6 +84,7 @@ def pipeline_blocks(
     sin: jnp.ndarray,               # [B, L, hd/2]
     block_step: BlockStep,
     return_aux: bool = False,
+    remat_tick: bool = False,
 ):
     """Run the block stack as a pipeline; returns (hidden, aux).
 
@@ -91,10 +92,24 @@ def pipeline_blocks(
     models/sharding.param_pspecs with pipeline=True); x/seg/cos/sin are
     pipe-replicated. Streams are padded to a multiple of
     ``n_microbatches`` internally.
+
+    ``remat_tick``: rematerialize each TICK (the whole per-stage layer
+    slab) in backward instead of each block. The scan's saved
+    residuals then shrink from O(T * layers_per_stage) microbatch
+    activations to O(T) single tick boundaries -- depth-INDEPENDENT
+    resident memory, the 1F1B-class profile (reference TrainSchedule
+    keeps <= S in-flight microbatch activation sets,
+    static_schedule.py:319; with M ~ 2S this holds ~3S tick tensors).
+    Cost: one extra forward of the slab per tick during backward, the
+    same recompute block-level remat already pays.
     """
     S, M = pipe.n_stages, pipe.n_microbatches
     assert n_layers % S == 0, (n_layers, S)
     per_stage = n_layers // S
+    if remat_tick:
+        block_step = jax.checkpoint(
+            block_step, policy=jax.checkpoint_policies.nothing_saveable,
+            prevent_cse=False)
 
     (x, seg_ids, cos, sin), b_orig = pad_streams(
         [x, seg_ids, cos, sin], M)

@@ -400,6 +400,125 @@ def simulate_named_assignment(
         pick.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))))
 
 
+def calibrate_cost_model(
+    spec,
+    base: Optional[TPUCostModel] = None,
+    probe_seqs: int = 4,
+    probe_len: int = 512,
+    probe_gen_tokens: int = 32,
+    probe_layers: int = 4,
+) -> TPUCostModel:
+    """Measure-and-fit the cost model on the CURRENT backend
+    (reference profiler-driven cost model,
+    realhf/search_engine/estimate.py:323 + layers.py:56: per-layer
+    fwd/bwd/opt timings feed the estimator; analytic rooflines rank
+    candidates fine but mis-price realloc-vs-colocate trade-offs).
+
+    For each distinct role architecture, a depth-truncated probe model
+    (same hidden/ffn/vocab shapes, ``probe_layers`` layers -- per-layer
+    cost is depth-linear, so achieved efficiency transfers) runs one
+    timed train step and one timed decode on a single device. The
+    returned model replaces ``mxu_efficiency`` with the measured
+    train-step MFU and scales ``hbm_bandwidth`` by the measured decode
+    bandwidth fraction."""
+    import time
+
+    import jax
+
+    from realhf_tpu.api.config import ModelName
+    from realhf_tpu.base import monitor
+    from realhf_tpu.engine.engine import Engine
+    from realhf_tpu.engine.optim import OptimizerConfig
+    from realhf_tpu.experiments.heuristic import _model_config_of
+    from realhf_tpu.models import transformer as T
+    from realhf_tpu.ops import functional as F
+    from realhf_tpu.parallel.mesh import MeshContext, make_mesh
+
+    cm = dataclasses.replace(base or TPUCostModel())
+    mfus: List[float] = []
+    bw_fracs: List[float] = []
+    seen = set()
+    for role, mspec in spec.models.items():
+        cfg = _model_config_of(mspec)
+        key = (cfg.hidden_dim, cfg.intermediate_dim, cfg.n_q_heads,
+               cfg.n_kv_heads, cfg.vocab_size, cfg.mlp_type)
+        if key in seen:
+            continue
+        seen.add(key)
+        probe = dataclasses.replace(
+            cfg, n_layers=min(probe_layers, cfg.n_layers),
+            is_critic=False, gradient_checkpointing=True)
+        parallel = ParallelismConfig()
+        mesh = make_mesh(parallel, devices=jax.devices()[:1])
+        ctx = MeshContext(ModelName(f"probe_{role}", 0), mesh, parallel)
+        params = T.init_params(probe, jax.random.PRNGKey(0))
+        engine = Engine(probe, ctx, params,
+                        optimizer=OptimizerConfig(
+                            lr=1e-5, warmup_steps_proportion=0.0,
+                            lr_scheduler_type="constant"),
+                        total_train_steps=100)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(2, probe.vocab_size,
+                           size=(probe_seqs, probe_len)).astype(np.int32)
+        seg = np.ones_like(ids)
+        mb = dict(input_ids=ids, seg_ids=seg)
+
+        def loss_fn(p, mb):
+            h, _ = T.forward(probe, p, mb["input_ids"], mb["seg_ids"])
+            lp = F.shifted_logprobs_from_hidden(
+                probe, p, h, mb["input_ids"], mb["seg_ids"])
+            return -lp.mean(), {}
+
+        engine.train_batch([mb], loss_fn, loss_fn_key="calib")  # compile
+        t0 = time.monotonic()
+        engine.train_batch([mb], loss_fn, loss_fn_key="calib")
+        train_s = time.monotonic() - t0
+        flops = 4 * monitor.transformer_forward_flops(  # remat: 4x fwd
+            n_layers=probe.n_layers, hidden_dim=probe.hidden_dim,
+            n_q_heads=probe.n_q_heads, n_kv_heads=probe.n_kv_heads,
+            head_dim=probe.head_dim,
+            intermediate_dim=probe.intermediate_dim,
+            vocab_size=probe.vocab_size,
+            seqlens=[probe_len] * probe_seqs)
+        mfus.append(flops / train_s / cm.peak_flops)
+
+        from realhf_tpu.ops.sampling import GenerationHyperparameters
+        from realhf_tpu.engine import packing
+        g = GenerationHyperparameters(
+            max_new_tokens=probe_gen_tokens,
+            min_new_tokens=probe_gen_tokens, greedy=True,
+            force_no_logits_mask=True)
+        prompts = [ids[i, :64] for i in range(probe_seqs)]
+        pids, pseg, ppos = packing.left_padded_prompts(prompts, pad_id=0)
+        out = engine.generate(pids, pseg, ppos, jax.random.PRNGKey(0),
+                              g, eos_token_id=None, pad_token_id=0)
+        jax.block_until_ready(out.tokens)  # compile
+        t0 = time.monotonic()
+        out = engine.generate(pids, pseg, ppos, jax.random.PRNGKey(1),
+                              g, eos_token_id=None, pad_token_id=0)
+        jax.block_until_ready(out.tokens)
+        gen_s = time.monotonic() - t0
+        pbytes = probe.n_params() * jnp_dtype_size(probe.param_dtype)
+        decode_bytes = probe_gen_tokens * pbytes
+        bw_fracs.append(decode_bytes / gen_s / cm.hbm_bandwidth)
+
+    if mfus:
+        cm.mxu_efficiency = float(np.clip(np.median(mfus), 0.01, 1.0))
+    if bw_fracs:
+        cm.hbm_bandwidth *= float(np.clip(np.median(bw_fracs), 0.01, 1.0))
+    logger.info(
+        "Calibrated cost model: mxu_efficiency=%.3f (measured MFUs %s), "
+        "effective HBM bw %.0f GB/s (fracs %s)", cm.mxu_efficiency,
+        [round(m, 3) for m in mfus], cm.hbm_bandwidth / 1e9,
+        [round(b, 3) for b in bw_fracs])
+    return cm
+
+
+def jnp_dtype_size(dtype_name: str) -> int:
+    import jax.numpy as jnp
+    return jnp.dtype(dtype_name).itemsize
+
+
 def workloads_from_spec(spec, gen_tokens: int = 256,
                         avg_seqlen: int = 512) -> Tuple[
                             List[MFCWorkload], Dict[str, List[str]]]:

@@ -63,6 +63,16 @@ class DataStore:
                 return cur
             return (cur[0] if cur is not None else -1, None)
 
+    def gc_blobs(self, prefix: str, keep_versions):
+        """Drop blobs under ``prefix`` whose version is not in
+        ``keep_versions`` (bounds sender memory to the retained
+        chunk-set generations)."""
+        keep = set(keep_versions)
+        with self._lock:
+            for name in [n for n in self._blobs if n.startswith(prefix)]:
+                if self._blobs[name][0] not in keep:
+                    del self._blobs[name]
+
     def put(self, sample: SequenceSample):
         """Merge a (possibly multi-sequence) sample into the store."""
         for piece in sample.unpack():

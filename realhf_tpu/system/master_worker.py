@@ -142,6 +142,7 @@ class MasterWorker(worker_base.Worker):
         self._role_version: Dict[str, int] = {
             role: 0 for role in self.train_nodes_of_role}
         self._last_synced: Dict[str, int] = {}
+        self._sync_nonce = 0
         return "master-configured"
 
     # ------------------------------------------------------------------
@@ -213,8 +214,13 @@ class MasterWorker(worker_base.Worker):
             for w, r in zip(senders, rids):
                 self._inflight[r] = (None, None, w, "sync")
             self._last_synced[role] = version
+        # nonce: unique per dispatch -- the exec group's members agree
+        # on ONE exact installed version under this key (a stale key
+        # from an earlier dispatch must never leak into a later one).
+        self._sync_nonce += 1
         return dict(role=role, version=version,
-                    src=self.role_workers[role][0], eta=eta)
+                    src=self.role_workers[role][0], eta=eta,
+                    nonce=self._sync_nonce)
 
     def _dispatch_fetch(self):
         rid = self.stream.request(
@@ -301,11 +307,13 @@ class MasterWorker(worker_base.Worker):
                 f"{r['proc_peak_hbm_bytes'] / 2 ** 30:>9.2f}G "
                 f"[{r['start'] - t0:+.3f}s..{r['end'] - t0:+.3f}s]")
         logger.info("\n".join(lines))
-        # keep only live batches in the working log (rows were already
-        # copied to the bounded history when their replies arrived)
+        # keep rows of every batch except the one just logged: with
+        # off-policy overlap an EARLIER batch can still be live when a
+        # later one finishes, and pruning `> bid` would silently drop
+        # its table (advisor r3)
         self._exec_log = [r for r in self._exec_log
                           if r.get("bid") is not None
-                          and r["bid"] > bid]
+                          and r["bid"] != bid]
 
     def _maybe_save_eval(self, entry, force=False):
         train_nodes = [m for ms in self.train_nodes_of_role.values()

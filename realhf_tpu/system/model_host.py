@@ -262,6 +262,29 @@ class ModelHost:
         logger.info("Installed params v%d on %s in %.3fs.", version,
                     node_name, dt)
 
+    def install_node_params_streamed(self, node_name: str, n_chunks: int,
+                                     fetch_chunk, version: int,
+                                     eta: float = 1.0):
+        """Receiver side, streamed: chunks land on the replica's mesh
+        one at a time (parallel/realloc.py:install_param_chunks), so
+        peak host memory is one chunk."""
+        from realhf_tpu.parallel.realloc import install_param_chunks
+        model = self.replicas[node_name]
+        model.engine.ensure_on_device()
+        dt, nbytes = install_param_chunks(model.config, model.engine,
+                                          n_chunks, fetch_chunk, eta=eta)
+        self.replica_mgr.last_reshard_secs = dt
+        self.node_param_version[node_name] = version
+        logger.info("Streamed params v%d onto %s: %d chunks, %.1f MB "
+                    "in %.3fs (%.2f GB/s).", version, node_name,
+                    n_chunks, nbytes / 1e6, dt,
+                    nbytes / max(dt, 1e-9) / 1e9)
+
+    def role_version(self, role: str) -> int:
+        """The primary engine's train-step count (the version label
+        stamped on outgoing param-sync streams)."""
+        return self.models[role].version.global_step
+
     def node_version(self, node_name: str) -> int:
         return self.node_param_version.get(node_name, 0)
 

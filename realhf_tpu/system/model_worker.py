@@ -181,6 +181,10 @@ class ModelWorker(worker_base.Worker):
                               leader_of_role=self.leader_of_role,
                               cross_group_nodes=self.cross_group_nodes)
 
+        # role -> engine version of the last published sync stream;
+        # role -> retained published versions (GC window)
+        self._last_published_sync: Dict[str, int] = {}
+        self._published_versions: Dict[str, list] = {}
         # data plane: store + threaded server + peer-fetch client
         self.store = DataStore()
         self.data_server = DataServer(spec.experiment_name,
@@ -304,12 +308,8 @@ class ModelWorker(worker_base.Worker):
         if ps and self.host.node_version(node_name) < ps["version"]:
             # Cross-group parameter sync, receiver side: the primary's
             # group was dispatched a param_sync_send alongside this
-            # request; fetch (polling until published) and install.
-            version, host_params = self.data_client.fetch_blob(
-                ps["src"], f"__params__/{ps['role']}", ps["version"])
-            self.host.install_node_params(node_name, host_params,
-                                          version,
-                                          eta=ps.get("eta", 1.0))
+            # request; fetch the streamed chunk set and install.
+            self._receive_param_sync(node_name, ps)
         keys = [k for k in node.input_keys]
         inp = self._assemble_input(d["ids"], keys, d.get("fetch_plan", {}))
         out = self.host.execute(node_name, inp)
@@ -340,17 +340,100 @@ class ModelWorker(worker_base.Worker):
         """Sender side of the cross-group parameter sync: gather the
         role's primary weights to host (COLLECTIVE over the primary
         group -- the master dispatched this to every member) and
-        publish them on the data plane for the exec group to fetch
-        (reference param_realloc sender steps,
-        comm/param_realloc.py:279)."""
+        publish them as a version-qualified CHUNK STREAM on the data
+        plane (reference param_realloc sender steps,
+        comm/param_realloc.py:279,312: per-shard sends, one sender per
+        node -- here per-chunk blobs, one publisher per group).
+
+        The blobs are stamped with the sender's OWN train version at
+        gather time (not the master's dispatch-time capture): with
+        off-policyness > 0 a later train step may have run before this
+        gather, and the label must name the weights actually shipped.
+        The previous version's chunk set is retained so a receiver
+        group mid-install never has its agreed version overwritten."""
+        from realhf_tpu.parallel import param_stream
+
         role = req.data["role"]
-        version = int(req.data["version"])
         assert role in self.sync_send_roles, (role, self.sync_send_roles)
+        actual = self.host.role_version(role)
+        if self._last_published_sync.get(role) == actual:
+            # identical weights already streamed: dedupe the collective
+            # gather (decision uses only process-local state, so every
+            # member of a multi-process sender group agrees).
+            self.stream.respond(req, data=dict(published=actual))
+            return
         host_params = self.host.gather_role_params(role)
         if self.leader_of_role.get(role, True):
-            self.store.put_blob(f"__params__/{role}", version,
-                                host_params)
-        self.stream.respond(req, data=dict(published=version))
+            flat = param_stream.flatten_params(host_params)
+            plan = param_stream.plan_chunks(flat)
+            prefix = f"__params__/{role}/"
+            for i, idxs in enumerate(plan):
+                self.store.put_blob(
+                    f"{prefix}v{actual}/chunk{i}", actual,
+                    param_stream.chunk_payload(flat, idxs))
+            self.store.put_blob(f"{prefix}v{actual}/manifest", actual,
+                                param_stream.build_manifest(flat, plan))
+            self.store.put_blob(f"{prefix}latest", actual, actual)
+            # Retention window: a receiver may still be mid-install on
+            # a version up to max_head_offpolicyness dispatches behind
+            # the newest publish; keep that many generations so its
+            # agreed chunk set never vanishes under it.
+            window = getattr(self.spec, "max_head_offpolicyness", 0) + 2
+            hist = self._published_versions.setdefault(role, [])
+            if actual not in hist:
+                hist.append(actual)
+            del hist[:-window]
+            self.store.gc_blobs(prefix + "v", set(hist))
+        self._last_published_sync[role] = actual
+        self.stream.respond(req, data=dict(published=actual))
+
+    def _receive_param_sync(self, node_name: str, ps: Dict):
+        """Receiver side: agree on ONE exact version for the whole
+        exec group (the leader picks the sender's latest >= the
+        master's floor and publishes "nonce:version" under ONE
+        per-node name_resolve key -- reused every dispatch so the
+        store stays bounded; members poll until the nonce matches
+        their dispatch), then stream the chunks and install
+        incrementally."""
+        import time as _time
+
+        role, src = ps["role"], ps["src"]
+        agree_key = (names.trial_root(constants.experiment_name(),
+                                      constants.trial_name())
+                     + f"/param_install/{node_name}")
+        if node_name in self.leader_nodes:
+            version, _ = self.data_client.fetch_blob(
+                src, f"__params__/{role}/latest", ps["version"])
+            name_resolve.add(agree_key, f"{ps['nonce']}:{version}",
+                             replace=True)
+        else:
+            deadline = _time.monotonic() + 300
+            while True:
+                try:
+                    nonce_s, ver_s = name_resolve.get(agree_key).split(
+                        ":", 1)
+                    if int(nonce_s) == ps["nonce"]:
+                        version = int(ver_s)
+                        break
+                except name_resolve.NameEntryNotFoundError:
+                    pass
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"param_install agreement for {node_name} "
+                        f"nonce {ps['nonce']} not published in 300s.")
+                _time.sleep(0.05)
+        prefix = f"__params__/{role}/v{version}"
+        _, manifest = self.data_client.fetch_blob(
+            src, f"{prefix}/manifest", version)
+
+        def fetch_chunk(i):
+            _, chunk = self.data_client.fetch_blob(
+                src, f"{prefix}/chunk{i}", version)
+            return chunk
+
+        self.host.install_node_params_streamed(
+            node_name, manifest["n_chunks"], fetch_chunk, version,
+            eta=ps.get("eta", 1.0))
 
     def _handle_save(self, req: Payload):
         saved = {}

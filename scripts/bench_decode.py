@@ -10,7 +10,7 @@ import numpy as np
 
 import jax
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
 V5E_PEAK_FLOPS = 197e12
 V5E_HBM_BW = 819e9

@@ -146,3 +146,31 @@ def test_pipeline_candidates_enumerated_with_bubble_cost():
         fwd_flops=1e15, param_bytes=14e9, gen_tokens=256, n_layers=80)
     assert all(c.parallel.pipeline_parallel_size == 1
                for c in enumerate_candidates(gen, 128, cm))
+
+
+def test_calibrate_cost_model_probes_measured_efficiency():
+    """calibrate_cost_model times real probe models on the current
+    backend and folds the measured MFU / decode bandwidth into the
+    model (reference profiled cost model, estimate.py:323)."""
+    from realhf_tpu.search.engine import TPUCostModel, calibrate_cost_model
+    from realhf_tpu.experiments.sft_exp import SFTConfig
+    from realhf_tpu.experiments.common import apply_overrides
+
+    cfg = SFTConfig(experiment_name="calib", trial_name="t0")
+    spec = cfg.build()
+    spec.models["default"].path = None
+    spec.models["default"].random_init_config = dict(
+        n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+        intermediate_dim=64, vocab_size=128, apply_rotary=True,
+        layer_norm_type="rms", mlp_type="llama",
+        use_attention_bias=False, use_attn_proj_bias=False,
+        use_mlp_bias=False, activation_function="silu",
+        compute_dtype="float32")
+    base = TPUCostModel(peak_flops=1e12, hbm_bandwidth=100e9)
+    cm = calibrate_cost_model(spec, base=base, probe_seqs=2,
+                              probe_len=32, probe_gen_tokens=4)
+    # measured values replaced the defaults and are sane fractions
+    assert 0.0 < cm.mxu_efficiency <= 1.0
+    assert cm.mxu_efficiency != base.mxu_efficiency or \
+        cm.hbm_bandwidth != base.hbm_bandwidth
+    assert 0.0 < cm.hbm_bandwidth <= base.hbm_bandwidth

@@ -117,11 +117,15 @@ def test_cross_group_actor_gen(prompt_data):
                               for r in train_rows)
 
     # Weights flow EVERY step: the replica's installed version
-    # advances with each batch (actor trained once per batch)
+    # advances with each batch (actor trained once per batch). The
+    # master's dispatch version is a FLOOR: the stream is stamped with
+    # the sender's version at gather time, so a train step racing
+    # ahead can legitimately deliver a fresher version.
     versions = {r["bid"]: r["param_version"]
                 for r in gen_rows if "param_version" in r}
     assert versions[0] == 0  # first rollout uses the shared init
-    assert versions[1] == 1 and versions[2] == 2, versions
+    assert versions[1] >= 1 and versions[2] >= 2, versions
+    assert versions[1] <= versions[2] <= 3, versions
 
     # Wall-clock overlap: generation of a later batch on worker 1 ran
     # CONCURRENTLY with critic-side compute of the previous batch on
