@@ -356,6 +356,10 @@ def bench_sft(on_tpu):
             apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
             use_attention_bias=False, use_attn_proj_bias=False,
             use_mlp_bias=False, activation_function="silu",
+            # bf16 weights (fp32 master lives in the ZeRO-sharded opt
+            # state): the decode roofline assumes bf16 streaming, and
+            # fp32 weights would halve the achievable fraction
+            param_dtype="bfloat16",
             compute_dtype="bfloat16", gradient_checkpointing=True)
         n_streams, stream_len = 8, 1024
         peak_flops = V5E_PEAK_FLOPS

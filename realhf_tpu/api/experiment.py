@@ -31,6 +31,12 @@ class ModelSpec:
         default_factory=ParallelismConfig)
     gradient_checkpointing: bool = True
     bf16: bool = True
+    # Set by the RECOVERY path when `path` was redirected to a recover
+    # checkpoint: restore saved Adam moments/master alongside the
+    # weights. Never set for ordinary warm-starts from a checkpoint
+    # dir -- a new run must begin with fresh optimizer state even if
+    # the dir carries an optimizer_state.npz.
+    restore_optimizer_state: bool = False
 
 
 @dataclasses.dataclass

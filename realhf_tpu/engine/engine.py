@@ -154,6 +154,14 @@ class Engine:
                 cfg.moe.num_experts // cfg.moe.top_k, cfg.moe.top_k)
 
         self.optimizer_config = optimizer
+        if (optimizer is not None and optimizer.offload
+                and self._multiproc):
+            raise ValueError(
+                "OptimizerConfig.offload moves the state to this "
+                "process's CPU device and cannot be used on a mesh "
+                "spanning multiple processes (shards on other hosts "
+                "are not addressable here); disable offload or use a "
+                "single-process group for this role.")
         if optimizer is not None and optimizer.type != "empty":
             # Mixed precision: non-fp32 params train against an fp32
             # master copy held INSIDE the optimizer state (reference
@@ -323,6 +331,12 @@ class Engine:
         """
         if self._tx is None:
             raise RuntimeError("Engine has no optimizer (inference-only).")
+        if getattr(self, "_opt_offloaded", False):
+            # optimizer offload (reference DeepSpeed zero-offload,
+            # deepspeed.py:445): state lives on host between steps
+            self.opt_state = jax.device_put(self.opt_state,
+                                            self._opt_shardings)
+            self._opt_offloaded = False
         key = loss_fn_key or loss_fn
         if key not in self._train_step_cache:
             self._train_step_cache[key] = self._build_train_step(loss_fn)
@@ -340,6 +354,12 @@ class Engine:
         self.params, self.opt_state, loss, stats, gnorm = step(
             self.params, self.opt_state, stacked, weights)
         self.version += 1
+        if (self.optimizer_config is not None
+                and self.optimizer_config.offload):
+            cpu = jax.devices("cpu")[0]
+            self.opt_state = jax.device_put(self.opt_state, cpu)
+            jax.block_until_ready(self.opt_state)
+            self._opt_offloaded = True
         # ONE batched host fetch for all scalar stats: converting each
         # scalar with float() would issue a separate blocking D2H
         # round trip, which dominates step time on remote-attached
@@ -476,6 +496,32 @@ class Engine:
             params = jax.tree.map(gather_leaf, params)
         return shard_rules.unpad_vocab(
             self.cfg, jax.tree.map(np.asarray, params))
+
+    def opt_state_numpy(self) -> list:
+        """Host copy of the optimizer-state leaves (tree order).
+        COLLECTIVE on a multi-process mesh (same discipline as
+        params_numpy: leaf-by-leaf replicating gathers)."""
+        assert self.opt_state is not None
+        leaves = jax.tree.leaves(self.opt_state)
+        if self._multiproc:
+            if self._gather_jit is None:
+                rep = jax.sharding.NamedSharding(
+                    self.ctx.mesh, jax.sharding.PartitionSpec())
+                self._gather_jit = jax.jit(lambda x: x, out_shardings=rep)
+            return [np.asarray(self._gather_jit(l)) for l in leaves]
+        return [np.asarray(l) for l in leaves]
+
+    def load_opt_state(self, host_leaves: list):
+        """Install gathered host leaves back onto the state shardings
+        (recovery path; see engine/opt_checkpoint.py)."""
+        assert self.opt_state is not None
+        treedef = jax.tree.structure(self.opt_state)
+        shard_leaves = jax.tree.leaves(self._opt_shardings)
+        self.opt_state = jax.tree.unflatten(
+            treedef,
+            [jax.device_put(l, s)
+             for l, s in zip(host_leaves, shard_leaves)])
+        self._opt_offloaded = False
 
     def inc_version(self):
         self.version += 1

@@ -33,6 +33,10 @@ class OptimizerConfig:
     # fp16 loss scaling is irrelevant on TPU (bf16 training); kept for
     # config-surface parity and ignored.
     initial_loss_scale: float = 2 ** 32
+    # Keep optimizer state on host between train steps (reference
+    # DeepSpeed zero-offload, deepspeed.py:445): frees
+    # master+moments HBM for colocated MFCs at the cost of a
+    # host<->device round trip per step (engine.train_batch).
     offload: bool = False
     # ZeRO-1-equivalent optimizer-state sharding over the DP axis
     # (reference Megatron DistributedOptimizer / DeepSpeed zero_stage=1,

@@ -74,12 +74,6 @@ def reallocate(
     return dt
 
 
-@jax.jit
-def _ema_leaf(x, y, eta):
-    return (eta * x.astype(jnp.float32)
-            + (1.0 - eta) * y.astype(jnp.float32)).astype(y.dtype)
-
-
 def install_param_chunks(cfg: TransformerConfig, dst_engine, n_chunks: int,
                          fetch_chunk, eta: float = 1.0):
     """Streamed receiver install: ``fetch_chunk(i) -> {path: ndarray}``
@@ -110,7 +104,8 @@ def install_param_chunks(cfg: TransformerConfig, dst_engine, n_chunks: int,
                 arr = arr.astype(pdt)
             leaf = jax.device_put(arr, shardings[path])
             if eta != 1.0:
-                leaf = _ema_leaf(leaf, old[path], eta_dev)
+                # a bare array is a valid pytree: reuse the jitted lerp
+                leaf = _ema_lerp(leaf, old[path], eta_dev)
             moved[path] = leaf
     missing = set(shardings) - set(moved)
     assert not missing, f"param stream missed leaves: {sorted(missing)}"

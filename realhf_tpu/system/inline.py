@@ -55,6 +55,7 @@ class InlineRunner:
                 if os.path.exists(os.path.join(ckpt, "config.json")):
                     mspec.path = ckpt
                     mspec.random_init_config = None
+                    mspec.restore_optimizer_state = True
                     logger.info("Recovered %s from %s", role, ckpt)
 
         import realhf_tpu.datasets  # noqa: F401 - register datasets

@@ -123,6 +123,7 @@ class MasterWorker(worker_base.Worker):
         # per-MFC per-worker execution spans + peak HBM (reference
         # __log_gpu_stats table, model_worker.py:999-1094)
         self._exec_log: list = []
+        self._logged_bids: set = set()
         self._exec_history: list = []
         self._consumed_ids = list(self._ids_to_skip)
         self._cur_epoch = self._start_epoch
@@ -307,13 +308,15 @@ class MasterWorker(worker_base.Worker):
                 f"{r['proc_peak_hbm_bytes'] / 2 ** 30:>9.2f}G "
                 f"[{r['start'] - t0:+.3f}s..{r['end'] - t0:+.3f}s]")
         logger.info("\n".join(lines))
-        # keep rows of every batch except the one just logged: with
+        # Prune every ALREADY-LOGGED batch's rows (not `> bid`: with
         # off-policy overlap an EARLIER batch can still be live when a
-        # later one finishes, and pruning `> bid` would silently drop
-        # its table (advisor r3)
+        # later one finishes, advisor r3; not `!= bid` alone either:
+        # member rows arriving after their batch was logged would then
+        # never be swept and the log would grow unboundedly).
+        self._logged_bids.add(bid)
         self._exec_log = [r for r in self._exec_log
                           if r.get("bid") is not None
-                          and r["bid"] != bid]
+                          and r["bid"] not in self._logged_bids]
 
     def _maybe_save_eval(self, entry, force=False):
         train_nodes = [m for ms in self.train_nodes_of_role.values()

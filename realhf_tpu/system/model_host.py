@@ -80,6 +80,14 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
     ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
     engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
                     total_train_steps=total_steps)
+    if (params_override is None and spec.path
+            and getattr(spec, "restore_optimizer_state", False)
+            and engine.opt_state is not None):
+        # RECOVERY only: restore saved Adam moments/master (exceeds
+        # reference §5.4). Ordinary warm-starts from a checkpoint dir
+        # must NOT inherit a previous trial's moments/LR step.
+        from realhf_tpu.engine import opt_checkpoint
+        opt_checkpoint.restore_engine_opt_state(engine, spec.path)
     return model_api.Model(ModelName(role, 0), engine, tokenizer,
                            hf_family=spec.hf_family)
 
@@ -384,10 +392,17 @@ class ModelHost:
         # interface, so leader and member collective counts match by
         # construction no matter what the interface's save() does.
         host_params = model.engine.params_numpy()
+        host_opt = (model.engine.opt_state_numpy()
+                    if model.engine.opt_state is not None else None)
         if not self.leader_of_role.get(role, True):
             return None
         self.interfaces[train_node_name].save(model, path,
                                               host_params=host_params)
+        if host_opt is not None:
+            # EXCEEDS reference: Adam moments + fp32 master survive
+            # recovery instead of re-warming from zero (§5.4)
+            from realhf_tpu.engine import opt_checkpoint
+            opt_checkpoint.save_opt_state(path, host_opt)
         logger.info("Saved %s to %s", role, path)
         return path
 
