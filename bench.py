@@ -95,13 +95,15 @@ def bench_ppo(on_tpu):
     from realhf_tpu.system.inline import InlineRunner
 
     if on_tpu:
-        # ~262M params/role: sized so all four roles (two trainable
-        # with bf16 weights + dp-sharded fp32 master/Adam, two frozen
-        # bf16) fill the chip -- per-call work large enough that MFU
-        # reflects capability, not dispatch overhead (round-3 verdict:
-        # the 191M/256-token config measured overhead).
+        # ~226M params/role: sized so all four roles (two trainable:
+        # bf16 weights + fp32 master/Adam ~4.1 GB each at dp=1, two
+        # frozen bf16 ~0.5 GB) fill most of the 16 GB chip while
+        # leaving activation/KV headroom -- per-call work large enough
+        # that MFU reflects capability, not dispatch overhead
+        # (round-3 verdict: the 191M/256-token config measured
+        # overhead).
         model_cfg = dict(
-            n_layers=10, n_kv_heads=5, n_q_heads=10, hidden_dim=1280,
+            n_layers=8, n_kv_heads=5, n_q_heads=10, hidden_dim=1280,
             intermediate_dim=3456, vocab_size=32000, n_positions=4096,
             apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
             use_attention_bias=False, use_attn_proj_bias=False,
@@ -445,14 +447,17 @@ def bench_sft(on_tpu):
     key = jax.random.PRNGKey(0)
     gen_out = engine.generate(pids, pseg, ppos, key, gconfig,
                               eos_token_id=None, pad_token_id=0)
-    jax.block_until_ready(gen_out.tokens)  # compile + warmup
+    # host materialization, not block_until_ready: on the tunneled
+    # axon platform block_until_ready can return before remote
+    # execution finishes (observed impossible sub-roofline timings)
+    np.asarray(gen_out.tokens)  # compile + warmup
     g0 = time.monotonic()
     gen_steps = 3 if on_tpu else 1
     for i in range(gen_steps):
         gen_out = engine.generate(pids, pseg, ppos,
                                   jax.random.fold_in(key, i), gconfig,
                                   eos_token_id=None, pad_token_id=0)
-        jax.block_until_ready(gen_out.tokens)
+        np.asarray(gen_out.tokens)
     gdt = time.monotonic() - g0
     gen_tok_per_sec = gen_bs * gen_new * gen_steps / gdt
 

@@ -35,6 +35,9 @@ from realhf_tpu.models import sharding as shard_rules
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.ops import functional as F
+from realhf_tpu.ops.decode_attention import (
+    mesh_nontrivial as _mesh_nontrivial,
+)
 from realhf_tpu.ops.sampling import GenerationHyperparameters
 from realhf_tpu.parallel.mesh import MeshContext
 
@@ -137,6 +140,29 @@ class Engine:
                                       sliding_window=sliding_window)
 
             self.attention_fn = _ring
+        elif jax.default_backend() == "tpu" and _mesh_nontrivial(self.mesh):
+            if ctx.pp_size > 1:
+                # Inside the pipe-manual shard_map a bare pallas_call
+                # would force per-stage gathers; use the XLA path,
+                # which GSPMD partitions natively.
+                from realhf_tpu.ops.attention import packed_attention_xla
+
+                def _xla_attn(q, k, v, seg, causal=True, scale=None,
+                              sliding_window=None):
+                    return packed_attention_xla(
+                        q, k, v, seg, causal=causal, scale=scale,
+                        sliding_window=sliding_window)
+
+                self.attention_fn = _xla_attn
+            else:
+                # Partition the Pallas flash kernel over dp x tp: a
+                # bare pallas_call has no GSPMD rule and would gather
+                # full Q/K/V per device
+                # (ops/attention.make_sharded_attention).
+                from realhf_tpu.ops.attention import (
+                    make_sharded_attention,
+                )
+                self.attention_fn = make_sharded_attention(self.mesh)
         else:
             self.attention_fn = None
 
@@ -450,7 +476,8 @@ class Engine:
                 self.cfg, gconfig, eos_token_id, pad_token_id,
                 activation_constraint=self._constrain,
                 moe_constraint=self.moe_constraint,
-                out_sharding=self._out_replicated())
+                out_sharding=self._out_replicated(),
+                mesh=self.mesh, attention_fn=self.attention_fn)
         fn = self._generate_cache[cache_key]
         return fn(self.params, self._globalize(prompt_ids),
                   self._globalize(prompt_seg), self._globalize(prompt_pos),

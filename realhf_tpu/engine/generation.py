@@ -50,6 +50,8 @@ def generate(
     pad_token_id: int,
     activation_constraint=None,
     moe_constraint=None,
+    mesh=None,  # partitions the pallas decode kernels on dp x tp meshes
+    attention_fn=None,  # sharded prefill attention on dp x tp meshes
 ) -> GenerationOutput:
     """Functional generation; wrap in jax.jit with gconfig/eos/pad
     static. See `build_generate_fn` for the cached jitted wrapper."""
@@ -59,6 +61,7 @@ def generate(
     hidden, cache = T.prefill(cfg, params, prompt_ids, prompt_seg, prompt_pos,
                               total_len=lp + gconfig.max_new_tokens,
                               activation_constraint=activation_constraint,
+                              attention_fn=attention_fn,
                               moe_constraint=moe_constraint)
     last_hidden = hidden[:, -1]  # left padding => last column is last token
 
@@ -103,7 +106,8 @@ def generate(
         # all streams share the padded prompt length, so cache writes
         # land in one uniform slot (dynamic_update_slice fast path)
         new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos,
-                                          moe_constraint, uniform_slot=True)
+                                          moe_constraint, uniform_slot=True,
+                                          mesh=mesh)
         out = (tokens, logprob, mask) if not gconfig.force_no_logits_mask \
             else (tokens, logprob)
         return (new_hidden, cache, unfinished, emitted), out
@@ -133,14 +137,15 @@ def build_generate_fn(cfg: TransformerConfig,
                       gconfig: GenerationHyperparameters,
                       eos_token_id: Optional[int], pad_token_id: int,
                       activation_constraint=None, moe_constraint=None,
-                      out_sharding=None):
+                      out_sharding=None, mesh=None, attention_fn=None):
     """Jitted generate closure; XLA caches compilations per
     batch/bucket shape. Engines build this once and reuse it."""
     fn = functools.partial(generate, cfg, gconfig=gconfig,
                            eos_token_id=eos_token_id,
                            pad_token_id=pad_token_id,
                            activation_constraint=activation_constraint,
-                           moe_constraint=moe_constraint)
+                           moe_constraint=moe_constraint,
+                           mesh=mesh, attention_fn=attention_fn)
 
     def run(params, prompt_ids, prompt_seg, prompt_pos, key):
         return fn(params, prompt_ids, prompt_seg, prompt_pos, key)

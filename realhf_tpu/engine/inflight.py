@@ -62,7 +62,8 @@ class InflightBatchingGenerator:
                  gconfig: GenerationHyperparameters,
                  *, n_slots: int, max_prompt_len: int,
                  eos_token_id: Optional[int], pad_token_id: int,
-                 chunk_size: int = 32, moe_constraint=None):
+                 chunk_size: int = 32, moe_constraint=None,
+                 mesh=None, attention_fn=None):
         if not gconfig.force_no_logits_mask:
             raise ValueError(
                 "inflight batching does not produce the PPO logits "
@@ -81,7 +82,7 @@ class InflightBatchingGenerator:
         # jitted function covers every bucket.
         self._prefill = jax.jit(functools.partial(
             _prefill_into_slot, self.cfg, self.cache_len,
-            moe_constraint))
+            moe_constraint, attention_fn))
 
         nm = gconfig.max_new_tokens
         self.state = dict(
@@ -100,7 +101,7 @@ class InflightBatchingGenerator:
 
         self._decode_chunk = jax.jit(functools.partial(
             _decode_chunk, cfg, gconfig, eos_token_id, pad_token_id,
-            chunk_size, moe_constraint))
+            chunk_size, moe_constraint, mesh))
 
     # ------------------------------------------------------------------
     def _fill_slot(self, slot: int, request_id: int,
@@ -166,14 +167,15 @@ class InflightBatchingGenerator:
 # ----------------------------------------------------------------------
 # jitted pieces
 # ----------------------------------------------------------------------
-def _prefill_into_slot(cfg, cache_len, moe_constraint, params, state, slot,
-                       ids, seg, pos):
+def _prefill_into_slot(cfg, cache_len, moe_constraint, attention_fn,
+                       params, state, slot, ids, seg, pos):
     """Batch-1 prefill scattered into `slot`'s cache rows + state."""
     # total_len=cache_len: the prefill cache comes back already padded
     # to the slot's row length (cache_len is round_cache_len-aligned by
     # the constructor, so prefill's own rounding is a no-op).
     hidden, pcache = T.prefill(cfg, params, ids, seg, pos,
                                total_len=cache_len,
+                               attention_fn=attention_fn,
                                moe_constraint=moe_constraint)
     lp = ids.shape[1]
     pad_s = cache_len - lp
@@ -199,8 +201,8 @@ def _prefill_into_slot(cfg, cache_len, moe_constraint, params, state, slot,
     return new
 
 
-def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, params, state,
-                  key):
+def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, mesh, params,
+                  state, key):
     """`chunk` decode steps over every slot (inactive/finished slots
     keep stepping on pad tokens but write nothing)."""
     nm = g.max_new_tokens
@@ -246,7 +248,8 @@ def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, params, state,
 
         pos = st["prompt_len"] + st["emitted"]
         new_hidden, cache = T.decode_step(cfg, params, st["cache"],
-                                          tokens, pos, moe_constraint)
+                                          tokens, pos, moe_constraint,
+                                          mesh=mesh)
         st = dict(st, cache=cache, last_hidden=new_hidden,
                   emitted=emitted, unfinished=unfinished,
                   hit_eos=hit_eos, out_tokens=out_tokens,

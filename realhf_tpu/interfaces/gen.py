@@ -97,7 +97,9 @@ class GenerationInterface(model_api.ModelInterface):
                     max_prompt_len=need,
                     eos_token_id=tok.eos_token_id,
                     pad_token_id=tok.pad_token_id,
-                    moe_constraint=model.engine.moe_constraint)
+                    moe_constraint=model.engine.moe_constraint,
+                    mesh=model.engine.mesh,
+                    attention_fn=model.engine.attention_fn)
             self._inflight.params = model.engine.params  # fresh weights
             finished = self._inflight.generate_all(prompts, key)
             # do not pin the weights pytree (train_batch donates its

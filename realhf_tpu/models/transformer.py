@@ -449,6 +449,7 @@ def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int,
 def prefill(cfg: TransformerConfig, params: Params, input_ids: jnp.ndarray,
             seg_ids: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
             *, total_len: Optional[int] = None, activation_constraint=None,
+            attention_fn=None,
             moe_constraint=None) -> Tuple[jnp.ndarray, KVCache]:
     """Run the packed forward and materialize a KV cache whose first
     L slots hold the prompt keys/values.
@@ -459,6 +460,7 @@ def prefill(cfg: TransformerConfig, params: Params, input_ids: jnp.ndarray,
     hidden, kvs = forward(cfg, params, input_ids, seg_ids, positions,
                           return_kv=True,
                           activation_constraint=activation_constraint,
+                          attention_fn=attention_fn,
                           moe_constraint=moe_constraint)
     k, v = kvs  # [nl, B, L, nkv, hd]
     k = k.transpose(0, 1, 3, 2, 4)  # -> [nl, B, nkv, L, hd] head-major
@@ -501,10 +503,11 @@ def extend_kv_cache(cache: KVCache, extra: int) -> KVCache:
 
 
 def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
-                              scale, sliding_window, slot):
+                              scale, sliding_window, slot, mesh=None):
     """Decode attention against the FULL stacked cache at a traced
     layer index. TPU: scalar-prefetch Pallas kernel (streams exactly
-    one layer's rows from HBM, no slice copy). A traced scale (deep
+    one layer's rows from HBM, no slice copy), shard_map-partitioned
+    over dp x tp meshes. A traced scale (deep
     scale_attn_by_inverse_layer_idx models) pre-multiplies q so the
     kernel still runs with a static scale -- falling back to slicing
     the layer out would re-materialize a full layer-cache copy per
@@ -513,18 +516,35 @@ def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
     hd = q.shape[-1]
     if jax.default_backend() == "tpu" and hd >= 64:
         from realhf_tpu.ops.decode_attention import (
+            decode_shardable,
             flash_decode_attention_stacked,
+            mesh_nontrivial,
+            sharded_decode_attention,
         )
         if not (scale is None or isinstance(scale, (int, float))):
             q = (q.astype(jnp.float32) * scale).astype(q.dtype)
             scale = 1.0
-        return flash_decode_attention_stacked(
-            q, k_all, v_all, valid, layer_idx, scale=scale,
-            sliding_window=sliding_window, slot=slot)
+        b, nq = q.shape[0], q.shape[1]
+        nkv = k_all.shape[2]
+        if not mesh_nontrivial(mesh):
+            return flash_decode_attention_stacked(
+                q, k_all, v_all, valid, layer_idx, scale=scale,
+                sliding_window=sliding_window, slot=slot)
+        if decode_shardable(mesh, b, nq, nkv):
+            def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
+                return flash_decode_attention_stacked(
+                    q_l, k_l, v_l, valid_l, lidx, scale=scale,
+                    sliding_window=sliding_window, slot=slot_l)
+            return sharded_decode_attention(
+                fn, mesh, q, (k_all, v_all), valid, slot, layer_idx,
+                stacked=True)
+        # fall through: pass mesh so decode_attention's own gate skips
+        # the bare kernel and takes the GSPMD-partitioned XLA path
     k_l = jax.lax.dynamic_index_in_dim(k_all, layer_idx, 0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(v_all, layer_idx, 0, keepdims=False)
     return decode_attention(q, k_l, v_l, valid, scale=scale,
-                            sliding_window=sliding_window, slot=slot)
+                            sliding_window=sliding_window, slot=slot,
+                            mesh=mesh)
 
 
 def decode_step(
@@ -535,6 +555,7 @@ def decode_step(
     positions: jnp.ndarray,  # [B] int32 -- its position in the sequence
     moe_constraint=None,
     uniform_slot: bool = False,
+    mesh=None,  # dp x tp mesh: partitions the pallas decode kernels
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One decode step: feed `token`, return hidden [B, H] for the next
     token's logits and the updated cache. The jitted decode loop built
@@ -613,11 +634,11 @@ def decode_step(
             attn = decode_attention(q, k_all[static_l], v_all[static_l],
                                     valid, scale=scale,
                                     sliding_window=cfg.sliding_window,
-                                    slot=slot)
+                                    slot=slot, mesh=mesh)
         else:
             attn = _stacked_decode_attention(
                 q, k_all, v_all, valid, layer_idx, scale=scale,
-                sliding_window=cfg.sliding_window, slot=slot)
+                sliding_window=cfg.sliding_window, slot=slot, mesh=mesh)
         proj = attn.reshape(b, -1) @ lp["attn"]["wo"].astype(x.dtype)
         if "bo" in lp["attn"]:
             proj = proj + lp["attn"]["bo"].astype(x.dtype)

@@ -113,6 +113,67 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
                                 sliding_window=sliding_window)
 
 
+def make_sharded_attention(mesh, inner=None):
+    """Factory for a packed-attention fn that partitions the Pallas
+    flash kernel over a dp x tp mesh with `shard_map` (B over "data",
+    heads over "model"; L stays whole -- sequence sharding is ring
+    attention's job). A bare pallas_call under GSPMD has no
+    partitioning rule, so without this the sharded forward would
+    gather full Q/K/V onto every device. Engines install this as
+    ``attention_fn`` on non-trivial TPU meshes.
+
+    Falls back to the XLA path (which GSPMD partitions natively) when
+    shapes do not divide the mesh or the scale is traced. ``inner``
+    overrides the per-shard implementation (tests inject the
+    interpret-mode kernel)."""
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    local = inner or packed_attention
+
+    def attn(q, k, v, seg_ids, causal=True, scale=None,
+             sliding_window=None):
+        b, _, nq, _ = q.shape
+        nkv = k.shape[2]
+        if dp * tp == 1:
+            return local(q, k, v, seg_ids, causal=causal, scale=scale,
+                         sliding_window=sliding_window)
+        if (b % dp or nq % tp or nkv % tp
+                or not (scale is None
+                        or isinstance(scale, (int, float)))):
+            return packed_attention_xla(
+                q, k, v, seg_ids, causal=causal, scale=scale,
+                sliding_window=sliding_window)
+
+        extra = [a for a in mesh.axis_names
+                 if a not in (DATA_AXIS, MODEL_AXIS)]
+        axis_names = (set(mesh.axis_names)
+                      if all(mesh.shape[a] == 1 for a in extra)
+                      else {DATA_AXIS, MODEL_AXIS})
+
+        @_partial(jax.shard_map, mesh=mesh,
+                  axis_names=axis_names,
+                  in_specs=(P(DATA_AXIS, None, MODEL_AXIS, None),
+                            P(DATA_AXIS, None, MODEL_AXIS, None),
+                            P(DATA_AXIS, None, MODEL_AXIS, None),
+                            P(DATA_AXIS, None)),
+                  out_specs=P(DATA_AXIS, None, MODEL_AXIS, None),
+                  # pallas_call outputs carry no varying-axes metadata
+                  check_vma=False)
+        def run(q_l, k_l, v_l, seg_l):
+            return local(q_l, k_l, v_l, seg_l, causal=causal,
+                         scale=scale, sliding_window=sliding_window)
+
+        return run(q, k, v, seg_ids)
+
+    return attn
+
+
 def decode_attention(
     q: jnp.ndarray,        # [B, nq, hd] -- one new token per stream
     k_cache: jnp.ndarray,  # [B, nkv, S, hd] (head-major)
@@ -126,6 +187,7 @@ def decode_attention(
     sliding_window: Optional[int] = None,
     slot: Optional[jnp.ndarray] = None,  # [B] int32 current write index,
                                          # required with sliding_window
+    mesh=None,  # partition the pallas kernel over a dp x tp mesh
 ) -> jnp.ndarray:
     """Single-step decode attention against a padded KV cache.
 
@@ -146,11 +208,24 @@ def decode_attention(
             and (scale is None or isinstance(scale, (int, float)))):
         try:
             from realhf_tpu.ops.decode_attention import (
+                decode_shardable,
                 flash_decode_attention,
+                mesh_nontrivial,
+                sharded_decode_attention,
             )
-            return flash_decode_attention(
-                q, k_cache, v_cache, valid_mask, scale=scale,
-                sliding_window=sliding_window, slot=slot)
+            if not mesh_nontrivial(mesh):
+                return flash_decode_attention(
+                    q, k_cache, v_cache, valid_mask, scale=scale,
+                    sliding_window=sliding_window, slot=slot)
+            if decode_shardable(mesh, b, nq, nkv):
+                def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
+                    return flash_decode_attention(
+                        q_l, k_l, v_l, valid_l, scale=scale,
+                        sliding_window=sliding_window, slot=slot_l)
+                return sharded_decode_attention(
+                    fn, mesh, q, (k_cache, v_cache), valid_mask, slot,
+                    stacked=False)
+            # fall through to the XLA path: GSPMD partitions it itself
         except ImportError:
             pass
 

@@ -181,6 +181,75 @@ def flash_decode_attention(
     return out[:, :, :group, :].reshape(b, nq, hd)
 
 
+def sharded_decode_attention(
+    fn, mesh, q, caches, valid_mask, slot, layer_index=None, *,
+    stacked: bool,
+):
+    """Partition a decode-attention kernel over a dp x tp mesh with
+    `shard_map` (manual over the data/model axes): a bare pallas_call
+    under GSPMD has no partitioning rule, so without this wrapper XLA
+    would gather the full KV cache onto every device -- fatal for the
+    tp16 70B decode story (docs/distributed.md).
+    ``fn(q, k, v, valid, slot, lidx)`` runs on LOCAL shards: B over
+    "data", heads over "model" (GQA grouping survives because nq and
+    nkv shard together).
+
+    Callers must check `decode_shardable` (B % dp, nq % tp, nkv % tp)
+    and fall back to the XLA path otherwise."""
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    layer_lead = (None,) if stacked else ()
+    kv_spec = P(*layer_lead, DATA_AXIS, MODEL_AXIS, None, None)
+    slot_spec = P(DATA_AXIS) if slot is not None else P()
+    has_slot = slot is not None
+    # decode requires pipe=ctx=1, so go FULLY manual (partial-auto
+    # meshes cannot host the interpret-mode kernel's callbacks)
+    axis_names = {a for a in mesh.axis_names}
+
+    @_partial(jax.shard_map, mesh=mesh,
+              axis_names=axis_names,
+              in_specs=(P(DATA_AXIS, MODEL_AXIS, None), kv_spec,
+                        kv_spec, P(DATA_AXIS, None), slot_spec, P()),
+              out_specs=P(DATA_AXIS, MODEL_AXIS, None),
+              # pallas_call outputs carry no varying-axes metadata
+              check_vma=False)
+    def run(q_l, k_l, v_l, valid_l, slot_l, lidx):
+        return fn(q_l, k_l, v_l, valid_l,
+                  slot_l if has_slot else None, lidx)
+
+    k_all, v_all = caches
+    return run(q, k_all, v_all, valid_mask,
+               slot if has_slot else jnp.zeros((), jnp.int32),
+               (layer_index if layer_index is not None
+                else jnp.zeros((), jnp.int32)))
+
+
+def mesh_nontrivial(mesh) -> bool:
+    """True when the mesh actually shards over data/model (the pallas
+    kernels then need the shard_map wrappers)."""
+    if mesh is None:
+        return False
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    return (mesh.shape.get(DATA_AXIS, 1)
+            * mesh.shape.get(MODEL_AXIS, 1)) > 1
+
+
+def decode_shardable(mesh, b: int, nq: int, nkv: int) -> bool:
+    """Whether the pallas decode kernels can partition on this mesh."""
+    if mesh is None:
+        return True
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if dp == 1 and tp == 1:
+        return True
+    return b % dp == 0 and nq % tp == 0 and nkv % tp == 0
+
+
 def flash_decode_attention_stacked(
     q: jnp.ndarray,        # [B, nq, hd]
     k_all: jnp.ndarray,    # [nl, B, nkv, S, hd] -- the FULL stacked cache

@@ -52,7 +52,11 @@ def main():
     t_c = time.monotonic()
     out = engine.generate(pids, pseg, ppos, key, gconfig,
                           eos_token_id=None, pad_token_id=0)
-    jax.block_until_ready(out.tokens)
+    # host materialization, NOT block_until_ready: on the tunneled
+    # axon platform block_until_ready has been observed returning
+    # before remote execution finishes (impossible sub-roofline
+    # timings); np.asarray forces the real round trip.
+    np.asarray(out.tokens)
     print(f"compile+warmup: {time.monotonic() - t_c:.1f}s")
 
     g0 = time.monotonic()
@@ -60,7 +64,7 @@ def main():
     for i in range(steps):
         out = engine.generate(pids, pseg, ppos, jax.random.fold_in(key, i),
                               gconfig, eos_token_id=None, pad_token_id=0)
-        jax.block_until_ready(out.tokens)
+        np.asarray(out.tokens)
     gdt = (time.monotonic() - g0) / steps
 
     kv_bytes_per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2

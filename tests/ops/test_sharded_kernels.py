@@ -1,0 +1,133 @@
+"""Pallas kernels composed with GSPMD meshes via shard_map.
+
+A bare pallas_call under jit has no partitioning rule; the wrappers in
+ops/attention.py (make_sharded_attention) and ops/decode_attention.py
+(sharded_decode_attention) run the kernels on LOCAL shards -- B over
+"data", heads over "model" -- which is what the tp16 70B decode story
+relies on (docs/distributed.md). Validated here on the virtual CPU
+mesh with the interpret-mode kernels injected."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from realhf_tpu.ops.attention import (
+    decode_attention,
+    make_sharded_attention,
+    packed_attention_xla,
+)
+from realhf_tpu.ops.decode_attention import (
+    decode_shardable,
+    flash_decode_attention,
+    flash_decode_attention_stacked,
+    sharded_decode_attention,
+)
+from realhf_tpu.ops.flash_attention import flash_attention
+from realhf_tpu.parallel.mesh import ParallelismConfig, make_mesh
+
+
+def _mesh(dp=2, tp=2):
+    par = ParallelismConfig(data_parallel_size=dp,
+                            tensor_parallel_size=tp)
+    return make_mesh(par, devices=jax.devices("cpu")[:par.world_size])
+
+
+def test_sharded_packed_attention_matches_xla():
+    rng = np.random.default_rng(0)
+    b, l, nq, nkv, hd = 4, 128, 8, 4, 128
+    q = jnp.asarray(rng.standard_normal((b, l, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, nkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, nkv, hd)), jnp.float32)
+    seg = np.ones((b, l), np.int32)
+    seg[:, l // 2:] = 2
+    seg[0, -16:] = 0
+    seg = jnp.asarray(seg)
+
+    ref = packed_attention_xla(q, k, v, seg, causal=True)
+    inner = functools.partial(_interp_packed)
+    attn = make_sharded_attention(_mesh(), inner=inner)
+    got = jax.jit(lambda *a: attn(*a))(q, k, v, seg)
+    valid = np.asarray(seg) != 0  # pad-row outputs are don't-care
+    np.testing.assert_allclose(np.asarray(got)[valid],
+                               np.asarray(ref)[valid],
+                               atol=2e-5, rtol=2e-5)
+
+
+def _interp_packed(q, k, v, seg, causal=True, scale=None,
+                   sliding_window=None):
+    from jax.experimental.pallas import tpu as pltpu
+    assert sliding_window is None
+    with pltpu.force_tpu_interpret_mode():
+        return flash_attention(q, k, v, seg, causal=causal, scale=scale)
+
+
+def test_sharded_packed_attention_indivisible_falls_back():
+    rng = np.random.default_rng(1)
+    b, l, nq, nkv, hd = 3, 64, 8, 4, 128  # b=3 not divisible by dp=2
+    q = jnp.asarray(rng.standard_normal((b, l, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, nkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, nkv, hd)), jnp.float32)
+    seg = jnp.ones((b, l), jnp.int32)
+    attn = make_sharded_attention(_mesh(), inner=_boom)
+    ref = packed_attention_xla(q, k, v, seg, causal=True)
+    got = attn(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def _boom(*a, **k):
+    raise AssertionError("kernel path must not run for odd shapes")
+
+
+def test_sharded_decode_kernel_matches_xla():
+    rng = np.random.default_rng(2)
+    b, s, nq, nkv, hd = 4, 128, 8, 4, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    valid = np.zeros((b, s), bool)
+    valid[:, :100] = True
+    valid = jnp.asarray(valid)
+    mesh = _mesh()
+    assert decode_shardable(mesh, b, nq, nkv)
+
+    ref = decode_attention(q, k, v, valid)
+
+    def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
+        return flash_decode_attention(q_l, k_l, v_l, valid_l,
+                                      interpret=True)
+
+    got = jax.jit(lambda *a: sharded_decode_attention(
+        fn, mesh, a[0], (a[1], a[2]), a[3], None, stacked=False))(
+            q, k, v, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sharded_stacked_decode_kernel_matches_xla():
+    rng = np.random.default_rng(3)
+    nl, b, s, nq, nkv, hd = 3, 4, 64, 8, 4, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    v_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    valid = jnp.ones((b, s), bool)
+    mesh = _mesh()
+    layer = jnp.asarray(1, jnp.int32)
+
+    ref = decode_attention(q, k_all[1], v_all[1], valid)
+
+    def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
+        return flash_decode_attention_stacked(q_l, k_l, v_l, valid_l,
+                                              lidx, interpret=True)
+
+    got = jax.jit(lambda *a: sharded_decode_attention(
+        fn, mesh, a[0], (a[1], a[2]), a[3], None, a[4],
+        stacked=True))(q, k_all, v_all, valid, layer)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
