@@ -229,6 +229,11 @@ class Engine:
 
         self._train_step_cache: Dict[Any, Callable] = {}
         self._generate_cache: Dict[Any, Callable] = {}
+        # Generation view on pp/ctx meshes (decode_engine): a second
+        # inference-only Engine on a collapsed dp x tp mesh over the
+        # SAME devices; weights reshard into it when they change.
+        self._decode_view: Optional["Engine"] = None
+        self._decode_view_src: Any = None
         self._jit_forward_hidden = None
         self._gather_jit = None
         self._jit_logprobs = None
@@ -457,19 +462,86 @@ class Engine:
     # ------------------------------------------------------------------
     # Generation
     # ------------------------------------------------------------------
+    def decode_engine(self) -> "Engine":
+        """The engine generation should run on.
+
+        dp/tp meshes decode in place (returns self). On a pipeline- or
+        context-parallel mesh, decoding against layer-sharded (pipe) or
+        ring-attention (ctx) weights has no efficient schedule -- the
+        reference streams tokens through PP stages instead
+        (``pipe_runner.py:847``, ``static_schedule.py:195``
+        GenerateSchedule). The TPU-first equivalent: reshard the weights
+        onto a collapsed dp x tp mesh over the SAME devices (one
+        cross-mesh ``device_put`` riding ICI, amortized over the whole
+        rollout and refreshed only when the weights change) and run the
+        fast dp/tp decode there. ``ParallelismConfig.gen_tp_size``
+        ("g" in the allocation shorthand, e.g. ``d2t2p2g4``) picks the
+        decode tensor-parallel degree; default is the train tp, giving
+        pp*dp-way decode data parallelism for free.
+        """
+        gen_tp = self.ctx.parallel.gen_tp_size or self.ctx.tp_size
+        if (self.pipeline_ctx is None
+                and self.ctx.parallel.context_parallel_size == 1
+                and gen_tp == self.ctx.tp_size):
+            return self
+        if self._decode_view is None:
+            from realhf_tpu.parallel.mesh import (
+                MeshContext, ParallelismConfig, make_mesh,
+            )
+            devices = list(self.mesh.devices.flat)
+            tp = gen_tp
+            if len(devices) % tp != 0:
+                raise ValueError(
+                    f"gen_tp_size={tp} does not divide the mesh's "
+                    f"{len(devices)} devices.")
+            par = ParallelismConfig(
+                data_parallel_size=len(devices) // tp,
+                tensor_parallel_size=tp,
+                sequence_parallel=self.ctx.parallel.sequence_parallel)
+            view_ctx = MeshContext(self.ctx.model_name,
+                                   make_mesh(par, devices), par)
+            logger.info("Building decode view %s for %s mesh %s",
+                        par, self.ctx.model_name, self.ctx.parallel)
+            self._decode_view = Engine(self.cfg, view_ctx, self.params,
+                                       optimizer=None)
+            self._decode_view_src = self.params
+        elif self._decode_view_src is not self.params:
+            # train_batch donates + replaces self.params; set_params
+            # installs a realloc'd pytree -- either way identity moved.
+            # Drop the view's stale copy FIRST: holding it through the
+            # reshard would transiently keep old+new gen-layout copies
+            # resident (2x 2*n_params/gen_tp per chip -- an OOM at the
+            # 70B scale this path exists for).
+            self._decode_view.params = None
+            self._decode_view.set_params(self.params)
+            self._decode_view_src = self.params
+        return self._decode_view
+
+    def set_gen_tp(self, gen_tp: int):
+        """Install a decode-view TP override (the allocation
+        shorthand's "g"), validating against the mesh NOW rather than
+        at the first rollout mid-experiment."""
+        ndev = len(self.mesh.devices.flat)
+        if gen_tp and ndev % gen_tp != 0:
+            raise ValueError(
+                f"gen_tp_size={gen_tp} does not divide the mesh's "
+                f"{ndev} devices.")
+        if gen_tp == self.ctx.parallel.gen_tp_size:
+            return
+        import dataclasses as _dc
+        self.ctx.parallel = _dc.replace(self.ctx.parallel,
+                                        gen_tp_size=gen_tp)
+        self._decode_view = None
+        self._decode_view_src = None
+
     def generate(self, prompt_ids, prompt_seg, prompt_pos, key,
                  gconfig: GenerationHyperparameters,
                  eos_token_id: Optional[int], pad_token_id: int
                  ) -> gen_mod.GenerationOutput:
-        if self.ctx.parallel.context_parallel_size > 1 or \
-                self.ctx.pp_size > 1:
-            raise NotImplementedError(
-                "Generation on a context- or pipeline-parallel mesh is "
-                "not supported; allocate the generation MFC on a dp/tp "
-                "layout (decoupled allocation, e.g. actor_gen_alloc="
-                "d8t1). The reference's token-streaming GenerateSchedule "
-                "has no efficient XLA analogue (SURVEY.md §7 risk "
-                "register).")
+        view = self.decode_engine()
+        if view is not self:
+            return view.generate(prompt_ids, prompt_seg, prompt_pos,
+                                 key, gconfig, eos_token_id, pad_token_id)
         cache_key = (gconfig, eos_token_id, pad_token_id)
         if cache_key not in self._generate_cache:
             self._generate_cache[cache_key] = gen_mod.build_generate_fn(
@@ -565,6 +637,11 @@ class Engine:
         """Move weights to host memory, freeing HBM until the next use."""
         if self.offloaded:
             return
+        # the decode view holds a second full weight copy in the gen
+        # layout; drop it too (rebuilt on the next pp/ctx generate; the
+        # jit cache survives via XLA's compilation cache)
+        self._decode_view = None
+        self._decode_view_src = None
         cpu = jax.devices("cpu")[0]
         self.params = jax.device_put(self.params, cpu)
         jax.block_until_ready(self.params)

@@ -63,17 +63,11 @@ class GenerationInterface(model_api.ModelInterface):
                     "(worker-group) mesh is not supported; run the "
                     "generation MFC on a single-process allocation or "
                     "disable use_inflight_batching.")
-            if (model.engine.pipeline_ctx is not None
-                    or model.engine.ctx.parallel.context_parallel_size > 1):
-                # same restriction Engine.generate enforces on the
-                # batch path: decode with pipe-layer-sharded or
-                # ctx-sharded weights would silently all-gather the
-                # stack every step instead of erroring
-                raise NotImplementedError(
-                    "Inflight-batching generation on a pipeline- or "
-                    "context-parallel mesh is not supported; allocate "
-                    "the generation MFC on a dp/tp layout (decoupled "
-                    "allocation).")
+            # On a pipeline- or context-parallel mesh, decode runs on
+            # the collapsed dp x tp decode view (weights resharded per
+            # version, engine.decode_engine) -- same path the batch
+            # generate takes.
+            eng = model.engine.decode_engine()
             from realhf_tpu.engine.inflight import (
                 InflightBatchingGenerator,
             )
@@ -92,15 +86,15 @@ class GenerationInterface(model_api.ModelInterface):
                 # inflight_slots=0 = "track batch size") a different
                 # prompt count than the slots were built for
                 self._inflight = InflightBatchingGenerator(
-                    model.config, model.engine.params, self.gconfig,
+                    model.config, eng.params, self.gconfig,
                     n_slots=n_slots,
                     max_prompt_len=need,
                     eos_token_id=tok.eos_token_id,
                     pad_token_id=tok.pad_token_id,
-                    moe_constraint=model.engine.moe_constraint,
-                    mesh=model.engine.mesh,
-                    attention_fn=model.engine.attention_fn)
-            self._inflight.params = model.engine.params  # fresh weights
+                    moe_constraint=eng.moe_constraint,
+                    mesh=eng.mesh,
+                    attention_fn=eng.attention_fn)
+            self._inflight.params = eng.params  # fresh weights
             finished = self._inflight.generate_all(prompts, key)
             # do not pin the weights pytree (train_batch donates its
             # buffers; a stale reference would keep a second full model

@@ -53,6 +53,12 @@ class ParallelismConfig:
     # (0 = auto: 2*pp, bubble fraction (pp-1)/(3*pp-1)); not part of
     # the weight layout (same_layout ignores it).
     pipeline_microbatches: int = 0
+    # Tensor-parallel degree of the DECODE VIEW used for generation on
+    # a pipeline- or context-parallel mesh (engine.decode_engine):
+    # weights reshard onto a collapsed (world/gen_tp) x gen_tp dp x tp
+    # mesh over the same devices. 0 = inherit tensor_parallel_size.
+    # Not part of the weight layout (same_layout ignores it).
+    gen_tp_size: int = 0
 
     def __post_init__(self):
         if self.sequence_parallel and self.tensor_parallel_size == 1:
@@ -79,6 +85,8 @@ class ParallelismConfig:
             s += f"c{self.context_parallel_size}"
         if self.sequence_parallel:
             s += "s"
+        if self.gen_tp_size:
+            s += f"g{self.gen_tp_size}"
         return s
 
 
@@ -90,9 +98,9 @@ def parse_parallelism(name: str) -> ParallelismConfig:
     """
     import re
     s = name.strip()
-    tokens = re.findall(r"([dtmpc])(\d+)|(s)(?!\d)", s)
+    tokens = re.findall(r"([dtmpcg])(\d+)|(s)(?!\d)", s)
     consumed = "".join(t[0] + t[1] + t[2] for t in tokens)
-    sizes = {"d": 1, "t": 1, "p": 1, "c": 1}
+    sizes = {"d": 1, "t": 1, "p": 1, "c": 1, "g": 0}
     seq_par = False
     for axis, num, sp in tokens:
         if sp:
@@ -109,7 +117,8 @@ def parse_parallelism(name: str) -> ParallelismConfig:
         tensor_parallel_size=sizes["t"],
         pipeline_parallel_size=sizes["p"],
         context_parallel_size=sizes["c"],
-        sequence_parallel=seq_par)
+        sequence_parallel=seq_par,
+        gen_tp_size=sizes["g"])
 
 
 def default_devices() -> List:

@@ -194,12 +194,18 @@ def exec_time(w: MFCWorkload, tp: int, dp: int,
         return bubble * flops / (chips * cm.peak_flops
                                  * cm.mxu_efficiency)
     if w.interface_type == ModelInterfaceType.GENERATE:
-        assert pp == 1, "generation does not run on pipeline meshes"
         prefill = w.fwd_flops / (chips * cm.peak_flops
                                  * cm.mxu_efficiency)
         # decode is weight-bandwidth bound: every step re-reads this
         # chip's weight shard from HBM
         decode = w.gen_tokens * (w.param_bytes / tp) / cm.hbm_bandwidth
+        if pp > 1:
+            # pp-mesh generation runs on the collapsed dp x tp decode
+            # view (engine.decode_engine): same per-chip decode traffic
+            # at the view's tp (= train tp by default), plus one
+            # weights reshard onto the view per weight version
+            return (prefill + decode
+                    + (w.param_bytes / chips) / cm.ici_bandwidth)
         return prefill + decode
     return bubble * w.fwd_flops / (chips * cm.peak_flops
                                    * cm.mxu_efficiency)
@@ -210,6 +216,13 @@ def enumerate_candidates(w: MFCWorkload, n_devices: int,
     """(slice, layout) placements whose per-chip memory fits."""
     need = w.train_state_bytes if w.trainable else w.param_bytes * 1.25
     out: List[Candidate] = []
+    # GENERATE candidates stay pp=1 on purpose: a same-slice pp=1
+    # candidate already models the colocated-rollout configuration
+    # (overlapping slices serialize in the simulator, and the runtime
+    # realizes it as either a realloc replica or the engine's decode
+    # view -- both one extra gen-layout weight copy); a distinct pp>1
+    # generate candidate would be redundant search space. exec_time
+    # still prices pp>1 correctly for direct/profile callers.
     if w.interface_type == ModelInterfaceType.GENERATE or not w.n_layers:
         pps = [1]
     else:

@@ -166,7 +166,11 @@ class ModelHost:
                     and alloc.device_ids is None:
                 # redundant entry (same layout, same group): no-op,
                 # never a replica -- accepted for generated configs
-                # that list every MFC
+                # that list every MFC. A gen_tp_size ("g") override
+                # does not change the weight layout but must still
+                # reach the engine's decode view.
+                self._install_gen_tp(self.models[role], alloc.parallel,
+                                     node.name)
                 continue
             if node.interface_type == ModelInterfaceType.TRAIN_STEP:
                 raise ValueError(
@@ -195,6 +199,7 @@ class ModelHost:
             primary = self.models[role]
             if alloc.parallel.same_layout(primary.engine.ctx.parallel) \
                     and alloc.device_ids is None:
+                self._install_gen_tp(primary, alloc.parallel, node.name)
                 continue
             mspec = _dc.replace(spec.models[role], parallel=alloc.parallel,
                                 optimizer=None)
@@ -215,6 +220,23 @@ class ModelHost:
 
         if getattr(spec, "auto_offload", False):
             self._resolve_offload_hooks(nodes)
+
+    @staticmethod
+    def _install_gen_tp(model, par, node_name: str):
+        """An MFC allocation that differs from the engine's layout only
+        by gen_tp_size ("g", decode-view TP) is not a replica -- the
+        weight layout is identical -- but the override must reach
+        Engine.decode_engine, which reads ctx.parallel.gen_tp_size."""
+        eng = model.engine
+        cur = eng.ctx.parallel.gen_tp_size
+        if not par.gen_tp_size or par.gen_tp_size == cur:
+            return
+        if cur and cur != par.gen_tp_size:
+            logger.warning(
+                "MFC %s sets gen_tp_size=%d over an engine already at "
+                "gen_tp_size=%d; last writer wins.", node_name,
+                par.gen_tp_size, cur)
+        eng.set_gen_tp(par.gen_tp_size)
 
     @staticmethod
     def _resolve_offload_hooks(nodes: List[MFCDef]):

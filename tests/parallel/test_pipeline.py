@@ -200,7 +200,10 @@ def test_sft_trains_on_pipeline_mesh():
     assert s2["loss"] < s1["loss"]
 
 
-def test_generation_raises_on_pipeline_mesh():
+def test_generation_on_pipeline_mesh_uses_decode_view():
+    """Generation on a pipe mesh no longer raises: it runs on the
+    collapsed dp x tp decode view (engine.decode_engine; full parity
+    coverage in tests/engine/test_pp_generate.py)."""
     cfg = _cfg()
     params = T.init_params(cfg, jax.random.PRNGKey(0))
     parallel = ParallelismConfig(data_parallel_size=4,
@@ -209,13 +212,17 @@ def test_generation_raises_on_pipeline_mesh():
     ctx = MeshContext(ModelName("actor", 0), mesh, parallel)
     engine = Engine(cfg, ctx, params)
     from realhf_tpu.ops.sampling import GenerationHyperparameters
-    with pytest.raises(NotImplementedError):
-        engine.generate(np.ones((2, 8), np.int32),
-                        np.ones((2, 8), np.int32),
-                        np.zeros((2, 8), np.int32),
-                        jax.random.PRNGKey(0),
-                        GenerationHyperparameters(max_new_tokens=4),
-                        eos_token_id=None, pad_token_id=0)
+    out = engine.generate(np.ones((2, 8), np.int32),
+                          np.ones((2, 8), np.int32),
+                          np.tile(np.arange(8, dtype=np.int32), (2, 1)),
+                          jax.random.PRNGKey(0),
+                          GenerationHyperparameters(max_new_tokens=4,
+                                                    min_new_tokens=1),
+                          eos_token_id=None, pad_token_id=0)
+    assert np.asarray(out.tokens).shape[1] == 4
+    view = engine.decode_engine()
+    assert view is not engine and view.pipeline_ctx is None
+    assert view.ctx.dp_size == 8 and view.ctx.tp_size == 1
 
 
 def test_pipeline_moe_aux_ignores_padded_microbatches():
