@@ -385,6 +385,13 @@ class Engine:
         self.params, self.opt_state, loss, stats, gnorm = step(
             self.params, self.opt_state, stacked, weights)
         self.version += 1
+        if self._decode_view is not None:
+            # the view's gen-layout weight copy is now stale (params
+            # identity moved) and would otherwise sit in HBM through
+            # the memory-peak train phase; the next rollout reshards
+            # fresh weights into the view anyway
+            self._decode_view.params = None
+            self._decode_view_src = None
         if (self.optimizer_config is not None
                 and self.optimizer_config.offload):
             cpu = jax.devices("cpu")[0]

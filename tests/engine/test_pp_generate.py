@@ -26,7 +26,12 @@ from realhf_tpu.engine.engine import Engine
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.ops.sampling import GenerationHyperparameters
-from realhf_tpu.parallel.mesh import MeshContext, ParallelismConfig, make_mesh
+from realhf_tpu.parallel.mesh import (
+    MeshContext,
+    ParallelismConfig,
+    make_mesh,
+    parse_parallelism,
+)
 
 
 def tiny_cfg(**kw):
@@ -189,10 +194,7 @@ class TestDecodeView:
 
 
 def test_parse_gen_tp():
-    p = __import__("realhf_tpu.parallel.mesh", fromlist=["parse_parallelism"]
-                   ).parse_parallelism("d2t2p2g4")
+    p = parse_parallelism("d2t2p2g4")
     assert p.gen_tp_size == 4 and p.pipeline_parallel_size == 2
     assert "g4" in str(p)
-    q = __import__("realhf_tpu.parallel.mesh", fromlist=["parse_parallelism"]
-                   ).parse_parallelism("d4t2")
-    assert q.gen_tp_size == 0
+    assert parse_parallelism("d4t2").gen_tp_size == 0
