@@ -504,6 +504,10 @@ def main():
     headline, extra = bench_ppo(on_tpu)
     extra.update(bench_sft(on_tpu))
     extra["backend"] = jax.default_backend()
+    if not on_tpu:
+        # the probe timed out or failed (e.g. wedged axon relay):
+        # these numbers are CPU-smoke only, not the TPU capability
+        extra["tpu_unavailable"] = True
     headline["extra"] = extra
     print(json.dumps(headline))
 

@@ -24,6 +24,10 @@ from realhf_tpu.ops.sampling import (
     top_k_top_p_logits,
 )
 
+# Test hook: force the fixed-trip-count scan driver even when EOS
+# early exit applies (parity tests compare the two paths).
+_DISABLE_EARLY_EXIT = False
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -92,11 +96,11 @@ def generate(
             unfinished = unfinished & (tokens != eos_token_id)
         return tokens, logprob, mask, unfinished
 
-    keys = jax.random.split(key, gconfig.max_new_tokens)
+    t_max = gconfig.max_new_tokens
+    keys = jax.random.split(key, t_max)
 
-    def body(carry, x):
-        last_hidden, cache, unfinished, emitted = carry
-        step_idx, k = x
+    def step_once(last_hidden, cache, unfinished, emitted, step_idx, k):
+        """One decode step, shared by the scan and while-loop drivers."""
         logits = T.lm_logits(cfg, params, last_hidden)
         was_unfinished = unfinished
         tokens, logprob, mask, unfinished = sample_step(
@@ -108,22 +112,73 @@ def generate(
         new_hidden, cache = T.decode_step(cfg, params, cache, tokens, pos,
                                           moe_constraint, uniform_slot=True,
                                           mesh=mesh)
-        out = (tokens, logprob, mask) if not gconfig.force_no_logits_mask \
-            else (tokens, logprob)
-        return (new_hidden, cache, unfinished, emitted), out
+        return new_hidden, cache, unfinished, emitted, tokens, logprob, mask
 
-    init = (last_hidden, cache, jnp.ones((b,), bool), jnp.zeros((b,), jnp.int32))
-    (_, _, unfinished, emitted), outs = jax.lax.scan(
-        body, init, (jnp.arange(gconfig.max_new_tokens), keys))
+    want_mask = not gconfig.force_no_logits_mask
+    early_exit = (not _DISABLE_EARLY_EXIT
+                  and eos_token_id is not None
+                  and gconfig.min_new_tokens < t_max)
+    if early_exit:
+        # EOS can end every stream before t_max: a while_loop stops
+        # decoding the moment no stream is unfinished, writing into
+        # preallocated output buffers. The reference terminates its
+        # genstep loop the same way (real_llm_generate.py genstep
+        # terminate check); lax.scan cannot early-exit.
+        tokens_buf = jnp.full((b, t_max), pad_token_id, jnp.int32)
+        logp_buf = jnp.zeros((b, t_max), jnp.float32)
+        mask_buf = (jnp.zeros((b, t_max, cfg.vocab_size), bool)
+                    if want_mask else jnp.zeros((1,), bool))
 
-    if gconfig.force_no_logits_mask:
-        tokens, logprobs = outs
-        logits_mask = None
+        def w_cond(c):
+            step = c[0]
+            unfinished = c[3]
+            return (step < t_max) & jnp.any(unfinished)
+
+        def w_body(c):
+            step, last_hidden, cache, unfinished, emitted, bufs = c
+            tb, lb, mb = bufs
+            last_hidden, cache, unfinished, emitted, tok, lp, mask = \
+                step_once(last_hidden, cache, unfinished, emitted,
+                          step, keys[step])
+            tb = jax.lax.dynamic_update_slice(tb, tok[:, None],
+                                              (0, step))
+            lb = jax.lax.dynamic_update_slice(lb, lp[:, None], (0, step))
+            if want_mask:
+                mb = jax.lax.dynamic_update_slice(
+                    mb, mask[:, None, :], (0, step, 0))
+            return (step + 1, last_hidden, cache, unfinished, emitted,
+                    (tb, lb, mb))
+
+        init = (jnp.int32(0), last_hidden, cache, jnp.ones((b,), bool),
+                jnp.zeros((b,), jnp.int32),
+                (tokens_buf, logp_buf, mask_buf))
+        (_, _, _, unfinished, emitted,
+         (tokens, logprobs, logits_mask)) = jax.lax.while_loop(
+             w_cond, w_body, init)
+        if not want_mask:
+            logits_mask = None
     else:
-        tokens, logprobs, logits_mask = outs
-        logits_mask = logits_mask.swapaxes(0, 1)  # [B, T, V]
-    tokens = tokens.T  # [B, T]
-    logprobs = logprobs.T
+        def body(carry, x):
+            last_hidden, cache, unfinished, emitted = carry
+            step_idx, k = x
+            last_hidden, cache, unfinished, emitted, tok, lp, mask = \
+                step_once(last_hidden, cache, unfinished, emitted,
+                          step_idx, k)
+            out = (tok, lp, mask) if want_mask else (tok, lp)
+            return (last_hidden, cache, unfinished, emitted), out
+
+        init = (last_hidden, cache, jnp.ones((b,), bool),
+                jnp.zeros((b,), jnp.int32))
+        (_, _, unfinished, emitted), outs = jax.lax.scan(
+            body, init, (jnp.arange(t_max), keys))
+        if want_mask:
+            tokens, logprobs, logits_mask = outs
+            logits_mask = logits_mask.swapaxes(0, 1)  # [B, T, V]
+        else:
+            tokens, logprobs = outs
+            logits_mask = None
+        tokens = tokens.T  # [B, T]
+        logprobs = logprobs.T
     return GenerationOutput(
         tokens=tokens,
         logprobs=logprobs,
