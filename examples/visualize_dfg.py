@@ -1,0 +1,59 @@
+"""Example: inspect and export an experiment's dataflow graph.
+
+TPU-native counterpart of the reference's ``examples/visualize_dfg.py``:
+build any experiment config, walk its MFC graph (nodes = model
+function calls, edges = data keys), print a topological summary, and
+emit a Graphviz DOT file you can render with ``dot -Tpng``.
+
+Run::
+
+    PYTHONPATH=. python examples/visualize_dfg.py [out.dot]
+"""
+
+import sys
+
+from realhf_tpu.api.dfg import DFG
+from realhf_tpu.experiments.ppo_exp import PPOConfig
+
+
+def describe(dfg: DFG) -> str:
+    lines = []
+    for node in dfg.topological_order():
+        src = " (source)" if node.is_src else ""
+        dst = " (sink)" if node.is_dst else ""
+        lines.append(f"{node.name}{src}{dst}: role={node.role} "
+                     f"type={node.interface_type.value}")
+        for parent in node.parents:
+            shared = set(node.input_keys) & set(parent.output_keys)
+            lines.append(f"    <- {parent.name} [{', '.join(sorted(shared))}]")
+    return "\n".join(lines)
+
+
+def to_dot(dfg: DFG) -> str:
+    out = ["digraph dfg {", "  rankdir=LR;"]
+    for node in dfg.nodes:
+        shape = {"generate": "cds", "inference": "ellipse",
+                 "train_step": "box"}[node.interface_type.value]
+        out.append(f'  "{node.name}" [shape={shape}, '
+                   f'label="{node.name}\\n{node.role}"];')
+    for node in dfg.nodes:
+        for parent in node.parents:
+            shared = set(node.input_keys) & set(parent.output_keys)
+            out.append(f'  "{parent.name}" -> "{node.name}" '
+                       f'[label="{", ".join(sorted(shared))}"];')
+    out.append("}")
+    return "\n".join(out)
+
+
+def main():
+    spec = PPOConfig(experiment_name="vis", trial_name="t0").build()
+    dfg = DFG(spec.mfcs)
+    print(describe(dfg))
+    path = sys.argv[1] if len(sys.argv) > 1 else "dfg.dot"
+    with open(path, "w") as f:
+        f.write(to_dot(dfg) + "\n")
+    print(f"\nDOT written to {path} (render: dot -Tpng {path} -o dfg.png)")
+
+
+if __name__ == "__main__":
+    main()
