@@ -31,6 +31,13 @@ class ModelSpec:
         default_factory=ParallelismConfig)
     gradient_checkpointing: bool = True
     bf16: bool = True
+    # Host-RAM-bounded checkpoint load (hf/registry.py
+    # load_hf_checkpoint_streamed): place weights layer-by-layer
+    # directly onto the mesh; peak host memory = one transformer layer
+    # + embeddings instead of the full model. Required for >host-RAM
+    # models (70B); off by default because the eager path is faster
+    # for small checkpoints.
+    streamed_load: bool = False
     # Set by the RECOVERY path when `path` was redirected to a recover
     # checkpoint: restore saved Adam moments/master alongside the
     # weights. Never set for ordinary warm-starts from a checkpoint

@@ -14,6 +14,7 @@ from realhf_tpu.models.hf.registry import (  # noqa: F401
     config_from_hf,
     config_to_hf,
     load_hf_checkpoint,
+    load_hf_checkpoint_streamed,
     params_from_hf,
     params_to_hf,
     register_hf_family,

@@ -75,8 +75,8 @@ def _params_from_hf(state: StateDict, cfg: TransformerConfig) -> Dict[str, Any]:
     nl, h = cfg.n_layers, cfg.hidden_dim
     pre = "transformer.h.{}."
     if "transformer.wte.weight" not in state:  # bare GPT2Model naming
-        state = {f"transformer.{k}" if not k.startswith("transformer.")
-                 and k != "lm_head.weight" else k: v for k, v in state.items()}
+        from realhf_tpu.models.hf.registry import PrefixedStateView
+        state = PrefixedStateView(state, "transformer.")
     # Fused QKV (Conv1D, (in, 3h)) -> separate (in, out) mats.
     c_attn_w = stack_layers(state, pre + "attn.c_attn.weight", nl)  # [nl, h, 3h]
     c_attn_b = stack_layers(state, pre + "attn.c_attn.bias", nl)    # [nl, 3h]

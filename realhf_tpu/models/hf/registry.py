@@ -131,6 +131,225 @@ def load_hf_checkpoint(path: str, family: Optional[str] = None,
     return cfg, params
 
 
+class _LazyShardState:
+    """Dict-like view over a sharded safetensors checkpoint that reads
+    ONE tensor at a time (``safetensors.safe_open``), so host memory
+    never holds a full shard, let alone the full model."""
+
+    def __init__(self, path: str):
+        self._path = path
+        index_path = os.path.join(path, _INDEX_NAME)
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self._weight_map = json.load(f)["weight_map"]
+        else:
+            import safetensors
+
+            fname = "model.safetensors"
+            with safetensors.safe_open(os.path.join(path, fname),
+                                       framework="np") as f:
+                self._weight_map = {k: fname for k in f.keys()}
+        self._handles: Dict[str, Any] = {}
+
+    def _handle(self, fname: str):
+        if fname not in self._handles:
+            import safetensors
+            self._handles[fname] = safetensors.safe_open(
+                os.path.join(self._path, fname), framework="np")
+        return self._handles[fname]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._weight_map
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return self._handle(self._weight_map[key]).get_tensor(key)
+
+
+class PrefixedStateView:
+    """Lazy key-rename view for bare (headless) HF exports whose keys
+    lack a container prefix (e.g. GPT2Model without ``transformer.``):
+    behaves like the renamed dict without materializing the state, so
+    the streamed loader's one-tensor-at-a-time discipline survives."""
+
+    def __init__(self, base, prefix: str,
+                 passthrough: tuple = ("lm_head.weight",)):
+        self._base = base
+        self._prefix = prefix
+        self._passthrough = passthrough
+
+    def _map(self, key: str) -> str:
+        if key in self._passthrough or not key.startswith(self._prefix):
+            return key
+        return key[len(self._prefix):]
+
+    def __contains__(self, key: str) -> bool:
+        return self._map(key) in self._base
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return self._base[self._map(key)]
+
+
+class _LayerKeyView:
+    """Remap a single-layer converter's layer-0 keys onto layer ``i``
+    of the real checkpoint (``model.layers.0.`` -> ``model.layers.i.``,
+    ``transformer.h.0.`` -> ``transformer.h.i.``). Keys the layer
+    pattern does NOT match (embeddings, final norm, head) are memoized
+    across views: the converter rebuilds the full single-layer pytree
+    once per layer, and without the cache those multi-GB tensors would
+    be re-read from disk n_layers times for nothing (only the i==0
+    copies are kept)."""
+
+    _PAT = None  # compiled lazily (re import at module top kept minimal)
+
+    def __init__(self, base, layer: int, nonlayer_cache: dict):
+        import re
+        if _LayerKeyView._PAT is None:
+            # bare (container-less) exports drop the leading
+            # "model."/"transformer." -- accept both namings
+            _LayerKeyView._PAT = re.compile(
+                r"^((?:model\.layers|transformer\.h|layers|h)\.)0\.")
+        self._base = base
+        self._sub = r"\g<1>%d." % layer
+        self._cache = nonlayer_cache
+
+    def _map(self, key: str) -> str:
+        return _LayerKeyView._PAT.sub(self._sub, key)
+
+    def __contains__(self, key: str) -> bool:
+        return self._map(key) in self._base
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        mapped = self._map(key)
+        if mapped == key:  # non-layer key: read once, reuse per layer
+            if key not in self._cache:
+                self._cache[key] = self._base[key]
+            return self._cache[key]
+        return self._base[mapped]
+
+
+def load_hf_checkpoint_streamed(path: str, mesh, family: Optional[str] = None,
+                                is_critic: bool = False,
+                                param_dtype: Optional[str] = None):
+    """Host-RAM-bounded checkpoint load directly onto a device mesh.
+
+    ``load_hf_checkpoint`` materializes the full model in host RAM
+    before placement -- fine up to ~10B, impossible for the 70B the
+    framework targets (140 GB bf16 against typical host RAM). This
+    variant streams: the family converter runs once per transformer
+    layer on a single-layer view of the checkpoint (safetensors
+    ``safe_open`` reads one tensor at a time), each layer slice is cast
+    and written into preallocated sharded device buffers with a
+    donating ``dynamic_update_slice``, and only the non-stacked leaves
+    (embeddings, final norm, head) are ever fully resident on host.
+    Peak host memory = one transformer layer + embeddings. The
+    reference's per-rank shard loading (``hf_registry.load:62``) solves
+    the same problem GPU-side.
+
+    Returns ``(cfg, params)`` with every leaf a global device array
+    sharded per ``models/sharding.py`` rules on ``mesh`` (vocab already
+    Megatron-padded for the mesh's tp) -- hand to ``Engine`` with
+    ``already_sharded`` semantics (its device_put is then a no-op).
+    """
+    import copy
+
+    import jax
+    import jax.numpy as jnp
+
+    from realhf_tpu.models import sharding as shard_rules
+    from realhf_tpu.models import transformer as T
+
+    family = family or detect_family(path)
+    with open(os.path.join(path, "config.json")) as f:
+        hf_config = json.load(f)
+    cfg = config_from_hf(family, hf_config, is_critic=is_critic)
+    if param_dtype is not None:
+        cfg.param_dtype = param_dtype
+    tdt = np.dtype(jnp.dtype(cfg.param_dtype).name)
+    tp = int(mesh.shape.get("model", 1))
+
+    state = _LazyShardState(path)
+    cfg1 = copy.copy(cfg)
+    cfg1.n_layers = 1
+
+    shardings = shard_rules.param_shardings(cfg, mesh)
+
+    def put_full(leaf, sh):
+        return jax.device_put(np.asarray(leaf).astype(tdt, copy=False), sh)
+
+    write_cache: Dict[Any, Any] = {}
+
+    def write_slice(buf, sl, i, sh):
+        key = (buf.shape, buf.dtype, sh)
+        if key not in write_cache:
+            write_cache[key] = jax.jit(
+                lambda b, s, j: jax.lax.dynamic_update_slice_in_dim(
+                    b, s, j, axis=0),
+                donate_argnums=0, out_shardings=sh)
+        return write_cache[key](buf, sl.astype(tdt, copy=False),
+                                jnp.int32(i))
+
+    def sharding_at(kp):
+        """Leaf sharding looked up BY PATH (a critic's converter pytree
+        has no "head" until the value head lands below, so positional
+        zips against the shardings pytree would misalign)."""
+        node = shardings
+        for entry in kp:
+            node = node[entry.key]
+        return node
+
+    params: Optional[Dict[str, Any]] = None
+    p_flat_sh = []
+    nonlayer_cache: Dict[str, np.ndarray] = {}
+    for i in range(cfg.n_layers):
+        sub = params_from_hf(family,
+                             _LayerKeyView(state, i, nonlayer_cache),
+                             cfg1)
+        if i == 0:
+            # Vocab-dim leaves pad to the tp multiple host-side (tiny:
+            # embeddings only), matching Engine.normalize_vocab_padding.
+            sub = shard_rules.normalize_vocab_padding(cfg1, sub, tp)
+            sub_flat = jax.tree_util.tree_flatten_with_path(sub)[0]
+            treedef = jax.tree_util.tree_structure(sub)
+            leaves = []
+            for kp, leaf in sub_flat:
+                sh = sharding_at(kp)
+                p_flat_sh.append(sh)
+                if kp and getattr(kp[0], "key", None) == "blocks":
+                    full_shape = (cfg.n_layers,) + tuple(leaf.shape[1:])
+                    buf = jax.jit(
+                        lambda shp=full_shape: jnp.zeros(shp, tdt),
+                        out_shardings=sh)()
+                    leaves.append(write_slice(buf, leaf, 0, sh))
+                else:
+                    leaves.append(put_full(leaf, sh))
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+        else:
+            sub_flat = jax.tree_util.tree_flatten_with_path(sub)[0]
+            p_leaves = jax.tree_util.tree_leaves(params)
+            new_leaves = []
+            for (kp, leaf), buf, sh in zip(sub_flat, p_leaves, p_flat_sh):
+                if kp and getattr(kp[0], "key", None) == "blocks":
+                    new_leaves.append(write_slice(buf, leaf, i, sh))
+                else:
+                    new_leaves.append(buf)  # embed/norm/head: done at i=0
+            params = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(params), new_leaves)
+
+    vh_path = os.path.join(path, _VALUE_HEAD_NAME)
+    if is_critic:
+        import safetensors.numpy
+        if os.path.exists(vh_path):
+            vh = safetensors.numpy.load_file(vh_path)
+            w = vh["value_head.weight"]
+        else:
+            rng = np.random.RandomState(0)
+            w = rng.normal(0, 0.02,
+                           size=(cfg.hidden_dim, 1)).astype(np.float32)
+            logger.info("Initialized critic value head from scratch.")
+        params["head"] = {"w": put_full(w, shardings["head"]["w"])}
+    return cfg, params
+
+
 def save_hf_checkpoint(path: str, family: str, cfg: TransformerConfig,
                        params: Dict[str, Any],
                        tokenizer: Optional[Any] = None):

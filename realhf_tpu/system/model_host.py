@@ -41,11 +41,27 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
     even though its display name carries the MFC suffix."""
     from realhf_tpu.parallel.mesh import default_devices
 
+    # One mesh for both the (possibly streamed) load and the Engine:
+    # the streamed loader places weights with this mesh's shardings,
+    # and Engine.__init__'s device_put is then a no-op by identity.
+    if devices is None:
+        devices = default_devices()[:spec.parallel.world_size]
+    mesh = make_mesh(spec.parallel, devices=devices)
+
     if params_override is not None:
         # Replica path: reuse the primary's live weights (device_put in
         # Engine.__init__ reshards them) instead of re-reading the
         # checkpoint.
         cfg, params = cfg_override, params_override
+    elif spec.path and spec.streamed_load:
+        # Host-RAM-bounded: stream layer-by-layer straight onto the
+        # mesh (needed for >host-RAM models; hf/registry.py).
+        from realhf_tpu.models.hf import load_hf_checkpoint_streamed
+
+        cfg, params = load_hf_checkpoint_streamed(
+            spec.path, mesh, spec.hf_family,
+            is_critic=spec.is_critic or spec.init_critic_from_actor,
+            param_dtype="bfloat16" if spec.bf16 else None)
     elif spec.path:
         cfg, params = load_hf_checkpoint(
             spec.path, spec.hf_family,
@@ -74,9 +90,6 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
                else seeding.derive_key("model_init", skey))
         params = T.init_params(cfg, key)
 
-    if devices is None:
-        devices = default_devices()[:spec.parallel.world_size]
-    mesh = make_mesh(spec.parallel, devices=devices)
     ctx = MeshContext(ModelName(role, 0), mesh, spec.parallel)
     engine = Engine(cfg, ctx, params, optimizer=spec.optimizer,
                     total_train_steps=total_steps)
