@@ -19,4 +19,5 @@ from realhf_tpu.models.hf.registry import (  # noqa: F401
     params_to_hf,
     register_hf_family,
     save_hf_checkpoint,
+    save_hf_checkpoint_streamed,
 )

@@ -165,6 +165,22 @@ class _LazyShardState:
         return self._handle(self._weight_map[key]).get_tensor(key)
 
 
+# Layer-container prefixes across families (bare, container-less
+# exports drop the leading "model."/"transformer."): the SINGLE place
+# the streamed loader's layer-key detection and the streamed saver's
+# shard-key renaming agree on.
+_LAYER_KEY_PAT = None
+
+
+def _layer_key_pat():
+    global _LAYER_KEY_PAT
+    if _LAYER_KEY_PAT is None:
+        import re
+        _LAYER_KEY_PAT = re.compile(
+            r"^((?:model\.layers|transformer\.h|layers|h)\.)0\.")
+    return _LAYER_KEY_PAT
+
+
 class PrefixedStateView:
     """Lazy key-rename view for bare (headless) HF exports whose keys
     lack a container prefix (e.g. GPT2Model without ``transformer.``):
@@ -199,21 +215,13 @@ class _LayerKeyView:
     be re-read from disk n_layers times for nothing (only the i==0
     copies are kept)."""
 
-    _PAT = None  # compiled lazily (re import at module top kept minimal)
-
     def __init__(self, base, layer: int, nonlayer_cache: dict):
-        import re
-        if _LayerKeyView._PAT is None:
-            # bare (container-less) exports drop the leading
-            # "model."/"transformer." -- accept both namings
-            _LayerKeyView._PAT = re.compile(
-                r"^((?:model\.layers|transformer\.h|layers|h)\.)0\.")
         self._base = base
         self._sub = r"\g<1>%d." % layer
         self._cache = nonlayer_cache
 
     def _map(self, key: str) -> str:
-        return _LayerKeyView._PAT.sub(self._sub, key)
+        return _layer_key_pat().sub(self._sub, key)
 
     def __contains__(self, key: str) -> bool:
         return self._map(key) in self._base
@@ -401,6 +409,105 @@ def save_hf_checkpoint(path: str, family: str, cfg: TransformerConfig,
     if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):
         tokenizer.save_pretrained(path)
     logger.info("Saved %s checkpoint to %s", family, path)
+
+
+def save_hf_checkpoint_streamed(path: str, family: str,
+                                cfg: TransformerConfig,
+                                params: Dict[str, Any],
+                                tokenizer: Optional[Any] = None):
+    """Host-RAM-bounded HF save: one safetensors shard per transformer
+    layer, written from a single-layer slice of the (device-resident,
+    possibly sharded) params -- the mirror of
+    ``load_hf_checkpoint_streamed``. Peak host memory is one layer
+    plus the non-stacked leaves (embeddings, norms, head), where the
+    eager ``save_hf_checkpoint`` holds the full model TWICE (numpy
+    pytree + converted HF state dict). Single-process meshes only: on
+    a process-spanning mesh use ``Engine.params_numpy`` (the
+    collective leaf-by-leaf gather) with the eager save.
+    """
+    import copy
+
+    import jax
+    import safetensors.numpy
+
+    procs = {d.process_index
+             for leaf in jax.tree.leaves(params)
+             if hasattr(leaf, "sharding")
+             for d in leaf.sharding.device_set}
+    if len(procs) > 1:
+        raise ValueError(
+            "save_hf_checkpoint_streamed needs fully-addressable "
+            "params; gather with Engine.params_numpy (collective) and "
+            "use save_hf_checkpoint on a process-spanning mesh.")
+
+    os.makedirs(path, exist_ok=True)
+    cfg1 = copy.copy(cfg)
+    cfg1.n_layers = 1
+    pat = _layer_key_pat()
+
+    params = dict(params)
+    value_head = None
+    if cfg.is_critic:
+        value_head = np.asarray(params.pop("head")["w"])
+
+    # Non-stacked leaves: one host gather, vocab-unpadded, reused by
+    # every per-layer conversion pass (the converters emit them each
+    # pass; only pass 0's copies are written).
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    nonlayer_host = {}
+    from realhf_tpu.models.sharding import repad_vocab_leaf
+    for kp, leaf in flat:
+        if not (kp and getattr(kp[0], "key", None) == "blocks"):
+            keypath = tuple(e.key for e in kp)
+            # checkpoints store the true vocab; the device copy is
+            # Megatron-padded for its tp (repad to tp=1 == unpad)
+            nonlayer_host[keypath] = repad_vocab_leaf(
+                cfg, keypath, np.asarray(leaf), target_tp=1)
+
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config_to_hf(family, cfg), f, indent=2)
+
+    n_files = cfg.n_layers + 1
+    weight_map: Dict[str, str] = {}
+    total_bytes = 0
+
+    def write_file(idx: int, state: StateDict):
+        nonlocal total_bytes
+        name = f"model-{idx + 1:05d}-of-{n_files:05d}.safetensors"
+        safetensors.numpy.save_file(state, os.path.join(path, name))
+        weight_map.update({k: name for k in state})
+        total_bytes += sum(v.nbytes for v in state.values())
+
+    for i in range(cfg.n_layers):
+        leaves = []
+        for kp, leaf in flat:
+            if kp and getattr(kp[0], "key", None) == "blocks":
+                leaves.append(np.asarray(leaf[i:i + 1]))
+            else:
+                leaves.append(nonlayer_host[tuple(e.key for e in kp)])
+        tree_i = jax.tree_util.tree_unflatten(treedef, leaves)
+        state_i = params_to_hf(family, tree_i, cfg1)
+        layer_state = {
+            pat.sub(r"\g<1>%d." % i, k): v
+            for k, v in state_i.items() if pat.match(k)}
+        write_file(i, layer_state)
+        if i == 0:
+            write_file(cfg.n_layers, {k: v for k, v in state_i.items()
+                                      if not pat.match(k)})
+
+    with open(os.path.join(path, _INDEX_NAME), "w") as f:
+        json.dump({"metadata": {"total_size": total_bytes},
+                   "weight_map": weight_map}, f, indent=2)
+
+    if value_head is not None:
+        safetensors.numpy.save_file(
+            {"value_head.weight": value_head},
+            os.path.join(path, _VALUE_HEAD_NAME))
+    if tokenizer is not None and hasattr(tokenizer, "save_pretrained"):
+        tokenizer.save_pretrained(path)
+    logger.info("Saved %s checkpoint (streamed, %d shards) to %s",
+                family, n_files, path)
 
 
 def _to_numpy(tree):

@@ -15,6 +15,7 @@ from realhf_tpu.models.hf import (
     load_hf_checkpoint,
     load_hf_checkpoint_streamed,
     save_hf_checkpoint,
+    save_hf_checkpoint_streamed,
 )
 from realhf_tpu.parallel.mesh import ParallelismConfig, make_mesh
 
@@ -164,6 +165,85 @@ def test_build_model_streamed_flag(tmp_path):
                     jax.tree.leaves(m_e.engine.params)):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_streamed_vocab_padding_roundtrip(tmp_path):
+    """vocab_size NOT divisible by tp: the streamed loader must pad
+    wte/head for the mesh's tp and the streamed saver must strip that
+    padding back to the true vocab (the early-return paths hide both
+    when vocab % tp == 0)."""
+    import jax.numpy as jnp
+
+    cfg = _cfg("llama", vocab=97)  # 97 % 2 != 0 -> real padding
+    host = jax.tree.map(np.asarray, T.init_params(cfg,
+                                                  jax.random.PRNGKey(6)))
+    path = str(tmp_path / "m")
+    save_hf_checkpoint(path, "llama", cfg, host)
+
+    mesh = make_mesh(ParallelismConfig(data_parallel_size=4,
+                                       tensor_parallel_size=2))
+    _, streamed = load_hf_checkpoint_streamed(path, mesh, family="llama")
+    assert streamed["embed"]["wte"].shape[0] == 98  # padded to tp mult
+    assert streamed["head"]["w"].shape[1] == 98
+    back = shard_rules.unpad_vocab(cfg, jax.tree.map(np.asarray, streamed))
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(host)[0],
+            jax.tree_util.tree_flatten_with_path(back)[0]):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-6, atol=1e-7, err_msg=str(kp))
+
+    # streamed SAVE from the padded device params strips the padding
+    out = str(tmp_path / "out")
+    save_hf_checkpoint_streamed(out, "llama", cfg, streamed)
+    _, loaded = load_hf_checkpoint(out, family="llama")
+    assert loaded["embed"]["wte"].shape[0] == 97
+    np.testing.assert_allclose(
+        np.asarray(loaded["head"]["w"], np.float32),
+        np.asarray(host["head"]["w"], np.float32), rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_streamed_save_roundtrip(family, tmp_path):
+    """save_hf_checkpoint_streamed (one shard per layer, sliced from
+    sharded device arrays) produces a directory the EAGER loader reads
+    back to the exact original weights."""
+    import jax.numpy as jnp
+
+    cfg = _cfg(family)
+    host = jax.tree.map(np.asarray, T.init_params(cfg,
+                                                  jax.random.PRNGKey(5)))
+    mesh = make_mesh(ParallelismConfig(data_parallel_size=4,
+                                       tensor_parallel_size=2))
+    padded = shard_rules.pad_vocab(cfg, host, 2)
+    dev = jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: jax.device_put(
+            jnp.asarray(leaf),
+            _sharding_at(shard_rules.param_shardings(cfg, mesh), kp)),
+        padded)
+    path = str(tmp_path / "out")
+    save_hf_checkpoint_streamed(path, family, cfg, dev)
+
+    import os
+    shard_files = [f for f in os.listdir(path)
+                   if f.endswith(".safetensors")]
+    assert len(shard_files) == cfg.n_layers + 1  # one per layer + rest
+
+    _, loaded = load_hf_checkpoint(path, family=family)
+    e_flat = jax.tree_util.tree_flatten_with_path(host)[0]
+    l_flat = jax.tree_util.tree_flatten_with_path(loaded)[0]
+    assert [k for k, _ in e_flat] == [k for k, _ in l_flat]
+    for (kp, a), (_, b) in zip(e_flat, l_flat):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-6, atol=1e-7, err_msg=str(kp))
+
+
+def _sharding_at(shardings, kp):
+    node = shardings
+    for entry in kp:
+        node = node[entry.key]
+    return node
 
 
 def test_streamed_bf16_cast(tmp_path):
