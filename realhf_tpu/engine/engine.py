@@ -68,6 +68,8 @@ class Engine:
         self._mesh_procs = sorted(
             {d.process_index for d in self.mesh.devices.flat})
         self._multiproc = len(self._mesh_procs) > 1
+        # (read `engine.multiproc` from outside; collective-count
+        # decisions in the runtime key on it)
         if self._multiproc:
             import jax as _jax
             mine = _jax.process_index()
@@ -242,6 +244,12 @@ class Engine:
     # ------------------------------------------------------------------
     # Multi-process (worker-group) helpers
     # ------------------------------------------------------------------
+    @property
+    def multiproc(self) -> bool:
+        """True when this engine's mesh spans >1 OS process; gathers /
+        saves are then collectives every member must join."""
+        return self._multiproc
+
     @property
     def _replicated_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P

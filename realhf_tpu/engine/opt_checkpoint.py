@@ -36,17 +36,40 @@ def _to_savable(a: np.ndarray):
 def save_opt_state(path: str, host_leaves: List[np.ndarray]) -> str:
     """Write gathered host leaves (Engine.opt_state_numpy()) to
     ``path/optimizer_state.npz``."""
-    arrays = {}
-    dtypes = []
-    for i, a in enumerate(host_leaves):
-        arr, dt = _to_savable(np.asarray(a))
-        arrays[f"l{i}"] = arr
-        dtypes.append(dt)
-    arrays["__meta__"] = np.frombuffer(
-        json.dumps({"n": len(host_leaves), "dtypes": dtypes})
-        .encode(), dtype=np.uint8)
+    return save_opt_state_iter(path, iter(host_leaves))
+
+
+def save_opt_state_iter(path: str, leaves) -> str:
+    """Streaming form of :func:`save_opt_state`: consumes an iterator
+    of leaves and writes each straight into the npz (a zip of .npy
+    members, same layout ``np.savez`` produces and ``load_opt_state``
+    reads), so only ONE leaf is ever host-resident. On single-process
+    meshes the caller feeds ``np.asarray(leaf)`` per device leaf --
+    the optimizer state is ~3x the model in fp32, the difference
+    between fitting host RAM and not at the 70B scale."""
+    import zipfile
+
+    from numpy.lib import format as npformat
+
     out = os.path.join(path, FILENAME)
-    np.savez(out, **arrays)
+    dtypes = []
+    n = 0
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_STORED,
+                         allowZip64=True) as zf:
+        for a in leaves:
+            arr, dt = _to_savable(np.asarray(a))
+            dtypes.append(dt)
+            with zf.open(f"l{n}.npy", "w", force_zip64=True) as fh:
+                # NOT ascontiguousarray: it promotes 0-d leaves (optax
+                # step counters) to 1-d, breaking the restore's
+                # structure check
+                npformat.write_array(fh, np.asarray(arr, order="C"))
+            n += 1
+        meta = np.frombuffer(
+            json.dumps({"n": n, "dtypes": dtypes}).encode(),
+            dtype=np.uint8)
+        with zf.open("__meta__.npy", "w", force_zip64=True) as fh:
+            npformat.write_array(fh, meta)
     return out
 
 

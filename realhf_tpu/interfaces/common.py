@@ -131,3 +131,26 @@ def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:
         out.append(StreamBatch(info=b.info, arrays=arrays,
                                n_tokens=b.n_tokens))
     return out
+
+
+def save_checkpoint(model, save_dir: str, host_params=None):
+    """Shared interface-save body (reference interfaces all end in the
+    same ``api.save_hf(...)`` call).
+
+    ``host_params`` is the pre-gathered host copy the ModelHost hands
+    in on MULTI-process meshes (the gather is a collective every
+    member must join -- see ModelHost.save_role). Without it the mesh
+    is fully addressable, so save streams one layer at a time straight
+    from the device arrays (``save_hf_checkpoint_streamed``) and never
+    materializes the full model on host."""
+    from realhf_tpu.models.hf import (
+        save_hf_checkpoint,
+        save_hf_checkpoint_streamed,
+    )
+    if host_params is not None:
+        save_hf_checkpoint(save_dir, model.hf_family, model.config,
+                           host_params, tokenizer=model.tokenizer)
+    else:
+        save_hf_checkpoint_streamed(save_dir, model.hf_family,
+                                    model.config, model.engine.params,
+                                    tokenizer=model.tokenizer)

@@ -19,7 +19,6 @@ from realhf_tpu.api.data import SequenceSample
 from realhf_tpu.base import logging
 from realhf_tpu.interfaces import common
 from realhf_tpu.models import transformer as T
-from realhf_tpu.models.hf import save_hf_checkpoint
 from realhf_tpu.ops import functional as F
 
 logger = logging.getLogger("DPOInterface")
@@ -168,10 +167,7 @@ class DPOInterface(model_api.ModelInterface):
              host_params=None):
         if not self.enable_save:
             return
-        save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           host_params if host_params is not None
-                           else model.engine.params_numpy(),
-                           tokenizer=model.tokenizer)
+        common.save_checkpoint(model, save_dir, host_params)
 
 
 model_api.register_interface("dpo", DPOInterface)

@@ -22,7 +22,6 @@ from realhf_tpu.base.datapack import flat2d
 from realhf_tpu.engine import packing
 from realhf_tpu.interfaces import common, ppo_functional
 from realhf_tpu.models import transformer as T
-from realhf_tpu.models.hf import save_hf_checkpoint
 from realhf_tpu.ops import functional as F
 from realhf_tpu.ops.gae import gae_packed_numpy
 from realhf_tpu.ops.sampling import GenerationHyperparameters
@@ -362,10 +361,7 @@ class PPOActorInterface(model_api.ModelInterface):
              host_params=None):
         if not self.enable_save:
             return
-        save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           host_params if host_params is not None
-                           else model.engine.params_numpy(),
-                           tokenizer=model.tokenizer)
+        common.save_checkpoint(model, save_dir, host_params)
 
 
 @dataclasses.dataclass
@@ -531,10 +527,7 @@ class PPOCriticInterface(model_api.ModelInterface):
              host_params=None):
         if not self.enable_save:
             return
-        save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           host_params if host_params is not None
-                           else model.engine.params_numpy(),
-                           tokenizer=model.tokenizer)
+        common.save_checkpoint(model, save_dir, host_params)
 
 
 model_api.register_interface("ppo_actor", PPOActorInterface)

@@ -16,7 +16,6 @@ from realhf_tpu.api.data import SequenceSample
 from realhf_tpu.base import logging
 from realhf_tpu.interfaces import common
 from realhf_tpu.models import transformer as T
-from realhf_tpu.models.hf import save_hf_checkpoint
 from realhf_tpu.ops import functional as F
 
 logger = logging.getLogger("SFTInterface")
@@ -114,10 +113,7 @@ class SFTInterface(model_api.ModelInterface):
 
     def save(self, model: model_api.Model, save_dir: str,
              host_params=None):
-        save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           host_params if host_params is not None
-                           else model.engine.params_numpy(),
-                           tokenizer=model.tokenizer)
+        common.save_checkpoint(model, save_dir, host_params)
 
 
 model_api.register_interface("sft", SFTInterface)

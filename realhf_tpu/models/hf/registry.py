@@ -479,13 +479,23 @@ def save_hf_checkpoint_streamed(path: str, family: str,
         weight_map.update({k: name for k in state})
         total_bytes += sum(v.nbytes for v in state.values())
 
+    # i>0 passes only keep the LAYER keys of the converter output, so
+    # the non-layer leaves get rank-preserving 1-element stand-ins
+    # there -- converting real multi-GB embeddings n_layers times
+    # would dominate the save this function exists to make cheap.
+    nonlayer_dummy = {
+        k: np.zeros((1,) * v.ndim, v.dtype)
+        for k, v in nonlayer_host.items()}
+
     for i in range(cfg.n_layers):
         leaves = []
         for kp, leaf in flat:
             if kp and getattr(kp[0], "key", None) == "blocks":
                 leaves.append(np.asarray(leaf[i:i + 1]))
             else:
-                leaves.append(nonlayer_host[tuple(e.key for e in kp)])
+                keypath = tuple(e.key for e in kp)
+                leaves.append(nonlayer_host[keypath] if i == 0
+                              else nonlayer_dummy[keypath])
         tree_i = jax.tree_util.tree_unflatten(treedef, leaves)
         state_i = params_to_hf(family, tree_i, cfg1)
         layer_state = {

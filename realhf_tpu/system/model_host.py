@@ -426,18 +426,34 @@ class ModelHost:
         # it HERE on every group member and hand the host copy to the
         # interface, so leader and member collective counts match by
         # construction no matter what the interface's save() does.
-        host_params = model.engine.params_numpy()
+        # Single-process meshes skip the gather entirely: the
+        # interface then streams one layer at a time from the device
+        # arrays (interfaces/common.py save_checkpoint), never holding
+        # the full model on host.
+        multiproc = model.engine.multiproc
+        host_params = model.engine.params_numpy() if multiproc else None
         host_opt = (model.engine.opt_state_numpy()
-                    if model.engine.opt_state is not None else None)
+                    if model.engine.opt_state is not None and multiproc
+                    else None)
         if not self.leader_of_role.get(role, True):
             return None
         self.interfaces[train_node_name].save(model, path,
                                               host_params=host_params)
-        if host_opt is not None:
+        if model.engine.opt_state is not None:
             # EXCEEDS reference: Adam moments + fp32 master survive
             # recovery instead of re-warming from zero (§5.4)
+            import numpy as _np
+
+            import jax as _jax
+
             from realhf_tpu.engine import opt_checkpoint
-            opt_checkpoint.save_opt_state(path, host_opt)
+            if host_opt is not None:
+                opt_checkpoint.save_opt_state(path, host_opt)
+            else:
+                # single-process: one leaf host-resident at a time
+                opt_checkpoint.save_opt_state_iter(
+                    path, (_np.asarray(l) for l in
+                           _jax.tree.leaves(model.engine.opt_state)))
         logger.info("Saved %s to %s", role, path)
         return path
 

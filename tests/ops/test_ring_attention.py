@@ -145,13 +145,18 @@ def test_engine_ctx_parallel_matches_and_trains():
                              loss_fn_key="cp")
     assert st["loss"] < s0["loss"]
 
-    with pytest.raises(NotImplementedError):
-        eng.generate(np.zeros((2, 8), np.int32), np.ones((2, 8), np.int32),
-                     np.zeros((2, 8), np.int32), jax.random.PRNGKey(0),
-                     __import__("realhf_tpu.ops.sampling",
-                                fromlist=["GenerationHyperparameters"]
-                                ).GenerationHyperparameters(max_new_tokens=2),
-                     eos_token_id=None, pad_token_id=0)
+    # generation on the ctx mesh runs on the collapsed dp x tp decode
+    # view (engine.decode_engine; parity pinned in
+    # tests/engine/test_pp_generate.py::test_ctx_generate_matches_dense)
+    from realhf_tpu.ops.sampling import GenerationHyperparameters
+    out = eng.generate(
+        np.zeros((2, 8), np.int32), np.ones((2, 8), np.int32),
+        np.tile(np.arange(8, dtype=np.int32), (2, 1)),
+        jax.random.PRNGKey(0),
+        GenerationHyperparameters(max_new_tokens=2, min_new_tokens=1),
+        eos_token_id=None, pad_token_id=0)
+    assert np.asarray(out.tokens).shape == (2, 2)
+    assert eng.decode_engine() is not eng
 
 
 @pytest.mark.parametrize("causal", [True, False])
