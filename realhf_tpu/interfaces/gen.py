@@ -54,7 +54,7 @@ class GenerationInterface(model_api.ModelInterface):
         key = jax.random.fold_in(_base_key(), self._calls)
 
         if self.use_inflight_batching:
-            if model.engine._multiproc:
+            if model.engine.multiproc:
                 # InflightBatchingGenerator keeps process-local jnp
                 # state and reads arrays host-side (np.asarray), both
                 # invalid when the mesh spans worker processes.
