@@ -35,9 +35,11 @@ class ModelSpec:
     # load_hf_checkpoint_streamed): place weights layer-by-layer
     # directly onto the mesh; peak host memory = one transformer layer
     # + embeddings instead of the full model. Required for >host-RAM
-    # models (70B); off by default because the eager path is faster
-    # for small checkpoints.
-    streamed_load: bool = False
+    # models (70B). None (default) = automatic: stream when the
+    # checkpoint's safetensors total exceeds 16 GB (single-process
+    # meshes only -- process-spanning meshes need the explicit flag so
+    # every member takes the same collective path); True/False force.
+    streamed_load: Optional[bool] = None
     # Set by the RECOVERY path when `path` was redirected to a recover
     # checkpoint: restore saved Adam moments/master alongside the
     # weights. Never set for ordinary warm-starts from a checkpoint

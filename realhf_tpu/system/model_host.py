@@ -28,6 +28,44 @@ from realhf_tpu.parallel.realloc import ReplicaManager
 logger = logging.getLogger("model_host", "benchmark")
 
 
+# Auto streamed-load size cutoff (ModelSpec.streamed_load=None):
+# checkpoints whose safetensors total exceeds this stream layer-by-
+# layer instead of materializing on host first.
+STREAMED_LOAD_AUTO_BYTES = 16e9
+
+
+def _use_streamed_load(spec, multiproc: bool = False) -> bool:
+    flag = getattr(spec, "streamed_load", None)
+    if flag is not None:
+        return bool(flag)
+    if multiproc:
+        # Auto mode probes the LOCAL filesystem; on a process-spanning
+        # mesh a divergent verdict between members would mismatch their
+        # collective schedules (streamed = one device_put per layer
+        # slice) and hang. Only the explicit flag -- identical on every
+        # process by construction -- may stream there.
+        return False
+    try:
+        total = sum(
+            os.path.getsize(os.path.join(spec.path, f))
+            for f in os.listdir(spec.path) if f.endswith(".safetensors"))
+    except OSError as e:
+        logger.warning(
+            "Could not size checkpoint %s for the auto streamed-load "
+            "decision (%s); loading eagerly. Set "
+            "ModelSpec.streamed_load=True if this model exceeds host "
+            "RAM.", spec.path, e)
+        return False
+    if total > STREAMED_LOAD_AUTO_BYTES:
+        logger.info(
+            "Checkpoint %s is %.1f GB (> %.0f GB): loading streamed "
+            "(set ModelSpec.streamed_load=False to force the eager "
+            "path).", spec.path, total / 1e9,
+            STREAMED_LOAD_AUTO_BYTES / 1e9)
+        return True
+    return False
+
+
 def build_model(role: str, spec, tokenizer, total_steps: int,
                 devices=None, params_override=None,
                 cfg_override=None, init_seed=None,
@@ -53,7 +91,9 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
         # Engine.__init__ reshards them) instead of re-reading the
         # checkpoint.
         cfg, params = cfg_override, params_override
-    elif spec.path and spec.streamed_load:
+    elif spec.path and _use_streamed_load(
+            spec, multiproc=len({d.process_index
+                                 for d in mesh.devices.flat}) > 1):
         # Host-RAM-bounded: stream layer-by-layer straight onto the
         # mesh (needed for >host-RAM models; hf/registry.py).
         from realhf_tpu.models.hf import load_hf_checkpoint_streamed

@@ -167,6 +167,34 @@ def test_build_model_streamed_flag(tmp_path):
                                    np.asarray(b, np.float32), rtol=1e-6)
 
 
+def test_streamed_load_auto_threshold(tmp_path, monkeypatch):
+    """streamed_load=None streams automatically for checkpoints whose
+    safetensors total exceeds the cutoff, stays eager below it, and
+    False forces eager regardless."""
+    from realhf_tpu.api.experiment import ModelSpec
+    from realhf_tpu.system import model_host
+
+    cfg = _cfg("llama")
+    params = T.init_params(cfg, jax.random.PRNGKey(7))
+    path = str(tmp_path / "m")
+    save_hf_checkpoint(path, "llama", cfg,
+                       jax.tree.map(np.asarray, params))
+
+    spec = ModelSpec(path=path, hf_family="llama")
+    assert not model_host._use_streamed_load(spec)  # tiny -> eager
+    monkeypatch.setattr(model_host, "STREAMED_LOAD_AUTO_BYTES", 1)
+    assert model_host._use_streamed_load(spec)      # auto-streams
+    # auto never streams on process-spanning meshes (collective paths
+    # must match across members); the explicit flag still does
+    assert not model_host._use_streamed_load(spec, multiproc=True)
+    assert model_host._use_streamed_load(
+        ModelSpec(path=path, hf_family="llama", streamed_load=True),
+        multiproc=True)
+    spec_off = ModelSpec(path=path, hf_family="llama",
+                         streamed_load=False)
+    assert not model_host._use_streamed_load(spec_off)  # forced eager
+
+
 def test_streamed_vocab_padding_roundtrip(tmp_path):
     """vocab_size NOT divisible by tp: the streamed loader must pad
     wte/head for the mesh's tp and the streamed saver must strip that
