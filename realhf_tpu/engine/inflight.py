@@ -257,5 +257,22 @@ def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, mesh, params,
         return st, None
 
     keys = jax.random.split(key, chunk)
-    state, _ = jax.lax.scan(body, state, keys)
+
+    # Early exit within the chunk: when every slot has finished (EOS
+    # or max tokens), the remaining steps would decode pads and write
+    # nothing -- stop instead of burning them (mirrors the batch
+    # path's EOS early-exit while_loop, engine/generation.py).
+    def w_cond(c):
+        i, st = c
+        live_any = jnp.any(st["active"] & st["unfinished"]
+                           & (st["emitted"] < nm))
+        return (i < chunk) & live_any
+
+    def w_body(c):
+        i, st = c
+        st, _ = body(st, keys[i])
+        return (i + 1, st)
+
+    _, state = jax.lax.while_loop(w_cond, w_body,
+                                  (jnp.int32(0), state))
     return state

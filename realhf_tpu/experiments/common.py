@@ -29,6 +29,9 @@ class ModelConfigCLI:
         default_factory=OptimizerConfig)
     parallel: ParallelismConfig = dataclasses.field(
         default_factory=ParallelismConfig)
+    # None = auto (stream checkpoints > 16 GB on single-process
+    # meshes); True/False force (ModelSpec.streamed_load)
+    streamed_load: Optional[bool] = None
 
     def to_spec(self, train: bool = True,
                 random_init_config: Optional[dict] = None) -> ModelSpec:
@@ -41,7 +44,8 @@ class ModelConfigCLI:
             optimizer=self.optimizer if train else None,
             parallel=self.parallel,
             gradient_checkpointing=self.gradient_checkpointing,
-            bf16=self.bf16)
+            bf16=self.bf16,
+            streamed_load=self.streamed_load)
 
 
 @dataclasses.dataclass
