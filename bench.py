@@ -47,20 +47,49 @@ def _accelerator_usable(timeout: float = 150.0) -> bool:
     timeout. TPU init can either raise (chip held by another client)
     or block forever; neither may wedge the bench, so the probe is
     fully isolated and the parent only ever initializes a backend that
-    is known to work."""
+    is known to work. A wedged tunnel can clear within minutes
+    (stale-claim expiry), so the probe retries a few times before
+    condemning the round to a CPU-fallback bench
+    (REALHF_BENCH_PROBE_RETRIES / _RETRY_SLEEP_S override)."""
     if os.environ.get("REALHF_BENCH_FORCE_CPU"):
         return False
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print(jax.default_backend())"],
-            timeout=timeout, capture_output=True, text=True)
-    except Exception:
-        return False
-    if r.returncode != 0:
-        return False
-    out = r.stdout.strip().splitlines()
-    return bool(out) and out[-1] != "cpu"
+    retries = int(os.environ.get("REALHF_BENCH_PROBE_RETRIES", "2"))
+    # A TIMED-OUT probe means the child was killed mid-claim -- the
+    # very act that wedges the relay -- so before retrying one, wait
+    # out a full claim-expiry window rather than re-killing every two
+    # minutes. Fast clean failures (chip held by a live client) retry
+    # sooner.
+    err_sleep = float(os.environ.get("REALHF_BENCH_PROBE_RETRY_SLEEP_S",
+                                     "120"))
+    timeout_sleep = float(os.environ.get(
+        "REALHF_BENCH_PROBE_TIMEOUT_SLEEP_S", "600"))
+    for attempt in range(max(retries, 1)):
+        timed_out = False
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); "
+                 "print(jax.default_backend())"],
+                timeout=timeout, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            r = None
+            timed_out = True
+        except Exception:
+            r = None
+        if r is not None and r.returncode == 0:
+            out = r.stdout.strip().splitlines()
+            if bool(out) and out[-1] != "cpu":
+                return True
+            # clean verdict: this machine's default backend IS cpu --
+            # retrying cannot change that
+            return False
+        if attempt + 1 < max(retries, 1):
+            sleep_s = timeout_sleep if timed_out else err_sleep
+            print(f"# accelerator probe {attempt + 1}/{retries} "
+                  f"{'timed out' if timed_out else 'failed'}; "
+                  f"retrying in {sleep_s:.0f}s", file=sys.stderr)
+            time.sleep(sleep_s)
+    return False
 
 
 def _flops_kw(cfg):
