@@ -273,11 +273,3 @@ def moe_ep_constraint(cfg: TransformerConfig, mesh: Mesh):
     return constrain
 
 
-def kv_cache_pspecs() -> Dict[str, P]:
-    """KV cache: [nl, B, nkv, S, hd] -- DP over streams, TP over heads."""
-    return {
-        "k": P(None, DATA_AXIS, MODEL_AXIS, None, None),
-        "v": P(None, DATA_AXIS, MODEL_AXIS, None, None),
-        "valid": P(DATA_AXIS, None),
-        "length": P(DATA_AXIS),
-    }

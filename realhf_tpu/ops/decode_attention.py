@@ -34,6 +34,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("decode_attention")
+
 NEG_INF = -2.0 ** 30
 SUBLANES = 8
 DEFAULT_BK = 512
@@ -238,8 +242,20 @@ def mesh_nontrivial(mesh) -> bool:
             * mesh.shape.get(MODEL_AXIS, 1)) > 1
 
 
+_warned_unshardable = set()
+
+
 def decode_shardable(mesh, b: int, nq: int, nkv: int) -> bool:
-    """Whether the pallas decode kernels can partition on this mesh."""
+    """Whether the pallas decode kernels can partition on this mesh.
+
+    The limiting case is GQA at high TP (tp > n_kv_heads, e.g. 8
+    kv-heads at tp16): KV heads cannot shard evenly over "model", so
+    decode falls back to the GSPMD einsum path -- still sharded, but
+    with partial KV replication and without the single-pass flash
+    kernel. That fallback is a real throughput loss on the biggest
+    decode configs, so it WARNS (once per shape) instead of silently
+    downgrading; a query-group-axis sharded kernel is the planned
+    lift (docs/distributed.md, 70B decode story)."""
     if mesh is None:
         return True
     from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -247,7 +263,19 @@ def decode_shardable(mesh, b: int, nq: int, nkv: int) -> bool:
     tp = mesh.shape.get(MODEL_AXIS, 1)
     if dp == 1 and tp == 1:
         return True
-    return b % dp == 0 and nq % tp == 0 and nkv % tp == 0
+    ok = b % dp == 0 and nq % tp == 0 and nkv % tp == 0
+    if not ok:
+        key = (dp, tp, b, nq, nkv)
+        if key not in _warned_unshardable:
+            _warned_unshardable.add(key)
+            logger.warning(
+                "Pallas decode kernel cannot partition on this mesh "
+                "(dp=%d tp=%d, batch=%d, nq=%d, nkv=%d must divide "
+                "evenly); decoding via the GSPMD einsum path instead "
+                "-- expect lower decode throughput. GQA at tp > "
+                "n_kv_heads is the usual cause; prefer gen_tp_size <= "
+                "n_kv_heads when weights allow.", dp, tp, b, nq, nkv)
+    return ok
 
 
 def flash_decode_attention_stacked(

@@ -314,6 +314,12 @@ class MasterWorker(worker_base.Worker):
         # member rows arriving after their batch was logged would then
         # never be swept and the log would grow unboundedly).
         self._logged_bids.add(bid)
+        # membership only matters while a batch can still emit late
+        # member rows, i.e. while it is live; pruning below the minimum
+        # live bid keeps the set bounded by the off-policy window
+        # instead of growing for the daemon's lifetime
+        self._logged_bids = {b for b in self._logged_bids
+                             if b >= self._min_live_bid}
         self._exec_log = [r for r in self._exec_log
                           if r.get("bid") is not None
                           and r["bid"] not in self._logged_bids]

@@ -10,6 +10,7 @@ import json
 
 import numpy as np
 
+from realhf_tpu.base.testing import IntegerTokenizer
 from realhf_tpu.engine.optim import OptimizerConfig
 from realhf_tpu.experiments.common import apply_overrides
 from realhf_tpu.experiments.ppo_exp import PPOConfig
@@ -20,27 +21,6 @@ TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
             layer_norm_type="rms", mlp_type="llama",
             use_attention_bias=False, use_attn_proj_bias=False,
             use_mlp_bias=False, activation_function="silu")
-
-
-class FakeTokenizer:
-    pad_token_id = 0
-    eos_token_id = 1
-    eos_token = " zEOSz"
-    padding_side = "left"
-
-    def __call__(self, texts, truncation=False, max_length=None,
-                 padding=False, return_length=False,
-                 return_attention_mask=False, **kw):
-        ids = [[2 + (hash(w) % 1000) for w in t.split()] for t in texts]
-        if truncation and max_length:
-            ids = [x[:max_length] for x in ids]
-        out = {"input_ids": ids}
-        if return_length:
-            out["length"] = [len(x) for x in ids]
-        return out
-
-    def decode(self, ids, **kw):
-        return " ".join(map(str, ids))
 
 
 def test_ppo_pp_actor_decode_view(tmp_path):
@@ -81,7 +61,7 @@ def test_ppo_pp_actor_decode_view(tmp_path):
             mspec.optimizer = OptimizerConfig(
                 lr=1e-3, warmup_steps_proportion=0.0,
                 lr_scheduler_type="constant")
-    spec.tokenizer = FakeTokenizer()
+    spec.tokenizer = IntegerTokenizer(vocab_size=1000)
 
     runner = InlineRunner(spec)
     stats = runner.run()
