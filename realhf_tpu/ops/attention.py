@@ -208,22 +208,35 @@ def decode_attention(
             and (scale is None or isinstance(scale, (int, float)))):
         try:
             from realhf_tpu.ops.decode_attention import (
-                decode_shardable,
+                choose_decode_partitioning,
                 flash_decode_attention,
                 mesh_nontrivial,
                 sharded_decode_attention,
+                sharded_decode_attention_seqsplit,
+                window_keep,
             )
             if not mesh_nontrivial(mesh):
                 return flash_decode_attention(
                     q, k_cache, v_cache, valid_mask, scale=scale,
                     sliding_window=sliding_window, slot=slot)
-            if decode_shardable(mesh, b, nq, nkv):
+            part = choose_decode_partitioning(mesh, b, nq, nkv, s)
+            if part == "heads":
                 def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
                     return flash_decode_attention(
                         q_l, k_l, v_l, valid_l, scale=scale,
                         sliding_window=sliding_window, slot=slot_l)
                 return sharded_decode_attention(
                     fn, mesh, q, (k_cache, v_cache), valid_mask, slot,
+                    stacked=False)
+            if part == "seq":
+                keep = window_keep(valid_mask, sliding_window, slot)
+
+                def fn_stats(q_l, k_l, v_l, keep_l, lidx):
+                    return flash_decode_attention(
+                        q_l, k_l, v_l, keep_l.astype(bool), scale=scale,
+                        return_stats=True)
+                return sharded_decode_attention_seqsplit(
+                    fn_stats, mesh, q, (k_cache, v_cache), keep,
                     stacked=False)
             # fall through to the XLA path: GSPMD partitions it itself
         except ImportError:

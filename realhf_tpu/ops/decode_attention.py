@@ -43,10 +43,14 @@ SUBLANES = 8
 DEFAULT_BK = 512
 
 
-def _decode_body(q, k_at, v_at, keep_at, o_ref, *, scale, bk, s):
+def _decode_body(q, k_at, v_at, keep_at, o_ref, *, scale, bk, s,
+                 m_ref=None, l_ref=None):
     """Shared online-softmax body over one (stream, kv-head) cell.
     ``q``: loaded [gp, hd]; ``k_at(j)/v_at(j)``: [bk, hd] block loads;
-    ``keep_at(j)``: [bk] int32; ``o_ref``: the output ref."""
+    ``keep_at(j)``: [bk] int32; ``o_ref``: the output ref.
+    ``m_ref``/``l_ref`` (optional): per-row softmax max / normalizer
+    outputs -- the partial stats a KV-sequence-split caller combines
+    across shards (sharded_decode_attention_seqsplit)."""
     gp, hd = q.shape
     q = q.astype(jnp.float32) * scale
 
@@ -79,6 +83,9 @@ def _decode_body(q, k_at, v_at, keep_at, o_ref, *, scale, bk, s):
     safe_l = jnp.where(l_sum > 0, l_sum, 1.0)
     out = jnp.where(row_valid[:, None], acc / safe_l[:, None], 0.0)
     o_ref[...] = out.reshape(o_ref.shape).astype(o_ref.dtype)
+    if m_ref is not None:
+        m_ref[...] = m.reshape(m_ref.shape)
+        l_ref[...] = l_sum.reshape(l_ref.shape)
 
 
 def _layer_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
@@ -89,6 +96,17 @@ def _layer_kernel(q_ref, k_ref, v_ref, keep_ref, o_ref, *, scale, bk):
         lambda j: v_ref[0, 0, pl.ds(j * bk, bk), :],
         lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
         o_ref, scale=scale, bk=bk, s=s)
+
+
+def _layer_kernel_stats(q_ref, k_ref, v_ref, keep_ref, o_ref, m_ref,
+                        l_ref, *, scale, bk):
+    s = k_ref.shape[-2]
+    _decode_body(
+        q_ref[0, 0],
+        lambda j: k_ref[0, 0, pl.ds(j * bk, bk), :],
+        lambda j: v_ref[0, 0, pl.ds(j * bk, bk), :],
+        lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
+        o_ref, scale=scale, bk=bk, s=s, m_ref=m_ref, l_ref=l_ref)
 
 
 def _stacked_kernel(lidx_ref, q_ref, k_ref, v_ref, keep_ref, o_ref, *,
@@ -102,6 +120,45 @@ def _stacked_kernel(lidx_ref, q_ref, k_ref, v_ref, keep_ref, o_ref, *,
         lambda j: v_ref[0, 0, 0, pl.ds(j * bk, bk), :],
         lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
         o_ref, scale=scale, bk=bk, s=s)
+
+
+def _stacked_kernel_stats(lidx_ref, q_ref, k_ref, v_ref, keep_ref,
+                          o_ref, m_ref, l_ref, *, scale, bk):
+    s = k_ref.shape[-2]
+    _decode_body(
+        q_ref[0, 0],
+        lambda j: k_ref[0, 0, 0, pl.ds(j * bk, bk), :],
+        lambda j: v_ref[0, 0, 0, pl.ds(j * bk, bk), :],
+        lambda j: keep_ref[0, 0, pl.ds(j * bk, bk)],
+        o_ref, scale=scale, bk=bk, s=s, m_ref=m_ref, l_ref=l_ref)
+
+
+def _with_stats(kernel, kernel_stats, return_stats, o_shape, o_dtype,
+                o_spec, stat_spec, **kw):
+    """Pick the (kernel, out_shape, out_specs) triple for a decode
+    pallas_call with or without the (m, l) stats outputs -- shared by
+    the flat and stacked wrappers so their call setup cannot drift."""
+    b, nkv, gp = o_shape[0], o_shape[1], o_shape[2]
+    if return_stats:
+        stat = jax.ShapeDtypeStruct((b, nkv, gp), jnp.float32)
+        return (functools.partial(kernel_stats, **kw),
+                (jax.ShapeDtypeStruct(o_shape, o_dtype), stat, stat),
+                (o_spec, stat_spec, stat_spec))
+    return (functools.partial(kernel, **kw),
+            jax.ShapeDtypeStruct(o_shape, o_dtype), o_spec)
+
+
+def _trim_stats(res, return_stats, b, nq, group):
+    """Strip the padded query-group rows from a decode pallas_call's
+    result(s) and flatten heads back to [B, nq, ...]."""
+    if return_stats:
+        out, m, l = res
+        hd = out.shape[-1]
+        return (out[:, :, :group, :].reshape(b, nq, hd),
+                m[:, :, :group].reshape(b, nq),
+                l[:, :, :group].reshape(b, nq))
+    hd = res.shape[-1]
+    return res[:, :, :group, :].reshape(b, nq, hd)
 
 
 def _pick_bk(s: int, block_k: int = DEFAULT_BK) -> int:
@@ -126,6 +183,11 @@ def _window_keep(valid_mask, sliding_window, slot):
     return keep.astype(jnp.int32)
 
 
+# public alias: seqsplit callers precompute the keep mask GLOBALLY
+# (window positions are global; shards see local indices)
+window_keep = _window_keep
+
+
 def _pad_group(q, nkv, group, gp):
     b, _, hd = q.shape
     qg = q.reshape(b, nkv, group, hd)
@@ -146,6 +208,7 @@ def flash_decode_attention(
     slot: Optional[jnp.ndarray] = None,  # [B] int32, with sliding_window
     block_k: int = DEFAULT_BK,
     interpret: bool = False,
+    return_stats: bool = False,  # also return (m, l) softmax partials
 ) -> jnp.ndarray:
     b, nq, hd = q.shape
     nkv, s = k_cache.shape[1], k_cache.shape[2]
@@ -168,21 +231,23 @@ def flash_decode_attention(
     qg = _pad_group(q, nkv, group, gp)
     keep_b = jnp.broadcast_to(keep[:, None, :], (b, SUBLANES, s))
 
-    out = pl.pallas_call(
-        functools.partial(_layer_kernel, scale=scale, bk=bk),
-        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
-        grid=(b, nkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, hd), lambda bi, h: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
-            pl.BlockSpec((1, SUBLANES, s), lambda bi, h: (bi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, gp, hd),
-                               lambda bi, h: (bi, h, 0, 0)),
-        interpret=interpret,
+    in_specs = [
+        pl.BlockSpec((1, 1, gp, hd), lambda bi, h: (bi, h, 0, 0)),
+        pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
+        pl.BlockSpec((1, 1, s, hd), lambda bi, h: (bi, h, 0, 0)),
+        pl.BlockSpec((1, SUBLANES, s), lambda bi, h: (bi, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, 1, gp, hd), lambda bi, h: (bi, h, 0, 0))
+    kernel, out_shape, out_specs = _with_stats(
+        _layer_kernel, _layer_kernel_stats, return_stats,
+        (b, nkv, gp, hd), q.dtype, o_spec,
+        pl.BlockSpec((1, 1, gp), lambda bi, h: (bi, h, 0)),
+        scale=scale, bk=bk)
+    res = pl.pallas_call(
+        kernel, out_shape=out_shape, grid=(b, nkv),
+        in_specs=in_specs, out_specs=out_specs, interpret=interpret,
     )(qg, k_cache, v_cache, keep_b)
-    return out[:, :, :group, :].reshape(b, nq, hd)
+    return _trim_stats(res, return_stats, b, nq, group)
 
 
 def sharded_decode_attention(
@@ -246,16 +311,8 @@ _warned_unshardable = set()
 
 
 def decode_shardable(mesh, b: int, nq: int, nkv: int) -> bool:
-    """Whether the pallas decode kernels can partition on this mesh.
-
-    The limiting case is GQA at high TP (tp > n_kv_heads, e.g. 8
-    kv-heads at tp16): KV heads cannot shard evenly over "model", so
-    decode falls back to the GSPMD einsum path -- still sharded, but
-    with partial KV replication and without the single-pass flash
-    kernel. That fallback is a real throughput loss on the biggest
-    decode configs, so it WARNS (once per shape) instead of silently
-    downgrading; a query-group-axis sharded kernel is the planned
-    lift (docs/distributed.md, 70B decode story)."""
+    """Whether the pallas decode kernels can partition HEAD-wise on
+    this mesh (B over "data", q/kv heads over "model")."""
     if mesh is None:
         return True
     from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -263,19 +320,100 @@ def decode_shardable(mesh, b: int, nq: int, nkv: int) -> bool:
     tp = mesh.shape.get(MODEL_AXIS, 1)
     if dp == 1 and tp == 1:
         return True
-    ok = b % dp == 0 and nq % tp == 0 and nkv % tp == 0
-    if not ok:
-        key = (dp, tp, b, nq, nkv)
-        if key not in _warned_unshardable:
-            _warned_unshardable.add(key)
-            logger.warning(
-                "Pallas decode kernel cannot partition on this mesh "
-                "(dp=%d tp=%d, batch=%d, nq=%d, nkv=%d must divide "
-                "evenly); decoding via the GSPMD einsum path instead "
-                "-- expect lower decode throughput. GQA at tp > "
-                "n_kv_heads is the usual cause; prefer gen_tp_size <= "
-                "n_kv_heads when weights allow.", dp, tp, b, nq, nkv)
-    return ok
+    return b % dp == 0 and nq % tp == 0 and nkv % tp == 0
+
+
+def choose_decode_partitioning(mesh, b: int, nq: int, nkv: int,
+                               s: int) -> Optional[str]:
+    """How the pallas decode kernel partitions on this mesh:
+    ``"heads"`` (B over "data", heads over "model" -- the fast path),
+    ``"seq"`` (KV sequence over "model" with a cross-shard flash
+    combine -- GQA at tp > n_kv_heads, e.g. LLaMA-70B's 8 kv-heads at
+    tp16), or ``None`` (nothing divides: GSPMD einsum fallback, with a
+    one-time warning because the throughput loss is real)."""
+    if mesh is None:
+        return "heads"
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if dp == 1 and tp == 1:
+        return "heads"
+    if decode_shardable(mesh, b, nq, nkv):
+        return "heads"
+    s_local = s // tp
+    # the LOCAL shard length must satisfy the kernels' K-block
+    # constraint (stacked kernel asserts s % bk == 0; _pick_bk finds a
+    # divisor when s_local <= block_k or s_local % 128 == 0)
+    if (b % dp == 0 and s % tp == 0
+            and (s_local <= DEFAULT_BK or s_local % 128 == 0)):
+        return "seq"
+    key = (dp, tp, b, nq, nkv, s)
+    if key not in _warned_unshardable:
+        _warned_unshardable.add(key)
+        logger.warning(
+            "Pallas decode kernel cannot partition on this mesh "
+            "(dp=%d tp=%d, batch=%d, nq=%d, nkv=%d, cache_len=%d: "
+            "neither heads nor KV sequence divide evenly); decoding "
+            "via the GSPMD einsum path instead -- expect lower decode "
+            "throughput.", dp, tp, b, nq, nkv, s)
+    return None
+
+
+def sharded_decode_attention_seqsplit(
+    fn_stats, mesh, q, caches, keep, layer_index=None, *,
+    stacked: bool,
+):
+    """KV-SEQUENCE-split decode for GQA at tp > n_kv_heads (the
+    LLaMA-70B tp16 case, docs/distributed.md): heads cannot shard
+    16-ways, so each "model" shard instead holds a SLICE OF THE CACHE
+    SEQUENCE, runs the flash kernel over its slice with partial
+    softmax stats, and the shards combine with the standard
+    flash-attention merge (``out = sum_i w_i out_i``,
+    ``w_i = l_i exp(m_i - m)``) via psum over "model". Attention
+    FLOPs and KV bytes split tp-ways evenly regardless of head
+    counts; q (tiny at decode, [B, nq, hd]) is replicated over
+    "model".
+
+    ``fn_stats(q, k, v, keep, lidx) -> (out, m, l)`` runs on LOCAL
+    shards and must apply any sliding window itself -- ``keep`` here
+    is the PRE-COMPUTED global keep mask ([B, S] int32), since window
+    positions are global while each shard sees local indices."""
+    from functools import partial as _partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from realhf_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    layer_lead = (None,) if stacked else ()
+    kv_spec = P(*layer_lead, DATA_AXIS, None, MODEL_AXIS, None)
+    axis_names = {a for a in mesh.axis_names}
+
+    @_partial(jax.shard_map, mesh=mesh,
+              axis_names=axis_names,
+              in_specs=(P(DATA_AXIS, None, None), kv_spec, kv_spec,
+                        P(DATA_AXIS, MODEL_AXIS), P()),
+              out_specs=P(DATA_AXIS, None, None),
+              check_vma=False)
+    def run(q_l, k_l, v_l, keep_l, lidx):
+        out, m, l = fn_stats(q_l, k_l, v_l, keep_l, lidx)
+        out = out.astype(jnp.float32)
+        # flash merge across sequence shards; empty shards carry
+        # m=NEG_INF / l=0 and must contribute weight 0, not NaN
+        m_all = jax.lax.pmax(m, MODEL_AXIS)
+        m_safe = jnp.where(m_all > NEG_INF / 2, m_all, 0.0)
+        w = jnp.where(m > NEG_INF / 2, l * jnp.exp(m - m_safe), 0.0)
+        # one fused psum for numerator and normalizer (this runs per
+        # layer per decode token: collective count is latency)
+        num, denom = jax.lax.psum((out * w[..., None], w), MODEL_AXIS)
+        safe = jnp.where(denom > 0, denom, 1.0)
+        out = jnp.where(denom[..., None] > 0,
+                        num / safe[..., None], 0.0)
+        return out.astype(q_l.dtype)
+
+    k_all, v_all = caches
+    return run(q, k_all, v_all, keep,
+               (layer_index if layer_index is not None
+                else jnp.zeros((), jnp.int32)))
 
 
 def flash_decode_attention_stacked(
@@ -290,6 +428,7 @@ def flash_decode_attention_stacked(
     slot: Optional[jnp.ndarray] = None,
     block_k: int = DEFAULT_BK,
     interpret: bool = False,
+    return_stats: bool = False,  # also return (m, l) softmax partials
 ) -> jnp.ndarray:
     """Same math as `flash_decode_attention` but reads layer
     ``layer_index`` of the stacked cache directly via a scalar-prefetch
@@ -313,24 +452,25 @@ def flash_decode_attention_stacked(
     keep_b = jnp.broadcast_to(keep[:, None, :], (b, SUBLANES, s))
     lidx = jnp.asarray(layer_index, jnp.int32).reshape(1)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, gp, hd), lambda bi, h, lr: (bi, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, s, hd),
+                     lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
+        pl.BlockSpec((1, 1, 1, s, hd),
+                     lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
+        pl.BlockSpec((1, SUBLANES, s), lambda bi, h, lr: (bi, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, 1, gp, hd), lambda bi, h, lr: (bi, h, 0, 0))
+    kernel, out_shape, out_specs = _with_stats(
+        _stacked_kernel, _stacked_kernel_stats, return_stats,
+        (b, nkv, gp, hd), q.dtype, o_spec,
+        pl.BlockSpec((1, 1, gp), lambda bi, h, lr: (bi, h, 0)),
+        scale=scale, bk=bk)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b, nkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, gp, hd), lambda bi, h, lr: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, s, hd),
-                         lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, s, hd),
-                         lambda bi, h, lr: (lr[0], bi, h, 0, 0)),
-            pl.BlockSpec((1, SUBLANES, s), lambda bi, h, lr: (bi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, gp, hd),
-                               lambda bi, h, lr: (bi, h, 0, 0)),
-    )
-    out = pl.pallas_call(
-        functools.partial(_stacked_kernel, scale=scale, bk=bk),
-        out_shape=jax.ShapeDtypeStruct((b, nkv, gp, hd), q.dtype),
-        grid_spec=grid_spec,
+        num_scalar_prefetch=1, grid=(b, nkv),
+        in_specs=in_specs, out_specs=out_specs)
+    res = pl.pallas_call(
+        kernel, out_shape=out_shape, grid_spec=grid_spec,
         interpret=interpret,
     )(lidx, qg, k_all, v_all, keep_b)
-    return out[:, :, :group, :].reshape(b, nq, hd)
+    return _trim_stats(res, return_stats, b, nq, group)

@@ -131,3 +131,148 @@ def test_sharded_stacked_decode_kernel_matches_xla():
         stacked=True))(q, k_all, v_all, valid, layer)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_choose_decode_partitioning():
+    from realhf_tpu.ops.decode_attention import (
+        choose_decode_partitioning,
+    )
+    mesh = _mesh(dp=2, tp=4)
+    # heads divide: fast path
+    assert choose_decode_partitioning(mesh, 4, 8, 4, 256) == "heads"
+    # GQA at tp > nkv: KV-sequence split
+    assert choose_decode_partitioning(mesh, 4, 8, 2, 256) == "seq"
+    # nothing divides (cache length odd vs tp): einsum fallback
+    assert choose_decode_partitioning(mesh, 4, 8, 2, 255) is None
+    # divisible globally but the LOCAL shard (2304/4 = 576) violates
+    # the stacked kernel's K-block constraint (>512 and not a 128
+    # multiple): must fall back, not crash at trace time
+    assert choose_decode_partitioning(mesh, 4, 8, 2, 2304) is None
+    # 4096/4 = 1024 local: fine (128 multiple)
+    assert choose_decode_partitioning(mesh, 4, 8, 2, 4096) == "seq"
+
+
+def test_seqsplit_decode_matches_xla():
+    """GQA at tp > n_kv_heads: KV sequence shards over "model" and the
+    cross-shard flash merge must reproduce dense decode attention,
+    including rows with partially-valid caches and a fully-empty row."""
+    from realhf_tpu.ops.decode_attention import (
+        sharded_decode_attention_seqsplit,
+        window_keep,
+    )
+    rng = np.random.default_rng(4)
+    b, s, nq, nkv, hd = 4, 256, 8, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    valid = np.zeros((b, s), bool)
+    valid[0, :200] = True
+    valid[1, 64:192] = True   # valid region split across seq shards
+    valid[2, :40] = True      # valid only on shard 0
+    # row 3 fully empty: merge must emit zeros, not NaNs
+    valid = jnp.asarray(valid)
+    mesh = _mesh(dp=2, tp=4)
+
+    ref = decode_attention(q, k, v, valid)
+
+    def fn_stats(q_l, k_l, v_l, keep_l, lidx):
+        return flash_decode_attention(q_l, k_l, v_l,
+                                      keep_l.astype(bool),
+                                      interpret=True, return_stats=True)
+
+    keep = window_keep(valid, None, None)
+    got = jax.jit(lambda *a: sharded_decode_attention_seqsplit(
+        fn_stats, mesh, a[0], (a[1], a[2]), a[3], stacked=False))(
+            q, k, v, keep)
+    # rows 0-2 must match dense attention; row 3's cache is fully
+    # empty -- a don't-care (prefill always writes >= 1 token) where
+    # the flash kernels emit zeros while XLA softmax degenerates to
+    # mean-of-v. Pin zeros/no-NaN for it instead.
+    np.testing.assert_allclose(np.asarray(got)[:3], np.asarray(ref)[:3],
+                               atol=2e-5, rtol=2e-5)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_array_equal(np.asarray(got)[3], 0.0)
+
+
+def test_seqsplit_decode_sliding_window():
+    from realhf_tpu.ops.decode_attention import (
+        sharded_decode_attention_seqsplit,
+        window_keep,
+    )
+    rng = np.random.default_rng(5)
+    b, s, nq, nkv, hd = 2, 256, 4, 1, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    valid = np.zeros((b, s), bool)
+    valid[:, :220] = True
+    valid = jnp.asarray(valid)
+    slot = jnp.asarray([219, 219], jnp.int32)
+    window = 100
+    mesh = _mesh(dp=2, tp=4)
+
+    ref = decode_attention(q, k, v, valid, sliding_window=window,
+                           slot=slot)
+
+    def fn_stats(q_l, k_l, v_l, keep_l, lidx):
+        # window applied via the precomputed GLOBAL keep mask
+        return flash_decode_attention(q_l, k_l, v_l,
+                                      keep_l.astype(bool),
+                                      interpret=True, return_stats=True)
+
+    keep = window_keep(valid, window, slot)
+    got = jax.jit(lambda *a: sharded_decode_attention_seqsplit(
+        fn_stats, mesh, a[0], (a[1], a[2]), a[3], stacked=False))(
+            q, k, v, keep)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_seqsplit_stacked_decode_matches_xla():
+    from realhf_tpu.ops.decode_attention import (
+        sharded_decode_attention_seqsplit,
+        window_keep,
+    )
+    rng = np.random.default_rng(6)
+    nl, b, s, nq, nkv, hd = 3, 4, 256, 8, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    v_all = jnp.asarray(rng.standard_normal((nl, b, nkv, s, hd)),
+                        jnp.float32)
+    valid = np.zeros((b, s), bool)
+    valid[:, :130] = True
+    valid = jnp.asarray(valid)
+    mesh = _mesh(dp=2, tp=4)
+    layer = jnp.asarray(2, jnp.int32)
+
+    ref = decode_attention(q, k_all[2], v_all[2], valid)
+
+    def fn_stats(q_l, k_l, v_l, keep_l, lidx):
+        return flash_decode_attention_stacked(
+            q_l, k_l, v_l, keep_l.astype(bool), lidx,
+            interpret=True, return_stats=True)
+
+    keep = window_keep(valid, None, None)
+    got = jax.jit(lambda *a: sharded_decode_attention_seqsplit(
+        fn_stats, mesh, a[0], (a[1], a[2]), a[3], a[4],
+        stacked=True))(q, k_all, v_all, keep, layer)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_decode_return_stats_consistency():
+    """(out, m, l) from return_stats recombine to the plain output:
+    the invariant the seqsplit merge relies on."""
+    rng = np.random.default_rng(7)
+    b, s, nq, nkv, hd = 2, 128, 4, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, nq, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, nkv, s, hd)), jnp.float32)
+    valid = jnp.asarray(np.ones((b, s), bool))
+    plain = flash_decode_attention(q, k, v, valid, interpret=True)
+    out, m, l = flash_decode_attention(q, k, v, valid, interpret=True,
+                                       return_stats=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(plain),
+                               atol=1e-6)
+    assert np.asarray(l).min() > 0 and np.isfinite(np.asarray(m)).all()
