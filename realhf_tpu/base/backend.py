@@ -34,3 +34,38 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:  # noqa: BLE001 - backends already up; env set
         pass
+
+
+def pallas_enabled() -> bool:
+    """Whether the Pallas kernel paths (flash attention, flash decode,
+    their shard_map wrappers) should engage: a real TPU backend, or
+    ``REALHF_TPU_FORCE_PALLAS=1`` -- the test hook that runs the SAME
+    wiring with interpret-mode kernels on CPU (callers then execute
+    under ``pltpu.force_tpu_interpret_mode()``), so the kernel
+    plumbing is exercised in CI instead of only on hardware.
+
+    The flag is read at TRACE time: set it before building engines /
+    tracing jits, and do not expect a mid-process flip to invalidate
+    already-compiled programs (the env var is not part of any jit
+    cache key). Forcing the flag on a non-TPU backend OUTSIDE the
+    interpret-mode context raises here -- the bare kernels would
+    otherwise die deep in Mosaic lowering with an opaque error."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    if os.environ.get("REALHF_TPU_FORCE_PALLAS") != "1":
+        return False
+    try:
+        from jax._src import config as _jcfg
+        in_interpret = (_jcfg.pallas_tpu_interpret_mode_context_manager
+                        .value is not None)
+    except Exception:  # noqa: BLE001 - jax internals moved: don't block
+        in_interpret = True
+    if not in_interpret:
+        raise RuntimeError(
+            "REALHF_TPU_FORCE_PALLAS=1 on a non-TPU backend requires "
+            "running under pltpu.force_tpu_interpret_mode() (the bare "
+            "Pallas kernels cannot lower for CPU); wrap the "
+            "computation in that context or unset the flag.")
+    return True

@@ -29,6 +29,7 @@ import numpy as np
 import optax
 
 from realhf_tpu.base import logging
+from realhf_tpu.base.backend import pallas_enabled as _pallas_enabled
 from realhf_tpu.engine import generation as gen_mod
 from realhf_tpu.engine.optim import OptimizerConfig, make_optimizer
 from realhf_tpu.models import sharding as shard_rules
@@ -142,7 +143,7 @@ class Engine:
                                       sliding_window=sliding_window)
 
             self.attention_fn = _ring
-        elif jax.default_backend() == "tpu" and _mesh_nontrivial(self.mesh):
+        elif _pallas_enabled() and _mesh_nontrivial(self.mesh):
             if ctx.pp_size > 1:
                 # Inside the pipe-manual shard_map a bare pallas_call
                 # would force per-stage gathers; use the XLA path,

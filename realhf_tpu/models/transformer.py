@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from realhf_tpu.base.backend import pallas_enabled
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.ops.attention import decode_attention, packed_attention
 from realhf_tpu.ops.rotary import apply_rotary, rotary_freqs
@@ -514,7 +515,7 @@ def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
     token, the very bottleneck this kernel removes. The XLA slice
     path remains for CPU tests only."""
     hd = q.shape[-1]
-    if jax.default_backend() == "tpu" and hd >= 64:
+    if pallas_enabled() and hd >= 64:
         from realhf_tpu.ops.decode_attention import (
             choose_decode_partitioning,
             flash_decode_attention_stacked,

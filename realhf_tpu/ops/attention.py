@@ -21,6 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from realhf_tpu.base.backend import pallas_enabled
+
 NEG_INF = -2.0 ** 30  # large finite value; -inf breaks softmax for all-masked rows
 
 
@@ -90,7 +92,7 @@ def packed_attention(q, k, v, seg_ids, *, causal=True, scale=None,
     meet the kernel's tiling constraints, XLA otherwise (CPU tests).
     """
     if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu"
+        use_flash = (pallas_enabled()
                      and q.shape[1] % 128 == 0 and q.shape[3] >= 64
                      # the flash kernel requires a static python scale
                      # and has no soft-cap / sliding-window support
@@ -203,7 +205,7 @@ def decode_attention(
 
     # Pallas flash-decode on TPU: single tiled pass over the cache, no
     # [B, nq, S] score tensor (ops/decode_attention.py).
-    if (jax.default_backend() == "tpu" and hd >= 64
+    if (pallas_enabled() and hd >= 64
             and logits_soft_cap is None
             and (scale is None or isinstance(scale, (int, float)))):
         try:
