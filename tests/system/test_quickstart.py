@@ -22,25 +22,13 @@ TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
             use_mlp_bias=False, activation_function="silu")
 
 
-class FakeTokenizer:
-    pad_token_id = 0
-    eos_token_id = 1
-    eos_token = " zEOSz"
-    padding_side = "left"
+from realhf_tpu.base.testing import IntegerTokenizer
 
-    def __call__(self, texts, truncation=False, max_length=None,
-                 padding=False, return_length=False,
-                 return_attention_mask=False, **kw):
-        ids = [[2 + (hash(w) % 1000) for w in t.split()] for t in texts]
-        if truncation and max_length:
-            ids = [x[:max_length] for x in ids]
-        out = {"input_ids": ids}
-        if return_length:
-            out["length"] = [len(x) for x in ids]
-        return out
 
-    def decode(self, ids, **kw):
-        return " ".join(map(str, ids))
+def FakeTokenizer():
+    """Deterministic tokenizer (builtin hash() is randomized per
+    process, making losses irreproducible run-to-run)."""
+    return IntegerTokenizer(vocab_size=1000)
 
 
 def _write_jsonl(path, records):
