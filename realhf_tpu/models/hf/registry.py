@@ -228,7 +228,11 @@ class _LayerKeyView:
 
     def __getitem__(self, key: str) -> np.ndarray:
         mapped = self._map(key)
-        if mapped == key:  # non-layer key: read once, reuse per layer
+        # memoize only TRUE non-layer keys (pattern match, not
+        # mapped == key: for layer 0 the substitution is the identity
+        # and the equality test would cache a whole extra layer of
+        # weights for the lifetime of the load)
+        if _layer_key_pat().match(key) is None:
             if key not in self._cache:
                 self._cache[key] = self._base[key]
             return self._cache[key]

@@ -516,47 +516,15 @@ def _stacked_decode_attention(q, k_all, v_all, valid, layer_idx, *,
     path remains for CPU tests only."""
     hd = q.shape[-1]
     if pallas_enabled() and hd >= 64:
-        from realhf_tpu.ops.decode_attention import (
-            choose_decode_partitioning,
-            flash_decode_attention_stacked,
-            mesh_nontrivial,
-            sharded_decode_attention,
-            sharded_decode_attention_seqsplit,
-            window_keep,
-        )
-        if not (scale is None or isinstance(scale, (int, float))):
-            q = (q.astype(jnp.float32) * scale).astype(q.dtype)
-            scale = 1.0
-        b, nq = q.shape[0], q.shape[1]
-        nkv, s = k_all.shape[2], k_all.shape[3]
-        if not mesh_nontrivial(mesh):
-            return flash_decode_attention_stacked(
-                q, k_all, v_all, valid, layer_idx, scale=scale,
-                sliding_window=sliding_window, slot=slot)
-        part = choose_decode_partitioning(mesh, b, nq, nkv, s)
-        if part == "heads":
-            def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
-                return flash_decode_attention_stacked(
-                    q_l, k_l, v_l, valid_l, lidx, scale=scale,
-                    sliding_window=sliding_window, slot=slot_l)
-            return sharded_decode_attention(
-                fn, mesh, q, (k_all, v_all), valid, slot, layer_idx,
-                stacked=True)
-        if part == "seq":
-            # GQA at tp > nkv: KV sequence shards over "model" with a
-            # cross-shard flash merge; window keep precomputed
-            # globally (shards see local indices)
-            keep = window_keep(valid, sliding_window, slot)
-
-            def fn_stats(q_l, k_l, v_l, keep_l, lidx):
-                return flash_decode_attention_stacked(
-                    q_l, k_l, v_l, keep_l.astype(bool), lidx,
-                    scale=scale, return_stats=True)
-            return sharded_decode_attention_seqsplit(
-                fn_stats, mesh, q, (k_all, v_all), keep, layer_idx,
-                stacked=True)
-        # fall through: pass mesh so decode_attention's own gate skips
-        # the bare kernel and takes the GSPMD-partitioned XLA path
+        from realhf_tpu.ops.decode_attention import run_decode_kernels
+        out = run_decode_kernels(
+            mesh, q, (k_all, v_all), valid, slot, layer_idx,
+            stacked=True, scale=scale, sliding_window=sliding_window)
+        if out is not None:
+            return out
+        # fall through: no kernel partitioning applies; the sliced
+        # decode_attention below re-enters the dispatcher flat, gets
+        # the same None, and takes its GSPMD-partitioned XLA path
     k_l = jax.lax.dynamic_index_in_dim(k_all, layer_idx, 0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(v_all, layer_idx, 0, keepdims=False)
     return decode_attention(q, k_l, v_l, valid, scale=scale,

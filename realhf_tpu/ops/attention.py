@@ -204,43 +204,22 @@ def decode_attention(
     group = nq // nkv
 
     # Pallas flash-decode on TPU: single tiled pass over the cache, no
-    # [B, nq, S] score tensor (ops/decode_attention.py).
-    if (pallas_enabled() and hd >= 64
-            and logits_soft_cap is None
-            and (scale is None or isinstance(scale, (int, float)))):
+    # [B, nq, S] score tensor. Routing (bare / head-sharded /
+    # KV-sequence-split shard_map) lives in one dispatcher shared with
+    # the stacked path (ops/decode_attention.run_decode_kernels);
+    # None = no kernel partitioning applies -> the XLA path below,
+    # which GSPMD partitions itself.
+    if pallas_enabled() and hd >= 64 and logits_soft_cap is None:
         try:
             from realhf_tpu.ops.decode_attention import (
-                choose_decode_partitioning,
-                flash_decode_attention,
-                mesh_nontrivial,
-                sharded_decode_attention,
-                sharded_decode_attention_seqsplit,
-                window_keep,
+                run_decode_kernels,
             )
-            if not mesh_nontrivial(mesh):
-                return flash_decode_attention(
-                    q, k_cache, v_cache, valid_mask, scale=scale,
-                    sliding_window=sliding_window, slot=slot)
-            part = choose_decode_partitioning(mesh, b, nq, nkv, s)
-            if part == "heads":
-                def fn(q_l, k_l, v_l, valid_l, slot_l, lidx):
-                    return flash_decode_attention(
-                        q_l, k_l, v_l, valid_l, scale=scale,
-                        sliding_window=sliding_window, slot=slot_l)
-                return sharded_decode_attention(
-                    fn, mesh, q, (k_cache, v_cache), valid_mask, slot,
-                    stacked=False)
-            if part == "seq":
-                keep = window_keep(valid_mask, sliding_window, slot)
-
-                def fn_stats(q_l, k_l, v_l, keep_l, lidx):
-                    return flash_decode_attention(
-                        q_l, k_l, v_l, keep_l.astype(bool), scale=scale,
-                        return_stats=True)
-                return sharded_decode_attention_seqsplit(
-                    fn_stats, mesh, q, (k_cache, v_cache), keep,
-                    stacked=False)
-            # fall through to the XLA path: GSPMD partitions it itself
+            out = run_decode_kernels(
+                mesh, q, (k_cache, v_cache), valid_mask, slot, None,
+                stacked=False, scale=scale,
+                sliding_window=sliding_window)
+            if out is not None:
+                return out
         except ImportError:
             pass
 

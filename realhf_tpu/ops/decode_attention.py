@@ -359,6 +359,64 @@ def choose_decode_partitioning(mesh, b: int, nq: int, nkv: int,
     return None
 
 
+def run_decode_kernels(mesh, q, caches, valid_mask, slot, layer_index,
+                       *, stacked: bool, scale=None,
+                       sliding_window=None):
+    """Single dispatcher for one decode-attention call onto the Pallas
+    kernels: bare kernel on trivial meshes, head-sharded or
+    KV-sequence-split shard_map per ``choose_decode_partitioning``.
+    Returns ``None`` when no kernel partitioning applies -- the caller
+    then takes its GSPMD/XLA fallback. Shared by the flat
+    (``ops/attention.decode_attention``) and stacked
+    (``models/transformer._stacked_decode_attention``) paths so the
+    routing cannot drift between them. Traced scales (deep
+    scale_attn_by_inverse_layer_idx models) fold into q here, since
+    the kernels need a python-static scale."""
+    if not (scale is None or isinstance(scale, (int, float))):
+        q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+        scale = 1.0
+    b, nq = q.shape[0], q.shape[1]
+    if stacked:
+        nkv, s = caches[0].shape[2], caches[0].shape[3]
+
+        def plain(q_, k_, v_, valid_, slot_, lidx):
+            return flash_decode_attention_stacked(
+                q_, k_, v_, valid_, lidx, scale=scale,
+                sliding_window=sliding_window, slot=slot_)
+
+        def stats(q_, k_, v_, keep_, lidx):
+            return flash_decode_attention_stacked(
+                q_, k_, v_, keep_.astype(bool), lidx, scale=scale,
+                return_stats=True)
+    else:
+        nkv, s = caches[0].shape[1], caches[0].shape[2]
+
+        def plain(q_, k_, v_, valid_, slot_, lidx):
+            return flash_decode_attention(
+                q_, k_, v_, valid_, scale=scale,
+                sliding_window=sliding_window, slot=slot_)
+
+        def stats(q_, k_, v_, keep_, lidx):
+            return flash_decode_attention(
+                q_, k_, v_, keep_.astype(bool), scale=scale,
+                return_stats=True)
+
+    if not mesh_nontrivial(mesh):
+        return plain(q, caches[0], caches[1], valid_mask, slot,
+                     (layer_index if layer_index is not None
+                      else jnp.zeros((), jnp.int32)))
+    part = choose_decode_partitioning(mesh, b, nq, nkv, s)
+    if part == "heads":
+        return sharded_decode_attention(
+            plain, mesh, q, caches, valid_mask, slot, layer_index,
+            stacked=stacked)
+    if part == "seq":
+        keep = window_keep(valid_mask, sliding_window, slot)
+        return sharded_decode_attention_seqsplit(
+            stats, mesh, q, caches, keep, layer_index, stacked=stacked)
+    return None
+
+
 def sharded_decode_attention_seqsplit(
     fn_stats, mesh, q, caches, keep, layer_index=None, *,
     stacked: bool,

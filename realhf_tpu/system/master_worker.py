@@ -314,15 +314,21 @@ class MasterWorker(worker_base.Worker):
         # member rows arriving after their batch was logged would then
         # never be swept and the log would grow unboundedly).
         self._logged_bids.add(bid)
-        # membership only matters while a batch can still emit late
-        # member rows, i.e. while it is live; pruning below the minimum
-        # live bid keeps the set bounded by the off-policy window
-        # instead of growing for the daemon's lifetime
-        self._logged_bids = {b for b in self._logged_bids
-                             if b >= self._min_live_bid}
+        # Sweep rows of logged batches AND any stragglers of batches
+        # that already left the live window (a late member row whose
+        # bid dropped out of the set below would otherwise stick
+        # forever), THEN bound the set by the live window -- membership
+        # only matters while a batch can still emit late rows. Order
+        # matters: pruning the set first would empty it (the just-
+        # logged bid is below the already-advanced _min_live_bid) and
+        # make the row sweep a no-op, growing _exec_log unboundedly.
+        min_live = self._min_live_bid
         self._exec_log = [r for r in self._exec_log
                           if r.get("bid") is not None
-                          and r["bid"] not in self._logged_bids]
+                          and r["bid"] not in self._logged_bids
+                          and r["bid"] >= min_live]
+        self._logged_bids = {b for b in self._logged_bids
+                             if b >= min_live}
 
     def _maybe_save_eval(self, entry, force=False):
         train_nodes = [m for ms in self.train_nodes_of_role.values()
