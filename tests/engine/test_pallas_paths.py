@@ -77,3 +77,21 @@ def test_decode_step_via_pallas_kernels(dp, tp, path, monkeypatch):
     with pltpu.force_tpu_interpret_mode():
         got = _one_decode_step(cfg, params, mesh=mesh)
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("dp,tp", [(4, 2), (2, 4)])
+def test_decode_step_stacked_scan_path(dp, tp, monkeypatch):
+    """Deep-model wiring: dropping the unroll threshold forces the
+    layer lax.scan with a TRACED layer index, so decode_step routes
+    through the scalar-prefetch stacked kernel
+    (flash_decode_attention_stacked) under both shard_map
+    partitionings -- the exact path an 80-layer model decodes with."""
+    cfg = _cfg()
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    ref = _one_decode_step(cfg, params, mesh=None)  # unrolled XLA path
+
+    monkeypatch.setattr(T, "_DECODE_UNROLL_MAX_LAYERS", 0)
+    monkeypatch.setenv("REALHF_TPU_FORCE_PALLAS", "1")
+    with pltpu.force_tpu_interpret_mode():
+        got = _one_decode_step(cfg, params, mesh=_mesh(dp, tp))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
