@@ -51,12 +51,14 @@ class ModelInterface(abc.ABC):
     """Algorithm handlers; all default to unimplemented
     (reference model_api.py:605-640)."""
 
-    def save(self, model: Model, save_dir: str, host_params=None):
+    def save(self, model: Model, save_dir: str, host_params=None,
+             writer: bool = True):
         """``host_params``, when given, is a pre-gathered host copy of
-        the weights (``Engine.params_numpy()``). On multi-process
-        meshes the CALLER runs that collective on every group member
-        and hands the result in, so an interface's save can never
-        change the group's collective count (see
+        the weights (``Engine.params_numpy()``); without it the save
+        streams layer-by-layer from the device arrays. On a
+        multi-process mesh the streamed save is a COLLECTIVE: the
+        ModelHost calls it on every group member with ``writer=True``
+        only on the leader, which alone writes files (see
         ModelHost.save_role)."""
         pass
 

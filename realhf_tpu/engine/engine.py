@@ -616,6 +616,15 @@ class Engine:
         """Host copy of the optimizer-state leaves (tree order).
         COLLECTIVE on a multi-process mesh (same discipline as
         params_numpy: leaf-by-leaf replicating gathers)."""
+        return list(self.iter_opt_state_numpy())
+
+    def iter_opt_state_numpy(self):
+        """Yield optimizer-state leaves as host arrays ONE AT A TIME
+        (tree order) -- the streaming form of :meth:`opt_state_numpy`:
+        peak extra host memory is one unsharded leaf, the difference
+        between fitting host RAM and not when the fp32 Adam state is
+        ~3x the model. COLLECTIVE per leaf on a multi-process mesh;
+        every group member must drain the iterator in step."""
         assert self.opt_state is not None
         leaves = jax.tree.leaves(self.opt_state)
         if self._multiproc:
@@ -623,8 +632,11 @@ class Engine:
                 rep = jax.sharding.NamedSharding(
                     self.ctx.mesh, jax.sharding.PartitionSpec())
                 self._gather_jit = jax.jit(lambda x: x, out_shardings=rep)
-            return [np.asarray(self._gather_jit(l)) for l in leaves]
-        return [np.asarray(l) for l in leaves]
+            for l in leaves:
+                yield np.asarray(self._gather_jit(l))
+        else:
+            for l in leaves:
+                yield np.asarray(l)
 
     def load_opt_state(self, host_leaves: list):
         """Install gathered host leaves back onto the state shardings

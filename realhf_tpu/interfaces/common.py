@@ -133,24 +133,29 @@ def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:
     return out
 
 
-def save_checkpoint(model, save_dir: str, host_params=None):
+def save_checkpoint(model, save_dir: str, host_params=None,
+                    writer: bool = True):
     """Shared interface-save body (reference interfaces all end in the
     same ``api.save_hf(...)`` call).
 
-    ``host_params`` is the pre-gathered host copy the ModelHost hands
-    in on MULTI-process meshes (the gather is a collective every
-    member must join -- see ModelHost.save_role). Without it the mesh
-    is fully addressable, so save streams one layer at a time straight
-    from the device arrays (``save_hf_checkpoint_streamed``) and never
-    materializes the full model on host."""
+    Default path: stream one layer at a time straight from the device
+    arrays (``save_hf_checkpoint_streamed``), never materializing the
+    full model on host. On a PROCESS-SPANNING mesh the per-layer
+    slices are collective gathers every group member must join --
+    ModelHost.save_role calls this on all members with
+    ``writer=True`` only on the group leader, which alone writes
+    files. ``host_params`` (a pre-gathered host copy) keeps the eager
+    non-streamed path available for external callers."""
     from realhf_tpu.models.hf import (
         save_hf_checkpoint,
         save_hf_checkpoint_streamed,
     )
     if host_params is not None:
-        save_hf_checkpoint(save_dir, model.hf_family, model.config,
-                           host_params, tokenizer=model.tokenizer)
+        if writer:
+            save_hf_checkpoint(save_dir, model.hf_family, model.config,
+                               host_params, tokenizer=model.tokenizer)
     else:
         save_hf_checkpoint_streamed(save_dir, model.hf_family,
                                     model.config, model.engine.params,
-                                    tokenizer=model.tokenizer)
+                                    tokenizer=model.tokenizer,
+                                    writer=writer)

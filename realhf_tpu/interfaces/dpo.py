@@ -164,10 +164,11 @@ class DPOInterface(model_api.ModelInterface):
         return stats
 
     def save(self, model: model_api.Model, save_dir: str,
-             host_params=None):
+             host_params=None, writer: bool = True):
         if not self.enable_save:
             return
-        common.save_checkpoint(model, save_dir, host_params)
+        common.save_checkpoint(model, save_dir, host_params,
+                               writer=writer)
 
 
 model_api.register_interface("dpo", DPOInterface)

@@ -358,10 +358,11 @@ class PPOActorInterface(model_api.ModelInterface):
         return agg
 
     def save(self, model: model_api.Model, save_dir: str,
-             host_params=None):
+             host_params=None, writer: bool = True):
         if not self.enable_save:
             return
-        common.save_checkpoint(model, save_dir, host_params)
+        common.save_checkpoint(model, save_dir, host_params,
+                               writer=writer)
 
 
 @dataclasses.dataclass
@@ -524,10 +525,11 @@ class PPOCriticInterface(model_api.ModelInterface):
         return agg
 
     def save(self, model: model_api.Model, save_dir: str,
-             host_params=None):
+             host_params=None, writer: bool = True):
         if not self.enable_save:
             return
-        common.save_checkpoint(model, save_dir, host_params)
+        common.save_checkpoint(model, save_dir, host_params,
+                               writer=writer)
 
 
 model_api.register_interface("ppo_actor", PPOActorInterface)

@@ -140,10 +140,11 @@ class PairedRewardInterface(model_api.ModelInterface):
         return stats
 
     def save(self, model: model_api.Model, save_dir: str,
-             host_params=None):
+             host_params=None, writer: bool = True):
         if not self.enable_save:
             return
-        common.save_checkpoint(model, save_dir, host_params)
+        common.save_checkpoint(model, save_dir, host_params,
+                               writer=writer)
 
 
 model_api.register_interface("paired_rw", PairedRewardInterface)

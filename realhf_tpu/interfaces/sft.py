@@ -112,8 +112,9 @@ class SFTInterface(model_api.ModelInterface):
         return {"loss": loss, "ppl": float(np.exp(loss))}
 
     def save(self, model: model_api.Model, save_dir: str,
-             host_params=None):
-        common.save_checkpoint(model, save_dir, host_params)
+             host_params=None, writer: bool = True):
+        common.save_checkpoint(model, save_dir, host_params,
+                               writer=writer)
 
 
 model_api.register_interface("sft", SFTInterface)

@@ -415,19 +415,45 @@ def save_hf_checkpoint(path: str, family: str, cfg: TransformerConfig,
     logger.info("Saved %s checkpoint to %s", family, path)
 
 
+# Per-mesh cache of the collective gather/slice jits the streamed save
+# uses: fresh lambdas would retrace + recompile one program per leaf
+# shape on EVERY periodic checkpoint.
+_STREAM_SAVE_JITS: Dict[Any, Any] = {}
+
+
+def _stream_save_jits(mesh):
+    if mesh not in _STREAM_SAVE_JITS:
+        import jax
+
+        rep = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec())
+        _STREAM_SAVE_JITS[mesh] = (
+            jax.jit(lambda x: x, out_shardings=rep),
+            jax.jit(
+                lambda b, j: jax.lax.dynamic_slice_in_dim(b, j, 1,
+                                                          axis=0),
+                out_shardings=rep))
+    return _STREAM_SAVE_JITS[mesh]
+
+
 def save_hf_checkpoint_streamed(path: str, family: str,
                                 cfg: TransformerConfig,
                                 params: Dict[str, Any],
-                                tokenizer: Optional[Any] = None):
+                                tokenizer: Optional[Any] = None,
+                                writer: bool = True):
     """Host-RAM-bounded HF save: one safetensors shard per transformer
     layer, written from a single-layer slice of the (device-resident,
     possibly sharded) params -- the mirror of
     ``load_hf_checkpoint_streamed``. Peak host memory is one layer
     plus the non-stacked leaves (embeddings, norms, head), where the
     eager ``save_hf_checkpoint`` holds the full model TWICE (numpy
-    pytree + converted HF state dict). Single-process meshes only: on
-    a process-spanning mesh use ``Engine.params_numpy`` (the
-    collective leaf-by-leaf gather) with the eager save.
+    pytree + converted HF state dict).
+
+    On a PROCESS-SPANNING mesh this is a COLLECTIVE: every member of
+    the mesh must call it together (each per-layer slice is gathered
+    by a replicating jit all members join -- the per-layer schedule of
+    the reference's per-rank shard IO, ``conversion/hf_registry.py``);
+    only the process with ``writer=True`` touches the filesystem.
     """
     import copy
 
@@ -438,13 +464,26 @@ def save_hf_checkpoint_streamed(path: str, family: str,
              for leaf in jax.tree.leaves(params)
              if hasattr(leaf, "sharding")
              for d in leaf.sharding.device_set}
-    if len(procs) > 1:
-        raise ValueError(
-            "save_hf_checkpoint_streamed needs fully-addressable "
-            "params; gather with Engine.params_numpy (collective) and "
-            "use save_hf_checkpoint on a process-spanning mesh.")
+    multiproc = len(procs) > 1
+    if multiproc:
+        mesh = next(leaf.sharding.mesh for leaf in jax.tree.leaves(params)
+                    if hasattr(leaf, "sharding"))
+        gather_jit, slice_jit = _stream_save_jits(mesh)
 
-    os.makedirs(path, exist_ok=True)
+    def to_host(leaf):
+        """One leaf to host; replicating collective gather on a
+        process-spanning mesh (every member holds a full copy after,
+        so np.asarray reads process-local data)."""
+        return np.asarray(gather_jit(leaf) if multiproc else leaf)
+
+    def layer_slice(leaf, i):
+        """Stacked-leaf layer i as a [1, ...] host array."""
+        if multiproc:
+            return np.asarray(slice_jit(leaf, i))
+        return np.asarray(leaf[i:i + 1])
+
+    if writer:
+        os.makedirs(path, exist_ok=True)
     cfg1 = copy.copy(cfg)
     cfg1.n_layers = 1
     pat = _layer_key_pat()
@@ -452,7 +491,7 @@ def save_hf_checkpoint_streamed(path: str, family: str,
     params = dict(params)
     value_head = None
     if cfg.is_critic:
-        value_head = np.asarray(params.pop("head")["w"])
+        value_head = to_host(params.pop("head")["w"])
 
     # Non-stacked leaves: one host gather, vocab-unpadded, reused by
     # every per-layer conversion pass (the converters emit them each
@@ -467,10 +506,11 @@ def save_hf_checkpoint_streamed(path: str, family: str,
             # checkpoints store the true vocab; the device copy is
             # Megatron-padded for its tp (repad to tp=1 == unpad)
             nonlayer_host[keypath] = repad_vocab_leaf(
-                cfg, keypath, np.asarray(leaf), target_tp=1)
+                cfg, keypath, to_host(leaf), target_tp=1)
 
-    with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(config_to_hf(family, cfg), f, indent=2)
+    if writer:
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(config_to_hf(family, cfg), f, indent=2)
 
     n_files = cfg.n_layers + 1
     weight_map: Dict[str, str] = {}
@@ -478,6 +518,8 @@ def save_hf_checkpoint_streamed(path: str, family: str,
 
     def write_file(idx: int, state: StateDict):
         nonlocal total_bytes
+        if not writer:
+            return
         name = f"model-{idx + 1:05d}-of-{n_files:05d}.safetensors"
         safetensors.numpy.save_file(state, os.path.join(path, name))
         weight_map.update({k: name for k in state})
@@ -495,7 +537,7 @@ def save_hf_checkpoint_streamed(path: str, family: str,
         leaves = []
         for kp, leaf in flat:
             if kp and getattr(kp[0], "key", None) == "blocks":
-                leaves.append(np.asarray(leaf[i:i + 1]))
+                leaves.append(layer_slice(leaf, i))
             else:
                 keypath = tuple(e.key for e in kp)
                 leaves.append(nonlayer_host[keypath] if i == 0
@@ -510,6 +552,8 @@ def save_hf_checkpoint_streamed(path: str, family: str,
             write_file(cfg.n_layers, {k: v for k, v in state_i.items()
                                       if not pat.match(k)})
 
+    if not writer:
+        return
     with open(os.path.join(path, _INDEX_NAME), "w") as f:
         json.dump({"metadata": {"total_size": total_bytes},
                    "weight_map": weight_map}, f, indent=2)

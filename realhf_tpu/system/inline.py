@@ -140,10 +140,11 @@ class InlineRunner:
         for node in self.dfg.nodes:
             if node.interface_type != ModelInterfaceType.TRAIN_STEP:
                 continue
-            model = self.models[node.role]
-            path = f"{constants.run_save_path()}/{node.role}"
-            self.interfaces[node.name].save(model, path)
-            logger.info("Saved %s to %s", node.role, path)
+            # host.save_role streams the weights AND the optimizer
+            # state -- the resume path above restores Adam moments
+            # only if they were written here (it used to call the
+            # interface save directly, which silently dropped them).
+            self.host.save_role(node.role, node.name)
         # Recover info is only valid paired with the checkpoint it
         # describes (reference couples them in __recover_save), so it
         # is dumped here, never on unsaved steps.

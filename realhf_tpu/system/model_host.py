@@ -38,18 +38,26 @@ def _use_streamed_load(spec, multiproc: bool = False) -> bool:
     flag = getattr(spec, "streamed_load", None)
     if flag is not None:
         return bool(flag)
-    if multiproc:
-        # Auto mode probes the LOCAL filesystem; on a process-spanning
-        # mesh a divergent verdict between members would mismatch their
-        # collective schedules (streamed = one device_put per layer
-        # slice) and hang. Only the explicit flag -- identical on every
-        # process by construction -- may stream there.
-        return False
+    # Auto mode sizes the checkpoint on the local filesystem. That is
+    # safe on process-spanning meshes too: EVERY member reads the same
+    # spec.path to load at all (shared FS by requirement), so the size
+    # probe -- and with it the collective schedule -- agrees across
+    # members. A member that cannot even stat the path would fail the
+    # load itself, not just the probe.
     try:
         total = sum(
             os.path.getsize(os.path.join(spec.path, f))
             for f in os.listdir(spec.path) if f.endswith(".safetensors"))
     except OSError as e:
+        if multiproc:
+            # A silent eager fallback here could diverge from peers
+            # that sized the path fine, mismatching the group's
+            # collective load schedule -- fail loudly instead.
+            raise RuntimeError(
+                f"Could not size checkpoint {spec.path} for the auto "
+                "streamed-load decision on a process-spanning mesh "
+                f"({e}); set ModelSpec.streamed_load explicitly."
+            ) from e
         logger.warning(
             "Could not size checkpoint %s for the auto streamed-load "
             "decision (%s); loading eagerly. Set "
@@ -459,41 +467,44 @@ class ModelHost:
         if not getattr(self.interfaces[train_node_name], "enable_save",
                        True):
             # The leader's interface.save() returns without touching
-            # the params; members must skip the collective gather too
-            # or they would block in an all-gather nobody else joins.
+            # the params; members must skip the collective path too or
+            # they would block in a gather nobody else joins.
             return None
-        # params_numpy() is a COLLECTIVE on a multi-process mesh: run
-        # it HERE on every group member and hand the host copy to the
-        # interface, so leader and member collective counts match by
-        # construction no matter what the interface's save() does.
-        # Single-process meshes skip the gather entirely: the
-        # interface then streams one layer at a time from the device
-        # arrays (interfaces/common.py save_checkpoint), never holding
-        # the full model on host.
-        multiproc = model.engine.multiproc
-        host_params = model.engine.params_numpy() if multiproc else None
-        host_opt = (model.engine.opt_state_numpy()
-                    if model.engine.opt_state is not None and multiproc
-                    else None)
-        if not self.leader_of_role.get(role, True):
-            return None
-        self.interfaces[train_node_name].save(model, path,
-                                              host_params=host_params)
+        # Streamed save on EVERY mesh (VERDICT r4 #5): the interface
+        # streams one layer at a time from the device arrays
+        # (interfaces/common.py save_checkpoint). On a multi-process
+        # mesh each per-layer slice is a collective gather -- the save
+        # runs on every group member in step, and only the leader
+        # (writer=True) touches the filesystem. Peak host memory is
+        # one layer + embeddings on every process, never the model.
+        writer = self.leader_of_role.get(role, True)
+        import inspect
+        itf_save = self.interfaces[train_node_name].save
+        if "writer" in inspect.signature(itf_save).parameters:
+            itf_save(model, path, writer=writer)
+        else:
+            # Externally registered interface predating the writer
+            # kwarg: keep the old contract (pre-gathered host copy on
+            # multi-process meshes, leader-only call).
+            host_params = (model.engine.params_numpy()
+                           if model.engine.multiproc else None)
+            if writer:
+                itf_save(model, path, host_params=host_params)
         if model.engine.opt_state is not None:
             # EXCEEDS reference: Adam moments + fp32 master survive
-            # recovery instead of re-warming from zero (§5.4)
-            import numpy as _np
-
-            import jax as _jax
-
+            # recovery instead of re-warming from zero (§5.4). Same
+            # streaming discipline: one leaf host-resident at a time,
+            # collective per leaf on multi-process meshes (members
+            # drain the iterator to keep collective counts aligned).
             from realhf_tpu.engine import opt_checkpoint
-            if host_opt is not None:
-                opt_checkpoint.save_opt_state(path, host_opt)
+            leaf_iter = model.engine.iter_opt_state_numpy()
+            if writer:
+                opt_checkpoint.save_opt_state_iter(path, leaf_iter)
             else:
-                # single-process: one leaf host-resident at a time
-                opt_checkpoint.save_opt_state_iter(
-                    path, (_np.asarray(l) for l in
-                           _jax.tree.leaves(model.engine.opt_state)))
+                for _ in leaf_iter:
+                    pass
+        if not writer:
+            return None
         logger.info("Saved %s to %s", role, path)
         return path
 

@@ -1,0 +1,89 @@
+"""Child process body for the 2-process streamed-checkpoint test
+(no ``test_`` prefix: pytest must not collect this; it is spawned by
+``test_streamed_multiproc.py`` with a fixed rank).
+
+Protocol: argv = [rank, n_procs, coordinator, ckpt_dir, out_dir].
+Joins a 2-process jax.distributed world (2 virtual CPU devices per
+process), streams the checkpoint onto a d2t2 mesh spanning both
+processes, asserts host RSS stayed layer-bounded (never full-model),
+streams a SAVE back out (leader writes, member joins the collective
+gathers), and rank 0 verifies the round-trip bit-exactly.
+"""
+
+import resource
+import sys
+
+import numpy as np
+
+
+def rss_bytes() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def main():
+    rank, n_procs = int(sys.argv[1]), int(sys.argv[2])
+    coordinator, ckpt_dir, out_dir = sys.argv[3], sys.argv[4], sys.argv[5]
+
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n_procs, process_id=rank,
+                               local_device_ids=[0, 1])
+    assert jax.device_count() == 4, jax.devices()
+
+    from realhf_tpu.models.hf import (
+        load_hf_checkpoint_streamed,
+        save_hf_checkpoint_streamed,
+    )
+    from realhf_tpu.parallel.mesh import ParallelismConfig, make_mesh
+
+    mesh = make_mesh(ParallelismConfig(data_parallel_size=2,
+                                       tensor_parallel_size=2))
+    procs = {d.process_index for d in mesh.devices.flat}
+    assert procs == {0, 1}, procs
+
+    # warm up the runtime so baseline RSS includes jax/XLA overhead
+    jax.block_until_ready(
+        jax.jit(lambda x: x * 2)(np.ones((4, 4), np.float32)))
+
+    rss0 = rss_bytes()
+    cfg, params = load_hf_checkpoint_streamed(ckpt_dir, mesh,
+                                              family="llama")
+    jax.block_until_ready(params)
+    load_delta = rss_bytes() - rss0
+
+    model_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree.leaves(params))
+    # Host-RAM bound: the streamed load holds one layer (+ embeddings
+    # + this process's device shards, which live in RSS on the CPU
+    # backend) -- materializing the full model host-side even once
+    # would push the delta past model_bytes.
+    assert load_delta < model_bytes, (load_delta, model_bytes)
+
+    save_hf_checkpoint_streamed(out_dir, "llama", cfg, params,
+                                writer=(rank == 0))
+
+    if rank == 0:
+        import os
+
+        from realhf_tpu.models.hf import load_hf_checkpoint
+
+        _, orig = load_hf_checkpoint(ckpt_dir, family="llama")
+        _, rt = load_hf_checkpoint(out_dir, family="llama")
+        o_flat = jax.tree_util.tree_flatten_with_path(orig)[0]
+        r_flat = jax.tree_util.tree_flatten_with_path(rt)[0]
+        assert [k for k, _ in o_flat] == [k for k, _ in r_flat]
+        for (kp, a), (_, b) in zip(o_flat, r_flat):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(kp))
+        # per-layer shards really exist (streamed layout, not one blob)
+        shards = [f for f in os.listdir(out_dir)
+                  if f.endswith(".safetensors")]
+        assert len(shards) == cfg.n_layers + 1, shards
+    print(f"CHILD{rank} OK load_delta_mb={load_delta / 1e6:.1f} "
+          f"model_mb={model_bytes / 1e6:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

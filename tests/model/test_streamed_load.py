@@ -184,9 +184,10 @@ def test_streamed_load_auto_threshold(tmp_path, monkeypatch):
     assert not model_host._use_streamed_load(spec)  # tiny -> eager
     monkeypatch.setattr(model_host, "STREAMED_LOAD_AUTO_BYTES", 1)
     assert model_host._use_streamed_load(spec)      # auto-streams
-    # auto never streams on process-spanning meshes (collective paths
-    # must match across members); the explicit flag still does
-    assert not model_host._use_streamed_load(spec, multiproc=True)
+    # auto streams on process-spanning meshes too: every member sizes
+    # the same spec.path, so the collective schedule agrees (r5: the
+    # multiproc -> eager restriction is lifted)
+    assert model_host._use_streamed_load(spec, multiproc=True)
     assert model_host._use_streamed_load(
         ModelSpec(path=path, hf_family="llama", streamed_load=True),
         multiproc=True)

@@ -85,10 +85,15 @@ def test_sft_worker_group_spanning_two_processes(sft_data):
     assert out["complete"]
     assert out["global_step"] == 2  # 16 samples / bs 8
     assert np.isfinite(out["stats"]["trainDefault"]["loss"])
-    # collective checkpoint: the group leader wrote the HF files after
-    # the all-gather both members participated in
-    assert os.path.exists(os.path.join(constants.run_save_path(),
-                                       "default", "config.json"))
+    # STREAMED collective checkpoint (VERDICT r4 #5): per-layer
+    # gathers both members joined, leader-only writes, one safetensors
+    # shard per layer (+1 for embeddings/head) and streamed opt state
+    save_dir = os.path.join(constants.run_save_path(), "default")
+    assert os.path.exists(os.path.join(save_dir, "config.json"))
+    shards = [f for f in os.listdir(save_dir)
+              if f.endswith(".safetensors")]
+    assert len(shards) == TINY["n_layers"] + 1, shards
+    assert os.path.exists(os.path.join(save_dir, "optimizer_state.npz"))
 
 
 def test_ppo_actor_group_with_single_worker_roles(tmp_path):
