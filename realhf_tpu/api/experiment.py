@@ -46,6 +46,13 @@ class ModelSpec:
     # dir -- a new run must begin with fresh optimizer state even if
     # the dir carries an optimizer_state.npz.
     restore_optimizer_state: bool = False
+    # pp/ctx meshes generate through a decode view -- a SECOND full
+    # weight copy on a collapsed dp x tp mesh (Engine.decode_engine).
+    # True frees that copy after every generate MFC (steady-state HBM
+    # back to one copy, the 70B OOM frontier) at the price of one
+    # cross-mesh reshard per rollout; False keeps it resident so only
+    # weight changes pay the reshard.
+    drop_decode_view_after_rollout: bool = False
 
 
 @dataclasses.dataclass

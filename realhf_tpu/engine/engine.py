@@ -533,6 +533,30 @@ class Engine:
             self._decode_view_src = self.params
         return self._decode_view
 
+    def drop_decode_view(self):
+        """Free the decode view's weight copy.
+
+        On a pp/ctx mesh the view holds a second full copy of the
+        weights (2*n_params/gen_tp bytes per chip) between rollouts;
+        at the 70B scale that steady-state cost is the OOM frontier.
+        Dropping returns HBM to one resident copy; the next rollout
+        pays one cross-mesh reshard to rebuild the view. Policy knob:
+        ``ModelSpec.drop_decode_view_after_rollout`` (applied by
+        ModelHost after each generate MFC)."""
+        if self._decode_view is not None:
+            self._decode_view.params = None
+            self._decode_view_src = None
+
+    def decode_view_param_bytes(self) -> int:
+        """Bytes the decode view's weight copy currently holds across
+        the mesh (0 when absent or dropped) -- the quantity
+        ``drop_decode_view`` frees."""
+        if self._decode_view is None or self._decode_view.params is None:
+            return 0
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self._decode_view.params))
+
     def set_gen_tp(self, gen_tp: int):
         """Install a decode-view TP override (the allocation
         shorthand's "g"), validating against the mesh NOW rather than

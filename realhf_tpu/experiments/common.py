@@ -32,6 +32,9 @@ class ModelConfigCLI:
     # None = auto (stream checkpoints > 16 GB on single-process
     # meshes); True/False force (ModelSpec.streamed_load)
     streamed_load: Optional[bool] = None
+    # Free the decode view's second weight copy after each generate
+    # MFC on pp/ctx meshes (ModelSpec.drop_decode_view_after_rollout)
+    drop_decode_view_after_rollout: bool = False
 
     def to_spec(self, train: bool = True,
                 random_init_config: Optional[dict] = None) -> ModelSpec:
@@ -45,7 +48,9 @@ class ModelConfigCLI:
             parallel=self.parallel,
             gradient_checkpointing=self.gradient_checkpointing,
             bf16=self.bf16,
-            streamed_load=self.streamed_load)
+            streamed_load=self.streamed_load,
+            drop_decode_view_after_rollout=(
+                self.drop_decode_view_after_rollout))
 
 
 @dataclasses.dataclass

@@ -482,8 +482,15 @@ def save_hf_checkpoint_streamed(path: str, family: str,
             return np.asarray(slice_jit(leaf, i))
         return np.asarray(leaf[i:i + 1])
 
+    # Writer-side IO errors are RECORDED, not raised, until every
+    # collective gather below has run: aborting early would leave the
+    # other mesh members blocked in a gather the writer never joins.
+    io_error: Optional[BaseException] = None
     if writer:
-        os.makedirs(path, exist_ok=True)
+        try:
+            os.makedirs(path, exist_ok=True)
+        except OSError as e:
+            io_error = e
     cfg1 = copy.copy(cfg)
     cfg1.n_layers = 1
     pat = _layer_key_pat()
@@ -508,22 +515,33 @@ def save_hf_checkpoint_streamed(path: str, family: str,
             nonlayer_host[keypath] = repad_vocab_leaf(
                 cfg, keypath, to_host(leaf), target_tp=1)
 
-    if writer:
-        with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump(config_to_hf(family, cfg), f, indent=2)
+    if writer and io_error is None:
+        try:
+            with open(os.path.join(path, "config.json"), "w") as f:
+                json.dump(config_to_hf(family, cfg), f, indent=2)
+        except OSError as e:
+            io_error = e
 
     n_files = cfg.n_layers + 1
     weight_map: Dict[str, str] = {}
     total_bytes = 0
 
     def write_file(idx: int, state: StateDict):
-        nonlocal total_bytes
-        if not writer:
+        nonlocal total_bytes, io_error
+        if not writer or io_error is not None:
             return
-        name = f"model-{idx + 1:05d}-of-{n_files:05d}.safetensors"
-        safetensors.numpy.save_file(state, os.path.join(path, name))
-        weight_map.update({k: name for k in state})
-        total_bytes += sum(v.nbytes for v in state.values())
+        # A writer-side IO failure (ENOSPC, quota) must NOT abort the
+        # per-layer loop: on a process-spanning mesh the members keep
+        # running the collective gathers and would deadlock waiting
+        # for the writer to join. Record the error, keep pace with
+        # the collective schedule, re-raise once the loop completes.
+        try:
+            name = f"model-{idx + 1:05d}-of-{n_files:05d}.safetensors"
+            safetensors.numpy.save_file(state, os.path.join(path, name))
+            weight_map.update({k: name for k in state})
+            total_bytes += sum(v.nbytes for v in state.values())
+        except OSError as e:
+            io_error = e
 
     # i>0 passes only keep the LAYER keys of the converter output, so
     # the non-layer leaves get rank-preserving 1-element stand-ins
@@ -554,6 +572,8 @@ def save_hf_checkpoint_streamed(path: str, family: str,
 
     if not writer:
         return
+    if io_error is not None:
+        raise io_error
     with open(os.path.join(path, _INDEX_NAME), "w") as f:
         json.dump({"metadata": {"total_size": total_bytes},
                    "weight_map": weight_map}, f, indent=2)

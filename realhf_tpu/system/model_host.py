@@ -74,6 +74,31 @@ def _use_streamed_load(spec, multiproc: bool = False) -> bool:
     return False
 
 
+def _agreed_streamed_load(spec, mesh, tag: str) -> bool:
+    """The streamed-vs-eager verdict, AGREED across a process-spanning
+    mesh: a divergent local verdict (e.g. one member's stale network-FS
+    listing sizing the checkpoint differently) would mismatch the
+    group's collective load schedules and hang. The mesh's lowest-rank
+    process publishes its verdict under name_resolve; every other
+    member adopts it instead of trusting its own filesystem view."""
+    import jax
+
+    multiproc = len({d.process_index for d in mesh.devices.flat}) > 1
+    if not multiproc:
+        return _use_streamed_load(spec)
+    from realhf_tpu.base import name_resolve, names
+
+    key = (names.trial_root(constants.experiment_name(),
+                            constants.trial_name())
+           + f"/streamed_load/{tag}")
+    lead = min(d.process_index for d in mesh.devices.flat)
+    if jax.process_index() == lead:
+        verdict = _use_streamed_load(spec, multiproc=True)
+        name_resolve.add(key, str(int(verdict)), replace=True)
+        return verdict
+    return bool(int(name_resolve.wait(key, timeout=300)))
+
+
 def build_model(role: str, spec, tokenizer, total_steps: int,
                 devices=None, params_override=None,
                 cfg_override=None, init_seed=None,
@@ -99,9 +124,7 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
         # Engine.__init__ reshards them) instead of re-reading the
         # checkpoint.
         cfg, params = cfg_override, params_override
-    elif spec.path and _use_streamed_load(
-            spec, multiproc=len({d.process_index
-                                 for d in mesh.devices.flat}) > 1):
+    elif spec.path and _agreed_streamed_load(spec, mesh, role):
         # Host-RAM-bounded: stream layer-by-layer straight onto the
         # mesh (needed for >host-RAM models; hf/registry.py).
         from realhf_tpu.models.hf import load_hf_checkpoint_streamed
@@ -449,6 +472,17 @@ class ModelHost:
             out.remap_keys_(node.output_key_remap)
 
         # post-hooks ----------------------------------------------------
+        if (node.interface_type == ModelInterfaceType.GENERATE
+                and self.spec.models.get(node.role) is not None
+                and self.spec.models[node.role]
+                .drop_decode_view_after_rollout):
+            freed = model.engine.decode_view_param_bytes()
+            model.engine.drop_decode_view()
+            if freed:
+                logger.info(
+                    "Dropped %s decode view after %s (freed %.2f GB "
+                    "of weight copy; next rollout reshards).",
+                    node.role, node_name, freed / 2 ** 30)
         for h in node._post_hooks:
             if isinstance(h, OffloadHook):
                 model.engine.offload()
@@ -499,7 +533,14 @@ class ModelHost:
             from realhf_tpu.engine import opt_checkpoint
             leaf_iter = model.engine.iter_opt_state_numpy()
             if writer:
-                opt_checkpoint.save_opt_state_iter(path, leaf_iter)
+                try:
+                    opt_checkpoint.save_opt_state_iter(path, leaf_iter)
+                except Exception:
+                    # a writer-side IO failure mid-stream must not
+                    # desync the members' per-leaf collective gathers
+                    for _ in leaf_iter:
+                        pass
+                    raise
             else:
                 for _ in leaf_iter:
                     pass

@@ -198,3 +198,42 @@ def test_parse_gen_tp():
     assert p.gen_tp_size == 4 and p.pipeline_parallel_size == 2
     assert "g4" in str(p)
     assert parse_parallelism("d4t2").gen_tp_size == 0
+
+
+class TestDropDecodeView:
+
+    def test_drop_frees_and_rebuilds(self):
+        """drop_decode_view frees the view's weight copy (bytes -> 0);
+        the next rollout reshards and decodes identically."""
+        cfg = tiny_cfg()
+        prompts = prompts_small()
+        gcfg = greedy_gcfg()
+        eng = make_engine(cfg, ParallelismConfig(
+            data_parallel_size=2, pipeline_parallel_size=2,
+            tensor_parallel_size=2))
+        assert eng.decode_view_param_bytes() == 0  # lazy: no view yet
+        tok1, lp1, _ = run_generate(eng, prompts, gcfg)
+        held = eng.decode_view_param_bytes()
+        assert held > 0
+        # the view holds one full weight copy (param_dtype bytes)
+        expected = sum(l.size * l.dtype.itemsize
+                       for l in jax.tree.leaves(eng.params))
+        assert held == expected
+
+        eng.drop_decode_view()
+        assert eng.decode_view_param_bytes() == 0
+        assert eng._decode_view.params is None
+
+        tok2, lp2, _ = run_generate(eng, prompts, gcfg)  # reshards
+        assert eng.decode_view_param_bytes() == held
+        np.testing.assert_array_equal(tok1, tok2)
+        np.testing.assert_allclose(lp1, lp2, rtol=1e-5, atol=1e-6)
+
+    def test_drop_noop_on_plain_mesh(self):
+        """dp/tp meshes decode in place: nothing to drop, no error."""
+        cfg = tiny_cfg()
+        eng = make_engine(cfg, ParallelismConfig(
+            data_parallel_size=4, tensor_parallel_size=2))
+        run_generate(eng, prompts_small(), greedy_gcfg())
+        assert eng.decode_view_param_bytes() == 0
+        eng.drop_decode_view()

@@ -54,6 +54,9 @@ def test_ppo_pp_actor_decode_view(tmp_path):
             mspec.parallel = ParallelismConfig(
                 data_parallel_size=2, tensor_parallel_size=2,
                 pipeline_parallel_size=2)
+            # free the view's second weight copy after every rollout
+            # (ModelSpec knob wired through ModelHost.execute)
+            mspec.drop_decode_view_after_rollout = True
         else:
             mspec.parallel = ParallelismConfig(
                 data_parallel_size=2, tensor_parallel_size=4)
@@ -75,3 +78,7 @@ def test_ppo_pp_actor_decode_view(tmp_path):
     assert view is not None, "decode view never engaged"
     assert view.ctx.tp_size == 4 and view.ctx.dp_size == 2
     assert view.pipeline_ctx is None
+    # drop_decode_view_after_rollout: the view's weight copy was freed
+    # after the last generate MFC (steady-state HBM = one copy)
+    assert eng.decode_view_param_bytes() == 0
+    assert view.params is None
