@@ -1,0 +1,32 @@
+#!/bin/bash
+# Sweep-file profiling workflow (reference examples/profiling parity).
+#
+# Each jsonl line is a dict of dotted overrides on the `profile`
+# experiment (the 6-MFC PPO graph on synthetic data,
+# realhf_tpu/experiments/profile_exp.py). One format covers what the
+# reference splits across allocations/datasets/interfaces/models
+# sweep files -- see the samples next to this script.
+#
+# REALHF_TPU_DUMP_TRACE=1 dumps a jax.profiler trace per MFC;
+# REALHF_TPU_DUMP_MEMORY=1 dumps device memory profiles
+# (base/monitor.py). On a machine without TPUs, prepend
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# to sweep layouts on the virtual mesh (timings then rank CPU cost,
+# not TPU cost; run on the chip for real numbers).
+#
+# A single setup (no sweep) runs through quickstart directly:
+#   python -m realhf_tpu.apps.quickstart profile \
+#       model_size=1b benchmark_steps=3 actor_gen_alloc=d8t1
+
+set -e
+cd "$(dirname "$0")/../.."
+
+REALHF_TPU_DUMP_TRACE=${REALHF_TPU_DUMP_TRACE:-0} \
+python scripts/profile_sweep.py \
+    --sweep examples/profiling/allocations.jsonl \
+    --out profile_results.jsonl \
+    model_size=${MODEL_SIZE:-125m} \
+    benchmark_steps=${BENCHMARK_STEPS:-3} \
+    n_prompts=64 \
+    dataset.train_bs_n_seqs=16 \
+    ppo.max_new_tokens=64 ppo.min_new_tokens=64

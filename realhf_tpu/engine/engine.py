@@ -548,14 +548,18 @@ class Engine:
             self._decode_view_src = None
 
     def decode_view_param_bytes(self) -> int:
-        """Bytes the decode view's weight copy currently holds across
-        the mesh (0 when absent or dropped) -- the quantity
-        ``drop_decode_view`` frees."""
+        """MESH-WIDE bytes the decode view's weights currently hold
+        (0 when absent or dropped) -- the quantity ``drop_decode_view``
+        frees. One logical copy shards over the view's tp and
+        REPLICATES over its dp groups, so this is
+        ``n_params * itemsize * view_dp`` (per chip:
+        ``n_params * itemsize / view_tp``)."""
         if self._decode_view is None or self._decode_view.params is None:
             return 0
-        return sum(
+        logical = sum(
             leaf.size * leaf.dtype.itemsize
             for leaf in jax.tree.leaves(self._decode_view.params))
+        return logical * self._decode_view.ctx.dp_size
 
     def set_gen_tp(self, gen_tp: int):
         """Install a decode-view TP override (the allocation

@@ -540,7 +540,9 @@ def save_hf_checkpoint_streamed(path: str, family: str,
             safetensors.numpy.save_file(state, os.path.join(path, name))
             weight_map.update({k: name for k in state})
             total_bytes += sum(v.nbytes for v in state.values())
-        except OSError as e:
+        except Exception as e:  # noqa: BLE001 - SafetensorError is not
+            # an OSError; any writer-side failure must keep the loop
+            # (and with it the collective schedule) running
             io_error = e
 
     # i>0 passes only keep the LAYER keys of the converter output, so

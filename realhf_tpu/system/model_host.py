@@ -83,6 +83,11 @@ def _agreed_streamed_load(spec, mesh, tag: str) -> bool:
     member adopts it instead of trusting its own filesystem view."""
     import jax
 
+    flag = getattr(spec, "streamed_load", None)
+    if flag is not None:
+        # explicit flag: identical on every member by construction, no
+        # rendezvous needed
+        return bool(flag)
     multiproc = len({d.process_index for d in mesh.devices.flat}) > 1
     if not multiproc:
         return _use_streamed_load(spec)
@@ -481,8 +486,8 @@ class ModelHost:
             if freed:
                 logger.info(
                     "Dropped %s decode view after %s (freed %.2f GB "
-                    "of weight copy; next rollout reshards).",
-                    node.role, node_name, freed / 2 ** 30)
+                    "of mesh-wide weight copies; next rollout "
+                    "reshards).", node.role, node_name, freed / 2 ** 30)
         for h in node._post_hooks:
             if isinstance(h, OffloadHook):
                 model.engine.offload()
@@ -514,16 +519,25 @@ class ModelHost:
         writer = self.leader_of_role.get(role, True)
         import inspect
         itf_save = self.interfaces[train_node_name].save
-        if "writer" in inspect.signature(itf_save).parameters:
-            itf_save(model, path, writer=writer)
-        else:
-            # Externally registered interface predating the writer
-            # kwarg: keep the old contract (pre-gathered host copy on
-            # multi-process meshes, leader-only call).
-            host_params = (model.engine.params_numpy()
-                           if model.engine.multiproc else None)
-            if writer:
-                itf_save(model, path, host_params=host_params)
+        save_err: Optional[BaseException] = None
+        try:
+            if "writer" in inspect.signature(itf_save).parameters:
+                itf_save(model, path, writer=writer)
+            else:
+                # Externally registered interface predating the writer
+                # kwarg: keep the old contract (pre-gathered host copy
+                # on multi-process meshes, leader-only call).
+                host_params = (model.engine.params_numpy()
+                               if model.engine.multiproc else None)
+                if writer:
+                    itf_save(model, path, host_params=host_params)
+        except Exception as e:  # noqa: BLE001 - re-raised below
+            # The streamed save completes its collective schedule
+            # before raising writer-side IO errors, but raising HERE
+            # would still skip the writer's opt-state collectives
+            # while members run theirs -- hold the error until every
+            # collective phase of this save is done.
+            save_err = e
         if model.engine.opt_state is not None:
             # EXCEEDS reference: Adam moments + fp32 master survive
             # recovery instead of re-warming from zero (§5.4). Same
@@ -532,18 +546,19 @@ class ModelHost:
             # drain the iterator to keep collective counts aligned).
             from realhf_tpu.engine import opt_checkpoint
             leaf_iter = model.engine.iter_opt_state_numpy()
-            if writer:
+            if writer and save_err is None:
                 try:
                     opt_checkpoint.save_opt_state_iter(path, leaf_iter)
-                except Exception:
+                except Exception as e:  # noqa: BLE001 - raised below
                     # a writer-side IO failure mid-stream must not
                     # desync the members' per-leaf collective gathers
-                    for _ in leaf_iter:
-                        pass
-                    raise
-            else:
-                for _ in leaf_iter:
-                    pass
+                    save_err = e
+            # members -- and a writer that already failed -- drain the
+            # iterator so per-leaf collective counts stay matched
+            for _ in leaf_iter:
+                pass
+        if save_err is not None:
+            raise save_err
         if not writer:
             return None
         logger.info("Saved %s to %s", role, path)

@@ -215,10 +215,12 @@ class TestDropDecodeView:
         tok1, lp1, _ = run_generate(eng, prompts, gcfg)
         held = eng.decode_view_param_bytes()
         assert held > 0
-        # the view holds one full weight copy (param_dtype bytes)
-        expected = sum(l.size * l.dtype.itemsize
-                       for l in jax.tree.leaves(eng.params))
-        assert held == expected
+        # mesh-wide: one logical copy replicated over the view's dp
+        # groups (d4t2 view on the 8-device d2p2t2 mesh -> 4x)
+        logical = sum(l.size * l.dtype.itemsize
+                      for l in jax.tree.leaves(eng.params))
+        assert eng._decode_view.ctx.dp_size == 4
+        assert held == logical * 4
 
         eng.drop_decode_view()
         assert eng.decode_view_param_bytes() == 0
