@@ -27,6 +27,7 @@ should be a multiple of 128 on real TPUs. S is padded to the K block.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -40,7 +41,29 @@ logger = logging.getLogger("decode_attention")
 
 NEG_INF = -2.0 ** 30
 SUBLANES = 8
-DEFAULT_BK = 512
+
+
+def _default_bk() -> int:
+    """K-block rows per kernel step; REALHF_TPU_DECODE_BK overrides
+    for on-chip tuning sweeps (scripts/sweep_decode_bk.py) without a
+    code edit. Validated here so a malformed value fails at the knob,
+    not as a ZeroDivisionError deep inside the kernel."""
+    raw = os.environ.get("REALHF_TPU_DECODE_BK")
+    if not raw:
+        return 512
+    try:
+        v = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"REALHF_TPU_DECODE_BK={raw!r} is not an integer") from e
+    if v < 128 or v % 128:
+        raise ValueError(
+            "REALHF_TPU_DECODE_BK must be a positive multiple of 128 "
+            f"(lane tiling), got {v}")
+    return v
+
+
+DEFAULT_BK = _default_bk()
 
 
 def _decode_body(q, k_at, v_at, keep_at, o_ref, *, scale, bk, s,
@@ -161,13 +184,18 @@ def _trim_stats(res, return_stats, b, nq, group):
     return res[:, :, :group, :].reshape(b, nq, hd)
 
 
+#: candidate K-blocks, descending (multiples of 128 for lane tiling)
+_BK_LADDER = (4096, 2048, 1024, 512, 384, 256, 128)
+
+
 def _pick_bk(s: int, block_k: int = DEFAULT_BK) -> int:
     """Largest K-block <= block_k that divides s (cache lengths are
     allocated as multiples of 128, so this normally succeeds and the
-    concat-pad fallback never runs on the hot path)."""
+    concat-pad fallback never runs on the hot path). The ladder spans
+    past 512 so a raised DEFAULT_BK actually takes effect."""
     if s <= block_k:
         return s
-    for bk in (512, 384, 256, 128):
+    for bk in _BK_LADDER:
         if bk <= block_k and s % bk == 0:
             return bk
     return block_k

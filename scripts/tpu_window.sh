@@ -1,0 +1,50 @@
+#!/bin/bash
+# One-command TPU measurement window (round-5 plan): when the axon
+# tunnel recovers, this captures every chip-blocked VERDICT item in
+# one run. Do NOT kill it mid-run -- a jax process killed while
+# holding the chip wedges the relay for hours.
+#
+#   bash scripts/tpu_window.sh [outdir]
+#
+# Runs, in order (cheapest first so a re-wedge loses the least):
+#   1. decode profile (kernel engagement + roofline fraction)
+#   2. decode K-block sweep (tune DEFAULT_BK on real silicon)
+#   3. remat recompute-tax measurement
+#   4. cost-model calibration + searched-vs-heuristic comparison
+#   5. the full bench.py (headline PPO + SFT + serving numbers)
+#
+# Each step's stdout/stderr lands in $OUT. The chip is ONE v5e behind
+# the tunnel; everything runs sequentially.
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-.round5/tpu_window_$(date +%H%M)}
+mkdir -p "$OUT"
+echo "TPU window capture -> $OUT"
+
+probe() {
+  timeout 150 python -c "import jax; jax.devices(); print(jax.default_backend())" 2>/dev/null | tail -1
+}
+
+BACKEND=$(probe)
+if [ "$BACKEND" != "tpu" ]; then
+  echo "backend '$BACKEND' is not tpu -- tunnel still wedged? aborting."
+  exit 1
+fi
+echo "chip is live; capturing."
+
+run() {  # run <name> <cmd...>
+  local name=$1; shift
+  echo "=== $name: $*"
+  "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "--- $name rc=$? (tail)"; tail -3 "$OUT/$name.out"
+}
+
+run decode_profile python scripts/profile_decode.py
+run decode_bk_sweep python scripts/sweep_decode_bk.py
+run remat_tax python scripts/remat_tax.py
+run calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
+run bench python bench.py
+
+echo "done; results in $OUT"
+grep -h '"metric"' "$OUT/bench.out" | tail -1
