@@ -21,6 +21,7 @@ the algorithm interfaces do SequenceSample <-> stream packing.
 """
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -132,6 +133,7 @@ class Engine:
             self.mesh, ctx.parallel.sequence_parallel)
         # Context parallelism: attention becomes a ring over the "ctx"
         # mesh axis; the rest of the model shards L via GSPMD.
+        self.attention_fn_inference = None
         if ctx.parallel.context_parallel_size > 1:
             from realhf_tpu.ops.ring_attention import ring_attention
             mesh = self.mesh
@@ -143,6 +145,29 @@ class Engine:
                                       sliding_window=sliding_window)
 
             self.attention_fn = _ring
+            # REALHF_TPU_FUSED_RING=1: single-Pallas-kernel ring with
+            # the KV RDMA overlapped against flash compute
+            # (ops/ring_attention_fused.py) -- INFERENCE jits only:
+            # training keeps the shard_map formulation because a
+            # side-effecting kernel cannot live inside the
+            # jax.checkpoint regions gradient_checkpointing wraps
+            # around every block. Off by default until validated on
+            # multi-chip hardware; on CPU it runs the interpret-mode
+            # emulation (CI wiring coverage).
+            if os.environ.get("REALHF_TPU_FUSED_RING") == "1":
+                from realhf_tpu.ops.ring_attention_fused import (
+                    ring_attention_fused,
+                )
+                interp = jax.default_backend() != "tpu"
+
+                def _ring_fused(q, k, v, seg, causal=True, scale=None,
+                                sliding_window=None):
+                    return ring_attention_fused(
+                        q, k, v, seg, mesh, "ctx", causal=causal,
+                        scale=scale, sliding_window=sliding_window,
+                        interpret=interp)
+
+                self.attention_fn_inference = _ring_fused
         elif _pallas_enabled() and _mesh_nontrivial(self.mesh):
             if ctx.pp_size > 1:
                 # Inside the pipe-manual shard_map a bare pallas_call
@@ -276,6 +301,13 @@ class Engine:
         addressable on every member process); None single-process to
         let XLA choose."""
         return self._replicated_sharding if self._multiproc else None
+
+    @property
+    def _infer_attention_fn(self):
+        """Attention for inference-only jits (forward/logprobs/values
+        /generate): the fused-RDMA ring when enabled, else the same
+        train-safe fn the loss closures capture."""
+        return self.attention_fn_inference or self.attention_fn
 
     @property
     def n_streams(self) -> int:
@@ -425,7 +457,7 @@ class Engine:
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn,
+                                 attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return h
@@ -443,7 +475,7 @@ class Engine:
             def f(params, ids, seg, mask, temp, has_mask):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn,
+                                 attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return F.shifted_logprobs_from_hidden(
@@ -466,7 +498,7 @@ class Engine:
             def f(params, ids, seg):
                 h, _ = T.forward(self.cfg, params, ids, seg,
                                  activation_constraint=self._constrain,
-                                 attention_fn=self.attention_fn,
+                                 attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
                                  pipeline=self.pipeline_ctx)
                 return T.critic_values(self.cfg, params, h)

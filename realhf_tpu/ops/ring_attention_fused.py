@@ -1,0 +1,413 @@
+"""Fused ring attention: one Pallas kernel per device with the KV
+ring riding inter-chip RDMA (``pltpu.make_async_remote_copy``)
+overlapped against flash compute.
+
+The shard_map/ppermute formulation (``ops/ring_attention.py``) leaves
+the comm/compute overlap to XLA's scheduler and re-enters jitted
+glue between rounds. Here one kernel owns the whole ring: KV shards
+live in a double-buffered HBM slab, each round's send to the right
+neighbor is issued BEFORE the round's flash compute so the transfer
+hides behind it, and slot reuse is fenced by a neighbor handshake
+(regular semaphore: a receiver frees a slot only after its own reads
+AND its forwarding send of that slot have completed). Per-round
+compute is the same tiled online-softmax (flash-2 schedule, GQA,
+packed-segment + causal + sliding-window masks on GLOBAL positions)
+as ``ops/flash_attention.py``.
+
+Ring choreography per device (n = ring size, slot = r % 2):
+
+  round r first cell:  r==0: neighbor barrier (all members entered)
+                       r>0:  wait recv[slot]  (this round's KV landed)
+                             wait send[1-slot] (our r-1 send drained)
+                             signal LEFT: "my slot 1-slot is free"
+                       r<n-1: (r>0: wait RIGHT's free signal)
+                              start RDMA kbuf/vbuf/segk[slot] ->
+                              right neighbor's [1-slot]
+  every cell:          local DMA of this (batch, kv-head) KV slice
+                       HBM slab -> VMEM, flash-accumulate the q tile
+  round n-1:           normalize and write o
+
+Cross-round accumulator state (m / l / unnormalized acc) persists in
+unblocked HBM slabs (``pl.ANY`` outputs) moved by explicit local DMAs
+each cell -- Mosaic's output pipeline forbids revisiting blocked
+output windows across non-adjacent grid cells, and these are the same
+bytes the shard_map formulation carries through its fori_loop anyway.
+
+Interpret-mode tested on the virtual CPU mesh (remote DMAs + remote
+semaphore signals are emulated by ``pltpu.InterpretParams``); real
+multi-chip validation pending hardware (docs/PARITY.md).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax import shard_map  # needs the check_vma-era API
+from jax.sharding import Mesh, PartitionSpec as P
+
+from realhf_tpu.ops.ring_attention import ring_attention
+
+NEG_INF = -2.0 ** 30
+LANES = 128
+SUBLANES = 8
+
+
+def _fit_block(lc: int, block: int) -> int:
+    b = min(block, lc)
+    while lc % b:
+        b -= 1
+    if b < 8:
+        # a silent mis-grid (empty q dimension / dropped tail tokens)
+        # would return uninitialized output -- refuse instead
+        raise ValueError(
+            f"local context shard of {lc} tokens has no >=8 tile "
+            f"divisor <= {block}; pad the sequence or adjust the "
+            "ctx degree for ring_attention_fused.")
+    return b
+
+
+def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
+                 kin_ref, vin_ref, segin_ref,         # ANY inputs
+                 o_ref,                                # ANY output
+                 kbuf_ref, vbuf_ref, segk_ref,        # ANY ring slabs
+                 m_ref, l_ref, acc_ref,               # ANY state slabs
+                 k_vmem, v_vmem, sk_vmem,             # VMEM KV scratch
+                 m_vmem, l_vmem, acc_vmem, o_vmem,    # VMEM state
+                 dma_sems,                             # local-copy sems
+                 send_sems, recv_sems,                 # RDMA sems [3, 2]
+                 free_sem,                             # slot handshake
+                 *, n: int, axis: str, bq: int, bk: int, group: int,
+                 scale: float, causal: bool,
+                 sliding_window: Optional[int]):
+    r = pl.program_id(0)
+    bi = pl.program_id(1)
+    hk = pl.program_id(2)
+    qi = pl.program_id(3)
+    n_qb = pl.num_programs(3)
+    my = jax.lax.axis_index(axis)
+    right = jax.lax.rem(my + 1, n)
+    left = jax.lax.rem(my + n - 1, n)
+    slot = jax.lax.rem(r, 2)
+    nxt = 1 - slot
+    lc = k_vmem.shape[0]
+
+    first_cell = jnp.logical_and(
+        jnp.logical_and(bi == 0, hk == 0), qi == 0)
+
+    def slab_rdma(slot_src, slot_dst, sem_i):
+        """RDMA descriptors for the three ring slabs (k, v, segk)."""
+        return [
+            pltpu.make_async_remote_copy(
+                src_ref=src.at[slot_src], dst_ref=src.at[slot_dst],
+                send_sem=send_sems.at[i, sem_i],
+                recv_sem=recv_sems.at[i, sem_i],
+                device_id={axis: right},
+                device_id_type=pltpu.DeviceIdType.MESH)
+            for i, src in enumerate((kbuf_ref, vbuf_ref, segk_ref))
+        ]
+
+    # ---- round bookkeeping (once per round) --------------------------
+    @pl.when(jnp.logical_and(first_cell, r == 0))
+    def _round0_setup():
+        # every ring member must have entered the kernel (allocated
+        # its slabs) before anyone RDMAs into it
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, inc=1, device_id={axis: left},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_signal(bar, inc=1, device_id={axis: right},
+                               device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(bar, 2)
+        # local KV -> ring slot 0 (the slab round 0 sends from)
+        cps = [pltpu.make_async_copy(src, dst.at[0], dma_sems.at[i])
+               for i, (src, dst) in enumerate(
+                   ((kin_ref, kbuf_ref), (vin_ref, vbuf_ref),
+                    (segin_ref, segk_ref)))]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+
+    @pl.when(jnp.logical_and(first_cell, r > 0))
+    def _round_start():
+        # this round's KV has landed in [slot]; our forwarding send
+        # of [nxt] (issued in round r-1 from slot (r-1)%2 == nxt) has
+        # drained, so the LEFT neighbor may now overwrite [nxt]
+        for d in slab_rdma(nxt, slot, slot):
+            d.wait()
+
+        @pl.when(r < n - 1)
+        def _free_slot():
+            # matched by the LEFT neighbor's _wait_free at its round
+            # r (sends happen at rounds 0..n-2); an unguarded signal
+            # at round n-1 would leave the semaphore non-zero at
+            # kernel exit
+            pltpu.semaphore_signal(
+                free_sem, inc=1, device_id={axis: left},
+                device_id_type=pltpu.DeviceIdType.MESH)
+
+    @pl.when(jnp.logical_and(first_cell, r < n - 1))
+    def _round_send():
+        # overlap: the send for round r+1 flies while round r computes
+        @pl.when(r > 0)
+        def _wait_free():
+            pltpu.semaphore_wait(free_sem, 1)
+
+        for d in slab_rdma(slot, nxt, nxt):
+            d.start()
+
+    # ---- this cell's KV slice: HBM slab -> VMEM ----------------------
+    cp_k = pltpu.make_async_copy(kbuf_ref.at[slot, bi, hk], k_vmem,
+                                 dma_sems.at[0])
+    cp_v = pltpu.make_async_copy(vbuf_ref.at[slot, bi, hk], v_vmem,
+                                 dma_sems.at[1])
+    cp_s = pltpu.make_async_copy(segk_ref.at[slot, bi], sk_vmem,
+                                 dma_sems.at[2])
+    cp_k.start(); cp_v.start(); cp_s.start()
+
+    # ---- cross-round accumulator state: HBM slab -> VMEM -------------
+    @pl.when(r > 0)
+    def _load_state():
+        cps = [
+            pltpu.make_async_copy(
+                m_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], m_vmem,
+                dma_sems.at[3]),
+            pltpu.make_async_copy(
+                l_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], l_vmem,
+                dma_sems.at[4]),
+            pltpu.make_async_copy(
+                acc_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], acc_vmem,
+                dma_sems.at[5]),
+        ]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+
+    @pl.when(r == 0)
+    def _init_state():
+        m_vmem[...] = jnp.full(m_vmem.shape, NEG_INF, jnp.float32)
+        l_vmem[...] = jnp.zeros(l_vmem.shape, jnp.float32)
+        acc_vmem[...] = jnp.zeros(acc_vmem.shape, jnp.float32)
+
+    cp_k.wait(); cp_v.wait(); cp_s.wait()
+
+    # ---- flash-accumulate this q tile vs the round's KV shard -------
+    src_dev = jax.lax.rem(my - r + n, n)   # whose shard we hold
+    q_off = my * (n_qb * bq) + qi * bq
+    k_off = src_dev * lc
+    seg_q = segq_ref[0, :, 0]              # [bq]
+    n_kb = lc // bk
+
+    for g in range(group):
+        q = q_ref[0, 0, g].astype(jnp.float32) * scale     # [bq, hd]
+        hd = q.shape[-1]
+        m0 = m_vmem[g]
+        l0 = l_vmem[g]
+        a0 = acc_vmem[g]
+
+        def body(j, carry, q=q):
+            m, l_sum, acc = carry
+            k = k_vmem[pl.ds(j * bk, bk), :].astype(jnp.float32)
+            v = v_vmem[pl.ds(j * bk, bk), :]
+            seg_k = sk_vmem[0, pl.ds(j * bk, bk)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [bq, bk]
+            qg = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kg = (k_off + j * bk
+                  + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+            mask = (seg_q[:, None] == seg_k[None, :]) \
+                & (seg_q[:, None] != 0)
+            if causal:
+                mask &= qg >= kg
+            if sliding_window is not None:
+                mask &= (qg - kg) < sliding_window
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l_sum * alpha + p.sum(axis=1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m, l_sum, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, a0))
+        m_vmem[g] = m
+        l_vmem[g] = l_sum
+        acc_vmem[g] = acc
+
+        @pl.when(r == n - 1)
+        def _finalize(m=m, l_sum=l_sum, acc=acc, g=g):
+            row_valid = m > NEG_INF / 2
+            safe_l = jnp.where(l_sum > 0, l_sum, 1.0)
+            out = jnp.where(row_valid[:, None], acc / safe_l[:, None],
+                            0.0)
+            o_vmem[g] = out.astype(o_vmem.dtype)
+
+    # ---- state / output: VMEM -> HBM slabs ---------------------------
+    @pl.when(r < n - 1)
+    def _store_state():
+        cps = [
+            pltpu.make_async_copy(
+                m_vmem, m_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
+                dma_sems.at[3]),
+            pltpu.make_async_copy(
+                l_vmem, l_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
+                dma_sems.at[4]),
+            pltpu.make_async_copy(
+                acc_vmem, acc_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
+                dma_sems.at[5]),
+        ]
+        for c in cps:
+            c.start()
+        for c in cps:
+            c.wait()
+
+    @pl.when(r == n - 1)
+    def _store_out():
+        cp = pltpu.make_async_copy(
+            o_vmem, o_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
+            dma_sems.at[6])
+        cp.start()
+        cp.wait()
+
+
+def _fused_local(q, k, v, seg, *, mesh, axis, n, scale, causal,
+                 sliding_window, bq, bk, interpret, collective_id):
+    """Per-device body under shard_map. Local shapes:
+    q [b, lc, nq, hd], k/v [b, lc, nkv, hd], seg [b, lc]."""
+    b, lc, nq, hd = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    n_qb = lc // bq
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b, nkv, group, lc, hd)
+    segq = jnp.broadcast_to(seg[:, :, None], (b, lc, LANES))
+    kt = k.transpose(0, 2, 1, 3)                  # [b, nkv, lc, hd]
+    vt = v.transpose(0, 2, 1, 3)
+    segk = jnp.broadcast_to(seg[:, None, :], (b, SUBLANES, lc))
+
+    grid = (n, b, nkv, n_qb)
+    kernel = functools.partial(
+        _ring_kernel, n=n, axis=axis, bq=bq, bk=bk, group=group,
+        scale=scale, causal=causal, sliding_window=sliding_window)
+
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, bq, hd),
+                         lambda r, bi, hk, qi: (bi, hk, 0, qi, 0)),
+            pl.BlockSpec((1, bq, LANES),
+                         lambda r, bi, hk, qi: (bi, qi, 0)),  # segq
+            any_spec, any_spec, any_spec,        # local k / v / segk
+        ],
+        out_shape=(
+            # o + ring slabs + cross-round state, all manually DMA'd
+            jax.ShapeDtypeStruct((b, nkv, group, lc, hd), q.dtype),
+            jax.ShapeDtypeStruct((2,) + kt.shape, kt.dtype),
+            jax.ShapeDtypeStruct((2,) + vt.shape, vt.dtype),
+            jax.ShapeDtypeStruct((2,) + segk.shape, segk.dtype),
+            jax.ShapeDtypeStruct((b, nkv, group, lc), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, group, lc), jnp.float32),
+            jax.ShapeDtypeStruct((b, nkv, group, lc, hd), jnp.float32),
+        ),
+        out_specs=(any_spec,) * 7,
+        scratch_shapes=[
+            pltpu.VMEM((lc, hd), k.dtype),              # k slice
+            pltpu.VMEM((lc, hd), v.dtype),              # v slice
+            pltpu.VMEM((SUBLANES, lc), seg.dtype),      # segk slice
+            pltpu.VMEM((group, bq), jnp.float32),       # m
+            pltpu.VMEM((group, bq), jnp.float32),       # l
+            pltpu.VMEM((group, bq, hd), jnp.float32),   # acc
+            pltpu.VMEM((group, bq, hd), q.dtype),       # out tile
+            pltpu.SemaphoreType.DMA((7,)),              # local copies
+            pltpu.SemaphoreType.DMA((3, 2)),            # RDMA send
+            pltpu.SemaphoreType.DMA((3, 2)),            # RDMA recv
+            pltpu.SemaphoreType.REGULAR,                # slot free
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=(pltpu.InterpretParams() if interpret else False),
+    )(qt, segq, kt, vt, segk)
+
+    o = out[0].reshape(b, nq, lc, hd).transpose(0, 2, 1, 3)
+    return o.astype(q.dtype)
+
+
+def ring_attention_fused(
+    q: jnp.ndarray,        # [B, L, nq, hd] -- L sharded over `axis`
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seg_ids: jnp.ndarray,  # [B, L]
+    mesh: Mesh,
+    axis: str = "ctx",
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+    collective_id: int = 7,
+) -> jnp.ndarray:
+    """Drop-in for :func:`ring_attention` with the fused-RDMA kernel
+    on the forward pass. Differentiable: the backward delegates to the
+    shard_map/ppermute formulation's VJP (recompute-based -- the same
+    work gradient checkpointing already schedules), so gradients are
+    bit-identical to the unfused path while the forward gains the
+    overlapped ring.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = mesh.shape[axis]
+    if n == 1:
+        return ring_attention(q, k, v, seg_ids, mesh, axis,
+                              causal=causal, scale=scale,
+                              sliding_window=sliding_window)
+    lc = q.shape[1] // n
+    bq = _fit_block(lc, block_q)
+    bk = _fit_block(lc, block_k)
+
+    data_ax = "data" if "data" in mesh.axis_names \
+        and mesh.shape["data"] > 1 else None
+    model_ax = "model" if ("model" in mesh.axis_names
+                           and mesh.shape["model"] > 1
+                           and q.shape[2] % mesh.shape["model"] == 0
+                           and k.shape[2] % mesh.shape["model"] == 0) \
+        else None
+    spec4 = P(data_ax, axis, model_ax, None)
+    spec2 = P(data_ax, axis)
+
+    local = functools.partial(
+        _fused_local, mesh=mesh, axis=axis, n=n, scale=scale,
+        causal=causal, sliding_window=sliding_window, bq=bq, bk=bk,
+        interpret=interpret, collective_id=collective_id)
+    fused_fwd = shard_map(local, mesh=mesh,
+                          in_specs=(spec4, spec4, spec4, spec2),
+                          out_specs=spec4, check_vma=False)
+
+    @jax.custom_vjp
+    def attn(q, k, v, seg):
+        return fused_fwd(q, k, v, seg)
+
+    def attn_fwd(q, k, v, seg):
+        return fused_fwd(q, k, v, seg), (q, k, v, seg)
+
+    def attn_bwd(res, g):
+        q, k, v, seg = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: ring_attention(
+                q_, k_, v_, seg, mesh, axis, causal=causal,
+                scale=scale, sliding_window=sliding_window,
+                block_q=block_q, block_k=block_k),
+            q, k, v)
+        return (*vjp(g), None)
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn(q, k, v, seg_ids)
