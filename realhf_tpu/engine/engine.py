@@ -304,9 +304,11 @@ class Engine:
 
     @property
     def _infer_attention_fn(self):
-        """Attention for inference-only jits (forward/logprobs/values
-        /generate): the fused-RDMA ring when enabled, else the same
-        train-safe fn the loss closures capture."""
+        """Attention for the inference-only jits (forward_hidden /
+        forward_logprobs / forward_values): the fused-RDMA ring when
+        enabled, else the same train-safe fn the loss closures
+        capture. Generation never sees it -- on a ctx mesh it runs on
+        the collapsed dp x tp decode view, where no ring exists."""
         return self.attention_fn_inference or self.attention_fn
 
     @property
