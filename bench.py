@@ -532,6 +532,15 @@ def main():
 
     headline, extra = bench_ppo(on_tpu)
     extra.update(bench_sft(on_tpu))
+    # Fixed per-call dispatch+sync overhead (one cached no-op jit,
+    # host-materialized): on the tunneled axon platform every engine
+    # call pays this on top of device execution, so the per-phase
+    # walls above are compute + k * this. Lets the reader separate
+    # capability from relay latency (scripts/overhead_probe.py).
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from overhead_probe import measure_dispatch
+    extra["dispatch_overhead_s"] = round(measure_dispatch(10), 5)
     extra["backend"] = jax.default_backend()
     if not on_tpu:
         # the probe timed out or failed (e.g. wedged axon relay):

@@ -1,0 +1,75 @@
+"""Per-call dispatch overhead probe (round-5 diagnosis aid).
+
+The r3 PPO phase table is consistent with a FIXED per-engine-call
+overhead of ~0.1s (inference MFCs at 15-17% "MFU" while the same
+engine hits 50% on one big SFT call): on the tunneled axon platform
+every jit dispatch + host transfer is a network round-trip. This
+probe separates that overhead from compute:
+
+  - noop:      time a cached trivial jit (pure dispatch+sync)
+  - transfer:  device_put + np.asarray round-trip of 1 MB
+  - matmul:    a 2 GFLOP matmul (compute floor for comparison)
+
+If noop >> matmul, PPO step time is dispatch-bound at bench scale and
+the fix is fewer/larger calls (fuse MFC phases, device-resident
+inter-MFC data), not kernel work.
+
+Usage: python scripts/overhead_probe.py [--reps 20]
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def measure_dispatch(reps: int = 20) -> float:
+    """Seconds per cached no-op jit call, host-materialized (one
+    dispatch+sync round-trip). Shared with bench.py."""
+    import jax
+    import jax.numpy as jnp
+
+    noop = jax.jit(lambda x: x + 1)
+    x0 = jnp.zeros((8, 128), jnp.float32)
+    np.asarray(noop(x0))  # compile
+    t0 = time.monotonic()
+    for _ in range(reps):
+        np.asarray(noop(x0))
+    return (time.monotonic() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend())
+
+    noop_s = measure_dispatch(args.reps)
+
+    host = np.zeros((256, 1024), np.float32)  # 1 MB
+    t0 = time.monotonic()
+    for _ in range(args.reps):
+        np.asarray(jax.device_put(host))
+    xfer_s = (time.monotonic() - t0) / args.reps
+
+    mm = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((1024, 1024), jnp.bfloat16)
+    np.asarray(mm(a, a))  # compile
+    t0 = time.monotonic()
+    for _ in range(args.reps):
+        np.asarray(mm(a, a))
+    mm_s = (time.monotonic() - t0) / args.reps
+
+    print(f"noop_dispatch_ms={noop_s * 1e3:.2f} "
+          f"transfer_1mb_ms={xfer_s * 1e3:.2f} "
+          f"matmul_2gflop_ms={mm_s * 1e3:.2f}")
+    if mm_s > 0:
+        print(f"# dispatch/compute ratio: {noop_s / mm_s:.1f}x "
+              "(>> 1 means calls are overhead-bound)")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ run() {  # run <name> <cmd...>
   echo "--- $name rc=$? (tail)"; tail -3 "$OUT/$name.out"
 }
 
+run overhead python scripts/overhead_probe.py
 run decode_profile python scripts/profile_decode.py
 run decode_bk_sweep python scripts/sweep_decode_bk.py
 run remat_tax python scripts/remat_tax.py

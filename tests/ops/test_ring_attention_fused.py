@@ -128,11 +128,12 @@ def test_engine_wiring_flag(monkeypatch):
         ctx = MeshContext(ModelName("t", 0), make_mesh(par), par)
         return Engine(cfg, ctx, jax.tree.map(jnp.copy, params))
 
-    lp_ref = np.asarray(build(False).forward_logprobs(ids, seg))
+    ref_eng = build(False)
+    assert ref_eng.attention_fn_inference is None
+    lp_ref = np.asarray(ref_eng.forward_logprobs(ids, seg))
     fused_eng = build(True)
     # the flag really engaged (guards against the parity assert
     # passing vacuously if the wiring regresses)
     assert fused_eng.attention_fn_inference is not None
-    assert build(False).attention_fn_inference is None
     lp_fused = np.asarray(fused_eng.forward_logprobs(ids, seg))
     np.testing.assert_allclose(lp_fused, lp_ref, rtol=2e-4, atol=2e-4)
