@@ -53,9 +53,21 @@ def prompt_data(tmp_path):
 
 def test_cross_group_actor_gen(prompt_data):
     """actor-train on worker 0, actor-gen on worker 1."""
+    # The wall-clock overlap assertion at the end is sensitive to CPU
+    # contention (a loaded machine can serialize the workers); the
+    # correctness assertions must hold every attempt, only the
+    # overlap observation gets a retry.
+    for attempt in range(2):
+        overlaps = _run_cross_group_trial(prompt_data, attempt)
+        if overlaps:
+            return
+    assert overlaps, "no cross-worker overlap observed in 2 trials"
+
+
+def _run_cross_group_trial(prompt_data, attempt):
     from realhf_tpu.apps.main import main_start
 
-    cfg = PPOConfig(experiment_name="xgppo", trial_name="t0",
+    cfg = PPOConfig(experiment_name="xgppo", trial_name=f"t{attempt}",
                     total_train_epochs=1, benchmark_steps=3)
     apply_overrides(cfg, {
         "dataset.path": prompt_data,
@@ -135,9 +147,10 @@ def test_cross_group_actor_gen(prompt_data):
         for g in gen_rows for r in other_rows
         if g["bid"] > r["bid"]
         and g["start"] < r["end"] and g["end"] > r["start"]]
-    assert overlaps, (
-        "no cross-worker overlap observed:\n"
-        + "\n".join(f"{r['worker']} {r['mfc']} bid={r['bid']} "
-                    f"[{r['start']:.3f}..{r['end']:.3f}]"
-                    for r in sorted(exec_log,
-                                    key=lambda r: r["start"])))
+    if not overlaps:
+        print("no cross-worker overlap observed (attempt", attempt,
+              "):\n" + "\n".join(
+                  f"{r['worker']} {r['mfc']} bid={r['bid']} "
+                  f"[{r['start']:.3f}..{r['end']:.3f}]"
+                  for r in sorted(exec_log, key=lambda r: r["start"])))
+    return overlaps
