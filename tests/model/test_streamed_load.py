@@ -287,3 +287,54 @@ def test_streamed_bf16_cast(tmp_path):
     import jax.numpy as jnp
     for leaf in jax.tree.leaves(streamed):
         assert leaf.dtype == jnp.bfloat16
+
+
+def test_agreed_streamed_load_follows_leader(tmp_path, monkeypatch):
+    """On a process-spanning mesh the auto verdict is GROUP-AGREED:
+    the lowest-rank process publishes via name_resolve and members
+    adopt it even when their own filesystem view would disagree
+    (stale-NFS divergence would otherwise hang mismatched collective
+    load schedules)."""
+    import collections
+
+    import jax as _jax
+
+    from realhf_tpu.api.experiment import ModelSpec
+    from realhf_tpu.base import constants
+    from realhf_tpu.system import model_host
+
+    cfg = _cfg("llama")
+    params = T.init_params(cfg, jax.random.PRNGKey(9))
+    path = str(tmp_path / "m")
+    save_hf_checkpoint(path, "llama", cfg,
+                       jax.tree.map(np.asarray, params))
+    spec = ModelSpec(path=path, hf_family="llama")
+
+    monkeypatch.setattr(constants, "_experiment_name", "agreetest")
+    monkeypatch.setattr(constants, "_trial_name", "t0")
+
+    Dev = collections.namedtuple("Dev", "process_index")
+
+    class FakeMesh:
+        class devices:
+            flat = [Dev(0), Dev(1)]
+
+    # leader (process 0): sizes the checkpoint -> streams (cutoff 1)
+    monkeypatch.setattr(model_host, "STREAMED_LOAD_AUTO_BYTES", 1)
+    monkeypatch.setattr(_jax, "process_index", lambda: 0)
+    assert model_host._agreed_streamed_load(spec, FakeMesh, "roleA")
+
+    # member (process 1) with a DIVERGENT local view (cutoff back to
+    # huge -> its own verdict would be eager): adopts the leader's
+    monkeypatch.setattr(model_host, "STREAMED_LOAD_AUTO_BYTES", 1e18)
+    monkeypatch.setattr(_jax, "process_index", lambda: 1)
+    assert model_host._agreed_streamed_load(spec, FakeMesh, "roleA")
+
+    # explicit flag short-circuits the rendezvous entirely (patch
+    # back to the leader so a regression fails fast instead of
+    # stalling in the member's 300s name_resolve wait)
+    monkeypatch.setattr(_jax, "process_index", lambda: 0)
+    spec_off = ModelSpec(path=path, hf_family="llama",
+                         streamed_load=False)
+    assert not model_host._agreed_streamed_load(spec_off, FakeMesh,
+                                                "roleB")
