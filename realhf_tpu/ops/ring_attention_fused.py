@@ -14,7 +14,14 @@ compute is the same tiled online-softmax (flash-2 schedule, GQA,
 packed-segment + causal + sliding-window masks on GLOBAL positions)
 as ``ops/flash_attention.py``.
 
-Ring choreography per device (n = ring size, slot = r % 2):
+By default the ring is BIDIRECTIONAL: each device's KV shard splits
+into two halves that counter-rotate (dir 0 rightward, dir 1
+leftward), so both ICI ring directions carry traffic and per-round
+transfer time halves -- the full-bisection-bandwidth pattern. Falls
+back to one direction when a half-shard would not tile.
+
+Ring choreography per device and direction (n = ring size,
+slot = r % 2):
 
   round r first cell:  r==0: neighbor barrier (all members entered)
                        r>0:  wait recv[slot]  (this round's KV landed)
@@ -76,11 +83,12 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
                  m_ref, l_ref, acc_ref,               # ANY state slabs
                  k_vmem, v_vmem, sk_vmem,             # VMEM KV scratch
                  m_vmem, l_vmem, acc_vmem, o_vmem,    # VMEM state
-                 dma_sems,                             # local-copy sems
-                 send_sems, recv_sems,                 # RDMA sems [3, 2]
-                 free_sem,                             # slot handshake
+                 kv_sems,                              # local KV copies
+                 misc_sems,                            # state/out copies
+                 send_sems, recv_sems,                 # RDMA [3, 2, nd]
+                 free_sems,                            # handshake [nd]
                  *, n: int, axis: str, bq: int, bk: int, group: int,
-                 scale: float, causal: bool,
+                 n_dirs: int, scale: float, causal: bool,
                  sliding_window: Optional[int]):
     r = pl.program_id(0)
     bi = pl.program_id(1)
@@ -92,19 +100,25 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
     left = jax.lax.rem(my + n - 1, n)
     slot = jax.lax.rem(r, 2)
     nxt = 1 - slot
-    lc = k_vmem.shape[0]
+    lch = k_vmem.shape[1]                  # per-direction shard length
+    lc = lch * n_dirs
+
+    # direction d sends to send_to[d]; the device that sends TO us in
+    # direction d is from_of[d] (dir 0 rotates right, dir 1 left)
+    send_to = [right, left]
+    from_of = [left, right]
 
     first_cell = jnp.logical_and(
         jnp.logical_and(bi == 0, hk == 0), qi == 0)
 
-    def slab_rdma(slot_src, slot_dst, sem_i):
-        """RDMA descriptors for the three ring slabs (k, v, segk)."""
+    def slab_rdma(d, slot_src, slot_dst, sem_i):
+        """RDMA descriptors for direction d's three ring slabs."""
         return [
             pltpu.make_async_remote_copy(
-                src_ref=src.at[slot_src], dst_ref=src.at[slot_dst],
-                send_sem=send_sems.at[i, sem_i],
-                recv_sem=recv_sems.at[i, sem_i],
-                device_id={axis: right},
+                src_ref=src.at[d, slot_src], dst_ref=src.at[d, slot_dst],
+                send_sem=send_sems.at[i, sem_i, d],
+                recv_sem=recv_sems.at[i, sem_i, d],
+                device_id={axis: send_to[d]},
                 device_id_type=pltpu.DeviceIdType.MESH)
             for i, src in enumerate((kbuf_ref, vbuf_ref, segk_ref))
         ]
@@ -120,8 +134,10 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
         pltpu.semaphore_signal(bar, inc=1, device_id={axis: right},
                                device_id_type=pltpu.DeviceIdType.MESH)
         pltpu.semaphore_wait(bar, 2)
-        # local KV -> ring slot 0 (the slab round 0 sends from)
-        cps = [pltpu.make_async_copy(src, dst.at[0], dma_sems.at[i])
+        # local KV halves -> ring slot 0 (what round 0 sends from)
+        cps = [pltpu.make_async_copy(src.at[d], dst.at[d, 0],
+                                     kv_sems.at[i, d])
+               for d in range(n_dirs)
                for i, (src, dst) in enumerate(
                    ((kin_ref, kbuf_ref), (vin_ref, vbuf_ref),
                     (segin_ref, segk_ref)))]
@@ -132,40 +148,46 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
 
     @pl.when(jnp.logical_and(first_cell, r > 0))
     def _round_start():
-        # this round's KV has landed in [slot]; our forwarding send
-        # of [nxt] (issued in round r-1 from slot (r-1)%2 == nxt) has
-        # drained, so the LEFT neighbor may now overwrite [nxt]
-        for d in slab_rdma(nxt, slot, slot):
-            d.wait()
+        # this round's KV landed in [slot]; our forwarding sends of
+        # [nxt] (issued in round r-1 from slot (r-1)%2 == nxt) have
+        # drained, so each direction's sender may overwrite [nxt]
+        for d in range(n_dirs):
+            for desc in slab_rdma(d, nxt, slot, slot):
+                desc.wait()
 
         @pl.when(r < n - 1)
-        def _free_slot():
-            # matched by the LEFT neighbor's _wait_free at its round
-            # r (sends happen at rounds 0..n-2); an unguarded signal
-            # at round n-1 would leave the semaphore non-zero at
-            # kernel exit
-            pltpu.semaphore_signal(
-                free_sem, inc=1, device_id={axis: left},
-                device_id_type=pltpu.DeviceIdType.MESH)
+        def _free_slots():
+            # matched by each sender's _wait_free at its round r
+            # (sends happen at rounds 0..n-2); an unguarded signal at
+            # round n-1 would leave the semaphores non-zero at exit
+            for d in range(n_dirs):
+                pltpu.semaphore_signal(
+                    free_sems.at[d], inc=1,
+                    device_id={axis: from_of[d]},
+                    device_id_type=pltpu.DeviceIdType.MESH)
 
     @pl.when(jnp.logical_and(first_cell, r < n - 1))
     def _round_send():
-        # overlap: the send for round r+1 flies while round r computes
-        @pl.when(r > 0)
-        def _wait_free():
-            pltpu.semaphore_wait(free_sem, 1)
+        # overlap: the sends for round r+1 fly while round r computes
+        for d in range(n_dirs):
+            @pl.when(r > 0)
+            def _wait_free(d=d):
+                pltpu.semaphore_wait(free_sems.at[d], 1)
 
-        for d in slab_rdma(slot, nxt, nxt):
-            d.start()
+            for desc in slab_rdma(d, slot, nxt, nxt):
+                desc.start()
 
-    # ---- this cell's KV slice: HBM slab -> VMEM ----------------------
-    cp_k = pltpu.make_async_copy(kbuf_ref.at[slot, bi, hk], k_vmem,
-                                 dma_sems.at[0])
-    cp_v = pltpu.make_async_copy(vbuf_ref.at[slot, bi, hk], v_vmem,
-                                 dma_sems.at[1])
-    cp_s = pltpu.make_async_copy(segk_ref.at[slot, bi], sk_vmem,
-                                 dma_sems.at[2])
-    cp_k.start(); cp_v.start(); cp_s.start()
+    # ---- this cell's KV slices: HBM slabs -> VMEM --------------------
+    kv_cps = [c for d in range(n_dirs) for c in (
+        pltpu.make_async_copy(kbuf_ref.at[d, slot, bi, hk],
+                              k_vmem.at[d], kv_sems.at[0, d]),
+        pltpu.make_async_copy(vbuf_ref.at[d, slot, bi, hk],
+                              v_vmem.at[d], kv_sems.at[1, d]),
+        pltpu.make_async_copy(segk_ref.at[d, slot, bi],
+                              sk_vmem.at[d], kv_sems.at[2, d]),
+    )]
+    for c in kv_cps:
+        c.start()
 
     # ---- cross-round accumulator state: HBM slab -> VMEM -------------
     @pl.when(r > 0)
@@ -173,13 +195,13 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
         cps = [
             pltpu.make_async_copy(
                 m_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], m_vmem,
-                dma_sems.at[3]),
+                misc_sems.at[0]),
             pltpu.make_async_copy(
                 l_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], l_vmem,
-                dma_sems.at[4]),
+                misc_sems.at[1]),
             pltpu.make_async_copy(
                 acc_ref.at[bi, hk, :, pl.ds(qi * bq, bq)], acc_vmem,
-                dma_sems.at[5]),
+                misc_sems.at[2]),
         ]
         for c in cps:
             c.start()
@@ -192,50 +214,57 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
         l_vmem[...] = jnp.zeros(l_vmem.shape, jnp.float32)
         acc_vmem[...] = jnp.zeros(acc_vmem.shape, jnp.float32)
 
-    cp_k.wait(); cp_v.wait(); cp_s.wait()
+    for c in kv_cps:
+        c.wait()
 
-    # ---- flash-accumulate this q tile vs the round's KV shard -------
-    src_dev = jax.lax.rem(my - r + n, n)   # whose shard we hold
+    # ---- flash-accumulate this q tile vs each direction's shard ------
     q_off = my * (n_qb * bq) + qi * bq
-    k_off = src_dev * lc
     seg_q = segq_ref[0, :, 0]              # [bq]
-    n_kb = lc // bk
+    n_kb = lch // bk
 
     for g in range(group):
         q = q_ref[0, 0, g].astype(jnp.float32) * scale     # [bq, hd]
         hd = q.shape[-1]
-        m0 = m_vmem[g]
-        l0 = l_vmem[g]
-        a0 = acc_vmem[g]
+        carry = (m_vmem[g], l_vmem[g], acc_vmem[g])
 
-        def body(j, carry, q=q):
-            m, l_sum, acc = carry
-            k = k_vmem[pl.ds(j * bk, bk), :].astype(jnp.float32)
-            v = v_vmem[pl.ds(j * bk, bk), :]
-            seg_k = sk_vmem[0, pl.ds(j * bk, bk)]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [bq, bk]
-            qg = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kg = (k_off + j * bk
-                  + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
-            mask = (seg_q[:, None] == seg_k[None, :]) \
-                & (seg_q[:, None] != 0)
-            if causal:
-                mask &= qg >= kg
-            if sliding_window is not None:
-                mask &= (qg - kg) < sliding_window
-            s = jnp.where(mask, s, NEG_INF)
-            m_new = jnp.maximum(m, s.max(axis=1))
-            p = jnp.exp(s - m_new[:, None])
-            alpha = jnp.exp(m - m_new)
-            l_new = l_sum * alpha + p.sum(axis=1)
-            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return m_new, l_new, acc_new
+        for d in range(n_dirs):
+            # dir 0 holds the [0:lch] half of shard (my - r) % n;
+            # dir 1 the [lch:lc] half of shard (my + r) % n
+            src_dev = jax.lax.rem(my - r + n, n) if d == 0 \
+                else jax.lax.rem(my + r, n)
+            k_off = src_dev * lc + d * lch
 
-        m, l_sum, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, a0))
+            def body(j, carry, q=q, d=d, k_off=k_off):
+                m, l_sum, acc = carry
+                k = k_vmem[d, pl.ds(j * bk, bk), :].astype(jnp.float32)
+                v = v_vmem[d, pl.ds(j * bk, bk), :]
+                seg_k = sk_vmem[d, 0, pl.ds(j * bk, bk)]
+                s = jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bq, bk]
+                qg = q_off + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                kg = (k_off + j * bk
+                      + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+                mask = (seg_q[:, None] == seg_k[None, :]) \
+                    & (seg_q[:, None] != 0)
+                if causal:
+                    mask &= qg >= kg
+                if sliding_window is not None:
+                    mask &= (qg - kg) < sliding_window
+                s = jnp.where(mask, s, NEG_INF)
+                m_new = jnp.maximum(m, s.max(axis=1))
+                p = jnp.exp(s - m_new[:, None])
+                alpha = jnp.exp(m - m_new)
+                l_new = l_sum * alpha + p.sum(axis=1)
+                acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, acc_new
+
+            carry = jax.lax.fori_loop(0, n_kb, body, carry)
+
+        m, l_sum, acc = carry
         m_vmem[g] = m
         l_vmem[g] = l_sum
         acc_vmem[g] = acc
@@ -254,13 +283,13 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
         cps = [
             pltpu.make_async_copy(
                 m_vmem, m_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
-                dma_sems.at[3]),
+                misc_sems.at[0]),
             pltpu.make_async_copy(
                 l_vmem, l_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
-                dma_sems.at[4]),
+                misc_sems.at[1]),
             pltpu.make_async_copy(
                 acc_vmem, acc_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
-                dma_sems.at[5]),
+                misc_sems.at[2]),
         ]
         for c in cps:
             c.start()
@@ -271,13 +300,25 @@ def _ring_kernel(q_ref, segq_ref,                     # blocked inputs
     def _store_out():
         cp = pltpu.make_async_copy(
             o_vmem, o_ref.at[bi, hk, :, pl.ds(qi * bq, bq)],
-            dma_sems.at[6])
+            misc_sems.at[3])
         cp.start()
         cp.wait()
 
 
+def _plan_dirs(lc: int, block_k: int, want_bidir: bool):
+    """(n_dirs, lch, bk): split the local shard across both ICI ring
+    directions when each half still tiles; else one direction."""
+    if want_bidir and lc % 2 == 0 and lc // 2 >= 8:
+        try:
+            return 2, lc // 2, _fit_block(lc // 2, block_k)
+        except ValueError:
+            pass  # the half has no tileable block; the full shard may
+    return 1, lc, _fit_block(lc, block_k)
+
+
 def _fused_local(q, k, v, seg, *, mesh, axis, n, scale, causal,
-                 sliding_window, bq, bk, interpret, collective_id):
+                 sliding_window, bq, bk, n_dirs, lch, interpret,
+                 collective_id):
     """Per-device body under shard_map. Local shapes:
     q [b, lc, nq, hd], k/v [b, lc, nkv, hd], seg [b, lc]."""
     b, lc, nq, hd = q.shape
@@ -287,14 +328,20 @@ def _fused_local(q, k, v, seg, *, mesh, axis, n, scale, causal,
 
     qt = q.transpose(0, 2, 1, 3).reshape(b, nkv, group, lc, hd)
     segq = jnp.broadcast_to(seg[:, :, None], (b, lc, LANES))
-    kt = k.transpose(0, 2, 1, 3)                  # [b, nkv, lc, hd]
-    vt = v.transpose(0, 2, 1, 3)
-    segk = jnp.broadcast_to(seg[:, None, :], (b, SUBLANES, lc))
+    # dir-major KV halves: [nd, b, nkv, lch, hd] (contiguous split of
+    # the sequence dim; nd == 1 keeps the whole shard in "half" 0)
+    kt = k.transpose(0, 2, 1, 3).reshape(
+        b, nkv, n_dirs, lch, hd).transpose(2, 0, 1, 3, 4)
+    vt = v.transpose(0, 2, 1, 3).reshape(
+        b, nkv, n_dirs, lch, hd).transpose(2, 0, 1, 3, 4)
+    segk = jnp.broadcast_to(seg[:, None, :], (b, SUBLANES, lc)).reshape(
+        b, SUBLANES, n_dirs, lch).transpose(2, 0, 1, 3)
 
     grid = (n, b, nkv, n_qb)
     kernel = functools.partial(
         _ring_kernel, n=n, axis=axis, bq=bq, bk=bk, group=group,
-        scale=scale, causal=causal, sliding_window=sliding_window)
+        n_dirs=n_dirs, scale=scale, causal=causal,
+        sliding_window=sliding_window)
 
     any_spec = pl.BlockSpec(memory_space=pl.ANY)
 
@@ -311,26 +358,28 @@ def _fused_local(q, k, v, seg, *, mesh, axis, n, scale, causal,
         out_shape=(
             # o + ring slabs + cross-round state, all manually DMA'd
             jax.ShapeDtypeStruct((b, nkv, group, lc, hd), q.dtype),
-            jax.ShapeDtypeStruct((2,) + kt.shape, kt.dtype),
-            jax.ShapeDtypeStruct((2,) + vt.shape, vt.dtype),
-            jax.ShapeDtypeStruct((2,) + segk.shape, segk.dtype),
+            jax.ShapeDtypeStruct((n_dirs, 2) + kt.shape[1:], kt.dtype),
+            jax.ShapeDtypeStruct((n_dirs, 2) + vt.shape[1:], vt.dtype),
+            jax.ShapeDtypeStruct((n_dirs, 2) + segk.shape[1:],
+                                 segk.dtype),
             jax.ShapeDtypeStruct((b, nkv, group, lc), jnp.float32),
             jax.ShapeDtypeStruct((b, nkv, group, lc), jnp.float32),
             jax.ShapeDtypeStruct((b, nkv, group, lc, hd), jnp.float32),
         ),
         out_specs=(any_spec,) * 7,
         scratch_shapes=[
-            pltpu.VMEM((lc, hd), k.dtype),              # k slice
-            pltpu.VMEM((lc, hd), v.dtype),              # v slice
-            pltpu.VMEM((SUBLANES, lc), seg.dtype),      # segk slice
+            pltpu.VMEM((n_dirs, lch, hd), k.dtype),     # k slices
+            pltpu.VMEM((n_dirs, lch, hd), v.dtype),     # v slices
+            pltpu.VMEM((n_dirs, SUBLANES, lch), seg.dtype),
             pltpu.VMEM((group, bq), jnp.float32),       # m
             pltpu.VMEM((group, bq), jnp.float32),       # l
             pltpu.VMEM((group, bq, hd), jnp.float32),   # acc
             pltpu.VMEM((group, bq, hd), q.dtype),       # out tile
-            pltpu.SemaphoreType.DMA((7,)),              # local copies
-            pltpu.SemaphoreType.DMA((3, 2)),            # RDMA send
-            pltpu.SemaphoreType.DMA((3, 2)),            # RDMA recv
-            pltpu.SemaphoreType.REGULAR,                # slot free
+            pltpu.SemaphoreType.DMA((3, n_dirs)),       # local KV
+            pltpu.SemaphoreType.DMA((4,)),              # state / out
+            pltpu.SemaphoreType.DMA((3, 2, n_dirs)),    # RDMA send
+            pltpu.SemaphoreType.DMA((3, 2, n_dirs)),    # RDMA recv
+            pltpu.SemaphoreType.REGULAR((n_dirs,)),     # slot free
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
@@ -354,6 +403,7 @@ def ring_attention_fused(
     sliding_window: Optional[int] = None,
     block_q: int = 256,
     block_k: int = 512,
+    bidirectional: bool = True,
     interpret: bool = False,
     collective_id: int = 7,
 ) -> jnp.ndarray:
@@ -363,6 +413,11 @@ def ring_attention_fused(
     work gradient checkpointing already schedules), so gradients are
     bit-identical to the unfused path while the forward gains the
     overlapped ring.
+
+    ``bidirectional`` (default): each device's KV shard splits in two
+    halves that counter-rotate (dir 0 rightward, dir 1 leftward), so
+    both ICI ring directions carry traffic and per-round transfer time
+    halves; falls back to one direction when a half would not tile.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     n = mesh.shape[axis]
@@ -372,7 +427,7 @@ def ring_attention_fused(
                               sliding_window=sliding_window)
     lc = q.shape[1] // n
     bq = _fit_block(lc, block_q)
-    bk = _fit_block(lc, block_k)
+    n_dirs, lch, bk = _plan_dirs(lc, block_k, bidirectional)
 
     data_ax = "data" if "data" in mesh.axis_names \
         and mesh.shape["data"] > 1 else None
@@ -387,7 +442,8 @@ def ring_attention_fused(
     local = functools.partial(
         _fused_local, mesh=mesh, axis=axis, n=n, scale=scale,
         causal=causal, sliding_window=sliding_window, bq=bq, bk=bk,
-        interpret=interpret, collective_id=collective_id)
+        n_dirs=n_dirs, lch=lch, interpret=interpret,
+        collective_id=collective_id)
     fused_fwd = shard_map(local, mesh=mesh,
                           in_specs=(spec4, spec4, spec4, spec2),
                           out_specs=spec4, check_vma=False)

@@ -137,3 +137,34 @@ def test_engine_wiring_flag(monkeypatch):
     assert fused_eng.attention_fn_inference is not None
     lp_fused = np.asarray(fused_eng.forward_logprobs(ids, seg))
     np.testing.assert_allclose(lp_fused, lp_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bidirectional_plan_and_parity():
+    """_plan_dirs splits when halves tile (and not otherwise), and the
+    uni- vs bidirectional kernels agree exactly on the same inputs."""
+    from realhf_tpu.ops.ring_attention_fused import _plan_dirs
+
+    assert _plan_dirs(16, 512, True)[0] == 2   # halves of 8 tile
+    assert _plan_dirs(8, 512, True)[0] == 1    # half of 4 would not
+    assert _plan_dirs(16, 512, False)[0] == 1  # opt-out honored
+    nd, lch, bk = _plan_dirs(64, 16, True)
+    assert (nd, lch, bk) == (2, 32, 16)
+
+    mesh = ctx_mesh(4)
+    q, k, v, seg = make_inputs(seed=11)
+    uni = jax.jit(lambda *a: ring_attention_fused(
+        *a, mesh=mesh, bidirectional=False, interpret=True))(
+            q, k, v, seg)
+    bidi = jax.jit(lambda *a: ring_attention_fused(
+        *a, mesh=mesh, bidirectional=True, interpret=True))(
+            q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(bidi), np.asarray(uni),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_plan_dirs_falls_back_on_untileable_half():
+    """lc=24, block_k=8: the half (12) has no >=8 divisor <= 8 but the
+    full shard tiles (24 % 8 == 0) -- must fall back, not raise."""
+    from realhf_tpu.ops.ring_attention_fused import _plan_dirs
+
+    assert _plan_dirs(24, 8, True) == (1, 24, 8)
