@@ -6,12 +6,14 @@
 #
 #   bash scripts/tpu_window.sh [outdir]
 #
-# Runs, in order (cheapest first so a re-wedge loses the least):
-#   1. decode profile (kernel engagement + roofline fraction)
-#   2. decode K-block sweep (tune DEFAULT_BK on real silicon)
-#   3. remat recompute-tax measurement
-#   4. cost-model calibration + searched-vs-heuristic comparison
-#   5. the full bench.py (headline PPO + SFT + serving numbers)
+# Runs in value order -- a short window must capture the headline
+# before anything else:
+#   1. dispatch-overhead probe (30s diagnostic)
+#   2. the full bench.py (headline PPO + SFT + serving numbers)
+#   3. decode profile (kernel engagement + roofline fraction)
+#   4. decode K-block sweep (tune DEFAULT_BK on real silicon)
+#   5. remat recompute-tax measurement
+#   6. cost-model calibration + searched-vs-heuristic comparison
 #
 # Each step's stdout/stderr lands in $OUT. The chip is ONE v5e behind
 # the tunnel; everything runs sequentially.
@@ -41,11 +43,11 @@ run() {  # run <name> <cmd...>
 }
 
 run overhead python scripts/overhead_probe.py
+run bench python bench.py
 run decode_profile python scripts/profile_decode.py
 run decode_bk_sweep python scripts/sweep_decode_bk.py
 run remat_tax python scripts/remat_tax.py
 run calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
-run bench python bench.py
 
 echo "done; results in $OUT"
 grep -h '"metric"' "$OUT/bench.out" | tail -1
