@@ -10,7 +10,7 @@ and run control (epochs, save/eval frequency, seed).
 import dataclasses
 from typing import Dict, List, Optional
 
-from realhf_tpu.api.config import DatasetAbstraction, ModelName
+from realhf_tpu.api.config import DatasetAbstraction
 from realhf_tpu.api.dfg import MFCDef
 from realhf_tpu.engine.optim import OptimizerConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig

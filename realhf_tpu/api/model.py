@@ -12,7 +12,6 @@ import dataclasses
 from typing import Any, Callable, Dict, Optional
 
 from realhf_tpu.api.config import (
-    ModelAbstraction,
     ModelInterfaceAbstraction,
     ModelName,
 )

@@ -13,7 +13,7 @@ import contextlib
 import dataclasses
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 def attn_flops(q_len: int, kv_len: int, n_q_heads: int, head_dim: int,

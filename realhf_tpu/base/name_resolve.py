@@ -8,7 +8,6 @@ is the default for multi-host TPU pods (any shared FS works); the
 memory backend serves single-process tests and the inline runner.
 """
 
-import dataclasses
 import os
 import shutil
 import threading

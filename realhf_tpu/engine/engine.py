@@ -20,7 +20,6 @@ All methods consume/produce device arrays in [S, L] stream layout;
 the algorithm interfaces do SequenceSample <-> stream packing.
 """
 
-import functools
 import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 

@@ -11,7 +11,7 @@ the logits-mask output PPO replays later (genstep:131-136).
 
 import dataclasses
 import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ waste bound: at most one bucket per stream).
 """
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -35,7 +35,6 @@ from realhf_tpu.base import logging as _logging
 logger = _logging.getLogger("heuristic")
 
 from realhf_tpu.api.config import ModelInterfaceType
-from realhf_tpu.api.dfg import MFCDef
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 

@@ -15,7 +15,7 @@ for allocation decisions.
 """
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict
 
 from realhf_tpu.api.config import DatasetAbstraction
 from realhf_tpu.api.experiment import ExperimentSpec, ModelSpec

@@ -8,7 +8,7 @@ import dataclasses
 import fcntl
 import json
 import os
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 import numpy as np

@@ -7,7 +7,6 @@ for value/return normalization). Losses are jittable over [S, L]
 stream arrays; reward/GAE prep runs host-side on flat packed arrays.
 """
 
-import dataclasses
 from typing import Dict, Optional, Tuple
 
 import jax

@@ -17,7 +17,7 @@ Mapping (reference module -> spec here):
   exactly Megatron-SP's communication pattern.
 """
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -23,8 +23,6 @@ Layer indexing convention matches the reference (real_llm_base.py:394):
 conversion and (later) pipeline splitting.
 """
 
-import dataclasses
-import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax

@@ -8,7 +8,7 @@ stream layout. The vocab-parallel cross entropy of the reference
 under GSPMD shard the vocab dim and XLA inserts the reductions.
 """
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

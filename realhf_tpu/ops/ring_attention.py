@@ -19,7 +19,6 @@ single Pallas kernel with overlapped RDMA
 (pltpu.make_async_remote_copy) remains the next optimization.
 """
 
-import functools
 from typing import Optional
 
 import jax

@@ -8,7 +8,7 @@ output that PPO replays during inference for numerical consistency
 """
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

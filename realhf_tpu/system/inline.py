@@ -17,13 +17,12 @@ throughput logging (tokens + TFLOP/s), and benchmark early exit.
 
 import os
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 
 from realhf_tpu.api import data as data_api
-from realhf_tpu.api import model as model_api
-from realhf_tpu.api.config import ModelInterfaceType, ModelName
+from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import DFG
 from realhf_tpu.api.experiment import ExperimentSpec
 from realhf_tpu.base import constants, logging, recover, seeding, timeutil

@@ -17,7 +17,7 @@ completed batch k-1-max_head_offpolicyness and earlier.
 
 import pickle
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import DFG

@@ -17,8 +17,7 @@ clear_data_cache / offload.
 import os
 import pickle
 import queue
-import threading
-from typing import Dict, Optional
+from typing import Dict
 
 from realhf_tpu.api import data as data_api
 from realhf_tpu.api.config import ModelInterfaceType

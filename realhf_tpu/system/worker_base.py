@@ -10,7 +10,6 @@ statuses for failure detection (reference controller ``wait:275``).
 import dataclasses
 import enum
 import pickle
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
