@@ -8,7 +8,6 @@ the next batch overlaps worker 0's same-step compute on the wall
 clock -- the decoupled-allocation concurrency that is the reference's
 core throughput claim."""
 
-import json
 
 import numpy as np
 import pytest
@@ -20,11 +19,7 @@ from realhf_tpu.experiments.common import apply_overrides
 from realhf_tpu.experiments.ppo_exp import PPOConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
+from tiny_model import TINY, write_jsonl
 
 WORKER_ENV = {
     "REALHF_TPU_BACKEND": "cpu",
@@ -34,17 +29,13 @@ WORKER_ENV = {
 }
 
 
-def _write_jsonl(path, records):
-    with open(path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
 
 
 @pytest.fixture
 def prompt_data(tmp_path):
     rng = np.random.default_rng(1)
     path = tmp_path / "prompts.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
         for i in range(24)])

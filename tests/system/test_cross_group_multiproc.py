@@ -23,7 +23,6 @@ Three cases the round-4 suite did not cover:
    the collective per-leaf device_put install.
 """
 
-import json
 
 import numpy as np
 import pytest
@@ -37,11 +36,7 @@ from realhf_tpu.experiments.common import apply_overrides
 from realhf_tpu.experiments.ppo_exp import PPOConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
+from tiny_model import TINY, write_jsonl
 
 # 2 virtual CPU devices per worker process; a 3-process world has 6.
 WORKER_ENV = {
@@ -53,17 +48,13 @@ WORKER_ENV = {
 }
 
 
-def _write_jsonl(path, records):
-    with open(path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
 
 
 @pytest.fixture
 def prompt_data(tmp_path):
     rng = np.random.default_rng(1)
     path = tmp_path / "prompts.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
         for i in range(24)])

@@ -4,7 +4,6 @@ the host data plane moving tensors between workers (the VERDICT round-1
 acceptance test: the 6-MFC PPO graph across >=2 worker processes with
 actor and reward on different meshes)."""
 
-import json
 import os
 
 import numpy as np
@@ -17,11 +16,7 @@ from realhf_tpu.experiments.ppo_exp import PPOConfig
 from realhf_tpu.experiments.sft_exp import SFTConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
+from tiny_model import TINY, write_jsonl
 
 WORKER_ENV = {
     # spawned workers must run on the virtual CPU mesh and never touch
@@ -34,10 +29,6 @@ WORKER_ENV = {
 }
 
 
-def _write_jsonl(path, records):
-    with open(path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
 
 
 def _patch_random_models(spec, dp=2, tp=4):
@@ -58,7 +49,7 @@ def _patch_random_models(spec, dp=2, tp=4):
 def sft_data(tmp_path):
     rng = np.random.default_rng(0)
     path = tmp_path / "sft.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
          "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
@@ -70,7 +61,7 @@ def sft_data(tmp_path):
 def prompt_data(tmp_path):
     rng = np.random.default_rng(1)
     path = tmp_path / "prompts.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
         for i in range(16)])

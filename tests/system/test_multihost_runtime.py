@@ -7,7 +7,6 @@ role on a d2t4 mesh -- data parallelism across the two processes
 (ICI) -- driven end-to-end by the master over ZMQ: collective train
 steps, a collective checkpoint gather, leader-reply protocol."""
 
-import json
 import os
 
 import numpy as np
@@ -19,11 +18,7 @@ from realhf_tpu.experiments.common import apply_overrides
 from realhf_tpu.experiments.sft_exp import SFTConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
+from tiny_model import TINY, write_jsonl
 
 # each worker process gets 4 virtual CPU devices; the 2-process world
 # has 8 global devices for the d2t4 mesh
@@ -36,17 +31,13 @@ WORKER_ENV = {
 }
 
 
-def _write_jsonl(path, records):
-    with open(path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
 
 
 @pytest.fixture
 def sft_data(tmp_path):
     rng = np.random.default_rng(0)
     path = tmp_path / "sft.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
          "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
@@ -108,7 +99,7 @@ def test_ppo_actor_group_with_single_worker_roles(tmp_path):
 
     rng = np.random.default_rng(1)
     data = tmp_path / "prompts.jsonl"
-    _write_jsonl(data, [
+    write_jsonl(data, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
         for i in range(16)])

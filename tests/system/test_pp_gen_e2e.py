@@ -16,11 +16,7 @@ from realhf_tpu.experiments.common import apply_overrides
 from realhf_tpu.experiments.ppo_exp import PPOConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
+from tiny_model import TINY
 
 
 def test_ppo_pp_actor_decode_view(tmp_path):

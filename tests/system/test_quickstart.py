@@ -3,7 +3,6 @@ SFT and the 6-MFC PPO graph, on the virtual 8-device mesh. Mirrors the
 role of the reference's profile/mock system tests
 (``experiments/benchmark/profile_exp.py``)."""
 
-import json
 
 import numpy as np
 import pytest
@@ -15,14 +14,10 @@ from realhf_tpu.experiments.ppo_exp import PPOConfig
 from realhf_tpu.experiments.sft_exp import SFTConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig
 
-TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
-            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
-            layer_norm_type="rms", mlp_type="llama",
-            use_attention_bias=False, use_attn_proj_bias=False,
-            use_mlp_bias=False, activation_function="silu")
-
 
 from realhf_tpu.base.testing import IntegerTokenizer
+
+from tiny_model import TINY, write_jsonl
 
 
 def FakeTokenizer():
@@ -31,17 +26,13 @@ def FakeTokenizer():
     return IntegerTokenizer(vocab_size=1000)
 
 
-def _write_jsonl(path, records):
-    with open(path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
 
 
 @pytest.fixture
 def sft_data(tmp_path):
     rng = np.random.default_rng(0)
     path = tmp_path / "sft.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
          "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
@@ -53,7 +44,7 @@ def sft_data(tmp_path):
 def prompt_data(tmp_path):
     rng = np.random.default_rng(1)
     path = tmp_path / "prompts.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
         for i in range(16)])
@@ -142,7 +133,7 @@ def test_dpo_end_to_end(tmp_path):
 
     rng = np.random.default_rng(2)
     path = tmp_path / "pairs.jsonl"
-    _write_jsonl(path, [
+    write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
          "pos_answers": [" good answer here"],

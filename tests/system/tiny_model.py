@@ -1,0 +1,18 @@
+"""Shared fixtures-in-module for the system suite: the canonical tiny
+llama config and the jsonl dataset writer every e2e test uses (pytest
+puts this directory on sys.path, so tests import it as
+``from tiny_model import TINY, write_jsonl``)."""
+
+TINY = dict(n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+            intermediate_dim=64, vocab_size=1100, apply_rotary=True,
+            layer_norm_type="rms", mlp_type="llama",
+            use_attention_bias=False, use_attn_proj_bias=False,
+            use_mlp_bias=False, activation_function="silu")
+
+
+def write_jsonl(path, records):
+    import json
+
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
