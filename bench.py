@@ -525,6 +525,19 @@ def bench_sft(on_tpu):
     }
 
 
+def _reexec(force_cpu: bool, depth: int) -> "typing.NoReturn":
+    """Re-run this bench in a FRESH process (a jax backend that died
+    mid-run cannot be re-initialized in-process) and exit with its
+    return code. The child re-probes from scratch."""
+    env = dict(os.environ)
+    env["REALHF_BENCH_MIDRUN_DEPTH"] = str(depth + 1)
+    if force_cpu:
+        env["REALHF_BENCH_FORCE_CPU"] = "1"
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env)
+    sys.exit(r.returncode)
+
+
 def main():
     use_accel = _accelerator_usable()
 
@@ -533,6 +546,9 @@ def main():
     if not use_accel:
         from realhf_tpu.base.backend import force_cpu_backend
         force_cpu_backend()
+
+    from realhf_tpu.base.backend import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
 
     import jax
 
@@ -544,8 +560,29 @@ def main():
         force_cpu_backend()
         on_tpu = False
 
-    headline, extra = bench_ppo(on_tpu)
-    extra.update(bench_sft(on_tpu))
+    # Mid-run resilience: the axon relay can drop AFTER a successful
+    # probe (observed: bench died 28 min in with remote_compile
+    # connection-refused, and the driver recorded nothing). On a
+    # mid-run failure, retry once in a fresh process after a recovery
+    # wait -- the persistent compilation cache makes the retry resume
+    # from the compiles the dead run banked -- then fall back to a
+    # CPU-smoke line so the harness ALWAYS gets a JSON record.
+    depth = int(os.environ.get("REALHF_BENCH_MIDRUN_DEPTH", "0"))
+    try:
+        headline, extra = bench_ppo(on_tpu)
+        extra.update(bench_sft(on_tpu))
+    except Exception as e:
+        if not on_tpu:
+            raise
+        print(f"# TPU bench died mid-run ({type(e).__name__}: {e}); "
+              f"depth={depth}", file=sys.stderr)
+        if depth >= 1:
+            _reexec(force_cpu=True, depth=depth)
+        wait_s = float(os.environ.get("REALHF_BENCH_MIDRUN_WAIT_S", "600"))
+        print(f"# retrying in a fresh process after {wait_s:.0f}s",
+              file=sys.stderr)
+        time.sleep(wait_s)
+        _reexec(force_cpu=False, depth=depth)
     # Fixed per-call dispatch+sync overhead (one cached no-op jit,
     # host-materialized): on the tunneled axon platform every engine
     # call pays this on top of device execution, so the per-phase
@@ -553,8 +590,12 @@ def main():
     # capability from relay latency (scripts/overhead_probe.py).
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "scripts"))
-    from overhead_probe import measure_dispatch
-    extra["dispatch_overhead_s"] = round(measure_dispatch(10), 5)
+    try:
+        from overhead_probe import measure_dispatch
+        extra["dispatch_overhead_s"] = round(measure_dispatch(10), 5)
+    except Exception:  # noqa: BLE001 - a relay drop HERE must not void
+        # the measured record the lines above already earned
+        extra["dispatch_overhead_s"] = None
     extra["backend"] = jax.default_backend()
     if not on_tpu:
         # the probe timed out or failed (e.g. wedged axon relay):

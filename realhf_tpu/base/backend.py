@@ -36,6 +36,44 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
         pass
 
 
+def enable_persistent_compilation_cache(path: Optional[str] = None) -> None:
+    """Turn on JAX's on-disk executable cache so compiles survive
+    process crashes.
+
+    On the tunneled axon platform every compile is a remote round-trip
+    (http ``/remote_compile``) and a relay drop mid-run loses all of
+    them; with the cache, each attempt banks the programs it managed
+    to compile and the next attempt resumes from there. No-entry-size
+    floor: the tunnel makes even tiny compiles expensive. Best-effort
+    -- if the backend's executables don't support serialization JAX
+    logs a warning per miss and runs uncached, which is the status quo.
+
+    Default location is ``.jax_cache`` under the current directory
+    (bench/scripts run from the repo root), overridable via
+    ``REALHF_TPU_COMPILE_CACHE``; set it to ``0``/empty to disable.
+    """
+    if path is None:
+        path = os.environ.get("REALHF_TPU_COMPILE_CACHE",
+                              os.path.join(os.getcwd(), ".jax_cache"))
+    if not path or path == "0":
+        return
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 - read-only fs or ancient jax
+        return
+    # Independent knobs, each best-effort: a jax that knows the cache
+    # dir but not a floor knob should still cache what it can.
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", 0),
+                      ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def pallas_enabled() -> bool:
     """Whether the Pallas kernel paths (flash attention, flash decode,
     their shard_map wrappers) should engage: a real TPU backend, or

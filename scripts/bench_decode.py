@@ -11,6 +11,8 @@ import numpy as np
 import jax
 
 sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from realhf_tpu.base.backend import enable_persistent_compilation_cache  # noqa: E402
+enable_persistent_compilation_cache()
 
 V5E_PEAK_FLOPS = 197e12
 V5E_HBM_BW = 819e9

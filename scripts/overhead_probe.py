@@ -45,6 +45,13 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from realhf_tpu.base.backend import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
+
     print("backend:", jax.default_backend())
 
     noop_s = measure_dispatch(args.reps)

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Relay watcher (round-5): waits for the axon tunnel to come back and
+# then runs the full measurement window exactly once.
+#
+#   bash scripts/tpu_watch.sh [outdir]
+#
+# Probe protocol, cheapest-first, designed around the relay's known
+# failure modes:
+#   1. TCP check of the relay's HTTP port (127.0.0.1:8083) with curl
+#      -- zero jax involvement, cannot wedge anything, safe to poll
+#      often (connection-refused means the relay process is down).
+#   2. Only when the port listens, a child-process jax probe with a
+#      hard timeout. A probe KILLED mid-claim is the act that wedges
+#      the relay, so after a timed-out jax probe the loop backs off a
+#      full claim-expiry window before trying again.
+#   3. backend == tpu  =>  hand off to scripts/tpu_window.sh.
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-.round5/tpu_window_$(date +%H%M)}
+PORT=${REALHF_TPU_RELAY_PORT:-8083}
+TCP_SLEEP=${REALHF_TPU_WATCH_TCP_SLEEP_S:-120}
+WEDGE_SLEEP=${REALHF_TPU_WATCH_WEDGE_SLEEP_S:-1800}
+
+echo "watching relay port $PORT; window output -> $OUT"
+while true; do
+  curl -s -m 3 -o /dev/null "http://127.0.0.1:$PORT/"
+  rc=$?
+  # 7 = connection refused, 28 = connect timeout (relay down); any
+  # other outcome proves a listener exists
+  if [ "$rc" = 7 ] || [ "$rc" = 28 ]; then
+    sleep "$TCP_SLEEP"
+    continue
+  fi
+  echo "$(date +%T) relay port answers; jax probe..."
+  if timeout 150 python -c "import jax; jax.devices(); print(jax.default_backend())" 2>/dev/null | tail -1 | grep -q tpu; then
+    echo "$(date +%T) chip live -> window capture"
+    bash scripts/tpu_window.sh "$OUT"
+    exit $?
+  fi
+  echo "$(date +%T) probe failed/timed out with the port up: possible claim wedge; backing off ${WEDGE_SLEEP}s"
+  sleep "$WEDGE_SLEEP"
+done

@@ -11,9 +11,10 @@
 #   1. dispatch-overhead probe (30s diagnostic)
 #   2. the full bench.py (headline PPO + SFT + serving numbers)
 #   3. decode profile (kernel engagement + roofline fraction)
-#   4. decode K-block sweep (tune DEFAULT_BK on real silicon)
-#   5. remat recompute-tax measurement
-#   6. cost-model calibration + searched-vs-heuristic comparison
+#   4. remat recompute-tax measurement
+#   5. cost-model calibration + searched-vs-heuristic comparison
+#   6. decode K-block sweep, LAST and untimed (tune DEFAULT_BK; its
+#      no-per-candidate-timeout design must not block earlier steps)
 #
 # Each step's stdout/stderr lands in $OUT. The chip is ONE v5e behind
 # the tunnel; everything runs sequentially.
@@ -35,19 +36,29 @@ if [ "$BACKEND" != "tpu" ]; then
 fi
 echo "chip is live; capturing."
 
-run() {  # run <name> <cmd...>
-  local name=$1; shift
+run() {  # run <timeout_s> <name> <cmd...>
+  # Per-step timeout: a relay drop mid-step otherwise hangs the whole
+  # window forever (observed r5: profile_decode blocked on a dead
+  # tunnel). A step killed while the relay is dead holds no claim;
+  # the generous budgets below are far beyond any healthy runtime.
+  local tmo=$1 name=$2; shift 2
   echo "=== $name: $*"
-  "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
   echo "--- $name rc=$? (tail)"; tail -3 "$OUT/$name.out"
 }
 
-run overhead python scripts/overhead_probe.py
-run bench python bench.py
-run decode_profile python scripts/profile_decode.py
-run decode_bk_sweep python scripts/sweep_decode_bk.py
-run remat_tax python scripts/remat_tax.py
-run calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
+# bench budget covers its own mid-run retry (fresh-process re-exec
+# after a 600s recovery wait, bench.py _reexec); the BK sweep runs
+# LAST with the timeout disabled -- sweep_decode_bk.py's design is
+# explicitly no-per-candidate-timeout (killing a chip-holding child
+# wedges the relay), and putting it last means a hang can no longer
+# block the rest of the window.
+run 600   overhead python scripts/overhead_probe.py
+run 14400 bench python bench.py
+run 3600  decode_profile python scripts/profile_decode.py
+run 1800  remat_tax python scripts/remat_tax.py
+run 3600  calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
+run 0     decode_bk_sweep python scripts/sweep_decode_bk.py
 
 echo "done; results in $OUT"
 grep -h '"metric"' "$OUT/bench.out" | tail -1
