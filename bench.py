@@ -137,8 +137,14 @@ def bench_ppo(on_tpu):
             apply_rotary=True, layer_norm_type="rms", mlp_type="llama",
             use_attention_bias=False, use_attn_proj_bias=False,
             use_mlp_bias=False, activation_function="silu")
-        n_seqs, prompt_len, new_tokens = 64, 256, 256
-        steps, warmup = 3, 1
+        # Env-overridable for in-window tuning (relay overhead is a
+        # FIXED per-call cost, so bigger batches amortize it; sweep
+        # n_seqs without editing code during a live chip window).
+        n_seqs = int(os.environ.get("REALHF_BENCH_N_SEQS", "64"))
+        prompt_len = int(os.environ.get("REALHF_BENCH_PROMPT_LEN", "256"))
+        new_tokens = int(os.environ.get("REALHF_BENCH_NEW_TOKENS", "256"))
+        steps = max(1, int(os.environ.get("REALHF_BENCH_STEPS", "3")))
+        warmup = 1
         peak_flops, hbm_bw = V5E_PEAK_FLOPS, V5E_HBM_BW
     else:
         model_cfg = dict(

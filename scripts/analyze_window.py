@@ -1,0 +1,77 @@
+"""Decompose a captured TPU window into capability vs relay latency.
+
+Reads a scripts/tpu_window.sh output directory (bench.out JSON line +
+overhead.out) and prints, per PPO phase, the measured wall next to
+overhead-adjusted MFU at k = 1 and 2 assumed host-sync boundaries --
+the per-call relay round-trip on the tunneled axon platform is fixed
+(~0.08-0.2 s, scripts/overhead_probe.py), so
+
+    true-MFU ~= phase_flops / (wall - k * dispatch_overhead) / peak
+
+brackets the chip's actual efficiency between the raw number (k=0)
+and the all-overhead reading (k=2). Vanishes on an untunneled pod.
+
+Usage: python scripts/analyze_window.py [outdir]
+"""
+import json
+import re
+import sys
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_main"
+    line = None
+    with open(f"{out}/bench.out") as f:
+        for ln in f:
+            if '"metric"' in ln:
+                line = ln
+    if line is None:
+        print(f"no bench JSON line in {out}/bench.out")
+        return 1
+    rec = json.loads(line)
+    extra = rec["extra"]
+    oh = extra.get("dispatch_overhead_s")
+    if oh is None:
+        try:
+            with open(f"{out}/overhead.out") as f:
+                m = re.search(r"noop_dispatch_ms=([\d.]+)", f.read())
+            oh = float(m.group(1)) / 1e3 if m else 0.0
+        except OSError:
+            oh = 0.0
+
+    print(f"backend={extra.get('backend')}  "
+          f"headline={rec['value']} {rec['unit']}  "
+          f"vs_baseline={rec['vs_baseline']}")
+    print(f"dispatch_overhead_s={oh}")
+    print()
+    print("| phase | wall s | MFU raw | MFU k=1 | MFU k=2 | "
+          "decode_roofline raw |")
+    print("|---|---|---|---|---|---|")
+    for name, d in extra.get("ppo_phases", {}).items():
+        wall = d["secs"]
+        mfu = d.get("mfu", 0.0)
+        cells = []
+        for k in (1, 2):
+            adj = wall - k * oh
+            cells.append(f"{mfu * wall / adj:.3f}" if adj > 0 else "--")
+        roof = d.get("decode_roofline_frac")
+        print(f"| {name} | {wall} | {mfu:.3f} | {cells[0]} | {cells[1]} "
+              f"| {roof if roof is not None else ''} |")
+    print()
+    for k in ("sft_mfu", "gen_hbm_roofline_frac", "ppo_step_time_s",
+              "ppo_baseline_model_step_s", "reshard_gbytes_per_s",
+              "cross_group_sync_gbytes_per_s"):
+        if k in extra:
+            print(f"{k}: {extra[k]}")
+    n_phases = len(extra.get("ppo_phases", {}))
+    if oh and n_phases:
+        step = extra.get("ppo_step_time_s", 0.0)
+        floor = n_phases * oh
+        print(f"\nrelay floor at 1 sync/phase: {floor:.3f}s "
+              f"({100 * floor / step:.0f}% of the measured step)"
+              if step else "")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
