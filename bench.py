@@ -144,6 +144,14 @@ def bench_ppo(on_tpu):
         prompt_len = int(os.environ.get("REALHF_BENCH_PROMPT_LEN", "256"))
         new_tokens = int(os.environ.get("REALHF_BENCH_NEW_TOKENS", "256"))
         steps = max(1, int(os.environ.get("REALHF_BENCH_STEPS", "3")))
+        # Memory knobs for large-batch sweeps: remat trades 1/3 extra
+        # train FLOPs (the baseline model gets the same 4/3 factor) for
+        # activation memory; train_mbs accumulates gradients over
+        # SCANNED on-device microbatches -- activation memory drops by
+        # the factor with no extra dispatch round-trips.
+        if os.environ.get("REALHF_BENCH_REMAT") == "1":
+            model_cfg["gradient_checkpointing"] = True
+        train_mbs = int(os.environ.get("REALHF_BENCH_TRAIN_MBS", "1"))
         warmup = 1
         peak_flops, hbm_bw = V5E_PEAK_FLOPS, V5E_HBM_BW
     else:
@@ -171,6 +179,11 @@ def bench_ppo(on_tpu):
         "ppo.ppo_n_minibatches": "2",
         "ppo.force_no_logits_mask": "true",
     })
+    if on_tpu and train_mbs > 1:
+        apply_overrides(cfg, {
+            "actor_train_n_mbs": str(train_mbs),
+            "critic_train_n_mbs": str(train_mbs),
+        })
     spec = cfg.build()
     spec.dataset = DatasetAbstraction(
         "random_prompt",

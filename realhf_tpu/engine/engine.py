@@ -295,6 +295,15 @@ class Engine:
         return jax.make_array_from_callback(
             a.shape, self._replicated_sharding, lambda idx: a[idx])
 
+    def _globalize_tree(self, tree):
+        """Host pytree -> device, ONE bundled transfer where possible.
+        Per-leaf ``jnp.asarray`` costs a dispatch round-trip per leaf;
+        on a relayed platform that fixed latency (~0.1s/call) dominates
+        small uploads, so batch the whole tree into one device_put."""
+        if not self._multiproc:
+            return jax.device_put(tree)
+        return jax.tree.map(self._globalize, tree)
+
     def _out_replicated(self):
         """out_shardings making jit outputs replicated (hence fully
         addressable on every member process); None single-process to
@@ -415,14 +424,14 @@ class Engine:
             self._train_step_cache[key] = self._build_train_step(loss_fn)
         step = self._train_step_cache[key]
 
-        stacked = {
-            k: self._globalize(np.stack([np.asarray(mb[k])
-                                         for mb in microbatches]))
-            for k in microbatches[0]
-        }
         if loss_weights is None:
             loss_weights = [1.0] * len(microbatches)
-        weights = self._globalize(np.asarray(loss_weights, np.float32))
+        host_batch = {
+            k: np.stack([np.asarray(mb[k]) for mb in microbatches])
+            for k in microbatches[0]
+        }
+        stacked, weights = self._globalize_tree(
+            (host_batch, np.asarray(loss_weights, np.float32)))
 
         self.params, self.opt_state, loss, stats, gnorm = step(
             self.params, self.opt_state, stacked, weights)
@@ -464,9 +473,8 @@ class Engine:
                 return h
             self._jit_forward_hidden = jax.jit(
                 f, out_shardings=self._out_replicated())
-        return self._jit_forward_hidden(self.params,
-                                        self._globalize(input_ids),
-                                        self._globalize(seg_ids))
+        ids, seg = self._globalize_tree((input_ids, seg_ids))
+        return self._jit_forward_hidden(self.params, ids, seg)
 
     def forward_logprobs(self, input_ids, seg_ids, temperature: float = 1.0,
                          logits_mask=None):
@@ -485,10 +493,11 @@ class Engine:
             self._jit_logprobs = jax.jit(
                 f, static_argnames=("temp", "has_mask"),
                 out_shardings=self._out_replicated())
-        mask = self._globalize(logits_mask) if logits_mask is not None \
-            else self._globalize(np.zeros((1,), bool))
-        return self._jit_logprobs(self.params, self._globalize(input_ids),
-                                  self._globalize(seg_ids), mask,
+        ids, seg, mask = self._globalize_tree(
+            (input_ids, seg_ids,
+             logits_mask if logits_mask is not None
+             else np.zeros((1,), bool)))
+        return self._jit_logprobs(self.params, ids, seg, mask,
                                   temp=temperature,
                                   has_mask=logits_mask is not None)
 
@@ -505,8 +514,8 @@ class Engine:
                 return T.critic_values(self.cfg, params, h)
             self._jit_values = jax.jit(
                 f, out_shardings=self._out_replicated())
-        return self._jit_values(self.params, self._globalize(input_ids),
-                                self._globalize(seg_ids))
+        ids, seg = self._globalize_tree((input_ids, seg_ids))
+        return self._jit_values(self.params, ids, seg)
 
     # ------------------------------------------------------------------
     # Generation
@@ -628,9 +637,9 @@ class Engine:
                 out_sharding=self._out_replicated(),
                 mesh=self.mesh, attention_fn=self.attention_fn)
         fn = self._generate_cache[cache_key]
-        return fn(self.params, self._globalize(prompt_ids),
-                  self._globalize(prompt_seg), self._globalize(prompt_pos),
-                  self._globalize(key))
+        ids, seg, pos = self._globalize_tree(
+            (prompt_ids, prompt_seg, prompt_pos))
+        return fn(self.params, ids, seg, pos, self._globalize(key))
 
     # ------------------------------------------------------------------
     def _cast_param_dtype(self, params):
@@ -670,8 +679,12 @@ class Engine:
                 return np.asarray(self._gather_jit(x))
 
             params = jax.tree.map(gather_leaf, params)
-        return shard_rules.unpad_vocab(
-            self.cfg, jax.tree.map(np.asarray, params))
+            return shard_rules.unpad_vocab(
+                self.cfg, jax.tree.map(np.asarray, params))
+        # single-process: ONE bundled D2H fetch for the whole tree
+        # (leaf-by-leaf np.asarray pays a sync round-trip per leaf,
+        # ~100 trips for even a small model on a tunneled chip)
+        return shard_rules.unpad_vocab(self.cfg, jax.device_get(params))
 
     def opt_state_numpy(self) -> list:
         """Host copy of the optimizer-state leaves (tree order).

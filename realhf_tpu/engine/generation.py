@@ -40,6 +40,15 @@ class GenerationOutput:
     lengths: jnp.ndarray         # int32 [B]
     no_eos_mask: jnp.ndarray     # bool [B]: True if never emitted EOS
 
+    def to_host(self) -> "GenerationOutput":
+        """All fields as host numpy via ONE bundled ``jax.device_get``.
+        Field-by-field ``np.asarray`` costs one device sync round-trip
+        per field; on a relayed/tunneled platform each round-trip is
+        ~0.1s of fixed latency, so the bundle matters. The class is a
+        registered pytree, so device_get covers every field (including
+        ones added later) and a None logits_mask passes through."""
+        return jax.device_get(self)
+
 
 def generate(
     cfg: TransformerConfig,

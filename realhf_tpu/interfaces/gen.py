@@ -113,6 +113,7 @@ class GenerationInterface(model_api.ModelInterface):
                 ids, seg, pos, key, self.gconfig,
                 eos_token_id=tok.eos_token_id,
                 pad_token_id=tok.pad_token_id)
+            out = out.to_host()  # one bundled D2H round-trip
             gen_tokens = np.asarray(out.tokens)
             lengths = np.asarray(out.lengths)
 

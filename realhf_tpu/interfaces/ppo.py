@@ -117,6 +117,7 @@ class PPOActorInterface(model_api.ModelInterface):
         out = engine.generate(ids, seg, pos, key, self.gconfig,
                               eos_token_id=tok.eos_token_id,
                               pad_token_id=tok.pad_token_id)
+        out = out.to_host()  # one bundled D2H round-trip for all fields
         gen_tokens = np.asarray(out.tokens)
         gen_lp = np.asarray(out.logprobs)
         gen_lens = np.asarray(out.lengths)

@@ -452,21 +452,37 @@ class ModelHost:
         # Per-MFC device stats (reference __log_gpu_stats,
         # model_worker.py:999-1094): wall span + HBM over this
         # process's mesh devices. JAX exposes no per-region peak
-        # reset, so the table carries the honest pair: bytes in use
-        # right after the call (attributable to what this MFC leaves
-        # resident) and the PROCESS-lifetime allocator peak.
+        # reset, so the table carries the pair: bytes in use right
+        # after the call (attributable to what this MFC leaves
+        # resident) and the process-lifetime allocator peak.
+        # memory_stats() is a device query -- on a remote-attached
+        # chip it costs a full relay round-trip (~0.1s) -- so by
+        # default each MFC is SAMPLED ONCE, on its first (warmup)
+        # execution; the reported peak is the peak as of that sample.
+        # Set REALHF_TPU_HBM_STATS_EVERY_STEP=1 to re-query on every
+        # execution (exact lifetime peaks, one round-trip per call).
         import jax
 
-        now = peak = 0
-        try:
-            mine = jax.process_index()
-            for d in {d for d in model.engine.mesh.devices.flat
-                      if d.process_index == mine}:
-                stats = monitor.device_memory_stats(d)
-                now = max(now, stats.get("bytes_in_use", 0))
-                peak = max(peak, stats.get("peak_bytes_in_use", 0))
-        except Exception:  # noqa: BLE001 - stats are best-effort
-            now = peak = 0
+        if not hasattr(self, "_hbm_memo"):
+            self._hbm_memo = {}
+        every_step = os.environ.get(
+            "REALHF_TPU_HBM_STATS_EVERY_STEP") == "1"
+        if node_name in self._hbm_memo and not every_step:
+            now, peak = self._hbm_memo[node_name]
+        else:
+            now, peak = self._hbm_memo.get(node_name, (0, 0))
+            try:
+                mine = jax.process_index()
+                for d in {d for d in model.engine.mesh.devices.flat
+                          if d.process_index == mine}:
+                    stats = monitor.device_memory_stats(d)
+                    now = max(now, stats.get("bytes_in_use", 0))
+                    peak = max(peak, stats.get("peak_bytes_in_use", 0))
+                # memoize only on success: a transient stats failure
+                # must retry next execution, not freeze zeros forever
+                self._hbm_memo[node_name] = (now, peak)
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                pass
         self.last_exec_info = dict(node=node_name, start=t_start,
                                    end=t_end,
                                    secs=round(t_end - t_start, 4),

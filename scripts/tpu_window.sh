@@ -55,6 +55,11 @@ run() {  # run <timeout_s> <name> <cmd...>
 # block the rest of the window.
 run 600   overhead python scripts/overhead_probe.py
 run 14400 bench python bench.py
+# Batch sweep: relay overhead is a FIXED per-call cost, so bigger
+# batches raise vs_baseline until HBM/compile limits; capture enough
+# points to pick the best DEFAULT for the driver's end-of-round run.
+run 3600  bench_ns128 env REALHF_BENCH_N_SEQS=128 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=2 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
+run 3600  bench_ns256 env REALHF_BENCH_N_SEQS=256 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=4 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
 run 3600  decode_profile python scripts/profile_decode.py
 run 1800  remat_tax python scripts/remat_tax.py
 run 3600  calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
