@@ -53,7 +53,7 @@ def _accelerator_usable(timeout: float = 150.0) -> bool:
     (REALHF_BENCH_PROBE_RETRIES / _RETRY_SLEEP_S override)."""
     if os.environ.get("REALHF_BENCH_FORCE_CPU"):
         return False
-    retries = int(os.environ.get("REALHF_BENCH_PROBE_RETRIES", "2"))
+    retries = int(os.environ.get("REALHF_BENCH_PROBE_RETRIES", "3"))
     # A TIMED-OUT probe means the child was killed mid-claim -- the
     # very act that wedges the relay -- so before retrying one, wait
     # out a full claim-expiry window rather than re-killing every two
@@ -215,41 +215,52 @@ def bench_ppo(on_tpu):
 
     phase_hbm = {}
 
-    def timed_step(batch):
+    def timed_step(batch, parallel=True):
+        """One DFG step, level-parallel like the runtime: independent
+        MFCs of a level execute concurrently (their per-call host/relay
+        latency overlaps; device compute still serializes on the one
+        chip). Per-phase walls come from the host's per-node exec info;
+        the step wall is end-to-end. ``parallel=False`` serializes --
+        the honest denominator for per-phase MFU."""
         phase_secs = {}
         data = batch
         t_step = time.monotonic()
-        for node in runner.dfg.topological_order():
-            inp = data.select(
-                [k for k in node.input_keys if k in data.keys])
-            t0 = time.monotonic()
-            out = runner.host.execute(node.name, inp)
-            phase_secs[node.name] = time.monotonic() - t0
-            info = getattr(runner.host, "last_exec_info", None) or {}
-            # measured HBM profile (VERDICT r4 weak #3): bytes in use
-            # right after each phase + the process-lifetime peak
-            if info.get("hbm_bytes_in_use"):
-                phase_hbm[node.name] = max(
-                    phase_hbm.get(node.name, 0),
-                    info["hbm_bytes_in_use"])
-                phase_hbm["proc_peak"] = max(
-                    phase_hbm.get("proc_peak", 0),
-                    info.get("proc_peak_hbm_bytes", 0))
-            if isinstance(out, data_api.SequenceSample):
-                data.update_(out)
+        for level in runner.dfg.topological_levels():
+            named = [(node.name,
+                      data.select([k for k in node.input_keys
+                                   if k in data.keys]))
+                     for node in level]
+            outs = runner.host.execute_level(named, parallel=parallel)
+            for node, out in zip(level, outs):
+                info = runner.host.exec_infos.get(node.name) or {}
+                phase_secs[node.name] = info.get(
+                    "secs", 0.0)
+                # measured HBM profile (VERDICT r4 weak #3): bytes in
+                # use right after each phase + process-lifetime peak
+                if info.get("hbm_bytes_in_use"):
+                    phase_hbm[node.name] = max(
+                        phase_hbm.get(node.name, 0),
+                        info["hbm_bytes_in_use"])
+                    phase_hbm["proc_peak"] = max(
+                        phase_hbm.get("proc_peak", 0),
+                        info.get("proc_peak_hbm_bytes", 0))
+                if isinstance(out, data_api.SequenceSample):
+                    data.update_(out)
         return time.monotonic() - t_step, phase_secs
 
     for _ in range(warmup):
         timed_step(next(batches))
-    per_phase = {}
+    # Phase table from ONE SERIALIZED step: with level-parallel
+    # execution concurrent phases' walls overlap on the one chip, so
+    # serialized walls are the honest per-phase MFU denominator. The
+    # HEADLINE step time is then measured level-parallel (the runtime's
+    # real execution mode).
+    _, per_phase = timed_step(next(batches), parallel=False)
     t0 = time.monotonic()
     for _ in range(steps):
-        dt, phases = timed_step(next(batches))
-        for k, v in phases.items():
-            per_phase[k] = per_phase.get(k, 0.0) + v
+        dt, _ = timed_step(next(batches))
     total = time.monotonic() - t0
     step_time = total / steps
-    per_phase = {k: v / steps for k, v in per_phase.items()}
 
     # ---- reference-class per-phase model --------------------------------
     total_len = prompt_len + new_tokens
@@ -311,6 +322,10 @@ def bench_ppo(on_tpu):
     }
     extra = {
         "ppo_step_time_s": round(step_time, 4),
+        # serialized-phase sum minus the level-parallel step wall: the
+        # host/relay latency the runtime's concurrent dispatch hides
+        "ppo_level_overlap_s": round(
+            sum(per_phase.values()) - step_time, 4),
         "ppo_baseline_model_step_s": round(baseline_step, 4),
         # vs_baseline divides a MODELED reference-class step (40% MFU
         # train/inference, 40%-of-roofline decode) by the measured

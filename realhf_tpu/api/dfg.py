@@ -218,6 +218,15 @@ class DFG:
     def topological_order(self) -> List[MFCDef]:
         return [self.G.nodes[x]["object"] for x in nx.topological_sort(self.G)]
 
+    def topological_levels(self) -> List[List[MFCDef]]:
+        """Antichain levels: every node's producers live in earlier
+        levels, so all nodes WITHIN a level are mutually independent
+        and may execute concurrently (the distributed master exploits
+        this across workers, master_worker.py dispatch; the inline
+        runner across threads)."""
+        return [[self.G.nodes[x]["object"] for x in gen]
+                for gen in nx.topological_generations(self.G)]
+
     @property
     def dataset_keys(self) -> List[str]:
         """Input keys that no MFC produces -- they must come from the

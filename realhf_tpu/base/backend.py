@@ -90,6 +90,11 @@ def pallas_enabled() -> bool:
     otherwise die deep in Mosaic lowering with an opaque error."""
     import jax
 
+    # Escape hatch / A-B rig: force the GSPMD/XLA fallback paths even
+    # on a real TPU (profile_decode --no-pallas sets this to compare
+    # the handwritten kernels against XLA on silicon).
+    if os.environ.get("REALHF_TPU_DISABLE_PALLAS") == "1":
+        return False
     if jax.default_backend() == "tpu":
         return True
     if os.environ.get("REALHF_TPU_FORCE_PALLAS") != "1":

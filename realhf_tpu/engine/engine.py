@@ -331,7 +331,11 @@ class Engine:
     # ------------------------------------------------------------------
     # Training
     # ------------------------------------------------------------------
-    def _build_train_step(self, loss_fn: LossFn) -> Callable:
+    def _train_step_body(self, loss_fn: LossFn) -> Callable:
+        """The un-jitted one-optimizer-step body shared by
+        ``_build_train_step`` (one minibatch per dispatch) and
+        ``_build_train_seq`` (a lax.scan over minibatches inside one
+        dispatch)."""
 
         def step(params, opt_state, mbs: Dict[str, jnp.ndarray],
                  mb_weights: jnp.ndarray):
@@ -390,7 +394,47 @@ class Engine:
             mean_loss = (losses * mb_weights / wsum).sum()
             return new_params, new_opt, mean_loss, mean_stats, gnorm
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def _train_out_shardings(self, extra_outs: int):
+        """Pin the params/opt-state OUTPUTS of a train jit to their
+        input shardings. Without this XLA picks output shardings
+        freely, the second call sees donated inputs whose shardings no
+        longer match the first compilation, and the step silently
+        compiles twice (measured: a full second compile on step 2).
+        The scalar/stat outputs stay compiler-chosen."""
+        return (self._param_shardings, self._opt_shardings) + \
+            (None,) * extra_outs
+
+    def _build_train_step(self, loss_fn: LossFn) -> Callable:
+        return jax.jit(self._train_step_body(loss_fn),
+                       donate_argnums=(0, 1),
+                       out_shardings=self._train_out_shardings(3))
+
+    def _build_train_seq(self, loss_fn: LossFn) -> Callable:
+        """N SEQUENTIAL optimizer steps (e.g. the PPO minibatch loop,
+        reference ppo_interface.py train_step's minibatch iteration) in
+        ONE compiled dispatch: an outer lax.scan threads params and
+        optimizer state through the per-minibatch step body, so a
+        remote-attached chip pays one dispatch+sync round-trip for the
+        whole loop instead of one per minibatch. Semantics (update
+        order, early-stop skip, gradient weighting) are identical to
+        calling train_batch once per minibatch."""
+        body = self._train_step_body(loss_fn)
+
+        def seq(params, opt_state, all_mbs, all_weights):
+            def outer(carry, x):
+                p, o = carry
+                mbs, w = x
+                p, o, loss, stats, gnorm = body(p, o, mbs, w)
+                return (p, o), (loss, stats, gnorm)
+
+            (params, opt_state), (losses, stats, gnorms) = jax.lax.scan(
+                outer, (params, opt_state), (all_mbs, all_weights))
+            return params, opt_state, losses, stats, gnorms
+
+        return jax.jit(seq, donate_argnums=(0, 1),
+                       out_shardings=self._train_out_shardings(3))
 
     def train_batch(self, microbatches: List[Dict[str, np.ndarray]],
                     loss_fn: LossFn,
@@ -457,6 +501,65 @@ class Engine:
         out = {k: float(v) for k, v in stats.items()}
         out["loss"] = float(loss)
         out["grad_norm"] = float(gnorm)
+        return out
+
+    def train_minibatches(self,
+                          minibatches: List[List[Dict[str, np.ndarray]]],
+                          loss_fn: LossFn,
+                          loss_weights: Optional[List[List[float]]] = None,
+                          loss_fn_key: Optional[str] = None
+                          ) -> List[Dict[str, float]]:
+        """N sequential optimizer steps -- one per minibatch, each
+        accumulating gradients over its microbatches -- in ONE jitted
+        dispatch (the PPO minibatch loop fused; see _build_train_seq).
+        Array shapes must match across ALL microbatches of ALL
+        minibatches (``pad_stream_batches`` over the union). Returns
+        one stats dict per minibatch, exactly what the same sequence
+        of ``train_batch`` calls would have returned."""
+        if self._tx is None:
+            raise RuntimeError("Engine has no optimizer (inference-only).")
+        if len(minibatches) == 1:
+            return [self.train_batch(minibatches[0], loss_fn,
+                                     loss_weights[0] if loss_weights
+                                     else None, loss_fn_key)]
+        if getattr(self, "_opt_offloaded", False):
+            self.opt_state = jax.device_put(self.opt_state,
+                                            self._opt_shardings)
+            self._opt_offloaded = False
+        key = ("__seq__", loss_fn_key or loss_fn)
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = self._build_train_seq(loss_fn)
+        step = self._train_step_cache[key]
+
+        if loss_weights is None:
+            loss_weights = [[1.0] * len(m) for m in minibatches]
+        host_batch = {
+            k: np.stack([np.stack([np.asarray(mb[k]) for mb in m])
+                         for m in minibatches])
+            for k in minibatches[0][0]
+        }
+        stacked, weights = self._globalize_tree(
+            (host_batch, np.asarray(loss_weights, np.float32)))
+
+        self.params, self.opt_state, losses, stats, gnorms = step(
+            self.params, self.opt_state, stacked, weights)
+        self.version += len(minibatches)
+        if self._decode_view is not None:
+            self._decode_view.params = None
+            self._decode_view_src = None
+        if (self.optimizer_config is not None
+                and self.optimizer_config.offload):
+            cpu = jax.devices("cpu")[0]
+            self.opt_state = jax.device_put(self.opt_state, cpu)
+            jax.block_until_ready(self.opt_state)
+            self._opt_offloaded = True
+        losses, stats, gnorms = jax.device_get((losses, stats, gnorms))
+        out = []
+        for i in range(len(minibatches)):
+            d = {k: float(v[i]) for k, v in stats.items()}
+            d["loss"] = float(losses[i])
+            d["grad_norm"] = float(gnorms[i])
+            out.append(d)
         return out
 
     # ------------------------------------------------------------------

@@ -7,6 +7,7 @@ arrays for the data plane.
 """
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -111,6 +112,46 @@ def run_train_microbatched(engine, sample: SequenceSample, build_sb,
         weights = [float(sb.n_tokens) for sb in sbs]
     return engine.train_batch([sb.arrays for sb in sbs], loss_fn,
                               loss_weights=weights, loss_fn_key=loss_fn_key)
+
+
+def run_train_minibatches(engine, minibatch_samples, build_sb, loss_fn,
+                          loss_fn_key, n_mbs: Optional[int],
+                          weight_key: str = "loss_mask") -> List[Dict]:
+    """The PPO-style minibatch loop: one optimizer step per minibatch
+    sample, each accumulating over ``n_mbs`` memory microbatches.
+
+    By default the WHOLE loop runs inside one jitted dispatch
+    (``Engine.train_minibatches``: lax.scan threads params/opt state
+    through the per-minibatch step), so a remote-attached chip pays one
+    dispatch+sync round-trip instead of one per minibatch -- identical
+    update order and numerics to sequential ``train_batch`` calls.
+    ``REALHF_TPU_FUSE_MINIBATCHES=0`` restores the sequential calls
+    (e.g. when length-skewed minibatches would over-pad the common
+    bucket the fused path stacks into)."""
+    fused = os.environ.get("REALHF_TPU_FUSE_MINIBATCHES", "1") != "0"
+    if not fused or len(minibatch_samples) == 1:
+        return [run_train_microbatched(engine, m, build_sb, loss_fn,
+                                       loss_fn_key, n_mbs, weight_key)
+                for m in minibatch_samples]
+    per_mb = [[build_sb(m) for m in split_minibatches(s, n_mbs or 1)]
+              for s in minibatch_samples]
+    if len({len(g) for g in per_mb}) != 1:
+        # uneven microbatch counts cannot stack into one [N, M, ...]
+        return [run_train_microbatched(engine, m, build_sb, loss_fn,
+                                       loss_fn_key, n_mbs, weight_key)
+                for m in minibatch_samples]
+    flat = pad_stream_batches([sb for g in per_mb for sb in g])
+    it = iter(flat)
+    groups = [[next(it) for _ in g] for g in per_mb]
+    stacks, weights = [], []
+    for g in groups:
+        w = [float(np.asarray(sb.arrays[weight_key]).sum()) for sb in g]
+        if not any(x > 0 for x in w):
+            w = [float(sb.n_tokens) for sb in g]
+        stacks.append([sb.arrays for sb in g])
+        weights.append(w)
+    return engine.train_minibatches(stacks, loss_fn, weights,
+                                    loss_fn_key)
 
 
 def pad_stream_batches(batches: List[StreamBatch]) -> List[StreamBatch]:

@@ -345,12 +345,10 @@ class PPOActorInterface(model_api.ModelInterface):
 
         # MFCDef.n_mbs: memory microbatching WITHIN each PPO minibatch
         # -- gradients accumulate over n_mbs scanned microbatches in a
-        # single optimizer step.
-        all_stats = [
-            common.run_train_microbatched(engine, minibatch, build_sb,
-                                          loss_fn, loss_key, n_mbs)
-            for minibatch in mbs
-        ]
+        # single optimizer step; the minibatch loop itself runs fused
+        # in one dispatch (common.run_train_minibatches).
+        all_stats = common.run_train_minibatches(
+            engine, mbs, build_sb, loss_fn, loss_key, n_mbs)
         model.inc_version()
 
         agg = {k: float(np.mean([s[k] for s in all_stats]))
@@ -512,12 +510,8 @@ class PPOCriticInterface(model_api.ModelInterface):
                     .astype(np.float32)),
                 n_streams=engine.n_streams)
 
-        all_stats = [
-            common.run_train_microbatched(engine, minibatch, build_sb,
-                                          loss_fn, ("ppo_critic", eps),
-                                          n_mbs)
-            for minibatch in mbs
-        ]
+        all_stats = common.run_train_minibatches(
+            engine, mbs, build_sb, loss_fn, ("ppo_critic", eps), n_mbs)
         model.inc_version()
 
         agg = {k: float(np.mean([s[k] for s in all_stats]))

@@ -122,15 +122,22 @@ class InlineRunner:
         stats (mirrors one master-worker _poll iteration)."""
         stats: Dict[str, Dict] = {}
         data = batch
-        for node in self.dfg.topological_order():
-            inp = data.select([k for k in node.input_keys if k in data.keys])
-            out = self.host.execute(node.name, inp)
-            if isinstance(out, data_api.SequenceSample):
-                data.update_(out)
-            elif isinstance(out, dict):
-                stats[node.name] = out
-                if node.log_return_value:
-                    logger.info("MFC %s stats: %s", node.name, out)
+        # Execute level by level; independent MFCs within a level run
+        # concurrently (host.execute_level), mirroring the distributed
+        # master's concurrent dispatch. Outputs merge in level order.
+        for level in self.dfg.topological_levels():
+            named = [(node.name,
+                      data.select([k for k in node.input_keys
+                                   if k in data.keys]))
+                     for node in level]
+            outs = self.host.execute_level(named)
+            for node, out in zip(level, outs):
+                if isinstance(out, data_api.SequenceSample):
+                    data.update_(out)
+                elif isinstance(out, dict):
+                    stats[node.name] = out
+                    if node.log_return_value:
+                        logger.info("MFC %s stats: %s", node.name, out)
         return stats
 
     def _maybe_save(self, epochs: int = 0, steps: int = 0, force=False):

@@ -143,6 +143,12 @@ def build_model(role: str, spec, tokenizer, total_steps: int,
             spec.path, spec.hf_family,
             is_critic=spec.is_critic or spec.init_critic_from_actor)
     else:
+        if spec.random_init_config is None:
+            raise ValueError(
+                f"Model role {role!r} has neither a checkpoint "
+                "path nor a random_init_config; pass "
+                f"`{role}.path=<hf-or-saved-checkpoint>` (CLI) or "
+                "set random_init_config on its ModelSpec.")
         cfg = TransformerConfig(**spec.random_init_config,
                                 is_critic=spec.is_critic)
         params = None
@@ -244,6 +250,11 @@ class ModelHost:
         # node -> version of the primary weights currently installed
         # (cross-group sync protocol; 0 = initial checkpoint/seed)
         self.node_param_version: Dict[str, int] = {}
+        # per-node execution records + HBM sample memo: initialized
+        # HERE because execute() may run concurrently from
+        # execute_level threads (lazy init would race on first use)
+        self.exec_infos: Dict[str, dict] = {}
+        self._hbm_memo: Dict[str, tuple] = {}
         for node in nodes:
             alloc = spec.alloc_of(node.name)
             if alloc is None:
@@ -463,8 +474,6 @@ class ModelHost:
         # execution (exact lifetime peaks, one round-trip per call).
         import jax
 
-        if not hasattr(self, "_hbm_memo"):
-            self._hbm_memo = {}
         every_step = os.environ.get(
             "REALHF_TPU_HBM_STATS_EVERY_STEP") == "1"
         if node_name in self._hbm_memo and not every_step:
@@ -488,6 +497,9 @@ class ModelHost:
                                    secs=round(t_end - t_start, 4),
                                    hbm_bytes_in_use=int(now),
                                    proc_peak_hbm_bytes=int(peak))
+        # per-node record (last_exec_info is clobbered when a level of
+        # independent MFCs executes concurrently, execute_level)
+        self.exec_infos[node_name] = self.last_exec_info
 
         if isinstance(out, data_api.SequenceSample) and node.output_key_remap:
             out.remap_keys_(node.output_key_remap)
@@ -514,6 +526,27 @@ class ModelHost:
                 logger.info("Offloaded %s weights to host after %s.",
                             node.role, node_name)
         return out
+
+    def execute_level(self, named_inputs, parallel: Optional[bool] = None):
+        """Run a list of ``(node_name, inp)`` MFCs -- one topological
+        level, mutually independent by construction -- CONCURRENTLY in
+        threads, returning outputs in input order. On a single device
+        the compute still serializes on the XLA stream; what overlaps
+        is per-call host work (packing, dispatch, transfer syncs) --
+        exactly what the distributed runtime overlaps across worker
+        processes (the decoupled-allocation concurrency). jax dispatch
+        is thread-safe; two same-role nodes in one level may race a
+        jit-cache insert, costing at worst a duplicate compile.
+        ``parallel=False`` (or ``REALHF_TPU_PARALLEL_MFC=0``)
+        serializes."""
+        if parallel is None:
+            parallel = os.environ.get("REALHF_TPU_PARALLEL_MFC") != "0"
+        if len(named_inputs) == 1 or not parallel:
+            return [self.execute(n, i) for n, i in named_inputs]
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(named_inputs)) as ex:
+            futs = [ex.submit(self.execute, n, i) for n, i in named_inputs]
+            return [f.result() for f in futs]
 
     # ------------------------------------------------------------------
     def save_role(self, role: str, train_node_name: str):

@@ -33,7 +33,11 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--prompt", type=int, default=256)
     ap.add_argument("--gen", type=int, default=256)
+    ap.add_argument("--no-pallas", action="store_true",
+                    help="force the XLA fallback (A/B vs the kernels)")
     args = ap.parse_args()
+    if args.no_pallas:
+        os.environ["REALHF_TPU_DISABLE_PALLAS"] = "1"
 
     from realhf_tpu.api.config import ModelName
     from realhf_tpu.engine import packing

@@ -61,6 +61,7 @@ run 14400 bench python bench.py
 run 3600  bench_ns128 env REALHF_BENCH_N_SEQS=128 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=2 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
 run 3600  bench_ns256 env REALHF_BENCH_N_SEQS=256 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=4 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
 run 3600  decode_profile python scripts/profile_decode.py
+run 3600  decode_profile_xla python scripts/profile_decode.py --no-pallas
 run 1800  remat_tax python scripts/remat_tax.py
 run 3600  calibrate python scripts/calibrate_tpu.py --out "$OUT/calibration_tpu.json"
 run 0     decode_bk_sweep python scripts/sweep_decode_bk.py

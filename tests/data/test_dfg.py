@@ -67,6 +67,22 @@ class TestDFG:
         assert order.index("actor_gen") < order.index("rew_inf")
         assert order.index("rew_inf") < order.index("actor_train")
 
+    def test_topological_levels(self):
+        g = DFG(ppo_nodes())
+        levels = [{n.name for n in lvl} for lvl in g.topological_levels()]
+        assert levels[0] == {"actor_gen"}
+        # the three inference MFCs are mutually independent: one level
+        assert levels[1] == {"rew_inf", "ref_inf", "critic_inf"}
+        assert levels[2] == {"actor_train", "critic_train"}
+        # levels partition the node set and respect every edge
+        flat = [n for lvl in g.topological_levels() for n in lvl]
+        assert {n.name for n in flat} == {n.name for n in g.nodes}
+        depth = {n.name: i for i, lvl in
+                 enumerate(g.topological_levels()) for n in lvl}
+        for n in g.nodes:
+            for p in n.parents:
+                assert depth[p.name] < depth[n.name]
+
     def test_single_node_graph(self):
         sft = MFCDef(name="trainDefault", n_seqs=8,
                      interface_type=ModelInterfaceType.TRAIN_STEP,

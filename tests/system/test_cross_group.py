@@ -72,11 +72,14 @@ def _run_cross_group_trial(prompt_data, attempt):
     spec = cfg.build()
     for role, mspec in spec.models.items():
         mspec.path = None
-        # critic deep enough that critic_train outlasts
-        # actor_train + param sync: the overlap window the wall-clock
-        # assertion below measures
+        # critic deep/wide enough that critic_train UNAMBIGUOUSLY
+        # outlasts actor_train + param sync even on an overhead-bound
+        # 1-CPU box (~1s fixed per train call): the scanned layer
+        # stack makes depth nearly free at compile time, so 32 layers
+        # buy runtime asymmetry without lengthening compilation
         mspec.random_init_config = (
-            dict(TINY, n_layers=10) if role == "critic" else dict(TINY))
+            dict(TINY, n_layers=32, hidden_dim=64, intermediate_dim=128)
+            if role == "critic" else dict(TINY))
         mspec.bf16 = False
         mspec.parallel = ParallelismConfig(
             data_parallel_size=2, tensor_parallel_size=4)
