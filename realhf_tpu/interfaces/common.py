@@ -129,17 +129,16 @@ def run_train_minibatches(engine, minibatch_samples, build_sb, loss_fn,
     (e.g. when length-skewed minibatches would over-pad the common
     bucket the fused path stacks into)."""
     fused = os.environ.get("REALHF_TPU_FUSE_MINIBATCHES", "1") != "0"
-    if not fused or len(minibatch_samples) == 1:
+    splits = [split_minibatches(s, n_mbs or 1) for s in minibatch_samples]
+    if (not fused or len(minibatch_samples) == 1
+            or len({len(g) for g in splits}) != 1):
+        # uneven microbatch counts cannot stack into one [N, M, ...];
+        # counts are checked BEFORE any packing so the fallback does
+        # not redo build_sb work
         return [run_train_microbatched(engine, m, build_sb, loss_fn,
                                        loss_fn_key, n_mbs, weight_key)
                 for m in minibatch_samples]
-    per_mb = [[build_sb(m) for m in split_minibatches(s, n_mbs or 1)]
-              for s in minibatch_samples]
-    if len({len(g) for g in per_mb}) != 1:
-        # uneven microbatch counts cannot stack into one [N, M, ...]
-        return [run_train_microbatched(engine, m, build_sb, loss_fn,
-                                       loss_fn_key, n_mbs, weight_key)
-                for m in minibatch_samples]
+    per_mb = [[build_sb(m) for m in group] for group in splits]
     flat = pad_stream_batches([sb for g in per_mb for sb in g])
     it = iter(flat)
     groups = [[next(it) for _ in g] for g in per_mb]

@@ -70,12 +70,40 @@ def main():
         np.asarray(mm(a, a))
     mm_s = (time.monotonic() - t0) / args.reps
 
+    # Two-thread concurrent dispatch: do two host-synced calls overlap
+    # (wall ~= serial/2) or serialize in the client (wall ~= serial)?
+    # This is the premise of level-parallel MFC execution
+    # (ModelHost.execute_level) -- measure it BEFORE the bench relies
+    # on it, and prove the client survives threads at all.
+    from concurrent.futures import ThreadPoolExecutor
+    noop = jax.jit(lambda x: x + 1)
+    x0 = jnp.zeros((8, 128), jnp.float32)
+    np.asarray(noop(x0))
+
+    def spin(reps):
+        for _ in range(reps):
+            np.asarray(noop(x0))
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            t0 = time.monotonic()
+            futs = [ex.submit(spin, args.reps) for _ in range(2)]
+            for f in futs:
+                f.result()
+        pair_s = (time.monotonic() - t0) / args.reps  # 2 calls/rep
+        thread_note = f"threaded_pair_ms={pair_s * 1e3:.2f}"
+    except Exception as e:  # noqa: BLE001 - diagnostic only
+        thread_note = f"threaded_pair_error={type(e).__name__}"
+
     print(f"noop_dispatch_ms={noop_s * 1e3:.2f} "
           f"transfer_1mb_ms={xfer_s * 1e3:.2f} "
-          f"matmul_2gflop_ms={mm_s * 1e3:.2f}")
+          f"matmul_2gflop_ms={mm_s * 1e3:.2f} {thread_note}")
     if mm_s > 0:
         print(f"# dispatch/compute ratio: {noop_s / mm_s:.1f}x "
               "(>> 1 means calls are overhead-bound)")
+    print("# threaded_pair ~= noop_dispatch => concurrent syncs "
+          "overlap (level-parallel pays off); ~= 2x => client "
+          "serializes them")
 
 
 if __name__ == "__main__":
