@@ -19,7 +19,7 @@ import sys
 
 
 def main():
-    out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_main"
+    out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_r5main"
     line = None
     with open(f"{out}/bench.out") as f:
         for ln in f:
@@ -59,6 +59,8 @@ def main():
               f"| {roof if roof is not None else ''} |")
     print()
     for k in ("sft_mfu", "gen_hbm_roofline_frac", "ppo_step_time_s",
+              "ppo_step_time_serial_s", "ppo_step_time_parallel_s",
+              "ppo_parallel_mfc_error", "sft_error", "reshard_error",
               "ppo_baseline_model_step_s", "reshard_gbytes_per_s",
               "cross_group_sync_gbytes_per_s"):
         if k in extra:
