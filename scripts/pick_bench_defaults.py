@@ -1,0 +1,86 @@
+"""Compare the chip window's bench sweep points and persist the best
+configuration as bench_defaults.json at the repo root, so the driver's
+end-of-round `python bench.py` measures the best configuration even if
+nobody is attending the window. The file is INTENDED to be committed:
+it is a measured tuning artifact (like a calibration table), and the
+driver's bench runs from a fresh checkout.
+
+Reads <outdir>/{bench,bench_ns128,bench_ns256}.out (tpu_window.sh
+step outputs), takes the LAST JSON line of each, ranks by
+vs_baseline, and writes the winner's shape knobs. Only acts on
+TPU-backed records (a CPU-fallback line must never repoint defaults);
+keeps the built-ins when the default-shape run wins or nothing
+parses.
+
+Usage: python scripts/pick_bench_defaults.py <outdir>
+"""
+import json
+import os
+import sys
+
+SWEEP = {
+    # step name -> the shape knobs that run used (tpu_window.sh)
+    "bench": None,  # built-in defaults
+    "bench_ns128": dict(n_seqs=128, train_mbs=2),
+    "bench_ns256": dict(n_seqs=256, train_mbs=4),
+}
+
+
+def read_record(path):
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if '"metric"' in ln]
+    except OSError:
+        return None
+    if not lines:
+        return None
+    try:
+        rec = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return None
+    if rec.get("extra", {}).get("backend") != "tpu":
+        return None
+    return rec
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_r5main"
+    scored = []
+    for name, knobs in SWEEP.items():
+        rec = read_record(os.path.join(out, f"{name}.out"))
+        if rec is not None:
+            scored.append((rec["vs_baseline"], name, knobs))
+            print(f"{name}: vs_baseline={rec['vs_baseline']}")
+    if not scored:
+        print("no TPU-backed records; leaving defaults untouched")
+        return 1
+    scored.sort(reverse=True)
+    best_vs, best_name, best_knobs = scored[0]
+    if best_knobs is None:
+        print(f"built-in defaults win (vs_baseline={best_vs}); "
+              "no defaults file needed")
+        # a stale defaults file from an earlier window must not
+        # shadow a now-better built-in
+        try:
+            os.remove(os.path.join(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))),
+                "bench_defaults.json"))
+            print("removed stale bench_defaults.json")
+        except OSError:
+            pass
+        return 0
+    dst = os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "bench_defaults.json")
+    # atomic: a kill mid-write must never leave truncated JSON for the
+    # end-of-round bench to trip over
+    tmp = dst + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(best_knobs, picked_from=best_name,
+                       measured_vs_baseline=best_vs), f, indent=1)
+    os.replace(tmp, dst)
+    print(f"wrote {dst}: {best_name} (vs_baseline={best_vs})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

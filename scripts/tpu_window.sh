@@ -60,6 +60,10 @@ run 14400 bench python bench.py
 # points to pick the best DEFAULT for the driver's end-of-round run.
 run 3600  bench_ns128 env REALHF_BENCH_N_SEQS=128 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=2 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
 run 3600  bench_ns256 env REALHF_BENCH_N_SEQS=256 REALHF_BENCH_STEPS=2 REALHF_BENCH_TRAIN_MBS=4 REALHF_BENCH_PROBE_RETRIES=1 python bench.py
+# Persist the best-measured shape as bench_defaults.json so the
+# driver's end-of-round bench.py measures the winning config even if
+# this window ran unattended (no jax involvement; cannot wedge).
+run 120   pick_defaults python scripts/pick_bench_defaults.py "$OUT"
 run 3600  decode_profile python scripts/profile_decode.py
 run 3600  decode_profile_xla python scripts/profile_decode.py --no-pallas
 run 1800  remat_tax python scripts/remat_tax.py
