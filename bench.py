@@ -184,6 +184,7 @@ def bench_ppo(on_tpu):
         n_seqs, prompt_len, new_tokens = 4, 16, 8
         steps, warmup = 1, 1
         peak_flops, hbm_bw = 1e12, 100e9
+        train_mbs = 1
 
     cfg = PPOConfig(experiment_name="benchppo", trial_name="t0",
                     total_train_epochs=100)
@@ -378,6 +379,8 @@ def bench_ppo(on_tpu):
         "ppo_n_seqs": n_seqs,
         "ppo_prompt_len": prompt_len,
         "ppo_new_tokens": new_tokens,
+        "ppo_train_mbs": train_mbs,
+        "ppo_remat": bool(model_cfg.get("gradient_checkpointing")),
         "ppo_actor_params_m": round(acfg.n_params() / 1e6, 1),
         "ppo_phases": phase_detail,
         "ppo_phase_hbm_gb": {k: round(v / 2 ** 30, 3)

@@ -740,9 +740,9 @@ class Engine:
                 out_sharding=self._out_replicated(),
                 mesh=self.mesh, attention_fn=self.attention_fn)
         fn = self._generate_cache[cache_key]
-        ids, seg, pos = self._globalize_tree(
-            (prompt_ids, prompt_seg, prompt_pos))
-        return fn(self.params, ids, seg, pos, self._globalize(key))
+        ids, seg, pos, key = self._globalize_tree(
+            (prompt_ids, prompt_seg, prompt_pos, key))
+        return fn(self.params, ids, seg, pos, key)
 
     # ------------------------------------------------------------------
     def _cast_param_dtype(self, params):

@@ -117,9 +117,13 @@ class InflightBatchingGenerator:
         ids[0, lp - len(prompt):] = prompt          # left padding
         seg[0, lp - len(prompt):] = 1
         pos[0, lp - len(prompt):] = np.arange(len(prompt))
-        self.state = self._prefill(
-            self.params, self.state, jnp.asarray(slot),
-            jnp.asarray(ids), jnp.asarray(seg), jnp.asarray(pos))
+        # one bundled upload (a relayed platform pays fixed latency
+        # per transfer; see Engine._globalize_tree). `slot` keeps its
+        # host int for the list index below -- indexing with a device
+        # scalar would force a blocking D2H readback per fill.
+        dev_slot, ids, seg, pos = jax.device_put((slot, ids, seg, pos))
+        self.state = self._prefill(self.params, self.state, dev_slot,
+                                   ids, seg, pos)
         self._slot_req[slot] = request_id
 
     # ------------------------------------------------------------------

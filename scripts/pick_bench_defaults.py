@@ -18,12 +18,11 @@ import json
 import os
 import sys
 
-SWEEP = {
-    # step name -> the shape knobs that run used (tpu_window.sh)
-    "bench": None,  # built-in defaults
-    "bench_ns128": dict(n_seqs=128, train_mbs=2),
-    "bench_ns256": dict(n_seqs=256, train_mbs=4),
-}
+# tpu_window.sh step names whose .out files carry bench records; the
+# SHAPE of each run is read from the record itself (ppo_n_seqs etc.),
+# not assumed -- the un-overridden "bench" step may already be running
+# a previously-persisted defaults file.
+STEPS = ("bench", "bench_ns128", "bench_ns256")
 
 
 def read_record(path):
@@ -43,32 +42,36 @@ def read_record(path):
     return rec
 
 
+def knobs_of(rec):
+    """The shape a record ACTUALLY ran, from its own extra."""
+    e = rec["extra"]
+    knobs = dict(n_seqs=e["ppo_n_seqs"], prompt_len=e["ppo_prompt_len"],
+                 new_tokens=e["ppo_new_tokens"])
+    if "ppo_train_mbs" in e:
+        knobs["train_mbs"] = e["ppo_train_mbs"]
+    if e.get("ppo_remat"):
+        knobs["remat"] = 1
+    return knobs
+
+
 def main():
     out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_r5main"
     scored = []
-    for name, knobs in SWEEP.items():
+    for name in STEPS:
         rec = read_record(os.path.join(out, f"{name}.out"))
         if rec is not None:
-            scored.append((rec["vs_baseline"], name, knobs))
-            print(f"{name}: vs_baseline={rec['vs_baseline']}")
+            scored.append((rec["vs_baseline"], name, knobs_of(rec)))
+            print(f"{name}: vs_baseline={rec['vs_baseline']} "
+                  f"shape={scored[-1][2]}")
     if not scored:
         print("no TPU-backed records; leaving defaults untouched")
         return 1
-    scored.sort(reverse=True)
+    scored.sort(key=lambda t: t[0], reverse=True)
     best_vs, best_name, best_knobs = scored[0]
-    if best_knobs is None:
-        print(f"built-in defaults win (vs_baseline={best_vs}); "
-              "no defaults file needed")
-        # a stale defaults file from an earlier window must not
-        # shadow a now-better built-in
-        try:
-            os.remove(os.path.join(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))),
-                "bench_defaults.json"))
-            print("removed stale bench_defaults.json")
-        except OSError:
-            pass
-        return 0
+    # ALWAYS write the winner's measured shape (even when it matches
+    # the built-ins, the file is then a harmless no-op): no delete
+    # path, so a previously-persisted winner can never be silently
+    # reverted to a never-measured configuration.
     dst = os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "bench_defaults.json")
     # atomic: a kill mid-write must never leave truncated JSON for the
