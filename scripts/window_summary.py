@@ -1,0 +1,72 @@
+"""Condense a tpu_window.sh output directory into one committable JSON
+summary (the window directory itself is gitignored): bench records for
+every sweep point, the overhead/decode/remat/calibration lines, and
+the picked defaults. Pure file shuffling — no jax, cannot wedge.
+
+Usage: python scripts/window_summary.py <outdir> [dst.json]
+"""
+import json
+import os
+import re
+import sys
+
+
+def last_json_line(path):
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if '"metric"' in ln]
+        return json.loads(lines[-1]) if lines else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def tail_lines(path, n=6):
+    try:
+        with open(path) as f:
+            return [ln.rstrip() for ln in f.readlines()[-n:]]
+    except OSError:
+        return None
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else ".round5/tpu_window_r5main"
+    dst = sys.argv[2] if len(sys.argv) > 2 else "WINDOW_r05.json"
+    summary = {"window_dir": out}
+    for step in ("bench", "bench_ns128", "bench_ns256"):
+        rec = last_json_line(os.path.join(out, f"{step}.out"))
+        if rec is not None:
+            summary[step] = rec
+    for step in ("overhead", "decode_profile", "decode_profile_xla",
+                 "remat_tax", "calibrate", "decode_bk_sweep",
+                 "pick_defaults"):
+        lines = tail_lines(os.path.join(out, f"{step}.out"))
+        if lines:
+            summary[step] = lines
+    cal = os.path.join(out, "calibration_tpu.json")
+    try:
+        with open(cal) as f:
+            summary["calibration"] = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    # captured = evidence read from THIS window's outdir; the repo-root
+    # defaults file may be stale from an earlier window and must not
+    # count toward "something was captured"
+    captured = len(summary) - 1
+    try:
+        with open("bench_defaults.json") as f:
+            summary["bench_defaults"] = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    if captured == 0:
+        print("nothing captured from", out, "; not writing", dst)
+        return 1
+    tmp = dst + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=1)
+    os.replace(tmp, dst)
+    print(f"wrote {dst} with {sorted(k for k in summary if k != 'window_dir')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
