@@ -92,6 +92,33 @@ class SaveEvalControl:
 
 
 @dataclasses.dataclass
+class FaultToleranceConfig:
+    """Knobs for the fault-tolerant runtime (heartbeats, watchdog,
+    retry/backoff, requeue); see docs/distributed.md "Fault tolerance
+    & recovery"."""
+    # liveness: WorkerServer beats every interval; a beat older than
+    # heartbeat_timeout marks the worker LOST
+    heartbeat_interval: float = 2.0
+    heartbeat_timeout: float = 20.0
+    watchdog_poll_secs: float = 1.0
+    # allowance for process spawn + jax import before the first beat
+    startup_grace_secs: float = 120.0
+    # requeue: how often one MFC may be requeued after worker loss
+    # before the trial fails (relaunch-level recovery takes over)
+    max_mfc_retries: int = 1
+    # a worker continuously LOST this long fails the trial even if
+    # nothing was in flight on it (it will be needed eventually)
+    worker_lost_fatal_secs: float = 60.0
+    # excluded_workers backoff: a lost worker is kept out of dispatch
+    # for base * 2**(losses-1) seconds (capped, jittered)
+    exclude_base_secs: float = 5.0
+    exclude_max_secs: float = 120.0
+    # save/eval dispatch+gather: attempts and per-attempt timeout
+    gather_retries: int = 2
+    gather_timeout_secs: float = 600.0
+
+
+@dataclasses.dataclass
 class ExperimentSpec:
     experiment_name: str
     trial_name: str
@@ -111,6 +138,8 @@ class ExperimentSpec:
     total_train_epochs: int = 1
     seed: int = 1
     ctl: SaveEvalControl = dataclasses.field(default_factory=SaveEvalControl)
+    ft: FaultToleranceConfig = dataclasses.field(
+        default_factory=FaultToleranceConfig)
     eval_dataset: Optional[DatasetAbstraction] = None
     # --- distributed runtime (mode=distributed) -----------------------
     # Number of model-worker processes; each owns its own device set

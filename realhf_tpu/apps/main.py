@@ -17,10 +17,16 @@ import sys
 import time
 from typing import Dict, Optional
 
-from realhf_tpu.api.experiment import ExperimentSpec
+from realhf_tpu.api.experiment import ExperimentSpec, FaultToleranceConfig
 from realhf_tpu.base import constants, logging, name_resolve, names
-from realhf_tpu.system.scheduler import JobException, make_scheduler
+from realhf_tpu.system.scheduler import (
+    JobException,
+    JobState,
+    make_scheduler,
+)
+from realhf_tpu.system.watchdog import Watchdog
 from realhf_tpu.system.worker_base import (
+    HEARTBEAT_INTERVAL_ENV,
     WorkerControlPanel,
     WorkerServerStatus,
 )
@@ -86,6 +92,8 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
     env = dict(env or {})
     env.setdefault("REALHF_TPU_NAME_RESOLVE_ROOT", record_root)
     env.setdefault("REALHF_TPU_ROOT", constants.ROOT_DIR)
+    ft = getattr(spec, "ft", None) or FaultToleranceConfig()
+    env.setdefault(HEARTBEAT_INTERVAL_ENV, str(ft.heartbeat_interval))
 
     worker_names = ([f"model_worker/{i}"
                      for i in range(spec.n_model_workers)]
@@ -126,6 +134,14 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         panel.group_request("start")
         logger.info("All %d workers started.", len(worker_names))
 
+        # watchdog over the whole fleet (master included): catches
+        # hung-but-not-dead workers the scheduler still reports as
+        # RUNNING (the master's own watchdog covers only the model
+        # workers it talks to)
+        watchdog = Watchdog(
+            spec.experiment_name, spec.trial_name, worker_names,
+            timeout=ft.heartbeat_timeout, grace=ft.startup_grace_secs,
+            poll_interval=ft.watchdog_poll_secs)
         deadline = time.monotonic() + timeout
         while True:
             try:
@@ -142,6 +158,10 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
                     raise JobException(w, info.state)
                 if panel.get_worker_status(w) == WorkerServerStatus.ERROR:
                     raise JobException(w, info.state)
+            watchdog.poll()
+            lost = watchdog.lost_longer_than(ft.worker_lost_fatal_secs)
+            if lost:
+                raise JobException(lost[0], JobState.LOST)
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"Trial did not complete within {timeout}s.")

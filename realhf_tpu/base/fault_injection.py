@@ -1,0 +1,142 @@
+"""Deterministic fault injection for the distributed runtime.
+
+Env/config-driven faults let tier-1 tests prove every fault-tolerance
+path without races: a worker crashes while handling its Nth matching
+request, silently drops a reply, or delays one. Spec strings live in
+``REALHF_TPU_FAULTS`` (``;``-separated)::
+
+    kind:worker:handle:nth[:seconds]
+
+    crash:model_worker/0:train_step:2      # raise on the 2nd train_step
+    die:model_worker/0:train_step:2        # os._exit: silent death
+    drop_reply:*:inference:1               # execute, never reply, once
+    delay_reply:model_worker/1:*:3:2.5     # 3rd request sleeps 2.5s
+
+``crash`` raises (the worker reports an error payload and exits with
+ERROR status -- the attributed-error path); ``die`` hard-exits the
+process mid-request with no goodbye (the heartbeat-loss path the
+watchdog must catch).
+
+``worker`` and ``handle`` are fnmatch patterns (``*`` = any). Faults
+are one-shot: each fires exactly once per matching spec. For
+crash-then-recover tests the injector persists fired fault ids to
+``REALHF_TPU_FAULTS_STATE`` (a plain text file, one id per line) so a
+relaunched worker does not re-fire the same fault and crash-loop.
+"""
+
+import dataclasses
+import fnmatch
+import os
+from typing import Dict, List, Optional
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("fault_injection")
+
+KINDS = ("crash", "die", "drop_reply", "delay_reply")
+
+FAULTS_ENV = "REALHF_TPU_FAULTS"
+FAULTS_STATE_ENV = "REALHF_TPU_FAULTS_STATE"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a worker executing an injected ``crash`` fault."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str            # crash | drop_reply | delay_reply
+    worker: str = "*"    # fnmatch pattern on the worker name
+    handle: str = "*"    # fnmatch pattern on the request handle_name
+    nth: int = 1         # fire on the Nth matching event (1-based)
+    seconds: float = 0.0  # delay_reply sleep
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"Unknown fault kind {self.kind!r} "
+                             f"(known: {KINDS})")
+        if self.nth < 1:
+            raise ValueError(f"Fault nth must be >= 1, got {self.nth}")
+
+    @property
+    def fault_id(self) -> str:
+        return (f"{self.kind}:{self.worker}:{self.handle}:{self.nth}"
+                f":{self.seconds}")
+
+    def matches(self, worker: str, handle: str) -> bool:
+        return (fnmatch.fnmatchcase(worker, self.worker)
+                and fnmatch.fnmatchcase(handle, self.handle))
+
+
+def parse_faults(spec: str) -> List[FaultSpec]:
+    """Parse a ``;``-separated fault spec string (see module doc)."""
+    out = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        # worker names contain "/" but never ":"; rejoin is not needed
+        if len(fields) < 4 or len(fields) > 5:
+            raise ValueError(
+                f"Bad fault spec {part!r}: want "
+                "kind:worker:handle:nth[:seconds]")
+        kind, worker, handle, nth = fields[:4]
+        seconds = float(fields[4]) if len(fields) == 5 else 0.0
+        out.append(FaultSpec(kind=kind, worker=worker, handle=handle,
+                             nth=int(nth), seconds=seconds))
+    return out
+
+
+class FaultInjector:
+    """Counts (worker, handle) events against each spec and reports
+    which fault (if any) an event should trigger. Each spec fires at
+    most once per injector lifetime AND -- when ``state_path`` is set
+    -- at most once across process relaunches."""
+
+    def __init__(self, specs: List[FaultSpec],
+                 state_path: Optional[str] = None):
+        self.specs = list(specs)
+        self.state_path = state_path
+        self._counts: Dict[str, int] = {s.fault_id: 0 for s in self.specs}
+        self._fired = self._load_state()
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        env = os.environ if env is None else env
+        raw = env.get(FAULTS_ENV)
+        if not raw:
+            return None
+        return cls(parse_faults(raw), state_path=env.get(FAULTS_STATE_ENV))
+
+    def _load_state(self) -> set:
+        if not self.state_path or not os.path.isfile(self.state_path):
+            return set()
+        with open(self.state_path, "r") as f:
+            return {line.strip() for line in f if line.strip()}
+
+    def _record_fired(self, fid: str):
+        self._fired.add(fid)
+        if self.state_path:
+            # append-only: concurrent workers each add their own lines
+            with open(self.state_path, "a") as f:
+                f.write(fid + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def on_event(self, worker: str, handle: str) -> Optional[FaultSpec]:
+        """Record one (worker, handle) event; return the fault to
+        execute now, or None. Counters advance per matching spec, so
+        ``nth`` is deterministic regardless of other specs firing."""
+        for s in self.specs:
+            if not s.matches(worker, handle):
+                continue
+            self._counts[s.fault_id] += 1
+            if (self._counts[s.fault_id] == s.nth
+                    and s.fault_id not in self._fired):
+                self._record_fired(s.fault_id)
+                logger.warning("Fault injection firing %s for %s/%s "
+                               "(event %d).", s.fault_id, worker, handle,
+                               s.nth)
+                return s
+        return None

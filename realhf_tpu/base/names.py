@@ -32,6 +32,18 @@ def worker_key(experiment_name: str, trial_name: str, key: str) -> str:
     return f"{_root(experiment_name, trial_name)}/worker_key/{key}"
 
 
+def worker_heartbeat(experiment_name: str, trial_name: str,
+                     worker_name: str) -> str:
+    """Liveness beacon: the worker re-publishes a wall-clock timestamp
+    here every heartbeat interval; the watchdog marks it LOST when the
+    entry expires (TTL backends) or the timestamp goes stale."""
+    return f"{_root(experiment_name, trial_name)}/heartbeat/{worker_name}"
+
+
+def heartbeat_root(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/heartbeat/"
+
+
 def request_reply_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/request_reply_stream/{stream_name}"
 

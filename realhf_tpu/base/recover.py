@@ -1,17 +1,33 @@
 """Recovery bookkeeping (reference ``realhf/base/recover.py``).
 
-The master dumps a small ``RecoverInfo`` (epoch/step counters + data ids
-already consumed this epoch) so a restarted run can skip processed data
-and resume step accounting. Model weights are recovered from the latest
-checkpoint separately.
+The master dumps a ``RecoverInfo`` (schema-versioned) so a restarted
+run resumes instead of starting over: epoch/step counters, the data
+ids already consumed this epoch, the SequenceBuffer's in-flight state,
+and dataloader epoch accounting. Model weights are recovered from the
+latest checkpoint separately.
+
+Dumps are atomic (tmp + fsync + rename) and loads are tolerant: a
+corrupt, truncated, or future-versioned file degrades to a fresh
+start (``load_safe`` returns None) rather than crashing the resumed
+trial. Pre-versioning pickles (schema v1, counters + consumed ids
+only) are upgraded in place on load.
 """
 
 import dataclasses
 import os
 import pickle
-from typing import Hashable, List, Optional
+from typing import Any, Dict, Hashable, List, Optional
 
-from realhf_tpu.base import constants
+from realhf_tpu.base import constants, logging
+
+logger = logging.getLogger("recover")
+
+#: Schema history -- bump when RecoverInfo grows fields:
+#:   1: recover_start/last_step_info/hash_vals_to_ignore (implicit,
+#:      pre-versioning pickles)
+#:   2: + version, buffer_state (SequenceBuffer in-flight snapshot),
+#:      dataloader_state (epoch accounting)
+RECOVER_INFO_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -23,9 +39,18 @@ class StepInfo:
 
 @dataclasses.dataclass
 class RecoverInfo:
+    version: int = RECOVER_INFO_VERSION
     recover_start: StepInfo = dataclasses.field(default_factory=StepInfo)
     last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
     hash_vals_to_ignore: List[Hashable] = dataclasses.field(default_factory=list)
+    # SequenceBuffer.state_dict() of batches fetched but unfinished at
+    # dump time: their ids are deliberately NOT in hash_vals_to_ignore
+    # (the relaunched trial refetches them); the snapshot preserves
+    # batch-id monotonicity and exposes what was in flight.
+    buffer_state: Optional[Dict[str, Any]] = None
+    # dataloader epoch accounting: {"epoch", "epoch_step",
+    # "epochs_fetched"} -- whichever the dumping runtime tracks.
+    dataloader_state: Optional[Dict[str, Any]] = None
 
 
 def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
@@ -34,17 +59,67 @@ def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> 
 
 def dump(info: RecoverInfo, experiment: Optional[str] = None,
          trial: Optional[str] = None):
+    """Atomic versioned dump: a crash mid-write must never leave a
+    torn file where the previous valid one stood."""
     path = dump_path(experiment, trial)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def _upgrade(info: RecoverInfo) -> RecoverInfo:
+    """Fill fields missing from older-schema pickles (pickle restores
+    __dict__ verbatim, so v1 instances lack the v2 attributes)."""
+    # NB: membership in __dict__, not hasattr -- dataclass simple
+    # defaults exist as CLASS attributes, so hasattr is always True
+    had_version = "version" in info.__dict__
+    for f in dataclasses.fields(RecoverInfo):
+        if f.name not in info.__dict__:
+            default = (f.default_factory() if f.default_factory
+                       is not dataclasses.MISSING else f.default)
+            setattr(info, f.name, default)
+    if not had_version:
+        info.version = 1
+    return info
 
 
 def load(experiment: Optional[str] = None,
          trial: Optional[str] = None) -> RecoverInfo:
+    """Strict load: raises on missing/corrupt files. Prefer
+    ``load_safe`` in resume paths."""
     with open(dump_path(experiment, trial), "rb") as f:
-        return pickle.load(f)
+        info = pickle.load(f)
+    if not isinstance(info, RecoverInfo):
+        raise ValueError(f"recover_info.pkl holds {type(info)!r}, "
+                         "not RecoverInfo")
+    return _upgrade(info)
+
+
+def load_safe(experiment: Optional[str] = None,
+              trial: Optional[str] = None) -> Optional[RecoverInfo]:
+    """Tolerant load for resume: None (-> fresh start) when the file
+    is absent, truncated, corrupt, of an unknown future schema, or
+    not a RecoverInfo at all. A bad recover file must downgrade the
+    restart, never kill it."""
+    path = dump_path(experiment, trial)
+    if not os.path.isfile(path):
+        return None
+    try:
+        info = load(experiment, trial)
+    except Exception as e:  # noqa: BLE001 - any corruption -> fresh
+        logger.warning("Ignoring unreadable recover info at %s (%s); "
+                       "starting fresh.", path, e)
+        return None
+    if info.version > RECOVER_INFO_VERSION:
+        logger.warning(
+            "Recover info at %s has schema v%d > supported v%d "
+            "(written by newer code); starting fresh.", path,
+            info.version, RECOVER_INFO_VERSION)
+        return None
+    return info
 
 
 def exists(experiment: Optional[str] = None, trial: Optional[str] = None) -> bool:

@@ -1,0 +1,70 @@
+"""Retry with exponential backoff + jitter for control-plane calls.
+
+The distributed runtime retries transient control-plane failures
+(reply timeouts, scheduler submission hiccups) instead of dying on the
+first one. Policies are small value objects so every call site can
+tune attempts/delays independently; randomness and sleeping are
+injectable for deterministic tests.
+"""
+
+import dataclasses
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("retry")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: delay_i = min(base * factor**i, max_delay),
+    plus uniform jitter in [0, jitter * delay_i] so a fleet of
+    retriers never thunders in lockstep."""
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    factor: float = 2.0
+    max_delay: float = 30.0
+    jitter: float = 0.5
+
+
+def backoff_delays(policy: RetryPolicy,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """Delays to sleep BETWEEN attempts (max_attempts - 1 of them)."""
+    rng = rng or random
+    for i in range(max(0, policy.max_attempts - 1)):
+        d = min(policy.base_delay * policy.factor ** i, policy.max_delay)
+        yield d + rng.uniform(0.0, policy.jitter * d)
+
+
+def retry_call(fn: Callable, policy: Optional[RetryPolicy] = None,
+               retry_on: Tuple[Type[BaseException], ...] = (TimeoutError,),
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               rng: Optional[random.Random] = None,
+               what: str = ""):
+    """Call ``fn()`` up to ``policy.max_attempts`` times, sleeping a
+    backoff-with-jitter delay between attempts. Only exceptions listed
+    in ``retry_on`` are retried; anything else propagates immediately,
+    as does the final matching failure. ``on_retry(attempt, exc)`` is
+    invoked before each re-attempt (attempt counts from 1)."""
+    policy = policy or RetryPolicy()
+    delays = backoff_delays(policy, rng=rng)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except retry_on as e:
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise e  # attempts exhausted: surface the last failure
+            logger.warning("Retrying %s (attempt %d/%d) after %s; "
+                           "sleeping %.2fs.", what or getattr(
+                               fn, "__name__", "call"), attempt,
+                           policy.max_attempts, e, delay)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)

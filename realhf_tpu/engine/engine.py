@@ -155,8 +155,14 @@ class Engine:
             # emulation (CI wiring coverage).
             if os.environ.get("REALHF_TPU_FUSED_RING") == "1":
                 from realhf_tpu.ops.ring_attention_fused import (
+                    FUSED_RING_SUPPORTED,
+                    FUSED_RING_UNSUPPORTED_REASON,
                     ring_attention_fused,
                 )
+                if not FUSED_RING_SUPPORTED:
+                    raise RuntimeError(
+                        "REALHF_TPU_FUSED_RING=1 requested but "
+                        f"unavailable: {FUSED_RING_UNSUPPORTED_REASON}")
                 interp = jax.default_backend() != "tpu"
 
                 def _ring_fused(q, k, v, seg, causal=True, scale=None,

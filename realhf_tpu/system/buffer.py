@@ -13,7 +13,6 @@ reference's core throughput claim).
 """
 
 import dataclasses
-import itertools
 from typing import Dict, List, Optional, Set
 
 from realhf_tpu.api.data import SequenceSample
@@ -41,7 +40,7 @@ class SequenceBuffer:
         self._mfcs = list(mfc_names)
         self.capacity = capacity
         self._entries: Dict[int, BufferEntry] = {}
-        self._next_id = itertools.count()
+        self._next_id = 0
 
     def __len__(self):
         return len(self._entries)
@@ -52,7 +51,8 @@ class SequenceBuffer:
 
     def put_batch(self, meta: SequenceSample, owner: str, epoch: int,
                   is_epoch_last: bool) -> int:
-        bid = next(self._next_id)
+        bid = self._next_id
+        self._next_id += 1
         self._entries[bid] = BufferEntry(
             batch_id=bid, meta=meta,
             key_owner={k: owner for k in meta.keys},
@@ -87,8 +87,58 @@ class SequenceBuffer:
     def mark_dispatched(self, batch_id: int, mfc_name: str):
         self._entries[batch_id].dispatched.add(mfc_name)
 
+    def mark_undispatched(self, batch_id: int, mfc_name: str):
+        """Requeue an in-flight MFC (its worker was lost before
+        replying): ready_mfcs offers it again once its group is
+        eligible. No-op for completed MFCs."""
+        e = self._entries.get(batch_id)
+        if e is not None and mfc_name not in e.completed:
+            e.dispatched.discard(mfc_name)
+
     def get(self, batch_id: int) -> BufferEntry:
         return self._entries[batch_id]
+
+    def batch_ids(self) -> List[int]:
+        return sorted(self._entries)
+
+    @property
+    def next_batch_id(self) -> int:
+        """The id the next put_batch will assign (the watermark a
+        resumed master restores)."""
+        return self._next_id
+
+    # -- crash-recovery snapshot ----------------------------------------
+    def state_dict(self) -> Dict:
+        """Picklable in-flight snapshot for RecoverInfo. Dispatch
+        state is intentionally NOT saved: after a crash every
+        uncompleted MFC must re-dispatch, and the data-plane tensors
+        behind these entries died with the workers anyway -- the
+        snapshot records identity/accounting (ids, completion, epoch
+        position, batch-id watermark), not payloads."""
+        return {
+            "next_id": self._next_id,
+            "entries": [
+                dict(batch_id=e.batch_id, meta=e.meta,
+                     key_owner=dict(e.key_owner),
+                     completed=sorted(e.completed), epoch=e.epoch,
+                     is_epoch_last=e.is_epoch_last)
+                for bid, e in sorted(self._entries.items())
+            ],
+        }
+
+    def load_state_dict(self, state: Dict):
+        """Restore a snapshot. Uncompleted MFCs come back
+        undispatched (they re-run); the batch-id counter resumes past
+        the watermark so ids stay monotonic across restarts."""
+        self._entries = {}
+        for d in state.get("entries", ()):
+            self._entries[d["batch_id"]] = BufferEntry(
+                batch_id=d["batch_id"], meta=d["meta"],
+                key_owner=dict(d["key_owner"]),
+                dispatched=set(d["completed"]),
+                completed=set(d["completed"]),
+                epoch=d["epoch"], is_epoch_last=d["is_epoch_last"])
+        self._next_id = int(state.get("next_id", 0))
 
     def pop_finished(self) -> List[BufferEntry]:
         """Remove and return entries every MFC has completed."""

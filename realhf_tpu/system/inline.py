@@ -45,9 +45,13 @@ class InlineRunner:
         # redirect trainable models to their latest checkpoints.
         self.recover_mode = recover_mode
         self._recover_info = None
-        if recover_mode == "resume" and recover.exists():
-            self._recover_info = recover.load()
-            logger.info("Resuming from recover info: %s",
+        if recover_mode == "resume":
+            # load_safe: a corrupt/truncated/future-schema file means
+            # a fresh start, not a crash loop
+            self._recover_info = recover.load_safe()
+        if self._recover_info is not None:
+            logger.info("Resuming from recover info (schema v%d): %s",
+                        self._recover_info.version,
                         self._recover_info.recover_start)
             for role, mspec in spec.models.items():
                 ckpt = os.path.join(constants.run_save_path(), role)
@@ -93,11 +97,18 @@ class InlineRunner:
             freq_sec=None)
         self.global_step = 0
         self._start_epoch = 0
+        self._start_epoch_step = 0
         self._ids_to_skip = set()
         if self._recover_info is not None:
             self.global_step = self._recover_info.last_step_info.global_step
             self._start_epoch = self._recover_info.recover_start.epoch
             self._ids_to_skip = set(self._recover_info.hash_vals_to_ignore)
+            # dataloader epoch state (schema v2): resume epoch-step
+            # accounting mid-epoch so save/eval frequency control and
+            # logs line up with the interrupted run (consumed-id
+            # skipping already prevents data re-consumption)
+            dl = self._recover_info.dataloader_state or {}
+            self._start_epoch_step = int(dl.get("epoch_step", 0))
 
     # -- compat accessors (tests + callers use these) -------------------
     @property
@@ -164,7 +175,10 @@ class InlineRunner:
                     epoch=self._cur_epoch,
                     epoch_step=self._cur_epoch_step,
                     global_step=self.global_step),
-                hash_vals_to_ignore=list(self._consumed_ids)))
+                hash_vals_to_ignore=list(self._consumed_ids),
+                dataloader_state=dict(
+                    epoch=self._cur_epoch,
+                    epoch_step=self._cur_epoch_step)))
 
     def _maybe_eval(self, epochs: int = 0, steps: int = 0):
         if self.eval_dataloader is None:
@@ -186,7 +200,7 @@ class InlineRunner:
         done = False
         self._consumed_ids = list(self._ids_to_skip)
         self._cur_epoch = self._start_epoch
-        self._cur_epoch_step = 0
+        self._cur_epoch_step = self._start_epoch_step
         for epoch in range(self._start_epoch, spec.total_train_epochs):
             self._cur_epoch = epoch
             for step, batch in enumerate(self.dataloader):

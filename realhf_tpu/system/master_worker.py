@@ -21,6 +21,7 @@ from typing import Dict
 
 from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import DFG
+from realhf_tpu.api.experiment import FaultToleranceConfig
 from realhf_tpu.base import (
     constants,
     logging,
@@ -29,9 +30,15 @@ from realhf_tpu.base import (
     recover,
     timeutil,
 )
+from realhf_tpu.base.retry import RetryPolicy, retry_call
 from realhf_tpu.system import worker_base
 from realhf_tpu.system.buffer import SequenceBuffer
 from realhf_tpu.system.request_reply_stream import NameResolvingRequestClient
+from realhf_tpu.system.watchdog import (
+    ExclusionBook,
+    Watchdog,
+    WorkerLostError,
+)
 
 logger = logging.getLogger("master_worker", "benchmark")
 
@@ -105,14 +112,42 @@ class MasterWorker(worker_base.Worker):
         self.global_step = 0
         self._start_epoch = 0
         self._ids_to_skip = set()
-        if self.recover_mode == "resume" and recover.exists():
-            info = recover.load()
-            self.global_step = info.last_step_info.global_step
-            self._start_epoch = info.recover_start.epoch
-            self._ids_to_skip = set(info.hash_vals_to_ignore)
-            logger.info("Master resuming at global step %d (epoch %d, "
-                        "%d consumed ids).", self.global_step,
-                        self._start_epoch, len(self._ids_to_skip))
+        if self.recover_mode == "resume":
+            # tolerant load: a corrupt/truncated/future-schema file
+            # degrades to a fresh start, never a crash loop
+            info = recover.load_safe()
+            if info is not None:
+                self.global_step = info.last_step_info.global_step
+                self._start_epoch = info.recover_start.epoch
+                self._ids_to_skip = set(info.hash_vals_to_ignore)
+                if info.buffer_state:
+                    # restore only the batch-id watermark: the
+                    # in-flight entries' tensors died with the old
+                    # workers, and their ids are absent from
+                    # hash_vals_to_ignore so the data refetches
+                    self.buffer.load_state_dict(dict(
+                        info.buffer_state, entries=[]))
+                logger.info(
+                    "Master resuming at global step %d (epoch %d, %d "
+                    "consumed ids, %d batches were in flight, recover "
+                    "schema v%d).", self.global_step, self._start_epoch,
+                    len(self._ids_to_skip),
+                    len((info.buffer_state or {}).get("entries", ())),
+                    info.version)
+
+        # fault tolerance: heartbeat watchdog over the worker fleet,
+        # excluded-workers bookkeeping, per-MFC requeue accounting
+        self.ft = getattr(spec, "ft", None) or FaultToleranceConfig()
+        self.watchdog = Watchdog(
+            spec.experiment_name, spec.trial_name, self.all_workers,
+            timeout=self.ft.heartbeat_timeout,
+            grace=self.ft.startup_grace_secs,
+            poll_interval=self.ft.watchdog_poll_secs)
+        self._exclusions = ExclusionBook(
+            base=self.ft.exclude_base_secs,
+            max_delay=self.ft.exclude_max_secs)
+        self._mfc_requeues: Dict[tuple, int] = {}  # (bid, mfc) -> count
+        self._fetch_requeues = 0
 
         # runtime state
         self._subscribed = False
@@ -135,7 +170,12 @@ class MasterWorker(worker_base.Worker):
         # batch_id -> highest batch whose train MFCs finished, per role
         self._train_done_upto: Dict[str, Dict[int, set]] = {
             role: {} for role in self.train_nodes_of_role}
-        self._min_live_bid = 0
+        # On resume the live window starts at the restored batch-id
+        # watermark: every pre-crash bid is finished or refetched
+        # under a NEW bid, so the staleness guard must never wait on
+        # one (it would deadlock the resumed trial).
+        self._min_live_bid = min(self.buffer.batch_ids()
+                                 + [self.buffer.next_batch_id])
         # cross-group param sync bookkeeping: how often each role has
         # trained, and the last version the primary group was asked to
         # publish (keyed per ROLE -- the blob is per-role, so N cross
@@ -168,9 +208,101 @@ class MasterWorker(worker_base.Worker):
 
     def _dispatchable(self, bid: int, mfc_name: str) -> bool:
         node = self.dfg.find(mfc_name)
+        if not self._workers_eligible(self.node_workers[mfc_name]):
+            return False
         if node.role in self.train_nodes_of_role:
             return self._train_caught_up(bid, node.role)
         return True
+
+    # -- fault tolerance -----------------------------------------------
+    def _workers_eligible(self, workers) -> bool:
+        """Dispatch gate: every addressed worker must be live and out
+        of its exclusion window (a flapping worker is not re-picked
+        until its backoff expires)."""
+        return all(not self._exclusions.is_excluded(w)
+                   and w not in self.watchdog.lost_workers()
+                   for w in workers)
+
+    def _check_liveness(self):
+        """Run the watchdog (rate-limited); requeue or fail work
+        attributed to newly lost workers; enforce the fatal deadline
+        for workers that stay lost."""
+        for w in self.watchdog.poll():
+            self._on_worker_lost(w)
+        fatal = self.watchdog.lost_longer_than(
+            self.ft.worker_lost_fatal_secs)
+        if fatal:
+            raise WorkerLostError(
+                fatal, inflight=self._work_attributed_to(fatal),
+                detail="Lost longer than worker_lost_fatal_secs="
+                       f"{self.ft.worker_lost_fatal_secs:.0f}s; "
+                       "failing the trial for relaunch-level recovery.")
+
+    def _work_attributed_to(self, workers) -> list:
+        """MFC names in flight on, or queued for, any of ``workers``
+        (for attributed error messages)."""
+        ws = set(workers)
+        out = {f"{mfc}@batch{bid}"
+               for bid, mfc, w, kind in self._inflight.values()
+               if w in ws and mfc is not None}
+        for bid in self.buffer.batch_ids():
+            e = self.buffer.get(bid)
+            for m in self._mfcs_pending(e):
+                if ws & set(self.node_workers[m]):
+                    out.add(f"{m}@batch{bid}")
+        return sorted(out)
+
+    def _mfcs_pending(self, entry) -> list:
+        return [n.name for n in self.dfg.nodes
+                if n.name not in entry.completed]
+
+    def _on_worker_lost(self, worker: str):
+        """A worker's heartbeat expired: exclude it with backoff,
+        drop its in-flight requests, and requeue the affected MFCs
+        (bounded by ft.max_mfc_retries) so a flap heals without
+        failing the trial; exhausted retries raise a WorkerLostError
+        naming the worker and the MFC."""
+        self._exclusions.exclude(worker)
+        lost_refs = [(rid, ref) for rid, ref in self._inflight.items()
+                     if ref[2] == worker]
+        for rid, (bid, mfc_name, _w, kind) in lost_refs:
+            self._inflight.pop(rid, None)
+            self.stream.discard([rid])
+            if kind in ("leader", "member"):
+                # drop the sibling requests of the same dispatch too:
+                # surviving members' late replies fall through the
+                # unknown-rid path harmlessly, and the whole MFC
+                # re-dispatches as one group
+                siblings = [r for r, ref in list(self._inflight.items())
+                            if ref[0] == bid and ref[1] == mfc_name]
+                for r in siblings:
+                    self._inflight.pop(r, None)
+                self.stream.discard(siblings)
+                n = self._mfc_requeues.get((bid, mfc_name), 0) + 1
+                self._mfc_requeues[(bid, mfc_name)] = n
+                if n > self.ft.max_mfc_retries:
+                    raise WorkerLostError(
+                        worker, inflight=[f"{mfc_name}@batch{bid}"],
+                        detail=f"MFC {mfc_name} (batch {bid}) already "
+                               f"requeued {n - 1}x; giving up.")
+                self.buffer.mark_undispatched(bid, mfc_name)
+                logger.warning(
+                    "Requeued MFC %s (batch %d) after losing worker "
+                    "%s (attempt %d/%d).", mfc_name, bid, worker, n,
+                    self.ft.max_mfc_retries)
+            elif kind == "fetch":
+                self._fetch_requeues += 1
+                if self._fetch_requeues > self.ft.max_mfc_retries:
+                    raise WorkerLostError(
+                        worker, inflight=["fetch_data"],
+                        detail="Data owner lost; fetch already "
+                               f"requeued {self._fetch_requeues - 1}x.")
+                self._fetch_inflight = False
+                logger.warning("Requeued fetch_data after losing data "
+                               "owner %s.", worker)
+            else:  # clear / sync: best-effort, drop silently
+                logger.warning("Dropped in-flight %s request to lost "
+                               "worker %s.", kind, worker)
 
     def _dispatch_mfc(self, bid: int, mfc_name: str):
         e = self.buffer.get(bid)
@@ -265,6 +397,9 @@ class MasterWorker(worker_base.Worker):
     def _finish_batches(self):
         for e in self.buffer.pop_finished():
             self._min_live_bid = max(self._min_live_bid, e.batch_id + 1)
+            self._mfc_requeues = {k: v for k, v in
+                                  self._mfc_requeues.items()
+                                  if k[0] != e.batch_id}
             self.global_step += 1
             self._cur_epoch = e.epoch
             self._consumed_ids.extend(e.ids)
@@ -342,11 +477,11 @@ class MasterWorker(worker_base.Worker):
                 for w in self.node_workers[m]:
                     by_worker.setdefault(w, []).append(m)
             # post ALL save requests first, then gather: workers
-            # checkpoint concurrently instead of one at a time
-            rids = [self.stream.request(
-                [w], "save", datas=[dict(nodes=nodes)])[0]
-                for w, nodes in by_worker.items()]
-            self.stream.gather_replies(rids, timeout=600)
+            # checkpoint concurrently instead of one at a time.
+            # Retried with backoff (save is idempotent); each attempt
+            # is liveness-checked so a dead worker aborts it within
+            # the heartbeat timeout, not after gather_timeout_secs.
+            self._request_gather_with_retry("save", by_worker)
             if self.recover_mode != "disabled":
                 recover.dump(recover.RecoverInfo(
                     recover_start=recover.StepInfo(
@@ -355,19 +490,48 @@ class MasterWorker(worker_base.Worker):
                     last_step_info=recover.StepInfo(
                         epoch=self._cur_epoch, epoch_step=0,
                         global_step=self.global_step),
-                    hash_vals_to_ignore=list(self._consumed_ids)))
+                    hash_vals_to_ignore=list(self._consumed_ids),
+                    buffer_state=self.buffer.state_dict(),
+                    dataloader_state=dict(
+                        epoch=self._cur_epoch,
+                        epochs_fetched=self._epochs_fetched)))
         if self.spec.eval_dataset is not None and not force and \
                 self.eval_ctl.check(epochs=epochs, steps=1):
             by_worker = {}
             for m in train_nodes:
                 for w in self.node_workers[m]:
                     by_worker.setdefault(w, []).append(m)
-            rids = [self.stream.request(
-                [w], "evaluate", datas=[dict(nodes=nodes)])[0]
-                for w, nodes in by_worker.items()]
-            for p in self.stream.gather_replies(rids, timeout=600):
+            for p in self._request_gather_with_retry("evaluate",
+                                                     by_worker):
                 if p.data:
                     logger.info("Eval results: %s", p.data)
+
+    def _request_gather_with_retry(self, handle: str,
+                                   by_worker: Dict[str, list]):
+        """Dispatch ``handle`` to each worker and gather, retrying
+        the whole round with exponential backoff + jitter on reply
+        timeout (control-plane retry policy; WorkerLostError is never
+        retried -- a dead worker needs relaunch-level recovery)."""
+
+        def attempt():
+            rids = [self.stream.request(
+                [w], handle, datas=[dict(nodes=nodes)])[0]
+                for w, nodes in by_worker.items()]
+            try:
+                return self.stream.gather_replies(
+                    rids, timeout=self.ft.gather_timeout_secs,
+                    check_liveness=lambda: self.watchdog.raise_if_lost(
+                        by_worker,
+                        inflight=[f"{handle}:{sorted(ns)}"
+                                  for ns in by_worker.values()]))
+            finally:
+                self.stream.discard(rids)
+
+        return retry_call(
+            attempt,
+            RetryPolicy(max_attempts=max(1, self.ft.gather_retries),
+                        base_delay=1.0),
+            retry_on=(TimeoutError,), what=f"{handle} gather")
 
     # ------------------------------------------------------------------
     def _poll(self) -> worker_base.PollResult:
@@ -375,15 +539,25 @@ class MasterWorker(worker_base.Worker):
             time.sleep(0.05)
             return worker_base.PollResult(0, 0)
         if not self._subscribed:
-            self.stream.wait_subscribers(self.all_workers, timeout=300)
+            # liveness-checked: a worker that died during configure
+            # aborts the wait promptly with attribution instead of
+            # after the full 300 s
+            self.stream.wait_subscribers(
+                self.all_workers, timeout=300,
+                check_liveness=self.watchdog.raise_if_lost)
             self._subscribed = True
             self._publish_status("running")
             self._step_t0 = time.monotonic()
 
+        # 0. watchdog: requeue/fail work on lost workers (rate-limited
+        # internally, so this is cheap every iteration)
+        self._check_liveness()
+
         n = 0
         # 1. keep the buffer fed
         if (self.buffer.has_space and not self._fetch_inflight
-                and not self._done_fetching):
+                and not self._done_fetching
+                and self._workers_eligible([self.data_owner])):
             self._dispatch_fetch()
             n += 1
 

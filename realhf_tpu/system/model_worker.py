@@ -17,6 +17,7 @@ clear_data_cache / offload.
 import os
 import pickle
 import queue
+import time
 from typing import Dict
 
 from realhf_tpu.api import data as data_api
@@ -29,6 +30,7 @@ from realhf_tpu.base import (
     names,
     seeding,
 )
+from realhf_tpu.base.fault_injection import FaultInjected, FaultInjector
 from realhf_tpu.system import worker_base
 from realhf_tpu.system.data_plane import DataClient, DataServer, DataStore
 from realhf_tpu.system.model_host import ModelHost
@@ -192,6 +194,10 @@ class ModelWorker(worker_base.Worker):
         self.data_server.start()
         self.data_client = DataClient(spec.experiment_name,
                                       spec.trial_name)
+
+        # deterministic fault injection (REALHF_TPU_FAULTS), used by
+        # the fault-tolerance tier-1 tests; None in production
+        self.faults = FaultInjector.from_env()
 
         self.stream = NameResolvingReplyServer(
             spec.experiment_name, spec.trial_name, self.worker_name)
@@ -489,9 +495,41 @@ class ModelWorker(worker_base.Worker):
             n += 1
         return worker_base.PollResult(n, n)
 
+    def _apply_fault(self, req: Payload) -> bool:
+        """Execute any injected fault for this request. Returns True
+        when the reply must be suppressed (drop_reply)."""
+        if self.faults is None:
+            return False
+        fault = self.faults.on_event(self.worker_name, req.handle_name)
+        if fault is None:
+            return False
+        if fault.kind == "die":
+            # emulate a silent machine/process loss: no error reply,
+            # no ERROR status, heartbeat just stops -- only the
+            # watchdog can notice
+            logger.error("Fault injection: hard-exiting %s now.",
+                         self.worker_name)
+            os._exit(17)
+        if fault.kind == "crash":
+            raise FaultInjected(
+                f"injected crash in {self.worker_name} handling "
+                f"{req.handle_name} ({fault.fault_id})")
+        if fault.kind == "delay_reply":
+            logger.warning("Fault injection: delaying %s reply by "
+                           "%.1fs.", req.handle_name, fault.seconds)
+            time.sleep(fault.seconds)
+            return False
+        return fault.kind == "drop_reply"
+
     def _handle_request(self, req: Payload):
         handle = req.handle_name
         try:
+            if self._apply_fault(req):
+                # drop_reply: execute nothing and never respond --
+                # the master sees pure silence on this request id
+                logger.warning("Fault injection: dropping reply for "
+                               "%s (%s).", handle, req.request_id)
+                return
             if handle == "fetch_data":
                 self._handle_fetch_data(req)
             elif handle in ("generate", "inference", "train_step"):

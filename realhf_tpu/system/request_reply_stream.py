@@ -28,6 +28,24 @@ logger = logging.getLogger("request_reply_stream")
 PUBSUB_BARRIER_NAME = "__pubsub_barrier__"
 
 
+class ReplyTimeoutError(TimeoutError):
+    """gather_replies timed out: names the handlers that never replied
+    and the request ids still outstanding (satellite of the
+    fault-tolerance work: a bare TimeoutError after 600 s gave the
+    operator nothing to act on)."""
+
+    def __init__(self, missing: Dict[str, tuple], timeout: float):
+        #: request_id -> (handler, handle_name)
+        self.missing = dict(missing)
+        self.handlers = sorted({h for h, _ in missing.values()})
+        self.request_ids = sorted(missing)
+        handles = sorted({hn for _, hn in missing.values() if hn})
+        super().__init__(
+            f"No reply within {timeout:.1f}s from handlers "
+            f"{self.handlers} (requests {handles or '?'}); outstanding "
+            f"request ids: {self.request_ids}.")
+
+
 @dataclasses.dataclass
 class Payload:
     """One control-plane message (reference Payload:33)."""
@@ -50,6 +68,10 @@ class NameResolvingRequestClient:
     def __init__(self, experiment_name: str, trial_name: str,
                  stream_name: str = "master"):
         self._reply_backlog = collections.deque()
+        # request_id -> (handler, handle_name) of every request()ed
+        # payload still awaiting its reply; lets timeouts name who is
+        # silent. Entries clear on reply arrival or discard().
+        self._outstanding: Dict[str, tuple] = {}
         self._ctx = zmq.Context.instance()
         self._pub = self._ctx.socket(zmq.PUB)
         host = network.gethostip()
@@ -65,12 +87,17 @@ class NameResolvingRequestClient:
         logger.info("Request client bound pub=%s pull=%s", pub_port,
                     pull_port)
 
-    def wait_subscribers(self, handlers: List[str], timeout: float = 60.0):
+    def wait_subscribers(self, handlers: List[str], timeout: float = 60.0,
+                         check_liveness: Optional[callable] = None):
         """ZMQ PUB drops messages sent before SUB connects; workers ack
-        a barrier message until all confirm (the pubsub barrier)."""
+        a barrier message until all confirm (the pubsub barrier).
+        ``check_liveness`` may raise to abort the wait early when a
+        pending worker is known dead."""
         pending = set(handlers)
         deadline = time.monotonic() + timeout
         while pending:
+            if check_liveness is not None:
+                check_liveness()
             for h in list(pending):
                 self.post(Payload(handler=h,
                                   handle_name=PUBSUB_BARRIER_NAME))
@@ -81,7 +108,7 @@ class NameResolvingRequestClient:
                     pending.discard(p.handler)
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"Subscribers never connected: {pending}")
+                    f"Subscribers never connected: {sorted(pending)}")
 
     def post(self, payload: Payload) -> str:
         # NUL-terminated topic: ZMQ SUB matches by prefix, so a bare
@@ -89,6 +116,23 @@ class NameResolvingRequestClient:
         self._pub.send_multipart([
             payload.handler.encode() + b"\0", pickle.dumps(payload)])
         return payload.request_id
+
+    def _recv(self) -> Payload:
+        p: Payload = pickle.loads(self._pull.recv())
+        self._outstanding.pop(p.request_id, None)
+        return p
+
+    def discard(self, request_ids: List[str]):
+        """Forget outstanding requests whose replies will never come
+        (their worker was declared LOST); late replies still drain
+        harmlessly through poll paths."""
+        for r in request_ids:
+            self._outstanding.pop(r, None)
+
+    def outstanding_handlers(self, request_ids: List[str]) -> List[str]:
+        """Handlers still owing replies among ``request_ids``."""
+        return sorted({self._outstanding[r][0] for r in request_ids
+                       if r in self._outstanding})
 
     def request(self, handlers: List[str], handle_name: str,
                 datas: Optional[List[Any]] = None,
@@ -106,6 +150,7 @@ class NameResolvingRequestClient:
             for h, d in zip(handlers, datas)
         ]
         for p in payloads:
+            self._outstanding[p.request_id] = (p.handler, p.handle_name)
             self.post(p)
         if not no_syn:
             want = {p.syn_reply_id: p.handler for p in payloads}
@@ -131,7 +176,7 @@ class NameResolvingRequestClient:
         if timeout is not None:
             if not self._pull.poll(timeout * 1000):
                 raise TimeoutError("No reply within timeout.")
-        return pickle.loads(self._pull.recv())
+        return self._recv()
 
     def poll_batch(self, timeout: float = 0.0) -> List[Payload]:
         """All immediately-available replies; `timeout` bounds the wait
@@ -139,17 +184,25 @@ class NameResolvingRequestClient:
         out = list(self._reply_backlog)
         self._reply_backlog.clear()
         if self._pull.poll(0 if out else timeout * 1000):
-            out.append(pickle.loads(self._pull.recv()))
+            out.append(self._recv())
             while self._pull.poll(0):
-                out.append(pickle.loads(self._pull.recv()))
+                out.append(self._recv())
         return out
 
     def gather_replies(self, request_ids: List[str],
-                       timeout: float = 600.0) -> List[Payload]:
+                       timeout: float = 600.0,
+                       check_liveness: Optional[callable] = None
+                       ) -> List[Payload]:
         """Blocking gather of specific replies. Replies to OTHER
         requests arriving meanwhile are buffered for later
         poll/poll_batch calls, never dropped (the master interleaves
         blocking save/eval gathers with in-flight MFC replies).
+
+        ``check_liveness`` (optional) runs ~every 100 ms of waiting
+        and may raise (e.g. ``Watchdog.raise_if_lost``): a dead worker
+        then fails the gather within the heartbeat timeout instead of
+        after the full ``timeout``. On expiry raises
+        :class:`ReplyTimeoutError` naming the silent handlers.
 
         Reads the SOCKET directly -- going through poll() would
         re-consume the very payloads this method just backlogged and
@@ -164,14 +217,17 @@ class NameResolvingRequestClient:
         deadline = time.monotonic() + timeout
         while len(got) < len(request_ids):
             remaining = deadline - time.monotonic()
+            missing = {r: self._outstanding.get(r, ("<unknown>", ""))
+                       for r in request_ids if r not in got}
             if remaining <= 0:
                 # checked every iteration: steady unrelated traffic
                 # must not postpone the timeout indefinitely
-                missing = [r for r in request_ids if r not in got]
-                raise TimeoutError(f"No reply for requests {missing}.")
+                raise ReplyTimeoutError(missing, timeout)
+            if check_liveness is not None:
+                check_liveness()
             if not self._pull.poll(min(remaining, 0.1) * 1000):
                 continue
-            p: Payload = pickle.loads(self._pull.recv())
+            p = self._recv()
             if p.request_id in request_ids:
                 got[p.request_id] = p
             else:

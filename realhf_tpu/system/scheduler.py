@@ -18,6 +18,7 @@ import time
 from typing import Dict, List, Optional
 
 from realhf_tpu.base import logging
+from realhf_tpu.base.retry import RetryPolicy, retry_call
 
 logger = logging.getLogger("scheduler")
 
@@ -29,6 +30,10 @@ class JobState(str, enum.Enum):
     COMPLETED = "COMPLETED"
     FAILED = "FAILED"
     CANCELLED = "CANCELLED"
+    # watchdog verdict: the process may still exist but its heartbeat
+    # expired (hung or on a dead host) -- treated like FAILED by the
+    # launcher's auto-recover loop
+    LOST = "LOST"
 
 
 @dataclasses.dataclass
@@ -80,14 +85,31 @@ class LocalSchedulerClient(SchedulerClient):
 
     def __init__(self):
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._specs: Dict[str, tuple] = {}  # name -> (cmd, env)
 
     def submit(self, name, cmd, env=None):
         full_env = dict(os.environ)
         if env:
             full_env.update(env)
         logger.info("Launching job %s: %s", name, " ".join(cmd))
+        self._specs[name] = (list(cmd), dict(env or {}))
         self._procs[name] = subprocess.Popen(
             cmd, env=full_env, start_new_session=True)
+
+    def resubmit(self, name) -> JobInfo:
+        """Relaunch a dead job under the same name (single-worker
+        recovery primitive: an external supervisor can restart just
+        the lost worker while the rest of the fleet keeps running).
+        Refuses while the old process is still alive."""
+        if name not in self._specs:
+            raise KeyError(f"Job {name} was never submitted.")
+        p = self._procs.get(name)
+        if p is not None and p.poll() is None:
+            raise RuntimeError(f"Job {name} is still running "
+                               f"(pid {p.pid}); not resubmitting.")
+        cmd, env = self._specs[name]
+        self.submit(name, cmd, env)
+        return self.find(name)
 
     def find(self, name) -> JobInfo:
         p = self._procs.get(name)
@@ -166,7 +188,8 @@ class SlurmSchedulerClient(SchedulerClient):
                  partition: str = "", account: str = "",
                  cpus_per_task: int = 8, mem_gb: int = 32,
                  container_image: str = "",
-                 script_dir: Optional[str] = None, runner=None):
+                 script_dir: Optional[str] = None, runner=None,
+                 submit_retry: Optional[RetryPolicy] = None):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
         self.partition = partition
@@ -179,6 +202,10 @@ class SlurmSchedulerClient(SchedulerClient):
         # injectable for tests: (argv) -> stdout string
         self._run = runner or (lambda argv: subprocess.check_output(
             argv, text=True))
+        # sbatch hits transient slurmctld hiccups under load; retry
+        # with backoff instead of failing the whole launch
+        self._submit_retry = submit_retry or RetryPolicy(
+            max_attempts=3, base_delay=1.0, max_delay=15.0)
         self._slurm_ids: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
@@ -218,7 +245,11 @@ class SlurmSchedulerClient(SchedulerClient):
                             name.replace("/", "-") + ".sbatch")
         with open(path, "w") as f:
             f.write(script)
-        out = self._run(["sbatch", "--parsable", path])
+        out = retry_call(
+            lambda: self._run(["sbatch", "--parsable", path]),
+            self._submit_retry,
+            retry_on=(subprocess.SubprocessError, OSError),
+            what=f"sbatch {name}")
         self._slurm_ids[name] = out.strip().split(";")[0]
         logger.info("Submitted slurm job %s (id %s).", name,
                     self._slurm_ids[name])

@@ -9,7 +9,9 @@ statuses for failure detection (reference controller ``wait:275``).
 
 import dataclasses
 import enum
+import os
 import pickle
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -18,6 +20,14 @@ import zmq
 from realhf_tpu.base import logging, name_resolve, names, network
 
 logger = logging.getLogger("worker_base")
+
+#: Heartbeat cadence knobs. Workers read the env (the launcher exports
+#: the experiment's FaultToleranceConfig values before spawning); the
+#: TTL handed to TTL-capable name_resolve backends (redis) is a
+#: multiple of the interval so one missed beat never expires an entry.
+HEARTBEAT_INTERVAL_ENV = "REALHF_TPU_HEARTBEAT_INTERVAL"
+DEFAULT_HEARTBEAT_INTERVAL = 2.0
+HEARTBEAT_TTL_FACTOR = 5.0
 
 
 class WorkerServerStatus(str, enum.Enum):
@@ -40,7 +50,8 @@ class WorkerServer:
     name_resolve; reference WorkerServer:77)."""
 
     def __init__(self, experiment_name: str, trial_name: str,
-                 worker_name: str):
+                 worker_name: str,
+                 heartbeat_interval: Optional[float] = None):
         self.worker_name = worker_name
         self._exp, self._trial = experiment_name, trial_name
         ctx = zmq.Context.instance()
@@ -51,6 +62,44 @@ class WorkerServer:
             names.worker_key(experiment_name, trial_name, worker_name),
             f"tcp://{host}:{port}", replace=True)
         self.set_status(WorkerServerStatus.READY)
+        # liveness beacon: a daemon thread re-publishes a wall-clock
+        # timestamp so the controller-side watchdog (system/watchdog.py)
+        # can attribute silence to a dead/hung worker. A thread (not
+        # the poll loop) keeps beating through long jit compiles and
+        # multi-minute MFC executions.
+        if heartbeat_interval is None:
+            heartbeat_interval = float(os.environ.get(
+                HEARTBEAT_INTERVAL_ENV, DEFAULT_HEARTBEAT_INTERVAL))
+        self._hb_interval = heartbeat_interval
+        self._hb_key = names.worker_heartbeat(experiment_name, trial_name,
+                                              worker_name)
+        self._hb_stop = threading.Event()
+        self.beat()  # visible before the first interval elapses
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"heartbeat[{worker_name}]", daemon=True)
+        self._hb_thread.start()
+
+    def beat(self):
+        """Publish one heartbeat (current wall-clock seconds). Wall
+        clock, not monotonic: the watchdog lives in another process."""
+        try:
+            name_resolve.add(
+                self._hb_key, f"{time.time():.3f}", replace=True,
+                delete_on_exit=False,
+                keepalive_ttl=self._hb_interval * HEARTBEAT_TTL_FACTOR)
+        except Exception as e:  # noqa: BLE001 - next beat retries
+            logger.warning("Heartbeat publish failed for %s: %s",
+                           self.worker_name, e)
+
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(self._hb_interval):
+            self.beat()
+
+    def stop_heartbeat(self):
+        """Stop the beacon (clean exit; terminal status takes over as
+        the liveness signal)."""
+        self._hb_stop.set()
 
     def set_status(self, status: WorkerServerStatus):
         name_resolve.add(
@@ -100,15 +149,30 @@ class WorkerControlPanel:
         """group_request with per-worker kwargs. All requests go out
         before any reply is awaited, so command handlers that form a
         cross-worker barrier (e.g. configure joining a jax.distributed
-        world) complete even when each worker needs different kwargs."""
+        world) complete even when each worker needs different kwargs.
+
+        Failure-aware: a worker whose handler raised replies the
+        exception -- re-raised here with attribution -- and a worker
+        that DIED mid-command (status ERROR) fails the wait promptly
+        instead of hanging out the full timeout."""
         for w, kw in kwargs_by_worker.items():
             self._socks[w].send(pickle.dumps((command, kw or {})))
         out = {}
         for w in kwargs_by_worker:
-            if not self._socks[w].poll(timeout * 1000):
-                raise TimeoutError(f"Worker {w} did not respond to "
-                                   f"`{command}`.")
+            deadline = time.monotonic() + timeout
+            while not self._socks[w].poll(1000):
+                if self.get_worker_status(w) == WorkerServerStatus.ERROR:
+                    raise RuntimeError(
+                        f"Worker {w} died (status ERROR) during "
+                        f"`{command}`.")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"Worker {w} did not respond "
+                                       f"to `{command}`.")
             out[w] = pickle.loads(self._socks[w].recv())
+            if isinstance(out[w], Exception):
+                raise RuntimeError(
+                    f"Worker {w} failed handling `{command}`: "
+                    f"{out[w]!r}") from out[w]
         return out
 
     def get_worker_status(self, worker_name: str) -> WorkerServerStatus:
@@ -184,7 +248,12 @@ class Worker:
                 if self._running:
                     self._poll()
             self._exit_hook()
+            self.server.stop_heartbeat()
             self.server.set_status(WorkerServerStatus.COMPLETED)
         except Exception:
+            # terminal status (not the beacon) is the liveness signal
+            # from here on; the watchdog treats ERROR/COMPLETED as
+            # "accounted for", never LOST
+            self.server.stop_heartbeat()
             self.server.set_status(WorkerServerStatus.ERROR)
             raise

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Fail fast on pytest import/collection errors.
+
+A broken import used to shrink the tier-1 suite silently: pytest
+``--continue-on-collection-errors`` keeps running the tests that DID
+collect, so a module-level ImportError quietly removes a whole file
+from coverage. This gate runs ``pytest --collect-only`` and exits
+non-zero -- printing the offending modules -- whenever anything fails
+to collect.
+
+Usage::
+
+    python scripts/check_collect.py [pytest-args...]   # default: tests/
+
+Run it as a CI pre-step before the real suite (or any time after
+touching imports).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+
+def check_collection(args=None, cwd=None):
+    """Returns (ok: bool, report: str). Pure-ish for unit testing."""
+    argv = [
+        sys.executable, "-m", "pytest", "--collect-only", "-q",
+        "--continue-on-collection-errors", "-p", "no:cacheprovider",
+        *(args or ["tests/"]),
+    ]
+    proc = subprocess.run(argv, capture_output=True, text=True, cwd=cwd)
+    out = proc.stdout + proc.stderr
+    # "ERROR tests/foo.py" in the short summary + the "N errors" tally
+    errors = sorted({m.group(1) for m in re.finditer(
+        r"^ERROR[: ]+(\S+)", out, re.MULTILINE)})
+    tally = re.search(r"(\d+) errors?\b", out)
+    n_collected = re.search(r"(\d+) tests? collected", out)
+    if errors or (tally and int(tally.group(1)) > 0):
+        lines = ["Collection FAILED for:"]
+        lines += [f"  {e}" for e in errors] or ["  (see pytest output)"]
+        if n_collected:
+            lines.append(f"({n_collected.group(1)} tests still "
+                         "collected elsewhere)")
+        return False, "\n".join(lines)
+    if proc.returncode not in (0, 5):  # 5 = no tests collected match
+        return False, (f"pytest --collect-only exited {proc.returncode}"
+                       f":\n{out[-2000:]}")
+    return True, (f"Collection OK "
+                  f"({n_collected.group(1) if n_collected else '?'} "
+                  "tests).")
+
+
+def main():
+    ok, report = check_collection(sys.argv[1:] or None,
+                                  cwd=os.getcwd())
+    print(report)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""The CI collection gate: a broken import must fail the check, not
+silently shrink the suite."""
+
+import importlib.util
+import os
+import textwrap
+
+
+def _load_check_collect():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "check_collect.py")
+    spec = importlib.util.spec_from_file_location("check_collect", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_detects_import_error(tmp_path):
+    mod = _load_check_collect()
+    d = tmp_path / "suite"
+    d.mkdir()
+    (d / "test_good.py").write_text("def test_ok():\n    assert True\n")
+    (d / "test_broken.py").write_text(textwrap.dedent("""
+        import definitely_not_a_module_xyz  # noqa: F401
+
+        def test_never_collects():
+            assert True
+    """))
+    ok, report = mod.check_collection([str(d)], cwd=str(tmp_path))
+    assert not ok
+    assert "test_broken.py" in report
+
+
+def test_passes_clean_suite(tmp_path):
+    mod = _load_check_collect()
+    d = tmp_path / "suite"
+    d.mkdir()
+    (d / "test_good.py").write_text("def test_ok():\n    assert True\n")
+    ok, report = mod.check_collection([str(d)], cwd=str(tmp_path))
+    assert ok, report
+    assert "1 tests" in report or "OK" in report

@@ -1,0 +1,75 @@
+"""Deterministic fault-injection harness: parsing, counting, once
+semantics, and cross-relaunch state persistence."""
+
+import pytest
+
+from realhf_tpu.base.fault_injection import (
+    FaultInjector,
+    FaultSpec,
+    parse_faults,
+)
+
+
+def test_parse_multi_spec():
+    specs = parse_faults(
+        "crash:model_worker/0:train_step:2;"
+        "delay_reply:*:inference:1:2.5; drop_reply:w/1:*:3")
+    assert specs == [
+        FaultSpec("crash", "model_worker/0", "train_step", 2),
+        FaultSpec("delay_reply", "*", "inference", 1, 2.5),
+        FaultSpec("drop_reply", "w/1", "*", 3),
+    ]
+
+
+@pytest.mark.parametrize("bad", [
+    "explode:w:h:1",        # unknown kind
+    "crash:w:h",            # too few fields
+    "crash:w:h:0",          # nth < 1
+    "crash:w:h:1:2.0:extra",
+])
+def test_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        parse_faults(bad)
+
+
+def test_counts_fire_on_nth_matching_event_only():
+    inj = FaultInjector(parse_faults("crash:model_worker/0:train_step:2"))
+    # non-matching events advance nothing
+    assert inj.on_event("model_worker/1", "train_step") is None
+    assert inj.on_event("model_worker/0", "inference") is None
+    assert inj.on_event("model_worker/0", "train_step") is None  # 1st
+    fired = inj.on_event("model_worker/0", "train_step")         # 2nd
+    assert fired is not None and fired.kind == "crash"
+    # once: never again
+    assert inj.on_event("model_worker/0", "train_step") is None
+
+
+def test_wildcards_and_independent_counters():
+    inj = FaultInjector(parse_faults(
+        "delay_reply:*:inference:1:0.5;drop_reply:*:train_step:1"))
+    f1 = inj.on_event("w/3", "inference")
+    assert f1.kind == "delay_reply" and f1.seconds == 0.5
+    f2 = inj.on_event("w/9", "train_step")
+    assert f2.kind == "drop_reply"
+
+
+def test_state_file_survives_relaunch(tmp_path):
+    state = str(tmp_path / "faults_state")
+    spec = "crash:w/0:train_step:1"
+    inj = FaultInjector(parse_faults(spec), state_path=state)
+    assert inj.on_event("w/0", "train_step") is not None
+    # a relaunched worker builds a fresh injector over the same state
+    # file: the fault already fired, so it must not crash-loop
+    inj2 = FaultInjector(parse_faults(spec), state_path=state)
+    assert inj2.on_event("w/0", "train_step") is None
+
+
+def test_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("REALHF_TPU_FAULTS", raising=False)
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("REALHF_TPU_FAULTS", "die:w/0:*:1")
+    monkeypatch.setenv("REALHF_TPU_FAULTS_STATE",
+                       str(tmp_path / "state"))
+    inj = FaultInjector.from_env()
+    assert inj is not None
+    assert inj.on_event("w/0", "anything").kind == "die"

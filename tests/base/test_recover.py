@@ -1,0 +1,132 @@
+"""Extended crash-recovery info: versioned round-trip, buffer +
+dataloader state, and corrupt/truncated-file tolerance."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import constants, recover
+from realhf_tpu.system.buffer import SequenceBuffer
+
+
+@pytest.fixture(autouse=True)
+def _trial_names():
+    constants.set_experiment_trial_names("rectest", "t0")
+    yield
+
+
+def _meta(ids):
+    return SequenceSample(
+        keys=["packed_prompts"],
+        trailing_shapes={"packed_prompts": ()},
+        dtypes={"packed_prompts": np.int32},
+        ids=list(ids),
+        seqlens={"packed_prompts": [[4] for _ in ids]})
+
+
+def _filled_buffer():
+    buf = SequenceBuffer(["gen", "train"], capacity=4)
+    bid0 = buf.put_batch(_meta(["a", "b"]), "model_worker/0", 0, False)
+    bid1 = buf.put_batch(_meta(["c", "d"]), "model_worker/0", 0, True)
+    buf.mark_dispatched(bid0, "gen")
+    buf.amend_batch(bid0, None, "model_worker/0", "gen")  # completed
+    buf.mark_dispatched(bid0, "train")                    # in flight
+    return buf, bid0, bid1
+
+
+def test_recover_info_v2_round_trip(tmp_path, monkeypatch):
+    buf, bid0, _ = _filled_buffer()
+    info = recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=1, epoch_step=2,
+                                       global_step=7),
+        last_step_info=recover.StepInfo(epoch=1, epoch_step=1,
+                                        global_step=7),
+        hash_vals_to_ignore=["a", "b"],
+        buffer_state=buf.state_dict(),
+        dataloader_state=dict(epoch=1, epoch_step=2, epochs_fetched=1))
+    recover.dump(info)
+    assert recover.exists()
+    back = recover.load()
+    assert back.version == recover.RECOVER_INFO_VERSION == 2
+    assert back.recover_start == info.recover_start
+    assert back.last_step_info == info.last_step_info
+    assert back.hash_vals_to_ignore == ["a", "b"]
+    assert back.dataloader_state["epoch_step"] == 2
+    # buffer snapshot restores: completion sticks, in-flight work is
+    # requeued (undispatched), batch ids stay monotonic
+    buf2 = SequenceBuffer(["gen", "train"], capacity=4)
+    buf2.load_state_dict(back.buffer_state)
+    assert buf2.batch_ids() == [0, 1]
+    e0 = buf2.get(0)
+    assert e0.completed == {"gen"}
+    assert "train" not in e0.dispatched  # re-runs after restart
+    assert list(e0.ids) == ["a", "b"]
+    ready = buf2.ready_mfcs({"gen": (), "train": ()})
+    assert (0, "train") in ready      # requeued, offered again
+    assert (0, "gen") not in ready    # completion survived the dump
+    assert buf2.put_batch(_meta(["e"]), "w", 1, False) == 2
+
+
+def test_load_safe_missing_returns_none():
+    assert recover.load_safe() is None
+
+
+def test_load_safe_corrupt_and_truncated(tmp_path):
+    info = recover.RecoverInfo(hash_vals_to_ignore=[1, 2, 3])
+    recover.dump(info)
+    path = recover.dump_path()
+    raw = open(path, "rb").read()
+
+    # truncated mid-pickle
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    assert recover.load_safe() is None
+
+    # outright garbage
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage not a pickle")
+    assert recover.load_safe() is None
+
+    # not a RecoverInfo
+    with open(path, "wb") as f:
+        pickle.dump({"hello": "world"}, f)
+    assert recover.load_safe() is None
+
+    # intact file still loads
+    with open(path, "wb") as f:
+        f.write(raw)
+    assert recover.load_safe().hash_vals_to_ignore == [1, 2, 3]
+
+
+def test_load_safe_future_version_falls_back(tmp_path):
+    info = recover.RecoverInfo(version=recover.RECOVER_INFO_VERSION + 1)
+    recover.dump(info)
+    assert recover.load_safe() is None
+    # strict load still hands it over for forensic use
+    assert recover.load().version == recover.RECOVER_INFO_VERSION + 1
+
+
+def test_load_upgrades_legacy_v1_pickle(tmp_path):
+    """A pre-versioning dump (no version/buffer_state/dataloader_state
+    attributes) loads as schema v1 with the new fields defaulted."""
+    legacy = recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=3),
+        hash_vals_to_ignore=["x"])
+    for f in ("version", "buffer_state", "dataloader_state"):
+        del legacy.__dict__[f]
+    recover.dump(legacy)
+    back = recover.load_safe()
+    assert back is not None
+    assert back.version == 1
+    assert back.buffer_state is None
+    assert back.dataloader_state is None
+    assert back.recover_start.epoch == 3
+    assert back.hash_vals_to_ignore == ["x"]
+
+
+def test_dump_is_atomic_over_existing(tmp_path):
+    recover.dump(recover.RecoverInfo(hash_vals_to_ignore=["old"]))
+    recover.dump(recover.RecoverInfo(hash_vals_to_ignore=["new"]))
+    assert recover.load_safe().hash_vals_to_ignore == ["new"]

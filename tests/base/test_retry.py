@@ -1,0 +1,82 @@
+"""Retry/backoff policy: deterministic with injected rng + sleep."""
+
+import random
+
+import pytest
+
+from realhf_tpu.base.retry import RetryPolicy, backoff_delays, retry_call
+
+
+def test_backoff_growth_and_cap():
+    pol = RetryPolicy(max_attempts=6, base_delay=1.0, factor=2.0,
+                      max_delay=4.0, jitter=0.0)
+    assert list(backoff_delays(pol)) == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+
+def test_backoff_jitter_bounds():
+    pol = RetryPolicy(max_attempts=50, base_delay=1.0, factor=1.0,
+                      max_delay=1.0, jitter=0.5)
+    ds = list(backoff_delays(pol, rng=random.Random(0)))
+    assert all(1.0 <= d <= 1.5 for d in ds)
+    assert len(set(ds)) > 1  # actually jittered
+
+
+def test_retry_call_succeeds_after_failures():
+    calls = []
+    slept = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("transient")
+        return "ok"
+
+    out = retry_call(flaky, RetryPolicy(max_attempts=4, base_delay=0.1,
+                                        jitter=0.0),
+                     sleep=slept.append)
+    assert out == "ok"
+    assert len(calls) == 3
+    assert slept == [0.1, 0.2]
+
+
+def test_retry_call_exhausts_and_raises_last():
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise TimeoutError("always")
+
+    with pytest.raises(TimeoutError, match="always"):
+        retry_call(always_fails,
+                   RetryPolicy(max_attempts=3, base_delay=0.0,
+                               jitter=0.0),
+                   sleep=lambda _d: None)
+    assert len(calls) == 3
+
+
+def test_retry_call_non_matching_exception_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, RetryPolicy(max_attempts=5, base_delay=0.0),
+                   retry_on=(TimeoutError,), sleep=lambda _d: None)
+    assert len(calls) == 1
+
+
+def test_retry_call_on_retry_hook():
+    seen = []
+
+    def flaky():
+        if len(seen) < 1:
+            raise TimeoutError("x")
+        return 7
+
+    assert retry_call(flaky, RetryPolicy(max_attempts=2, base_delay=0.0,
+                                         jitter=0.0),
+                      on_retry=lambda a, e: seen.append((a, str(e))),
+                      sleep=lambda _d: None) == 7
+    assert seen == [(1, "x")]

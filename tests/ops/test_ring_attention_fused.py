@@ -10,7 +10,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from realhf_tpu.ops.ring_attention import ring_attention
-from realhf_tpu.ops.ring_attention_fused import ring_attention_fused
+from realhf_tpu.ops.ring_attention_fused import (
+    FUSED_RING_SUPPORTED,
+    FUSED_RING_UNSUPPORTED_REASON,
+    ring_attention_fused,
+)
+
+pytestmark = pytest.mark.skipif(
+    not FUSED_RING_SUPPORTED, reason=FUSED_RING_UNSUPPORTED_REASON or "")
 
 
 def ctx_mesh(n=4):

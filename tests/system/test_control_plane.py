@@ -140,6 +140,38 @@ def test_local_scheduler(tmp_path):
     sched3.stop_all()
 
 
+def test_local_scheduler_resubmit(tmp_path):
+    """Single-worker recovery primitive: a dead job relaunches under
+    the same name with the same command; a live one is refused."""
+    from realhf_tpu.system.scheduler import (
+        JobState,
+        LocalSchedulerClient,
+    )
+
+    sched = LocalSchedulerClient()
+    marker = tmp_path / "count"
+    cmd = [sys.executable, "-c",
+           f"open({str(marker)!r}, 'a').write('x')"]
+    sched.submit("job", cmd)
+    sched.wait(timeout=30)
+    assert marker.read_text() == "x"
+    info = sched.resubmit("job")
+    assert info.state in (JobState.RUNNING, JobState.COMPLETED)
+    sched.wait(timeout=30)
+    assert marker.read_text() == "xx"
+
+    with pytest.raises(KeyError):
+        sched.resubmit("never_submitted")
+
+    sched.submit("live", [sys.executable, "-c",
+                          "import time; time.sleep(60)"])
+    try:
+        with pytest.raises(RuntimeError, match="still running"):
+            sched.resubmit("live")
+    finally:
+        sched.stop_all()
+
+
 def test_sub_topic_no_prefix_collision(record_root):
     """A worker named 'w/1' must not receive requests addressed to
     'w/10' (ZMQ SUB matches topics by prefix; the stream terminates

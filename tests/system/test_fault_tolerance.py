@@ -1,0 +1,235 @@
+"""Fault-tolerant runtime end-to-end: real OS worker processes with
+heartbeats, a watchdog that attributes silent death to the worker and
+the in-flight MFC, deterministic fault injection, and crash-recovery
+resume without data re-consumption (the ISSUE 1 acceptance tests)."""
+
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tiny_model import TINY, write_jsonl
+
+WORKER_ENV = {
+    # spawned workers must run on the virtual CPU mesh and never touch
+    # the TPU plugin; PYTHONPATH also displaces the image's TPU
+    # sitecustomize
+    "REALHF_TPU_BACKEND": "cpu",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": "/root/repo",
+}
+
+
+def _ft_worker_proc(record_root, exp, trial, widx, faults=None):
+    """A minimal heartbeating worker process: answers `compute`
+    requests, with fault injection applied exactly as the model
+    worker applies it."""
+    os.environ["REALHF_TPU_NAME_RESOLVE"] = "nfs"
+    os.environ["REALHF_TPU_HEARTBEAT_INTERVAL"] = "0.2"
+    if faults:
+        os.environ["REALHF_TPU_FAULTS"] = faults
+    from realhf_tpu.base import name_resolve
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    from realhf_tpu.base.fault_injection import FaultInjector
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingReplyServer,
+    )
+    from realhf_tpu.system.worker_base import PollResult, Worker
+
+    name = f"mw/{widx}"
+
+    class FTWorker(Worker):
+
+        def _configure(self, config):
+            self.stream = NameResolvingReplyServer(exp, trial, name)
+            self.faults = FaultInjector.from_env()
+            return "ok"
+
+        def _poll(self):
+            try:
+                req = self.stream.poll(timeout=0.05)
+            except TimeoutError:
+                return PollResult(0, 0)
+            if self.faults is not None:
+                f = self.faults.on_event(name, req.handle_name)
+                if f is not None and f.kind == "die":
+                    os._exit(17)  # silent death: no reply, no status
+                if f is not None and f.kind == "drop_reply":
+                    return PollResult(1, 1)  # executed, reply vanished
+            self.stream.respond(req, data=req.data)
+            return PollResult(1, 1)
+
+    FTWorker(exp, trial, name).run()
+
+
+@pytest.fixture
+def record_root(tmp_path):
+    return str(tmp_path / "nr")
+
+
+def _spawn_fleet(record_root, exp, trial, n, faults_of=None):
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(
+        target=_ft_worker_proc,
+        args=(record_root, exp, trial, i,
+              (faults_of or {}).get(i)), daemon=True)
+        for i in range(n)]
+    for p in procs:
+        p.start()
+    return procs
+
+
+def _setup_master(record_root, exp, trial, workers):
+    from realhf_tpu.base import name_resolve
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingRequestClient,
+    )
+    from realhf_tpu.system.worker_base import WorkerControlPanel
+
+    master = NameResolvingRequestClient(exp, trial)
+    panel = WorkerControlPanel(exp, trial)
+    panel.connect(workers, timeout=60)
+    panel.group_request("configure", kwargs={"config": {}})
+    panel.group_request("start")
+    master.wait_subscribers(workers, timeout=30)
+    return master, panel
+
+
+def test_silently_killed_worker_is_detected_and_attributed(record_root):
+    """Acceptance: a worker injected to die mid-request is marked
+    LOST within the heartbeat timeout and the raised error names the
+    worker and the in-flight MFC."""
+    from realhf_tpu.system.watchdog import Watchdog, WorkerLostError
+
+    exp, trial = "fttest", "t0"
+    procs = _spawn_fleet(record_root, exp, trial, 2,
+                         faults_of={0: "die:mw/0:train_step:1"})
+    try:
+        workers = ["mw/0", "mw/1"]
+        master, _panel = _setup_master(record_root, exp, trial, workers)
+        watchdog = Watchdog(exp, trial, workers, timeout=1.5,
+                            grace=60.0, poll_interval=0.1)
+        # mw/0 hard-exits on receipt; mw/1 answers normally
+        rids = master.request(workers, "train_step", datas=[1, 2])
+        t0 = time.monotonic()
+        with pytest.raises(WorkerLostError) as ei:
+            master.gather_replies(
+                rids, timeout=120.0,
+                check_liveness=lambda: watchdog.raise_if_lost(
+                    workers, inflight=["train_step@batch0"]))
+        elapsed = time.monotonic() - t0
+        # detected by heartbeat staleness, far inside the 120s reply
+        # timeout (1.5s watchdog timeout + beats every 0.2s + slack)
+        assert elapsed < 30.0
+        assert ei.value.workers == ["mw/0"]
+        assert "mw/0" in str(ei.value)
+        assert "train_step@batch0" in str(ei.value)
+        master.close()
+    finally:
+        for p in procs:
+            p.terminate()
+            p.join(timeout=10)
+
+
+def test_dropped_reply_times_out_with_attribution(record_root):
+    """drop-reply injection: the worker executes but the reply
+    vanishes; the gather times out naming the silent handler (the
+    worker is alive, so the watchdog correctly stays quiet), and the
+    fault fires exactly once."""
+    from realhf_tpu.system.request_reply_stream import ReplyTimeoutError
+    from realhf_tpu.system.watchdog import Watchdog
+
+    exp, trial = "fttest", "t1"
+    procs = _spawn_fleet(record_root, exp, trial, 1,
+                         faults_of={0: "drop_reply:mw/0:compute:1"})
+    try:
+        master, _panel = _setup_master(record_root, exp, trial, ["mw/0"])
+        watchdog = Watchdog(exp, trial, ["mw/0"], timeout=2.0,
+                            grace=60.0, poll_interval=0.1)
+        rid = master.request(["mw/0"], "compute", datas=[41])[0]
+        with pytest.raises(ReplyTimeoutError) as ei:
+            master.gather_replies(
+                [rid], timeout=2.0,
+                check_liveness=lambda: watchdog.raise_if_lost(["mw/0"]))
+        assert ei.value.handlers == ["mw/0"]
+        assert rid in ei.value.request_ids
+        master.discard([rid])
+        # once-semantics: the next request round-trips fine
+        rid2 = master.request(["mw/0"], "compute", datas=[42])[0]
+        assert master.gather_replies([rid2],
+                                     timeout=30.0)[0].data == 42
+        master.close()
+    finally:
+        for p in procs:
+            p.terminate()
+            p.join(timeout=10)
+
+
+@pytest.fixture
+def sft_data(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "sft.jsonl"
+    write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 3)),
+         "answer": " " + " ".join(["good"] * int(rng.integers(2, 6)))}
+        for i in range(16)])
+    return str(path)
+
+
+def test_injected_crash_recovers_without_reconsuming_data(
+        sft_data, tmp_path):
+    """Acceptance: a model worker injected to crash on its 2nd
+    train_step (i.e. after step 1 checkpointed + dumped RecoverInfo)
+    fails the trial; the auto-recover relaunch resumes from the
+    versioned RecoverInfo and finishes WITHOUT re-consuming the ids
+    of step 1 (global_step would overshoot 2 otherwise)."""
+    from realhf_tpu.apps.main import main_start
+    from realhf_tpu.base import recover
+    from realhf_tpu.base.testing import IntegerTokenizer
+    from realhf_tpu.engine.optim import OptimizerConfig
+    from realhf_tpu.experiments.common import apply_overrides
+    from realhf_tpu.experiments.sft_exp import SFTConfig
+    from realhf_tpu.parallel.mesh import ParallelismConfig
+
+    state = tmp_path / "faults_state"
+    cfg = SFTConfig(experiment_name="ftrec", trial_name="t0",
+                    total_train_epochs=1, save_freq_steps=1,
+                    recover_mode="auto")
+    apply_overrides(cfg, {"dataset.path": sft_data,
+                          "dataset.train_bs_n_seqs": "8",
+                          "dataset.max_seqlen": "32"})
+    spec = cfg.build()
+    for _role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        mspec.parallel = ParallelismConfig(
+            data_parallel_size=2, tensor_parallel_size=4)
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = IntegerTokenizer()
+    spec.n_model_workers = 1
+    env = dict(
+        WORKER_ENV,
+        REALHF_TPU_FAULTS="crash:model_worker/0:train_step:2",
+        REALHF_TPU_FAULTS_STATE=str(state))
+    out = main_start(spec, recover_mode="auto", recover_retries=2,
+                     env=env, timeout=600)
+    assert out["complete"]
+    # the fault really fired (recorded in the cross-relaunch state)
+    assert "crash:model_worker/0:train_step:2" in state.read_text()
+    # 16 samples / bs 8 = 2 steps total; a re-consumed first batch
+    # would make this 3
+    assert out["global_step"] == 2
+    assert np.isfinite(out["stats"]["trainDefault"]["loss"])
+    info = recover.load_safe()
+    assert info is not None
+    assert info.version == recover.RECOVER_INFO_VERSION
+    assert info.dataloader_state is not None

@@ -1,0 +1,193 @@
+"""Heartbeats + watchdog + attributed control-plane failures
+(in-process, memory name_resolve backend, fake clocks -- no races)."""
+
+import random
+import time
+
+import pytest
+
+from realhf_tpu.base import name_resolve, names
+from realhf_tpu.system.watchdog import (
+    ALIVE,
+    DONE,
+    LOST,
+    PENDING,
+    ExclusionBook,
+    Watchdog,
+    WorkerLostError,
+)
+from realhf_tpu.system.worker_base import WorkerServer, WorkerServerStatus
+
+EXP, TRIAL = "wdtest", "t0"
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _beat(worker, ts):
+    name_resolve.add(names.worker_heartbeat(EXP, TRIAL, worker),
+                     f"{ts:.3f}", replace=True, delete_on_exit=False)
+
+
+def _watchdog(workers, clock, timeout=10.0, grace=30.0):
+    return Watchdog(EXP, TRIAL, workers, timeout=timeout, grace=grace,
+                    poll_interval=0.0, clock=clock)
+
+
+def test_worker_server_publishes_heartbeat():
+    server = WorkerServer(EXP, TRIAL, "hb/0", heartbeat_interval=0.05)
+    try:
+        key = names.worker_heartbeat(EXP, TRIAL, "hb/0")
+        t0 = float(name_resolve.get(key))
+        assert abs(time.time() - t0) < 5.0
+        deadline = time.time() + 5.0
+        while float(name_resolve.get(key)) == t0:
+            assert time.time() < deadline, "heartbeat never refreshed"
+            time.sleep(0.02)
+    finally:
+        server.stop_heartbeat()
+
+
+def test_watchdog_verdicts_fresh_stale_missing():
+    clock = FakeClock(1000.0)
+    wd = _watchdog(["w/0", "w/1", "w/2"], clock)
+    _beat("w/0", 995.0)   # fresh (5s old <= 10s timeout)
+    _beat("w/1", 985.0)   # stale (15s old)
+    # w/2 never beat: within grace -> PENDING
+    snap = wd.check()
+    assert snap == {"w/0": ALIVE, "w/1": LOST, "w/2": PENDING}
+    assert wd.lost_workers() == ["w/1"]
+    # grace expires -> missing worker becomes LOST too
+    clock.t = 1031.0
+    _beat("w/0", 1030.0)
+    assert wd.check()["w/2"] == LOST
+    # heartbeat returns -> the flap clears
+    _beat("w/1", 1030.5)
+    snap = wd.check()
+    assert snap["w/1"] == ALIVE
+    assert "w/1" not in wd.lost_workers()
+
+
+def test_watchdog_terminal_status_is_not_lost():
+    clock = FakeClock(1000.0)
+    wd = _watchdog(["w/0"], clock)
+    _beat("w/0", 995.0)
+    assert wd.check()["w/0"] == ALIVE
+    # worker exits cleanly: beats stop, COMPLETED status published
+    name_resolve.add(names.worker_status(EXP, TRIAL, "w/0"),
+                     WorkerServerStatus.COMPLETED.value, replace=True,
+                     delete_on_exit=False)
+    clock.t = 1100.0
+    assert wd.check()["w/0"] == DONE
+    assert wd.lost_workers() == []
+
+
+def test_watchdog_lost_longer_than_and_raise():
+    clock = FakeClock(1000.0)
+    wd = _watchdog(["w/0", "w/1"], clock)
+    _beat("w/0", 999.0)
+    _beat("w/1", 950.0)
+    wd.check()
+    assert wd.lost_longer_than(5.0) == []
+    clock.t = 1007.0
+    _beat("w/0", 1006.0)
+    wd.check()
+    assert wd.lost_longer_than(5.0) == ["w/1"]
+    with pytest.raises(WorkerLostError) as ei:
+        wd.raise_if_lost(inflight=["actor_train@batch3"])
+    assert "w/1" in str(ei.value)
+    assert "actor_train@batch3" in str(ei.value)
+    # scoped to live workers only -> no raise
+    wd.raise_if_lost(["w/0"])
+
+
+def test_watchdog_poll_is_edge_triggered():
+    clock = FakeClock(1000.0)
+    wd = Watchdog(EXP, TRIAL, ["w/0"], timeout=10.0, grace=30.0,
+                  poll_interval=5.0, clock=clock)
+    _beat("w/0", 980.0)
+    assert wd.poll() == ["w/0"]   # first detection
+    assert wd.poll() == []        # rate-limited
+    clock.t = 1006.0
+    assert wd.poll() == []        # still lost, but not NEWLY lost
+
+
+def test_exclusion_book_backoff_and_expiry():
+    clock = FakeClock(0.0)
+    book = ExclusionBook(base=4.0, factor=2.0, max_delay=100.0,
+                         jitter=0.0, clock=clock,
+                         rng=random.Random(0))
+    assert not book.is_excluded("w/0")
+    d1 = book.exclude("w/0")
+    assert d1 == 4.0 and book.is_excluded("w/0")
+    clock.t = 4.5
+    assert not book.is_excluded("w/0")  # window over
+    d2 = book.exclude("w/0")            # repeat loss -> doubled
+    assert d2 == 8.0
+    assert book.loss_count("w/0") == 2
+    assert book.excluded() == ["w/0"]
+    book.forgive("w/0")
+    assert not book.is_excluded("w/0") and book.loss_count("w/0") == 0
+
+
+def test_exclusion_book_jitter_bounded():
+    clock = FakeClock(0.0)
+    book = ExclusionBook(base=10.0, jitter=0.5, clock=clock,
+                         rng=random.Random(7))
+    d = book.exclude("w/0")
+    assert 10.0 <= d <= 15.0
+
+
+def test_gather_replies_timeout_names_silent_handlers():
+    """Satellite: the gather timeout must list which handlers never
+    replied and which request ids are outstanding."""
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingRequestClient,
+        ReplyTimeoutError,
+    )
+
+    master = NameResolvingRequestClient(EXP, TRIAL)
+    try:
+        # nobody subscribed: these requests vanish into the PUB socket
+        rids = master.request(["ghost/0", "ghost/1"], "train_step",
+                              datas=[None, None])
+        with pytest.raises(ReplyTimeoutError) as ei:
+            master.gather_replies(rids, timeout=0.2)
+        err = ei.value
+        assert err.handlers == ["ghost/0", "ghost/1"]
+        assert sorted(err.request_ids) == sorted(rids)
+        assert "ghost/0" in str(err) and "train_step" in str(err)
+        assert master.outstanding_handlers(rids) == ["ghost/0",
+                                                     "ghost/1"]
+        master.discard(rids)
+        assert master.outstanding_handlers(rids) == []
+    finally:
+        master.close()
+
+
+def test_gather_replies_liveness_hook_aborts_promptly():
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingRequestClient,
+    )
+
+    master = NameResolvingRequestClient(EXP, TRIAL)
+    try:
+        rid = master.request(["ghost/0"], "save")[0]
+
+        def dead():
+            raise WorkerLostError("ghost/0", inflight=["save"])
+
+        t0 = time.monotonic()
+        with pytest.raises(WorkerLostError, match="ghost/0"):
+            master.gather_replies([rid], timeout=60.0,
+                                  check_liveness=dead)
+        # must abort within the liveness check cadence, nowhere near
+        # the 60s reply timeout
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        master.close()
