@@ -119,6 +119,38 @@ class FaultToleranceConfig:
 
 
 @dataclasses.dataclass
+class ServingSpec:
+    """A standalone rollout/serving deployment (docs/serving.md): one
+    or more ``GenServerWorker`` processes, each running a
+    continuous-batching ``RolloutServer`` over the named model role.
+    Launched by ``apps.main.run_serve`` -- standalone or alongside a
+    training trial as its asynchronous rollout producer."""
+    model_role: str = "default"
+    n_servers: int = 1
+    #: decode slots per server (concurrent sequences in the batch)
+    n_slots: int = 4
+    #: decode steps per host<->device sync
+    chunk_size: int = 8
+    max_prompt_len: int = 512
+    #: admission control: queue entries beyond this are rejected with
+    #: a retry_after hint (backpressure) instead of growing unbounded
+    max_queue_depth: int = 256
+    #: reject/evict sequences whose start weight version lags the
+    #: installed version by more than this; None disables the bound
+    max_staleness: Optional[int] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    #: GenerationHyperparameters kwargs (max_new_tokens, greedy, ...);
+    #: force_no_logits_mask is always set -- inflight serving never
+    #: produces the PPO logits mask
+    gconfig: dict = dataclasses.field(default_factory=dict)
+    #: send incremental token deltas after every decode chunk
+    stream_tokens: bool = True
+    #: seconds drain() waits for in-flight sequences at shutdown
+    drain_timeout_secs: float = 30.0
+
+
+@dataclasses.dataclass
 class ExperimentSpec:
     experiment_name: str
     trial_name: str
@@ -167,6 +199,10 @@ class ExperimentSpec:
     # resolve_rpc_hooks, experiments/common/utils.py:143 +
     # model_worker.py:542-552).
     auto_offload: bool = False
+    # Rollout/serving deployment (apps.main.run_serve spawns
+    # ``serving.n_servers`` GenServerWorker processes); None for
+    # ordinary training trials.
+    serving: Optional[ServingSpec] = None
 
     def workers_of_role(self, role: str) -> List[int]:
         """Worker group of a role (leader first). Single-int

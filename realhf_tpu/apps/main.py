@@ -176,6 +176,95 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         sched.stop_all()
 
 
+def run_serve(spec: ExperimentSpec,
+              env: Optional[Dict[str, str]] = None,
+              duration: Optional[float] = None,
+              timeout: float = 86400.0) -> Dict:
+    """Launch ``spec.serving.n_servers`` GenServerWorker processes
+    (the async rollout & serving subsystem, docs/serving.md) and
+    supervise them with the same heartbeat/watchdog plumbing as a
+    training trial: a hung or dead server raises JobException naming
+    the worker.
+
+    Runs until ``duration`` elapses (None = until ``timeout`` or
+    KeyboardInterrupt), then drains gracefully: workers bounce queued
+    requests, finish in-flight sequences, and exit COMPLETED. Returns
+    the per-server stats gathered just before shutdown."""
+    sv = getattr(spec, "serving", None)
+    if sv is None:
+        raise ValueError(
+            "run_serve needs ExperimentSpec.serving (build one with "
+            "the `serve` experiment, experiments/serve_exp.py).")
+    constants.set_experiment_trial_names(spec.experiment_name,
+                                         spec.trial_name)
+    path = _spec_path(spec)
+    with open(path, "wb") as f:
+        pickle.dump(spec, f)
+    record_root = os.path.join(constants.run_log_path(), "name_resolve")
+    name_resolve.reconfigure("nfs", record_root=record_root)
+    env = dict(env or {})
+    env.setdefault("REALHF_TPU_NAME_RESOLVE_ROOT", record_root)
+    env.setdefault("REALHF_TPU_ROOT", constants.ROOT_DIR)
+    ft = getattr(spec, "ft", None) or FaultToleranceConfig()
+    env.setdefault(HEARTBEAT_INTERVAL_ENV, str(ft.heartbeat_interval))
+
+    worker_names = [f"gen_server/{i}" for i in range(sv.n_servers)]
+    sched = make_scheduler("local")
+    name_resolve.clear_subtree(
+        names.trial_root(spec.experiment_name, spec.trial_name))
+    try:
+        for i in range(sv.n_servers):
+            sched.submit(f"gen_server/{i}",
+                         _worker_cmd("gen_server", i, spec), env=env)
+        panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
+        panel.connect(worker_names, timeout=120)
+        out = panel.group_request_varied(
+            "configure",
+            {f"gen_server/{i}": dict(config=dict(spec_path=path,
+                                                 server_index=i))
+             for i in range(sv.n_servers)},
+            timeout=600)
+        panel.group_request("start")
+        logger.info("All %d rollout servers started: %s.",
+                    len(worker_names),
+                    {w: r.get("address") for w, r in out.items()
+                     if isinstance(r, dict)})
+
+        watchdog = Watchdog(
+            spec.experiment_name, spec.trial_name, worker_names,
+            timeout=ft.heartbeat_timeout, grace=ft.startup_grace_secs,
+            poll_interval=ft.watchdog_poll_secs)
+        end = None if duration is None else time.monotonic() + duration
+        deadline = time.monotonic() + timeout
+        while True:
+            for w in worker_names:
+                info = sched.find(w)
+                if info.state.value == "FAILED":
+                    raise JobException(w, info.state)
+                if panel.get_worker_status(w) == WorkerServerStatus.ERROR:
+                    raise JobException(w, info.state)
+            watchdog.poll()
+            lost = watchdog.lost_longer_than(ft.worker_lost_fatal_secs)
+            if lost:
+                raise JobException(lost[0], JobState.LOST)
+            if end is not None and time.monotonic() > end:
+                break
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.2)
+
+        stats = panel.group_request("stats")
+        # exit drains each server (GenServerWorker._exit_hook) before
+        # the COMPLETED status lands
+        panel.group_request("exit",
+                            timeout=sv.drain_timeout_secs + 60)
+        sched.wait(timeout=sv.drain_timeout_secs + 60,
+                   check_status=False)
+        return stats
+    finally:
+        sched.stop_all(grace=sv.drain_timeout_secs + 10)
+
+
 def main_start(spec: ExperimentSpec, recover_mode: str = "disabled",
                recover_retries: int = 1,
                env: Optional[Dict[str, str]] = None,

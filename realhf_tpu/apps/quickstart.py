@@ -109,7 +109,14 @@ def main(argv=None):
                     cfg.allocation_mode, n,
                     {k: str(v) for k, v in spec.allocations.items()})
 
-    if cfg.mode == "distributed":
+    if getattr(spec, "serving", None) is not None:
+        # rollout/serving deployment: no master/dataflow, just
+        # GenServerWorker processes answering RolloutClient traffic
+        # (docs/serving.md)
+        from realhf_tpu.apps.main import run_serve
+        stats = run_serve(
+            spec, duration=getattr(cfg, "serve_duration_secs", None))
+    elif cfg.mode == "distributed":
         # master + model-worker processes, concurrent MFCs on disjoint
         # meshes (reference multi-worker runtime)
         from realhf_tpu.apps.main import main_start

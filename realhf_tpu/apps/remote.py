@@ -32,6 +32,10 @@ def main_worker(args):
         from realhf_tpu.system.master_worker import MasterWorker
         cls = MasterWorker
         name = "master_worker/0"
+    elif args.worker_type == "gen_server":
+        from realhf_tpu.serving.worker import GenServerWorker
+        cls = GenServerWorker
+        name = f"gen_server/{args.index}"
     else:
         raise ValueError(args.worker_type)
     cls(args.experiment_name, args.trial_name, name).run()
@@ -42,7 +46,8 @@ def main():
     sub = parser.add_subparsers(dest="cmd", required=True)
     w = sub.add_parser("worker")
     w.add_argument("--worker_type", required=True,
-                   choices=["model_worker", "master_worker"])
+                   choices=["model_worker", "master_worker",
+                            "gen_server"])
     w.add_argument("--index", type=int, default=0)
     w.add_argument("--experiment_name", required=True)
     w.add_argument("--trial_name", required=True)

@@ -104,8 +104,71 @@ class InflightBatchingGenerator:
             chunk_size, moe_constraint, mesh))
 
     # ------------------------------------------------------------------
-    def _fill_slot(self, slot: int, request_id: int,
-                   prompt: np.ndarray):
+    # Slot-level step API. The serving subsystem
+    # (``realhf_tpu/serving/scheduler.py``) drives these directly to
+    # interleave admission, decoding, and harvesting at iteration
+    # granularity; ``generate_all`` below is the run-to-completion
+    # composition of the same primitives.
+    # ------------------------------------------------------------------
+    def free_slots(self) -> List[int]:
+        """Slot indices with no request bound to them."""
+        return [s for s, r in enumerate(self._slot_req) if r < 0]
+
+    @property
+    def n_live(self) -> int:
+        """Slots currently bound to a request (decoding or awaiting
+        harvest)."""
+        return sum(1 for r in self._slot_req if r >= 0)
+
+    def decode_chunk(self, key: jax.Array):
+        """Advance every live slot by up to ``chunk_size`` decode
+        steps (one host<->device sync)."""
+        self.state = self._decode_chunk(self.params, self.state, key)
+
+    def swap_params(self, params):
+        """Hot-swap the weights used from the next decode/prefill on.
+        Safe between ``decode_chunk`` calls: the jitted programs take
+        params as an argument, so no recompilation happens as long as
+        shapes/dtypes match."""
+        self.params = params
+
+    def release_slot(self, slot: int):
+        """Abort the sequence in ``slot`` (cancellation/eviction): the
+        slot immediately becomes free and the partial output is
+        dropped."""
+        self._slot_req[slot] = -1
+        self.state["active"] = self.state["active"].at[slot].set(False)
+
+    def snapshot_slot(self, slot: int):
+        """(tokens_so_far, logprobs_so_far) of the sequence in
+        ``slot`` -- the incremental-streaming read. Device sync."""
+        n = int(np.asarray(self.state["emitted"][slot]))
+        return (np.asarray(self.state["out_tokens"][slot, :n]),
+                np.asarray(self.state["out_logprobs"][slot, :n]))
+
+    def harvest(self) -> List[FinishedSequence]:
+        """Collect every finished sequence and free its slot."""
+        out: List[FinishedSequence] = []
+        if self.n_live == 0:
+            return out
+        active = np.asarray(self.state["active"])
+        unfinished = np.asarray(self.state["unfinished"])
+        for slot in range(self.n_slots):
+            rid = self._slot_req[slot]
+            if rid < 0 or (active[slot] and unfinished[slot]):
+                continue
+            n = int(np.asarray(self.state["emitted"][slot]))
+            out.append(FinishedSequence(
+                request_id=rid,
+                tokens=np.asarray(self.state["out_tokens"][slot, :n]),
+                logprobs=np.asarray(self.state["out_logprobs"][slot, :n]),
+                no_eos=not bool(np.asarray(self.state["hit_eos"][slot]))))
+            self.release_slot(slot)
+        return out
+
+    # ------------------------------------------------------------------
+    def fill_slot(self, slot: int, request_id: int,
+                  prompt: np.ndarray):
         max_prompt = self.cache_len - self.g.max_new_tokens
         assert len(prompt) <= max_prompt, (
             f"prompt of {len(prompt)} tokens exceeds max_prompt_len "
@@ -133,38 +196,17 @@ class InflightBatchingGenerator:
         queue = list(enumerate(prompts))[::-1]  # pop() takes req 0 first
         results: Dict[int, FinishedSequence] = {}
 
-        for slot in range(self.n_slots):
-            if queue:
+        while queue or self.n_live:
+            for slot in self.free_slots():
+                if not queue:
+                    break
                 rid, p = queue.pop()
-                self._fill_slot(slot, rid, p)
-
-        step = 0
-        while any(r >= 0 for r in self._slot_req):
+                self.fill_slot(slot, rid, p)
             key, sub = jax.random.split(key)
-            self.state = self._decode_chunk(self.params, self.state, sub)
-            step += self.chunk
+            self.decode_chunk(sub)
             # host sync once per chunk: harvest finished slots
-            active = np.asarray(self.state["active"])
-            unfinished = np.asarray(self.state["unfinished"])
-            for slot in range(self.n_slots):
-                rid = self._slot_req[slot]
-                if rid < 0 or (active[slot] and unfinished[slot]):
-                    continue
-                n = int(np.asarray(self.state["emitted"][slot]))
-                results[rid] = FinishedSequence(
-                    request_id=rid,
-                    tokens=np.asarray(
-                        self.state["out_tokens"][slot, :n]),
-                    logprobs=np.asarray(
-                        self.state["out_logprobs"][slot, :n]),
-                    no_eos=not bool(
-                        np.asarray(self.state["hit_eos"][slot])))
-                self._slot_req[slot] = -1
-                self.state["active"] = \
-                    self.state["active"].at[slot].set(False)
-                if queue:
-                    rid2, p2 = queue.pop()
-                    self._fill_slot(slot, rid2, p2)
+            for fs in self.harvest():
+                results[fs.request_id] = fs
         return [results[i] for i in range(len(prompts))]
 
 

@@ -1,0 +1,86 @@
+"""Serving experiment: launch a standalone continuous-batching rollout
+service (docs/serving.md) -- no dataflow graph, no master; just
+``ServingSpec.n_servers`` GenServerWorker processes answering
+RolloutClient traffic until stopped.
+
+Standalone::
+
+    python -m realhf_tpu.apps.quickstart serve \
+        experiment_name=my-serve trial_name=t0 \
+        model.path=/path/to/llama n_slots=16 max_new_tokens=512
+
+Alongside a PPO trial as the asynchronous rollout producer: launch
+with the same experiment/trial names so clients (and the trainer's
+weight pushes) rendezvous through the shared name_resolve root, and
+set ``max_staleness`` to the off-policyness bound the algorithm
+tolerates.
+"""
+
+import dataclasses
+from typing import Optional
+
+from realhf_tpu.api.experiment import ExperimentSpec, ServingSpec
+from realhf_tpu.experiments.common import (
+    CommonExperimentConfig,
+    ModelConfigCLI,
+    register_experiment,
+)
+
+
+@dataclasses.dataclass
+class ServeConfig(CommonExperimentConfig):
+    model: ModelConfigCLI = dataclasses.field(
+        default_factory=ModelConfigCLI)
+    n_servers: int = 1
+    n_slots: int = 4
+    chunk_size: int = 8
+    max_prompt_len: int = 512
+    max_queue_depth: int = 256
+    max_staleness: Optional[int] = None
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    stream_tokens: bool = True
+    drain_timeout_secs: float = 30.0
+    # sampling defaults for every request (per-request overrides ride
+    # on the request itself in a future PR)
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    greedy: bool = False
+    top_p: float = 1.0
+    top_k: int = 0
+    temperature: float = 1.0
+    # how long run_serve keeps the service up before graceful drain;
+    # None = until interrupted
+    serve_duration_secs: Optional[float] = None
+
+    def build(self) -> ExperimentSpec:
+        serving = ServingSpec(
+            model_role="default",
+            n_servers=self.n_servers,
+            n_slots=self.n_slots,
+            chunk_size=self.chunk_size,
+            max_prompt_len=self.max_prompt_len,
+            max_queue_depth=self.max_queue_depth,
+            max_staleness=self.max_staleness,
+            eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id,
+            stream_tokens=self.stream_tokens,
+            drain_timeout_secs=self.drain_timeout_secs,
+            gconfig=dict(
+                max_new_tokens=self.max_new_tokens,
+                min_new_tokens=self.min_new_tokens,
+                greedy=self.greedy, top_p=self.top_p,
+                top_k=self.top_k, temperature=self.temperature))
+        return ExperimentSpec(
+            experiment_name=self.experiment_name,
+            trial_name=self.trial_name,
+            models={"default": self.model.to_spec(train=False)},
+            mfcs=[],
+            dataset=None,
+            tokenizer_path=self.tokenizer_path or self.model.path,
+            seed=self.seed,
+            ctl=self.ctl(),
+            serving=serving)
+
+
+register_experiment("serve", ServeConfig)

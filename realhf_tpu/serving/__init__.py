@@ -1,0 +1,26 @@
+"""Async rollout & serving subsystem (docs/serving.md).
+
+Turns the engine-level continuous-batching generator into a
+long-running generation service: admission-controlled request queue,
+iteration-level scheduler with weight hot-swap and bounded staleness,
+and a ZMQ streaming server/client pair wired into the worker stack.
+"""
+
+from realhf_tpu.serving.request_queue import (  # noqa: F401
+    AdmissionVerdict,
+    GenRequest,
+    Priority,
+    RequestQueue,
+)
+from realhf_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousScheduler,
+    FinishedRollout,
+    ServeEvent,
+)
+from realhf_tpu.serving.server import (  # noqa: F401
+    RolloutClient,
+    RolloutResult,
+    RolloutServer,
+    rollout_server_key,
+)
+from realhf_tpu.serving.weight_sync import WeightSync  # noqa: F401

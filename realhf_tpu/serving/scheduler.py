@@ -1,0 +1,224 @@
+"""Iteration-level continuous scheduler over decode slots.
+
+Orca-style scheduling for the rollout service: between decode
+iterations the scheduler (1) installs pending weight swaps, (2)
+evicts sequences that can no longer produce a useful result (deadline
+passed, or doomed to exceed the staleness bound after a weight jump),
+(3) admits queued requests into free slots (prefill interleaved with
+decoding of the other slots), (4) runs one decode chunk, and (5)
+harvests finished sequences, stamping each with the weight versions it
+was generated under.
+
+The backend contract (duck-typed; satisfied by
+``engine.inflight.InflightBatchingGenerator`` and by test fakes)::
+
+    n_slots: int                   chunk: int (decode steps per chunk)
+    free_slots() -> List[int]
+    fill_slot(slot, int_id, prompt)
+    decode_chunk(key)
+    harvest() -> List[FinishedSequence]   # frees slots
+    release_slot(slot)                    # abort, frees slot
+    swap_params(params)
+    snapshot_slot(slot) -> (tokens, logprobs)
+
+Counters make the continuous-batching win measurable: ``decode_steps``
+(an upper bound -- the backend's chunk loop may early-exit) versus
+``tokens_out``, which is exactly the number of decode passes a
+sequential (one-request-at-a-time) server would have paid.
+"""
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from realhf_tpu.base import logging
+from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
+from realhf_tpu.serving.weight_sync import WeightSync
+
+logger = logging.getLogger("serving.scheduler")
+
+
+@dataclasses.dataclass
+class ServeEvent:
+    """One scheduler-step outcome, routed to clients by the server.
+
+    kinds: ``started`` (entered a slot), ``tokens`` (incremental
+    delta), ``done`` (finished, data carries the FinishedRollout),
+    ``stale`` (finished/evicted beyond the staleness bound),
+    ``expired`` (deadline passed while decoding), ``cancelled``.
+    """
+    kind: str
+    rid: str
+    data: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class FinishedRollout:
+    rid: str
+    tokens: np.ndarray
+    logprobs: np.ndarray
+    no_eos: bool
+    #: weight version installed when the sequence entered its slot --
+    #: the behavior-policy version async RLHF consumers key on.
+    weight_version: int
+    #: version installed when it finished (== weight_version unless a
+    #: hot-swap happened mid-stream).
+    weight_version_final: int
+    queued_secs: float = 0.0
+    serve_secs: float = 0.0
+
+
+@dataclasses.dataclass
+class _ActiveSeq:
+    int_id: int
+    slot: int
+    req: GenRequest
+    version_start: int
+    streamed: int = 0  # tokens already reported via `tokens` events
+
+
+class ContinuousScheduler:
+    """Admission/eviction + decode driving over a slot backend."""
+
+    def __init__(self, backend, queue: RequestQueue,
+                 weight_sync: Optional[WeightSync] = None,
+                 max_staleness: Optional[int] = None,
+                 stream_tokens: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        self.backend = backend
+        self.queue = queue
+        self.weight_sync = weight_sync or WeightSync()
+        self.max_staleness = max_staleness
+        self.stream_tokens = stream_tokens
+        self._clock = clock
+        self._active: Dict[int, _ActiveSeq] = {}  # int_id -> seq
+        self._by_slot: Dict[int, int] = {}        # slot -> int_id
+        self._next_id = 0
+        self.stats = dict(prefills=0, decode_chunks=0, decode_steps=0,
+                          tokens_out=0, finished=0, expired=0, stale=0,
+                          cancelled=0, swaps=0,
+                          sequential_equiv_steps=0)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_live(self) -> int:
+        return len(self._active)
+
+    def idle(self) -> bool:
+        return not self._active and len(self.queue) == 0
+
+    def active_rids(self) -> List[str]:
+        return [s.req.rid for s in self._active.values()]
+
+    # ------------------------------------------------------------------
+    def cancel(self, rid: str) -> bool:
+        """Abort an ACTIVE sequence (queued ones are cancelled at the
+        queue). Frees the slot immediately."""
+        for int_id, seq in list(self._active.items()):
+            if seq.req.rid == rid:
+                self._evict(int_id)
+                self.stats["cancelled"] += 1
+                return True
+        return False
+
+    def _evict(self, int_id: int):
+        seq = self._active.pop(int_id)
+        self._by_slot.pop(seq.slot, None)
+        self.backend.release_slot(seq.slot)
+
+    # ------------------------------------------------------------------
+    def step(self, key, admit: bool = True) -> List[ServeEvent]:
+        """One serve iteration; returns the events it produced."""
+        events: List[ServeEvent] = []
+        now = self._clock()
+
+        # 1. weight swap between iterations
+        swapped = self.weight_sync.poll(self.backend.swap_params)
+        if swapped is not None:
+            self.stats["swaps"] += 1
+        version = self.weight_sync.version
+
+        # 2. evictions: deadline / doomed-stale sequences stop burning
+        #    decode steps right away
+        for int_id, seq in list(self._active.items()):
+            if (seq.req.deadline is not None
+                    and seq.req.deadline <= now):
+                self._evict(int_id)
+                self.stats["expired"] += 1
+                events.append(ServeEvent("expired", seq.req.rid))
+            elif self._is_stale(seq, version):
+                self._evict(int_id)
+                self.stats["stale"] += 1
+                events.append(ServeEvent("stale", seq.req.rid,
+                                         self._stale_info(seq, version)))
+
+        # 3. admission: prefill queued requests into free slots
+        if admit:
+            for slot in self.backend.free_slots():
+                req = self.queue.pop()
+                if req is None:
+                    break
+                req.started_at = now
+                int_id = self._next_id
+                self._next_id += 1
+                self.backend.fill_slot(slot, int_id, req.prompt)
+                self._active[int_id] = _ActiveSeq(
+                    int_id, slot, req, version_start=version)
+                self._by_slot[slot] = int_id
+                self.stats["prefills"] += 1
+                events.append(ServeEvent("started", req.rid,
+                                         dict(weight_version=version)))
+
+        # 4. one decode chunk over every live slot
+        if self._active:
+            self.backend.decode_chunk(key)
+            self.stats["decode_chunks"] += 1
+            self.stats["decode_steps"] += self.backend.chunk
+
+        # 5. harvest + streaming deltas
+        for fs in self.backend.harvest():
+            seq = self._active.pop(fs.request_id, None)
+            if seq is None:
+                continue  # evicted this very step
+            self._by_slot.pop(seq.slot, None)
+            self.stats["tokens_out"] += len(fs.tokens)
+            self.stats["sequential_equiv_steps"] += len(fs.tokens)
+            if self._is_stale(seq, version):
+                self.stats["stale"] += 1
+                events.append(ServeEvent("stale", seq.req.rid,
+                                         self._stale_info(seq, version)))
+                continue
+            self.stats["finished"] += 1
+            out = FinishedRollout(
+                rid=seq.req.rid, tokens=fs.tokens, logprobs=fs.logprobs,
+                no_eos=fs.no_eos, weight_version=seq.version_start,
+                weight_version_final=version,
+                queued_secs=max(0.0, (seq.req.started_at or now)
+                                - seq.req.submitted_at),
+                serve_secs=max(0.0, now - (seq.req.started_at or now)))
+            self.queue.note_service_time(now - seq.req.submitted_at)
+            events.append(ServeEvent("done", seq.req.rid,
+                                     dict(result=out)))
+        if self.stream_tokens:
+            for seq in self._active.values():
+                tokens, logprobs = self.backend.snapshot_slot(seq.slot)
+                if len(tokens) > seq.streamed:
+                    events.append(ServeEvent(
+                        "tokens", seq.req.rid,
+                        dict(tokens=tokens[seq.streamed:],
+                             logprobs=logprobs[seq.streamed:],
+                             offset=seq.streamed)))
+                    seq.streamed = len(tokens)
+        return events
+
+    # ------------------------------------------------------------------
+    def _is_stale(self, seq: _ActiveSeq, version: int) -> bool:
+        return (self.max_staleness is not None
+                and version - seq.version_start > self.max_staleness)
+
+    def _stale_info(self, seq: _ActiveSeq, version: int) -> dict:
+        return dict(weight_version=seq.version_start,
+                    current_version=version,
+                    max_staleness=self.max_staleness)

@@ -1,0 +1,360 @@
+"""ZMQ rollout server/client: streaming generation as a service.
+
+The delivery layer of the serving subsystem (docs/serving.md). One
+:class:`RolloutServer` owns a ROUTER socket (address rendezvoused
+through name_resolve, same convention as
+``system/request_reply_stream.py``), an admission
+:class:`~realhf_tpu.serving.request_queue.RequestQueue`, and a
+:class:`~realhf_tpu.serving.scheduler.ContinuousScheduler` over a slot
+backend (``engine.inflight.InflightBatchingGenerator``). Clients hold
+a DEALER socket; every request streams back incrementally::
+
+    client                          server
+      submit(rid, prompt, ...) ->
+                                 <- accepted | rejected(reason, retry_after)
+                                 <- started(weight_version)
+                                 <- tokens(delta) ...        [streaming]
+                                 <- done(result) | stale | expired
+      cancel(rid)              ->
+                                 <- cancelled
+
+Payloads are pickled tuples ``(kind, rid, data)`` -- metadata plus
+token id arrays, never model weights (those move through
+:class:`~realhf_tpu.serving.weight_sync.WeightSync` on the host).
+
+Graceful drain: ``drain()`` stops admission, bounces queued requests
+back to their clients (``draining``), lets in-flight slots finish (or
+cancels them past the timeout), and leaves no orphaned queue entries.
+"""
+
+import dataclasses
+import pickle
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import zmq
+
+from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.serving.request_queue import (
+    AdmissionVerdict,
+    GenRequest,
+    Priority,
+    RequestQueue,
+)
+from realhf_tpu.serving.scheduler import ContinuousScheduler, ServeEvent
+from realhf_tpu.serving.weight_sync import WeightSync
+
+logger = logging.getLogger("serving.server", "system")
+
+#: reply kinds that end a request's stream (the server drops its
+#: client route after sending one of these)
+TERMINAL_KINDS = ("done", "rejected", "stale", "expired", "cancelled",
+                  "draining")
+
+
+def rollout_server_key(experiment_name: str, trial_name: str,
+                       server_name: str) -> str:
+    return (names.trial_root(experiment_name, trial_name)
+            + f"/rollout_server/{server_name}")
+
+
+class RolloutServer:
+    """Continuous-batching generation service over one slot backend.
+
+    Single-threaded serve loop: ``serve_step`` pumps the socket, runs
+    one scheduler iteration, and routes events -- call it from a
+    worker's poll loop (``GenServerWorker``) or spin
+    ``serve_forever`` in a dedicated thread. ``weight_sync.push`` is
+    the only entry point other threads should touch.
+    """
+
+    def __init__(self, backend, *,
+                 experiment_name: Optional[str] = None,
+                 trial_name: Optional[str] = None,
+                 server_name: str = "rollout/0",
+                 queue: Optional[RequestQueue] = None,
+                 weight_sync: Optional[WeightSync] = None,
+                 max_staleness: Optional[int] = None,
+                 stream_tokens: bool = True,
+                 seed: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.server_name = server_name
+        self._clock = clock
+        # explicit None check: an EMPTY RequestQueue is falsy (__len__)
+        self.queue = queue if queue is not None else RequestQueue(
+            n_slots=getattr(backend, "n_slots", 1))
+        self.weight_sync = weight_sync or WeightSync()
+        self.scheduler = ContinuousScheduler(
+            backend, self.queue, self.weight_sync,
+            max_staleness=max_staleness, stream_tokens=stream_tokens,
+            clock=clock)
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        port = self._sock.bind_to_random_port("tcp://*")
+        self.address = f"tcp://{network.gethostip()}:{port}"
+        if experiment_name is not None and trial_name is not None:
+            name_resolve.add(
+                rollout_server_key(experiment_name, trial_name,
+                                   server_name),
+                self.address, replace=True)
+        self._routes: Dict[str, bytes] = {}  # rid -> client identity
+        import jax
+        self._key = jax.random.PRNGKey(seed)
+        self._draining = False
+        self._closed = False
+        logger.info("Rollout server %s listening on %s.", server_name,
+                    self.address)
+
+    # ------------------------------------------------------------------
+    def serve_step(self, poll_timeout: float = 0.0) -> int:
+        """One serve iteration: pump the socket (waiting up to
+        ``poll_timeout`` seconds for the first message when idle), run
+        the scheduler, deliver events. Returns how many client
+        messages were handled."""
+        handled = self._pump_socket(poll_timeout)
+        if self.scheduler.n_live or len(self.queue):
+            import jax
+            self._key, sub = jax.random.split(self._key)
+            events = self.scheduler.step(sub, admit=not self._draining)
+            self._deliver(events)
+        for req in self.queue.take_expired():
+            self._send(req.rid, "expired", {})
+        return handled
+
+    def serve_forever(self, stop_event, poll_timeout: float = 0.02,
+                      drain_timeout: float = 30.0):
+        """Loop until ``stop_event`` is set, then drain gracefully."""
+        while not stop_event.is_set():
+            self.serve_step(poll_timeout=poll_timeout)
+        self.drain(timeout=drain_timeout)
+
+    # ------------------------------------------------------------------
+    def _pump_socket(self, poll_timeout: float) -> int:
+        n = 0
+        while self._sock.poll(poll_timeout * 1000 if n == 0 else 0):
+            ident, raw = self._sock.recv_multipart()
+            try:
+                msg = pickle.loads(raw)
+                self._handle(ident, msg)
+            except Exception as e:  # noqa: BLE001 - a malformed client
+                # message must not kill the serve loop
+                logger.error("Bad client message: %r", e)
+            n += 1
+        return n
+
+    def _handle(self, ident: bytes, msg: tuple):
+        kind = msg[0]
+        if kind == "submit":
+            _, rid, prompt, priority, ttl, min_wv = msg
+            now = self._clock()
+            if self._draining:
+                self._reply(ident, "rejected", rid,
+                            dict(reason="draining", retry_after=None))
+                return
+            req = GenRequest(
+                rid=rid, prompt=np.asarray(prompt, np.int32),
+                priority=Priority(priority),
+                deadline=None if ttl is None else now + ttl,
+                submitted_at=now, min_weight_version=min_wv)
+            verdict: AdmissionVerdict = self.queue.submit(
+                req, current_weight_version=self.weight_sync.version)
+            if verdict.accepted:
+                self._routes[rid] = ident
+                self._reply(ident, "accepted", rid,
+                            dict(queue_depth=len(self.queue)))
+            else:
+                self._reply(ident, "rejected", rid,
+                            dict(reason=verdict.reason,
+                                 retry_after=verdict.retry_after))
+        elif kind == "cancel":
+            rid = msg[1]
+            if self.queue.cancel(rid) or self.scheduler.cancel(rid):
+                self._send(rid, "cancelled", {})
+        elif kind == "ping":
+            self._reply(ident, "pong", "", {})
+        else:
+            logger.warning("Unknown client message kind %r.", kind)
+
+    # ------------------------------------------------------------------
+    def _deliver(self, events: List[ServeEvent]):
+        for ev in events:
+            data = ev.data
+            if ev.kind == "done":
+                r = data["result"]
+                data = dict(tokens=r.tokens, logprobs=r.logprobs,
+                            no_eos=r.no_eos,
+                            weight_version=r.weight_version,
+                            weight_version_final=r.weight_version_final,
+                            queued_secs=r.queued_secs,
+                            serve_secs=r.serve_secs)
+            self._send(ev.rid, ev.kind, data)
+
+    def _send(self, rid: str, kind: str, data: dict):
+        ident = self._routes.get(rid)
+        if ident is None:
+            return
+        if kind in TERMINAL_KINDS:
+            del self._routes[rid]
+        try:
+            self._sock.send_multipart(
+                [ident, pickle.dumps((kind, rid, data))])
+        except zmq.ZMQError as e:
+            logger.warning("Dropping %s for %s: %s", kind, rid, e)
+
+    def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
+        if kind in TERMINAL_KINDS:
+            self._routes.pop(rid, None)
+        self._sock.send_multipart(
+            [ident, pickle.dumps((kind, rid, data))])
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout: float = 30.0):
+        """Graceful shutdown: refuse new work, bounce queued requests,
+        finish (or cancel) in-flight sequences, leave nothing
+        orphaned."""
+        if self._draining:
+            return
+        self._draining = True
+        bounced = self.queue.start_drain()
+        for req in bounced:
+            self._send(req.rid, "draining", {})
+        deadline = self._clock() + timeout
+        while self.scheduler.n_live and self._clock() < deadline:
+            self.serve_step(poll_timeout=0.0)
+        for rid in self.scheduler.active_rids():
+            self.scheduler.cancel(rid)
+            self._send(rid, "cancelled", {})
+        logger.info(
+            "Rollout server %s drained: %d queued bounced, stats=%s.",
+            self.server_name, len(bounced), self.stats())
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._sock.close(0)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        return dict(self.scheduler.stats,
+                    queue_depth=len(self.queue),
+                    queue_by_class=self.queue.depth_by_class(),
+                    queue_stats=dict(self.queue.stats),
+                    n_live=self.scheduler.n_live,
+                    weight_version=self.weight_sync.version,
+                    draining=self._draining)
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class RolloutResult:
+    """Terminal outcome of one request, as seen by the client."""
+    rid: str
+    status: str                 # done | rejected | stale | expired | ...
+    data: dict
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "done"
+
+    @property
+    def tokens(self) -> Optional[np.ndarray]:
+        return self.data.get("tokens") if self.ok else None
+
+    @property
+    def weight_version(self) -> Optional[int]:
+        return self.data.get("weight_version")
+
+
+class RolloutClient:
+    """DEALER-side client: submit/stream/cancel against one server.
+
+    Not thread-safe (one socket); use one client per thread. Many
+    requests may be in flight on one client -- replies demultiplex by
+    rid into per-request event queues.
+    """
+
+    def __init__(self, address: Optional[str] = None, *,
+                 experiment_name: Optional[str] = None,
+                 trial_name: Optional[str] = None,
+                 server_name: str = "rollout/0",
+                 resolve_timeout: float = 60.0):
+        if address is None:
+            address = name_resolve.wait(
+                rollout_server_key(experiment_name, trial_name,
+                                   server_name),
+                timeout=resolve_timeout)
+        self.address = address
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.connect(address)
+        self._events: Dict[str, List[tuple]] = {}
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt, priority: Priority = Priority.BATCH,
+               ttl: Optional[float] = None, rid: Optional[str] = None,
+               min_weight_version: int = 0) -> str:
+        rid = rid or uuid.uuid4().hex
+        self._events.setdefault(rid, [])
+        self._sock.send(pickle.dumps(
+            ("submit", rid, np.asarray(prompt, np.int32),
+             int(priority), ttl, min_weight_version)))
+        return rid
+
+    def cancel(self, rid: str):
+        self._sock.send(pickle.dumps(("cancel", rid)))
+
+    def ping(self, timeout: float = 10.0) -> bool:
+        self._sock.send(pickle.dumps(("ping",)))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._pump(deadline - time.monotonic()):
+                break
+            q = self._events.get("", [])
+            if any(k == "pong" for k, _ in q):
+                q.clear()
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    def _pump(self, timeout: float) -> bool:
+        """Receive every available reply (waiting up to ``timeout``
+        for the first); returns whether anything arrived."""
+        got = False
+        while self._sock.poll(0 if got else max(0.0, timeout) * 1000):
+            kind, rid, data = pickle.loads(self._sock.recv())
+            self._events.setdefault(rid, []).append((kind, data))
+            got = True
+        return got
+
+    def next_event(self, rid: str, timeout: float = 60.0) -> tuple:
+        """Next ``(kind, data)`` for ``rid``; raises TimeoutError."""
+        deadline = time.monotonic() + timeout
+        while True:
+            q = self._events.get(rid)
+            if q:
+                return q.pop(0)
+            if not self._pump(deadline - time.monotonic()) \
+                    and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"No event for request {rid} within {timeout}s.")
+
+    def stream(self, rid: str, timeout: float = 60.0):
+        """Yield ``(kind, data)`` events up to and including the
+        terminal one."""
+        while True:
+            kind, data = self.next_event(rid, timeout=timeout)
+            yield kind, data
+            if kind in TERMINAL_KINDS:
+                return
+
+    def result(self, rid: str, timeout: float = 60.0) -> RolloutResult:
+        """Block until the request reaches a terminal state."""
+        for kind, data in self.stream(rid, timeout=timeout):
+            if kind in TERMINAL_KINDS:
+                return RolloutResult(rid=rid, status=kind, data=data)
+        raise AssertionError("stream ended without a terminal event")
+
+    def close(self):
+        self._sock.close(0)

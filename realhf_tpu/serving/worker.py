@@ -1,0 +1,110 @@
+"""GenServerWorker: a rollout server in the worker/scheduler stack.
+
+The serving subsystem's process shell: a :class:`Worker` whose poll
+loop IS the serve loop. It inherits the full PR-1 fault-tolerance
+plumbing for free -- heartbeat beacon, status publication, watchdog
+attribution, scheduler supervision (``apps.main.run_serve``) -- so a
+hung generation server is detected and named like any other worker.
+
+Extra worker commands beyond the base set:
+
+- ``stats``: the server's scheduler/queue counters.
+- ``update_weights {version, path?}``: hot-swap. With ``path``, loads
+  an HF-format checkpoint and pushes it through WeightSync; without,
+  re-pushes the current weights under the new version (a pure version
+  bump -- the trainer advanced but this role's weights are refreshed
+  out-of-band, or a staleness drill).
+- ``drain``: early graceful drain without exiting.
+"""
+
+import pickle
+from typing import Any, Dict
+
+from realhf_tpu.base import constants, logging, seeding
+from realhf_tpu.system import worker_base
+
+logger = logging.getLogger("gen_server_worker", "system")
+
+
+class GenServerWorker(worker_base.Worker):
+    """One RolloutServer over one model role (see docs/serving.md)."""
+
+    def _configure(self, config: Dict):
+        from realhf_tpu.api.experiment import ExperimentSpec
+        from realhf_tpu.engine.inflight import InflightBatchingGenerator
+        from realhf_tpu.ops.sampling import GenerationHyperparameters
+        from realhf_tpu.serving.request_queue import RequestQueue
+        from realhf_tpu.serving.server import RolloutServer
+        from realhf_tpu.system.model_host import build_model
+
+        with open(config["spec_path"], "rb") as f:
+            spec: ExperimentSpec = pickle.load(f)
+        self.spec = spec
+        self.server_index = int(config.get("server_index", 0))
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+        seeding.set_random_seed(spec.seed + 1000 + self.server_index)
+
+        sv = spec.serving
+        if sv is None:
+            raise ValueError(
+                "GenServerWorker needs ExperimentSpec.serving (see "
+                "experiments/serve_exp.py).")
+        mspec = spec.models[sv.model_role]
+        self.model = build_model(sv.model_role, mspec, tokenizer=None,
+                                 total_steps=1, init_seed=spec.seed)
+        gconfig = GenerationHyperparameters(
+            **dict(sv.gconfig, force_no_logits_mask=True))
+        backend = InflightBatchingGenerator(
+            self.model.config, self.model.engine.params, gconfig,
+            n_slots=sv.n_slots, max_prompt_len=sv.max_prompt_len,
+            eos_token_id=sv.eos_token_id, pad_token_id=sv.pad_token_id,
+            chunk_size=sv.chunk_size)
+        self.rollout_server = RolloutServer(
+            backend,
+            experiment_name=spec.experiment_name,
+            trial_name=spec.trial_name,
+            server_name=self.worker_name,
+            queue=RequestQueue(max_depth=sv.max_queue_depth,
+                               n_slots=sv.n_slots),
+            max_staleness=sv.max_staleness,
+            stream_tokens=sv.stream_tokens,
+            seed=spec.seed + self.server_index)
+        self._drain_timeout = sv.drain_timeout_secs
+        logger.info("Gen server %s configured: role=%s slots=%d "
+                    "staleness=%s.", self.worker_name, sv.model_role,
+                    sv.n_slots, sv.max_staleness)
+        return dict(address=self.rollout_server.address)
+
+    # ------------------------------------------------------------------
+    def _poll(self) -> worker_base.PollResult:
+        n = self.rollout_server.serve_step(poll_timeout=0.02)
+        return worker_base.PollResult(sample_count=n,
+                                      batch_count=1 if n else 0)
+
+    def _handle_command(self, cmd: str, kwargs: Dict) -> Any:
+        if cmd == "stats":
+            return self.rollout_server.stats()
+        if cmd == "update_weights":
+            return self._update_weights(**(kwargs or {}))
+        if cmd == "drain":
+            self.rollout_server.drain(timeout=self._drain_timeout)
+            return self.rollout_server.stats()
+        return super()._handle_command(cmd, kwargs)
+
+    def _update_weights(self, version: int, path: str = None) -> Dict:
+        if path is not None:
+            from realhf_tpu.models.hf import load_hf_checkpoint
+            _, params = load_hf_checkpoint(
+                path, self.spec.models[self.spec.serving.model_role]
+                .hf_family)
+        else:
+            params = self.rollout_server.scheduler.backend.params
+        self.rollout_server.weight_sync.push(params, version)
+        return dict(pending_version=version,
+                    installed_version=self.rollout_server.weight_sync.version)
+
+    def _exit_hook(self):
+        if getattr(self, "rollout_server", None) is not None:
+            self.rollout_server.drain(timeout=self._drain_timeout)
+            self.rollout_server.close()

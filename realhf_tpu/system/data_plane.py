@@ -27,14 +27,16 @@ from realhf_tpu.base import logging, name_resolve, names, network
 logger = logging.getLogger("data_plane")
 
 
-def _send_zero_copy(sock, obj) -> None:
-    """Send a reply as [pickle5-header, buffer frames...]: numpy
+def _pickle_frames(obj) -> list:
+    """Serialize a reply as [pickle5-header, buffer frames...]: numpy
     payloads serialize out-of-band (no pickle copy of the array
     bytes), which is the difference between ~0.3 and multiple GB/s on
-    parameter-sync blobs. The paired receiver is _recv_zero_copy."""
+    parameter-sync blobs. The paired receiver is _recv_zero_copy.
+    Split from the send so a serialization failure (e.g. a
+    non-contiguous PickleBuffer) never leaves a REP socket mid-send."""
     bufs = []
     head = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
-    sock.send_multipart([head] + [b.raw() for b in bufs], copy=False)
+    return [head] + [b.raw() for b in bufs]
 
 
 def _recv_zero_copy(sock):
@@ -162,14 +164,35 @@ class DataServer(threading.Thread):
             except Exception as e:  # noqa: BLE001 - reply, don't die
                 logger.error("Data server request failed: %r", e)
                 reply = ("error", repr(e))
+            # A REP socket must send EXACTLY once per recv. Pickling
+            # is split from sending so a serialization failure (e.g. a
+            # non-contiguous PickleBuffer in .raw()) can still become
+            # an error reply; but once any frame may have hit the wire
+            # a second send would be EFSM and kill this thread, so the
+            # fallback only fires when nothing was sent. The error
+            # path uses copy=True: no zero-copy machinery to fail.
             try:
-                _send_zero_copy(self._sock, reply)
-            except Exception as e:  # noqa: BLE001 - a REP socket must
-                # send exactly once per recv; an unpicklable payload
-                # (or a non-contiguous PickleBuffer) must become an
-                # error reply, not a dead server thread
-                logger.error("Data server reply failed: %r", e)
-                _send_zero_copy(self._sock, ("error", repr(e)))
+                frames = _pickle_frames(reply)
+            except Exception as e:  # noqa: BLE001 - serialize error
+                logger.error("Data server reply pickling failed: %r", e)
+                frames = [pickle.dumps(("error", repr(e)))]
+            maybe_sent = False
+            try:
+                if len(frames) == 1:
+                    self._sock.send(frames[0], copy=True)
+                else:
+                    maybe_sent = True  # multipart may partially send
+                    self._sock.send_multipart(frames, copy=False)
+            except Exception as e:  # noqa: BLE001 - reply, don't die
+                logger.error("Data server reply send failed: %r", e)
+                if not maybe_sent:
+                    try:
+                        self._sock.send(
+                            pickle.dumps(("error", repr(e))), copy=True)
+                    except Exception:  # noqa: BLE001 - peer times out
+                        logger.error(
+                            "Data server error-reply send failed too; "
+                            "peer fetch will time out.")
 
     def stop(self):
         self._stop_evt.set()

@@ -255,6 +255,15 @@ class ModelHost:
         # execute_level threads (lazy init would race on first use)
         self.exec_infos: Dict[str, dict] = {}
         self._hbm_memo: Dict[str, tuple] = {}
+        # Same-role MFCs share one Engine (primary or replica refresh
+        # path), so two concurrent execute() calls could race
+        # ensure_on_device / a param-donating train step. One lock per
+        # role serializes within the role while cross-role calls stay
+        # threaded (execute_level's concurrency).
+        import threading
+        self._role_locks: Dict[str, threading.Lock] = {
+            n.role: threading.Lock() for n in nodes}
+        self._role_locks_guard = threading.Lock()
         for node in nodes:
             alloc = spec.alloc_of(node.name)
             if alloc is None:
@@ -418,10 +427,24 @@ class ModelHost:
     def node_version(self, node_name: str) -> int:
         return self.node_param_version.get(node_name, 0)
 
+    def _role_lock(self, role: str):
+        with self._role_locks_guard:
+            if role not in self._role_locks:
+                import threading
+                self._role_locks[role] = threading.Lock()
+            return self._role_locks[role]
+
     def execute(self, node_name: str, inp: data_api.SequenceSample):
         """Run one MFC: pre-hooks (reload offloaded weights, refresh
-        replica), the interface call, post-hooks (offload)."""
+        replica), the interface call, post-hooks (offload). Same-role
+        calls serialize on the role's lock (shared Engine); cross-role
+        calls run concurrently (execute_level)."""
         node = self.nodes[node_name]
+        with self._role_lock(node.role):
+            return self._execute_locked(node_name, node, inp)
+
+    def _execute_locked(self, node_name: str, node: MFCDef,
+                        inp: data_api.SequenceSample):
         primary, model = self.engines_of_node(node)
 
         # pre-hooks -----------------------------------------------------
@@ -492,14 +515,16 @@ class ModelHost:
                 self._hbm_memo[node_name] = (now, peak)
             except Exception:  # noqa: BLE001 - stats are best-effort
                 pass
-        self.last_exec_info = dict(node=node_name, start=t_start,
-                                   end=t_end,
-                                   secs=round(t_end - t_start, 4),
-                                   hbm_bytes_in_use=int(now),
-                                   proc_peak_hbm_bytes=int(peak))
-        # per-node record (last_exec_info is clobbered when a level of
-        # independent MFCs executes concurrently, execute_level)
-        self.exec_infos[node_name] = self.last_exec_info
+        # ONE local dict assigned to both records: reading
+        # self.last_exec_info back to fill exec_infos would let a
+        # concurrent execute_level thread clobber it in between and
+        # attribute the wrong node's secs/HBM to this node.
+        info = dict(node=node_name, start=t_start, end=t_end,
+                    secs=round(t_end - t_start, 4),
+                    hbm_bytes_in_use=int(now),
+                    proc_peak_hbm_bytes=int(peak))
+        self.last_exec_info = info
+        self.exec_infos[node_name] = info
 
         if isinstance(out, data_api.SequenceSample) and node.output_key_remap:
             out.remap_keys_(node.output_key_remap)
@@ -535,8 +560,9 @@ class ModelHost:
         is per-call host work (packing, dispatch, transfer syncs) --
         exactly what the distributed runtime overlaps across worker
         processes (the decoupled-allocation concurrency). jax dispatch
-        is thread-safe; two same-role nodes in one level may race a
-        jit-cache insert, costing at worst a duplicate compile.
+        is thread-safe, and two same-role nodes (which share one
+        Engine) serialize on the role's lock inside execute(), so only
+        genuinely independent cross-role work overlaps.
         ``parallel=False`` (or ``REALHF_TPU_PARALLEL_MFC=0``)
         serializes."""
         if parallel is None:

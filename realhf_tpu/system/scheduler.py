@@ -64,7 +64,7 @@ class SchedulerClient:
             cmd = [c.format(index=i) for c in cmd_template]
             self.submit(f"{name}/{i}", cmd, env)
 
-    def stop_all(self):
+    def stop_all(self, grace: float = 10.0):
         raise NotImplementedError()
 
     def find(self, name: str) -> JobInfo:
@@ -121,14 +121,18 @@ class LocalSchedulerClient(SchedulerClient):
         state = JobState.COMPLETED if rc == 0 else JobState.FAILED
         return JobInfo(name, state, pid=p.pid, returncode=rc)
 
-    def stop_all(self):
+    def stop_all(self, grace: float = 10.0):
+        """SIGTERM every job, escalate to SIGKILL after ``grace``
+        seconds. Serving deployments pass a longer grace so a
+        GenServerWorker can drain its in-flight sequences
+        (ServingSpec.drain_timeout_secs) before the hard kill."""
         for name, p in self._procs.items():
             if p.poll() is None:
                 try:
                     os.killpg(os.getpgid(p.pid), signal.SIGTERM)
                 except ProcessLookupError:
                     pass
-        deadline = time.monotonic() + 10
+        deadline = time.monotonic() + grace
         try:
             for name, p in self._procs.items():
                 try:
@@ -276,7 +280,7 @@ class SlurmSchedulerClient(SchedulerClient):
                                    JobState.NOT_FOUND)
         return JobInfo(name, state)
 
-    def stop_all(self):
+    def stop_all(self, grace: float = 10.0):
         for name, sid in self._slurm_ids.items():
             try:
                 self._run(["scancel", sid])

@@ -21,6 +21,17 @@ import re
 import subprocess
 import sys
 
+#: Directories the default (tests/) run must collect at least one
+#: test from. A deleted/renamed suite -- or one whose conftest-level
+#: import breaks in a way pytest reports as "0 collected" rather than
+#: an ERROR -- would otherwise vanish from CI silently.
+REQUIRED_DIRS = (
+    "tests/base",
+    "tests/engine",
+    "tests/serving",
+    "tests/system",
+)
+
 
 def check_collection(args=None, cwd=None):
     """Returns (ok: bool, report: str). Pure-ish for unit testing."""
@@ -46,6 +57,14 @@ def check_collection(args=None, cwd=None):
     if proc.returncode not in (0, 5):  # 5 = no tests collected match
         return False, (f"pytest --collect-only exited {proc.returncode}"
                        f":\n{out[-2000:]}")
+    if args is None:  # default tests/ run: registered suites must exist
+        missing = [d for d in REQUIRED_DIRS
+                   if not re.search(r"^" + re.escape(d) + r"/",
+                                    out, re.MULTILINE)]
+        if missing:
+            return False, ("Collection FAILED: registered director"
+                           f"{'ies' if len(missing) > 1 else 'y'} "
+                           f"collected no tests: {missing}")
     return True, (f"Collection OK "
                   f"({n_collected.group(1) if n_collected else '?'} "
                   "tests).")

@@ -31,6 +31,25 @@ def test_detects_import_error(tmp_path):
     assert "test_broken.py" in report
 
 
+def test_required_dirs_gate(tmp_path):
+    """The default run fails when a registered suite directory (e.g.
+    tests/serving) collects no tests -- a renamed/emptied suite must
+    not vanish from CI silently."""
+    mod = _load_check_collect()
+    t = tmp_path / "tests"
+    for d in mod.REQUIRED_DIRS:
+        (t / os.path.basename(d)).mkdir(parents=True)
+    for d in mod.REQUIRED_DIRS[:-1]:
+        base = os.path.basename(d)
+        # unique module names: same-named test files in sibling dirs
+        # without __init__.py would themselves error collection
+        (t / base / f"test_{base}.py").write_text(
+            "def test_ok():\n    assert True\n")
+    ok, report = mod.check_collection(None, cwd=str(tmp_path))
+    assert not ok
+    assert mod.REQUIRED_DIRS[-1] in report
+
+
 def test_passes_clean_suite(tmp_path):
     mod = _load_check_collect()
     d = tmp_path / "suite"

@@ -124,6 +124,44 @@ def test_no_eos_flag_semantics():
     assert saw_eos  # the construction guarantees at least one EOS end
 
 
+def test_step_level_slot_api():
+    """The serving subsystem drives fill/decode/harvest directly
+    (serving/scheduler.py); the step-level primitives must compose:
+    slots fill and free, snapshots grow monotonically, release aborts,
+    and swap_params takes effect between chunks."""
+    params = T.init_params(CFG, jax.random.PRNGKey(0))
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=8, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+    g = InflightBatchingGenerator(
+        CFG, params, gconfig, n_slots=3, max_prompt_len=32,
+        eos_token_id=None, pad_token_id=0, chunk_size=4)
+    rng = np.random.default_rng(2)
+    prompts = _prompts(rng, 2)
+
+    assert g.free_slots() == [0, 1, 2] and g.n_live == 0
+    g.fill_slot(0, 10, prompts[0])
+    g.fill_slot(2, 11, prompts[1])
+    assert g.free_slots() == [1] and g.n_live == 2
+    assert g.harvest() == []  # nothing finished yet
+
+    g.decode_chunk(jax.random.PRNGKey(0))
+    toks, lps = g.snapshot_slot(0)
+    assert len(toks) == 4 and len(lps) == 4  # one chunk in
+    # hot swap between chunks is a no-op for shapes: same params tree
+    g.swap_params(params)
+
+    g.release_slot(2)  # abort request 11
+    assert g.free_slots() == [1, 2] and g.n_live == 1
+
+    g.decode_chunk(jax.random.PRNGKey(1))
+    done = g.harvest()
+    assert [f.request_id for f in done] == [10]
+    assert len(done[0].tokens) == 8 and done[0].no_eos
+    np.testing.assert_array_equal(done[0].tokens[:4], toks)
+    assert g.n_live == 0 and g.free_slots() == [0, 1, 2]
+
+
 def test_unaligned_cache_len_with_clamped_bucket():
     """cache_len not a multiple of 128 with a prompt whose bucket gets
     clamped to max_prompt: the prefill row must still match the slot's

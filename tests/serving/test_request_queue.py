@@ -1,0 +1,108 @@
+"""Admission control: bounded depth with retry_after backpressure,
+priority classes, deadline expiry, cancellation, and drain (the front
+door of the serving subsystem, docs/serving.md)."""
+
+import numpy as np
+
+from realhf_tpu.serving.request_queue import (
+    GenRequest,
+    Priority,
+    RequestQueue,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, priority=Priority.BATCH, deadline=None, min_wv=0):
+    return GenRequest(rid=rid, prompt=np.zeros(4, np.int32),
+                      priority=priority, deadline=deadline,
+                      min_weight_version=min_wv)
+
+
+def test_backpressure_rejects_with_retry_after():
+    q = RequestQueue(max_depth=3, n_slots=2, clock=Clock())
+    for i in range(3):
+        assert q.submit(_req(f"r{i}")).accepted
+    v = q.submit(_req("r3"))
+    assert not v.accepted
+    assert v.reason == "backpressure"
+    assert v.retry_after is not None and v.retry_after > 0
+    # popping frees a slot in the queue
+    assert q.pop().rid == "r0"
+    assert q.submit(_req("r3")).accepted
+
+
+def test_priority_order_fifo_within_class():
+    q = RequestQueue(max_depth=10, clock=Clock())
+    q.submit(_req("roll0", Priority.ROLLOUT))
+    q.submit(_req("batch0", Priority.BATCH))
+    q.submit(_req("inter0", Priority.INTERACTIVE))
+    q.submit(_req("inter1", Priority.INTERACTIVE))
+    q.submit(_req("batch1", Priority.BATCH))
+    order = [q.pop().rid for _ in range(5)]
+    assert order == ["inter0", "inter1", "batch0", "batch1", "roll0"]
+    assert q.pop() is None
+
+
+def test_deadline_expiry_on_pop_and_at_admission():
+    clock = Clock()
+    q = RequestQueue(max_depth=10, clock=clock)
+    q.submit(_req("soon", deadline=1.0))
+    q.submit(_req("later", deadline=100.0))
+    clock.t = 5.0
+    # already-dead requests are rejected at the door
+    v = q.submit(_req("dead", deadline=2.0))
+    assert not v.accepted and v.reason == "expired"
+    # queued-but-expired entries are skipped, not served
+    assert q.pop().rid == "later"
+    expired = q.take_expired()
+    assert [r.rid for r in expired] == ["soon"]
+    assert q.take_expired() == []
+    assert q.stats["expired"] == 1
+
+
+def test_min_weight_version_gate():
+    q = RequestQueue(max_depth=10, clock=Clock())
+    v = q.submit(_req("fresh", min_wv=3), current_weight_version=2)
+    assert not v.accepted and v.reason == "weights_behind"
+    assert q.submit(_req("fresh", min_wv=3),
+                    current_weight_version=3).accepted
+
+
+def test_cancel_removes_queued_entry():
+    q = RequestQueue(max_depth=10, clock=Clock())
+    q.submit(_req("a"))
+    q.submit(_req("b"))
+    assert q.cancel("a")
+    assert not q.cancel("a")
+    assert q.pop().rid == "b"
+    assert len(q) == 0
+
+
+def test_drain_bounces_queued_and_refuses_new():
+    q = RequestQueue(max_depth=10, clock=Clock())
+    q.submit(_req("a"))
+    q.submit(_req("b", Priority.INTERACTIVE))
+    bounced = q.start_drain()
+    assert sorted(r.rid for r in bounced) == ["a", "b"]
+    assert len(q) == 0
+    v = q.submit(_req("c"))
+    assert not v.accepted and v.reason == "draining"
+    assert q.draining
+
+
+def test_retry_after_scales_with_depth_and_service_time():
+    q = RequestQueue(max_depth=2, n_slots=1, clock=Clock())
+    q.submit(_req("a"))
+    q.submit(_req("b"))
+    before = q.submit(_req("c")).retry_after
+    for _ in range(20):
+        q.note_service_time(10.0)  # slow server -> longer hint
+    after = q.submit(_req("c")).retry_after
+    assert after > before

@@ -1,0 +1,293 @@
+"""End-to-end rollout service over a real tiny model on CPU: ≥8
+concurrent client requests served by continuous batching (strictly
+fewer decode passes than sequential handling, via scheduler
+counters), weight hot-swap mid-stream with correct version stamps,
+staleness rejection, streaming with cancellation, and graceful drain
+with no orphaned queue entries (ISSUE 2 acceptance e2e).
+
+The deterministic test drives ``serve_step`` manually from the test
+thread (client and server interleave in lockstep -- no timing races);
+a separate test exercises the free-running ``serve_forever`` thread.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from realhf_tpu.engine.inflight import InflightBatchingGenerator
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+from realhf_tpu.serving.request_queue import Priority, RequestQueue
+from realhf_tpu.serving.server import (
+    TERMINAL_KINDS,
+    RolloutClient,
+    RolloutResult,
+    RolloutServer,
+)
+
+CFG = TransformerConfig(
+    n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+    intermediate_dim=64, vocab_size=97, apply_rotary=True,
+    layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+    use_attn_proj_bias=False, use_mlp_bias=False,
+    activation_function="silu", compute_dtype="float32")
+
+NEW_TOKENS = 12
+
+
+def _backend(params, n_slots=4, chunk=4):
+    g = GenerationHyperparameters(
+        max_new_tokens=NEW_TOKENS, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+    return InflightBatchingGenerator(
+        CFG, params, g, n_slots=n_slots, max_prompt_len=32,
+        eos_token_id=None, pad_token_id=0, chunk_size=chunk)
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(2, CFG.vocab_size,
+                         size=int(rng.integers(4, 10))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _collect(server, clients, rids_by_client, max_steps=3000):
+    """Interleave serve steps with client pumps until every request
+    reaches a terminal state."""
+    results = {}
+    pending = {(ci, rid) for ci, rids in rids_by_client.items()
+               for rid in rids}
+    for _ in range(max_steps):
+        if not pending:
+            return results
+        server.serve_step(poll_timeout=0.002)
+        for ci, rid in list(pending):
+            try:
+                kind, data = clients[ci].next_event(rid, timeout=0.002)
+            except TimeoutError:
+                continue
+            if kind in TERMINAL_KINDS:
+                results[rid] = RolloutResult(rid, kind, data)
+                pending.discard((ci, rid))
+    raise AssertionError(f"requests never finished: {pending}")
+
+
+def _await_kind(server, client, rid, kinds, max_steps=2000):
+    """Step the server until `rid` produces one of `kinds`; returns
+    every event seen for `rid` up to and including it. Drains ALL
+    available events before stepping again, so the server advances by
+    as few decode chunks as possible (a mid-stream test must catch
+    the sequence before it finishes)."""
+    seen = []
+    for _ in range(max_steps):
+        while True:
+            try:
+                ev = client.next_event(rid, timeout=0.005)
+            except TimeoutError:
+                break
+            seen.append(ev)
+            if ev[0] in kinds:
+                return seen
+            if ev[0] in TERMINAL_KINDS:
+                raise AssertionError(
+                    f"{rid} terminated with {ev[0]} before {kinds}")
+        server.serve_step(poll_timeout=0.002)
+    raise AssertionError(f"never saw {kinds} for {rid}")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def params_v1():
+    return T.init_params(CFG, jax.random.PRNGKey(1))
+
+
+def test_end_to_end_serving(params, params_v1):
+    server = RolloutServer(
+        _backend(params), server_name="e2e/0",
+        queue=RequestQueue(max_depth=32, n_slots=4),
+        max_staleness=1, seed=0)
+    c0 = RolloutClient(server.address)
+    c1 = RolloutClient(server.address)
+    try:
+        # --- phase 1: 8 concurrent requests, continuous batching ----
+        prompts = _prompts(8)
+        rids0 = [c0.submit(p, ttl=300.0) for p in prompts[:4]]
+        rids1 = [c1.submit(p, ttl=300.0) for p in prompts[4:]]
+        results = _collect(server, [c0, c1], {0: rids0, 1: rids1})
+        assert len(results) == 8
+        for rid in rids0 + rids1:
+            r = results[rid]
+            assert r.ok, (rid, r.status, r.data)
+            assert len(r.tokens) == NEW_TOKENS
+            assert r.weight_version == 0
+            assert r.data["weight_version_final"] == 0
+            assert np.isfinite(r.data["logprobs"]).all()
+        s = server.stats()
+        assert s["finished"] == 8
+        # strictly fewer decode passes than sequential handling: a
+        # one-at-a-time server pays one pass per emitted token
+        assert s["decode_steps"] < s["sequential_equiv_steps"]
+        assert s["sequential_equiv_steps"] == 8 * NEW_TOKENS
+
+        # outputs match the engine-level generator run standalone
+        # (the service adds scheduling, not different math)
+        ref = _backend(params).generate_all(prompts,
+                                            jax.random.PRNGKey(9))
+        for rid, want in zip(rids0 + rids1, ref[:4] + ref[4:]):
+            np.testing.assert_array_equal(results[rid].tokens,
+                                          want.tokens)
+
+        # --- phase 2: weight hot-swap mid-stream --------------------
+        rid = c0.submit(_prompts(1, seed=7)[0])
+        _await_kind(server, c0, rid, ("tokens",))  # mid-generation
+        server.weight_sync.push(params_v1, 1)
+        res = _collect(server, [c0], {0: [rid]})[rid]
+        assert res.ok
+        assert res.weight_version == 0               # started under v0
+        assert res.data["weight_version_final"] == 1  # finished under v1
+        assert server.stats()["swaps"] == 1
+
+        # a request admitted after the swap is stamped v1 end-to-end
+        rid2 = c1.submit(_prompts(1, seed=8)[0])
+        res2 = _collect(server, [c1], {0: [rid2]})[rid2]
+        assert res2.ok and res2.weight_version == 1
+        assert res2.data["weight_version_final"] == 1
+
+        # --- phase 3: staleness rejection ---------------------------
+        rid3 = c0.submit(_prompts(1, seed=9)[0])
+        _await_kind(server, c0, rid3, ("tokens",))
+        server.weight_sync.push(params_v1, 4)  # jump 1 -> 4 > bound 1
+        res3 = _collect(server, [c0], {0: [rid3]})[rid3]
+        assert res3.status == "stale"
+        assert res3.data == dict(weight_version=1, current_version=4,
+                                 max_staleness=1)
+
+        # --- phase 4: cancellation mid-stream -----------------------
+        rid4 = c1.submit(_prompts(1, seed=10)[0])
+        _await_kind(server, c1, rid4, ("tokens",))
+        c1.cancel(rid4)
+        res4 = _collect(server, [c1], {0: [rid4]})[rid4]
+        assert res4.status == "cancelled"
+
+        # --- phase 5: graceful drain, no orphans --------------------
+        # 4 slots busy + 2 queued, then drain: in-flight finish,
+        # queued bounce with `draining`, nothing orphaned
+        live = [c0.submit(p) for p in _prompts(4, seed=11)]
+        for r in live:
+            _await_kind(server, c0, r, ("started",))
+        queued = [c1.submit(p) for p in _prompts(2, seed=12)]
+        # pump ONLY the socket (no scheduler steps) so the queued
+        # requests are admitted to the queue but never reach a slot
+        acks = set()
+        for _ in range(500):
+            server._pump_socket(0.01)
+            for r in queued:
+                if r in acks:
+                    continue
+                try:
+                    kind, _ = c1.next_event(r, timeout=0.002)
+                except TimeoutError:
+                    continue
+                assert kind == "accepted"
+                acks.add(r)
+            if len(acks) == 2:
+                break
+        assert len(acks) == 2
+        server.drain(timeout=60.0)
+        res = _collect(server, [c0, c1], {0: live, 1: queued})
+        assert all(res[r].status == "done" for r in live)
+        assert all(res[r].status == "draining" for r in queued)
+        assert len(server.queue) == 0
+        assert server.scheduler.n_live == 0
+        assert server._routes == {}  # every stream closed out
+        # post-drain submissions bounce instead of queueing
+        rid5 = c0.submit(_prompts(1, seed=13)[0])
+        res5 = _collect(server, [c0], {0: [rid5]})[rid5]
+        assert res5.status == "rejected"
+        assert res5.data["reason"] == "draining"
+    finally:
+        c0.close()
+        c1.close()
+        server.close()
+
+
+def test_serve_forever_thread_and_drain(params):
+    """Free-running server thread: blocking client calls work, and
+    stopping the loop drains cleanly."""
+    server = RolloutServer(
+        _backend(params, n_slots=2), server_name="e2e/1",
+        queue=RequestQueue(max_depth=8, n_slots=2), seed=1)
+    stop = threading.Event()
+    t = threading.Thread(target=server.serve_forever,
+                         args=(stop,), kwargs=dict(poll_timeout=0.005,
+                                                   drain_timeout=60.0),
+                         daemon=True)
+    t.start()
+    c = RolloutClient(server.address)
+    try:
+        rids = [c.submit(p, priority=Priority.INTERACTIVE)
+                for p in _prompts(5, seed=3)]
+        results = [c.result(r, timeout=120.0) for r in rids]
+        assert all(r.ok and len(r.tokens) == NEW_TOKENS
+                   for r in results)
+        # streaming arrived incrementally for at least some request
+        assert server.stats()["finished"] == 5
+    finally:
+        stop.set()
+        t.join(timeout=90)
+        c.close()
+        server.close()
+    assert not t.is_alive()
+    assert len(server.queue) == 0 and server.scheduler.n_live == 0
+
+
+def test_backpressure_over_the_wire(params):
+    """A full queue rejects with retry_after; the client sees it as a
+    terminal `rejected` without ever occupying a slot."""
+    server = RolloutServer(
+        _backend(params, n_slots=1), server_name="e2e/2",
+        queue=RequestQueue(max_depth=2, n_slots=1), seed=2)
+    c = RolloutClient(server.address)
+    try:
+        rids = [c.submit(p) for p in _prompts(4, seed=5)]
+        # pump admission only (no decode yet): serve_step admits
+        # nothing until the messages arrive, so loop until all four
+        # submissions were adjudicated
+        seen = {}
+        for _ in range(500):
+            server.serve_step(poll_timeout=0.002)
+            for rid in rids:
+                if rid in seen:
+                    continue
+                try:
+                    kind, data = c.next_event(rid, timeout=0.002)
+                except TimeoutError:
+                    continue
+                if kind in ("accepted", "rejected"):
+                    seen[rid] = (kind, data)
+            if len(seen) == 4:
+                break
+        kinds = [seen[r][0] for r in rids]
+        # 1 slot + depth-2 queue: at least one rejection among four
+        # fast submissions; every rejection carries the hint
+        assert "rejected" in kinds
+        for rid in rids:
+            kind, data = seen[rid]
+            if kind == "rejected":
+                assert data["reason"] == "backpressure"
+                assert data["retry_after"] > 0
+        # the accepted ones still finish
+        accepted = [r for r in rids if seen[r][0] == "accepted"]
+        results = _collect(server, [c], {0: accepted})
+        assert all(results[r].ok for r in accepted)
+    finally:
+        c.close()
+        server.close()
