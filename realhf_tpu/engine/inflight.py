@@ -166,13 +166,21 @@ class InflightBatchingGenerator:
             self.release_slot(slot)
         return out
 
+    @property
+    def max_prompt_len(self) -> int:
+        """Longest admissible prompt: the cache row minus the decode
+        budget. Admission layers (serving.RequestQueue) check this so
+        oversized prompts are rejected before reaching a slot."""
+        return self.cache_len - self.g.max_new_tokens
+
     # ------------------------------------------------------------------
     def fill_slot(self, slot: int, request_id: int,
                   prompt: np.ndarray):
-        max_prompt = self.cache_len - self.g.max_new_tokens
-        assert len(prompt) <= max_prompt, (
-            f"prompt of {len(prompt)} tokens exceeds max_prompt_len "
-            f"{max_prompt}")
+        max_prompt = self.max_prompt_len
+        if len(prompt) > max_prompt:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds max_prompt_len "
+                f"{max_prompt}")
         lp = min(_bucket(len(prompt)), max_prompt)
         ids = np.full((1, lp), self.pad, np.int32)
         seg = np.zeros((1, lp), np.int32)

@@ -71,9 +71,15 @@ class RequestQueue:
     """
 
     def __init__(self, max_depth: int = 256, n_slots: int = 1,
+                 max_prompt_len: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.max_depth = max_depth
         self.n_slots = max(1, n_slots)
+        #: longest admissible prompt (tokens); None = unchecked. The
+        #: server fills this from the backend so oversized prompts are
+        #: rejected at admission instead of tripping a fill_slot error
+        #: deep inside the scheduler.
+        self.max_prompt_len = max_prompt_len
         self._clock = clock
         self._lock = threading.Lock()
         self._by_class: Dict[Priority, List[GenRequest]] = {
@@ -98,6 +104,10 @@ class RequestQueue:
             if req.deadline is not None and req.deadline <= now:
                 self.stats["rejected"] += 1
                 return AdmissionVerdict(False, reason="expired")
+            if (self.max_prompt_len is not None
+                    and len(req.prompt) > self.max_prompt_len):
+                self.stats["rejected"] += 1
+                return AdmissionVerdict(False, reason="prompt_too_long")
             if req.min_weight_version > current_weight_version:
                 self.stats["rejected"] += 1
                 return AdmissionVerdict(

@@ -47,7 +47,9 @@ class ServeEvent:
     kinds: ``started`` (entered a slot), ``tokens`` (incremental
     delta), ``done`` (finished, data carries the FinishedRollout),
     ``stale`` (finished/evicted beyond the staleness bound),
-    ``expired`` (deadline passed while decoding), ``cancelled``.
+    ``expired`` (deadline passed while decoding), ``cancelled``,
+    ``rejected`` (the backend refused the prompt at prefill time --
+    admission normally catches this first via ``max_prompt_len``).
     """
     kind: str
     rid: str
@@ -98,7 +100,7 @@ class ContinuousScheduler:
         self._next_id = 0
         self.stats = dict(prefills=0, decode_chunks=0, decode_steps=0,
                           tokens_out=0, finished=0, expired=0, stale=0,
-                          cancelled=0, swaps=0,
+                          cancelled=0, swaps=0, fill_failed=0,
                           sequential_equiv_steps=0)
 
     # ------------------------------------------------------------------
@@ -129,15 +131,25 @@ class ContinuousScheduler:
         self.backend.release_slot(seq.slot)
 
     # ------------------------------------------------------------------
+    def poll_weights(self) -> Optional[int]:
+        """Install pending weights, if any. Safe whenever no decode
+        chunk is in flight -- ``step`` calls it between iterations, and
+        the server calls it directly while idle so a pushed version
+        becomes visible to admission without waiting for traffic.
+        Returns the newly installed version or None."""
+        swapped = self.weight_sync.poll(self.backend.swap_params)
+        if swapped is not None:
+            self.stats["swaps"] += 1
+        return swapped
+
+    # ------------------------------------------------------------------
     def step(self, key, admit: bool = True) -> List[ServeEvent]:
         """One serve iteration; returns the events it produced."""
         events: List[ServeEvent] = []
         now = self._clock()
 
         # 1. weight swap between iterations
-        swapped = self.weight_sync.poll(self.backend.swap_params)
-        if swapped is not None:
-            self.stats["swaps"] += 1
+        self.poll_weights()
         version = self.weight_sync.version
 
         # 2. evictions: deadline / doomed-stale sequences stop burning
@@ -163,7 +175,20 @@ class ContinuousScheduler:
                 req.started_at = now
                 int_id = self._next_id
                 self._next_id += 1
-                self.backend.fill_slot(slot, int_id, req.prompt)
+                try:
+                    self.backend.fill_slot(slot, int_id, req.prompt)
+                except Exception as e:  # noqa: BLE001 - one bad
+                    # request must not crash the serve loop and drop
+                    # every other in-flight sequence
+                    logger.error("fill_slot failed for %s: %r",
+                                 req.rid, e)
+                    self.backend.release_slot(slot)
+                    self.stats["fill_failed"] += 1
+                    events.append(ServeEvent(
+                        "rejected", req.rid,
+                        dict(reason="fill_failed", error=str(e),
+                             retry_after=None)))
+                    continue
                 self._active[int_id] = _ActiveSeq(
                     int_id, slot, req, version_start=version)
                 self._by_slot[slot] = int_id

@@ -85,6 +85,12 @@ class RolloutServer:
         # explicit None check: an EMPTY RequestQueue is falsy (__len__)
         self.queue = queue if queue is not None else RequestQueue(
             n_slots=getattr(backend, "n_slots", 1))
+        if self.queue.max_prompt_len is None:
+            # oversized prompts must be rejected at admission -- past
+            # this point they only surface as a fill_slot failure deep
+            # inside the scheduler
+            self.queue.max_prompt_len = getattr(
+                backend, "max_prompt_len", None)
         self.weight_sync = weight_sync or WeightSync()
         self.scheduler = ContinuousScheduler(
             backend, self.queue, self.weight_sync,
@@ -119,6 +125,13 @@ class RolloutServer:
             self._key, sub = jax.random.split(self._key)
             events = self.scheduler.step(sub, admit=not self._draining)
             self._deliver(events)
+        else:
+            # install pushed weights even with no traffic (no decode
+            # chunk is in flight, so swapping is safe): otherwise a
+            # client insisting on min_weight_version is rejected
+            # "weights_behind" forever -- the rejection enqueues
+            # nothing, so no scheduler step would ever run the poll
+            self.scheduler.poll_weights()
         for req in self.queue.take_expired():
             self._send(req.rid, "expired", {})
         return handled
@@ -195,19 +208,25 @@ class RolloutServer:
         ident = self._routes.get(rid)
         if ident is None:
             return
-        if kind in TERMINAL_KINDS:
-            del self._routes[rid]
         try:
             self._sock.send_multipart(
                 [ident, pickle.dumps((kind, rid, data))])
         except zmq.ZMQError as e:
-            logger.warning("Dropping %s for %s: %s", kind, rid, e)
+            # keep the route: a terminal event dropped here would
+            # otherwise be lost for good, blocking the client until
+            # its own timeout; with the route intact a later terminal
+            # event (e.g. drain-time cancel) can still reach it
+            logger.warning("Dropping %s for %s (route kept): %s",
+                           kind, rid, e)
+            return
+        if kind in TERMINAL_KINDS:
+            del self._routes[rid]
 
     def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
-        if kind in TERMINAL_KINDS:
-            self._routes.pop(rid, None)
         self._sock.send_multipart(
             [ident, pickle.dumps((kind, rid, data))])
+        if kind in TERMINAL_KINDS:
+            self._routes.pop(rid, None)
 
     # ------------------------------------------------------------------
     def drain(self, timeout: float = 30.0):

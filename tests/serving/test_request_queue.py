@@ -75,6 +75,20 @@ def test_min_weight_version_gate():
                     current_weight_version=3).accepted
 
 
+def test_prompt_too_long_rejected_at_admission():
+    q = RequestQueue(max_depth=10, max_prompt_len=8, clock=Clock())
+    ok = GenRequest(rid="fits", prompt=np.zeros(8, np.int32))
+    assert q.submit(ok).accepted
+    big = GenRequest(rid="big", prompt=np.zeros(9, np.int32))
+    v = q.submit(big)
+    assert not v.accepted and v.reason == "prompt_too_long"
+    assert len(q) == 1
+    # unchecked by default
+    q2 = RequestQueue(max_depth=10, clock=Clock())
+    assert q2.submit(GenRequest(
+        rid="big", prompt=np.zeros(9999, np.int32))).accepted
+
+
 def test_cancel_removes_queued_entry():
     q = RequestQueue(max_depth=10, clock=Clock())
     q.submit(_req("a"))

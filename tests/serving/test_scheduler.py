@@ -215,6 +215,43 @@ def test_drain_mode_admits_nothing():
     assert len(q) == 1  # still queued; the server bounces it
 
 
+def test_fill_slot_failure_rejects_one_request_not_the_loop():
+    """A backend that refuses a prompt at prefill time (e.g. the
+    inflight generator's max_prompt_len check, if admission somehow
+    missed it) must cost only that request: the serve loop survives,
+    the slot is freed, and queued work keeps flowing."""
+    sched, q, _ = _mk(n_slots=1, chunk=4)
+    orig = sched.backend.fill_slot
+
+    def picky_fill(slot, int_id, prompt):
+        if int(prompt[0]) > 50:
+            raise ValueError("prompt exceeds max_prompt_len")
+        orig(slot, int_id, prompt)
+
+    sched.backend.fill_slot = picky_fill
+    _submit(q, "huge", need=100)  # rejected by the backend
+    _submit(q, "ok", need=4)
+    events = run_until_idle(sched)
+    rej = [e for e in events if e.kind == "rejected"]
+    assert [e.rid for e in rej] == ["huge"]
+    assert rej[0].data["reason"] == "fill_failed"
+    assert "max_prompt_len" in rej[0].data["error"]
+    assert any(e.kind == "done" and e.rid == "ok" for e in events)
+    assert sched.stats["fill_failed"] == 1
+    assert sched.stats["finished"] == 1
+    assert sched.n_live == 0 and sched.backend.free_slots() == [0]
+
+
+def test_poll_weights_installs_while_idle():
+    sched, q, _ = _mk()
+    sched.weight_sync.push("v5", 5)
+    assert sched.poll_weights() == 5
+    assert sched.backend.params == "v5"
+    assert sched.weight_sync.version == 5
+    assert sched.stats["swaps"] == 1
+    assert sched.poll_weights() is None
+
+
 def test_weight_sync_monotonic_and_pending_overwrite():
     ws = WeightSync()
     ws.push("a", 1)

@@ -249,6 +249,89 @@ def test_serve_forever_thread_and_drain(params):
     assert len(server.queue) == 0 and server.scheduler.n_live == 0
 
 
+def test_oversized_prompt_rejected_not_fatal(params):
+    """Regression: an oversized prompt used to pass admission, then
+    trip the backend's length check inside scheduler.step -- outside
+    the malformed-message guard -- killing the server and every
+    in-flight sequence. It must bounce at the door as
+    `prompt_too_long` while concurrent work finishes untouched."""
+    server = RolloutServer(
+        _backend(params, n_slots=2), server_name="e2e/3",
+        queue=RequestQueue(max_depth=8, n_slots=2), seed=3)
+    c = RolloutClient(server.address)
+    try:
+        limit = server.queue.max_prompt_len
+        assert limit is not None  # picked up from the backend
+        ok = c.submit(_prompts(1, seed=20)[0], ttl=300.0)
+        big = c.submit(np.ones(limit + 1, np.int32))
+        res = _collect(server, [c], {0: [ok, big]})
+        assert res[big].status == "rejected"
+        assert res[big].data["reason"] == "prompt_too_long"
+        assert res[ok].ok and len(res[ok].tokens) == NEW_TOKENS
+        assert server.stats()["fill_failed"] == 0
+    finally:
+        c.close()
+        server.close()
+
+
+def test_idle_weight_push_installs_without_traffic(params):
+    """Regression: weight_sync.poll only ran inside scheduler.step, so
+    weights pushed to an idle server never installed and a client
+    insisting on min_weight_version livelocked on `weights_behind`
+    (its rejection enqueues nothing that would trigger a step)."""
+    server = RolloutServer(
+        _backend(params, n_slots=1), server_name="e2e/4",
+        queue=RequestQueue(max_depth=4, n_slots=1), seed=4)
+    c = RolloutClient(server.address)
+    try:
+        server.weight_sync.push(params, 1)
+        server.serve_step(poll_timeout=0.0)  # idle: still installs
+        assert server.weight_sync.version == 1
+        assert server.stats()["swaps"] == 1
+        rid = c.submit(_prompts(1, seed=21)[0], min_weight_version=1)
+        res = _collect(server, [c], {0: [rid]})[rid]
+        assert res.ok and res.weight_version == 1
+    finally:
+        c.close()
+        server.close()
+
+
+def test_terminal_send_failure_keeps_route(params):
+    """Regression: _send dropped the rid's client route before
+    send_multipart, so a zmq error permanently lost the terminal
+    event. The route must survive the failure and close out on the
+    next successful terminal send."""
+    import zmq
+
+    class FlakySock:
+        def __init__(self):
+            self.sent = []
+            self.fail = True
+
+        def send_multipart(self, frames):
+            if self.fail:
+                raise zmq.ZMQError()
+            self.sent.append(frames)
+
+    server = RolloutServer(
+        _backend(params, n_slots=1), server_name="e2e/5",
+        queue=RequestQueue(max_depth=4, n_slots=1), seed=5)
+    try:
+        real, fake = server._sock, FlakySock()
+        server._routes["r0"] = b"ident"
+        server._sock = fake
+        server._send("r0", "done", {})
+        assert "r0" in server._routes  # kept: event can still arrive
+        assert fake.sent == []
+        fake.fail = False
+        server._send("r0", "cancelled", {})
+        assert "r0" not in server._routes  # delivered, stream closed
+        assert len(fake.sent) == 1
+        server._sock = real
+    finally:
+        server.close()
+
+
 def test_backpressure_over_the_wire(params):
     """A full queue rejects with retry_after; the client sees it as a
     terminal `rejected` without ever occupying a slot."""
