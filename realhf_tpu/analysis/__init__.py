@@ -1,0 +1,54 @@
+"""graft-lint: framework-aware static analysis for realhf_tpu.
+
+Four checker families guard the invariants the runtime's correctness
+rests on (docs/static_analysis.md):
+
+- ``jax-purity``: no host syncs / impure calls under JAX tracing, no
+  per-iteration host transfers in decode hot paths.
+- ``concurrency``: no blocking calls under locks, no unsynchronized
+  cross-thread fields, no unjoined non-daemon threads.
+- ``collective-determinism``: no unordered iteration feeding sharding
+  layouts, collectives, or name_resolve keys.
+- ``dfg-invariants``: registered experiment DFGs are acyclic, edge-
+  and mesh-compatible, with totally ordered weight reallocations.
+
+CLI: ``python -m realhf_tpu.analysis [--fail-on-new] [--baseline F]
+[--checker NAME] [paths...]`` -- see ``__main__.py``.
+"""
+
+from realhf_tpu.analysis.baseline import (  # noqa: F401
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from realhf_tpu.analysis.concurrency import ConcurrencyChecker
+from realhf_tpu.analysis.core import (  # noqa: F401
+    AstChecker,
+    Module,
+    ProjectChecker,
+    run_analysis,
+)
+from realhf_tpu.analysis.determinism import DeterminismChecker
+from realhf_tpu.analysis.dfg_invariants import DfgInvariantsChecker
+from realhf_tpu.analysis.finding import Finding  # noqa: F401
+from realhf_tpu.analysis.jax_purity import JaxPurityChecker
+
+#: family name -> checker class, in documentation order
+CHECKER_CLASSES = {
+    JaxPurityChecker.name: JaxPurityChecker,
+    ConcurrencyChecker.name: ConcurrencyChecker,
+    DeterminismChecker.name: DeterminismChecker,
+    DfgInvariantsChecker.name: DfgInvariantsChecker,
+}
+
+
+def all_checkers(names=None):
+    """Instantiate the requested checker families (all by default)."""
+    if names:
+        unknown = sorted(set(names) - set(CHECKER_CLASSES))
+        if unknown:
+            raise ValueError(
+                f"unknown checker(s) {unknown}; "
+                f"available: {sorted(CHECKER_CLASSES)}")
+        return [CHECKER_CLASSES[n]() for n in names]
+    return [cls() for cls in CHECKER_CLASSES.values()]

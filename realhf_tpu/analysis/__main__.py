@@ -1,0 +1,116 @@
+"""graft-lint CLI.
+
+::
+
+    python -m realhf_tpu.analysis [paths...]
+        [--checker NAME ...]        # default: all four families
+        [--baseline FILE]           # default: scripts/lint_baseline.json
+        [--fail-on-new]             # exit 1 only on findings beyond
+                                    # the baseline
+        [--write-baseline]          # accept the current findings
+        [--format text|json]
+        [--no-dfg]                  # skip the import-time DFG pass
+
+Default paths: the ``realhf_tpu`` package under the current directory.
+Exit codes: 0 = clean (or informational run), 1 = new findings with
+``--fail-on-new``, 2 = usage error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from realhf_tpu.analysis import (
+    CHECKER_CLASSES,
+    all_checkers,
+    diff_against_baseline,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join("scripts", "lint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m realhf_tpu.analysis",
+        description="graft-lint: framework-aware static analysis "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to scan (default: "
+                         "./realhf_tpu)")
+    ap.add_argument("--checker", action="append", default=None,
+                    choices=sorted(CHECKER_CLASSES),
+                    help="run only this family (repeatable)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="diff against the baseline; exit 1 on NEW "
+                         "findings only")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the accepted "
+                         "baseline and exit 0")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--no-dfg", action="store_true",
+                    help="skip the import-time dfg-invariants pass "
+                         "(e.g. scanning a fixture tree)")
+    args = ap.parse_args(argv)
+
+    try:
+        checkers = all_checkers(args.checker)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 2
+    if args.no_dfg:
+        checkers = [c for c in checkers
+                    if c.name != "dfg-invariants"]
+
+    paths = args.paths or ["realhf_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    findings = run_analysis(paths, checkers)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"Wrote {len(findings)} accepted finding(s) to "
+              f"{args.baseline}.")
+        return 0
+
+    if args.fail_on_new:
+        baseline = load_baseline(args.baseline)
+        new, fixed = diff_against_baseline(findings, baseline)
+        if args.format == "json":
+            print(json.dumps({
+                "new": [f.to_json() for f in new],
+                "fixed_fingerprints": fixed,
+                "total": len(findings),
+            }, indent=1))
+        else:
+            for f in new:
+                print(f"NEW {f.format()}")
+            if fixed:
+                print(f"note: {len(fixed)} baseline entr"
+                      f"{'y is' if len(fixed) == 1 else 'ies are'} "
+                      "fixed; regenerate with --write-baseline to "
+                      "prune.")
+            print(f"graft-lint: {len(findings)} finding(s), "
+                  f"{len(new)} new vs baseline "
+                  f"({os.path.relpath(args.baseline)}).")
+        return 1 if new else 0
+
+    if args.format == "json":
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"graft-lint: {len(findings)} finding(s).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

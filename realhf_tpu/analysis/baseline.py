@@ -1,0 +1,74 @@
+"""Baseline load/diff/write for graft-lint.
+
+The baseline (``scripts/lint_baseline.json``) records the ACCEPTED
+findings of the current tree as fingerprint -> count (plus one example
+per fingerprint for humans). ``--fail-on-new`` exits nonzero only on
+findings beyond the baseline, so the gate ratchets: new debt is
+blocked, old debt shrinks as fixes land (regenerate with
+``--write-baseline`` after fixing; stale entries are pruned).
+"""
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from realhf_tpu.analysis.finding import Finding, count_by_fingerprint
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> accepted count; {} for a missing file."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, int] = {}
+    for fp, entry in data.get("findings", {}).items():
+        out[fp] = int(entry.get("count", 1)) if isinstance(entry, dict) \
+            else int(entry)
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    counts = count_by_fingerprint(findings)
+    examples = {}
+    for f in findings:
+        examples.setdefault(f.fingerprint, f)
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": {
+            fp: {
+                "count": counts[fp],
+                "code": examples[fp].code,
+                "path": examples[fp].path,
+                "symbol": examples[fp].symbol,
+                "message": examples[fp].message,
+            }
+            for fp in sorted(counts)
+        },
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_against_baseline(
+    findings: List[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[str]]:
+    """(new_findings, fixed_fingerprints).
+
+    A fingerprint present N times in the baseline admits N current
+    occurrences; the (N+1)-th and later are new. Baseline entries with
+    no current occurrence are reported as fixed (prune by
+    regenerating the baseline).
+    """
+    budget = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:  # findings arrive location-sorted
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+        else:
+            new.append(f)
+    fixed = sorted(fp for fp, n in budget.items() if n > 0)
+    return new, fixed

@@ -1,0 +1,258 @@
+"""concurrency checker: locks vs blocking calls, unsynced thread state.
+
+The control plane's postmortems (PR 1 ``last_exec_info`` read-back
+race, PR 2 per-role Engine lock and ZMQ terminal-event loss) all
+reduce to three mechanical patterns this checker watches:
+
+- ``conc-lock-blocking``: a blocking call (ZMQ send/recv, socket
+  connect/accept, subprocess, ``name_resolve.wait``, ``sleep``,
+  thread ``join``) issued while a lock is held. A stalled peer then
+  stalls every thread contending for the lock. Serialize only the
+  shared-state mutation; do wire/pickle work outside the critical
+  section.
+- ``conc-unsynced-field``: an attribute written from a thread entry
+  point (``Thread(target=...)`` or a ``threading.Thread`` subclass's
+  ``run``) and also touched from other methods, with no lock on
+  either side.
+- ``conc-unjoined-thread``: a non-daemon ``threading.Thread`` that is
+  never ``join``-ed -- it outlives shutdown and hides exit hangs.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from realhf_tpu.analysis.core import (
+    AstChecker,
+    Module,
+    call_name,
+    dotted_name,
+)
+from realhf_tpu.analysis.finding import Finding
+
+#: method names that block on a peer / the OS
+BLOCKING_METHODS = {
+    "send", "send_multipart", "send_pyobj", "send_string", "send_json",
+    "recv", "recv_multipart", "recv_pyobj", "recv_string", "recv_json",
+    "connect", "accept", "join", "wait_for",
+}
+BLOCKING_CALLS = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "subprocess.Popen", "name_resolve.wait", "name_resolve.get_subtree",
+    "socket.create_connection",
+}
+#: blocking methods excused when the receiver is plainly bounded
+#: (queue.get(timeout=...) etc. stay flagged -- keep the list tight)
+
+_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: attribute values that are themselves thread-safe handshakes
+_SAFE_CTORS = ("threading.Event", "threading.Lock", "threading.RLock",
+               "threading.Condition", "threading.Semaphore",
+               "threading.BoundedSemaphore", "queue.Queue",
+               "queue.SimpleQueue", "collections.deque", "Event",
+               "Lock", "RLock", "Condition")
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    try:
+        src = ast.unparse(expr)
+    except Exception:  # noqa: BLE001 - best effort on exotic nodes
+        return False
+    return bool(_LOCKISH.search(src))
+
+
+class ConcurrencyChecker(AstChecker):
+    name = "concurrency"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith((
+            "realhf_tpu/system/", "realhf_tpu/serving/",
+            "realhf_tpu/base/", "realhf_tpu/apps/",
+            "realhf_tpu/parallel/"))
+
+    def check(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_lock_blocking(module))
+        findings.extend(self._check_unjoined_threads(module))
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class_fields(module, node))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_lock_blocking(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, lock_depth: int, symbol: str):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                symbol = node.name
+                lock_depth = 0  # a def body runs later, not under the
+                # lexically-enclosing with
+            if isinstance(node, ast.With):
+                if any(_is_lock_expr(i.context_expr)
+                       for i in node.items):
+                    lock_depth += 1
+            if lock_depth > 0 and isinstance(node, ast.Call):
+                nm = call_name(node)
+                blocking = nm in BLOCKING_CALLS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in BLOCKING_METHODS
+                    and not _is_lock_expr(node.func.value)
+                    # "sep".join(parts) is str.join, not Thread.join
+                    and not isinstance(node.func.value, ast.Constant))
+                if blocking:
+                    what = nm or f".{node.func.attr}"
+                    findings.append(self.finding(
+                        module, "conc-lock-blocking", node,
+                        f"blocking call `{what}` while holding a lock "
+                        f"in `{symbol}`; move wire/serialization work "
+                        "outside the critical section",
+                        symbol=symbol))
+            for child in ast.iter_child_nodes(node):
+                visit(child, lock_depth, symbol)
+
+        visit(module.tree, 0, "")
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_unjoined_threads(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        has_join = ".join(" in module.source
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = call_name(node)
+            if nm.rsplit(".", 1)[-1] != "Thread" or nm == "QThread":
+                continue
+            daemon = next((kw for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if daemon is not None and not (
+                    isinstance(daemon.value, ast.Constant)
+                    and daemon.value.value is False):
+                continue  # daemon=True (or dynamic: benefit of doubt)
+            if daemon is None and has_join:
+                continue  # joined somewhere; good enough statically
+            findings.append(self.finding(
+                module, "conc-unjoined-thread", node,
+                "non-daemon Thread never joined in this module; pass "
+                "daemon=True or join it on shutdown",
+                symbol=""))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_class_fields(self, module: Module,
+                            cls: ast.ClassDef) -> List[Finding]:
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not methods:
+            return []
+        thread_entries = self._thread_entry_methods(cls, methods)
+        if not thread_entries:
+            return []
+        safe_attrs = self._safe_attrs(methods.get("__init__"))
+
+        # attr -> (locked?, node) per method kind
+        def attr_uses(fn, store_only: bool):
+            uses: Dict[str, Tuple[bool, ast.AST]] = {}
+
+            def visit(node, lock_depth):
+                if isinstance(node, ast.With) and any(
+                        _is_lock_expr(i.context_expr)
+                        for i in node.items):
+                    lock_depth += 1
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    is_store = isinstance(node.ctx,
+                                          (ast.Store, ast.Del))
+                    if is_store or not store_only:
+                        prev = uses.get(node.attr)
+                        # an unlocked use wins (that's the bug)
+                        if prev is None or (prev[0]
+                                            and lock_depth == 0):
+                            uses[node.attr] = (lock_depth > 0, node)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, lock_depth)
+
+            visit(fn, 0)
+            return uses
+
+        writes_in_thread: Dict[str, Tuple[bool, ast.AST, str]] = {}
+        for name in sorted(thread_entries):
+            for attr, (locked, node) in attr_uses(
+                    methods[name], store_only=True).items():
+                if attr in safe_attrs or attr.startswith("__"):
+                    continue
+                prev = writes_in_thread.get(attr)
+                if prev is None or (prev[0] and not locked):
+                    writes_in_thread[attr] = (locked, node, name)
+
+        findings: List[Finding] = []
+        for mname, fn in sorted(methods.items()):
+            if mname in thread_entries or mname == "__init__":
+                continue
+            for attr, (locked, _n) in attr_uses(
+                    fn, store_only=False).items():
+                hit = writes_in_thread.get(attr)
+                if hit is None:
+                    continue
+                t_locked, t_node, t_name = hit
+                if locked or t_locked:
+                    continue  # one side synchronized: different bug
+                findings.append(self.finding(
+                    module, "conc-unsynced-field", t_node,
+                    f"`self.{attr}` written in thread entry "
+                    f"`{cls.name}.{t_name}` and used in "
+                    f"`{cls.name}.{mname}` without a common lock",
+                    symbol=f"{cls.name}.{t_name}"))
+                writes_in_thread.pop(attr)  # one finding per attr
+        return findings
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _thread_entry_methods(cls: ast.ClassDef,
+                              methods: Dict) -> Set[str]:
+        entries: Set[str] = set()
+        is_thread_subclass = any(
+            dotted_name(b).rsplit(".", 1)[-1] == "Thread"
+            for b in cls.bases)
+        if is_thread_subclass and "run" in methods:
+            entries.add("run")
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node).rsplit(".", 1)[-1] != "Thread":
+                continue
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and target.attr in methods):
+                entries.add(target.attr)
+        return entries
+
+    @staticmethod
+    def _safe_attrs(init: Optional[ast.AST]) -> Set[str]:
+        """Attributes initialized to sync primitives (Events, Locks,
+        Queues) are their own synchronization."""
+        safe: Set[str] = set()
+        if init is None:
+            return safe
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if call_name(node.value) not in _SAFE_CTORS:
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    safe.add(t.attr)
+        return safe

@@ -1,0 +1,175 @@
+"""graft-lint engine: shared visitor core and checker registry.
+
+AST checkers subclass :class:`AstChecker` and get one parsed
+:class:`Module` per file; project checkers subclass
+:class:`ProjectChecker` and run once per invocation (the
+dfg-invariants pass imports experiment registries instead of reading
+syntax). ``run_analysis`` walks the requested paths, applies per-file
+suppressions, and returns the surviving findings sorted by location.
+"""
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from realhf_tpu.analysis.finding import Finding
+from realhf_tpu.analysis.suppress import Suppressions
+
+#: directories never scanned (generated trees, VCS, caches)
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
+             ".claude"}
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file handed to AST checkers."""
+    path: str          # absolute
+    relpath: str       # repo-relative posix path (used in findings)
+    source: str
+    tree: ast.AST
+    suppressions: Suppressions
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> Optional["Module"]:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, ValueError):
+            return None  # unparseable files are not lint findings
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        return cls(path=path, relpath=rel, source=source, tree=tree,
+                   suppressions=Suppressions(source))
+
+
+class AstChecker:
+    """Base of per-file checkers. Subclasses set ``name`` (family id)
+    and implement ``check(module) -> List[Finding]``."""
+
+    name: str = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        """Default file filter. Only consulted for files inside the
+        ``realhf_tpu`` package tree -- external trees (fixture dirs,
+        explicit file arguments outside the package) always run every
+        checker, which is what the fixture tests rely on."""
+        return True
+
+    def check(self, module: Module) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, code: str, node, message: str,
+                symbol: str = "") -> Finding:
+        return Finding(
+            checker=self.name, code=code, path=module.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message, symbol=symbol)
+
+
+class ProjectChecker:
+    """Base of import-time (whole-project) checkers."""
+
+    name: str = ""
+
+    def check_project(self, root: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> Iterable[str]:
+    """Yield .py files under ``paths`` (files or directories),
+    deterministically sorted so every host reports findings in the
+    same order."""
+    seen = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            if p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    if fp not in seen:
+                        seen.add(fp)
+                        yield fp
+
+
+def _in_package(relpath: str) -> bool:
+    return relpath == "realhf_tpu" or relpath.startswith("realhf_tpu/")
+
+
+def run_analysis(
+    paths: Sequence[str],
+    checkers: Sequence[object],
+    root: Optional[str] = None,
+    on_file: Optional[Callable[[str], None]] = None,
+) -> List[Finding]:
+    """Run ``checkers`` over ``paths``; returns unsuppressed findings
+    sorted by (path, line, code)."""
+    root = os.path.abspath(root or os.getcwd())
+    ast_checkers = [c for c in checkers if isinstance(c, AstChecker)]
+    project_checkers = [c for c in checkers
+                        if isinstance(c, ProjectChecker)]
+    findings: List[Finding] = []
+    for path in iter_python_files(paths, root):
+        if on_file is not None:
+            on_file(path)
+        module = Module.parse(path, root)
+        if module is None:
+            continue
+        for checker in ast_checkers:
+            if (_in_package(module.relpath)
+                    and not checker.applies_to(module.relpath)):
+                continue
+            findings.extend(
+                module.suppressions.filter(checker.check(module)))
+    for checker in project_checkers:
+        findings.extend(checker.check_project(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code,
+                                 f.message))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# Shared AST helpers used by several checker families.
+# ----------------------------------------------------------------------
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.scan`` for the matching Attribute/Name chain, ""
+    otherwise (calls, subscripts, ... yield "")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def enclosing_symbols(tree: ast.AST) -> Dict[ast.AST, str]:
+    """node -> qualname of the innermost enclosing def/class, for
+    every node in ``tree`` (module-level nodes map to "")."""
+    out: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, qual: str):
+        for child in ast.iter_child_nodes(node):
+            child_qual = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_qual = (f"{qual}.{child.name}" if qual
+                              else child.name)
+            out[child] = child_qual
+            visit(child, child_qual)
+    out[tree] = ""
+    visit(tree, "")
+    return out

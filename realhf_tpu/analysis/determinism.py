@@ -1,0 +1,122 @@
+"""collective-determinism checker: unordered iteration feeding layouts.
+
+Every host in a multi-process mesh must issue identical collectives in
+identical order, construct identical ``PartitionSpec``/sharding
+layouts, and build identical ``name_resolve`` keys -- a ``dict`` or
+``set`` whose insertion order differs across hosts (config dicts built
+from network messages, resolved worker maps, ...) silently breaks
+that: the program deadlocks or, worse, shards land transposed.
+
+Rule ``det-unsorted-iter``: a ``for`` loop or comprehension iterating
+``*.items()`` / ``*.keys()`` / ``*.values()`` / a ``set``
+(un-``sorted``) whose body constructs partition specs / shardings,
+issues collectives or ``device_put``, or builds ``name_resolve`` keys.
+Wrap the iterable in ``sorted(...)``.
+"""
+
+import ast
+from typing import List, Optional
+
+from realhf_tpu.analysis.core import (
+    AstChecker,
+    Module,
+    call_name,
+    dotted_name,
+    enclosing_symbols,
+)
+from realhf_tpu.analysis.finding import Finding
+
+#: names whose presence in a loop body marks it layout/collective
+#: producing
+LAYOUT_NAMES = {
+    "PartitionSpec", "NamedSharding", "Mesh", "make_mesh",
+    "with_sharding_constraint", "device_put", "make_array_from_callback",
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "axis_index", "broadcast_one_to_all",
+    "process_allgather",
+}
+#: dotted prefixes equally marking the body (module-qualified forms)
+LAYOUT_PREFIXES = ("name_resolve.", "jax.sharding.", "multihost.")
+
+_DICT_METHODS = {"items", "keys", "values"}
+
+
+def _unordered_iterable(node: ast.AST) -> Optional[str]:
+    """A human-readable description of why ``node`` iterates in
+    unordered fashion, or None when the order is deterministic."""
+    if isinstance(node, ast.Call):
+        nm = call_name(node)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DICT_METHODS):
+            return f"dict.{node.func.attr}()"
+        if nm == "set" or nm == "frozenset":
+            return f"{nm}(...)"
+    if isinstance(node, ast.Set):
+        return "set literal"
+    if isinstance(node, (ast.BinOp,)) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        # set algebra: a | b, a & b, a - b over sets
+        if any(isinstance(s, (ast.Set, ast.Call)) and (
+                isinstance(s, ast.Set) or call_name(s) == "set")
+                for s in (node.left, node.right)):
+            return "set expression"
+    return None
+
+
+def _body_builds_layout(body_nodes) -> Optional[str]:
+    for stmt in body_nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                nm = call_name(node)
+                last = nm.rsplit(".", 1)[-1]
+                if last in LAYOUT_NAMES or nm.startswith(
+                        LAYOUT_PREFIXES):
+                    return nm or last
+                if last == "P" and nm in ("P", "jax.P"):
+                    return "PartitionSpec (P)"
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                nm = (node.id if isinstance(node, ast.Name)
+                      else dotted_name(node))
+                if nm.rsplit(".", 1)[-1] in LAYOUT_NAMES:
+                    return nm
+    return None
+
+
+class DeterminismChecker(AstChecker):
+    name = "collective-determinism"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith((
+            "realhf_tpu/models/", "realhf_tpu/parallel/",
+            "realhf_tpu/system/", "realhf_tpu/serving/",
+            "realhf_tpu/engine/", "realhf_tpu/base/"))
+
+    def check(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        symbols = enclosing_symbols(module.tree)
+        for node in ast.walk(module.tree):
+            iters = []
+            body = None
+            if isinstance(node, ast.For):
+                iters = [node.iter]
+                body = node.body
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                iters = [g.iter for g in node.generators]
+                body = ([node.key, node.value]
+                        if isinstance(node, ast.DictComp)
+                        else [node.elt])
+            for it in iters:
+                why = _unordered_iterable(it)
+                if why is None:
+                    continue
+                built = _body_builds_layout(body)
+                if built is None:
+                    continue
+                findings.append(self.finding(
+                    module, "det-unsorted-iter", node,
+                    f"iteration over {why} constructs `{built}` -- "
+                    "hosts may disagree on order; wrap the iterable "
+                    "in sorted(...)",
+                    symbol=symbols.get(node, "")))
+        return findings

@@ -1,0 +1,203 @@
+"""dfg-invariants checker: import-time validation of experiment DFGs.
+
+Unlike the AST families, this pass LOADS the registered experiment
+configs (``realhf_tpu.experiments.ALL_EXPERIMENT_CLASSES``), builds
+each spec with safe defaults, and statically validates the resulting
+dataflow graph -- the invariants the paper's per-MFC-mesh execution
+model rests on:
+
+- ``dfg-build-failed``: the experiment's ``build()`` (or graph
+  resolution) raises under defaults -- the config cannot even be
+  validated.
+- ``dfg-cycle`` / ``dfg-duplicate-key``: graph resolution errors
+  (cyclic MFC dependencies, two producers for one data key).
+- ``dfg-batch-mismatch``: a producer/consumer edge whose ``n_seqs``
+  don't divide -- the consumer cannot split the producer's batch into
+  whole per-DP-shard groups.
+- ``dfg-mesh-mismatch``: two MFCs placed on the SAME worker group
+  whose layouts multiply to different world sizes -- a group has a
+  fixed device count, so all layouts on it must use all of it.
+- ``dfg-bad-alloc``: allocation normalization errors (empty/duplicate
+  worker groups, allocation naming an unknown MFC).
+- ``dfg-realloc-order``: two MFCs of one role that carry distinct
+  weight layouts (or explicit ParamReallocHooks) are CONCURRENT in
+  the DAG -- reallocations of that role's weights would race; the
+  realloc chain must be totally ordered, and the per-role orders must
+  embed in one global topological order (guaranteed acyclic graph +
+  per-role chains).
+"""
+
+import inspect
+import os
+from typing import List
+
+from realhf_tpu.analysis.core import ProjectChecker
+from realhf_tpu.analysis.finding import Finding
+
+
+def _spec_location(cls, root: str):
+    """(relpath, line) of an experiment config class."""
+    try:
+        path = inspect.getsourcefile(cls)
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        line = inspect.getsourcelines(cls)[1]
+        return rel, line
+    except (TypeError, OSError):
+        return "realhf_tpu/experiments", 0
+
+
+def build_default_spec(cls):
+    """Instantiate an experiment config with lint-safe defaults and
+    build its spec. Returns None for experiments with no DFG (serve)."""
+    cfg = cls()
+    cfg.experiment_name = "graft-lint"
+    cfg.trial_name = "dfg-check"
+    ds = getattr(cfg, "dataset", None)
+    if ds is not None and hasattr(ds, "path") and not ds.path:
+        ds.path = "/dev/null"
+    spec = cfg.build()
+    if not getattr(spec, "mfcs", None):
+        return None
+    return spec
+
+
+def validate_spec(name: str, spec, path: str, line: int
+                  ) -> List[Finding]:
+    """Pure validation of one built ExperimentSpec's DFG."""
+    import networkx as nx
+
+    from realhf_tpu.api.dfg import ParamReallocHook, build_graph
+
+    def finding(code, message, extra_line=0):
+        return Finding(
+            checker="dfg-invariants", code=code, path=path,
+            line=extra_line or line, col=0, message=message,
+            symbol=name)
+
+    findings: List[Finding] = []
+    try:
+        G = build_graph(spec.mfcs)
+    except ValueError as e:
+        code = ("dfg-cycle" if "cycle" in str(e)
+                else "dfg-duplicate-key" if "produced by both" in str(e)
+                else "dfg-build-failed")
+        return [finding(code, f"graph resolution failed: {e}")]
+
+    # --- per-edge batch-size compatibility -----------------------------
+    for u, v, data in sorted(G.edges(data=True)):
+        nu, nv = G.nodes[u]["object"], G.nodes[v]["object"]
+        a, b = nu.n_seqs, nv.n_seqs
+        if a <= 0 or b <= 0 or max(a, b) % min(a, b) != 0:
+            findings.append(finding(
+                "dfg-batch-mismatch",
+                f"edge {u}->{v} (key `{data.get('key')}`): producer "
+                f"n_seqs={a} and consumer n_seqs={b} do not divide"))
+
+    # --- allocations name real MFCs, normalize cleanly -----------------
+    node_names = {n.name for n in spec.mfcs}
+    for alloc_name in sorted(getattr(spec, "allocations", {}) or {}):
+        if alloc_name not in node_names:
+            findings.append(finding(
+                "dfg-bad-alloc",
+                f"allocation for unknown MFC `{alloc_name}`"))
+
+    # --- same worker group => same world size --------------------------
+    group_ws = {}
+    for node in spec.mfcs:
+        try:
+            workers = tuple(spec.workers_of_node(node.name, node.role))
+            alloc = spec.alloc_of(node.name)
+        except ValueError as e:
+            findings.append(finding(
+                "dfg-bad-alloc",
+                f"MFC `{node.name}`: bad worker group: {e}"))
+            continue
+        par = (alloc.parallel if alloc is not None
+               else spec.models[node.role].parallel
+               if node.role in spec.models else None)
+        if par is None:
+            findings.append(finding(
+                "dfg-bad-alloc",
+                f"MFC `{node.name}` references unknown model role "
+                f"`{node.role}`"))
+            continue
+        ws = par.world_size
+        prev = group_ws.get(workers)
+        if prev is not None and prev[1] != ws:
+            findings.append(finding(
+                "dfg-mesh-mismatch",
+                f"MFCs `{prev[0]}` (world={prev[1]}) and "
+                f"`{node.name}` (world={ws}) share worker group "
+                f"{list(workers)} but need different device counts"))
+        else:
+            group_ws.setdefault(workers, (node.name, ws))
+
+    # --- weight-realloc total order per role ---------------------------
+    for role in sorted({n.role for n in spec.mfcs}):
+        nodes = [n for n in spec.mfcs if n.role == role]
+        primary_par = (spec.models[role].parallel
+                       if role in spec.models else None)
+        realloc_nodes = []
+        for n in nodes:
+            alloc = spec.alloc_of(n.name)
+            hooked = any(
+                isinstance(h, ParamReallocHook)
+                for h in (list(n._pre_hooks) + list(n._post_hooks)))
+            distinct_layout = (
+                alloc is not None and primary_par is not None
+                and not _same_layout(alloc.parallel, primary_par))
+            if hooked or distinct_layout:
+                realloc_nodes.append(n)
+        for i, a in enumerate(realloc_nodes):
+            for b in realloc_nodes[i + 1:]:
+                if (nx.has_path(G, a.name, b.name)
+                        or nx.has_path(G, b.name, a.name)):
+                    continue
+                findings.append(finding(
+                    "dfg-realloc-order",
+                    f"role `{role}`: MFCs `{a.name}` and `{b.name}` "
+                    "both trigger weight reallocation but are "
+                    "concurrent in the DAG -- their reshards would "
+                    "race; order them with a data dependency"))
+    return findings
+
+
+def _same_layout(a, b) -> bool:
+    same = getattr(a, "same_layout", None)
+    if callable(same):
+        return a.same_layout(b)
+    return a == b
+
+
+class DfgInvariantsChecker(ProjectChecker):
+    name = "dfg-invariants"
+
+    def check_project(self, root: str) -> List[Finding]:
+        try:
+            from realhf_tpu.experiments import ALL_EXPERIMENT_CLASSES
+        except Exception as e:  # noqa: BLE001 - import failure is a
+            # finding, not a crash: the gate must report it
+            return [Finding(
+                checker=self.name, code="dfg-build-failed",
+                path="realhf_tpu/experiments", line=0, col=0,
+                message=f"experiment registry import failed: {e!r}",
+                symbol="")]
+        findings: List[Finding] = []
+        for name in sorted(ALL_EXPERIMENT_CLASSES):
+            cls = ALL_EXPERIMENT_CLASSES[name]
+            path, line = _spec_location(cls, root)
+            try:
+                spec = build_default_spec(cls)
+            except Exception as e:  # noqa: BLE001 - any build error
+                # is exactly what this pass exists to surface
+                findings.append(Finding(
+                    checker=self.name, code="dfg-build-failed",
+                    path=path, line=line, col=0,
+                    message=(f"experiment `{name}` failed to build "
+                             f"under defaults: {e!r}"),
+                    symbol=name))
+                continue
+            if spec is None:
+                continue  # no DFG (pure serving experiments)
+            findings.extend(validate_spec(name, spec, path, line))
+        return findings

@@ -1,0 +1,65 @@
+"""Finding model for graft-lint (docs/static_analysis.md).
+
+A Finding is one checker hit at one source location. Findings are
+diffed against a committed baseline (``scripts/lint_baseline.json``)
+so CI fails only on NEW findings: the fingerprint therefore excludes
+line/column numbers (which shift on every unrelated edit) and hashes
+the stable coordinates instead -- checker code, file, enclosing
+symbol, and message.
+"""
+
+import dataclasses
+import hashlib
+from typing import Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis hit.
+
+    :param checker: checker family (``jax-purity``, ``concurrency``,
+        ``collective-determinism``, ``dfg-invariants``).
+    :param code: specific rule id within the family (e.g.
+        ``purity-host-sync``); suppressions and baselines match on it.
+    :param path: repo-relative posix path of the offending file.
+    :param line: 1-based line (0 for whole-file / import-time passes).
+    :param col: 0-based column.
+    :param message: human-readable description. Must not embed line
+        numbers -- it participates in the baseline fingerprint.
+    :param symbol: enclosing function/class qualname (or experiment
+        name for DFG findings); stabilizes fingerprints across edits
+        elsewhere in the file.
+    """
+
+    checker: str
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        raw = "|".join((self.code, self.path, self.symbol, self.message))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.code}{sym}: {self.message}"
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+
+def count_by_fingerprint(findings: List[Finding]) -> Dict[str, int]:
+    """fingerprint -> occurrence count. Identical code on N lines of a
+    file yields the same fingerprint N times; baseline diffing is done
+    on counts so adding an (N+1)-th occurrence is still NEW."""
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.fingerprint] = out.get(f.fingerprint, 0) + 1
+    return out

@@ -1,0 +1,296 @@
+"""jax-purity checker: host syncs and impurity under JAX tracing.
+
+Jitted code must stay pure: a ``.item()`` / ``np.asarray`` /
+``jax.device_get`` inside a traced function forces a blocking
+host<->device round-trip per trace-time call site (and silently
+freezes the value at trace time when the result feeds Python control
+flow); ``time.time()`` / ``random.*`` / ``print`` burn themselves into
+the compiled program once; mutating a closed-over list leaks tracers
+across traces. This checker resolves which functions run under trace
+-- ``@jax.jit``-style decorators, wrapper assignments
+(``self._f = jax.jit(functools.partial(f, ...))``), and call sites
+(``lax.scan(body, ...)``, ``while_loop(cond, body, ...)``) -- then
+flags the impurities inside them.
+
+A second rule (``purity-sync-in-loop``) targets HOST-side decode hot
+paths: a per-element ``.item()`` / ``np.asarray`` inside a Python loop
+pays one device sync per iteration; batch it into a single bundled
+``jax.device_get`` before the loop (see docs/perf.md).
+
+Rules:
+- ``purity-host-sync``: host transfer inside a traced function.
+- ``purity-impure-call``: wall-clock / host-RNG / I-O call inside a
+  traced function.
+- ``purity-closure-mutation``: mutation of a closed-over container
+  inside a traced function.
+- ``purity-sync-in-loop``: per-iteration host transfer in host-side
+  engine/serving loops.
+"""
+
+import ast
+from typing import Dict, List, Set
+
+from realhf_tpu.analysis.core import (
+    AstChecker,
+    Module,
+    call_name,
+    dotted_name,
+)
+from realhf_tpu.analysis.finding import Finding
+
+#: call wrappers whose function-valued arguments run under trace
+TRACE_WRAPPERS = {
+    "jit", "pjit", "shard_map", "scan", "while_loop", "cond",
+    "fori_loop", "vmap", "pmap", "grad", "value_and_grad", "remat",
+    "checkpoint", "custom_vjp", "custom_jvp", "map", "switch",
+    "associated_scan", "associative_scan",
+}
+
+#: decorator names (last dotted component) marking a def as traced
+TRACE_DECORATORS = {"jit", "pjit", "shard_map", "vmap", "pmap",
+                    "grad", "value_and_grad", "remat", "checkpoint",
+                    "custom_vjp", "custom_jvp"}
+
+HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get", "device_get",
+    "jax.block_until_ready",
+}
+HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist", "copy_to_host"}
+
+IMPURE_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "print", "input", "open",
+}
+IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.",
+                   "os.urandom")
+
+MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop",
+                   "clear", "add", "update", "setdefault", "popitem"}
+
+#: package paths where the host-loop rule applies (decode hot paths)
+_HOT_PATH_PREFIXES = ("realhf_tpu/engine/", "realhf_tpu/serving/")
+
+
+def _is_wrapper_name(name: str) -> bool:
+    if "tree" in name:  # jax.tree.map / tree_util.* run on the host
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in TRACE_WRAPPERS and (
+        "." not in name
+        or name.split(".", 1)[0] in ("jax", "lax", "functools", "jnp")
+        or ".lax." in name or ".experimental." in name
+        or name.startswith("jax."))
+
+
+def _function_args(call: ast.Call) -> List[ast.AST]:
+    """Positional arguments of a wrapper call that can denote
+    functions: bare names, lambdas, local defs via functools.partial."""
+    out: List[ast.AST] = []
+    for arg in call.args:
+        if isinstance(arg, (ast.Name, ast.Lambda)):
+            out.append(arg)
+        elif isinstance(arg, ast.Call):
+            inner = call_name(arg)
+            if inner.rsplit(".", 1)[-1] == "partial" and arg.args:
+                out.append(arg.args[0])
+    return out
+
+
+class _Scope(ast.NodeVisitor):
+    """Collects local bindings of one function (no nested defs)."""
+
+    def __init__(self, fn: ast.AST):
+        self.names: Set[str] = set()
+        a = fn.args
+        for grp in (a.posonlyargs, a.args, a.kwonlyargs):
+            self.names.update(x.arg for x in grp)
+        for va in (a.vararg, a.kwarg):
+            if va is not None:
+                self.names.add(va.arg)
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.names.add(node.name)
+                continue
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                self.names.add(node.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for alias in node.names:
+                    self.names.add(
+                        (alias.asname or alias.name).split(".")[0])
+
+
+class JaxPurityChecker(AstChecker):
+    name = "jax-purity"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith((
+            "realhf_tpu/engine/", "realhf_tpu/interfaces/",
+            "realhf_tpu/ops/", "realhf_tpu/models/",
+            "realhf_tpu/serving/", "realhf_tpu/parallel/",
+            "realhf_tpu/search/"))
+
+    # ------------------------------------------------------------------
+    def check(self, module: Module) -> List[Finding]:
+        defs = [n for n in ast.walk(module.tree)
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))]
+        by_name: Dict[str, List[ast.AST]] = {}
+        for d in defs:
+            by_name.setdefault(d.name, []).append(d)
+
+        traced: Set[ast.AST] = set()
+        # (a) decorators
+        for d in defs:
+            for dec in d.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                nm = dotted_name(target)
+                if nm and nm.rsplit(".", 1)[-1] in TRACE_DECORATORS:
+                    traced.add(d)
+        # (b) wrapper call sites anywhere in the module
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = call_name(node)
+            if not nm or not _is_wrapper_name(nm):
+                continue
+            for arg in _function_args(node):
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    traced.update(by_name.get(arg.id, ()))
+        # (c) closure: nested defs and same-module helpers referenced
+        # from traced bodies run under the same trace
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if node is fn:
+                        continue
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        if node not in traced:
+                            traced.add(node)
+                            changed = True
+                    elif (isinstance(node, ast.Name)
+                          and isinstance(node.ctx, ast.Load)
+                          and node.id in by_name):
+                        for cand in by_name[node.id]:
+                            if cand not in traced:
+                                traced.add(cand)
+                                changed = True
+
+        findings: List[Finding] = []
+        for fn in traced:
+            if isinstance(fn, ast.Lambda):
+                continue  # single expressions: covered via host fns
+            findings.extend(self._check_traced(module, fn))
+        if (not module.relpath.startswith("realhf_tpu/")
+                or module.relpath.startswith(_HOT_PATH_PREFIXES)):
+            findings.extend(
+                self._check_host_loops(module, traced))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_traced(self, module: Module, fn) -> List[Finding]:
+        findings: List[Finding] = []
+        scope = _Scope(fn)
+        for node in self._walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = call_name(node)
+            f = None
+            if nm in HOST_SYNC_CALLS:
+                f = ("purity-host-sync",
+                     f"`{nm}` forces a host sync inside traced "
+                     f"function `{fn.name}`; return device values and "
+                     "transfer after the jitted call")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in HOST_SYNC_METHODS
+                  and not node.args):
+                f = ("purity-host-sync",
+                     f"`.{node.func.attr}()` forces a host sync inside "
+                     f"traced function `{fn.name}`")
+            elif nm in ("float", "int", "bool") and self._syncs(node):
+                f = ("purity-host-sync",
+                     f"`{nm}()` on a traced value forces a host sync "
+                     f"inside traced function `{fn.name}`")
+            elif nm in IMPURE_CALLS or nm.startswith(IMPURE_PREFIXES):
+                f = ("purity-impure-call",
+                     f"impure call `{nm}` inside traced function "
+                     f"`{fn.name}` executes once at trace time; use "
+                     "jax-native equivalents")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in MUTATOR_METHODS
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id not in scope.names):
+                f = ("purity-closure-mutation",
+                     f"`{node.func.value.id}.{node.func.attr}(...)` "
+                     f"mutates a closed-over container inside traced "
+                     f"function `{fn.name}`; tracers leak across "
+                     "traces")
+            if f is not None:
+                findings.append(self.finding(module, f[0], node, f[1],
+                                             symbol=fn.name))
+        return findings
+
+    @staticmethod
+    def _walk_shallow(fn):
+        """Walk a function body without descending into nested defs
+        (they are traced-set members checked on their own)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _syncs(call: ast.Call) -> bool:
+        """float()/int() on shapes, lens, or literals is static and
+        fine; anything else on a traced value blocks."""
+        if len(call.args) != 1:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, (ast.Constant, ast.UnaryOp)):
+            return False
+        src = ast.unparse(arg)
+        return not any(t in src for t in (".shape", ".ndim", ".size",
+                                          "len("))
+
+    # ------------------------------------------------------------------
+    def _check_host_loops(self, module: Module,
+                          traced: Set[ast.AST]) -> List[Finding]:
+        """Per-iteration host transfers in host-side loops."""
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn in traced:
+                continue
+            for loop in self._walk_shallow(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    nm = call_name(node)
+                    is_sync = nm in HOST_SYNC_CALLS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item",
+                                               "block_until_ready")
+                        and not node.args)
+                    if is_sync:
+                        findings.append(self.finding(
+                            module, "purity-sync-in-loop", node,
+                            f"per-iteration host transfer `{nm or node.func.attr}` "
+                            f"in host loop of `{fn.name}`; batch into "
+                            "one jax.device_get before the loop",
+                            symbol=fn.name))
+        return findings

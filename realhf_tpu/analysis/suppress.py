@@ -1,0 +1,53 @@
+"""Per-file and per-line suppression for graft-lint.
+
+Syntax (docs/static_analysis.md):
+
+- ``# graft-lint: disable=<code>[,<code>...]`` on the flagged line
+  (or, for multi-line statements, on the statement's first line)
+  suppresses those codes there. ``disable=all`` suppresses everything.
+- ``# graft-lint: disable-file=<code>[,<code>...]`` anywhere in the
+  file suppresses those codes for the whole file.
+
+Codes may be full rule ids (``purity-host-sync``) or checker family
+names (``jax-purity``) -- a family name suppresses every rule in it.
+"""
+
+import re
+from typing import Dict, List, Set
+
+_LINE_RE = re.compile(r"#\s*graft-lint:\s*disable=([\w\-,\s]+)")
+_FILE_RE = re.compile(r"#\s*graft-lint:\s*disable-file=([\w\-,\s]+)")
+
+
+class Suppressions:
+    """Parsed suppression directives of one source file."""
+
+    def __init__(self, source: str):
+        self.file_codes: Set[str] = set()
+        self.line_codes: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _FILE_RE.search(text)
+            if m:
+                self.file_codes |= _split(m.group(1))
+                continue
+            m = _LINE_RE.search(text)
+            if m:
+                self.line_codes.setdefault(lineno, set()).update(
+                    _split(m.group(1)))
+
+    def is_suppressed(self, code: str, checker: str, line: int) -> bool:
+        for scope in (self.file_codes,
+                      self.line_codes.get(line, ())):
+            if not scope:
+                continue
+            if "all" in scope or code in scope or checker in scope:
+                return True
+        return False
+
+    def filter(self, findings: List) -> List:
+        return [f for f in findings
+                if not self.is_suppressed(f.code, f.checker, f.line)]
+
+
+def _split(raw: str) -> Set[str]:
+    return {p.strip() for p in raw.split(",") if p.strip()}

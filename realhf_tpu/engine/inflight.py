@@ -139,30 +139,60 @@ class InflightBatchingGenerator:
         self._slot_req[slot] = -1
         self.state["active"] = self.state["active"].at[slot].set(False)
 
+    def _host_view(self) -> Dict[str, np.ndarray]:
+        """ONE bundled D2H fetch of every per-slot output/status
+        array. Per-slot ``np.asarray`` reads pay a blocking sync
+        round-trip each (~0.1s fixed latency per transfer on a
+        relayed/tunneled platform); harvesting N finished slots that
+        way costs 4N transfers per chunk -- the decode hot path's
+        dominant host overhead (docs/perf.md). The bundle is a few
+        n_slots x max_new_tokens int/float arrays, so downloading all
+        of it beats per-slot slicing as soon as more than one value is
+        read."""
+        return jax.device_get({
+            k: self.state[k]
+            for k in ("active", "unfinished", "emitted", "hit_eos",
+                      "out_tokens", "out_logprobs")})
+
     def snapshot_slot(self, slot: int):
         """(tokens_so_far, logprobs_so_far) of the sequence in
-        ``slot`` -- the incremental-streaming read. Device sync."""
-        n = int(np.asarray(self.state["emitted"][slot]))
-        return (np.asarray(self.state["out_tokens"][slot, :n]),
-                np.asarray(self.state["out_logprobs"][slot, :n]))
+        ``slot`` -- the incremental-streaming read. One device sync;
+        use :meth:`snapshot_slots` to read several slots per chunk."""
+        return self.snapshot_slots([slot])[slot]
+
+    def snapshot_slots(self, slots: List[int]) -> Dict[int, tuple]:
+        """slot -> (tokens_so_far, logprobs_so_far) for every
+        requested slot via ONE bundled device fetch (the serving
+        scheduler streams every live slot after each chunk; per-slot
+        reads would pay one sync round-trip each)."""
+        if not slots:
+            return {}
+        host = self._host_view()
+        out: Dict[int, tuple] = {}
+        for slot in slots:
+            n = int(host["emitted"][slot])
+            out[slot] = (host["out_tokens"][slot, :n],
+                         host["out_logprobs"][slot, :n])
+        return out
 
     def harvest(self) -> List[FinishedSequence]:
-        """Collect every finished sequence and free its slot."""
+        """Collect every finished sequence and free its slot (one
+        bundled host transfer, not four per finished slot)."""
         out: List[FinishedSequence] = []
         if self.n_live == 0:
             return out
-        active = np.asarray(self.state["active"])
-        unfinished = np.asarray(self.state["unfinished"])
+        host = self._host_view()
         for slot in range(self.n_slots):
             rid = self._slot_req[slot]
-            if rid < 0 or (active[slot] and unfinished[slot]):
+            if rid < 0 or (host["active"][slot]
+                           and host["unfinished"][slot]):
                 continue
-            n = int(np.asarray(self.state["emitted"][slot]))
+            n = int(host["emitted"][slot])
             out.append(FinishedSequence(
                 request_id=rid,
-                tokens=np.asarray(self.state["out_tokens"][slot, :n]),
-                logprobs=np.asarray(self.state["out_logprobs"][slot, :n]),
-                no_eos=not bool(np.asarray(self.state["hit_eos"][slot]))))
+                tokens=host["out_tokens"][slot, :n],
+                logprobs=host["out_logprobs"][slot, :n],
+                no_eos=not bool(host["hit_eos"][slot])))
             self.release_slot(slot)
         return out
 

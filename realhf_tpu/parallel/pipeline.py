@@ -172,8 +172,11 @@ def pipeline_blocks(
         # microbatch) evaluation; average them over the M microbatches
         # (the reference likewise applies MoE aux per forward
         # microbatch, utils/moe.py:395-416) and sum over stages.
+        # sorted: one psum per aux key -- every pipeline stage must
+        # issue them in the same order or the collectives deadlock
+        # (det-unsorted-iter)
         aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS) / n_real_mb
-                   for k, v in auxs.items()}
+                   for k, v in sorted(auxs.items())}
         return outs[None], aux_tot
 
     outs, aux = run(blocks, x, seg_ids, cos, sin)

@@ -96,7 +96,10 @@ def install_param_chunks(cfg: TransformerConfig, dst_engine, n_chunks: int,
     total = 0
     for i in range(n_chunks):
         chunk = fetch_chunk(i)
-        for path, arr in chunk.items():
+        # sorted: every host must issue the per-leaf device_puts in
+        # the same order -- a chunk dict deserialized from the wire
+        # carries the SENDER's insertion order (det-unsorted-iter)
+        for path, arr in sorted(chunk.items()):
             path = tuple(path)
             total += param_stream.leaf_nbytes(arr)
             arr = shard_rules.repad_vocab_leaf(cfg, path, arr, tp)

@@ -20,6 +20,9 @@ The backend contract (duck-typed; satisfied by
     release_slot(slot)                    # abort, frees slot
     swap_params(params)
     snapshot_slot(slot) -> (tokens, logprobs)
+    snapshot_slots(slots) -> {slot: (tokens, logprobs)}  # optional:
+        one bundled device fetch for streaming (falls back to
+        per-slot snapshot_slot when absent)
 
 Counters make the continuous-batching win measurable: ``decode_steps``
 (an upper bound -- the backend's chunk loop may early-exit) versus
@@ -227,8 +230,11 @@ class ContinuousScheduler:
             events.append(ServeEvent("done", seq.req.rid,
                                      dict(result=out)))
         if self.stream_tokens:
+            # one bundled device fetch for every live slot -- a
+            # per-slot snapshot_slot pays one sync round-trip each
+            snaps = self._snapshot_active()
             for seq in self._active.values():
-                tokens, logprobs = self.backend.snapshot_slot(seq.slot)
+                tokens, logprobs = snaps[seq.slot]
                 if len(tokens) > seq.streamed:
                     events.append(ServeEvent(
                         "tokens", seq.req.rid,
@@ -239,6 +245,16 @@ class ContinuousScheduler:
         return events
 
     # ------------------------------------------------------------------
+    def _snapshot_active(self) -> Dict[int, tuple]:
+        """slot -> (tokens, logprobs) for every live slot; one bundled
+        transfer via the backend's ``snapshot_slots`` when it has one
+        (test fakes may only provide the per-slot form)."""
+        slots = [seq.slot for seq in self._active.values()]
+        batched = getattr(self.backend, "snapshot_slots", None)
+        if batched is not None:
+            return batched(slots)
+        return {s: self.backend.snapshot_slot(s) for s in slots}
+
     def _is_stale(self, seq: _ActiveSeq, version: int) -> bool:
         return (self.max_staleness is not None
                 and version - seq.version_start > self.max_staleness)

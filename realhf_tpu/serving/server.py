@@ -29,6 +29,7 @@ cancels them past the timeout), and leaves no orphaned queue entries.
 
 import dataclasses
 import pickle
+import threading
 import time
 import uuid
 from typing import Callable, Dict, List, Optional
@@ -105,7 +106,14 @@ class RolloutServer:
                 rollout_server_key(experiment_name, trial_name,
                                    server_name),
                 self.address, replace=True)
-        self._routes: Dict[str, bytes] = {}  # rid -> client identity
+        # rid -> client identity. Guarded by _routes_lock: drain() and
+        # stats() may run from a supervising thread while the serve
+        # loop spins in another (serve_forever). The lock covers ONLY
+        # route-table reads/mutations -- pickling and socket sends
+        # happen outside it (conc-lock-blocking: a stalled peer must
+        # not stall every thread contending for the table).
+        self._routes: Dict[str, bytes] = {}
+        self._routes_lock = threading.Lock()
         import jax
         self._key = jax.random.PRNGKey(seed)
         self._draining = False
@@ -174,7 +182,8 @@ class RolloutServer:
             verdict: AdmissionVerdict = self.queue.submit(
                 req, current_weight_version=self.weight_sync.version)
             if verdict.accepted:
-                self._routes[rid] = ident
+                with self._routes_lock:
+                    self._routes[rid] = ident
                 self._reply(ident, "accepted", rid,
                             dict(queue_depth=len(self.queue)))
             else:
@@ -205,12 +214,16 @@ class RolloutServer:
             self._send(ev.rid, ev.kind, data)
 
     def _send(self, rid: str, kind: str, data: dict):
-        ident = self._routes.get(rid)
+        with self._routes_lock:
+            ident = self._routes.get(rid)
         if ident is None:
             return
+        # pickle + send OUTSIDE the lock: serialization of token
+        # arrays and a blocking peer must not hold up other threads'
+        # route lookups
+        payload = pickle.dumps((kind, rid, data))
         try:
-            self._sock.send_multipart(
-                [ident, pickle.dumps((kind, rid, data))])
+            self._sock.send_multipart([ident, payload])
         except zmq.ZMQError as e:
             # keep the route: a terminal event dropped here would
             # otherwise be lost for good, blocking the client until
@@ -220,13 +233,16 @@ class RolloutServer:
                            kind, rid, e)
             return
         if kind in TERMINAL_KINDS:
-            del self._routes[rid]
+            # drop only AFTER the send succeeded (PR-2 semantics)
+            with self._routes_lock:
+                self._routes.pop(rid, None)
 
     def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
-        self._sock.send_multipart(
-            [ident, pickle.dumps((kind, rid, data))])
+        payload = pickle.dumps((kind, rid, data))
+        self._sock.send_multipart([ident, payload])
         if kind in TERMINAL_KINDS:
-            self._routes.pop(rid, None)
+            with self._routes_lock:
+                self._routes.pop(rid, None)
 
     # ------------------------------------------------------------------
     def drain(self, timeout: float = 30.0):

@@ -92,21 +92,39 @@ class DataStore:
                     del self._blobs[name]
 
     def put(self, sample: SequenceSample):
-        """Merge a (possibly multi-sequence) sample into the store."""
+        """Merge a (possibly multi-sequence) sample into the store.
+
+        Copy-on-write: the merge happens on a CLONE outside the lock
+        and the finished value is swapped in -- stored values really
+        are immutable once inserted, so readers (``get``) may run
+        ``select``/``gather`` on their references without holding the
+        lock. Single writer (the worker's poll thread); the lock only
+        orders the dict accesses against readers."""
         for piece in sample.unpack():
             sid = piece.ids[0]
             with self._lock:
                 cur = self._store.get(sid)
-                if cur is None:
-                    self._store[sid] = piece
-                else:
-                    cur.update_(piece)
+            if cur is not None:
+                merged = SequenceSample(
+                    keys=cur.keys, trailing_shapes=cur.trailing_shapes,
+                    dtypes=cur.dtypes, ids=cur.ids,
+                    seqlens=cur.seqlens,
+                    data=None if cur.data is None else dict(cur.data),
+                    metadata=cur.metadata)
+                merged.update_(piece)
+                piece = merged
+            with self._lock:
+                self._store[sid] = piece
 
     def get(self, ids: List[Hashable], keys: List[str]
             ) -> SequenceSample:
+        # hold the lock only for the dict reads; the per-sequence
+        # select and the gather concatenation (the expensive, numpy-
+        # copying part) run on immutable snapshots outside it
         with self._lock:
-            pieces = [self._store[i].select(list(keys)) for i in ids]
-        return SequenceSample.gather(pieces)
+            pieces = [self._store[i] for i in ids]
+        return SequenceSample.gather(
+            [p.select(list(keys)) for p in pieces])
 
     def has(self, sid: Hashable, keys: List[str]) -> bool:
         with self._lock:

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Fail fast on pytest import/collection errors.
+"""Fail fast on pytest import/collection errors + lint regressions.
 
 A broken import used to shrink the tier-1 suite silently: pytest
 ``--continue-on-collection-errors`` keeps running the tests that DID
@@ -7,6 +7,12 @@ collect, so a module-level ImportError quietly removes a whole file
 from coverage. This gate runs ``pytest --collect-only`` and exits
 non-zero -- printing the offending modules -- whenever anything fails
 to collect.
+
+The default run additionally invokes graft-lint
+(``python -m realhf_tpu.analysis --fail-on-new``, see
+docs/static_analysis.md): a NEW static-analysis finding beyond
+``scripts/lint_baseline.json`` fails the gate, printing the offending
+file:line and checker id.
 
 Usage::
 
@@ -26,11 +32,17 @@ import sys
 #: import breaks in a way pytest reports as "0 collected" rather than
 #: an ERROR -- would otherwise vanish from CI silently.
 REQUIRED_DIRS = (
+    "tests/analysis",
     "tests/base",
     "tests/engine",
     "tests/serving",
     "tests/system",
 )
+
+#: the committed graft-lint baseline; its presence marks a tree where
+#: the lint gate applies (unit tests run check_collection in tmp dirs
+#: that have no baseline and no package to lint)
+LINT_BASELINE = os.path.join("scripts", "lint_baseline.json")
 
 
 def check_collection(args=None, cwd=None):
@@ -70,10 +82,31 @@ def check_collection(args=None, cwd=None):
                   "tests).")
 
 
+def run_lint_gate(cwd=None):
+    """Returns (ok: bool, report: str): graft-lint in --fail-on-new
+    mode. New findings (vs scripts/lint_baseline.json) print as
+    ``NEW path:line:col: checker-code: message``."""
+    cwd = cwd or os.getcwd()
+    if not os.path.exists(os.path.join(cwd, LINT_BASELINE)):
+        return True, "Lint gate skipped (no lint baseline here)."
+    proc = subprocess.run(
+        [sys.executable, "-m", "realhf_tpu.analysis", "--fail-on-new",
+         "--baseline", LINT_BASELINE],
+        capture_output=True, text=True, cwd=cwd)
+    out = (proc.stdout + proc.stderr).strip()
+    if proc.returncode == 0:
+        return True, f"Lint gate OK. {out.splitlines()[-1] if out else ''}"
+    return False, f"Lint gate FAILED (new findings vs baseline):\n{out}"
+
+
 def main():
     ok, report = check_collection(sys.argv[1:] or None,
                                   cwd=os.getcwd())
     print(report)
+    if not sys.argv[1:]:  # default run: also gate on static analysis
+        lint_ok, lint_report = run_lint_gate()
+        print(lint_report)
+        ok = ok and lint_ok
     return 0 if ok else 1
 
 

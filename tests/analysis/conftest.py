@@ -1,0 +1,28 @@
+"""Shared fixtures for the graft-lint test suite: build a parsed
+Module straight from inline source (true-positive / true-negative
+fixtures live next to the assertions that read them)."""
+
+import ast
+
+import pytest
+
+from realhf_tpu.analysis.core import Module
+from realhf_tpu.analysis.suppress import Suppressions
+
+
+@pytest.fixture
+def make_module():
+    def _make(source: str, relpath: str = "fixtures/mod.py") -> Module:
+        return Module(path="/fixture/" + relpath, relpath=relpath,
+                      source=source, tree=ast.parse(source),
+                      suppressions=Suppressions(source))
+    return _make
+
+
+@pytest.fixture
+def codes_of():
+    """Finding list -> sorted list of rule codes (order-insensitive
+    assertions)."""
+    def _codes(findings):
+        return sorted(f.code for f in findings)
+    return _codes

@@ -1,0 +1,187 @@
+"""concurrency checker: true positives and true negatives."""
+
+import textwrap
+
+from realhf_tpu.analysis.concurrency import ConcurrencyChecker
+
+
+def check(make_module, src, relpath="fixtures/mod.py"):
+    return ConcurrencyChecker().check(
+        make_module(textwrap.dedent(src), relpath))
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_send_under_lock(make_module, codes_of):
+    """The PR-2 shape: a ZMQ send inside the route-table critical
+    section."""
+    fs = check(make_module, """
+        import pickle
+        import threading
+
+        class Server:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._routes = {}
+                self._sock = sock
+
+            def send(self, rid, kind, data):
+                with self._lock:
+                    ident = self._routes.get(rid)
+                    self._sock.send_multipart(
+                        [ident, pickle.dumps((kind, rid, data))])
+                    del self._routes[rid]
+    """)
+    assert "conc-lock-blocking" in codes_of(fs)
+    assert any("send_multipart" in f.message for f in fs)
+
+
+def test_name_resolve_wait_under_lock(make_module, codes_of):
+    fs = check(make_module, """
+        from realhf_tpu.base import name_resolve
+
+        def connect(lock, key):
+            with lock:
+                return name_resolve.wait(key, timeout=60)
+    """)
+    assert codes_of(fs) == ["conc-lock-blocking"]
+
+
+def test_unsynced_thread_field(make_module, codes_of):
+    fs = check(make_module, """
+        import threading
+
+        class Poller:
+            def __init__(self):
+                self.latest = None
+                self._t = threading.Thread(target=self._poll,
+                                           daemon=True)
+
+            def _poll(self):
+                while True:
+                    self.latest = fetch()
+
+            def read(self):
+                return self.latest
+    """)
+    assert "conc-unsynced-field" in codes_of(fs)
+    assert any("latest" in f.message for f in fs)
+
+
+def test_thread_subclass_run_counts_as_entry(make_module, codes_of):
+    fs = check(make_module, """
+        import threading
+
+        class Server(threading.Thread):
+            def run(self):
+                self.result = 42
+
+            def harvest(self):
+                return self.result
+    """)
+    assert "conc-unsynced-field" in codes_of(fs)
+
+
+def test_non_daemon_thread_never_joined(make_module, codes_of):
+    fs = check(make_module, """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            return t
+    """)
+    assert codes_of(fs) == ["conc-unjoined-thread"]
+
+
+# ----------------------------------------------------------------------
+# true negatives
+# ----------------------------------------------------------------------
+def test_send_outside_lock_is_clean(make_module):
+    """The fixed shape: only the route mutation under the lock."""
+    fs = check(make_module, """
+        import pickle
+        import threading
+
+        class Server:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self._routes = {}
+                self._sock = sock
+
+            def send(self, rid, kind, data):
+                with self._lock:
+                    ident = self._routes.get(rid)
+                if ident is None:
+                    return
+                payload = pickle.dumps((kind, rid, data))
+                self._sock.send_multipart([ident, payload])
+                with self._lock:
+                    self._routes.pop(rid, None)
+    """)
+    assert fs == []
+
+
+def test_locked_field_access_is_clean(make_module):
+    fs = check(make_module, """
+        import threading
+
+        class Poller:
+            def __init__(self):
+                self.latest = None
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._poll,
+                                           daemon=True)
+
+            def _poll(self):
+                with self._lock:
+                    self.latest = fetch()
+
+            def read(self):
+                with self._lock:
+                    return self.latest
+    """)
+    assert fs == []
+
+
+def test_event_fields_are_their_own_sync(make_module):
+    fs = check(make_module, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run,
+                                           daemon=True)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    pass
+
+            def stop(self):
+                self._stop.set()
+    """)
+    assert fs == []
+
+
+def test_daemon_and_joined_threads_are_clean(make_module):
+    fs = check(make_module, """
+        import threading
+
+        def run_both(fn):
+            d = threading.Thread(target=fn, daemon=True)
+            t = threading.Thread(target=fn)
+            d.start(); t.start()
+            t.join()
+    """)
+    assert fs == []
+
+
+def test_str_join_under_lock_is_clean(make_module):
+    fs = check(make_module, """
+        def fmt(lock, parts):
+            with lock:
+                return ", ".join(parts)
+    """)
+    assert fs == []

@@ -1,0 +1,97 @@
+"""collective-determinism checker: true positives / true negatives."""
+
+import textwrap
+
+from realhf_tpu.analysis.determinism import DeterminismChecker
+
+
+def check(make_module, src, relpath="fixtures/mod.py"):
+    return DeterminismChecker().check(
+        make_module(textwrap.dedent(src), relpath))
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_unsorted_items_building_pspecs(make_module, codes_of):
+    fs = check(make_module, """
+        from jax.sharding import PartitionSpec
+
+        def build(layouts):
+            specs = {}
+            for name, axes in layouts.items():
+                specs[name] = PartitionSpec(*axes)
+            return specs
+    """)
+    assert codes_of(fs) == ["det-unsorted-iter"]
+    assert "dict.items()" in fs[0].message
+
+
+def test_unsorted_values_issuing_device_put(make_module, codes_of):
+    fs = check(make_module, """
+        import jax
+
+        def install(chunks, shardings):
+            for arr in chunks.values():
+                jax.device_put(arr, shardings)
+    """)
+    assert codes_of(fs) == ["det-unsorted-iter"]
+
+
+def test_set_iteration_building_name_resolve_keys(make_module,
+                                                  codes_of):
+    fs = check(make_module, """
+        from realhf_tpu.base import name_resolve
+
+        def announce(workers):
+            for w in set(workers):
+                name_resolve.add(f"trial/{w}", "addr")
+    """)
+    assert codes_of(fs) == ["det-unsorted-iter"]
+
+
+def test_dict_comprehension_with_collective(make_module, codes_of):
+    fs = check(make_module, """
+        import jax
+
+        def reduce_aux(auxs, axis):
+            return {k: jax.lax.psum(v, axis) for k, v in auxs.items()}
+    """)
+    assert codes_of(fs) == ["det-unsorted-iter"]
+
+
+# ----------------------------------------------------------------------
+# true negatives
+# ----------------------------------------------------------------------
+def test_sorted_items_is_clean(make_module):
+    fs = check(make_module, """
+        from jax.sharding import PartitionSpec
+
+        def build(layouts):
+            return {name: PartitionSpec(*axes)
+                    for name, axes in sorted(layouts.items())}
+    """)
+    assert fs == []
+
+
+def test_unordered_iteration_without_layouts_is_clean(make_module):
+    """Plain bookkeeping over a dict is fine -- only layout/
+    collective/name_resolve-producing bodies flag."""
+    fs = check(make_module, """
+        def total(counters):
+            s = 0
+            for k, v in counters.items():
+                s += v
+            return s
+    """)
+    assert fs == []
+
+
+def test_list_iteration_with_layouts_is_clean(make_module):
+    fs = check(make_module, """
+        from jax.sharding import PartitionSpec
+
+        def build(pairs):
+            return [PartitionSpec(*axes) for _, axes in pairs]
+    """)
+    assert fs == []

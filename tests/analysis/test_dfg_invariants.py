@@ -1,0 +1,167 @@
+"""dfg-invariants pass: seeded-bad specs flag; every registered
+experiment validates clean (the collection-time acceptance)."""
+
+import pytest
+
+from realhf_tpu.analysis.dfg_invariants import (
+    DfgInvariantsChecker,
+    build_default_spec,
+    validate_spec,
+)
+from realhf_tpu.api.config import (
+    DatasetAbstraction,
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+)
+from realhf_tpu.api.dfg import MFCDef, ParamReallocHook
+from realhf_tpu.api.experiment import (
+    ExperimentSpec,
+    MFCAllocation,
+    ModelSpec,
+)
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+
+def _mfc(name, role, itype, inputs=(), outputs=(), n_seqs=8):
+    return MFCDef(
+        name=name, n_seqs=n_seqs, interface_type=itype,
+        interface_impl=ModelInterfaceAbstraction("testing"),
+        model_name=role, input_keys=tuple(inputs),
+        output_keys=tuple(outputs))
+
+
+def _spec(mfcs, models=None, allocations=None):
+    roles = {m.role for m in mfcs}
+    return ExperimentSpec(
+        experiment_name="lint", trial_name="dfg",
+        models=models or {r: ModelSpec() for r in sorted(roles)},
+        mfcs=mfcs,
+        dataset=DatasetAbstraction("prompt", dict(path="/dev/null")),
+        allocations=allocations or {})
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_cycle_is_flagged():
+    a = _mfc("a", "actor", ModelInterfaceType.INFERENCE,
+             inputs=["y"], outputs=["x"])
+    b = _mfc("b", "actor", ModelInterfaceType.INFERENCE,
+             inputs=["x"], outputs=["y"])
+    fs = validate_spec("cyc", _spec([a, b]), "exp.py", 1)
+    assert _codes(fs) == ["dfg-cycle"]
+
+
+def test_duplicate_producer_is_flagged():
+    a = _mfc("a", "actor", ModelInterfaceType.INFERENCE,
+             outputs=["x"])
+    b = _mfc("b", "actor", ModelInterfaceType.INFERENCE,
+             outputs=["x"])
+    fs = validate_spec("dup", _spec([a, b]), "exp.py", 1)
+    assert _codes(fs) == ["dfg-duplicate-key"]
+
+
+def test_batch_mismatch_is_flagged():
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"], n_seqs=10)
+    train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
+                 inputs=["seq"], n_seqs=4)  # 10 % 4 != 0
+    fs = validate_spec("bm", _spec([gen, train]), "exp.py", 1)
+    assert "dfg-batch-mismatch" in _codes(fs)
+    assert any("gen->train" in f.message for f in fs)
+
+
+def test_mesh_mismatch_on_shared_group_is_flagged():
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"])
+    train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
+                 inputs=["seq"])
+    spec = _spec(
+        [gen, train],
+        models={"actor": ModelSpec(parallel=ParallelismConfig(
+            data_parallel_size=2, tensor_parallel_size=4))},
+        # same worker group (the role's), but a 2-device layout vs
+        # the primary's 8 -- the group has one fixed device count
+        allocations={"gen": ParallelismConfig(
+            data_parallel_size=2, tensor_parallel_size=1)})
+    fs = validate_spec("mm", spec, "exp.py", 1)
+    assert "dfg-mesh-mismatch" in _codes(fs)
+
+
+def test_unknown_alloc_and_role_are_flagged():
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"])
+    spec = _spec([gen], models={"other": ModelSpec()},
+                 allocations={"nope": ParallelismConfig()})
+    fs = validate_spec("bad", spec, "exp.py", 1)
+    codes = _codes(fs)
+    assert codes.count("dfg-bad-alloc") == 2  # unknown MFC + role
+
+
+def test_concurrent_realloc_nodes_are_flagged():
+    """Two same-role MFCs with replica layouts and NO path between
+    them: their weight reshards would race."""
+    inf1 = _mfc("inf1", "actor", ModelInterfaceType.INFERENCE,
+                inputs=["p1"], outputs=["o1"])
+    inf2 = _mfc("inf2", "actor", ModelInterfaceType.INFERENCE,
+                inputs=["p2"], outputs=["o2"])
+    spec = _spec(
+        [inf1, inf2],
+        models={"actor": ModelSpec(parallel=ParallelismConfig(
+            data_parallel_size=8))},
+        allocations={
+            "inf1": ParallelismConfig(tensor_parallel_size=8),
+            "inf2": ParallelismConfig(data_parallel_size=2,
+                                      tensor_parallel_size=4)})
+    fs = validate_spec("rc", spec, "exp.py", 1)
+    assert "dfg-realloc-order" in _codes(fs)
+
+
+def test_hooked_concurrent_nodes_are_flagged():
+    inf1 = _mfc("inf1", "ref", ModelInterfaceType.INFERENCE,
+                inputs=["p1"], outputs=["o1"])
+    inf2 = _mfc("inf2", "ref", ModelInterfaceType.INFERENCE,
+                inputs=["p2"], outputs=["o2"])
+    for n in (inf1, inf2):
+        n.add_pre_hook(ParamReallocHook(source="actor"))
+    fs = validate_spec("hooked", _spec([inf1, inf2]), "exp.py", 1)
+    assert "dfg-realloc-order" in _codes(fs)
+
+
+# ----------------------------------------------------------------------
+# true negatives / acceptance
+# ----------------------------------------------------------------------
+def test_chained_realloc_nodes_are_clean():
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"])
+    train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
+                 inputs=["seq"])
+    spec = _spec(
+        [gen, train],
+        models={"actor": ModelSpec(parallel=ParallelismConfig(
+            data_parallel_size=2, tensor_parallel_size=4))},
+        allocations={"gen": MFCAllocation(
+            parallel=ParallelismConfig(data_parallel_size=8),
+            workers=[1])})  # own group: no shared-group constraint
+    assert validate_spec("ok", spec, "exp.py", 1) == []
+
+
+def test_ppo_default_spec_validates_clean():
+    from realhf_tpu.experiments.ppo_exp import PPOConfig
+
+    spec = build_default_spec(PPOConfig)
+    assert spec is not None and len(spec.mfcs) == 6
+    assert validate_spec("ppo", spec, "exp.py", 1) == []
+
+
+@pytest.mark.parametrize("dummy", [0])
+def test_all_registered_experiments_validate_clean(dummy):
+    """The collection-time acceptance: the import-time pass builds
+    and validates every registered experiment DFG with zero
+    findings."""
+    fs = DfgInvariantsChecker().check_project(".")
+    assert fs == [], [f.format() for f in fs]

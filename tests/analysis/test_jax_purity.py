@@ -1,0 +1,171 @@
+"""jax-purity checker: true positives and true negatives."""
+
+import textwrap
+
+from realhf_tpu.analysis.jax_purity import JaxPurityChecker
+
+
+def check(make_module, src, relpath="fixtures/mod.py"):
+    return JaxPurityChecker().check(
+        make_module(textwrap.dedent(src), relpath))
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_item_in_jitted_decorator(make_module, codes_of):
+    fs = check(make_module, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + x.sum().item()
+    """)
+    assert "purity-host-sync" in codes_of(fs)
+    assert fs[0].symbol == "step"
+    assert fs[0].line > 0
+
+
+def test_host_sync_in_wrapper_assigned_fn(make_module, codes_of):
+    """jax.jit(functools.partial(f, ...)) marks f traced."""
+    fs = check(make_module, """
+        import functools
+        import jax
+        import numpy as np
+
+        def _decode(cfg, state):
+            return np.asarray(state["x"])
+
+        run = jax.jit(functools.partial(_decode, 3))
+    """)
+    assert codes_of(fs) == ["purity-host-sync"]
+
+
+def test_scan_body_and_nested_helpers_are_traced(make_module, codes_of):
+    """Functions fed to lax.scan -- and helpers they call -- are
+    traced; impure time/random/print calls inside them flag."""
+    fs = check(make_module, """
+        import jax
+        import time, random
+
+        def helper(x):
+            print(x)
+            return x * random.random()
+
+        def outer(xs):
+            def body(c, x):
+                c = c + helper(x)
+                return c, time.time()
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+    codes = codes_of(fs)
+    assert codes.count("purity-impure-call") == 3  # print, random, time
+
+
+def test_closure_mutation_in_while_loop_body(make_module, codes_of):
+    fs = check(make_module, """
+        import jax
+
+        acc = []
+
+        def outer(x):
+            def cond(c):
+                return c[0] < 4
+            def body(c):
+                acc.append(c[1])
+                return (c[0] + 1, c[1])
+            return jax.lax.while_loop(cond, body, (0, x))
+    """)
+    assert "purity-closure-mutation" in codes_of(fs)
+
+
+def test_sync_in_host_loop_hot_path(make_module, codes_of):
+    """Per-iteration host transfers in engine/serving host loops."""
+    fs = check(make_module, """
+        import numpy as np
+
+        def harvest(state, n):
+            out = []
+            for slot in range(n):
+                out.append(np.asarray(state["emitted"][slot]).item())
+            return out
+    """, relpath="realhf_tpu/engine/fake.py")
+    assert "purity-sync-in-loop" in codes_of(fs)
+
+
+# ----------------------------------------------------------------------
+# true negatives
+# ----------------------------------------------------------------------
+def test_host_code_is_not_flagged(make_module):
+    """np.asarray / time.time outside traced functions (and outside
+    hot-path loops) are ordinary host code."""
+    fs = check(make_module, """
+        import time
+        import numpy as np
+
+        def gather(out):
+            t = time.time()
+            return np.asarray(out), t
+    """)
+    assert fs == []
+
+
+def test_pure_jitted_fn_is_clean(make_module):
+    fs = check(make_module, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            k = jax.random.PRNGKey(0)
+            y = int(x.shape[0])  # static: shapes are host ints
+            return x * y + jax.random.normal(k, x.shape)
+    """)
+    assert fs == []
+
+
+def test_tree_map_is_not_a_tracer(make_module):
+    """jax.tree.map runs its function on the host -- device_get /
+    np.asarray inside is the BUNDLING idiom, not a violation."""
+    fs = check(make_module, """
+        import jax
+        import numpy as np
+
+        def to_host(params):
+            return jax.tree.map(lambda x: np.asarray(x), params)
+
+        def gather(params):
+            def leaf(x):
+                return np.asarray(x)
+            return jax.tree.map(leaf, params)
+    """)
+    assert fs == []
+
+
+def test_batched_device_get_outside_loop_is_clean(make_module):
+    """The fixed decode hot path: one bundled device_get, numpy-only
+    loop below it."""
+    fs = check(make_module, """
+        import jax
+
+        def harvest(state, n):
+            host = jax.device_get(state)
+            return [int(host["emitted"][s]) for s in range(n)]
+    """, relpath="realhf_tpu/engine/fake.py")
+    assert fs == []
+
+
+def test_suppression_comment_respected(make_module):
+    """The raw checker flags the line; the engine-level suppression
+    filter (what run_analysis applies) drops it."""
+    src = """
+import numpy as np
+
+def stream(leaves):
+    for l in leaves:
+        yield np.asarray(l)  # graft-lint: disable=purity-sync-in-loop
+"""
+    m = make_module(src, relpath="realhf_tpu/engine/fake.py")
+    raw = JaxPurityChecker().check(m)
+    assert [f.code for f in raw] == ["purity-sync-in-loop"]
+    assert m.suppressions.filter(raw) == []

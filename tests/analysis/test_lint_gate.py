@@ -1,0 +1,180 @@
+"""Finding model, baseline diffing, suppression, CLI, and the
+repo-clean acceptance gate."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from realhf_tpu.analysis import all_checkers, run_analysis
+from realhf_tpu.analysis.__main__ import main as lint_main
+from realhf_tpu.analysis.baseline import (
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from realhf_tpu.analysis.finding import Finding
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+BAD_PURITY = textwrap.dedent("""
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x + x.sum().item()
+""")
+BAD_CONC = textwrap.dedent("""
+    import threading
+
+    def send_locked(lock, sock, payload):
+        with lock:
+            sock.send_multipart(payload)
+""")
+BAD_DET = textwrap.dedent("""
+    from jax.sharding import PartitionSpec
+
+    def build(layouts):
+        return [PartitionSpec(*a) for _, a in layouts.items()]
+""")
+
+
+def _seed_bad_tree(root):
+    (root / "purity_mod.py").write_text(BAD_PURITY)
+    (root / "conc_mod.py").write_text(BAD_CONC)
+    (root / "det_mod.py").write_text(BAD_DET)
+
+
+# ----------------------------------------------------------------------
+def test_fingerprint_ignores_line_numbers():
+    a = Finding("jax-purity", "purity-host-sync", "m.py", 10, 4,
+                "msg", symbol="f")
+    b = Finding("jax-purity", "purity-host-sync", "m.py", 99, 0,
+                "msg", symbol="f")
+    c = Finding("jax-purity", "purity-host-sync", "m.py", 10, 4,
+                "other msg", symbol="f")
+    assert a.fingerprint == b.fingerprint != c.fingerprint
+
+
+def test_baseline_roundtrip_and_diff(tmp_path):
+    f1 = Finding("jax-purity", "purity-host-sync", "m.py", 3, 0,
+                 "msg", symbol="f")
+    f2 = Finding("concurrency", "conc-lock-blocking", "n.py", 7, 0,
+                 "msg2", symbol="g")
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, [f1, f2])
+    baseline = load_baseline(path)
+    assert baseline == {f1.fingerprint: 1, f2.fingerprint: 1}
+
+    # same findings: nothing new; f2 missing: reported fixed
+    new, fixed = diff_against_baseline([f1, f2], baseline)
+    assert new == [] and fixed == []
+    new, fixed = diff_against_baseline([f1], baseline)
+    assert new == [] and fixed == [f2.fingerprint]
+    # a SECOND occurrence of a baselined fingerprint is new
+    new, fixed = diff_against_baseline([f1, f1, f2], baseline)
+    assert new == [f1] and fixed == []
+
+
+def test_missing_baseline_means_everything_is_new(tmp_path):
+    f1 = Finding("jax-purity", "purity-host-sync", "m.py", 3, 0,
+                 "msg", symbol="f")
+    baseline = load_baseline(str(tmp_path / "nope.json"))
+    new, fixed = diff_against_baseline([f1], baseline)
+    assert new == [f1]
+
+
+def test_file_level_suppression(tmp_path):
+    src = ("# graft-lint: disable-file=jax-purity\n" + BAD_PURITY
+           + BAD_DET)
+    (tmp_path / "mod.py").write_text(src)
+    fs = run_analysis([str(tmp_path)], all_checkers(
+        ["jax-purity", "collective-determinism"]), root=str(tmp_path))
+    assert sorted(f.code for f in fs) == ["det-unsorted-iter"]
+
+
+# ----------------------------------------------------------------------
+def test_cli_fails_on_seeded_bad_tree(tmp_path, capsys,
+                                      monkeypatch):
+    """Acceptance: nonzero exit on a seeded-bad fixture tree, naming
+    file:line and checker id for every family."""
+    _seed_bad_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(tmp_path), "--no-dfg", "--fail-on-new",
+                    "--baseline", str(tmp_path / "baseline.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for fname, code in (("purity_mod.py", "purity-host-sync"),
+                        ("conc_mod.py", "conc-lock-blocking"),
+                        ("det_mod.py", "det-unsorted-iter")):
+        line = next(ln for ln in out.splitlines()
+                    if fname in ln and code in ln)
+        # "NEW path:line:col: code ..." -- file:line coordinates
+        assert line.startswith("NEW ")
+        assert int(line.split(":")[1]) > 0
+
+
+def test_cli_baseline_ratchet(tmp_path, capsys, monkeypatch):
+    """Accepted findings stay accepted; a NEW violation still fails."""
+    _seed_bad_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    baseline = str(tmp_path / "baseline.json")
+    rc = lint_main([str(tmp_path), "--no-dfg", "--write-baseline",
+                    "--baseline", baseline])
+    assert rc == 0
+    capsys.readouterr()
+    rc = lint_main([str(tmp_path), "--no-dfg", "--fail-on-new",
+                    "--baseline", baseline])
+    assert rc == 0, capsys.readouterr().out
+    capsys.readouterr()
+    (tmp_path / "fresh_mod.py").write_text(BAD_PURITY)
+    rc = lint_main([str(tmp_path), "--no-dfg", "--fail-on-new",
+                    "--baseline", baseline])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fresh_mod.py" in out and "purity-host-sync" in out
+    # the old accepted findings are not re-reported as new
+    assert "purity_mod.py" not in out
+
+
+def test_cli_json_format(tmp_path, capsys, monkeypatch):
+    _seed_bad_tree(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(tmp_path), "--no-dfg", "--format", "json"])
+    assert rc == 0  # informational mode always exits 0
+    data = json.loads(capsys.readouterr().out)
+    assert {d["checker"] for d in data} == {
+        "jax-purity", "concurrency", "collective-determinism"}
+    assert all(d["fingerprint"] for d in data)
+
+
+def test_cli_unknown_checker_is_usage_error(tmp_path):
+    with pytest.raises(SystemExit) as ei:  # argparse choices
+        lint_main([str(tmp_path), "--checker", "nope",
+                   "--fail-on-new"])
+    assert ei.value.code == 2
+
+
+# ----------------------------------------------------------------------
+def test_repo_is_lint_clean(monkeypatch, capsys):
+    """THE tier-1 acceptance gate: the analyzer runs clean (zero new
+    findings vs scripts/lint_baseline.json) on the repo itself,
+    including the import-time dfg-invariants pass over every
+    registered experiment."""
+    monkeypatch.chdir(REPO_ROOT)
+    rc = lint_main(["--fail-on-new"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_check_collect_lint_gate_skips_without_baseline(tmp_path):
+    import importlib.util
+
+    path = os.path.join(REPO_ROOT, "scripts", "check_collect.py")
+    spec = importlib.util.spec_from_file_location("cc_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ok, report = mod.run_lint_gate(cwd=str(tmp_path))
+    assert ok and "skipped" in report.lower()
