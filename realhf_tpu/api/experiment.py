@@ -113,9 +113,34 @@ class FaultToleranceConfig:
     # for base * 2**(losses-1) seconds (capped, jittered)
     exclude_base_secs: float = 5.0
     exclude_max_secs: float = 120.0
-    # save/eval dispatch+gather: attempts and per-attempt timeout
+    # save/eval dispatch+gather: attempts and per-attempt timeout.
+    # The retry stack's TOTAL wall clock is additionally capped by
+    # gather_max_elapsed_secs (RetryPolicy.max_elapsed) so stacked
+    # backoffs during a degradation event cannot outlive the watchdog
+    # grace window and mask a real worker loss.
     gather_retries: int = 2
     gather_timeout_secs: float = 600.0
+    gather_max_elapsed_secs: Optional[float] = None
+    # --- elastic degraded-mode training (system/elastic.py) ----------
+    # re-plan MFCs of LOST/preempted workers onto survivors instead of
+    # requeue-and-hope; re-expand when the worker rejoins
+    elastic_degrade: bool = False
+    # launcher resubmits a PREEMPTED worker's process once it exits
+    # (the "replacement worker rejoins" path)
+    elastic_rejoin: bool = False
+    # grace window a preempted worker gets to drain + emergency-save
+    preempt_grace_secs: float = 15.0
+    # at most this many adopted (migrated) MFC replicas per survivor:
+    # each adoption is a full extra weight copy in HBM
+    max_adopted_per_worker: int = 2
+    # --- durable checkpoints (system/ckpt_manager.py) ----------------
+    # route model-worker saves through the sharded-manifest manager
+    # (per-shard checksums, atomic COMMITTED marker, verified load
+    # with fallback, GC); the HF layout is preserved via a `latest`
+    # symlink so external consumers keep working
+    durable_ckpt: bool = True
+    # committed checkpoints retained per role (older ones are GCed)
+    ckpt_keep: int = 2
 
 
 @dataclasses.dataclass

@@ -127,8 +127,9 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         # requests must all be in flight before any reply is awaited.
         panel.group_request_varied(
             "configure",
-            {f"model_worker/{i}": dict(config=dict(spec_path=path,
-                                                   worker_index=i))
+            {f"model_worker/{i}": dict(config=dict(
+                spec_path=path, worker_index=i,
+                recover_mode=recover_mode))
              for i in range(spec.n_model_workers)},
             timeout=600)
         panel.group_request("start")
@@ -143,6 +144,11 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
             timeout=ft.heartbeat_timeout, grace=ft.startup_grace_secs,
             poll_interval=ft.watchdog_poll_secs)
         deadline = time.monotonic() + timeout
+        # elastic rejoin (ft.elastic_rejoin): once a PREEMPTED model
+        # worker's process exits, resubmit it; the relaunched
+        # incarnation reconfigures from the same spec and the master
+        # re-expands degraded nodes back onto it (system/elastic.py)
+        rejoining: Dict[str, float] = {}
         while True:
             try:
                 status = name_resolve.get(status_key)
@@ -154,12 +160,64 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
             # (reference scheduler poll -> JobException, main.py:195)
             for w in worker_names:
                 info = sched.find(w)
+                wstatus = panel.get_worker_status(w)
+                elastic_mw = (ft.elastic_degrade
+                              and w.startswith("model_worker/"))
+                exited = info.state.value not in ("RUNNING", "PENDING")
+                if wstatus == WorkerServerStatus.PREEMPTED or (
+                        elastic_mw and info.state.value == "FAILED"):
+                    # preempted (graceful) or silently dead under
+                    # elastic degradation: the master has migrated or
+                    # is migrating its MFCs; optionally bring a
+                    # replacement up for re-expansion
+                    if ft.elastic_rejoin and w not in rejoining \
+                            and w.startswith("model_worker/") and exited:
+                        logger.warning(
+                            "Worker %s exited (%s); resubmitting a "
+                            "replacement for elastic rejoin.", w,
+                            wstatus.value if wstatus else info.state)
+                        # the dead incarnation's command address is
+                        # stale; drop it so connect() below waits for
+                        # the replacement's registration (a graceful
+                        # exit may have already removed its own key)
+                        try:
+                            name_resolve.delete(names.worker_key(
+                                spec.experiment_name, spec.trial_name, w))
+                        except name_resolve.NameEntryNotFoundError:
+                            pass
+                        sched.resubmit(w)
+                        rejoining[w] = time.monotonic()
+                    continue
                 if info.state.value == "FAILED":
                     raise JobException(w, info.state)
-                if panel.get_worker_status(w) == WorkerServerStatus.ERROR:
+                if wstatus == WorkerServerStatus.ERROR:
                     raise JobException(w, info.state)
+            for w in list(rejoining):
+                try:
+                    panel.connect([w], timeout=0.2)
+                except Exception:  # noqa: BLE001 - still booting
+                    if time.monotonic() - rejoining[w] > 300:
+                        raise JobException(w, JobState.LOST)
+                    continue  # retry next tick
+                idx = int(w.rsplit("/", 1)[1])
+                panel.group_request_varied(
+                    "configure",
+                    {w: dict(config=dict(spec_path=path,
+                                         worker_index=idx,
+                                         recover_mode=recover_mode))},
+                    timeout=600)
+                panel.group_request("start", worker_names=[w])
+                del rejoining[w]
+                logger.info("Worker %s rejoined (reconfigured + "
+                            "started).", w)
             watchdog.poll()
             lost = watchdog.lost_longer_than(ft.worker_lost_fatal_secs)
+            # under elastic degradation the MASTER owns the fatal
+            # policy for model workers (it knows what was migrated);
+            # the launcher only fatals on a lost master
+            if ft.elastic_degrade:
+                lost = [w for w in lost
+                        if not w.startswith("model_worker/")]
             if lost:
                 raise JobException(lost[0], JobState.LOST)
             if time.monotonic() > deadline:
@@ -169,8 +227,15 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
 
         stats = panel.group_request("stats",
                                     worker_names=["master_worker/0"])
-        panel.group_request("exit")
-        sched.wait(timeout=60, check_status=False)
+        try:
+            panel.group_request("exit", timeout=60)
+            sched.wait(timeout=60, check_status=False)
+        except (TimeoutError, RuntimeError) as e:
+            # a worker mid-rejoin (elastic) may miss the exit
+            # broadcast on its stale socket; the trial IS complete --
+            # stop_all's SIGTERM/SIGKILL escalation cleans it up
+            logger.warning("Exit broadcast incomplete (%s); scheduler "
+                           "stop_all cleans up.", e)
         return stats["master_worker/0"]
     finally:
         sched.stop_all()

@@ -11,11 +11,22 @@ request, silently drops a reply, or delays one. Spec strings live in
     die:model_worker/0:train_step:2        # os._exit: silent death
     drop_reply:*:inference:1               # execute, never reply, once
     delay_reply:model_worker/1:*:3:2.5     # 3rd request sleeps 2.5s
+    preempt:model_worker/1:*:2:5.0         # SIGTERM-equivalent notice,
+                                           # 5s grace window
+    corrupt_ckpt:model_worker/0:ckpt_commit:1  # flip bytes in the
+                                           # just-committed shard
 
 ``crash`` raises (the worker reports an error payload and exits with
 ERROR status -- the attributed-error path); ``die`` hard-exits the
 process mid-request with no goodbye (the heartbeat-loss path the
-watchdog must catch).
+watchdog must catch); ``preempt`` delivers a preemption notice with a
+grace window (``seconds``) -- the worker announces it, finishes
+in-flight work, runs its emergency hooks, and exits PREEMPTED, the
+elastic-degradation path (docs/distributed.md); ``corrupt_ckpt``
+flips bytes in a shard of the checkpoint that was just committed
+(``ckpt_manager.CheckpointManager`` feeds it ``ckpt_commit`` events),
+proving the checksum-verify + fallback-to-previous-manifest load
+path.
 
 ``worker`` and ``handle`` are fnmatch patterns (``*`` = any). Faults
 are one-shot: each fires exactly once per matching spec. For
@@ -33,7 +44,8 @@ from realhf_tpu.base import logging
 
 logger = logging.getLogger("fault_injection")
 
-KINDS = ("crash", "die", "drop_reply", "delay_reply")
+KINDS = ("crash", "die", "drop_reply", "delay_reply", "preempt",
+         "corrupt_ckpt")
 
 FAULTS_ENV = "REALHF_TPU_FAULTS"
 FAULTS_STATE_ENV = "REALHF_TPU_FAULTS_STATE"
@@ -45,11 +57,11 @@ class FaultInjected(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    kind: str            # crash | drop_reply | delay_reply
+    kind: str            # one of KINDS
     worker: str = "*"    # fnmatch pattern on the worker name
     handle: str = "*"    # fnmatch pattern on the request handle_name
     nth: int = 1         # fire on the Nth matching event (1-based)
-    seconds: float = 0.0  # delay_reply sleep
+    seconds: float = 0.0  # delay_reply sleep / preempt grace window
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -86,6 +98,25 @@ def parse_faults(spec: str) -> List[FaultSpec]:
         out.append(FaultSpec(kind=kind, worker=worker, handle=handle,
                              nth=int(nth), seconds=seconds))
     return out
+
+
+def flip_bytes(path: str, n: int = 16, offset: int = 0):
+    """In-place byte corruption of a file (the ``corrupt_ckpt``
+    payload): XOR-flips ``n`` bytes starting at ``offset``. The file
+    keeps its size -- a durability layer relying on size alone would
+    miss this; checksums must catch it."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    offset = min(offset, size - 1)
+    n = min(n, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(n)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
 
 
 class FaultInjector:

@@ -44,6 +44,21 @@ def heartbeat_root(experiment_name: str, trial_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/heartbeat/"
 
 
+def worker_preempt(experiment_name: str, trial_name: str,
+                   worker_name: str) -> str:
+    """Preemption notice: the worker publishes ``"<ts>:<grace>"``
+    (wall-clock notice time + grace-window seconds) when it receives a
+    SIGTERM-equivalent preemption signal, then drains and exits
+    PREEMPTED within the window. The master reads it to trigger
+    elastic degradation BEFORE the heartbeat goes stale; a relaunched
+    worker clears its own stale notice at startup."""
+    return f"{_root(experiment_name, trial_name)}/preempt/{worker_name}"
+
+
+def preempt_root(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/preempt/"
+
+
 def request_reply_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/request_reply_stream/{stream_name}"
 

@@ -27,7 +27,9 @@ logger = logging.getLogger("recover")
 #:      pre-versioning pickles)
 #:   2: + version, buffer_state (SequenceBuffer in-flight snapshot),
 #:      dataloader_state (epoch accounting)
-RECOVER_INFO_VERSION = 2
+#:   3: + ckpt_manifests (role -> committed durable-checkpoint
+#:      manifest path, system/ckpt_manager.py)
+RECOVER_INFO_VERSION = 3
 
 
 @dataclasses.dataclass
@@ -51,6 +53,12 @@ class RecoverInfo:
     # dataloader epoch accounting: {"epoch", "epoch_step",
     # "epochs_fetched"} -- whichever the dumping runtime tracks.
     dataloader_state: Optional[Dict[str, Any]] = None
+    # v3: role -> manifest.json path of the last COMMITTED durable
+    # checkpoint covering this dump (system/ckpt_manager.py). The
+    # resumed trial restores weights/optimizer state from these after
+    # checksum verification, falling back to the previous committed
+    # manifest when a shard fails to verify.
+    ckpt_manifests: Optional[Dict[str, str]] = None
 
 
 def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:

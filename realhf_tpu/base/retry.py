@@ -21,12 +21,20 @@ logger = logging.getLogger("retry")
 class RetryPolicy:
     """Exponential backoff: delay_i = min(base * factor**i, max_delay),
     plus uniform jitter in [0, jitter * delay_i] so a fleet of
-    retriers never thunders in lockstep."""
+    retriers never thunders in lockstep.
+
+    ``max_elapsed`` caps the TOTAL wall clock of one retry_call --
+    attempts plus sleeps -- regardless of how many attempts remain.
+    Stacked retries during a degradation event (every control-plane
+    call backing off at once) must not exceed the watchdog grace
+    window, or they mask a real worker loss as transient slowness.
+    None = attempts alone bound the call."""
     max_attempts: int = 3
     base_delay: float = 0.5
     factor: float = 2.0
     max_delay: float = 30.0
     jitter: float = 0.5
+    max_elapsed: Optional[float] = None
 
 
 def backoff_delays(policy: RetryPolicy,
@@ -43,14 +51,21 @@ def retry_call(fn: Callable, policy: Optional[RetryPolicy] = None,
                on_retry: Optional[Callable[[int, BaseException], None]] = None,
                sleep: Callable[[float], None] = time.sleep,
                rng: Optional[random.Random] = None,
+               clock: Callable[[], float] = time.monotonic,
                what: str = ""):
     """Call ``fn()`` up to ``policy.max_attempts`` times, sleeping a
     backoff-with-jitter delay between attempts. Only exceptions listed
     in ``retry_on`` are retried; anything else propagates immediately,
     as does the final matching failure. ``on_retry(attempt, exc)`` is
-    invoked before each re-attempt (attempt counts from 1)."""
+    invoked before each re-attempt (attempt counts from 1).
+
+    With ``policy.max_elapsed`` set, a re-attempt is abandoned -- and
+    the last failure re-raised -- once the total-deadline budget is
+    spent or the upcoming sleep would overrun it. ``clock`` is the
+    monotonic time source (injectable for tests)."""
     policy = policy or RetryPolicy()
     delays = backoff_delays(policy, rng=rng)
+    start = clock()
     attempt = 0
     while True:
         attempt += 1
@@ -61,6 +76,15 @@ def retry_call(fn: Callable, policy: Optional[RetryPolicy] = None,
                 delay = next(delays)
             except StopIteration:
                 raise e  # attempts exhausted: surface the last failure
+            if policy.max_elapsed is not None and \
+                    clock() - start + delay > policy.max_elapsed:
+                logger.warning(
+                    "Not retrying %s: total deadline budget "
+                    "max_elapsed=%.1fs would be exceeded (%.1fs spent "
+                    "+ %.1fs backoff).", what or getattr(
+                        fn, "__name__", "call"), policy.max_elapsed,
+                    clock() - start, delay)
+                raise e
             logger.warning("Retrying %s (attempt %d/%d) after %s; "
                            "sleeping %.2fs.", what or getattr(
                                fn, "__name__", "call"), attempt,

@@ -13,7 +13,7 @@ behavior)."""
 
 import json
 import os
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -75,19 +75,44 @@ def save_opt_state_iter(path: str, leaves) -> str:
     return out
 
 
-def load_opt_state(path: str) -> Optional[List[np.ndarray]]:
-    """Read ``path/optimizer_state.npz`` -> host leaves, or None."""
+def load_opt_state_checked(path: str) -> Tuple[
+        Optional[List[np.ndarray]], Optional[str]]:
+    """Read ``path/optimizer_state.npz`` -> (host leaves, None), or
+    (None, reason). A corrupt/truncated/short file must name WHY the
+    state is unusable -- the shard path, expected vs actual leaf count
+    -- instead of silently degrading to fresh optimizer moments."""
     f = os.path.join(path, FILENAME)
     if not os.path.exists(f):
-        return None
-    with np.load(f) as z:
-        meta = json.loads(bytes(z["__meta__"]).decode())
-        leaves = []
-        for i in range(meta["n"]):
-            a = z[f"l{i}"]
-            if meta["dtypes"][i] == "bfloat16":
-                a = a.view(jnp.bfloat16)
-            leaves.append(a)
+        return None, f"no optimizer state at {f}"
+    try:
+        with np.load(f) as z:
+            if "__meta__" not in z:
+                raise ValueError("missing __meta__ member")
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            expected = int(meta["n"])
+            leaves = []
+            for i in range(expected):
+                if f"l{i}" not in z:
+                    raise ValueError(
+                        f"short file: {len(leaves)} of {expected} "
+                        "leaves present")
+                a = z[f"l{i}"]
+                if meta["dtypes"][i] == "bfloat16":
+                    a = a.view(jnp.bfloat16)
+                leaves.append(a)
+    except Exception as e:  # noqa: BLE001 - reason surfaces to caller
+        reason = (f"unreadable optimizer state shard {f}: "
+                  f"{type(e).__name__}: {e}")
+        logger.warning("%s", reason)
+        return None, reason
+    return leaves, None
+
+
+def load_opt_state(path: str) -> Optional[List[np.ndarray]]:
+    """Read ``path/optimizer_state.npz`` -> host leaves, or None (the
+    failure reason is logged; use :func:`load_opt_state_checked` to
+    receive it programmatically)."""
+    leaves, _reason = load_opt_state_checked(path)
     return leaves
 
 
@@ -97,8 +122,10 @@ def restore_engine_opt_state(engine, path: str) -> bool:
     file from the shared FS). Returns True when restored."""
     if engine.opt_state is None:
         return False
-    leaves = load_opt_state(path)
+    leaves, reason = load_opt_state_checked(path)
     if leaves is None:
+        if reason is not None and "no optimizer state" not in reason:
+            logger.warning("Optimizer state NOT restored: %s", reason)
         return False
     cur = jax.tree.leaves(engine.opt_state)
     ok = len(cur) == len(leaves) and all(

@@ -92,6 +92,19 @@ class GenServerWorker(worker_base.Worker):
             return self.rollout_server.stats()
         return super()._handle_command(cmd, kwargs)
 
+    def _preempt_hook(self, grace: float):
+        """Drain-on-preempt (docs/serving.md "Shutdown"): on a
+        preemption notice the server stops admitting, bounces queued
+        requests with "draining", and finishes (or cancels) in-flight
+        sequences inside the grace window -- clients see terminal
+        events, never a socket that silently vanished. The remaining
+        grace after the drain lets late fetches of the final events
+        complete before the PREEMPTED exit."""
+        budget = max(0.0, min(self._drain_timeout, grace * 0.8))
+        logger.warning("Gen server %s preempted: draining within "
+                       "%.1fs.", self.worker_name, budget)
+        self.rollout_server.drain(timeout=budget)
+
     def _update_weights(self, version: int, path: str = None) -> Dict:
         if path is not None:
             from realhf_tpu.models.hf import load_hf_checkpoint

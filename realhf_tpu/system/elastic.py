@@ -1,0 +1,256 @@
+"""Elastic degraded-mode planning: survive preemptions, re-expand.
+
+The paper's core mechanism -- parameter reallocation between per-MFC
+device meshes -- is exactly the machinery needed to *survive* capacity
+loss. Before this module, a LOST worker could only requeue MFCs
+(hoping the worker returned) or fail the trial for a cold relaunch.
+Now the master consults an :class:`ElasticPlanner` when the watchdog
+declares workers LOST or a preemption notice arrives
+(``names.worker_preempt``), and:
+
+1. **Degrade.** Each affected MFC is re-planned onto the surviving
+   worker set: a new single-worker home (preferring the role's
+   primary group -- the weights are already there) and a degraded
+   parallelism layout sized to the adopter's devices
+   (:func:`degrade_parallelism`, optionally ranked by the search
+   engine's analytic cost model). The master dispatches
+   ``adopt_node`` to the adopter -- which builds a replica engine and
+   reshards weights onto the degraded layout via
+   ``parallel/realloc.py`` / ``param_stream.py`` -- and reroutes
+   dispatch. Training continues at reduced throughput.
+2. **Re-expand.** When the preempted/lost worker rejoins (relaunched
+   by the launcher, heartbeat fresh + status RUNNING), the master
+   dispatches ``release_node`` to the temporary adopter, restores the
+   original routing, and forgives the worker's exclusion-backoff
+   history (``ExclusionBook.forgive``). The rejoined worker's replica
+   self-heals to the latest weights through the ordinary cross-group
+   param-sync stream (version floor attached to the next dispatch) --
+   reverse reallocation is the existing machinery, not a special
+   case.
+
+What is deliberately NOT migrated: MFCs executing on their role's
+PRIMARY group (train steps above all). Moving a trainable primary
+means moving optimizer state and the data-parallel training world --
+that is relaunch-level recovery territory, served by the durable
+checkpoint subsystem (``system/ckpt_manager.py``): the preempted
+worker's emergency save lands a committed manifest the relaunch
+restores from. The planner returns None for such nodes and the
+master's existing requeue/fatal path takes over.
+
+The planner is pure bookkeeping over the ExperimentSpec -- no
+sockets, no engines -- so every decision is unit-testable.
+"""
+
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from realhf_tpu.api.config import ModelInterfaceType
+from realhf_tpu.base import logging
+from realhf_tpu.parallel.mesh import ParallelismConfig
+
+logger = logging.getLogger("elastic")
+
+
+def degrade_parallelism(par: ParallelismConfig, n_devices: int,
+                        workload=None, cost_model=None
+                        ) -> Optional[ParallelismConfig]:
+    """The degraded layout for a mesh that must now fit ``n_devices``.
+
+    Preference order mirrors what is cheapest to shrink: data
+    parallelism first (pure throughput, no weight-layout change along
+    other axes), then context, then pipeline, then tensor parallelism
+    last (a TP change re-pads the vocab and reshards every matrix).
+    The layout is preserved outright when it already fits -- a CPU
+    fleet or a fat surviving host keeps full-fidelity numerics, which
+    also keeps degraded-mode training bitwise-comparable to the
+    original plan.
+
+    With a ``workload`` (``search.engine.MFCWorkload``) the surviving
+    candidates enumerated by the search engine on ``n_devices`` are
+    ranked by its analytic cost model instead, picking the fastest
+    layout that fits HBM. Returns None when nothing fits (zero
+    devices).
+    """
+    if n_devices <= 0:
+        return None
+    if par.world_size <= n_devices:
+        return par
+    if workload is not None:
+        from realhf_tpu.search.engine import (
+            TPUCostModel,
+            enumerate_candidates,
+        )
+        cands = enumerate_candidates(workload, n_devices,
+                                     cost_model or TPUCostModel())
+        if cands:
+            best = min(cands, key=lambda c: c.time)
+            chosen = dataclasses.replace(
+                best.parallel, gen_tp_size=par.gen_tp_size)
+            logger.info("Degraded %s -> %s by cost model (%d devices).",
+                        par, chosen, n_devices)
+            return chosen
+    dp, tp = par.data_parallel_size, par.tensor_parallel_size
+    pp, cp = par.pipeline_parallel_size, par.context_parallel_size
+    while dp * tp * pp * cp > n_devices:
+        if dp > 1:
+            dp = max(1, dp // 2)
+        elif cp > 1:
+            cp = max(1, cp // 2)
+        elif pp > 1:
+            pp = max(1, pp // 2)
+        elif tp > 1:
+            tp = max(1, tp // 2)
+        else:
+            return None
+    return ParallelismConfig(
+        data_parallel_size=dp, tensor_parallel_size=tp,
+        pipeline_parallel_size=pp, context_parallel_size=cp,
+        sequence_parallel=par.sequence_parallel and tp > 1,
+        gen_tp_size=par.gen_tp_size if par.gen_tp_size
+        and par.gen_tp_size <= n_devices else 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class NodePlan:
+    """Where one MFC runs while degraded."""
+    node: str
+    workers: List[int]              # new exec group (single adopter)
+    parallel: ParallelismConfig     # degraded layout
+    cross_group: bool               # != the role's primary group
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class DegradedNode:
+    """Bookkeeping for one migrated MFC, kept until re-expansion."""
+    node: str
+    original_workers: List[str]     # worker names, leader first
+    original_cross_group: bool
+    adopted_workers: List[str]
+    plan: NodePlan
+    since: float
+
+
+class ElasticPlanner:
+    """Degrade/re-expand planning over an ExperimentSpec.
+
+    ``devices_per_worker``: local device count of one model worker
+    (the adopter sizes its degraded mesh to this). ``max_adopted``:
+    cap on concurrently adopted nodes per worker -- every adoption is
+    a full extra weight replica in HBM.
+    """
+
+    def __init__(self, spec, dfg, devices_per_worker: Optional[int] = None,
+                 max_adopted_per_worker: int = 2):
+        self.spec = spec
+        self.dfg = dfg
+        if devices_per_worker is None:
+            ldc = os.environ.get("REALHF_TPU_LOCAL_DEVICE_COUNT")
+            if ldc:
+                devices_per_worker = int(ldc)
+            else:
+                from realhf_tpu.parallel.mesh import default_devices
+                devices_per_worker = len(default_devices())
+        self.devices_per_worker = int(devices_per_worker)
+        self.max_adopted_per_worker = max_adopted_per_worker
+        #: node -> DegradedNode, the live degradations
+        self.degraded: Dict[str, DegradedNode] = {}
+
+    # ------------------------------------------------------------------
+    def _adopted_on(self, widx: int) -> int:
+        return sum(1 for d in self.degraded.values()
+                   if d.adopted_workers == [f"model_worker/{widx}"])
+
+    def plan_degraded(self, node_name: str, lost: Set[int],
+                      alive: Sequence[int],
+                      workload=None) -> Optional[NodePlan]:
+        """Re-plan one MFC off the ``lost`` workers onto a survivor.
+
+        Returns None when the node cannot be migrated (its role's
+        primary group is hit, it is a train step, or no survivor has
+        capacity) -- the caller falls back to requeue/fatal handling.
+        """
+        node = self.dfg.find(node_name)
+        role = node.role
+        primary = self.spec.workers_of_role(role)
+        exec_group = self.spec.workers_of_node(node_name, role)
+        if not (set(exec_group) & lost):
+            return None  # unaffected
+        if node.interface_type == ModelInterfaceType.TRAIN_STEP:
+            logger.warning(
+                "Elastic: train MFC %s hit by loss of workers %s; "
+                "train steps never migrate (optimizer state moves via "
+                "the durable checkpoint on relaunch).", node_name,
+                sorted(lost))
+            return None
+        if set(primary) & lost:
+            logger.warning(
+                "Elastic: role %s's PRIMARY group %s hit by loss of "
+                "workers %s; %s not migratable (weights source is "
+                "gone -- relaunch restores from the emergency "
+                "checkpoint).", role, primary, sorted(lost), node_name)
+            return None
+        survivors = [w for w in alive if w not in lost]
+        if not survivors:
+            return None
+        # Adopter preference: the role's primary-group leader first
+        # (weights are live in-process: adoption is a local reshard,
+        # no cross-group stream), then the least-loaded survivor.
+        ordered = ([w for w in primary if w in survivors]
+                   + sorted((w for w in survivors if w not in primary),
+                            key=lambda w: (self._adopted_on(w), w)))
+        for widx in ordered:
+            if self._adopted_on(widx) >= self.max_adopted_per_worker:
+                continue
+            par = degrade_parallelism(
+                self._node_parallel(node_name, role),
+                self.devices_per_worker, workload=workload)
+            if par is None:
+                continue
+            cross = widx not in primary
+            return NodePlan(
+                node=node_name, workers=[widx], parallel=par,
+                cross_group=cross,
+                reason=f"workers {sorted(lost)} lost/preempted")
+        logger.error(
+            "Elastic: no surviving worker can adopt %s (survivors %s "
+            "all at max_adopted_per_worker=%d or too small).",
+            node_name, survivors, self.max_adopted_per_worker)
+        return None
+
+    def _node_parallel(self, node_name: str, role: str
+                       ) -> ParallelismConfig:
+        alloc = self.spec.alloc_of(node_name)
+        if alloc is not None:
+            return alloc.parallel
+        return self.spec.models[role].parallel
+
+    # ------------------------------------------------------------------
+    def record_degraded(self, plan: NodePlan,
+                        original_workers: List[str],
+                        original_cross_group: bool,
+                        clock=time.monotonic) -> DegradedNode:
+        rec = DegradedNode(
+            node=plan.node, original_workers=list(original_workers),
+            original_cross_group=original_cross_group,
+            adopted_workers=[f"model_worker/{w}" for w in plan.workers],
+            plan=plan, since=clock())
+        self.degraded[plan.node] = rec
+        return rec
+
+    def restorable_nodes(self, rejoined: Set[str]) -> List[DegradedNode]:
+        """Degraded nodes whose ENTIRE original worker group is back
+        among ``rejoined`` (worker names) -- ready for re-expansion."""
+        return [d for d in self.degraded.values()
+                if set(d.original_workers) <= rejoined]
+
+    def mark_restored(self, node_name: str) -> Optional[DegradedNode]:
+        return self.degraded.pop(node_name, None)
+
+    def degraded_workers(self) -> Set[str]:
+        """Original homes of currently degraded nodes (the workers
+        whose rejoin we are waiting for)."""
+        return {w for d in self.degraded.values()
+                for w in d.original_workers}

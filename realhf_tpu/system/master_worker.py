@@ -112,6 +112,9 @@ class MasterWorker(worker_base.Worker):
         self.global_step = 0
         self._start_epoch = 0
         self._ids_to_skip = set()
+        # role -> manifest path of the last committed durable
+        # checkpoint (RecoverInfo v3, system/ckpt_manager.py)
+        self._ckpt_manifests: Dict[str, str] = {}
         if self.recover_mode == "resume":
             # tolerant load: a corrupt/truncated/future-schema file
             # degrades to a fresh start, never a crash loop
@@ -120,6 +123,8 @@ class MasterWorker(worker_base.Worker):
                 self.global_step = info.last_step_info.global_step
                 self._start_epoch = info.recover_start.epoch
                 self._ids_to_skip = set(info.hash_vals_to_ignore)
+                self._ckpt_manifests = dict(
+                    getattr(info, "ckpt_manifests", None) or {})
                 if info.buffer_state:
                     # restore only the batch-id watermark: the
                     # in-flight entries' tensors died with the old
@@ -148,10 +153,28 @@ class MasterWorker(worker_base.Worker):
             max_delay=self.ft.exclude_max_secs)
         self._mfc_requeues: Dict[tuple, int] = {}  # (bid, mfc) -> count
         self._fetch_requeues = 0
+        # elastic degraded-mode training (system/elastic.py): re-plan
+        # MFCs of preempted/LOST workers onto survivors; re-expand on
+        # rejoin. "Retiring" workers (preempt notice seen, or lost
+        # with their nodes migrated) are ineligible for dispatch but
+        # exempt from the fatal-loss deadline once nothing needs them.
+        self.elastic = None
+        if getattr(self.ft, "elastic_degrade", False):
+            from realhf_tpu.system.elastic import ElasticPlanner
+            self.elastic = ElasticPlanner(
+                self.spec, self.dfg,
+                max_adopted_per_worker=getattr(
+                    self.ft, "max_adopted_per_worker", 2))
+        self._retiring: set = set()
+        self._preempt_seen: set = set()
 
         # runtime state
         self._subscribed = False
         self._fetch_inflight = False
+        # completed fetch_data replies THIS incarnation: the exact
+        # number of dataloader advances a data-owner successor must
+        # replay to take over mid-epoch (elastic handoff)
+        self._fetches_done = 0
         # request_id -> (bid, mfc_name, worker, kind); kind in
         # {leader, member, fetch, clear, sync}
         self._inflight: Dict[str, tuple] = {}
@@ -216,27 +239,61 @@ class MasterWorker(worker_base.Worker):
 
     # -- fault tolerance -----------------------------------------------
     def _workers_eligible(self, workers) -> bool:
-        """Dispatch gate: every addressed worker must be live and out
-        of its exclusion window (a flapping worker is not re-picked
-        until its backoff expires)."""
+        """Dispatch gate: every addressed worker must be live, out of
+        its exclusion window (a flapping worker is not re-picked until
+        its backoff expires), and not retiring under a preemption
+        notice."""
         return all(not self._exclusions.is_excluded(w)
                    and w not in self.watchdog.lost_workers()
+                   and w not in self._retiring
                    for w in workers)
 
+    def _active_workers(self) -> list:
+        """Fan-out targets for best-effort broadcasts (cache clears):
+        the fleet minus retiring workers, whose requests would pile up
+        unanswered in ``_inflight`` forever."""
+        return [w for w in self.all_workers if w not in self._retiring]
+
     def _check_liveness(self):
-        """Run the watchdog (rate-limited); requeue or fail work
-        attributed to newly lost workers; enforce the fatal deadline
-        for workers that stay lost."""
+        """Run the watchdog (rate-limited); react to preemption
+        notices (elastic degrade BEFORE the heartbeat goes stale);
+        requeue or fail work attributed to newly lost workers; enforce
+        the fatal deadline for workers that stay lost; re-expand when
+        a degraded node's home worker rejoins."""
+        for w in self.watchdog.preempt_notices():
+            if w not in self._preempt_seen:
+                self._preempt_seen.add(w)
+                self._on_worker_preempted(w)
         for w in self.watchdog.poll():
             self._on_worker_lost(w)
         fatal = self.watchdog.lost_longer_than(
             self.ft.worker_lost_fatal_secs)
+        # a retired worker whose every responsibility was migrated is
+        # no longer load-bearing: its continued absence must not fail
+        # a trial that is training fine on the degraded plan
+        fatal = [w for w in fatal if self._still_needed(w)]
         if fatal:
             raise WorkerLostError(
                 fatal, inflight=self._work_attributed_to(fatal),
                 detail="Lost longer than worker_lost_fatal_secs="
                        f"{self.ft.worker_lost_fatal_secs:.0f}s; "
                        "failing the trial for relaunch-level recovery.")
+        if self._retiring:
+            self._maybe_reexpand()
+
+    def _still_needed(self, worker: str) -> bool:
+        """Does anything still route through ``worker``? Data
+        ownership, any MFC's exec group, or sender duty for a
+        cross-group param sync."""
+        if worker == self.data_owner:
+            return True
+        if any(worker in ws for ws in self.node_workers.values()):
+            return True
+        for n in self.dfg.nodes:
+            if n.name in self.cross_group_nodes \
+                    and worker in self.role_workers.get(n.role, ()):
+                return True
+        return False
 
     def _work_attributed_to(self, workers) -> list:
         """MFC names in flight on, or queued for, any of ``workers``
@@ -261,8 +318,96 @@ class MasterWorker(worker_base.Worker):
         drop its in-flight requests, and requeue the affected MFCs
         (bounded by ft.max_mfc_retries) so a flap heals without
         failing the trial; exhausted retries raise a WorkerLostError
-        naming the worker and the MFC."""
+        naming the worker and the MFC. With elastic degradation on,
+        its migratable MFCs are then re-planned onto survivors."""
         self._exclusions.exclude(worker)
+        self._drop_and_requeue(worker)
+        if self.elastic is not None:
+            self._retiring.add(worker)
+            self._elastic_degrade(worker)
+
+    def _on_worker_preempted(self, worker: str):
+        """A preemption notice arrived (SIGTERM-equivalent, grace
+        window running): stop dispatching to the worker, requeue what
+        was in flight on it (it may still finish -- the duplicate
+        reply drains harmlessly), and migrate its MFCs while the old
+        incarnation is still draining."""
+        notice = self.watchdog.preempt_notice(worker)
+        grace = notice[1] if notice else 0.0
+        logger.warning(
+            "Worker %s announced PREEMPTION (%.1fs grace): retiring "
+            "it from dispatch%s.", worker, grace,
+            "" if self.elastic is None
+            else " and re-planning its MFCs onto survivors")
+        self._retiring.add(worker)
+        self._drop_and_requeue(worker)
+        if self.elastic is not None:
+            # handoff FIRST: it must land while the draining worker's
+            # data server still answers inside the grace window
+            if worker == self.data_owner:
+                self._handoff_data_owner(worker, grace)
+            self._elastic_degrade(worker)
+
+    def _handoff_data_owner(self, worker: str, grace: float):
+        """The preempted worker owns the data plane (dataset loader +
+        live batches' tensors): hand both to a survivor before the
+        grace window closes. The successor pulls every live batch's
+        pieces still homed on the draining worker (its data server
+        keeps answering until the graceful exit), builds its own
+        dataloader, and replays ``_fetches_done`` advances -- the
+        seeded loader reproduces the exact stream, so position-based
+        replay means no sample is re-consumed or skipped. On failure
+        the old owner stays the owner and ``_still_needed`` keeps its
+        fatal deadline armed (relaunch-level recovery)."""
+        succ = next((w for w in self.all_workers
+                     if w != worker and w not in self._retiring
+                     and w not in self.watchdog.lost_workers()), None)
+        if succ is None:
+            logger.error("Data owner %s preempted but no survivor can "
+                         "take over; relaunch-level recovery applies.",
+                         worker)
+            return
+        rescue = []
+        for bid in self.buffer.batch_ids():
+            e = self.buffer.get(bid)
+            keys = sorted(k for k, o in e.key_owner.items()
+                          if o == worker)
+            if keys:
+                rescue.append(dict(ids=list(e.ids), keys=keys))
+        payload = dict(from_worker=worker,
+                       fetches_done=self._fetches_done,
+                       rescue=rescue,
+                       fetch_timeout=max(5.0, grace))
+        try:
+            rids = self.stream.request([succ], "adopt_data",
+                                       datas=[payload])
+            replies = self.stream.gather_replies(
+                rids, timeout=self.ft.gather_timeout_secs,
+                check_liveness=lambda: self.watchdog.raise_if_lost(
+                    [succ], inflight=["adopt_data"]))
+            err = next((p.data["error"] for p in replies
+                        if isinstance(p.data, dict)
+                        and p.data.get("error")), None)
+            if err is not None:
+                raise RuntimeError(f"successor rescue failed: {err}")
+        except Exception as e:  # noqa: BLE001 - keep the old owner
+            logger.error(
+                "Data-owner handoff %s -> %s FAILED (%s); %s stays "
+                "the owner and its loss is fatal after the deadline.",
+                worker, succ, e, worker)
+            return
+        self.data_owner = succ
+        for bid in self.buffer.batch_ids():
+            e = self.buffer.get(bid)
+            for k, o in list(e.key_owner.items()):
+                if o == worker:
+                    e.key_owner[k] = succ
+        logger.warning(
+            "DATA OWNERSHIP handed off %s -> %s: %d live batches "
+            "rescued, loader replayed to fetch %d.", worker, succ,
+            len(rescue), self._fetches_done)
+
+    def _drop_and_requeue(self, worker: str):
         lost_refs = [(rid, ref) for rid, ref in self._inflight.items()
                      if ref[2] == worker]
         for rid, (bid, mfc_name, _w, kind) in lost_refs:
@@ -300,9 +445,134 @@ class MasterWorker(worker_base.Worker):
                 self._fetch_inflight = False
                 logger.warning("Requeued fetch_data after losing data "
                                "owner %s.", worker)
-            else:  # clear / sync: best-effort, drop silently
+            else:  # clear / sync / adopt / release: drop silently
                 logger.warning("Dropped in-flight %s request to lost "
                                "worker %s.", kind, worker)
+
+    # -- elastic degrade / re-expand (system/elastic.py) ----------------
+    def _alive_worker_indices(self) -> list:
+        out = []
+        for w in self.all_workers:
+            if w in self._retiring or w in self.watchdog.lost_workers():
+                continue
+            out.append(int(w.rsplit("/", 1)[1]))
+        return sorted(out)
+
+    def _elastic_degrade(self, worker: str):
+        """Re-plan every MFC currently routed through ``worker`` onto
+        a survivor: the adopter builds a replica engine on a degraded
+        layout and weights reshard onto it (live primary / verified
+        emergency checkpoint / deterministic seed + param-sync
+        refresh). Non-migratable nodes (train steps, hit primaries)
+        keep the existing requeue/fatal semantics."""
+        widx = int(worker.rsplit("/", 1)[1])
+        alive = self._alive_worker_indices()
+        for node in self.dfg.nodes:
+            group = self.node_workers[node.name]
+            if worker not in group:
+                continue
+            plan = self.elastic.plan_degraded(node.name, lost={widx},
+                                              alive=alive)
+            if plan is None:
+                continue
+            new_workers = [f"model_worker/{i}" for i in plan.workers]
+            data = dict(node=node.name, parallel=plan.parallel,
+                        cross_group=plan.cross_group, try_ckpt=True)
+            try:
+                rids = self.stream.request(
+                    new_workers, "adopt_node",
+                    datas=[data] * len(new_workers))
+                replies = self.stream.gather_replies(
+                    rids, timeout=self.ft.gather_timeout_secs,
+                    check_liveness=lambda: self.watchdog.raise_if_lost(
+                        new_workers,
+                        inflight=[f"adopt_node:{node.name}"]))
+            except Exception as e:  # noqa: BLE001 - degrade is best
+                # effort: the node stays routed to the dead worker and
+                # the ordinary requeue/fatal machinery takes over
+                logger.error(
+                    "Elastic adoption of %s by %s FAILED (%s); "
+                    "falling back to requeue/fatal handling.",
+                    node.name, new_workers, e)
+                continue
+            self.elastic.record_degraded(
+                plan, original_workers=list(group),
+                original_cross_group=node.name in self.cross_group_nodes)
+            self.node_workers[node.name] = new_workers
+            self.node_worker[node.name] = new_workers[0]
+            if plan.cross_group:
+                self.cross_group_nodes.add(node.name)
+            else:
+                self.cross_group_nodes.discard(node.name)
+            logger.warning(
+                "DEGRADED %s: %s -> %s on layout %s (%s); installed "
+                "weight version %s. Training continues at reduced "
+                "throughput.", node.name, group, new_workers,
+                plan.parallel, plan.reason,
+                [p.data.get("version") if isinstance(p.data, dict)
+                 else "?" for p in replies])
+
+    def _worker_status(self, worker: str):
+        try:
+            return worker_base.WorkerServerStatus(name_resolve.get(
+                names.worker_status(self.spec.experiment_name,
+                                    self.spec.trial_name, worker)))
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None
+
+    def _maybe_reexpand(self):
+        """Detect rejoined workers (relaunched incarnation: fresh
+        heartbeat, RUNNING status, stale preempt notice cleared at its
+        startup) and re-expand: release adopted replicas, restore the
+        original routing, forgive exclusion history. The rejoined
+        worker's replica self-heals to the current weights through the
+        ordinary cross-group param-sync stream."""
+        rejoined = []
+        for w in sorted(self._retiring):
+            if not self.watchdog.has_fresh_beat(w):
+                continue
+            if self.watchdog.preempt_notice(w) is not None:
+                continue  # old incarnation still draining
+            if self._worker_status(w) != \
+                    worker_base.WorkerServerStatus.RUNNING:
+                continue
+            try:
+                # the new incarnation's SUB socket must prove it
+                # receives our PUB before any dispatch re-routes to it
+                self.stream.wait_subscribers([w], timeout=5)
+            except TimeoutError:
+                continue  # retry on a later poll
+            rejoined.append(w)
+        if not rejoined:
+            return
+        for w in rejoined:
+            self._retiring.discard(w)
+            self._preempt_seen.discard(w)
+            self._exclusions.forgive(w)
+            logger.warning("Worker %s REJOINED; re-expanding.", w)
+        if self.elastic is None:
+            return
+        available = {w for w in self.all_workers
+                     if w not in self._retiring
+                     and w not in self.watchdog.lost_workers()}
+        for rec in self.elastic.restorable_nodes(available):
+            rids = self.stream.request(
+                rec.adopted_workers, "release_node",
+                datas=[dict(node=rec.node)] * len(rec.adopted_workers))
+            for w, r in zip(rec.adopted_workers, rids):
+                self._inflight[r] = (None, None, w, "release")
+            self.node_workers[rec.node] = list(rec.original_workers)
+            self.node_worker[rec.node] = rec.original_workers[0]
+            if rec.original_cross_group:
+                self.cross_group_nodes.add(rec.node)
+            else:
+                self.cross_group_nodes.discard(rec.node)
+            self.elastic.mark_restored(rec.node)
+            logger.warning(
+                "RE-EXPANDED %s: %s -> %s (degraded for %.1fs); "
+                "param-sync refresh heals the rejoined replica "
+                "forward.", rec.node, rec.adopted_workers,
+                rec.original_workers, time.monotonic() - rec.since)
 
     def _dispatch_mfc(self, bid: int, mfc_name: str):
         e = self.buffer.get(bid)
@@ -365,6 +635,8 @@ class MasterWorker(worker_base.Worker):
     # ------------------------------------------------------------------
     def _on_fetch_reply(self, data: Dict):
         self._fetch_inflight = False
+        # every reply -- empty included -- advanced the owner's loader
+        self._fetches_done += 1
         epoch = self._start_epoch + data["epoch"]
         if data["is_epoch_last"]:
             self._epochs_fetched += 1
@@ -411,11 +683,14 @@ class MasterWorker(worker_base.Worker):
                 "%.2fs since last; stats keys: %s", e.batch_id,
                 self.global_step, e.epoch, dt,
                 sorted(self._step_stats))
-            # free worker-side storage for this batch
+            # free worker-side storage for this batch (active workers
+            # only: a retiring worker's store dies with it, and its
+            # unanswered clears would pile up in _inflight forever)
+            targets = self._active_workers()
             rids = self.stream.request(
-                self.all_workers, "clear_data_cache",
-                datas=[dict(ids=list(e.ids))] * len(self.all_workers))
-            for w, r in zip(self.all_workers, rids):
+                targets, "clear_data_cache",
+                datas=[dict(ids=list(e.ids))] * len(targets))
+            for w, r in zip(targets, rids):
                 self._inflight[r] = (None, None, w, "clear")
             self._log_device_stats(e.batch_id)
             self._maybe_save_eval(e)
@@ -481,7 +756,18 @@ class MasterWorker(worker_base.Worker):
             # Retried with backoff (save is idempotent); each attempt
             # is liveness-checked so a dead worker aborts it within
             # the heartbeat timeout, not after gather_timeout_secs.
-            self._request_gather_with_retry("save", by_worker)
+            replies = self._request_gather_with_retry("save", by_worker)
+            # durable-checkpoint manifests (system/ckpt_manager.py):
+            # workers reply {role: {path, manifest, step}} after the
+            # atomic commit; the newest manifest per role rides in
+            # RecoverInfo v3 so a resumed trial restores the exact
+            # weights these counters describe.
+            for p in replies:
+                if not isinstance(p.data, dict):
+                    continue
+                for role, v in p.data.items():
+                    if isinstance(v, dict) and v.get("manifest"):
+                        self._ckpt_manifests[role] = v["manifest"]
             if self.recover_mode != "disabled":
                 recover.dump(recover.RecoverInfo(
                     recover_start=recover.StepInfo(
@@ -494,7 +780,8 @@ class MasterWorker(worker_base.Worker):
                     buffer_state=self.buffer.state_dict(),
                     dataloader_state=dict(
                         epoch=self._cur_epoch,
-                        epochs_fetched=self._epochs_fetched)))
+                        epochs_fetched=self._epochs_fetched),
+                    ckpt_manifests=dict(self._ckpt_manifests) or None))
         if self.spec.eval_dataset is not None and not force and \
                 self.eval_ctl.check(epochs=epochs, steps=1):
             by_worker = {}
@@ -515,7 +802,9 @@ class MasterWorker(worker_base.Worker):
 
         def attempt():
             rids = [self.stream.request(
-                [w], handle, datas=[dict(nodes=nodes)])[0]
+                [w], handle,
+                datas=[dict(nodes=nodes,
+                            global_step=self.global_step)])[0]
                 for w, nodes in by_worker.items()]
             try:
                 return self.stream.gather_replies(
@@ -530,7 +819,9 @@ class MasterWorker(worker_base.Worker):
         return retry_call(
             attempt,
             RetryPolicy(max_attempts=max(1, self.ft.gather_retries),
-                        base_delay=1.0),
+                        base_delay=1.0,
+                        max_elapsed=getattr(
+                            self.ft, "gather_max_elapsed_secs", None)),
             retry_on=(TimeoutError,), what=f"{handle} gather")
 
     # ------------------------------------------------------------------

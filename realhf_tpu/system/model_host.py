@@ -214,6 +214,10 @@ class ModelHost:
         self.devices_fn = devices_fn
         self.leader_of_role = leader_of_role or {}
         self.cross_group_nodes = set(cross_group_nodes or ())
+        self.total_steps = total_steps
+        # elastic adoption: nodes migrated here by the master while
+        # their home worker is preempted/lost (system/elastic.py)
+        self.adopted_nodes: set = set()
 
         def alloc_devices(alloc, workers):
             """Devices for a replica mesh: the worker-world slice in
@@ -368,6 +372,78 @@ class ModelHost:
             node.add_post_hook(OffloadHook())
             logger.info("Auto-resolved offload post-hook on %s (%s).",
                         node.name, node.role)
+
+    # --- elastic degraded-mode adoption (system/elastic.py) -----------
+    def adopt_node(self, node: MFCDef, parallel,
+                   ckpt_path: Optional[str] = None) -> int:
+        """Take over an MFC whose home worker was preempted/lost:
+        build a replica engine on the degraded ``parallel`` layout and
+        register the node for execution here. Returns the weight
+        version now installed.
+
+        Weight source, in preference order:
+
+        - the role's PRIMARY lives in this process: the replica is
+          seeded from its live params (``jax.device_put`` resharding
+          onto the degraded mesh -- parallel/realloc.py); version =
+          the primary's train step.
+        - ``ckpt_path`` (a verified durable checkpoint dir): the
+          emergency save of the preempted worker; version 0 (the
+          cross-group sync refreshes forward if the role trains).
+        - neither: the deterministic init seed -- bit-identical to the
+          lost replica's own start; version 0, refreshed by the
+          cross-group param sync exactly like a configure-time
+          replica.
+        """
+        role = node.role
+        self.nodes[node.name] = node
+        if node.name not in self.interfaces:
+            self.interfaces[node.name] = model_api.make_interface(
+                node.interface_impl)
+        self._role_lock(role)  # ensure the lock exists before exec
+        primary = self.models.get(role)
+        mspec = _dc.replace(self.spec.models[role], parallel=parallel,
+                            optimizer=None)
+        if primary is not None:
+            self.replicas[node.name] = build_model(
+                f"{role}-{node.name}", mspec, self.tokenizer,
+                self.total_steps, params_override=primary.engine.params,
+                cfg_override=primary.config)
+            version = self.role_version(role)
+        else:
+            if ckpt_path is not None:
+                mspec = _dc.replace(mspec, path=ckpt_path,
+                                    random_init_config=None)
+            self.replicas[node.name] = build_model(
+                f"{role}-{node.name}", mspec, self.tokenizer,
+                self.total_steps, init_seed=self.spec.seed,
+                seed_role=role)
+            self.node_param_version[node.name] = 0
+            version = 0
+        self.adopted_nodes.add(node.name)
+        logger.info(
+            "ADOPTED %s (role %s) on degraded layout %s (weights from "
+            "%s, version %d).", node.name, role, parallel,
+            "live primary" if primary is not None
+            else (ckpt_path or "init seed"), version)
+        return version
+
+    def release_node(self, node_name: str) -> bool:
+        """Drop an adopted node's replica (re-expansion: its original
+        home rejoined). Frees the extra weight copy."""
+        if node_name not in self.adopted_nodes:
+            return False
+        self.adopted_nodes.discard(node_name)
+        self.node_param_version.pop(node_name, None)
+        model = self.replicas.pop(node_name, None)
+        self.interfaces.pop(node_name, None)
+        self.nodes.pop(node_name, None)
+        if model is not None:
+            # drop engine references so the mesh arrays free promptly
+            model.engine.params = None
+        logger.info("RELEASED adopted node %s (home worker rejoined).",
+                    node_name)
+        return True
 
     # ------------------------------------------------------------------
     def engines_of_node(self, node: MFCDef):
@@ -564,9 +640,19 @@ class ModelHost:
         Engine) serialize on the role's lock inside execute(), so only
         genuinely independent cross-role work overlaps.
         ``parallel=False`` (or ``REALHF_TPU_PARALLEL_MFC=0``)
-        serializes."""
+        serializes; ``REALHF_TPU_PARALLEL_MFC=1`` forces overlap.
+        With neither set, overlap additionally requires >1 online
+        CPU: concurrent XLA CPU executables carrying cross-module
+        collectives rendezvous by spin-waiting across threads, and on
+        a single-core host those spinners starve each other into a
+        deadlock (observed as 'waiting for all participants to
+        arrive at rendezvous' forever)."""
         if parallel is None:
-            parallel = os.environ.get("REALHF_TPU_PARALLEL_MFC") != "0"
+            env = os.environ.get("REALHF_TPU_PARALLEL_MFC")
+            if env is not None:
+                parallel = env != "0"
+            else:
+                parallel = (os.cpu_count() or 1) > 1
         if len(named_inputs) == 1 or not parallel:
             return [self.execute(n, i) for n, i in named_inputs]
         from concurrent.futures import ThreadPoolExecutor
@@ -575,9 +661,16 @@ class ModelHost:
             return [f.result() for f in futs]
 
     # ------------------------------------------------------------------
-    def save_role(self, role: str, train_node_name: str):
+    def save_role(self, role: str, train_node_name: str,
+                  path: Optional[str] = None):
+        """Checkpoint a role. ``path`` overrides the default
+        ``run_save_path()/role`` target -- the durable-checkpoint
+        manager points it at a staging directory that is checksummed
+        and atomically committed after this returns
+        (system/ckpt_manager.py)."""
         model = self.models[role]
-        path = os.path.join(constants.run_save_path(), role)
+        if path is None:
+            path = os.path.join(constants.run_save_path(), role)
         if not getattr(self.interfaces[train_node_name], "enable_save",
                        True):
             # The leader's interface.save() returns without touching

@@ -28,10 +28,12 @@ from realhf_tpu.base import (
     logging,
     name_resolve,
     names,
+    recover,
     seeding,
 )
 from realhf_tpu.base.fault_injection import FaultInjected, FaultInjector
 from realhf_tpu.system import worker_base
+from realhf_tpu.system.ckpt_manager import CheckpointManager
 from realhf_tpu.system.data_plane import DataClient, DataServer, DataStore
 from realhf_tpu.system.model_host import ModelHost
 from realhf_tpu.system.request_reply_stream import (
@@ -60,6 +62,19 @@ class ModelWorker(worker_base.Worker):
 
         import realhf_tpu.datasets  # noqa: F401 - register datasets
         import realhf_tpu.interfaces  # noqa: F401 - register interfaces
+
+        from realhf_tpu.api.experiment import FaultToleranceConfig
+        self.ft = getattr(spec, "ft", None) or FaultToleranceConfig()
+        # deterministic fault injection (REALHF_TPU_FAULTS), used by
+        # the fault-tolerance tier-1 tests; None in production.
+        # Created BEFORE the checkpoint managers so corrupt_ckpt
+        # faults reach their commit hooks.
+        self.faults = FaultInjector.from_env()
+        self._ckpt_mgrs: Dict[str, CheckpointManager] = {}
+        self.recover_mode = config.get("recover_mode", "disabled")
+        if self.recover_mode == "resume" and getattr(
+                self.ft, "durable_ckpt", False):
+            self._redirect_models_to_durable(spec)
 
         self.dfg = DFG(spec.mfcs)
         # Roles whose primary group includes this worker.
@@ -195,10 +210,6 @@ class ModelWorker(worker_base.Worker):
         self.data_client = DataClient(spec.experiment_name,
                                       spec.trial_name)
 
-        # deterministic fault injection (REALHF_TPU_FAULTS), used by
-        # the fault-tolerance tier-1 tests; None in production
-        self.faults = FaultInjector.from_env()
-
         self.stream = NameResolvingReplyServer(
             spec.experiment_name, spec.trial_name, self.worker_name)
         logger.info("ModelWorker %s configured: roles=%s nodes=%s "
@@ -207,6 +218,148 @@ class ModelWorker(worker_base.Worker):
         return dict(roles=my_roles, nodes=sorted(self.my_nodes),
                     owns_data=self.owns_data,
                     steps_per_epoch=self.steps_per_epoch)
+
+    # --- durable checkpoints (system/ckpt_manager.py) -----------------
+    def _ckpt_manager(self, role: str) -> CheckpointManager:
+        mgr = self._ckpt_mgrs.get(role)
+        if mgr is None:
+            mgr = CheckpointManager(
+                os.path.join(constants.run_save_path(), "durable", role),
+                keep=getattr(self.ft, "ckpt_keep", 2),
+                injector=self.faults, owner=self.worker_name)
+            self._ckpt_mgrs[role] = mgr
+        return mgr
+
+    def _redirect_models_to_durable(self, spec):
+        """Resume path: point every role with a committed durable
+        checkpoint at it (RecoverInfo v3 names the manifest covering
+        the restored counters; a checksum failure falls back to the
+        previous committed checkpoint, loudly). Multi-process groups
+        agree by construction: shared FS + deterministic
+        verification."""
+        info = recover.load_safe()
+        manifests = (getattr(info, "ckpt_manifests", None) or {}
+                     if info is not None else {})
+        for role, mspec in spec.models.items():
+            mgr = self._ckpt_mgrs.get(role) or self._ckpt_manager(role)
+            rec = (mgr.resolve_manifest(manifests[role])
+                   if role in manifests else mgr.latest_verified())
+            path = rec.path if rec is not None else None
+            if path is None:
+                if mgr.records():
+                    # durable checkpoints exist but NONE verifies: a
+                    # fresh start beats silently loading corrupt
+                    # weights through the legacy symlink (which points
+                    # into this same tree)
+                    logger.error(
+                        "Resume: every durable checkpoint of %s fails "
+                        "verification; starting %s from scratch.",
+                        role, role)
+                    continue
+                # durable_ckpt=False vintage: a REAL directory in the
+                # plain HF layout is accepted without checksum cover
+                legacy = os.path.join(constants.run_save_path(), role)
+                if not os.path.islink(legacy) and os.path.exists(
+                        os.path.join(legacy, "config.json")):
+                    path = legacy
+            if path is None:
+                continue
+            mspec.path = path
+            mspec.random_init_config = None
+            mspec.restore_optimizer_state = True
+            logger.info("Resume: %s restores from %s%s.", role, path,
+                        "" if rec is None else
+                        f" (committed step {rec.step}, verified)")
+
+    def _durable_save_role(self, role: str, node_name: str,
+                           step: int):
+        """Leader-side durable save: stage the ordinary role save in
+        the manager's temp dir, checksum every produced file into the
+        manifest, commit atomically, and refresh the legacy
+        ``run_save_path()/role`` symlink for external consumers.
+        Returns {path, manifest} or None (save disabled)."""
+        mgr = self._ckpt_manager(role)
+        writer = mgr.begin(step, meta=dict(role=role, node=node_name,
+                                           worker=self.worker_name))
+        try:
+            out = self.host.save_role(role, node_name, path=writer.path)
+        except BaseException:
+            writer.abort()
+            raise
+        if out is None and not os.listdir(writer.path):
+            writer.abort()  # interface save disabled: nothing staged
+            return None
+        rec = writer.commit()
+        mgr.gc()
+        self._refresh_latest_link(role, rec.path)
+        return dict(path=rec.path, manifest=rec.manifest_path,
+                    step=rec.step)
+
+    @staticmethod
+    def _refresh_latest_link(role: str, target: str):
+        """Atomic symlink swap: ``run_save_path()/role`` keeps naming
+        the newest committed checkpoint (external consumers and the
+        legacy resume path read it)."""
+        link = os.path.join(constants.run_save_path(), role)
+        tmp = f"{link}.tmp-{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if os.path.isdir(link) and not os.path.islink(link):
+                # a real directory from a pre-durable run: leave it --
+                # replacing user data with a link is not our call
+                return
+            os.symlink(target, tmp)
+            os.replace(tmp, link)
+        except OSError as e:
+            logger.warning("Could not refresh latest-checkpoint link "
+                           "%s -> %s: %s", link, target, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # --- preemption (elastic degraded-mode training) ------------------
+    def _preempt_hook(self, grace: float):
+        """Last acts inside the preemption grace window: finish
+        draining is handled by the poll loop; here the trainable
+        roles' state is emergency-saved through the durable manager so
+        a relaunch (or a surviving adopter) restores the exact
+        weights+optimizer instead of losing progress."""
+        if not getattr(self.ft, "durable_ckpt", True) or \
+                getattr(self, "host", None) is None:
+            return
+        deadline = time.monotonic() + max(0.0, grace) * 0.8
+        for role in self.host.roles:
+            model = self.host.models.get(role)
+            if model is None or model.engine.opt_state is None:
+                continue
+            if not self.host.leader_of_role.get(role, True):
+                continue  # member joins no emergency collectives alone
+            if self.spec.multihost and len(
+                    self.spec.workers_of_role(role)) > 1:
+                # a process-spanning mesh cannot run the collective
+                # save with its peers mid-preemption reliably; the
+                # periodic durable checkpoint is the recovery point
+                continue
+            node_name = next(
+                (n for n in self.leader_nodes
+                 if self.dfg.find(n).role == role
+                 and self.dfg.find(n).interface_type
+                 == ModelInterfaceType.TRAIN_STEP), None)
+            if node_name is None:
+                continue
+            mgr = self._ckpt_manager(role)
+            step = self.host.role_version(role)
+
+            def produce(writer, _role=role, _node=node_name):
+                self.host.save_role(_role, _node, path=writer.path)
+
+            rec = mgr.emergency_save(step, produce, deadline=deadline)
+            if rec is not None:
+                self._refresh_latest_link(role, rec.path)
+                logger.warning(
+                    "Emergency checkpoint of %s committed at step %d "
+                    "(%s).", role, rec.step, rec.manifest_path)
 
     # ------------------------------------------------------------------
     def _devices_for_group(self, group: list, parallel,
@@ -247,23 +400,30 @@ class ModelWorker(worker_base.Worker):
             devs.extend(local[:per])
         return devs
 
-    def _handle_fetch_data(self, req: Payload):
-        """Load the next dataset batch, keep tensors locally, reply
-        metadata (ids/seqlens/keys) + epoch accounting."""
-        assert self.owns_data
+    def _advance_loader(self):
+        """One dataloader advance with epoch-wrap + position
+        accounting. Shared by the serve path (_handle_fetch_data) and
+        the elastic data-owner handoff's position replay, so both walk
+        the identical stream."""
         try:
             batch = next(self.dataloader_iter)
-            is_epoch_last = False
         except StopIteration:
             self.dataloader_iter = iter(self.dataloader)
             self._epoch += 1
             batch = next(self.dataloader_iter)
-            is_epoch_last = False
         # Peek whether this batch ends the epoch by position.
         self._step_in_epoch = getattr(self, "_step_in_epoch", -1) + 1
+        is_epoch_last = False
         if self._step_in_epoch >= self.steps_per_epoch - 1:
             is_epoch_last = True
             self._step_in_epoch = -1
+        return batch, is_epoch_last
+
+    def _handle_fetch_data(self, req: Payload):
+        """Load the next dataset batch, keep tensors locally, reply
+        metadata (ids/seqlens/keys) + epoch accounting."""
+        assert self.owns_data
+        batch, is_epoch_last = self._advance_loader()
         batch = data_api.drop_ids(batch,
                                   req.data.get("skip_ids") or ())
         if batch is None:
@@ -322,6 +482,11 @@ class ModelWorker(worker_base.Worker):
         if info is not None and node_name in self.cross_group_nodes:
             info = dict(info,
                         param_version=self.host.node_version(node_name))
+        elif info is not None and node_name in self.host.adopted_nodes:
+            # adopted next to its live primary: fresh every execute
+            # via the replica-refresh pre-hook
+            info = dict(info,
+                        param_version=self.host.role_version(node.role))
         is_leader = node_name in self.leader_nodes
         if isinstance(out, data_api.SequenceSample):
             # members store the (replicated) outputs too: later MFCs on
@@ -442,10 +607,111 @@ class ModelWorker(worker_base.Worker):
 
     def _handle_save(self, req: Payload):
         saved = {}
+        step = int(req.data.get("global_step", 0) or 0)
+        durable = getattr(self.ft, "durable_ckpt", True)
         for node_name in req.data["nodes"]:
             node = self.dfg.find(node_name)
-            saved[node.role] = self.host.save_role(node.role, node_name)
+            writer = self.host.leader_of_role.get(node.role, True)
+            if durable and writer and not (
+                    self.spec.multihost
+                    and len(self.spec.workers_of_role(node.role)) > 1):
+                # single-process writer: stage + checksum + atomic
+                # commit. (Process-spanning meshes keep the collective
+                # legacy path -- every member must walk the identical
+                # collective schedule, and only the leader could
+                # commit; staged-dir coordination across hosts is
+                # future work.)
+                saved[node.role] = self._durable_save_role(
+                    node.role, node_name, step)
+            else:
+                saved[node.role] = self.host.save_role(node.role,
+                                                       node_name)
         self.stream.respond(req, data=saved)
+
+    # --- elastic adoption (system/elastic.py) -------------------------
+    def _handle_adopt_node(self, req: Payload):
+        """Take over an MFC from a preempted/lost worker: build a
+        replica engine on the degraded layout (weights from the live
+        primary when it lives here, else the verified emergency
+        checkpoint, else the deterministic init seed) and start
+        executing its dispatches."""
+        d = req.data
+        node_name = d["node"]
+        node = self.dfg.find(node_name)
+        ckpt = d.get("ckpt")
+        if ckpt is None and d.get("try_ckpt", False) \
+                and node.role not in self.host.models:
+            rec = self._ckpt_manager(node.role).latest_verified()
+            ckpt = rec.path if rec is not None else None
+        version = self.host.adopt_node(node, d["parallel"],
+                                       ckpt_path=ckpt)
+        self.my_nodes.add(node_name)
+        self.leader_nodes.add(node_name)  # single adopter leads
+        if d.get("cross_group", False):
+            self.cross_group_nodes.add(node_name)
+        else:
+            self.cross_group_nodes.discard(node_name)
+        self.stream.respond(req, data=dict(adopted=node_name,
+                                           version=version))
+
+    def _handle_adopt_data(self, req: Payload):
+        """Become the data owner (elastic handoff): the previous owner
+        is draining under a preemption notice. Pull every live batch's
+        pieces it still homes (its data server answers until the
+        graceful exit), then build a dataloader and replay
+        ``fetches_done`` advances -- same dataset, same seed, so the
+        position replay reproduces the exact sample stream with no
+        re-consumption."""
+        d = req.data
+        src_worker = d["from_worker"]
+        timeout = float(d.get("fetch_timeout", 30.0))
+        rescued = 0
+        try:
+            # rescue BEFORE any loader mutation: a failed pull leaves
+            # this worker untouched (it stays healthy, the master
+            # keeps the old owner and its fatal deadline)
+            for group in d.get("rescue") or ():
+                fetched = self.data_client.fetch(
+                    src_worker, list(group["ids"]), list(group["keys"]),
+                    timeout=timeout)
+                self.store.put(fetched)
+                rescued += len(group["ids"])
+        except Exception as e:  # noqa: BLE001 - soft-fail the handoff
+            logger.error("Data rescue from draining %s failed: %s",
+                         src_worker, e)
+            self.stream.respond(req, data=dict(error=repr(e)))
+            return
+        if not self.owns_data:
+            src = self.dfg.sources[0]
+            dataset = data_api.make_dataset(
+                self.spec.dataset, seed=self.spec.seed, dp_rank=0,
+                world_size=1, tokenizer_or_path=self.tokenizer)
+            self.dataloader = data_api.PackedDataLoader(
+                dataset, batch_size=src.n_seqs, seed=self.spec.seed)
+            self.steps_per_epoch = len(self.dataloader)
+            self.dataloader_iter = iter(self.dataloader)
+            self._epoch = 0
+            self._step_in_epoch = -1
+            self.owns_data = True
+            # an ALREADY-owning worker (re-adoption) keeps its loader:
+            # it is positioned correctly; replaying would skip samples
+            for _ in range(int(d.get("fetches_done", 0))):
+                self._advance_loader()
+        logger.warning(
+            "ADOPTED data ownership from %s: %d sequences rescued, "
+            "loader replayed %d fetches (epoch %d).", src_worker,
+            rescued, int(d.get("fetches_done", 0)), self._epoch)
+        self.stream.respond(req, data=dict(
+            rescued=rescued, epoch=self._epoch))
+
+    def _handle_release_node(self, req: Payload):
+        node_name = req.data["node"]
+        released = self.host.release_node(node_name)
+        if released:
+            self.my_nodes.discard(node_name)
+            self.leader_nodes.discard(node_name)
+            self.cross_group_nodes.discard(node_name)
+        self.stream.respond(req, data=dict(released=released))
 
     def _handle_evaluate(self, req: Payload):
         out = {}
@@ -519,6 +785,14 @@ class ModelWorker(worker_base.Worker):
                            "%.1fs.", req.handle_name, fault.seconds)
             time.sleep(fault.seconds)
             return False
+        if fault.kind == "preempt":
+            # SIGTERM-equivalent notice: announce, keep executing this
+            # request (in-flight work drains within the grace window),
+            # exit PREEMPTED when the window closes (worker_base)
+            self.notice_preemption(
+                grace=fault.seconds or None,
+                reason=f"injected fault {fault.fault_id}")
+            return False
         return fault.kind == "drop_reply"
 
     def _handle_request(self, req: Payload):
@@ -540,6 +814,12 @@ class ModelWorker(worker_base.Worker):
                 self._handle_save(req)
             elif handle == "evaluate":
                 self._handle_evaluate(req)
+            elif handle == "adopt_node":
+                self._handle_adopt_node(req)
+            elif handle == "adopt_data":
+                self._handle_adopt_data(req)
+            elif handle == "release_node":
+                self._handle_release_node(req)
             elif handle == "clear_data_cache":
                 self.store.clear(req.data["ids"])
                 self.stream.respond(req, data="ok")

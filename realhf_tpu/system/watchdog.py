@@ -104,10 +104,13 @@ class Watchdog:
                 self._ever_beat[worker] = ts
                 return ALIVE
         # silent: either a terminal exit (accounted for), startup lag,
-        # or a genuine loss
+        # or a genuine loss. A PREEMPTED worker that stopped beating
+        # exited its grace window gracefully -- accounted for, never
+        # LOST (the elastic planner already migrated its work).
         status = self._status_of(worker)
         if status in (WorkerServerStatus.COMPLETED,
-                      WorkerServerStatus.ERROR):
+                      WorkerServerStatus.ERROR,
+                      WorkerServerStatus.PREEMPTED):
             return DONE
         if worker not in self._ever_beat and now - self._start <= max(
                 self.grace, self.timeout):
@@ -148,6 +151,41 @@ class Watchdog:
 
     def is_alive(self, worker: str) -> bool:
         return self._verdict(worker, self._clock()) in (ALIVE, PENDING)
+
+    def has_fresh_beat(self, worker: str) -> bool:
+        """True when the worker's heartbeat is within ``timeout`` of
+        now -- the rejoin signal for elastic re-expansion (a DONE /
+        PREEMPTED verdict can coexist with a fresh beat while a
+        relaunched incarnation spins up)."""
+        try:
+            ts = float(name_resolve.get(names.worker_heartbeat(
+                self._exp, self._trial, worker)))
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return False
+        return self._clock() - ts <= self.timeout
+
+    def preempt_notice(self, worker: str):
+        """The worker's active preemption notice as ``(ts, grace)``
+        wall-clock seconds, or None. Published by
+        ``WorkerServer.publish_preempt_notice`` on SIGTERM/SIGUSR1 or
+        an injected ``preempt`` fault; cleared by the worker's next
+        incarnation at startup."""
+        try:
+            raw = name_resolve.get(names.worker_preempt(
+                self._exp, self._trial, worker))
+            ts_s, grace_s = str(raw).split(":", 1)
+            return float(ts_s), float(grace_s)
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None
+
+    def preempt_notices(self) -> Dict[str, tuple]:
+        """All active preemption notices {worker: (ts, grace)}."""
+        out = {}
+        for w in self.workers:
+            n = self.preempt_notice(w)
+            if n is not None:
+                out[w] = n
+        return out
 
     def lost_workers(self) -> List[str]:
         return sorted(self._lost_since)

@@ -11,6 +11,7 @@ import dataclasses
 import enum
 import os
 import pickle
+import signal
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -29,6 +30,18 @@ HEARTBEAT_INTERVAL_ENV = "REALHF_TPU_HEARTBEAT_INTERVAL"
 DEFAULT_HEARTBEAT_INTERVAL = 2.0
 HEARTBEAT_TTL_FACTOR = 5.0
 
+#: Preemption-notice knobs. A preempted worker (cluster SIGTERM,
+#: SIGUSR1, injected `preempt` fault, or `preempt` control command)
+#: publishes a notice under ``names.worker_preempt``, runs its
+#: ``_preempt_hook`` (emergency checkpoint / serving drain), keeps
+#: serving in-flight work for the grace window, then exits with
+#: status PREEMPTED. SIGTERM handling is opt-in via
+#: ``REALHF_TPU_PREEMPT_SIGTERM=1`` -- schedulers that SIGTERM for
+#: plain teardown must keep getting prompt exits.
+PREEMPT_GRACE_ENV = "REALHF_TPU_PREEMPT_GRACE"
+PREEMPT_SIGTERM_ENV = "REALHF_TPU_PREEMPT_SIGTERM"
+DEFAULT_PREEMPT_GRACE = 15.0
+
 
 class WorkerServerStatus(str, enum.Enum):
     READY = "READY"
@@ -37,6 +50,10 @@ class WorkerServerStatus(str, enum.Enum):
     COMPLETED = "COMPLETED"
     ERROR = "ERROR"
     LOST = "LOST"
+    # preemption notice received: draining within the grace window
+    # (while alive), terminal after the graceful exit. Accounted-for
+    # in liveness terms -- never LOST.
+    PREEMPTED = "PREEMPTED"
 
 
 @dataclasses.dataclass
@@ -73,6 +90,12 @@ class WorkerServer:
         self._hb_interval = heartbeat_interval
         self._hb_key = names.worker_heartbeat(experiment_name, trial_name,
                                               worker_name)
+        self._preempt_key = names.worker_preempt(
+            experiment_name, trial_name, worker_name)
+        # a RELAUNCHED worker must not inherit its previous
+        # incarnation's preemption notice -- the master reads notice
+        # presence as "this worker is retiring"
+        self.clear_preempt_notice()
         self._hb_stop = threading.Event()
         self.beat()  # visible before the first interval elapses
         self._hb_thread = threading.Thread(
@@ -100,6 +123,27 @@ class WorkerServer:
         """Stop the beacon (clean exit; terminal status takes over as
         the liveness signal)."""
         self._hb_stop.set()
+
+    def publish_preempt_notice(self, grace: float):
+        """Announce preemption: ``"<wall-ts>:<grace-secs>"`` under the
+        worker's preempt key. The master reacts to the notice (elastic
+        degrade + drain) BEFORE the heartbeat ever goes stale."""
+        try:
+            name_resolve.add(
+                self._preempt_key, f"{time.time():.3f}:{grace:.3f}",
+                replace=True, delete_on_exit=False)
+        except Exception as e:  # noqa: BLE001 - notice is best-effort
+            logger.warning("Preempt notice publish failed for %s: %s",
+                           self.worker_name, e)
+
+    def clear_preempt_notice(self):
+        try:
+            name_resolve.delete(self._preempt_key)
+        except name_resolve.NameEntryNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 - best-effort cleanup
+            logger.warning("Preempt notice clear failed for %s: %s",
+                           self.worker_name, e)
 
     def set_status(self, status: WorkerServerStatus):
         name_resolve.add(
@@ -199,6 +243,13 @@ class Worker:
         self._running = False
         self._exiting = False
         self.config = None
+        # preemption state machine: a signal handler only flips
+        # _preempt_signaled (async-signal-safe); the run loop converts
+        # it into a published notice + hook + graceful deadline.
+        self._preempt_signaled = False
+        self._preempt_deadline: Optional[float] = None
+        self._preempt_grace: Optional[float] = None
+        self._preempt_hook_ran = False
 
     # -- subclass API ---------------------------------------------------
     def _configure(self, config: Any):
@@ -210,6 +261,74 @@ class Worker:
     def _exit_hook(self):
         """Last-chance cleanup/checkpoint on exit (reference
         model_worker.py:953 recover save)."""
+
+    def _preempt_hook(self, grace: float):
+        """Emergency work on a preemption notice, run ONCE from the
+        poll loop (never the signal handler) with ``grace`` seconds
+        left: model workers emergency-save a durable checkpoint,
+        serving workers drain (docs/serving.md)."""
+
+    # -- preemption -----------------------------------------------------
+    @property
+    def preempted(self) -> bool:
+        return self._preempt_deadline is not None
+
+    def notice_preemption(self, grace: Optional[float] = None,
+                          reason: str = "signal"):
+        """Enter the preemption grace window: publish the notice and
+        status PREEMPTED (the master stops dispatching new work here
+        and starts elastic degradation), keep serving in-flight work,
+        and exit gracefully when the window closes. Idempotent."""
+        if self._preempt_deadline is not None:
+            return
+        if grace is None:
+            grace = float(os.environ.get(PREEMPT_GRACE_ENV,
+                                         DEFAULT_PREEMPT_GRACE))
+        grace = max(0.0, float(grace))
+        self._preempt_grace = grace
+        self._preempt_deadline = time.monotonic() + grace
+        logger.warning(
+            "Worker %s PREEMPTED (%s): %.1fs grace window; draining.",
+            self.worker_name, reason, grace)
+        self.server.publish_preempt_notice(grace)
+        self.server.set_status(WorkerServerStatus.PREEMPTED)
+
+    def _install_signal_handlers(self):
+        """SIGUSR1 always means preemption notice; SIGTERM only when
+        ``REALHF_TPU_PREEMPT_SIGTERM=1`` (schedulers that terminate
+        with SIGTERM for teardown must keep prompt exits)."""
+
+        def _handler(signum, _frame):
+            # flag only -- the run loop publishes the notice (file IO
+            # in a signal handler could reenter mid-operation)
+            self._preempt_signaled = True
+
+        try:
+            signal.signal(signal.SIGUSR1, _handler)
+            if os.environ.get(PREEMPT_SIGTERM_ENV) == "1":
+                signal.signal(signal.SIGTERM, _handler)
+        except ValueError:
+            # not the main thread (in-process test harness): the
+            # command/fault paths still deliver notices
+            pass
+
+    def _step_preemption(self) -> bool:
+        """Advance the preemption state machine once per loop
+        iteration; True when the grace window has closed and the
+        worker should exit."""
+        if self._preempt_signaled and self._preempt_deadline is None:
+            self.notice_preemption(reason="signal")
+        if self._preempt_deadline is None:
+            return False
+        if not self._preempt_hook_ran:
+            self._preempt_hook_ran = True
+            try:
+                self._preempt_hook(max(
+                    0.0, self._preempt_deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 - still exit PREEMPTED
+                logger.error("Preempt hook of %s failed.",
+                             self.worker_name, exc_info=True)
+        return time.monotonic() >= self._preempt_deadline
 
     # -------------------------------------------------------------------
     def _handle_command(self, cmd: str, kwargs: Dict) -> Any:
@@ -231,10 +350,17 @@ class Worker:
             return "ok"
         if cmd == "ping":
             return "pong"
+        if cmd == "preempt":
+            # controller-initiated preemption drill (tests / manual
+            # degrade rehearsals): same path as a cluster signal
+            self.notice_preemption(grace=(kwargs or {}).get("grace"),
+                                   reason="command")
+            return "ok"
         raise ValueError(f"Unknown worker command {cmd}")
 
     def run(self):
         logger.info("Worker %s starting poll loop.", self.worker_name)
+        self._install_signal_handlers()
         try:
             while not self._exiting:
                 cmd = self.server.poll_command(
@@ -245,11 +371,18 @@ class Worker:
                     except Exception as e:  # noqa: BLE001
                         self.server.respond(e)
                         raise
+                if self._step_preemption():
+                    logger.warning(
+                        "Worker %s: preemption grace window closed; "
+                        "exiting PREEMPTED.", self.worker_name)
+                    break
                 if self._running:
                     self._poll()
             self._exit_hook()
             self.server.stop_heartbeat()
-            self.server.set_status(WorkerServerStatus.COMPLETED)
+            self.server.set_status(
+                WorkerServerStatus.PREEMPTED if self.preempted
+                else WorkerServerStatus.COMPLETED)
         except Exception:
             # terminal status (not the beacon) is the liveness signal
             # from here on; the watchdog treats ERROR/COMPLETED as

@@ -80,3 +80,75 @@ def test_retry_call_on_retry_hook():
                       on_retry=lambda a, e: seen.append((a, str(e))),
                       sleep=lambda _d: None) == 7
     assert seen == [(1, "x")]
+
+
+class FakeClock:
+    """Deterministic monotonic clock advanced by fake sleeps."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, d):
+        self.now += d
+
+
+def test_max_elapsed_budget_abandons_remaining_attempts():
+    """The total-deadline budget (ISSUE 4 satellite): stacked backoff
+    must stop once spent-plus-next-sleep would overrun max_elapsed,
+    surfacing the real failure instead of masking it for the full
+    attempt count."""
+    clock = FakeClock()
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        clock.now += 1.0  # each attempt itself costs 1s
+        raise TimeoutError("worker gone")
+
+    with pytest.raises(TimeoutError, match="worker gone"):
+        retry_call(always_fails,
+                   RetryPolicy(max_attempts=10, base_delay=4.0,
+                               factor=1.0, jitter=0.0,
+                               max_elapsed=7.0),
+                   sleep=clock.sleep, clock=clock)
+    # attempt(1s) + sleep(4s) + attempt(1s): the next 4s sleep would
+    # hit 10s > 7s, so attempts 3..10 never run
+    assert len(calls) == 2
+    assert clock.now == pytest.approx(6.0)
+
+
+def test_max_elapsed_none_keeps_attempt_bound():
+    clock = FakeClock()
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise TimeoutError("x")
+
+    with pytest.raises(TimeoutError):
+        retry_call(always_fails,
+                   RetryPolicy(max_attempts=3, base_delay=100.0,
+                               jitter=0.0, max_elapsed=None),
+                   sleep=clock.sleep, clock=clock)
+    assert len(calls) == 3
+
+
+def test_max_elapsed_generous_budget_does_not_interfere():
+    clock = FakeClock()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("t")
+        return "ok"
+
+    assert retry_call(flaky,
+                      RetryPolicy(max_attempts=5, base_delay=1.0,
+                                  factor=1.0, jitter=0.0,
+                                  max_elapsed=100.0),
+                      sleep=clock.sleep, clock=clock) == "ok"
+    assert len(calls) == 3

@@ -24,6 +24,13 @@ jax.config.update("jax_default_device", jax.devices("cpu")[0])
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-trial e2e runs excluded from the tier-1 sweep "
+        "(run directly: pytest -m slow <file>)")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_name_resolve(tmp_path, monkeypatch):
     """Isolate name_resolve and file roots per test."""

@@ -78,3 +78,53 @@ def test_structure_mismatch_skips(tmp_path):
 def test_missing_file_returns_false(tmp_path):
     e = _engine(_cfg())
     assert not opt_checkpoint.restore_engine_opt_state(e, str(tmp_path))
+
+
+def test_corrupt_file_surfaces_reason_not_silence(tmp_path, caplog):
+    """ISSUE 4 satellite: a corrupt/short optimizer-state file must
+    name the shard path and why it is unusable, both in the log and
+    to the caller -- never a bare None."""
+    import logging as _logging
+
+    f = tmp_path / opt_checkpoint.FILENAME
+    f.write_bytes(b"PK\x03\x04 definitely not a real zip")
+    # the realhf_tpu root logger sets propagate=False; let caplog see it
+    root = _logging.getLogger("realhf_tpu")
+    root.propagate = True
+    try:
+        with caplog.at_level(_logging.WARNING):
+            leaves, reason = opt_checkpoint.load_opt_state_checked(
+                str(tmp_path))
+    finally:
+        root.propagate = False
+    assert leaves is None
+    assert reason is not None
+    assert str(f) in reason  # the shard path is named
+    assert any(str(f) in r.getMessage() for r in caplog.records)
+    # legacy API still degrades to None (reason already logged)
+    assert opt_checkpoint.load_opt_state(str(tmp_path)) is None
+
+
+def test_short_file_reports_expected_vs_actual_leaves(tmp_path):
+    """Truncate the member list (drop the last leaf): the reason names
+    how many leaves were present vs expected."""
+    import zipfile
+
+    e = _engine(_cfg())
+    opt_checkpoint.save_opt_state(str(tmp_path), e.opt_state_numpy())
+    src = tmp_path / opt_checkpoint.FILENAME
+    n_leaves = len(e.opt_state_numpy())
+    # rewrite the npz without its last leaf member
+    tmp = tmp_path / "short.npz"
+    with zipfile.ZipFile(str(src)) as zin, \
+            zipfile.ZipFile(str(tmp), "w") as zout:
+        for item in zin.infolist():
+            if item.filename == f"l{n_leaves - 1}.npy":
+                continue
+            zout.writestr(item, zin.read(item.filename))
+    tmp.replace(src)
+    leaves, reason = opt_checkpoint.load_opt_state_checked(str(tmp_path))
+    assert leaves is None
+    assert f"{n_leaves - 1} of {n_leaves}" in reason
+    e2 = _engine(_cfg())
+    assert not opt_checkpoint.restore_engine_opt_state(e2, str(tmp_path))

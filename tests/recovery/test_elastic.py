@@ -1,0 +1,180 @@
+"""Elastic degraded-mode planning (system/elastic.py): layout
+degradation heuristics, adoption targeting (primary-first, capacity
+caps), the non-migratable cases (train MFCs, hit primaries), and the
+degrade -> re-expand bookkeeping round trip."""
+
+import pytest
+
+from realhf_tpu.api.config import (
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+)
+from realhf_tpu.api.dfg import DFG, MFCDef
+from realhf_tpu.api.experiment import (
+    ExperimentSpec,
+    MFCAllocation,
+    ModelSpec,
+)
+from realhf_tpu.parallel.mesh import ParallelismConfig
+from realhf_tpu.system.elastic import ElasticPlanner, degrade_parallelism
+
+
+P = ParallelismConfig
+
+
+class TestDegradeParallelism:
+
+    def test_fitting_layout_is_preserved_bitwise(self):
+        par = P(data_parallel_size=2, tensor_parallel_size=2)
+        assert degrade_parallelism(par, 8) is par
+        assert degrade_parallelism(par, 4) is par
+
+    def test_shrinks_data_axis_first(self):
+        par = P(data_parallel_size=4, tensor_parallel_size=2)
+        out = degrade_parallelism(par, 4)
+        assert (out.data_parallel_size, out.tensor_parallel_size) == (2, 2)
+
+    def test_shrink_order_data_ctx_pipe_tensor(self):
+        par = P(data_parallel_size=2, tensor_parallel_size=2,
+                pipeline_parallel_size=2, context_parallel_size=2)
+        out = degrade_parallelism(par, 2)
+        # dp, cp, pp all shrank before tp was touched
+        assert out.tensor_parallel_size == 2
+        assert out.world_size <= 2
+        out1 = degrade_parallelism(par, 1)
+        assert out1.world_size == 1
+
+    def test_sequence_parallel_dropped_with_tensor_axis(self):
+        par = P(data_parallel_size=1, tensor_parallel_size=4,
+                sequence_parallel=True)
+        out = degrade_parallelism(par, 2)
+        assert out.tensor_parallel_size == 2 and out.sequence_parallel
+        out1 = degrade_parallelism(par, 1)
+        assert out1.tensor_parallel_size == 1
+        assert not out1.sequence_parallel
+
+    def test_no_devices_is_unplannable(self):
+        assert degrade_parallelism(P(), 0) is None
+
+    def test_gen_tp_kept_only_when_it_fits(self):
+        par = P(data_parallel_size=4, gen_tp_size=2)
+        assert degrade_parallelism(par, 2).gen_tp_size == 2
+        assert degrade_parallelism(par, 1).gen_tp_size == 0
+
+
+def _ppo_like_spec():
+    itf = ModelInterfaceAbstraction("null")
+    mfcs = [
+        MFCDef(name="actor_gen", n_seqs=8,
+               interface_type=ModelInterfaceType.GENERATE,
+               interface_impl=itf, model_name="actor",
+               input_keys=("packed_prompts",),
+               output_keys=("packed_input_ids",)),
+        MFCDef(name="rew_inf", n_seqs=8,
+               interface_type=ModelInterfaceType.INFERENCE,
+               interface_impl=itf, model_name="reward",
+               input_keys=("packed_input_ids",),
+               output_keys=("rewards",)),
+        MFCDef(name="actor_train", n_seqs=8,
+               interface_type=ModelInterfaceType.TRAIN_STEP,
+               interface_impl=itf, model_name="actor",
+               input_keys=("packed_input_ids", "rewards")),
+    ]
+    spec = ExperimentSpec(
+        experiment_name="el", trial_name="t0",
+        models={"actor": ModelSpec(parallel=P(data_parallel_size=2)),
+                "reward": ModelSpec(parallel=P(data_parallel_size=2))},
+        mfcs=mfcs, dataset=None,
+        n_model_workers=3,
+        worker_assignment={"actor": 0, "reward": 2},
+        allocations={"actor_gen": MFCAllocation(
+            P(data_parallel_size=2), workers=[1])})
+    return spec, DFG(mfcs)
+
+
+@pytest.fixture
+def planner():
+    spec, dfg = _ppo_like_spec()
+    return ElasticPlanner(spec, dfg, devices_per_worker=8)
+
+
+class TestPlanDegraded:
+
+    def test_cross_group_node_migrates_to_primary_first(self, planner):
+        # actor_gen lives on worker 1; actor's primary is worker 0
+        plan = planner.plan_degraded("actor_gen", lost={1},
+                                     alive=[0, 2])
+        assert plan is not None
+        assert plan.workers == [0]          # primary-first adoption
+        assert not plan.cross_group         # lands NEXT TO the primary
+        assert plan.parallel.world_size <= 8
+
+    def test_unaffected_node_returns_none(self, planner):
+        assert planner.plan_degraded("actor_gen", lost={2},
+                                     alive=[0, 1]) is None
+
+    def test_train_step_never_migrates(self, planner):
+        assert planner.plan_degraded("actor_train", lost={0},
+                                     alive=[1, 2]) is None
+
+    def test_hit_primary_is_not_migratable(self, planner):
+        # losing worker 0 takes actor's primary with it: actor_gen
+        # has no weight source -> relaunch-level recovery
+        assert planner.plan_degraded("actor_gen", lost={0, 1},
+                                     alive=[2]) is None
+
+    def test_non_primary_survivor_adoption_is_cross_group(self, planner):
+        # primary (worker 0) also lost from the ALIVE set but not from
+        # `lost` -> unavailable; worker 2 adopts cross-group
+        plan = planner.plan_degraded("actor_gen", lost={1}, alive=[2])
+        assert plan is not None
+        assert plan.workers == [2] and plan.cross_group
+
+    def test_capacity_cap_limits_adoptions(self):
+        spec, dfg = _ppo_like_spec()
+        p = ElasticPlanner(spec, dfg, devices_per_worker=8,
+                           max_adopted_per_worker=0)
+        assert p.plan_degraded("actor_gen", lost={1},
+                               alive=[0, 2]) is None
+
+    def test_degraded_layout_fits_adopter_devices(self):
+        spec, dfg = _ppo_like_spec()
+        spec.allocations["actor_gen"] = MFCAllocation(
+            P(data_parallel_size=4, tensor_parallel_size=2),
+            workers=[1])
+        p = ElasticPlanner(spec, dfg, devices_per_worker=4)
+        plan = p.plan_degraded("actor_gen", lost={1}, alive=[0, 2])
+        assert plan is not None
+        assert plan.parallel.world_size <= 4
+        assert plan.parallel.tensor_parallel_size == 2  # tp preserved
+
+    def test_no_survivors_returns_none(self, planner):
+        assert planner.plan_degraded("actor_gen", lost={1},
+                                     alive=[1]) is None
+
+
+class TestDegradeRestoreBookkeeping:
+
+    def test_record_restore_round_trip(self, planner):
+        plan = planner.plan_degraded("actor_gen", lost={1},
+                                     alive=[0, 2])
+        rec = planner.record_degraded(
+            plan, original_workers=["model_worker/1"],
+            original_cross_group=True)
+        assert planner.degraded["actor_gen"] is rec
+        assert planner.degraded_workers() == {"model_worker/1"}
+        # home still gone: nothing restorable
+        assert planner.restorable_nodes({"model_worker/0"}) == []
+        # home rejoined: restorable, then popped
+        back = planner.restorable_nodes(
+            {"model_worker/0", "model_worker/1"})
+        assert [d.node for d in back] == ["actor_gen"]
+        assert planner.mark_restored("actor_gen") is rec
+        assert planner.degraded == {}
+        assert planner.mark_restored("actor_gen") is None
+
+    def test_adoption_count_feeds_capacity(self, planner):
+        plan = planner.plan_degraded("actor_gen", lost={1},
+                                     alive=[0, 2])
+        planner.record_degraded(plan, ["model_worker/1"], True)
+        assert planner._adopted_on(plan.workers[0]) == 1

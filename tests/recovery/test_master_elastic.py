@@ -1,0 +1,292 @@
+"""Master-side elastic wiring (system/master_worker.py), isolated on
+fakes: preemption notice -> adopt_node dispatch + rerouting, the
+fatal-deadline exemption for fully-migrated workers, dispatch
+eligibility of retiring workers, rejoin -> release_node +
+route-restore + ExclusionBook.forgive, and the data-owner handoff
+(rescue plan + key_owner re-homing + position replay count)."""
+
+import time
+import uuid
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from realhf_tpu.api.config import (
+    ModelInterfaceAbstraction,
+    ModelInterfaceType,
+)
+from realhf_tpu.api.dfg import DFG, MFCDef
+from realhf_tpu.api.experiment import (
+    ExperimentSpec,
+    FaultToleranceConfig,
+    MFCAllocation,
+    ModelSpec,
+)
+from realhf_tpu.base import name_resolve, names
+from realhf_tpu.parallel.mesh import ParallelismConfig as P
+from realhf_tpu.system.elastic import ElasticPlanner
+from realhf_tpu.system.master_worker import MasterWorker
+from realhf_tpu.system.watchdog import ExclusionBook, Watchdog
+from realhf_tpu.system.worker_base import WorkerServerStatus
+
+EXP, TRIAL = "mel", "t0"
+
+
+class FakeStream:
+    def __init__(self):
+        self.sent = []          # (handler, handle, data)
+        self.subscribed = []
+
+    def request(self, handlers, handle, datas=None):
+        datas = datas or [None] * len(handlers)
+        rids = []
+        for h, d in zip(handlers, datas):
+            self.sent.append((h, handle, d))
+            rids.append(uuid.uuid4().hex)
+        return rids
+
+    def gather_replies(self, rids, timeout=None, check_liveness=None):
+        return [SimpleNamespace(data=dict(adopted=True, version=0))
+                for _ in rids]
+
+    def wait_subscribers(self, handlers, timeout=None):
+        self.subscribed.extend(handlers)
+
+    def discard(self, rids):
+        pass
+
+
+def _mfcs():
+    itf = ModelInterfaceAbstraction("null")
+    return [
+        MFCDef(name="actor_gen", n_seqs=8,
+               interface_type=ModelInterfaceType.GENERATE,
+               interface_impl=itf, model_name="actor",
+               input_keys=("packed_prompts",),
+               output_keys=("packed_input_ids",)),
+        MFCDef(name="actor_train", n_seqs=8,
+               interface_type=ModelInterfaceType.TRAIN_STEP,
+               interface_impl=itf, model_name="actor",
+               input_keys=("packed_input_ids",)),
+    ]
+
+
+def _master():
+    """A MasterWorker shell carrying exactly the elastic state."""
+    mfcs = _mfcs()
+    spec = ExperimentSpec(
+        experiment_name=EXP, trial_name=TRIAL,
+        models={"actor": ModelSpec(parallel=P(data_parallel_size=2))},
+        mfcs=mfcs, dataset=None, n_model_workers=2,
+        worker_assignment={"actor": 0},
+        allocations={"actor_gen": MFCAllocation(P(data_parallel_size=2),
+                                                workers=[1])})
+    m = MasterWorker.__new__(MasterWorker)
+    m.spec = spec
+    m.dfg = DFG(mfcs)
+    m.ft = FaultToleranceConfig(elastic_degrade=True)
+    m.elastic = ElasticPlanner(spec, m.dfg, devices_per_worker=8)
+    m.stream = FakeStream()
+    m.watchdog = Watchdog(EXP, TRIAL,
+                          ["model_worker/0", "model_worker/1"],
+                          timeout=5.0, grace=60.0, poll_interval=0.0)
+    m._exclusions = ExclusionBook()
+    m.all_workers = ["model_worker/0", "model_worker/1"]
+    m.data_owner = "model_worker/0"
+    m.node_workers = {"actor_gen": ["model_worker/1"],
+                      "actor_train": ["model_worker/0"]}
+    m.node_worker = {k: v[0] for k, v in m.node_workers.items()}
+    m.cross_group_nodes = {"actor_gen"}
+    m.role_workers = {"actor": ["model_worker/0"]}
+    m._retiring = set()
+    m._preempt_seen = set()
+    m._inflight = {}
+    return m
+
+
+def _beat(worker):
+    name_resolve.add(names.worker_heartbeat(EXP, TRIAL, worker),
+                     f"{time.time():.3f}", replace=True)
+
+
+def _status(worker, status):
+    name_resolve.add(names.worker_status(EXP, TRIAL, worker),
+                     status.value, replace=True)
+
+
+def test_degrade_reroutes_to_adopter_and_records():
+    m = _master()
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m._elastic_degrade("model_worker/1")
+    # adopt_node shipped to the surviving primary worker
+    adopts = [s for s in m.stream.sent if s[1] == "adopt_node"]
+    assert [a[0] for a in adopts] == ["model_worker/0"]
+    assert adopts[0][2]["node"] == "actor_gen"
+    assert adopts[0][2]["parallel"].world_size <= 8
+    # routing updated: dispatches now target the adopter
+    assert m.node_workers["actor_gen"] == ["model_worker/0"]
+    assert m.node_worker["actor_gen"] == "model_worker/0"
+    # next to the primary: no longer a cross-group sync receiver
+    assert "actor_gen" not in m.cross_group_nodes
+    assert "actor_gen" in m.elastic.degraded
+    # train MFC untouched
+    assert m.node_workers["actor_train"] == ["model_worker/0"]
+
+
+def test_fully_migrated_worker_is_not_fatal_but_needed_one_is():
+    m = _master()
+    # before migration: worker 1 hosts actor_gen -> needed
+    assert m._still_needed("model_worker/1")
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m._elastic_degrade("model_worker/1")
+    assert not m._still_needed("model_worker/1")
+    # the data owner / primary host is always needed
+    assert m._still_needed("model_worker/0")
+
+
+def test_retiring_worker_is_not_dispatch_eligible():
+    m = _master()
+    _beat("model_worker/0")
+    _beat("model_worker/1")
+    assert m._workers_eligible(["model_worker/1"])
+    m._retiring.add("model_worker/1")
+    assert not m._workers_eligible(["model_worker/1"])
+    assert m._workers_eligible(["model_worker/0"])
+
+
+def test_reexpand_restores_routing_and_forgives():
+    m = _master()
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m._preempt_seen.add("model_worker/1")
+    m._exclusions.exclude("model_worker/1")
+    m._elastic_degrade("model_worker/1")
+    assert m.node_workers["actor_gen"] == ["model_worker/0"]
+
+    # not yet rejoined: stale beat -> nothing happens
+    m._maybe_reexpand()
+    assert "model_worker/1" in m._retiring
+
+    # the relaunched incarnation: fresh beat, RUNNING, notice cleared
+    _beat("model_worker/1")
+    _status("model_worker/1", WorkerServerStatus.RUNNING)
+    m._maybe_reexpand()
+    assert m._retiring == set()
+    assert m._preempt_seen == set()
+    assert not m._exclusions.is_excluded("model_worker/1")
+    assert m.stream.subscribed == ["model_worker/1"]
+    # adopted replica released, original routing + sync restored
+    releases = [s for s in m.stream.sent if s[1] == "release_node"]
+    assert [r[0] for r in releases] == ["model_worker/0"]
+    assert releases[0][2] == {"node": "actor_gen"}
+    assert m.node_workers["actor_gen"] == ["model_worker/1"]
+    assert m.node_worker["actor_gen"] == "model_worker/1"
+    assert "actor_gen" in m.cross_group_nodes
+    assert m.elastic.degraded == {}
+    # release request tracked fire-and-forget
+    assert any(ref[3] == "release" for ref in m._inflight.values())
+
+
+def test_reexpand_waits_while_old_incarnation_drains():
+    m = _master()
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m._elastic_degrade("model_worker/1")
+    # fresh beat + RUNNING but the preempt notice is still up: the
+    # OLD incarnation is draining -- do not re-expand onto it
+    _beat("model_worker/1")
+    _status("model_worker/1", WorkerServerStatus.RUNNING)
+    name_resolve.add(names.worker_preempt(EXP, TRIAL, "model_worker/1"),
+                     f"{time.time():.3f}:5.0", replace=True)
+    m._maybe_reexpand()
+    assert "model_worker/1" in m._retiring
+    assert m.node_workers["actor_gen"] == ["model_worker/0"]
+
+
+def _meta(ids, key="packed_prompts"):
+    from realhf_tpu.api.data import SequenceSample
+    return SequenceSample(
+        keys=[key], trailing_shapes={key: ()},
+        dtypes={key: np.int32}, ids=list(ids),
+        seqlens={key: [[4] for _ in ids]})
+
+
+def _master_with_buffer(owner="model_worker/1"):
+    from realhf_tpu.system.buffer import SequenceBuffer
+    m = _master()
+    m.data_owner = owner
+    m._fetches_done = 3
+    m.buffer = SequenceBuffer(["actor_gen", "actor_train"], capacity=4)
+    m.buffer.put_batch(_meta(["a", "b"]), owner, 0, False)
+    m.buffer.put_batch(_meta(["c", "d"]), owner, 0, False)
+    return m
+
+
+def test_data_owner_handoff_rescues_and_rehomes():
+    """Preempting the data owner ships adopt_data to a survivor with
+    the live batches' rescue plan and the replay count, then re-homes
+    both data ownership and every key_owner entry."""
+    m = _master_with_buffer(owner="model_worker/1")
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m._handoff_data_owner("model_worker/1", grace=7.5)
+    adopts = [s for s in m.stream.sent if s[1] == "adopt_data"]
+    assert [a[0] for a in adopts] == ["model_worker/0"]
+    d = adopts[0][2]
+    assert d["from_worker"] == "model_worker/1"
+    assert d["fetches_done"] == 3
+    assert d["fetch_timeout"] == 7.5
+    assert [sorted(g["ids"]) for g in d["rescue"]] == \
+        [["a", "b"], ["c", "d"]]
+    assert all(g["keys"] == ["packed_prompts"] for g in d["rescue"])
+    assert m.data_owner == "model_worker/0"
+    for bid in m.buffer.batch_ids():
+        e = m.buffer.get(bid)
+        assert set(e.key_owner.values()) == {"model_worker/0"}
+    # after the MFC migration that follows in _on_worker_preempted,
+    # the departed worker is no longer load-bearing at all (data
+    # ownership moved, actor_gen adopted elsewhere)
+    m._elastic_degrade("model_worker/1")
+    assert not m._still_needed("model_worker/1")
+
+
+def test_data_owner_handoff_failure_keeps_old_owner():
+    """A failed rescue (successor replies with an error payload)
+    leaves ownership -- and the fatal deadline -- on the old owner."""
+    m = _master_with_buffer(owner="model_worker/1")
+    _beat("model_worker/0")
+    m._retiring.add("model_worker/1")
+    m.stream.gather_replies = lambda *a, **k: [
+        SimpleNamespace(data=dict(error="TimeoutError('dead server')"))]
+    m._handoff_data_owner("model_worker/1", grace=5.0)
+    assert m.data_owner == "model_worker/1"
+    e = m.buffer.get(m.buffer.batch_ids()[0])
+    assert set(e.key_owner.values()) == {"model_worker/1"}
+    assert m._still_needed("model_worker/1")
+
+
+def test_data_owner_handoff_no_survivor_is_noop():
+    m = _master_with_buffer(owner="model_worker/1")
+    m._retiring.update({"model_worker/0", "model_worker/1"})
+    m._handoff_data_owner("model_worker/1", grace=5.0)
+    assert not [s for s in m.stream.sent if s[1] == "adopt_data"]
+    assert m.data_owner == "model_worker/1"
+
+
+def test_degrade_failure_keeps_original_routing():
+    m = _master()
+    _beat("model_worker/0")
+
+    def boom(*a, **k):
+        raise TimeoutError("adopter hung")
+
+    m.stream.gather_replies = boom
+    m._retiring.add("model_worker/1")
+    m._elastic_degrade("model_worker/1")
+    # adoption failed: routing untouched -> requeue/fatal semantics
+    assert m.node_workers["actor_gen"] == ["model_worker/1"]
+    assert m.elastic.degraded == {}
+    assert m._still_needed("model_worker/1")

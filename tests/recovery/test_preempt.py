@@ -1,0 +1,138 @@
+"""Preemption-notice machinery (worker_base + watchdog): the notice
+is published with its grace window, the preempt hook runs exactly once
+inside the window, the worker keeps serving until the window closes
+and then exits PREEMPTED (never ERROR/LOST), and a relaunched
+incarnation clears the stale notice. In-process (worker thread, memory
+name_resolve) so the whole file stays tier-1 fast."""
+
+import threading
+import time
+
+import pytest
+
+from realhf_tpu.base import name_resolve, names
+from realhf_tpu.system.watchdog import DONE, Watchdog
+from realhf_tpu.system.worker_base import (
+    PollResult,
+    Worker,
+    WorkerControlPanel,
+    WorkerServer,
+    WorkerServerStatus,
+)
+
+EXP, TRIAL = "preempttest", "t0"
+
+
+class DrainRecorder(Worker):
+    """Counts polls; records preempt-hook invocations."""
+
+    def __init__(self, name):
+        super().__init__(EXP, TRIAL, name)
+        self.polls = 0
+        self.hook_calls = []
+
+    def _configure(self, config):
+        return "ok"
+
+    def _poll(self):
+        self.polls += 1
+        time.sleep(0.01)
+        return PollResult(1, 1)
+
+    def _preempt_hook(self, grace):
+        self.hook_calls.append(grace)
+
+
+@pytest.fixture
+def worker_thread():
+    threads = []
+
+    def start(name):
+        w = DrainRecorder(name)
+        t = threading.Thread(target=w.run, daemon=True)
+        t.start()
+        threads.append((w, t))
+        return w, t
+
+    yield start
+    for w, t in threads:
+        w._exiting = True
+        t.join(timeout=10)
+
+
+def test_preempt_command_drains_and_exits_preempted(worker_thread):
+    w, t = worker_thread("mw/0")
+    panel = WorkerControlPanel(EXP, TRIAL)
+    panel.connect(["mw/0"], timeout=10)
+    panel.group_request("configure", kwargs={"config": {}})
+    panel.group_request("start")
+    deadline = time.monotonic() + 5
+    while w.polls == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert w.polls > 0
+
+    t0 = time.monotonic()
+    assert panel.group_request("preempt",
+                               kwargs={"grace": 0.5})["mw/0"] == "ok"
+    # notice published with its grace window
+    raw = name_resolve.wait(
+        names.worker_preempt(EXP, TRIAL, "mw/0"), timeout=5)
+    ts, grace = map(float, str(raw).split(":"))
+    assert grace == pytest.approx(0.5)
+    assert abs(ts - time.time()) < 5.0
+    assert panel.get_worker_status("mw/0") == \
+        WorkerServerStatus.PREEMPTED
+    polls_at_notice = w.polls
+    t.join(timeout=10)
+    assert not t.is_alive()
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.4            # served out the grace window...
+    assert w.polls > polls_at_notice  # ...and kept polling through it
+    assert w.hook_calls and len(w.hook_calls) == 1  # hook ran once
+    assert 0.0 <= w.hook_calls[0] <= 0.5
+    # terminal status PREEMPTED, not COMPLETED and never ERROR
+    assert panel.get_worker_status("mw/0") == \
+        WorkerServerStatus.PREEMPTED
+
+
+def test_watchdog_treats_preempted_exit_as_done_not_lost(worker_thread):
+    w, t = worker_thread("mw/1")
+    panel = WorkerControlPanel(EXP, TRIAL)
+    panel.connect(["mw/1"], timeout=10)
+    panel.group_request("configure", kwargs={"config": {}})
+    panel.group_request("start")
+    dog = Watchdog(EXP, TRIAL, ["mw/1"], timeout=0.4, grace=5.0,
+                   poll_interval=0.0)
+    assert dog.preempt_notice("mw/1") is None
+    w.notice_preemption(grace=0.2, reason="test")
+    assert dog.preempt_notice("mw/1") is not None
+    assert dog.preempt_notices().keys() == {"mw/1"}
+    t.join(timeout=10)
+    assert not t.is_alive()
+    time.sleep(0.5)  # let the last beat go stale
+    assert dog.check()["mw/1"] == DONE   # accounted for, never LOST
+    assert dog.lost_workers() == []
+    assert not dog.has_fresh_beat("mw/1")
+
+
+def test_relaunched_incarnation_clears_stale_notice():
+    name_resolve.add(names.worker_preempt(EXP, TRIAL, "mw/2"),
+                     "123.0:5.0", replace=True)
+    server = WorkerServer(EXP, TRIAL, "mw/2",
+                          heartbeat_interval=60.0)
+    try:
+        with pytest.raises(name_resolve.NameEntryNotFoundError):
+            name_resolve.get(names.worker_preempt(EXP, TRIAL, "mw/2"))
+        dog = Watchdog(EXP, TRIAL, ["mw/2"], timeout=5.0)
+        assert dog.preempt_notice("mw/2") is None
+        assert dog.has_fresh_beat("mw/2")
+    finally:
+        server.stop_heartbeat()
+
+
+def test_notice_preemption_is_idempotent(worker_thread):
+    w, _t = worker_thread("mw/3")
+    w.notice_preemption(grace=30.0, reason="first")
+    d1 = w._preempt_deadline
+    w.notice_preemption(grace=0.0, reason="second")
+    assert w._preempt_deadline == d1  # first notice wins

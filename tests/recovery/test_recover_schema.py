@@ -1,0 +1,109 @@
+"""RecoverInfo schema-upgrade coverage (ISSUE 4 satellite): the
+v1 -> v2 -> v3 `_upgrade` chain round-trips, truncated dumps degrade
+to fresh starts, and future versions are tolerated -- each vintage
+simulated exactly as pickle restores it (__dict__ verbatim)."""
+
+import pytest
+
+from realhf_tpu.base import constants, recover
+
+
+@pytest.fixture(autouse=True)
+def _trial_names():
+    constants.set_experiment_trial_names("recschema", "t0")
+    yield
+
+
+def _strip_to_vintage(info, version):
+    """Remove from __dict__ every field a given schema vintage did not
+    write, exactly like unpickling an old dump."""
+    v2_fields = ("ckpt_manifests",)
+    v1_fields = ("version", "buffer_state", "dataloader_state",
+                 "ckpt_manifests")
+    drop = v1_fields if version == 1 else v2_fields
+    for f in drop:
+        info.__dict__.pop(f, None)
+    if version == 2:
+        info.version = 2
+    return info
+
+
+def test_v3_round_trip_with_ckpt_manifests():
+    info = recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=1, global_step=5),
+        hash_vals_to_ignore=["a"],
+        ckpt_manifests={"actor": "/ckpt/actor/step_00000005/manifest.json"})
+    recover.dump(info)
+    back = recover.load()
+    assert back.version == recover.RECOVER_INFO_VERSION == 3
+    assert back.ckpt_manifests == {
+        "actor": "/ckpt/actor/step_00000005/manifest.json"}
+    assert back.recover_start.global_step == 5
+
+
+def test_v2_pickle_upgrades_preserving_version_label():
+    info = _strip_to_vintage(recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=2),
+        hash_vals_to_ignore=["x", "y"],
+        buffer_state={"next_batch_id": 7, "entries": []},
+        dataloader_state={"epoch": 2, "epoch_step": 1}), 2)
+    recover.dump(info)
+    back = recover.load_safe()
+    assert back is not None
+    assert back.version == 2            # written-by label preserved
+    assert back.ckpt_manifests is None  # v3 field defaulted
+    assert back.buffer_state["next_batch_id"] == 7
+    assert back.dataloader_state["epoch_step"] == 1
+    assert back.hash_vals_to_ignore == ["x", "y"]
+
+
+def test_v1_pickle_upgrades_through_both_hops():
+    info = _strip_to_vintage(recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=3),
+        hash_vals_to_ignore=["z"]), 1)
+    assert "version" not in info.__dict__
+    recover.dump(info)
+    back = recover.load_safe()
+    assert back is not None
+    assert back.version == 1
+    assert back.buffer_state is None       # v2 fields defaulted
+    assert back.dataloader_state is None
+    assert back.ckpt_manifests is None     # v3 field defaulted
+    assert back.recover_start.epoch == 3
+    assert back.hash_vals_to_ignore == ["z"]
+
+
+def test_upgraded_v1_redump_becomes_current_schema():
+    """An upgraded legacy object re-dumped by current code carries the
+    current version and all fields -- the upgrade is not sticky."""
+    info = _strip_to_vintage(recover.RecoverInfo(), 1)
+    recover.dump(info)
+    back = recover.load()
+    back.version = recover.RECOVER_INFO_VERSION
+    back.ckpt_manifests = {"default": "/m.json"}
+    recover.dump(back)
+    again = recover.load()
+    assert again.version == 3
+    assert again.ckpt_manifests == {"default": "/m.json"}
+
+
+def test_truncated_dump_degrades_to_fresh_start():
+    recover.dump(recover.RecoverInfo(
+        ckpt_manifests={"a": "/m.json"}, hash_vals_to_ignore=[1, 2]))
+    path = recover.dump_path()
+    raw = open(path, "rb").read()
+    for cut in (1, len(raw) // 3, len(raw) - 2):
+        with open(path, "wb") as f:
+            f.write(raw[:cut])
+        assert recover.load_safe() is None
+    with open(path, "wb") as f:
+        f.write(raw)
+    assert recover.load_safe().ckpt_manifests == {"a": "/m.json"}
+
+
+def test_future_version_tolerated_not_crashed():
+    recover.dump(recover.RecoverInfo(
+        version=recover.RECOVER_INFO_VERSION + 1))
+    assert recover.load_safe() is None          # resume: fresh start
+    assert recover.load().version == \
+        recover.RECOVER_INFO_VERSION + 1        # forensics: strict load

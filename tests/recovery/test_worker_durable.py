@@ -1,0 +1,161 @@
+"""Model-worker durable-save plumbing, isolated from the distributed
+runtime: staging -> checksum -> atomic commit -> latest-link refresh,
+resume redirect to the verified checkpoint (with corrupt-shard
+fallback and fresh-start refusal of unverifiable trees), and the
+emergency-save hook's commit."""
+
+import os
+
+import pytest
+
+from realhf_tpu.api.experiment import FaultToleranceConfig, ModelSpec
+from realhf_tpu.base import constants, recover
+from realhf_tpu.base.fault_injection import flip_bytes
+from realhf_tpu.system.ckpt_manager import CheckpointManager
+from realhf_tpu.system.model_worker import ModelWorker
+
+
+class _FakeHost:
+    """Writes a recognizable checkpoint into whatever path save_role
+    is given -- the durable manager must checksum exactly these."""
+
+    def __init__(self):
+        self.saved_to = []
+        self.leader_of_role = {}
+        self.roles = []
+
+    def save_role(self, role, node_name, path=None):
+        assert path is not None
+        self.saved_to.append(path)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "config.json"), "w") as f:
+            f.write('{"tiny": true}')
+        with open(os.path.join(path, "model.safetensors"), "wb") as f:
+            f.write(b"\x00weights:" + role.encode())
+        return path
+
+
+def _worker():
+    """A ModelWorker shell with only the durable-save attrs -- no
+    sockets, engines, or jax."""
+    w = ModelWorker.__new__(ModelWorker)
+    w.worker_name = "model_worker/0"
+    w.ft = FaultToleranceConfig(ckpt_keep=2)
+    w.faults = None
+    w._ckpt_mgrs = {}
+    w.host = _FakeHost()
+    return w
+
+
+@pytest.fixture(autouse=True)
+def _trial(tmp_path):
+    constants.set_experiment_trial_names("wdur", "t0")
+    yield
+
+
+def test_durable_save_commits_and_refreshes_latest_link():
+    w = _worker()
+    out = w._durable_save_role("default", "trainDefault", step=3)
+    assert out is not None and out["step"] == 3
+    assert os.path.isfile(out["manifest"])
+    mgr = w._ckpt_manager("default")
+    rec = mgr.latest_verified()
+    assert rec is not None and rec.step == 3 and rec.path == out["path"]
+    link = os.path.join(constants.run_save_path(), "default")
+    assert os.path.islink(link)
+    assert os.path.realpath(link) == os.path.realpath(rec.path)
+    assert os.path.isfile(os.path.join(link, "config.json"))
+    # a newer save swaps the link atomically
+    out2 = w._durable_save_role("default", "trainDefault", step=4)
+    assert os.path.realpath(os.path.join(
+        constants.run_save_path(), "default")) == \
+        os.path.realpath(out2["path"])
+
+
+def test_resume_redirect_prefers_recorded_manifest():
+    w = _worker()
+    w._durable_save_role("default", "trainDefault", step=1)
+    out2 = w._durable_save_role("default", "trainDefault", step=2)
+    recover.dump(recover.RecoverInfo(
+        ckpt_manifests={"default": out2["manifest"]}))
+    spec_models = {"default": ModelSpec(
+        path=None, random_init_config={"n_layers": 1})}
+
+    class _Spec:
+        models = spec_models
+
+    w._redirect_models_to_durable(_Spec())
+    ms = spec_models["default"]
+    assert ms.path == out2["path"]
+    assert ms.random_init_config is None
+    assert ms.restore_optimizer_state
+
+
+def test_resume_redirect_falls_back_on_corrupt_shard():
+    """Acceptance: corrupt_ckpt on the newest shard -> the resume load
+    rejects it by checksum and falls back to the previous committed
+    manifest."""
+    w = _worker()
+    out1 = w._durable_save_role("default", "trainDefault", step=1)
+    out2 = w._durable_save_role("default", "trainDefault", step=2)
+    flip_bytes(os.path.join(out2["path"], "model.safetensors"))
+    recover.dump(recover.RecoverInfo(
+        ckpt_manifests={"default": out2["manifest"]}))
+    spec_models = {"default": ModelSpec(path=None,
+                                        random_init_config={"a": 1})}
+
+    class _Spec:
+        models = spec_models
+
+    w._redirect_models_to_durable(_Spec())
+    assert spec_models["default"].path == out1["path"]
+
+
+def test_resume_refuses_unverifiable_durable_tree():
+    """Every committed checkpoint corrupt -> fresh start (the legacy
+    symlink points INTO the corrupt durable tree and must not bypass
+    the checksums)."""
+    w = _worker()
+    out = w._durable_save_role("default", "trainDefault", step=1)
+    flip_bytes(os.path.join(out["path"], "model.safetensors"))
+    spec_models = {"default": ModelSpec(path=None,
+                                        random_init_config={"a": 1})}
+
+    class _Spec:
+        models = spec_models
+
+    w._redirect_models_to_durable(_Spec())
+    assert spec_models["default"].path is None          # fresh start
+    assert spec_models["default"].random_init_config == {"a": 1}
+
+
+def test_resume_accepts_legacy_plain_directory():
+    """durable_ckpt=False vintage: a real (non-symlink) HF directory
+    at run_save_path()/role is accepted as the recovery source."""
+    w = _worker()
+    legacy = os.path.join(constants.run_save_path(), "default")
+    os.makedirs(legacy)
+    with open(os.path.join(legacy, "config.json"), "w") as f:
+        f.write("{}")
+    spec_models = {"default": ModelSpec(path=None,
+                                        random_init_config={"a": 1})}
+
+    class _Spec:
+        models = spec_models
+
+    w._redirect_models_to_durable(_Spec())
+    assert spec_models["default"].path == legacy
+
+
+def test_partial_save_is_gced_not_committed():
+    w = _worker()
+    w._durable_save_role("default", "trainDefault", step=1)
+    mgr = w._ckpt_manager("default")
+    # crash mid-save: staged but never committed
+    writer = mgr.begin(2)
+    w.host.save_role("default", "trainDefault", path=writer.path)
+    staged = writer.path
+    assert mgr.latest_verified().step == 1
+    removed = mgr.gc()
+    assert staged in removed
+    assert mgr.latest_verified().step == 1

@@ -38,7 +38,8 @@ class TestExecuteLevel:
         # deterministic overlap proof: every execute() waits at a
         # shared barrier, which only releases when all three calls are
         # in flight SIMULTANEOUSLY -- no wall-clock bound to flake on
-        # a loaded box
+        # a loaded box. parallel=True bypasses the single-CPU default
+        # (the mechanism is what's under test, not the gate).
         host = _FakeHost()
         barrier = threading.Barrier(3)
         orig = host.execute
@@ -48,9 +49,33 @@ class TestExecuteLevel:
             return orig(node_name, inp)
 
         host.execute = execute
-        outs = host.execute_level([("a", 1), ("b", 2), ("c", 3)])
+        outs = host.execute_level([("a", 1), ("b", 2), ("c", 3)],
+                                  parallel=True)
         assert outs == ["out:a:1", "out:b:2", "out:c:3"]
         assert len(host.threads_seen) == 3
+
+    def test_single_cpu_defaults_to_serial(self, monkeypatch):
+        # concurrent XLA CPU collectives spin-wait their rendezvous;
+        # one core starves them into deadlock -- the default must
+        # serialize there (REALHF_TPU_PARALLEL_MFC=1 still forces)
+        import realhf_tpu.system.model_host as mh
+        monkeypatch.delenv("REALHF_TPU_PARALLEL_MFC", raising=False)
+        monkeypatch.setattr(mh.os, "cpu_count", lambda: 1)
+        host = _FakeHost()
+        host.execute_level([("a", 1), ("b", 2)])
+        assert len(host.threads_seen) == 1
+        monkeypatch.setenv("REALHF_TPU_PARALLEL_MFC", "1")
+        host2 = _FakeHost()
+        barrier = threading.Barrier(2)
+        orig = host2.execute
+
+        def execute(node_name, inp):
+            barrier.wait(timeout=30)  # needs both in flight at once
+            return orig(node_name, inp)
+
+        host2.execute = execute
+        host2.execute_level([("a", 1), ("b", 2)])
+        assert len(host2.threads_seen) == 2
 
     def test_parallel_false_serializes(self):
         host = _FakeHost(sleep_s=0.1)
