@@ -231,6 +231,16 @@ def bench_ppo(on_tpu):
     acfg = runner.models["actor"].config
     ccfg = runner.models["critic"].config
 
+    # In-memory span tracing over the timed steps (realhf_tpu/obs/):
+    # the drained spans become the per-MFC wall-time breakdown in the
+    # payload, making each round's perf trajectory attributable to a
+    # phase rather than one opaque headline. No file path => spans
+    # stay in the thread buffers until drained; overhead is a handful
+    # of dict appends per multi-second step.
+    from realhf_tpu.obs import metrics as obs_metrics
+    from realhf_tpu.obs import tracing as obs_tracing
+    obs_tracing.configure(process_name="bench", enabled=True)
+
     from realhf_tpu.api import data as data_api
     batches = iter(runner.dataloader)
 
@@ -246,28 +256,38 @@ def bench_ppo(on_tpu):
         phase_secs = {}
         data = batch
         t_step = time.monotonic()
-        for level in runner.dfg.topological_levels():
-            named = [(node.name,
-                      data.select([k for k in node.input_keys
-                                   if k in data.keys]))
-                     for node in level]
-            outs = runner.host.execute_level(named, parallel=parallel)
-            for node, out in zip(level, outs):
-                info = runner.host.exec_infos.get(node.name) or {}
-                phase_secs[node.name] = info.get(
-                    "secs", 0.0)
-                # measured HBM profile (VERDICT r4 weak #3): bytes in
-                # use right after each phase + process-lifetime peak
-                if info.get("hbm_bytes_in_use"):
-                    phase_hbm[node.name] = max(
-                        phase_hbm.get(node.name, 0),
-                        info["hbm_bytes_in_use"])
-                    phase_hbm["proc_peak"] = max(
-                        phase_hbm.get("proc_peak", 0),
-                        info.get("proc_peak_hbm_bytes", 0))
-                if isinstance(out, data_api.SequenceSample):
-                    data.update_(out)
-        return time.monotonic() - t_step, phase_secs
+        with obs_tracing.span(
+                "step", mode="parallel" if parallel else "serial"):
+            for level in runner.dfg.topological_levels():
+                named = [(node.name,
+                          data.select([k for k in node.input_keys
+                                       if k in data.keys]))
+                         for node in level]
+                outs = runner.host.execute_level(named,
+                                                 parallel=parallel)
+                for node, out in zip(level, outs):
+                    info = runner.host.exec_infos.get(node.name) or {}
+                    phase_secs[node.name] = info.get(
+                        "secs", 0.0)
+                    obs_metrics.observe("mfc_exec_secs",
+                                        phase_secs[node.name],
+                                        mfc=node.name)
+                    # measured HBM profile (VERDICT r4 weak #3): bytes
+                    # in use right after each phase + process peak
+                    if info.get("hbm_bytes_in_use"):
+                        phase_hbm[node.name] = max(
+                            phase_hbm.get(node.name, 0),
+                            info["hbm_bytes_in_use"])
+                        phase_hbm["proc_peak"] = max(
+                            phase_hbm.get("proc_peak", 0),
+                            info.get("proc_peak_hbm_bytes", 0))
+                    if isinstance(out, data_api.SequenceSample):
+                        data.update_(out)
+        wall = time.monotonic() - t_step
+        obs_metrics.observe(
+            "ppo_step_secs", wall,
+            mode="parallel" if parallel else "serial")
+        return wall, phase_secs
 
     for _ in range(warmup):
         # warmup serialized too: threaded dispatch is attempted ONLY
@@ -397,6 +417,23 @@ def bench_ppo(on_tpu):
         "ppo_phase_hbm_gb": {k: round(v / 2 ** 30, 3)
                              for k, v in phase_hbm.items()},
     }
+
+    # ---- observability payload (docs/observability.md) ------------------
+    # step-span summary: per-span-name count/total/mean from the
+    # drained tracer buffers (step + per-MFC compute spans), plus the
+    # full metrics-registry snapshot -- the machine-diffable record
+    # that makes BENCH_*.json perf regressions attributable per phase.
+    span_agg = {}
+    for s in obs_tracing.default_tracer().drain():
+        d = span_agg.setdefault(s.name, dict(count=0, total_s=0.0))
+        d["count"] += 1
+        d["total_s"] += (s.end or s.start) - s.start
+    for d in span_agg.values():
+        d["total_s"] = round(d["total_s"], 4)
+        d["mean_s"] = round(d["total_s"] / d["count"], 4)
+    extra["ppo_step_spans"] = dict(sorted(span_agg.items()))
+    extra["obs_metrics"] = obs_metrics.snapshot()
+    obs_tracing.configure(enabled=False)
 
     # ---- reshard latency (north-star metric) ----------------------------
     # Two flavors. (a) device path: move the actor's live weights onto

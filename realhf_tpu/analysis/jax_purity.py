@@ -25,6 +25,12 @@ Rules:
   inside a traced function.
 - ``purity-sync-in-loop``: per-iteration host transfer in host-side
   engine/serving loops.
+- ``purity-obs-in-trace``: observability call (``obs.tracing`` span,
+  metrics registry op, flight-recorder append) inside a traced
+  function. Spans time wall-clock and metrics mutate host state:
+  under trace they execute ONCE at trace time, so the timeline/counts
+  they produce are lies -- instrument around the jitted call instead
+  (docs/observability.md).
 """
 
 import ast
@@ -67,6 +73,17 @@ IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.",
 
 MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop",
                    "clear", "add", "update", "setdefault", "popitem"}
+
+#: observability namespaces (realhf_tpu/obs/) whose calls must stay
+#: host-side -- a span/counter inside a jitted function fires once at
+#: trace time and records garbage
+OBS_PREFIXES = ("tracing.", "obs_tracing.", "metrics.", "obs_metrics.",
+                "flight.", "obs_flight.", "obs.tracing.", "obs.metrics.",
+                "obs.flight.")
+#: obs API entry points (module-level convenience functions AND the
+#: Tracer/MetricsRegistry/FlightRecorder methods)
+OBS_METHODS = {"span", "start_span", "inc", "set_gauge", "observe",
+               "event", "record", "maybe_flush", "flush"}
 
 #: package paths where the host-loop rule applies (decode hot paths)
 _HOT_PATH_PREFIXES = ("realhf_tpu/engine/", "realhf_tpu/serving/")
@@ -218,6 +235,13 @@ class JaxPurityChecker(AstChecker):
                 f = ("purity-host-sync",
                      f"`{nm}()` on a traced value forces a host sync "
                      f"inside traced function `{fn.name}`")
+            elif (nm.startswith(OBS_PREFIXES)
+                  and nm.rsplit(".", 1)[-1] in OBS_METHODS):
+                f = ("purity-obs-in-trace",
+                     f"observability call `{nm}` inside traced "
+                     f"function `{fn.name}` executes once at trace "
+                     "time (spans/metrics record garbage); instrument "
+                     "around the jitted call")
             elif nm in IMPURE_CALLS or nm.startswith(IMPURE_PREFIXES):
                 f = ("purity-impure-call",
                      f"impure call `{nm}` inside traced function "

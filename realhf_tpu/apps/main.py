@@ -19,6 +19,7 @@ from typing import Dict, Optional
 
 from realhf_tpu.api.experiment import ExperimentSpec, FaultToleranceConfig
 from realhf_tpu.base import constants, logging, name_resolve, names
+from realhf_tpu.obs import tracing
 from realhf_tpu.system.scheduler import (
     JobException,
     JobState,
@@ -239,6 +240,28 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         return stats["master_worker/0"]
     finally:
         sched.stop_all()
+        _merge_run_traces()
+
+
+def _merge_run_traces():
+    """With ``REALHF_TPU_TRACE=1`` every worker process streamed its
+    spans to ``{run_log_path}/obs/trace/<worker>.trace.jsonl``; fold
+    them into ONE Perfetto-loadable Chrome trace so a PPO step renders
+    as a single timeline across the master, every model worker, and
+    the serving fleet. Runs in the teardown path (success or failure:
+    the trace of a crashed trial is the one you want most) and never
+    raises."""
+    if not tracing.trace_env_enabled():
+        return
+    try:
+        merged = tracing.merge_traces()
+    except Exception as e:  # noqa: BLE001 - teardown must not mask
+        # the trial's real outcome
+        logger.warning("Trace merge failed: %s", e)
+        return
+    if merged:
+        logger.info("Chrome trace written: %s (open in Perfetto / "
+                    "chrome://tracing).", merged)
 
 
 def run_serve(spec: ExperimentSpec,
@@ -328,6 +351,7 @@ def run_serve(spec: ExperimentSpec,
         return stats
     finally:
         sched.stop_all(grace=sv.drain_timeout_secs + 10)
+        _merge_run_traces()
 
 
 def main_start(spec: ExperimentSpec, recover_mode: str = "disabled",

@@ -127,7 +127,28 @@ def main(argv=None):
         runner = InlineRunner(spec, recover_mode=cfg.recover_mode)
         stats = runner.run()
     logger.info("Experiment complete. Last step stats: %s", stats)
+    _report_observability_artifacts()
     return stats
+
+
+def _report_observability_artifacts():
+    """Point the operator at what REALHF_TPU_TRACE=1 produced: the
+    merged Chrome trace (written by the inline runner or the launcher
+    teardown, docs/observability.md) and the per-process metrics
+    JSONL directory."""
+    import os
+
+    from realhf_tpu.obs import tracing
+    if not tracing.trace_env_enabled():
+        return
+    d = tracing.trace_dir()
+    merged = os.path.join(d, tracing.MERGED_TRACE_NAME)
+    if os.path.exists(merged):
+        logger.info("Trace timeline: %s (load in https://ui.perfetto.dev"
+                    " or chrome://tracing).", merged)
+    elif os.path.isdir(d):
+        logger.info("Per-process trace shards under %s (merge with "
+                    "realhf_tpu.obs.tracing.merge_traces).", d)
 
 
 if __name__ == "__main__":

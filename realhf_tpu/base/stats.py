@@ -5,30 +5,50 @@ inside the model (e.g. MoE aux losses) record scalars here; the
 algorithm interface drains them after each step and merges them into
 returned stats. In JAX these are traced scalars returned from jitted
 functions, so the tracker stores host-side values post-step.
+
+Absorbed by the observability layer (``realhf_tpu/obs/metrics.py``):
+accumulation runs on the same :class:`~realhf_tpu.obs.metrics.Accum`
+engine the metrics registry uses, and ``export`` now reports
+count/min/max/mean per key instead of a bare mean. The export swaps
+the accumulator map out under the lock and summarizes OUTSIDE it, so
+values recorded concurrently during a clearing export land in the
+fresh map for the next export instead of being dropped mid-clear.
 """
 
 import threading
-from collections import defaultdict
-from typing import Dict, List
+from typing import Dict
+
+from realhf_tpu.obs.metrics import Accum
 
 
 class StatsTracker:
 
     def __init__(self):
-        self._stats: Dict[str, List[float]] = defaultdict(list)
+        self._stats: Dict[str, Accum] = {}
         self._lock = threading.Lock()
 
     def record(self, **kwargs: float):
         with self._lock:
             for k, v in kwargs.items():
-                self._stats[k].append(float(v))
+                acc = self._stats.get(k)
+                if acc is None:
+                    acc = self._stats[k] = Accum()
+                acc.add(float(v))
 
-    def export(self, clear: bool = True) -> Dict[str, float]:
+    def export(self, clear: bool = True) -> Dict[str, Dict[str, float]]:
+        """Per-key ``{count, sum, min, max, mean}``. With ``clear``
+        the internal map is atomically replaced, so a concurrent
+        ``record`` either lands before the swap (in this export) or
+        after it (in the next one) -- never in a dict mid-``clear``."""
         with self._lock:
-            out = {k: sum(v) / len(v) for k, v in self._stats.items() if v}
             if clear:
-                self._stats.clear()
-        return out
+                taken, self._stats = self._stats, {}
+            else:
+                import dataclasses
+                taken = {k: dataclasses.replace(v)
+                         for k, v in self._stats.items()}
+        return {k: acc.as_dict() for k, acc in taken.items()
+                if acc.count}
 
 
 _tracker = StatsTracker()

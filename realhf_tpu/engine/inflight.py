@@ -31,6 +31,7 @@ import numpy as np
 
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.obs import tracing
 from realhf_tpu.ops.sampling import (
     NEG_INF,
     GenerationHyperparameters,
@@ -222,9 +223,12 @@ class InflightBatchingGenerator:
         # per transfer; see Engine._globalize_tree). `slot` keeps its
         # host int for the list index below -- indexing with a device
         # scalar would force a blocking D2H readback per fill.
-        dev_slot, ids, seg, pos = jax.device_put((slot, ids, seg, pos))
-        self.state = self._prefill(self.params, self.state, dev_slot,
-                                   ids, seg, pos)
+        with tracing.span("serve:prefill", slot=slot,
+                          prompt_len=len(prompt), bucket=lp):
+            dev_slot, ids, seg, pos = jax.device_put((slot, ids, seg,
+                                                      pos))
+            self.state = self._prefill(self.params, self.state,
+                                       dev_slot, ids, seg, pos)
         self._slot_req[slot] = request_id
 
     # ------------------------------------------------------------------

@@ -1,0 +1,136 @@
+"""Flight recorder: a bounded ring of recent events per worker.
+
+The postmortem half of the observability layer
+(docs/observability.md): every worker keeps the last N runtime events
+(requests handled, replies sent, faults fired, preemption notices,
+weight swaps, ...) in a fixed-size in-memory ring. Recording is a
+deque append under a lock -- cheap enough for hot paths -- and
+nothing touches disk until something goes wrong: injected
+``fault_injection`` crashes, preemption hooks, and
+``WorkerLostError``/ERROR exit paths call :func:`dump`, which writes
+the ring as one JSON file under ``{run_log_path}/obs/flight/`` so the
+operator sees exactly what the process did right before it died.
+
+Dump format (``docs/observability.md`` has the catalog)::
+
+    {"worker": ..., "reason": ..., "dumped_at": <wall ts>,
+     "n_events": N, "events": [{"ts": ..., "kind": ..., ...}, ...]}
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("obs.flight")
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Bounded event ring + crash-time dump for one process."""
+
+    def __init__(self, name: str = "proc",
+                 capacity: int = DEFAULT_CAPACITY):
+        self.name = name
+        self.capacity = capacity
+        self._events: Deque[Dict] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def configure(self, name: str):
+        self.name = name
+
+    def record(self, kind: str, **detail):
+        # detail keys must not collide with the positional event kind
+        # ("kind" in detail would TypeError at the call site -- use a
+        # qualified key like fault_kind instead)
+        ev = dict(ts=time.time(), kind=kind, **detail)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def dump(self, reason: str, path: Optional[str] = None
+             ) -> Optional[str]:
+        """Write the ring to ``path`` (default: this run's flight
+        dir). Returns the written path, or None when writing failed --
+        a postmortem must never mask the original failure."""
+        events = self.events()
+        record = dict(worker=self.name, reason=reason,
+                      dumped_at=time.time(), n_events=len(events),
+                      events=events)
+        if path is None:
+            try:
+                path = dump_path(self.name)
+            except Exception as e:  # noqa: BLE001 - run constants may
+                # be unset in unit-test contexts; fall back loudly
+                logger.warning("Flight dump path unavailable (%s); "
+                               "dropping dump for %s.", e, self.name)
+                return None
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("Flight dump to %s failed: %s", path, e)
+            return None
+        logger.warning("Flight recorder dumped %d events to %s "
+                       "(reason: %s).", len(events), path, reason)
+        return path
+
+
+def flight_dir(experiment: Optional[str] = None,
+               trial: Optional[str] = None) -> str:
+    from realhf_tpu.base import constants
+    return os.path.join(constants.run_log_path(experiment, trial),
+                        "obs", "flight")
+
+
+def dump_path(process_name: str,
+              experiment: Optional[str] = None,
+              trial: Optional[str] = None) -> str:
+    safe = process_name.replace("/", "-").replace(" ", "_")
+    return os.path.join(flight_dir(experiment, trial),
+                        f"{safe}.flight.json")
+
+
+# ----------------------------------------------------------------------
+_default = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    return _default
+
+
+def reset_default():
+    """Fresh default recorder (test isolation)."""
+    global _default
+    _default = FlightRecorder()
+
+
+def configure(name: str):
+    _default.configure(name)
+
+
+def record(kind: str, **detail):
+    _default.record(kind, **detail)
+
+
+def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    return _default.dump(reason, path=path)

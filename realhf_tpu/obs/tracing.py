@@ -1,0 +1,395 @@
+"""Structured span tracer with cross-process context propagation.
+
+The timeline half of the observability layer (docs/observability.md):
+nestable spans with trace/span ids and free-form attributes, buffered
+lock-free per thread (each thread appends to its own list; drains
+snapshot a length first so a racing append is never lost), and
+exported as Chrome trace-event JSON that Perfetto / ``chrome://tracing``
+load directly -- one PPO step renders as a single timeline across the
+master, every model worker, and the serving fleet.
+
+Propagation: a span's :class:`SpanContext` serializes to a plain dict
+(``inject``) that rides in ``request_reply_stream.Payload.trace`` and
+in the serving submit envelope; the receiving process ``extract``\\ s it
+and parents its spans there, so causality survives process hops.
+
+The tracer is OFF by default (every call is a cheap no-op). It turns
+on either programmatically (:func:`configure`) or through the
+``REALHF_TPU_TRACE=1`` env switch honored by every worker process,
+the inline runner, and quickstart (:func:`configure_from_env`). When a
+file path is configured, finished spans stream to it as JSON lines
+(one Chrome event per line); :func:`merge_traces` folds every
+per-process file of a run into one ``merged_trace.json``.
+"""
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+import zlib
+from typing import Any, Dict, Iterator, List, Optional
+
+from realhf_tpu.base import logging
+
+logger = logging.getLogger("obs.tracing")
+
+TRACE_ENV = "REALHF_TPU_TRACE"
+
+#: file name of the per-run merged Chrome trace (Perfetto-loadable)
+MERGED_TRACE_NAME = "merged_trace.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """The propagatable identity of a span."""
+    trace_id: str
+    span_id: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict]) -> Optional["SpanContext"]:
+        if not d or "trace_id" not in d or "span_id" not in d:
+            return None
+        return cls(trace_id=str(d["trace_id"]),
+                   span_id=str(d["span_id"]))
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed operation. Create through :meth:`Tracer.span` (context
+    manager, becomes the thread's current span) or
+    :meth:`Tracer.start_span` (explicit lifetime for long-lived work
+    like a serving request); ``finish()`` records it."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attributes", "_tracer", "_finished")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional[SpanContext], attributes: Dict):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = parent.trace_id if parent else _new_id()
+        self.span_id = _new_id()
+        self.parent_id = parent.span_id if parent else None
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attributes = dict(attributes)
+        self._finished = False
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(trace_id=self.trace_id, span_id=self.span_id)
+
+    def set_attribute(self, key: str, value: Any):
+        self.attributes[key] = value
+
+    def finish(self, end_time: Optional[float] = None):
+        if self._finished:
+            return
+        self._finished = True
+        self.end = end_time if end_time is not None else time.time()
+        self._tracer._record(self)
+
+
+class _NoopSpan:
+    """Returned while the tracer is disabled: every operation is free."""
+
+    __slots__ = ()
+    name = ""
+    trace_id = span_id = parent_id = None
+    attributes: Dict = {}
+    context = None
+
+    def set_attribute(self, key, value):
+        pass
+
+    def finish(self, end_time=None):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _ThreadBuffer(threading.local):
+    """Per-thread finished-span buffer. Appends are thread-local (no
+    lock); the drain snapshots a length first, so an append racing the
+    drain lands past the snapshot and survives for the next drain."""
+
+    def __init__(self, register):
+        self.spans: List[Span] = []
+        self.stack: List[Span] = []
+        register(self.spans)
+
+
+class Tracer:
+    """Span factory + buffer + exporter for one logical process."""
+
+    def __init__(self, process_name: str = "proc",
+                 enabled: bool = False, path: Optional[str] = None):
+        self.process_name = process_name
+        self.enabled = enabled
+        self.path = path
+        self._buffers: List[List[Span]] = []
+        self._buffers_lock = threading.Lock()
+        self._file_lock = threading.Lock()
+        self._wrote_meta = False
+        self._tl = _ThreadBuffer(self._register_buffer)
+
+    # -- configuration --------------------------------------------------
+    def configure(self, process_name: Optional[str] = None,
+                  enabled: Optional[bool] = None,
+                  path: Optional[str] = None):
+        if process_name is not None:
+            self.process_name = process_name
+            self._wrote_meta = False
+        if enabled is not None:
+            self.enabled = enabled
+        if path is not None:
+            self.path = path
+
+    def _register_buffer(self, buf: List[Span]):
+        with self._buffers_lock:
+            self._buffers.append(buf)
+
+    @property
+    def pid(self) -> int:
+        """Stable integer process id for Chrome events: derived from
+        the process NAME, so a merged multi-process trace keeps one
+        lane per worker and an in-process test harness can emulate
+        several 'processes' with several tracers."""
+        return zlib.crc32(self.process_name.encode()) & 0x7FFFFFFF
+
+    # -- span creation --------------------------------------------------
+    def current_span(self) -> Optional[Span]:
+        stack = self._tl.stack
+        return stack[-1] if stack else None
+
+    def current_context(self) -> Optional[SpanContext]:
+        cur = self.current_span()
+        return cur.context if cur is not None else None
+
+    def inject(self) -> Optional[Dict[str, str]]:
+        """Current span context as a payload-ready dict (None when no
+        span is open or tracing is off)."""
+        ctx = self.current_context() if self.enabled else None
+        return ctx.to_dict() if ctx is not None else None
+
+    @staticmethod
+    def extract(carrier: Optional[Dict]) -> Optional[SpanContext]:
+        return SpanContext.from_dict(carrier)
+
+    def start_span(self, name: str,
+                   parent: Optional[SpanContext] = None,
+                   **attributes) -> Span:
+        """Explicit-lifetime span (NOT pushed on the thread's current
+        stack): caller owns ``finish()``. ``parent=None`` parents to
+        the thread's current span."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is None:
+            parent = self.current_context()
+        return Span(self, name, parent, attributes)
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Optional[SpanContext] = None,
+             **attributes) -> Iterator[Span]:
+        """Scoped span: becomes the thread's current span, so nested
+        ``span()`` calls and ``inject()`` see it; finishes on exit
+        (exceptions are recorded as an ``error`` attribute)."""
+        if not self.enabled:
+            yield NOOP_SPAN
+            return
+        sp = self.start_span(name, parent=parent, **attributes)
+        self._tl.stack.append(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.set_attribute("error", repr(e))
+            raise
+        finally:
+            stack = self._tl.stack
+            if stack and stack[-1] is sp:
+                stack.pop()
+            sp.finish()
+
+    # -- recording / export ---------------------------------------------
+    def _record(self, span: Span):
+        self._tl.spans.append(span)
+
+    def drain(self) -> List[Span]:
+        """Remove and return every finished span across all threads."""
+        out: List[Span] = []
+        with self._buffers_lock:
+            buffers = list(self._buffers)
+        for buf in buffers:
+            n = len(buf)  # snapshot BEFORE slicing: racing appends
+            out.extend(buf[:n])  # land at >= n and survive
+            del buf[:n]
+        return out
+
+    def _event(self, span: Span) -> Dict:
+        args = {k: v for k, v in span.attributes.items()}
+        args["trace_id"] = span.trace_id
+        args["span_id"] = span.span_id
+        if span.parent_id:
+            args["parent_id"] = span.parent_id
+        return {
+            "name": span.name, "ph": "X", "cat": "span",
+            "ts": span.start * 1e6,
+            "dur": max(0.0, (span.end or span.start) - span.start) * 1e6,
+            "pid": self.pid, "tid": threading.get_ident() & 0x7FFFFFFF,
+            "args": args,
+        }
+
+    def _meta_events(self) -> List[Dict]:
+        return [{"name": "process_name", "ph": "M", "pid": self.pid,
+                 "args": {"name": self.process_name}}]
+
+    def to_events(self, spans: List[Span],
+                  with_meta: bool = True) -> List[Dict]:
+        events = self._meta_events() if with_meta else []
+        events.extend(self._event(s) for s in spans)
+        return events
+
+    def flush(self):
+        """Drain buffered spans; when a file path is configured,
+        append them to it as JSON lines. Serialization happens outside
+        any span-recording path, so instrumented code never blocks on
+        file IO."""
+        spans = self.drain()
+        if not spans or not self.path:
+            return
+        lines = [json.dumps(e, default=str)
+                 for e in self.to_events(spans,
+                                         with_meta=not self._wrote_meta)]
+        payload = "\n".join(lines) + "\n"
+        with self._file_lock:
+            self._wrote_meta = True
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(self.path, "a") as f:
+                    f.write(payload)
+            except OSError as e:  # tracing must never kill the run
+                logger.warning("Trace flush to %s failed: %s",
+                               self.path, e)
+
+
+# ----------------------------------------------------------------------
+# Module-level default tracer (one per process) + convenience API.
+# ----------------------------------------------------------------------
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default
+
+
+def configure(process_name: Optional[str] = None,
+              enabled: Optional[bool] = None,
+              path: Optional[str] = None):
+    _default.configure(process_name=process_name, enabled=enabled,
+                       path=path)
+
+
+def reset_default():
+    """Fresh default tracer (test isolation)."""
+    global _default
+    _default = Tracer()
+
+
+def enabled() -> bool:
+    return _default.enabled
+
+
+def span(name: str, parent: Optional[SpanContext] = None, **attributes):
+    return _default.span(name, parent=parent, **attributes)
+
+
+def start_span(name: str, parent: Optional[SpanContext] = None,
+               **attributes) -> Span:
+    return _default.start_span(name, parent=parent, **attributes)
+
+
+def current_context() -> Optional[SpanContext]:
+    return _default.current_context()
+
+
+def inject() -> Optional[Dict[str, str]]:
+    return _default.inject()
+
+
+def extract(carrier: Optional[Dict]) -> Optional[SpanContext]:
+    return Tracer.extract(carrier)
+
+
+def flush():
+    _default.flush()
+
+
+def trace_env_enabled(env=None) -> bool:
+    env = os.environ if env is None else env
+    return env.get(TRACE_ENV, "") not in ("", "0")
+
+
+def trace_dir(experiment: Optional[str] = None,
+              trial: Optional[str] = None) -> str:
+    from realhf_tpu.base import constants
+    return os.path.join(constants.run_log_path(experiment, trial),
+                        "obs", "trace")
+
+
+def trace_file_path(process_name: str,
+                    experiment: Optional[str] = None,
+                    trial: Optional[str] = None) -> str:
+    safe = process_name.replace("/", "-").replace(" ", "_")
+    return os.path.join(trace_dir(experiment, trial),
+                        f"{safe}.trace.jsonl")
+
+
+def merge_traces(directory: Optional[str] = None,
+                 out_path: Optional[str] = None,
+                 experiment: Optional[str] = None,
+                 trial: Optional[str] = None) -> Optional[str]:
+    """Fold every per-process ``*.trace.jsonl`` under ``directory``
+    (default: this run's trace dir) into one Chrome trace-event JSON
+    (``merged_trace.json``). Returns the merged path, or None when
+    there was nothing to merge. Unparseable lines are skipped -- a
+    worker killed mid-write must not void everyone else's timeline."""
+    directory = directory or trace_dir(experiment, trial)
+    if not os.path.isdir(directory):
+        return None
+    events: List[Dict] = []
+    for fn in sorted(os.listdir(directory)):
+        if not fn.endswith(".trace.jsonl"):
+            continue
+        try:
+            with open(os.path.join(directory, fn)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    if not events:
+        return None
+    out_path = out_path or os.path.join(directory, MERGED_TRACE_NAME)
+    merged = {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out_path)
+    logger.info("Merged %d trace events from %s into %s.",
+                len(events), directory, out_path)
+    return out_path

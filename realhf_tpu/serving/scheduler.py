@@ -37,6 +37,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics as obs_metrics
+from realhf_tpu.obs import tracing
 from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
 from realhf_tpu.serving.weight_sync import WeightSync
 
@@ -106,6 +108,14 @@ class ContinuousScheduler:
                           cancelled=0, swaps=0, fill_failed=0,
                           sequential_equiv_steps=0)
 
+    def _count(self, key: str, n: int = 1):
+        """Bump a scheduler counter AND its mirror in the process
+        metrics registry, so the worker health surface's Prometheus
+        export (``serving_<key>_total``) tracks the same numbers the
+        ``stats`` command reports."""
+        self.stats[key] += n
+        obs_metrics.inc(f"serving_{key}_total", n)
+
     # ------------------------------------------------------------------
     @property
     def n_live(self) -> int:
@@ -124,7 +134,7 @@ class ContinuousScheduler:
         for int_id, seq in list(self._active.items()):
             if seq.req.rid == rid:
                 self._evict(int_id)
-                self.stats["cancelled"] += 1
+                self._count("cancelled")
                 return True
         return False
 
@@ -142,7 +152,7 @@ class ContinuousScheduler:
         Returns the newly installed version or None."""
         swapped = self.weight_sync.poll(self.backend.swap_params)
         if swapped is not None:
-            self.stats["swaps"] += 1
+            self._count("swaps")
         return swapped
 
     # ------------------------------------------------------------------
@@ -161,11 +171,11 @@ class ContinuousScheduler:
             if (seq.req.deadline is not None
                     and seq.req.deadline <= now):
                 self._evict(int_id)
-                self.stats["expired"] += 1
+                self._count("expired")
                 events.append(ServeEvent("expired", seq.req.rid))
             elif self._is_stale(seq, version):
                 self._evict(int_id)
-                self.stats["stale"] += 1
+                self._count("stale")
                 events.append(ServeEvent("stale", seq.req.rid,
                                          self._stale_info(seq, version)))
 
@@ -186,7 +196,7 @@ class ContinuousScheduler:
                     logger.error("fill_slot failed for %s: %r",
                                  req.rid, e)
                     self.backend.release_slot(slot)
-                    self.stats["fill_failed"] += 1
+                    self._count("fill_failed")
                     events.append(ServeEvent(
                         "rejected", req.rid,
                         dict(reason="fill_failed", error=str(e),
@@ -195,15 +205,22 @@ class ContinuousScheduler:
                 self._active[int_id] = _ActiveSeq(
                     int_id, slot, req, version_start=version)
                 self._by_slot[slot] = int_id
-                self.stats["prefills"] += 1
+                self._count("prefills")
                 events.append(ServeEvent("started", req.rid,
                                          dict(weight_version=version)))
 
         # 4. one decode chunk over every live slot
         if self._active:
-            self.backend.decode_chunk(key)
-            self.stats["decode_chunks"] += 1
-            self.stats["decode_steps"] += self.backend.chunk
+            # the decode-chunk span is what makes continuous batching
+            # legible in the merged timeline: one span covers ALL live
+            # sequences, so a Perfetto lane shows chunk-interleaved
+            # serving instead of per-request decode walls
+            with tracing.span("serve:decode_chunk",
+                              n_live=len(self._active),
+                              weight_version=version):
+                self.backend.decode_chunk(key)
+            self._count("decode_chunks")
+            self._count("decode_steps", self.backend.chunk)
 
         # 5. harvest + streaming deltas
         for fs in self.backend.harvest():
@@ -211,14 +228,14 @@ class ContinuousScheduler:
             if seq is None:
                 continue  # evicted this very step
             self._by_slot.pop(seq.slot, None)
-            self.stats["tokens_out"] += len(fs.tokens)
-            self.stats["sequential_equiv_steps"] += len(fs.tokens)
+            self._count("tokens_out", len(fs.tokens))
+            self._count("sequential_equiv_steps", len(fs.tokens))
             if self._is_stale(seq, version):
-                self.stats["stale"] += 1
+                self._count("stale")
                 events.append(ServeEvent("stale", seq.req.rid,
                                          self._stale_info(seq, version)))
                 continue
-            self.stats["finished"] += 1
+            self._count("finished")
             out = FinishedRollout(
                 rid=seq.req.rid, tokens=fs.tokens, logprobs=fs.logprobs,
                 no_eos=fs.no_eos, weight_version=seq.version_start,

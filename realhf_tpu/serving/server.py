@@ -38,6 +38,7 @@ import numpy as np
 import zmq
 
 from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.obs import metrics, tracing
 from realhf_tpu.serving.request_queue import (
     AdmissionVerdict,
     GenRequest,
@@ -114,6 +115,11 @@ class RolloutServer:
         # not stall every thread contending for the table).
         self._routes: Dict[str, bytes] = {}
         self._routes_lock = threading.Lock()
+        # rid -> open request span (obs/tracing.py), parented to the
+        # context the client injected into its submit envelope;
+        # finished when the terminal event for the rid is delivered.
+        # Touched only from the serve-loop thread.
+        self._request_spans: Dict[str, tracing.Span] = {}
         import jax
         self._key = jax.random.PRNGKey(seed)
         self._draining = False
@@ -128,6 +134,10 @@ class RolloutServer:
         the scheduler, deliver events. Returns how many client
         messages were handled."""
         handled = self._pump_socket(poll_timeout)
+        metrics.set_gauge("serving_queue_depth", len(self.queue),
+                          server=self.server_name)
+        metrics.set_gauge("serving_live_slots", self.scheduler.n_live,
+                          server=self.server_name)
         if self.scheduler.n_live or len(self.queue):
             import jax
             self._key, sub = jax.random.split(self._key)
@@ -168,7 +178,12 @@ class RolloutServer:
     def _handle(self, ident: bytes, msg: tuple):
         kind = msg[0]
         if kind == "submit":
-            _, rid, prompt, priority, ttl, min_wv = msg
+            # 7th element (optional, newer clients): trace-context
+            # carrier injected by RolloutClient.submit -- the serving
+            # request span parents there, so the client's timeline and
+            # the server's line up in one merged trace
+            _, rid, prompt, priority, ttl, min_wv = msg[:6]
+            trace_ctx = msg[6] if len(msg) > 6 else None
             now = self._clock()
             if self._draining:
                 self._reply(ident, "rejected", rid,
@@ -184,9 +199,18 @@ class RolloutServer:
             if verdict.accepted:
                 with self._routes_lock:
                     self._routes[rid] = ident
+                if tracing.enabled():
+                    self._request_spans[rid] = tracing.start_span(
+                        "serve:request",
+                        parent=tracing.extract(trace_ctx),
+                        rid=rid, server=self.server_name,
+                        priority=int(priority),
+                        prompt_len=len(req.prompt))
                 self._reply(ident, "accepted", rid,
                             dict(queue_depth=len(self.queue)))
             else:
+                metrics.inc("serving_rejections_total",
+                            reason=verdict.reason or "unknown")
                 self._reply(ident, "rejected", rid,
                             dict(reason=verdict.reason,
                                  retry_after=verdict.retry_after))
@@ -236,6 +260,10 @@ class RolloutServer:
             # drop only AFTER the send succeeded (PR-2 semantics)
             with self._routes_lock:
                 self._routes.pop(rid, None)
+            sp = self._request_spans.pop(rid, None)
+            if sp is not None:
+                sp.set_attribute("outcome", kind)
+                sp.finish()
 
     def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
         payload = pickle.dumps((kind, rid, data))
@@ -332,9 +360,13 @@ class RolloutClient:
                min_weight_version: int = 0) -> str:
         rid = rid or uuid.uuid4().hex
         self._events.setdefault(rid, [])
+        # trailing trace-context carrier (None when tracing is off):
+        # the server parents its serve:request span there, stitching
+        # client and server into one timeline
         self._sock.send(pickle.dumps(
             ("submit", rid, np.asarray(prompt, np.int32),
-             int(priority), ttl, min_weight_version)))
+             int(priority), ttl, min_weight_version,
+             tracing.inject())))
         return rid
 
     def cancel(self, rid: str):

@@ -51,6 +51,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger("ckpt_manager")
 
@@ -159,6 +160,7 @@ class CheckpointWriter:
         this checkpoint exist as far as loads are concerned."""
         if self._done:
             raise RuntimeError("CheckpointWriter already committed/aborted")
+        t0 = time.monotonic()
         shards = self._collect_shards()
         for s in shards:
             local = s.name if self.host is None else \
@@ -191,6 +193,9 @@ class CheckpointWriter:
         _fsync_dir(self._mgr.root)
         self._done = True
         rec = CheckpointRecord(step=self.step, path=final)
+        obs_metrics.observe("ckpt_commit_secs",
+                            time.monotonic() - t0)
+        obs_metrics.inc("ckpt_commits_total")
         logger.info("Committed checkpoint step %d: %d shards, %.1f MB "
                     "at %s.", self.step, len(shards),
                     sum(s.size for s in shards) / 1e6, final)
@@ -247,6 +252,15 @@ class CheckpointManager:
     def verify(self, rec: CheckpointRecord) -> Tuple[bool, List[str]]:
         """Re-hash every shard against the manifest. Returns
         (ok, problems); problems name the offending shard paths."""
+        t0 = time.monotonic()
+        try:
+            return self._verify_timed(rec)
+        finally:
+            obs_metrics.observe("ckpt_verify_secs",
+                                time.monotonic() - t0)
+
+    def _verify_timed(self, rec: CheckpointRecord
+                      ) -> Tuple[bool, List[str]]:
         problems: List[str] = []
         if not rec.committed:
             return False, [f"{rec.path}: no {COMMIT_MARKER} marker"]

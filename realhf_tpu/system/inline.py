@@ -21,11 +21,13 @@ from typing import Dict
 
 import numpy as np
 
+from realhf_tpu import obs
 from realhf_tpu.api import data as data_api
 from realhf_tpu.api.config import ModelInterfaceType
 from realhf_tpu.api.dfg import DFG
 from realhf_tpu.api.experiment import ExperimentSpec
 from realhf_tpu.base import constants, logging, recover, seeding, timeutil
+from realhf_tpu.obs import metrics, tracing
 from realhf_tpu.system.model_host import ModelHost
 
 logger = logging.getLogger("InlineRunner", "benchmark")
@@ -37,6 +39,10 @@ class InlineRunner:
         self.spec = spec
         constants.set_experiment_trial_names(spec.experiment_name,
                                              spec.trial_name)
+        # REALHF_TPU_TRACE=1 gives the single-process runner the same
+        # span timeline the distributed runtime emits (one process)
+        obs.configure_from_env("inline", experiment=spec.experiment_name,
+                               trial=spec.trial_name)
         seeding.set_random_seed(spec.seed)
 
         # Recovery (reference recover_mode resume, base/recover.py +
@@ -212,9 +218,13 @@ class InlineRunner:
                     if batch is None:
                         continue
                 t0 = time.monotonic()
-                last_stats = self.run_step(batch)
+                with tracing.span("step", epoch=epoch, epoch_step=step,
+                                  global_step=self.global_step + 1):
+                    last_stats = self.run_step(batch)
                 dt = time.monotonic() - t0
                 self.global_step += 1
+                metrics.inc("master_steps_total")
+                metrics.observe("master_step_secs", dt)
                 token_key = next(
                     (k for k in ("packed_input_ids", "packed_prompts")
                      if k in batch.keys),
@@ -240,4 +250,10 @@ class InlineRunner:
             self._maybe_save(epochs=1)
             self._maybe_eval(epochs=1)
         self._maybe_save(force=True)
+        if tracing.enabled():
+            tracing.flush()
+            merged = tracing.merge_traces()
+            if merged:
+                logger.info("Chrome trace written: %s (open in "
+                            "Perfetto / chrome://tracing).", merged)
         return last_stats

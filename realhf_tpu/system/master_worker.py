@@ -31,6 +31,7 @@ from realhf_tpu.base import (
     timeutil,
 )
 from realhf_tpu.base.retry import RetryPolicy, retry_call
+from realhf_tpu.obs import flight, metrics, tracing
 from realhf_tpu.system import worker_base
 from realhf_tpu.system.buffer import SequenceBuffer
 from realhf_tpu.system.request_reply_stream import NameResolvingRequestClient
@@ -190,6 +191,11 @@ class MasterWorker(worker_base.Worker):
         self._complete = False
         self._step_t0 = None
         self._step_stats: Dict[str, Dict] = {}
+        # batch_id -> open step span (obs/tracing.py): the ancestor
+        # every dispatch/worker/serving span of that batch nests under
+        # in the merged Chrome trace. Opened on put_batch, finished
+        # when the batch completes (or the master exits).
+        self._step_spans: Dict[int, tracing.Span] = {}
         # batch_id -> highest batch whose train MFCs finished, per role
         self._train_done_upto: Dict[str, Dict[int, set]] = {
             role: {} for role in self.train_nodes_of_role}
@@ -273,6 +279,11 @@ class MasterWorker(worker_base.Worker):
         # a trial that is training fine on the degraded plan
         fatal = [w for w in fatal if self._still_needed(w)]
         if fatal:
+            # the WorkerLostError propagates to worker_base.run(),
+            # whose ERROR exit path dumps the master's flight ring --
+            # record the verdict context first so the dump names it
+            flight.record("worker_lost_fatal", workers=fatal,
+                          inflight=self._work_attributed_to(fatal))
             raise WorkerLostError(
                 fatal, inflight=self._work_attributed_to(fatal),
                 detail="Lost longer than worker_lost_fatal_secs="
@@ -334,6 +345,8 @@ class MasterWorker(worker_base.Worker):
         incarnation is still draining."""
         notice = self.watchdog.preempt_notice(worker)
         grace = notice[1] if notice else 0.0
+        metrics.inc("master_preempt_notices_total", worker=worker)
+        flight.record("preempt_notice", worker=worker, grace=grace)
         logger.warning(
             "Worker %s announced PREEMPTION (%.1fs grace): retiring "
             "it from dispatch%s.", worker, grace,
@@ -426,6 +439,9 @@ class MasterWorker(worker_base.Worker):
                 n = self._mfc_requeues.get((bid, mfc_name), 0) + 1
                 self._mfc_requeues[(bid, mfc_name)] = n
                 if n > self.ft.max_mfc_retries:
+                    flight.record("worker_lost_fatal", worker=worker,
+                                  mfc=mfc_name, batch_id=bid,
+                                  requeues=n - 1)
                     raise WorkerLostError(
                         worker, inflight=[f"{mfc_name}@batch{bid}"],
                         detail=f"MFC {mfc_name} (batch {bid}) already "
@@ -438,6 +454,9 @@ class MasterWorker(worker_base.Worker):
             elif kind == "fetch":
                 self._fetch_requeues += 1
                 if self._fetch_requeues > self.ft.max_mfc_retries:
+                    flight.record("worker_lost_fatal", worker=worker,
+                                  handle="fetch_data",
+                                  requeues=self._fetch_requeues - 1)
                     raise WorkerLostError(
                         worker, inflight=["fetch_data"],
                         detail="Data owner lost; fetch already "
@@ -504,6 +523,9 @@ class MasterWorker(worker_base.Worker):
                 self.cross_group_nodes.add(node.name)
             else:
                 self.cross_group_nodes.discard(node.name)
+            metrics.inc("elastic_degrade_total", node=node.name)
+            flight.record("elastic_degrade", node=node.name,
+                          lost_worker=worker, adopters=new_workers)
             logger.warning(
                 "DEGRADED %s: %s -> %s on layout %s (%s); installed "
                 "weight version %s. Training continues at reduced "
@@ -549,6 +571,8 @@ class MasterWorker(worker_base.Worker):
             self._retiring.discard(w)
             self._preempt_seen.discard(w)
             self._exclusions.forgive(w)
+            metrics.inc("elastic_rejoin_total", worker=w)
+            flight.record("elastic_rejoin", worker=w)
             logger.warning("Worker %s REJOINED; re-expanding.", w)
         if self.elastic is None:
             return
@@ -586,9 +610,19 @@ class MasterWorker(worker_base.Worker):
         if mfc_name in self.cross_group_nodes \
                 and node.role in self._role_version:
             payload["param_sync"] = self._attach_param_sync(node)
-        rids = self.stream.request(
-            workers, node.interface_type.value,
-            datas=[payload] * len(workers))
+        # the dispatch span parents to the batch's step span; its
+        # context rides in the payloads so worker-side MFC spans nest
+        # under it across the process boundary
+        step_span = self._step_spans.get(bid)
+        with tracing.span(
+                f"dispatch:{mfc_name}",
+                parent=step_span.context if step_span else None,
+                batch_id=bid, mfc=mfc_name, role=node.role,
+                workers=",".join(workers)) as sp:
+            rids = self.stream.request(
+                workers, node.interface_type.value,
+                datas=[payload] * len(workers),
+                trace_ctx=sp.context.to_dict() if sp.context else None)
         for w, rid in zip(workers, rids):
             self._inflight[rid] = (bid, mfc_name, w,
                                    "leader" if w == leader else "member")
@@ -647,8 +681,10 @@ class MasterWorker(worker_base.Worker):
                 self._done_fetching = True
         if data["empty"]:
             return
-        self.buffer.put_batch(data["meta"], self.data_owner, epoch,
-                              data["is_epoch_last"])
+        bid = self.buffer.put_batch(data["meta"], self.data_owner, epoch,
+                                    data["is_epoch_last"])
+        self._step_spans[bid] = tracing.start_span(
+            "step", batch_id=bid, epoch=epoch, worker=self.worker_name)
 
     def _on_mfc_reply(self, bid: int, mfc_name: str, data: Dict):
         node = self.dfg.find(mfc_name)
@@ -658,9 +694,15 @@ class MasterWorker(worker_base.Worker):
         if stats:
             self._step_stats.setdefault(mfc_name, {}).update(stats)
             if node.log_return_value:
-                logger.info("MFC %s (batch %d) stats: %s", mfc_name, bid,
-                            {k: round(v, 4) if isinstance(v, float) else v
-                             for k, v in stats.items()})
+                # structured JSONL through the metrics registry is the
+                # record of record; the human-readable line drops to
+                # DEBUG (docs/observability.md)
+                metrics.event("mfc_stats", mfc=mfc_name, batch_id=bid,
+                              role=node.role, stats=stats)
+                logger.debug(
+                    "MFC %s (batch %d) stats: %s", mfc_name, bid,
+                    {k: round(v, 4) if isinstance(v, float) else v
+                     for k, v in stats.items()})
         if node.interface_type == ModelInterfaceType.TRAIN_STEP:
             self._train_done_upto[node.role].setdefault(bid, set()).add(
                 mfc_name)
@@ -678,6 +720,12 @@ class MasterWorker(worker_base.Worker):
             dt = (time.monotonic() - self._step_t0
                   if self._step_t0 else 0.0)
             self._step_t0 = time.monotonic()
+            step_span = self._step_spans.pop(e.batch_id, None)
+            if step_span is not None:
+                step_span.set_attribute("global_step", self.global_step)
+                step_span.finish()
+            metrics.inc("master_steps_total")
+            metrics.observe("master_step_secs", dt)
             logger.info(
                 "Master: batch %d done (global step %d, epoch %d) "
                 "%.2fs since last; stats keys: %s", e.batch_id,
@@ -701,23 +749,37 @@ class MasterWorker(worker_base.Worker):
                 self._complete = True
 
     def _log_device_stats(self, bid: int):
-        """Per-MFC device stats table for a finished batch (reference
-        __log_gpu_stats all-gathered table, model_worker.py:999-1094)."""
+        """Per-MFC device stats for a finished batch (reference
+        __log_gpu_stats all-gathered table, model_worker.py:999-1094).
+        Structured JSONL through the metrics registry is the primary
+        emission (machine-diffable across runs); the human-readable
+        table is kept at DEBUG."""
         rows = [r for r in self._exec_log if r.get("bid") == bid]
         if not rows:
             return
-        lines = ["MFC device stats (batch %d):" % bid,
-                 f"  {'mfc':<16} {'worker':<18} {'secs':>8} "
-                 f"{'hbm_now':>10} {'proc_peak':>10}"]
         t0 = min(r["start"] for r in rows)
         for r in sorted(rows, key=lambda r: r["start"]):
-            lines.append(
-                f"  {r['mfc']:<16} {r['worker']:<18} "
-                f"{r['secs']:>8.3f} "
-                f"{r['hbm_bytes_in_use'] / 2 ** 30:>9.2f}G "
-                f"{r['proc_peak_hbm_bytes'] / 2 ** 30:>9.2f}G "
-                f"[{r['start'] - t0:+.3f}s..{r['end'] - t0:+.3f}s]")
-        logger.info("\n".join(lines))
+            metrics.event(
+                "mfc_device_stats", batch_id=bid, mfc=r["mfc"],
+                worker=r["worker"], secs=r["secs"],
+                hbm_bytes_in_use=r["hbm_bytes_in_use"],
+                proc_peak_hbm_bytes=r["proc_peak_hbm_bytes"],
+                rel_start=round(r["start"] - t0, 4),
+                rel_end=round(r["end"] - t0, 4))
+            metrics.observe("mfc_exec_secs", r["secs"], mfc=r["mfc"],
+                            worker=r["worker"])
+        if logger.isEnabledFor(10):  # DEBUG
+            lines = ["MFC device stats (batch %d):" % bid,
+                     f"  {'mfc':<16} {'worker':<18} {'secs':>8} "
+                     f"{'hbm_now':>10} {'proc_peak':>10}"]
+            for r in sorted(rows, key=lambda r: r["start"]):
+                lines.append(
+                    f"  {r['mfc']:<16} {r['worker']:<18} "
+                    f"{r['secs']:>8.3f} "
+                    f"{r['hbm_bytes_in_use'] / 2 ** 30:>9.2f}G "
+                    f"{r['proc_peak_hbm_bytes'] / 2 ** 30:>9.2f}G "
+                    f"[{r['start'] - t0:+.3f}s..{r['end'] - t0:+.3f}s]")
+            logger.debug("\n".join(lines))
         # Prune every ALREADY-LOGGED batch's rows (not `> bid`: with
         # off-policy overlap an EARLIER batch can still be live when a
         # later one finishes, advisor r3; not `!= bid` alone either:
@@ -908,8 +970,31 @@ class MasterWorker(worker_base.Worker):
                         global_step=self.global_step,
                         complete=self._complete,
                         exec_log=list(self._exec_history))
+        if cmd == "profiler":
+            # master control surface for jax.profiler: broadcast the
+            # start/stop to every active model worker (the master
+            # itself runs no device code worth profiling); replies
+            # drain through the ordinary poll loop
+            action = (kwargs or {}).get("action", "start")
+            targets = self._active_workers()
+            rids = self.stream.request(
+                targets, "profiler",
+                datas=[dict(kwargs or {}, action=action)] * len(targets))
+            for w, r in zip(targets, rids):
+                self._inflight[r] = (None, None, w, "profiler")
+            flight.record("profiler_broadcast", action=action,
+                          n_workers=len(targets))
+            return dict(action=action, requested=targets)
         return super()._handle_command(cmd, kwargs)
 
     def _exit_hook(self):
+        # close out still-open step spans so the merged trace shows
+        # the in-flight batches of an interrupted trial too
+        for sp in getattr(self, "_step_spans", {}).values():
+            sp.set_attribute("unfinished", True)
+            sp.finish()
+        if getattr(self, "_step_spans", None):
+            self._step_spans.clear()
+        tracing.flush()
         if getattr(self, "stream", None) is not None:
             self.stream.close()

@@ -548,16 +548,23 @@ class ModelHost:
         import time as _time
 
         from realhf_tpu.base import monitor
+        from realhf_tpu.obs import tracing
         t_start = _time.time()
-        with monitor.mfc_profile_region(node_name):
-            if node.interface_type == ModelInterfaceType.GENERATE:
-                out = itf.generate(model, inp, n_mbs=node.n_mbs)
-            elif node.interface_type == ModelInterfaceType.INFERENCE:
-                out = itf.inference(model, inp, n_mbs=node.n_mbs)
-            elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
-                out = itf.train_step(model, inp, n_mbs=node.n_mbs)
-            else:
-                raise NotImplementedError(node.interface_type)
+        # host-side span around the interface call (nests under the
+        # worker's mfc:* request span in the merged timeline); the
+        # TraceAnnotation inside mfc_profile_region covers the XLA view
+        with tracing.span(f"compute:{node_name}", mfc=node_name,
+                          role=node.role,
+                          kind=node.interface_type.value):
+            with monitor.mfc_profile_region(node_name):
+                if node.interface_type == ModelInterfaceType.GENERATE:
+                    out = itf.generate(model, inp, n_mbs=node.n_mbs)
+                elif node.interface_type == ModelInterfaceType.INFERENCE:
+                    out = itf.inference(model, inp, n_mbs=node.n_mbs)
+                elif node.interface_type == ModelInterfaceType.TRAIN_STEP:
+                    out = itf.train_step(model, inp, n_mbs=node.n_mbs)
+                else:
+                    raise NotImplementedError(node.interface_type)
         t_end = _time.time()
         # Per-MFC device stats (reference __log_gpu_stats,
         # model_worker.py:999-1094): wall span + HBM over this
@@ -656,8 +663,20 @@ class ModelHost:
         if len(named_inputs) == 1 or not parallel:
             return [self.execute(n, i) for n, i in named_inputs]
         from concurrent.futures import ThreadPoolExecutor
+
+        from realhf_tpu.obs import tracing
+
+        # pool threads have their own (empty) span stacks, so the
+        # caller's context is captured here and re-attached per MFC --
+        # the level's spans stay nested under the step span
+        ctx = tracing.current_context()
+
+        def run_one(n, i):
+            with tracing.span(f"mfc:{n}", parent=ctx, mfc=n):
+                return self.execute(n, i)
+
         with ThreadPoolExecutor(max_workers=len(named_inputs)) as ex:
-            futs = [ex.submit(self.execute, n, i) for n, i in named_inputs]
+            futs = [ex.submit(run_one, n, i) for n, i in named_inputs]
             return [f.result() for f in futs]
 
     # ------------------------------------------------------------------

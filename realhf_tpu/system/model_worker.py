@@ -32,6 +32,7 @@ from realhf_tpu.base import (
     seeding,
 )
 from realhf_tpu.base.fault_injection import FaultInjected, FaultInjector
+from realhf_tpu.obs import flight, metrics, tracing
 from realhf_tpu.system import worker_base
 from realhf_tpu.system.ckpt_manager import CheckpointManager
 from realhf_tpu.system.data_plane import DataClient, DataServer, DataStore
@@ -279,6 +280,7 @@ class ModelWorker(worker_base.Worker):
         ``run_save_path()/role`` symlink for external consumers.
         Returns {path, manifest} or None (save disabled)."""
         mgr = self._ckpt_manager(role)
+        t0 = time.monotonic()
         writer = mgr.begin(step, meta=dict(role=role, node=node_name,
                                            worker=self.worker_name))
         try:
@@ -292,6 +294,8 @@ class ModelWorker(worker_base.Worker):
         rec = writer.commit()
         mgr.gc()
         self._refresh_latest_link(role, rec.path)
+        metrics.observe("ckpt_save_secs", time.monotonic() - t0,
+                        role=role)
         return dict(path=rec.path, manifest=rec.manifest_path,
                     step=rec.step)
 
@@ -474,9 +478,16 @@ class ModelWorker(worker_base.Worker):
             # Cross-group parameter sync, receiver side: the primary's
             # group was dispatched a param_sync_send alongside this
             # request; fetch the streamed chunk set and install.
-            self._receive_param_sync(node_name, ps)
+            with tracing.span("realloc", mfc=node_name,
+                              role=node.role, worker=self.worker_name,
+                              weight_version=ps["version"]):
+                self._receive_param_sync(node_name, ps)
         keys = [k for k in node.input_keys]
-        inp = self._assemble_input(d["ids"], keys, d.get("fetch_plan", {}))
+        with tracing.span("data_fetch", mfc=node_name,
+                          worker=self.worker_name,
+                          n_ids=len(d["ids"]), n_keys=len(keys)):
+            inp = self._assemble_input(d["ids"], keys,
+                                       d.get("fetch_plan", {}))
         out = self.host.execute(node_name, inp)
         info = getattr(self.host, "last_exec_info", None)
         if info is not None and node_name in self.cross_group_nodes:
@@ -772,11 +783,19 @@ class ModelWorker(worker_base.Worker):
         if fault.kind == "die":
             # emulate a silent machine/process loss: no error reply,
             # no ERROR status, heartbeat just stops -- only the
-            # watchdog can notice
+            # watchdog can notice. The flight recorder still dumps:
+            # a real kernel panic leaves no trail, but an injected one
+            # should prove the postmortem pipeline end to end.
             logger.error("Fault injection: hard-exiting %s now.",
                          self.worker_name)
+            flight.record("fault", fault_kind="die",
+                          fault_id=fault.fault_id)
+            flight.dump(reason=f"injected die ({fault.fault_id})")
             os._exit(17)
         if fault.kind == "crash":
+            flight.record("fault", fault_kind="crash",
+                          fault_id=fault.fault_id,
+                          handle=req.handle_name)
             raise FaultInjected(
                 f"injected crash in {self.worker_name} handling "
                 f"{req.handle_name} ({fault.fault_id})")
@@ -797,43 +816,69 @@ class ModelWorker(worker_base.Worker):
 
     def _handle_request(self, req: Payload):
         handle = req.handle_name
+        node = (req.data or {}).get("node") \
+            if isinstance(req.data, dict) else None
+        flight.record("request", handle=handle, node=node,
+                      request_id=req.request_id)
+        metrics.inc("worker_requests_total", handle=handle)
+        # the master's dispatch span context rides in the payload;
+        # everything this request does (realloc, data fetch, compute)
+        # nests under this span in the merged timeline
+        ctx = tracing.extract(getattr(req, "trace", None))
         try:
-            if self._apply_fault(req):
-                # drop_reply: execute nothing and never respond --
-                # the master sees pure silence on this request id
-                logger.warning("Fault injection: dropping reply for "
-                               "%s (%s).", handle, req.request_id)
-                return
-            if handle == "fetch_data":
-                self._handle_fetch_data(req)
-            elif handle in ("generate", "inference", "train_step"):
-                self._handle_mfc(req)
-            elif handle == "param_sync_send":
-                self._handle_param_sync_send(req)
-            elif handle == "save":
-                self._handle_save(req)
-            elif handle == "evaluate":
-                self._handle_evaluate(req)
-            elif handle == "adopt_node":
-                self._handle_adopt_node(req)
-            elif handle == "adopt_data":
-                self._handle_adopt_data(req)
-            elif handle == "release_node":
-                self._handle_release_node(req)
-            elif handle == "clear_data_cache":
-                self.store.clear(req.data["ids"])
-                self.stream.respond(req, data="ok")
-            elif handle == "ping":
-                self.stream.respond(req, data="pong")
-            else:
-                raise ValueError(f"Unknown request {handle}")
+            with tracing.span(
+                    f"mfc:{node}" if node else f"rpc:{handle}",
+                    parent=ctx, handle=handle,
+                    worker=self.worker_name):
+                self._handle_request_inner(req, handle)
+            flight.record("reply", handle=handle, node=node,
+                          request_id=req.request_id)
         except Exception as e:  # noqa: BLE001 - report, then re-raise
             logger.error("ModelWorker %s failed handling %s: %s",
                          self.worker_name, handle, e, exc_info=True)
+            flight.record("error", handle=handle, node=node,
+                          error=repr(e))
             self.stream.reply(Payload(
                 handler=self.worker_name, handle_name="error",
                 request_id=req.request_id, data=repr(e)))
             raise
+
+    def _handle_request_inner(self, req: Payload, handle: str):
+        if self._apply_fault(req):
+            # drop_reply: execute nothing and never respond --
+            # the master sees pure silence on this request id
+            logger.warning("Fault injection: dropping reply for "
+                           "%s (%s).", handle, req.request_id)
+            return
+        if handle == "fetch_data":
+            self._handle_fetch_data(req)
+        elif handle in ("generate", "inference", "train_step"):
+            self._handle_mfc(req)
+        elif handle == "param_sync_send":
+            self._handle_param_sync_send(req)
+        elif handle == "save":
+            self._handle_save(req)
+        elif handle == "evaluate":
+            self._handle_evaluate(req)
+        elif handle == "adopt_node":
+            self._handle_adopt_node(req)
+        elif handle == "adopt_data":
+            self._handle_adopt_data(req)
+        elif handle == "release_node":
+            self._handle_release_node(req)
+        elif handle == "clear_data_cache":
+            self.store.clear(req.data["ids"])
+            self.stream.respond(req, data="ok")
+        elif handle == "profiler":
+            # master-broadcast jax.profiler toggle (worker_base owns
+            # the actual start/stop; same code path as the direct
+            # worker command)
+            self.stream.respond(
+                req, data=self._handle_profiler(**(req.data or {})))
+        elif handle == "ping":
+            self.stream.respond(req, data="pong")
+        else:
+            raise ValueError(f"Unknown request {handle}")
 
     def _exit_hook(self):
         if getattr(self, "data_server", None) is not None:

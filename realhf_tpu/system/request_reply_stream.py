@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 import zmq
 
 from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.obs import tracing
 
 logger = logging.getLogger("request_reply_stream")
 
@@ -57,6 +58,11 @@ class Payload:
     ack_reply_id: str = ""
     no_syn: bool = True        # skip the syn-ack handshake
     data: Any = None           # pickled metadata (SequenceSample.meta() etc.)
+    # trace-context carrier (obs/tracing.py): {trace_id, span_id} of
+    # the sender-side span this request causally descends from; the
+    # receiving worker parents its spans there so one PPO step renders
+    # as a single cross-process timeline. None when tracing is off.
+    trace: Optional[Dict] = None
     # pre/post hook descriptors (param_realloc / offload / data_transfer)
     pre_hooks: List[Any] = dataclasses.field(default_factory=list)
     post_hooks: List[Any] = dataclasses.field(default_factory=list)
@@ -137,15 +143,22 @@ class NameResolvingRequestClient:
     def request(self, handlers: List[str], handle_name: str,
                 datas: Optional[List[Any]] = None,
                 no_syn: bool = True,
-                syn_timeout: float = 300.0) -> List[str]:
+                syn_timeout: float = 300.0,
+                trace_ctx: Optional[Dict] = None) -> List[str]:
         """Send one request to several workers; with syn-ack, all
         workers hold until everyone acked (reference
         master_worker.py:438-451). Raises TimeoutError naming the
-        workers whose syn never arrived."""
+        workers whose syn never arrived.
+
+        ``trace_ctx`` overrides the propagated span context; by
+        default the caller thread's current span (if tracing is on)
+        rides along so worker-side spans nest under it."""
         datas = datas or [None] * len(handlers)
+        if trace_ctx is None:
+            trace_ctx = tracing.inject()
         payloads = [
             Payload(handler=h, handle_name=handle_name, data=d,
-                    no_syn=no_syn,
+                    no_syn=no_syn, trace=trace_ctx,
                     syn_reply_id=uuid.uuid4().hex if not no_syn else "")
             for h, d in zip(handlers, datas)
         ]

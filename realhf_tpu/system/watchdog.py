@@ -24,6 +24,7 @@ import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from realhf_tpu.base import logging, name_resolve, names
+from realhf_tpu.obs import flight, metrics
 from realhf_tpu.system.worker_base import WorkerServerStatus
 
 logger = logging.getLogger("watchdog")
@@ -128,6 +129,8 @@ class Watchdog:
             if v == LOST:
                 if w not in self._lost_since:
                     self._lost_since[w] = now
+                    metrics.inc("watchdog_lost_total", worker=w)
+                    flight.record("worker_lost", worker=w)
                     logger.error(
                         "Worker %s LOST: no heartbeat for > %.1fs "
                         "(last beat %s).", w, self.timeout,
@@ -135,7 +138,14 @@ class Watchdog:
                         if w in self._ever_beat else "never seen")
             elif w in self._lost_since:
                 del self._lost_since[w]
+                metrics.inc("watchdog_flap_recovered_total", worker=w)
                 logger.warning("Worker %s heartbeat returned (flap).", w)
+        counts = {v: 0 for v in (ALIVE, PENDING, LOST, DONE)}
+        for v in out.values():
+            counts[v] += 1
+        for verdict, n in counts.items():
+            metrics.set_gauge("watchdog_workers", n,
+                              state=verdict.lower())
         return out
 
     def poll(self) -> List[str]:

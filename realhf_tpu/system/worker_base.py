@@ -18,7 +18,9 @@ from typing import Any, Dict, List, Optional
 
 import zmq
 
+from realhf_tpu import obs
 from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.obs import flight, metrics, tracing
 
 logger = logging.getLogger("worker_base")
 
@@ -239,6 +241,11 @@ class Worker:
     def __init__(self, experiment_name: str, trial_name: str,
                  worker_name: str):
         self.worker_name = worker_name
+        # observability (realhf_tpu/obs/): label this process's
+        # tracer/metrics/flight recorder; REALHF_TPU_TRACE=1 turns on
+        # span export for every worker type uniformly
+        obs.configure_from_env(worker_name, experiment=experiment_name,
+                               trial=trial_name)
         self.server = WorkerServer(experiment_name, trial_name, worker_name)
         self._running = False
         self._exiting = False
@@ -292,6 +299,11 @@ class Worker:
             self.worker_name, reason, grace)
         self.server.publish_preempt_notice(grace)
         self.server.set_status(WorkerServerStatus.PREEMPTED)
+        # postmortem trail: record AND dump now -- the process may be
+        # SIGKILLed before the grace window closes
+        flight.record("preempted", reason=reason, grace=grace)
+        metrics.inc("worker_preempted_total")
+        flight.dump(reason=f"preempted ({reason})")
 
     def _install_signal_handlers(self):
         """SIGUSR1 always means preemption notice; SIGTERM only when
@@ -356,7 +368,43 @@ class Worker:
             self.notice_preemption(grace=(kwargs or {}).get("grace"),
                                    reason="command")
             return "ok"
+        if cmd == "metrics":
+            # the worker health surface's metrics export
+            # (docs/observability.md): Prometheus text + raw snapshot
+            return dict(
+                prometheus=metrics.to_prometheus(),
+                snapshot=metrics.snapshot(),
+                flight_events=len(flight.default_recorder()))
+        if cmd == "profiler":
+            # jax.profiler start/stop on THIS process (the master
+            # overrides this to broadcast to its model workers)
+            return self._handle_profiler(**(kwargs or {}))
         raise ValueError(f"Unknown worker command {cmd}")
+
+    def _handle_profiler(self, action: str = "start",
+                         path: Optional[str] = None) -> Dict:
+        """Toggle a jax.profiler trace in this process; dumps land in
+        ``{run_log_path}/trace/jax`` (TensorBoard/Perfetto-readable)
+        unless ``path`` overrides."""
+        import jax
+
+        from realhf_tpu.base import monitor
+        if action == "start":
+            target = path or monitor.trace_dir("jax")
+            try:
+                jax.profiler.start_trace(target)
+            except RuntimeError as e:  # already running
+                return dict(ok=False, error=str(e))
+            flight.record("profiler_start", path=target)
+            return dict(ok=True, path=target)
+        if action == "stop":
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:  # not running
+                return dict(ok=False, error=str(e))
+            flight.record("profiler_stop")
+            return dict(ok=True)
+        raise ValueError(f"Unknown profiler action {action!r}")
 
     def run(self):
         logger.info("Worker %s starting poll loop.", self.worker_name)
@@ -378,15 +426,24 @@ class Worker:
                     break
                 if self._running:
                     self._poll()
+                # periodic observability housekeeping: metrics JSONL
+                # snapshot + span-buffer flush (both cheap no-ops when
+                # no sink/trace file is configured)
+                metrics.maybe_flush()
+                tracing.flush()
             self._exit_hook()
+            tracing.flush()
             self.server.stop_heartbeat()
             self.server.set_status(
                 WorkerServerStatus.PREEMPTED if self.preempted
                 else WorkerServerStatus.COMPLETED)
-        except Exception:
+        except Exception as e:
             # terminal status (not the beacon) is the liveness signal
             # from here on; the watchdog treats ERROR/COMPLETED as
-            # "accounted for", never LOST
+            # "accounted for", never LOST. The flight recorder dumps
+            # FIRST: the ring of recent events is the postmortem.
+            flight.dump(reason=f"worker ERROR exit: {e!r}")
+            tracing.flush()
             self.server.stop_heartbeat()
             self.server.set_status(WorkerServerStatus.ERROR)
             raise

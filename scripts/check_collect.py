@@ -35,6 +35,7 @@ REQUIRED_DIRS = (
     "tests/analysis",
     "tests/base",
     "tests/engine",
+    "tests/observability",
     "tests/recovery",
     "tests/serving",
     "tests/system",

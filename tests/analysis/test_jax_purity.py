@@ -169,3 +169,55 @@ def stream(leaves):
     raw = JaxPurityChecker().check(m)
     assert [f.code for f in raw] == ["purity-sync-in-loop"]
     assert m.suppressions.filter(raw) == []
+
+
+def test_obs_call_in_jitted_fn_flags(make_module, codes_of):
+    """Spans/metrics inside traced code execute once at trace time
+    and record garbage (purity-obs-in-trace)."""
+    fs = check(make_module, """
+        import jax
+        from realhf_tpu.obs import metrics, tracing
+
+        @jax.jit
+        def step(x):
+            metrics.inc("steps_total")
+            with tracing.span("compute"):
+                return x * 2
+    """)
+    assert codes_of(fs) == ["purity-obs-in-trace",
+                            "purity-obs-in-trace"]
+    assert all(f.symbol == "step" for f in fs)
+
+
+def test_obs_call_in_scan_body_flags(make_module, codes_of):
+    fs = check(make_module, """
+        import jax
+        from realhf_tpu.obs import flight
+
+        def outer(xs):
+            def body(c, x):
+                flight.record("decode", step=1)
+                return c + x, x
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+    assert "purity-obs-in-trace" in codes_of(fs)
+
+
+def test_obs_call_on_host_is_clean(make_module):
+    """Instrumenting AROUND the jitted call is the supported pattern
+    (model_host / scheduler do exactly this)."""
+    fs = check(make_module, """
+        import jax
+        from realhf_tpu.obs import metrics, tracing
+
+        @jax.jit
+        def _kernel(x):
+            return x * 2
+
+        def run(x):
+            with tracing.span("compute"):
+                out = _kernel(x)
+            metrics.inc("runs_total")
+            return out
+    """)
+    assert fs == []

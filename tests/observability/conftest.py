@@ -1,0 +1,19 @@
+"""Observability suite fixtures: every test gets FRESH process-default
+tracer / metrics registry / flight recorder singletons, so span
+buffers and counters never leak between tests (the obs layer is
+process-global by design)."""
+
+import pytest
+
+from realhf_tpu.obs import flight, metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_defaults():
+    tracing.reset_default()
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+    tracing.reset_default()
+    metrics.reset_default()
+    flight.reset_default()

@@ -1,0 +1,153 @@
+"""Metrics registry: the four metric types, label handling, Prometheus
+text rendering, snapshots, and the JSONL sink."""
+
+import json
+
+import pytest
+
+from realhf_tpu.obs import metrics
+from realhf_tpu.obs.metrics import Accum, MetricsRegistry
+
+
+# ----------------------------------------------------------------------
+# Accum
+# ----------------------------------------------------------------------
+def test_accum_count_min_max_mean():
+    a = Accum()
+    for v in (3.0, -1.0, 10.0):
+        a.add(v)
+    assert a.as_dict() == dict(count=3, sum=12.0, min=-1.0, max=10.0,
+                               mean=4.0)
+    assert Accum().as_dict()["count"] == 0  # empty: all-zero, no inf
+
+
+# ----------------------------------------------------------------------
+# counters / gauges
+# ----------------------------------------------------------------------
+def test_counter_labels_and_values():
+    r = MetricsRegistry()
+    r.inc("requests_total", handle="train_step")
+    r.inc("requests_total", 2, handle="train_step")
+    r.inc("requests_total", handle="generate")
+    c = r.counter("requests_total")
+    assert c.value(handle="train_step") == 3
+    assert c.value(handle="generate") == 1
+    assert c.value(handle="missing") == 0
+
+
+def test_gauge_set_and_inc():
+    r = MetricsRegistry()
+    r.set_gauge("queue_depth", 7, server="s0")
+    r.set_gauge("queue_depth", 4, server="s0")  # last write wins
+    g = r.gauge("queue_depth")
+    g.inc(2, server="s0")
+    assert g.value(server="s0") == 6
+
+
+def test_metric_type_conflict_raises():
+    r = MetricsRegistry()
+    r.counter("x_total")
+    with pytest.raises(TypeError):
+        r.gauge("x_total")
+
+
+# ----------------------------------------------------------------------
+# summary / histogram
+# ----------------------------------------------------------------------
+def test_summary_accumulates_per_label_set():
+    r = MetricsRegistry()
+    for v in (0.1, 0.3):
+        r.observe("exec_secs", v, mfc="actor_gen")
+    r.observe("exec_secs", 5.0, mfc="actor_train")
+    s = r.summary("exec_secs")
+    a = s.accum(mfc="actor_gen")
+    assert a.count == 2 and a.min == 0.1 and a.max == 0.3
+    assert s.accum(mfc="missing").count == 0
+
+
+def test_histogram_buckets_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = "\n".join(h.prometheus_lines())
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="10"} 4' in text
+    assert 'lat_bucket{le="+Inf"} 5' in text
+    assert "lat_count 5" in text
+    assert "lat_sum 56.05" in text
+
+
+# ----------------------------------------------------------------------
+# Prometheus text exposition
+# ----------------------------------------------------------------------
+def test_prometheus_text_format():
+    r = MetricsRegistry("w0")
+    r.counter("reqs_total", help="requests").inc(3, handle="save")
+    r.set_gauge("depth", 2)
+    text = r.to_prometheus()
+    assert "# HELP reqs_total requests" in text
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{handle="save"} 3' in text
+    assert "# TYPE depth gauge" in text
+    assert "depth 2" in text.splitlines()
+    assert text.endswith("\n")
+
+
+def test_prometheus_summary_lines():
+    r = MetricsRegistry()
+    r.observe("secs", 1.0, role="actor")
+    r.observe("secs", 3.0, role="actor")
+    text = r.to_prometheus()
+    assert 'secs_count{role="actor"} 2' in text
+    assert 'secs_sum{role="actor"} 4' in text
+    assert 'secs_min{role="actor"} 1' in text
+    assert 'secs_max{role="actor"} 3' in text
+
+
+# ----------------------------------------------------------------------
+# snapshot + JSONL sink
+# ----------------------------------------------------------------------
+def test_snapshot_structure():
+    r = MetricsRegistry()
+    r.inc("a_total")
+    r.observe("b_secs", 2.0, mfc="x")
+    snap = r.snapshot()
+    assert snap["a_total"]["type"] == "counter"
+    assert snap["a_total"]["values"][""] == 1
+    key = json.dumps({"mfc": "x"})
+    assert snap["b_secs"]["values"][key]["mean"] == 2.0
+
+
+def test_event_and_periodic_jsonl(tmp_path):
+    path = str(tmp_path / "m" / "w.metrics.jsonl")
+    r = MetricsRegistry("w0")
+    r.attach_jsonl(path, interval=10.0)
+    rec = r.event("mfc_stats", mfc="actor_gen", batch_id=1,
+                  stats={"loss": 0.5})
+    assert rec["event"] == "mfc_stats" and rec["process"] == "w0"
+    r.inc("steps_total")
+    r.maybe_flush(now=0.0)      # interval not elapsed: no snapshot
+    r._last_snapshot = -100.0
+    r.maybe_flush(now=0.0)      # elapsed: snapshot line lands
+    lines = [json.loads(x) for x in open(path)]
+    kinds = [x["kind"] for x in lines]
+    assert kinds == ["event", "snapshot"]
+    assert lines[1]["metrics"]["steps_total"]["values"][""] == 1
+
+
+def test_event_without_sink_still_returns_record():
+    r = MetricsRegistry("p")
+    rec = r.event("elastic_degrade", node="actor_train")
+    assert rec["node"] == "actor_train"
+
+
+def test_module_default_convenience_and_reset():
+    metrics.inc("x_total", 2)
+    metrics.observe("y_secs", 1.5)
+    metrics.set_gauge("z", 9)
+    text = metrics.to_prometheus()
+    assert "x_total 2" in text and "z 9" in text
+    metrics.reset_default()
+    assert metrics.to_prometheus() == ""

@@ -1,0 +1,257 @@
+"""Cross-process trace-context propagation (ISSUE 5 satellite): a span
+opened in the master is the ancestor of spans recorded in a model
+worker over ``request_reply_stream``, and of serving spans over the
+ZMQ ROUTER/DEALER path. Processes are emulated with separate Tracer
+instances (pid derives from the process NAME, so the merged Chrome
+trace keeps one lane per 'process' even in-process)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from realhf_tpu.obs import metrics, tracing
+from realhf_tpu.obs.tracing import Tracer
+
+
+# ----------------------------------------------------------------------
+# request_reply_stream: master -> model worker
+# ----------------------------------------------------------------------
+@pytest.fixture
+def stream_pair():
+    from realhf_tpu.system.request_reply_stream import (
+        NameResolvingReplyServer,
+        NameResolvingRequestClient,
+    )
+
+    exp, trial = "obsprop", "t0"
+    master = NameResolvingRequestClient(exp, trial)
+    worker = NameResolvingReplyServer(exp, trial, "mw/0")
+    # SUB connection is asynchronous: ping until the subscription is
+    # live, then drain the queued pings.
+    for _ in range(200):
+        master.request(["mw/0"], "ping")
+        try:
+            worker.poll(timeout=0.05)
+            break
+        except TimeoutError:
+            continue
+    else:
+        pytest.fail("subscription never became live")
+    try:
+        while True:
+            worker.poll(timeout=0.2)
+    except TimeoutError:
+        pass
+    yield master, worker
+    worker.close()
+    master.close()
+
+
+def test_master_span_is_ancestor_over_request_reply(stream_pair):
+    master, worker = stream_pair
+    # master process: the default tracer (what stream.request injects)
+    tracing.configure(process_name="master", enabled=True)
+    worker_tracer = Tracer("model_worker/0", enabled=True)
+
+    with tracing.span("step", batch_id=7) as step:
+        with tracing.span("dispatch:actor_gen") as dispatch:
+            master.request(["mw/0"], "generate",
+                           datas=[{"node": "actor_gen"}])
+
+    req = worker.poll(timeout=5)
+    assert req.trace == dispatch.context.to_dict()
+    # worker side: parent the MFC span on the extracted context, the
+    # compute span nests inside it (model_worker._handle_request)
+    ctx = tracing.extract(req.trace)
+    with worker_tracer.span("mfc:actor_gen", parent=ctx) as mfc:
+        with worker_tracer.span("compute:actor_gen") as comp:
+            pass
+
+    assert mfc.trace_id == step.trace_id == comp.trace_id
+    assert mfc.parent_id == dispatch.span_id
+    assert dispatch.parent_id == step.span_id
+    assert comp.parent_id == mfc.span_id
+
+
+def test_explicit_trace_ctx_overrides_injection(stream_pair):
+    master, worker = stream_pair
+    tracing.configure(process_name="master", enabled=True)
+    ctx = {"trace_id": "t" * 16, "span_id": "s" * 16}
+    with tracing.span("unrelated"):
+        master.request(["mw/0"], "save", trace_ctx=ctx)
+    req = worker.poll(timeout=5)
+    assert req.trace == ctx
+
+
+def test_no_trace_rides_when_tracing_off(stream_pair):
+    master, worker = stream_pair
+    assert not tracing.enabled()
+    master.request(["mw/0"], "ping")
+    assert worker.poll(timeout=5).trace is None
+
+
+def test_merged_trace_has_one_lane_per_process(stream_pair, tmp_path):
+    """The acceptance shape in tier-1 form: master + worker tracers
+    flush to per-process files; the merged Chrome trace shows >= 2
+    pids with the worker span parented under the master's."""
+    master, worker = stream_pair
+    d = str(tmp_path / "trace")
+    tracing.configure(process_name="master", enabled=True,
+                      path=f"{d}/master.trace.jsonl")
+    worker_tracer = Tracer("model_worker/0", enabled=True,
+                           path=f"{d}/model_worker-0.trace.jsonl")
+
+    with tracing.span("step", batch_id=0):
+        with tracing.span("dispatch:actor_train"):
+            master.request(["mw/0"], "train_step",
+                           datas=[{"node": "actor_train"}])
+    req = worker.poll(timeout=5)
+    with worker_tracer.span("mfc:actor_train",
+                            parent=tracing.extract(req.trace)):
+        with worker_tracer.span("realloc"):
+            pass
+        with worker_tracer.span("data_fetch"):
+            pass
+        with worker_tracer.span("compute:actor_train"):
+            pass
+    tracing.flush()
+    worker_tracer.flush()
+
+    merged = tracing.merge_traces(directory=d)
+    events = json.load(open(merged))["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in spans}
+    assert len({e["pid"] for e in spans}) == 2
+    assert {"step", "dispatch:actor_train", "mfc:actor_train",
+            "realloc", "data_fetch",
+            "compute:actor_train"} <= set(by_name)
+    # one trace id across both processes; worker nests under dispatch
+    assert len({e["args"]["trace_id"] for e in spans}) == 1
+    assert (by_name["mfc:actor_train"]["args"]["parent_id"]
+            == by_name["dispatch:actor_train"]["args"]["span_id"])
+    assert (by_name["compute:actor_train"]["args"]["parent_id"]
+            == by_name["mfc:actor_train"]["args"]["span_id"])
+
+
+# ----------------------------------------------------------------------
+# serving ZMQ ROUTER/DEALER path
+# ----------------------------------------------------------------------
+class FakeBackend:
+    """prompt[0] = tokens the sequence needs; each decode_chunk
+    advances every live slot by up to ``chunk``."""
+
+    def __init__(self, n_slots=2, chunk=4):
+        self.n_slots = n_slots
+        self.chunk = chunk
+        self.params = "v0"
+        self._slots = {}
+
+    def free_slots(self):
+        return [s for s in range(self.n_slots) if s not in self._slots]
+
+    def fill_slot(self, slot, int_id, prompt):
+        self._slots[slot] = [int_id, int(prompt[0]), 0]
+
+    def decode_chunk(self, key):
+        for v in self._slots.values():
+            v[2] = min(v[1], v[2] + self.chunk)
+
+    def harvest(self):
+        from realhf_tpu.engine.inflight import FinishedSequence
+        out = []
+        for slot, (i, need, got) in list(self._slots.items()):
+            if got >= need:
+                out.append(FinishedSequence(
+                    request_id=i, tokens=np.arange(got),
+                    logprobs=np.zeros(got), no_eos=True))
+                del self._slots[slot]
+        return out
+
+    def release_slot(self, slot):
+        self._slots.pop(slot, None)
+
+    def swap_params(self, p):
+        self.params = p
+
+    def snapshot_slot(self, slot):
+        _, _, got = self._slots[slot]
+        return np.arange(got), np.zeros(got)
+
+    @property
+    def n_live(self):
+        return len(self._slots)
+
+
+def test_client_span_is_ancestor_over_serving_zmq():
+    from realhf_tpu.serving.server import (
+        TERMINAL_KINDS,
+        RolloutClient,
+        RolloutServer,
+    )
+
+    tracing.configure(process_name="serve_test", enabled=True)
+    server = RolloutServer(FakeBackend(), server_name="obs/0")
+    client = RolloutClient(server.address)
+    try:
+        with tracing.span("client:rollout") as root:
+            rid = client.submit(np.array([6, 1, 2], np.int32))
+        for _ in range(200):
+            server.serve_step(poll_timeout=0.02)
+            try:
+                kind, _ = client.next_event(rid, timeout=0.02)
+            except TimeoutError:
+                continue
+            if kind in TERMINAL_KINDS:
+                assert kind == "done"
+                break
+        else:
+            pytest.fail("request never finished")
+
+        spans = {s.name: s for s in tracing.default_tracer().drain()}
+        req_span = spans["serve:request"]
+        assert req_span.trace_id == root.trace_id
+        assert req_span.parent_id == root.span_id
+        assert req_span.attributes["rid"] == rid
+        assert req_span.attributes["outcome"] == "done"
+        # decode chunks traced too (one span covers all live slots)
+        assert "serve:decode_chunk" in spans
+    finally:
+        client.close()
+        server.close()
+
+
+def test_serving_counters_reach_prometheus_export():
+    """Acceptance: the Prometheus text export includes serving
+    queue-depth and scheduler decode counters."""
+    from realhf_tpu.serving.server import (
+        TERMINAL_KINDS,
+        RolloutClient,
+        RolloutServer,
+    )
+
+    server = RolloutServer(FakeBackend(), server_name="obs/1")
+    client = RolloutClient(server.address)
+    try:
+        rid = client.submit(np.array([6, 1, 2], np.int32))
+        for _ in range(200):
+            server.serve_step(poll_timeout=0.02)
+            try:
+                kind, _ = client.next_event(rid, timeout=0.02)
+            except TimeoutError:
+                continue
+            if kind in TERMINAL_KINDS:
+                break
+        text = metrics.to_prometheus()
+        assert 'serving_queue_depth{server="obs/1"}' in text
+        assert "serving_decode_chunks_total" in text
+        assert "serving_decode_steps_total" in text
+        assert "serving_prefills_total" in text
+        assert "serving_finished_total" in text
+        # the scheduler's own dict and the registry mirror agree
+        c = metrics.default_registry().counter(
+            "serving_decode_chunks_total")
+        assert c.value() == server.scheduler.stats["decode_chunks"]
+    finally:
+        client.close()
+        server.close()

@@ -1,0 +1,49 @@
+"""base/stats.py after absorption by the obs layer: per-key
+count/min/max/mean export and no value loss under a concurrent
+clearing export (ISSUE 5 satellite)."""
+
+import threading
+
+from realhf_tpu.base.stats import StatsTracker
+
+
+def test_export_reports_full_accumulation():
+    t = StatsTracker()
+    t.record(aux_loss=1.0)
+    t.record(aux_loss=3.0, z_loss=0.5)
+    out = t.export()
+    assert out["aux_loss"] == dict(count=2, sum=4.0, min=1.0, max=3.0,
+                                   mean=2.0)
+    assert out["z_loss"]["count"] == 1
+    assert t.export() == {}  # cleared
+
+
+def test_export_no_clear_keeps_values():
+    t = StatsTracker()
+    t.record(a=2.0)
+    snap = t.export(clear=False)
+    assert snap["a"]["mean"] == 2.0
+    snap["a"]["mean"] = 999  # a COPY: mutating it must not leak back
+    assert t.export()["a"]["mean"] == 2.0
+
+
+def test_concurrent_records_never_dropped_by_clearing_export():
+    """Every recorded value lands in exactly one export: a record
+    racing the clear either makes this export or the next one."""
+    t = StatsTracker()
+    total = 5000
+    done = threading.Event()
+
+    def producer():
+        for _ in range(total):
+            t.record(v=1.0)
+        done.set()
+
+    counted = 0
+    th = threading.Thread(target=producer)
+    th.start()
+    while not done.is_set():
+        counted += t.export().get("v", {}).get("count", 0)
+    th.join()
+    counted += t.export().get("v", {}).get("count", 0)
+    assert counted == total

@@ -1,0 +1,219 @@
+"""Span tracer: nesting, ids, noop-off mode, thread buffers, Chrome
+export, file flush + multi-process merge."""
+
+import json
+import threading
+
+from realhf_tpu.obs import tracing
+from realhf_tpu.obs.tracing import SpanContext, Tracer
+
+
+# ----------------------------------------------------------------------
+# off-by-default noop
+# ----------------------------------------------------------------------
+def test_disabled_tracer_is_noop():
+    t = Tracer("p")
+    assert not t.enabled
+    with t.span("work") as sp:
+        sp.set_attribute("k", 1)  # must not raise
+        assert t.inject() is None
+    assert t.start_span("x") is tracing.NOOP_SPAN
+    assert t.drain() == []
+
+
+def test_module_default_off_by_default():
+    with tracing.span("anything"):
+        assert tracing.inject() is None
+    assert tracing.default_tracer().drain() == []
+
+
+# ----------------------------------------------------------------------
+# nesting + ids
+# ----------------------------------------------------------------------
+def test_nested_spans_share_trace_and_parent():
+    t = Tracer("p", enabled=True)
+    with t.span("outer") as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert t.current_span() is inner
+        assert t.current_span() is outer
+    assert t.current_span() is None
+    names = {s.name: s for s in t.drain()}
+    assert set(names) == {"outer", "inner"}
+    assert names["inner"].end >= names["inner"].start
+
+
+def test_start_span_explicit_lifetime_parents_to_current():
+    t = Tracer("p", enabled=True)
+    with t.span("request") as req:
+        long_lived = t.start_span("background", rid="r1")
+    # NOT on the stack: finishing the scoped span leaves it open
+    assert {s.name for s in t.drain()} == {"request"}
+    assert long_lived.parent_id == req.span_id
+    long_lived.finish()
+    assert [s.name for s in t.drain()] == ["background"]
+    assert long_lived.attributes["rid"] == "r1"
+
+
+def test_exception_recorded_as_error_attribute():
+    t = Tracer("p", enabled=True)
+    try:
+        with t.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    (sp,) = t.drain()
+    assert "ValueError" in sp.attributes["error"]
+
+
+# ----------------------------------------------------------------------
+# context propagation carrier
+# ----------------------------------------------------------------------
+def test_inject_extract_roundtrip():
+    t = Tracer("p", enabled=True)
+    with t.span("root"):
+        carrier = t.inject()
+    ctx = Tracer.extract(carrier)
+    assert isinstance(ctx, SpanContext)
+    assert carrier == ctx.to_dict()
+    assert Tracer.extract(None) is None
+    assert Tracer.extract({"trace_id": "x"}) is None  # malformed
+
+
+def test_extracted_context_parents_remote_span():
+    master = Tracer("master", enabled=True)
+    worker = Tracer("model_worker/0", enabled=True)
+    with master.span("dispatch") as d:
+        carrier = master.inject()
+    with worker.span("mfc", parent=Tracer.extract(carrier)) as w:
+        assert w.trace_id == d.trace_id
+        assert w.parent_id == d.span_id
+
+
+# ----------------------------------------------------------------------
+# per-thread buffers
+# ----------------------------------------------------------------------
+def test_spans_from_many_threads_all_drain():
+    t = Tracer("p", enabled=True)
+    n_threads, per = 8, 50
+
+    def work():
+        for i in range(per):
+            with t.span(f"s{i}"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(t.drain()) == n_threads * per
+    assert t.drain() == []  # drained
+
+
+def test_drain_while_recording_never_loses_spans():
+    t = Tracer("p", enabled=True)
+    total = 2000
+    got = []
+    done = threading.Event()
+
+    def producer():
+        for _ in range(total):
+            with t.span("s"):
+                pass
+        done.set()
+
+    th = threading.Thread(target=producer)
+    th.start()
+    while not done.is_set():
+        got.extend(t.drain())
+    th.join()
+    got.extend(t.drain())
+    assert len(got) == total
+
+
+# ----------------------------------------------------------------------
+# chrome export + merge
+# ----------------------------------------------------------------------
+def test_chrome_events_shape_and_stable_pid():
+    t = Tracer("model_worker/0", enabled=True)
+    with t.span("step", batch_id=3):
+        pass
+    events = t.to_events(t.drain())
+    meta, ev = events[0], events[1]
+    assert meta["ph"] == "M" and meta["args"]["name"] == "model_worker/0"
+    assert ev["ph"] == "X" and ev["name"] == "step"
+    assert ev["dur"] >= 0 and ev["args"]["batch_id"] == 3
+    assert ev["pid"] == meta["pid"]
+    # pid derives from the NAME: same-named tracers share a lane
+    assert Tracer("model_worker/0").pid == t.pid
+    assert Tracer("model_worker/1").pid != t.pid
+
+
+def test_flush_to_file_and_merge(tmp_path):
+    d = str(tmp_path / "trace")
+    tracers = [
+        Tracer("master", enabled=True, path=f"{d}/master.trace.jsonl"),
+        Tracer("model_worker/0", enabled=True,
+               path=f"{d}/worker0.trace.jsonl"),
+    ]
+    for t in tracers:
+        with t.span("step"):
+            with t.span("compute"):
+                pass
+        t.flush()
+        t.flush()  # second flush with nothing buffered: no-op
+    merged = tracing.merge_traces(directory=d)
+    assert merged.endswith("merged_trace.json")
+    doc = json.load(open(merged))
+    events = doc["traceEvents"]
+    pids = {e["pid"] for e in events if e["ph"] == "X"}
+    assert len(pids) == 2  # one lane per process
+    assert sum(1 for e in events if e["ph"] == "X") == 4
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert names == {"master", "model_worker/0"}
+
+
+def test_merge_skips_corrupt_lines(tmp_path):
+    d = tmp_path / "trace"
+    d.mkdir()
+    good = Tracer("ok", enabled=True,
+                  path=str(d / "ok.trace.jsonl"))
+    with good.span("s"):
+        pass
+    good.flush()
+    # a worker killed mid-write leaves a torn line
+    (d / "dead.trace.jsonl").write_text('{"name": "torn', )
+    merged = tracing.merge_traces(directory=str(d))
+    events = json.load(open(merged))["traceEvents"]
+    assert any(e.get("name") == "s" for e in events)
+
+
+def test_merge_empty_dir_returns_none(tmp_path):
+    assert tracing.merge_traces(directory=str(tmp_path)) is None
+    assert tracing.merge_traces(
+        directory=str(tmp_path / "missing")) is None
+
+
+# ----------------------------------------------------------------------
+# env switch
+# ----------------------------------------------------------------------
+def test_trace_env_enabled():
+    assert not tracing.trace_env_enabled(env={})
+    assert not tracing.trace_env_enabled(env={"REALHF_TPU_TRACE": "0"})
+    assert not tracing.trace_env_enabled(env={"REALHF_TPU_TRACE": ""})
+    assert tracing.trace_env_enabled(env={"REALHF_TPU_TRACE": "1"})
+
+
+def test_configure_from_env_labels_and_enables(tmp_path, monkeypatch):
+    import realhf_tpu.base.constants as constants
+    from realhf_tpu import obs
+    monkeypatch.setenv("REALHF_TPU_TRACE", "1")
+    constants.set_experiment_trial_names("obst", "t0")
+    obs.configure_from_env("model_worker/0", experiment="obst",
+                           trial="t0")
+    t = tracing.default_tracer()
+    assert t.enabled
+    assert t.process_name == "model_worker/0"
+    assert t.path.endswith("model_worker-0.trace.jsonl")
