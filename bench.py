@@ -35,6 +35,10 @@ import subprocess
 import sys
 import time
 
+# Process birth: the cold-window time-to-first-headline clock starts
+# here, before any backend probe or compile.
+_PROC_T0 = time.monotonic()
+
 # v5e per-chip peaks (public spec): bf16 matmul and HBM bandwidth.
 V5E_PEAK_FLOPS = 197e12
 V5E_HBM_BW = 819e9
@@ -112,7 +116,8 @@ def _decode_roofline_s(cfg, batch, prompt_len, new_tokens, hbm_bw):
 
 
 def bench_ppo(on_tpu):
-    """Run the real 6-MFC PPO DFG; return (headline dict, extra dict)."""
+    """Run the real 6-MFC PPO DFG; return (headline dict, extra dict,
+    runner) -- the runner feeds the post-headline reshard phase."""
     import jax
     import numpy as np
     from realhf_tpu.api.config import DatasetAbstraction
@@ -435,24 +440,7 @@ def bench_ppo(on_tpu):
     extra["obs_metrics"] = obs_metrics.snapshot()
     obs_tracing.configure(enabled=False)
 
-    # ---- reshard latency (north-star metric) ----------------------------
-    # Two flavors. (a) device path: move the actor's live weights onto
-    # a second engine via device_put (ReplicaManager same-process
-    # path). (b) cross-group host path: the r4 streamed param sync --
-    # chunked blobs through a REAL loopback ZMQ data-plane
-    # server/client, installed chunk-by-chunk (the protocol
-    # cross-group PPO runs use, system/model_worker.py).
-    # Everything below is best-effort: the PPO step record above is
-    # already earned, and a relay drop in the reshard/cross-group
-    # section must degrade to an error note, not void it. On CPU
-    # (no relay to blame) a failure is a real regression: re-raise.
-    try:
-        _reshard_metrics(runner, extra)
-    except Exception as e:  # noqa: BLE001
-        if not on_tpu:
-            raise
-        extra["reshard_error"] = repr(e)
-    return headline, extra
+    return headline, extra, runner
 
 
 def _reshard_metrics(runner, extra):
@@ -677,17 +665,71 @@ def bench_sft(on_tpu):
 def _reexec(force_cpu: bool, depth: int) -> "typing.NoReturn":
     """Re-run this bench in a FRESH process (a jax backend that died
     mid-run cannot be re-initialized in-process) and exit with its
-    return code. The child re-probes from scratch."""
+    return code. The child re-probes from scratch; flags
+    (--headline-only) carry over."""
     env = dict(os.environ)
     env["REALHF_BENCH_MIDRUN_DEPTH"] = str(depth + 1)
     if force_cpu:
         env["REALHF_BENCH_FORCE_CPU"] = "1"
-    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                       env=env)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                        *sys.argv[1:]], env=env)
     sys.exit(r.returncode)
 
 
+def payload_path() -> str:
+    """Where the incrementally-flushed payload lands
+    (REALHF_BENCH_PAYLOAD overrides; default next to bench.py)."""
+    return os.environ.get(
+        "REALHF_BENCH_PAYLOAD",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_partial.json"))
+
+
+def _flush_payload(headline, extra, phases_done):
+    """Atomically (re)write the partial payload file. Called after
+    EVERY phase so a dying chip window always leaves its latest
+    complete record on disk -- the headline survives even if no later
+    phase ever finishes."""
+    record = dict(headline)
+    record["extra"] = dict(extra)
+    record["phases_done"] = list(phases_done)
+    path = payload_path()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"# payload flush failed ({e}); continuing",
+              file=sys.stderr)
+
+
+def _bench_pipeline_schedules():
+    """GPipe-vs-1F1B schedule micro-bench in a CPU-forced subprocess
+    (scripts/bench_pipeline.py): per-schedule step timings, tick
+    counts, and the analytic-vs-measured bubble fraction at S=4, M=4.
+    Subprocess because the schedule needs a multi-device virtual mesh
+    regardless of what backend the parent holds."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_pipeline.py")
+    r = subprocess.run(
+        [sys.executable, script, "--stages", "4", "--microbatches", "4",
+         "--layers", "4", "--hidden", "32", "--seqlen", "32",
+         "--reps", "3"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench_pipeline exited {r.returncode}: {r.stderr[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main():
+    headline_only = "--headline-only" in sys.argv[1:]
     use_accel = _accelerator_usable()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -718,7 +760,7 @@ def main():
     # CPU-smoke line so the harness ALWAYS gets a JSON record.
     depth = int(os.environ.get("REALHF_BENCH_MIDRUN_DEPTH", "0"))
     try:
-        headline, extra = bench_ppo(on_tpu)
+        headline, extra, runner = bench_ppo(on_tpu)
     except Exception as e:
         if not on_tpu:
             raise
@@ -731,9 +773,61 @@ def main():
               file=sys.stderr)
         time.sleep(wait_s)
         _reexec(force_cpu=False, depth=depth)
-    # The PPO record is secured; SFT/serving numbers are best-effort
-    # extras -- a relay drop here appends an error note instead of
-    # discarding the record a short window already earned.
+
+    # ---- the headline record is now EARNED: stamp + flush it before
+    # ANY non-headline phase runs, so a 5-minute chip window that dies
+    # here still yields a number (ROADMAP #3a).
+    extra["backend"] = jax.default_backend()
+    if not on_tpu:
+        extra["tpu_unavailable"] = True
+    extra["time_to_first_headline_s"] = round(
+        time.monotonic() - _PROC_T0, 2)
+    extra["headline_only"] = headline_only
+    phases_done = ["ppo_headline"]
+    _flush_payload(headline, extra, phases_done)
+    if headline_only:
+        # print the valid headline JSON line NOW; later enrichment
+        # only updates the payload file
+        headline_now = dict(headline)
+        headline_now["extra"] = extra
+        print(json.dumps(headline_now))
+        sys.stdout.flush()
+
+    # ---- per-kernel engaged/fallback disposition (ROADMAP weak #2):
+    # cheap introspection of the same gates the dispatch sites use
+    try:
+        from realhf_tpu.ops.dispositions import kernel_dispositions
+        extra["kernel_disposition"] = kernel_dispositions()
+    except Exception as e:  # noqa: BLE001 - the table must never void
+        # the record
+        extra["kernel_disposition"] = {"error": repr(e)}
+    phases_done.append("kernel_disposition")
+    _flush_payload(headline, extra, phases_done)
+
+    if headline_only:
+        return
+
+    # ---- non-headline phases, cheapest-first, each flushed ---------
+    try:
+        extra["pipeline_schedule_bench"] = _bench_pipeline_schedules()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["pipeline_schedule_bench"] = {"error": repr(e)}
+    phases_done.append("pipeline_schedules")
+    _flush_payload(headline, extra, phases_done)
+
+    # Reshard + cross-group sync (north-star metric): best-effort on
+    # TPU -- a relay drop degrades to an error note, never voids the
+    # headline. On CPU a failure is a real regression: re-raise.
+    try:
+        _reshard_metrics(runner, extra)
+    except Exception as e:  # noqa: BLE001
+        if not on_tpu:
+            raise
+        extra["reshard_error"] = repr(e)
+    phases_done.append("reshard")
+    _flush_payload(headline, extra, phases_done)
+
+    # SFT/serving numbers (round-2 continuity): best-effort extras.
     try:
         extra.update(bench_sft(on_tpu))
     except Exception as e:  # noqa: BLE001
@@ -742,6 +836,9 @@ def main():
         print(f"# bench_sft died ({type(e).__name__}: {e}); keeping "
               "the PPO record", file=sys.stderr)
         extra["sft_error"] = repr(e)
+    phases_done.append("sft")
+    _flush_payload(headline, extra, phases_done)
+
     # Fixed per-call dispatch+sync overhead (one cached no-op jit,
     # host-materialized): on the tunneled axon platform every engine
     # call pays this on top of device execution, so the per-phase
@@ -755,11 +852,8 @@ def main():
     except Exception:  # noqa: BLE001 - a relay drop HERE must not void
         # the measured record the lines above already earned
         extra["dispatch_overhead_s"] = None
-    extra["backend"] = jax.default_backend()
-    if not on_tpu:
-        # the probe timed out or failed (e.g. wedged axon relay):
-        # these numbers are CPU-smoke only, not the TPU capability
-        extra["tpu_unavailable"] = True
+    phases_done.append("overhead_probe")
+    _flush_payload(headline, extra, phases_done)
     headline["extra"] = extra
     print(json.dumps(headline))
 

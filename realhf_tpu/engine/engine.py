@@ -80,11 +80,17 @@ class Engine:
                     f"but this engine was built on process {mine}; "
                     "only group members may host the model.")
 
-        # Pipeline parallelism: blocks layer-sharded over "pipe",
-        # GPipe microbatch rotation inside every forward/backward
-        # (parallel/pipeline.py).
+        # Pipeline parallelism: blocks layer-sharded over "pipe".
+        # Training runs the schedule ParallelismConfig.pipeline_schedule
+        # picks -- 1F1B by default (parallel/schedule.py: explicit
+        # instruction streams, custom-VJP backward, bounded residuals)
+        # with GPipe (parallel/pipeline.py) as the selectable fallback;
+        # inference-only forwards always use the GPipe rotation (see
+        # pipeline_ctx_infer -- there is no backward to schedule and
+        # the rotation scan saves nothing).
         if ctx.pp_size > 1:
             from realhf_tpu.parallel.pipeline import PipelineContext
+            from realhf_tpu.parallel.schedule import default_microbatches
             if cfg.n_layers % ctx.pp_size != 0:
                 raise ValueError(
                     f"n_layers={cfg.n_layers} not divisible by "
@@ -94,9 +100,13 @@ class Engine:
                     "pipeline parallelism cannot be combined with "
                     "context parallelism (ring attention) yet; use "
                     "pp x tp x dp or cp x tp x dp.")
-            n_mb = ctx.parallel.pipeline_microbatches or 2 * ctx.pp_size
+            sched = getattr(ctx.parallel, "pipeline_schedule", "") \
+                or "1f1b"
+            n_mb = ctx.parallel.pipeline_microbatches \
+                or default_microbatches(ctx.pp_size, sched)
             self.pipeline_ctx = PipelineContext(
-                mesh=self.mesh, n_stages=ctx.pp_size, n_microbatches=n_mb)
+                mesh=self.mesh, n_stages=ctx.pp_size,
+                n_microbatches=n_mb, schedule=sched)
         else:
             self.pipeline_ctx = None
 
@@ -324,6 +334,17 @@ class Engine:
         capture. Generation never sees it -- on a ctx mesh it runs on
         the collapsed dp x tp decode view, where no ring exists."""
         return self.attention_fn_inference or self.attention_fn
+
+    @property
+    def pipeline_ctx_infer(self):
+        """Pipeline context for inference-only forwards: always the
+        GPipe rotation -- with no backward to schedule, the 1F1B
+        machinery (input saving, custom VJP) is pure overhead."""
+        if self.pipeline_ctx is None \
+                or self.pipeline_ctx.schedule == "gpipe":
+            return self.pipeline_ctx
+        import dataclasses as _dc
+        return _dc.replace(self.pipeline_ctx, schedule="gpipe")
 
     @property
     def n_streams(self) -> int:
@@ -578,7 +599,7 @@ class Engine:
                                  activation_constraint=self._constrain,
                                  attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
-                                 pipeline=self.pipeline_ctx)
+                                 pipeline=self.pipeline_ctx_infer)
                 return h
             self._jit_forward_hidden = jax.jit(
                 f, out_shardings=self._out_replicated())
@@ -595,7 +616,7 @@ class Engine:
                                  activation_constraint=self._constrain,
                                  attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
-                                 pipeline=self.pipeline_ctx)
+                                 pipeline=self.pipeline_ctx_infer)
                 return F.shifted_logprobs_from_hidden(
                     self.cfg, params, h, ids, seg, temperature=temp,
                     logits_mask=mask if has_mask else None)
@@ -619,7 +640,7 @@ class Engine:
                                  activation_constraint=self._constrain,
                                  attention_fn=self._infer_attention_fn,
                                  moe_constraint=self.moe_constraint,
-                                 pipeline=self.pipeline_ctx)
+                                 pipeline=self.pipeline_ctx_infer)
                 return T.critic_values(self.cfg, params, h)
             self._jit_values = jax.jit(
                 f, out_shardings=self._out_replicated())

@@ -313,11 +313,18 @@ def forward(
             "KV-cache prefill on a pipeline-parallel mesh is not "
             "supported; allocate generation MFCs on a dp/tp layout "
             "(decoupled allocation).")
+        from realhf_tpu.parallel import smap as _smap
         from realhf_tpu.parallel.pipeline import pipeline_blocks
+
+        # Old-jax fallback lowers the pipeline shard_map FULLY manual
+        # (parallel/smap.py) -- GSPMD sharding constraints are invalid
+        # inside, and semantically no-ops there (the fallback only
+        # exists for meshes whose non-pipe axes are trivial).
+        pconstrain = constrain if _smap.NEW_SHARD_MAP else (lambda t: t)
 
         def pblock(lp, layer_idx, carry, seg, cos_, sin_):
             y, _, aux = _block(cfg, lp, layer_idx, carry, seg, cos_,
-                               sin_, constrain, attention_fn,
+                               sin_, pconstrain, attention_fn,
                                moe_constraint)
             return y, aux
 
@@ -342,10 +349,21 @@ def forward(
             y, auxs = jax.lax.scan(body, xc, (slab, layer_ids))
             return y, {k: v.sum() for k, v in auxs.items()}
 
-        x, aux = pipeline_blocks(
-            pipeline, params["blocks"], cfg.n_layers, x, seg_ids, cos,
-            sin, block_step, return_aux=return_aux,
-            remat_tick=remat_tick)
+        if getattr(pipeline, "schedule", "gpipe") == "1f1b":
+            # Steady-state 1F1B: explicit instruction streams with a
+            # custom-VJP backward pipeline and bounded residuals
+            # (parallel/schedule.py). Tick-level remat is moot here --
+            # the backward already recomputes each stage-tick from its
+            # saved boundary input.
+            from realhf_tpu.parallel.schedule import pipeline_blocks_1f1b
+            x, aux = pipeline_blocks_1f1b(
+                pipeline, params["blocks"], cfg.n_layers, x, seg_ids,
+                cos, sin, block_step, return_aux=return_aux)
+        else:
+            x, aux = pipeline_blocks(
+                pipeline, params["blocks"], cfg.n_layers, x, seg_ids,
+                cos, sin, block_step, return_aux=return_aux,
+                remat_tick=remat_tick)
         x = _norm(cfg, x, params["ln_f"]["scale"],
                   params["ln_f"].get("bias"))
         if return_aux:

@@ -49,10 +49,19 @@ class ParallelismConfig:
     context_parallel_size: int = 1
     sequence_parallel: bool = False
     gradient_checkpointing: bool = False
-    # GPipe microbatch count when pipeline_parallel_size > 1
-    # (0 = auto: 2*pp, bubble fraction (pp-1)/(3*pp-1)); not part of
-    # the weight layout (same_layout ignores it).
+    # Pipeline microbatch count when pipeline_parallel_size > 1
+    # (0 = auto, schedule-dependent: 2*pp for gpipe, 4*pp for 1f1b --
+    # parallel/schedule.default_microbatches); not part of the weight
+    # layout (same_layout ignores it).
     pipeline_microbatches: int = 0
+    # Tick schedule for pipeline-parallel TRAINING: "1f1b" (default --
+    # explicit instruction streams, custom-VJP backward pipeline,
+    # bounded residuals, masked bubble ticks; parallel/schedule.py) or
+    # "gpipe" (lockstep rotation scan with autodiff backward;
+    # parallel/pipeline.py). Inference-only forwards always use the
+    # GPipe rotation (no backward to schedule). Not part of the weight
+    # layout (same_layout ignores it).
+    pipeline_schedule: str = "1f1b"
     # Tensor-parallel degree of the DECODE VIEW used for generation on
     # a pipeline- or context-parallel mesh (engine.decode_engine):
     # weights reshard onto a collapsed (world/gen_tp) x gen_tp dp x tp
@@ -63,6 +72,10 @@ class ParallelismConfig:
     def __post_init__(self):
         if self.sequence_parallel and self.tensor_parallel_size == 1:
             object.__setattr__(self, "sequence_parallel", False)
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipeline_schedule!r}")
 
     @property
     def world_size(self) -> int:

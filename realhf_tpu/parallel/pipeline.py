@@ -37,8 +37,10 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from realhf_tpu.parallel import smap
 from realhf_tpu.parallel.mesh import PIPE_AXIS
 
 # block_step(blocks_slab, layer_ids, x, seg, cos, sin)
@@ -48,14 +50,34 @@ BlockStep = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
 
 @dataclasses.dataclass(frozen=True)
 class PipelineContext:
-    """Static pipeline execution plan for one model."""
+    """Static pipeline execution plan for one model.
+
+    ``schedule`` picks the tick schedule models/transformer.forward
+    runs: "gpipe" (this module -- lockstep rotation, autodiff
+    backward; the inference default) or "1f1b"
+    (parallel/schedule.pipeline_blocks_1f1b -- explicit instruction
+    streams with a custom-VJP backward pipeline; the training
+    default, selected via ParallelismConfig.pipeline_schedule)."""
     mesh: Mesh
     n_stages: int
     n_microbatches: int
+    schedule: str = "gpipe"
 
     def __post_init__(self):
         assert self.n_stages > 1, "PipelineContext needs >= 2 stages"
         assert self.n_microbatches >= 1
+        assert self.schedule in ("gpipe", "1f1b"), self.schedule
+
+
+def microbatch_weights(b_orig: int, bm: int, n_mb: int) -> np.ndarray:
+    """Per-microbatch aux weights: REAL stream count of each
+    microbatch over the total real stream count. ``pad_streams``
+    appends all-padding streams at the end, so microbatch m holds
+    ``clip(b_orig - m*bm, 0, bm)`` real streams -- a partially-padded
+    trailing microbatch must weigh less than a full one (it used to
+    count as full, deflating every real microbatch's aux share)."""
+    real = np.clip(b_orig - np.arange(n_mb) * bm, 0, bm)
+    return (real / max(b_orig, 1)).astype(np.float32)
 
 
 def pad_streams(arrs, n_streams_multiple: int, pad_value=0):
@@ -117,26 +139,27 @@ def pipeline_blocks(
     Bm = B // M
     T = M + S - 1
     # Microbatches consisting entirely of internal padding streams
-    # (pad_streams appends them at the end) contribute zero aux; the
-    # per-microbatch aux mean must divide by the real count only.
-    n_real_mb = -(-b_orig // Bm)
+    # (pad_streams appends them at the end) contribute zero aux; real
+    # microbatches weigh by their REAL stream count, so a
+    # partially-padded trailing microbatch counts proportionally.
+    mb_w = jnp.asarray(microbatch_weights(b_orig, Bm, M))
 
-    @partial(jax.shard_map, mesh=pipe.mesh, axis_names={PIPE_AXIS},
-             in_specs=(P(PIPE_AXIS), P(None), P(None), P(None), P(None)),
+    @partial(smap.pipe_shard_map, mesh=pipe.mesh,
+             in_specs=(P(PIPE_AXIS), P(None), P(None), P(None), P(None),
+                       P(None)),
              out_specs=(P(PIPE_AXIS), P()))
-    def run(blocks_local, x, seg, cos, sin):
+    def run(blocks_local, x, seg, cos, sin, w):
         idx = jax.lax.axis_index(PIPE_AXIS)
         layer_ids = idx * per_stage + jnp.arange(per_stage,
                                                  dtype=jnp.int32)
 
         def mb(a):
             # pipe-varying so stages can index their own microbatch
-            return jax.lax.pcast(a.reshape(M, Bm, *a.shape[1:]),
-                                 (PIPE_AXIS,), to="varying")
+            return smap.to_varying(a.reshape(M, Bm, *a.shape[1:]))
 
         mbs_x, mbs_seg, mbs_cos, mbs_sin = mb(x), mb(seg), mb(cos), mb(sin)
-        state = jax.lax.pcast(jnp.zeros((Bm, L, H), x.dtype),
-                              (PIPE_AXIS,), to="varying")
+        wv = smap.to_varying(w)
+        state = smap.to_varying(jnp.zeros((Bm, L, H), x.dtype))
 
         def tick(state, t):
             # Stage `idx` processes microbatch m = t - idx at tick t
@@ -155,10 +178,11 @@ def pipeline_blocks(
             # Bubble ticks (stage s active only for s <= t < s + M):
             # their aux must not count; their outputs are never
             # consumed (see collection below), so they contribute zero
-            # gradient.
+            # gradient. Valid ticks weigh by their microbatch's real
+            # stream share.
             valid = (((t - idx) >= 0) & ((t - idx) < M)).astype(
                 jnp.float32)
-            aux = {k: v * valid for k, v in aux.items()}
+            aux = {k: v * (valid * pick(wv)) for k, v in aux.items()}
             nxt = jax.lax.ppermute(
                 y, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])
             return nxt, (y, aux)
@@ -169,17 +193,17 @@ def pipeline_blocks(
         # discards by indexing stage S-1 of the stacked output.
         outs = ys[S - 1:]                       # [M, Bm, L, H]
         # Aux losses are per-token means inside each (layer,
-        # microbatch) evaluation; average them over the M microbatches
-        # (the reference likewise applies MoE aux per forward
-        # microbatch, utils/moe.py:395-416) and sum over stages.
+        # microbatch) evaluation, already weighted per microbatch
+        # above (the reference likewise applies MoE aux per forward
+        # microbatch, utils/moe.py:395-416); sum over stages.
         # sorted: one psum per aux key -- every pipeline stage must
         # issue them in the same order or the collectives deadlock
         # (det-unsorted-iter)
-        aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS) / n_real_mb
+        aux_tot = {k: jax.lax.psum(v.sum(), PIPE_AXIS)
                    for k, v in sorted(auxs.items())}
         return outs[None], aux_tot
 
-    outs, aux = run(blocks, x, seg_ids, cos, sin)
+    outs, aux = run(blocks, x, seg_ids, cos, sin, mb_w)
     hidden = outs[S - 1].reshape(B, L, H)[:b_orig]
     if return_aux:
         return hidden, aux

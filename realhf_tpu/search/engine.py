@@ -106,6 +106,57 @@ class TPUCostModel:
     hbm_budget: float = 16e9 * 0.6
 
 
+#: calibration artifact the measured-fit entry (scripts/calibrate.py)
+#: persists and default_cost_model() picks up
+CALIBRATION_ENV = "REALHF_TPU_CALIBRATION"
+CALIBRATION_FILE = "calibration_tpu.json"
+_calib_logged: set = set()
+
+
+def load_cost_model(path: str) -> Optional[TPUCostModel]:
+    """Parse a calibration artifact into a TPUCostModel, tolerating
+    both the full artifact layout ({"calibrated": {...}}) and a flat
+    field dict; unknown keys are ignored, absent ones keep defaults.
+    Returns None (never raises) on a missing/corrupt file -- an
+    unreadable calibration must degrade to the analytic defaults, not
+    kill an allocation search."""
+    import json
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(raw, dict) and isinstance(raw.get("calibrated"), dict):
+        raw = raw["calibrated"]
+    if not isinstance(raw, dict):
+        return None
+    fields = {f.name for f in dataclasses.fields(TPUCostModel)}
+    kept = {k: float(v) for k, v in raw.items()
+            if k in fields and isinstance(v, (int, float))}
+    if not kept:
+        return None
+    return TPUCostModel(**kept)
+
+
+def default_cost_model() -> TPUCostModel:
+    """The cost model searches use when the caller passes none: a
+    persisted on-chip calibration when present (``$REALHF_TPU_CALIBRATION``
+    or ``./calibration_tpu.json``, written by ``scripts/calibrate.py``),
+    else the analytic v5e defaults -- allocations stop being educated
+    guesses as soon as one chip window has run the microbenchmark."""
+    path = os.environ.get(CALIBRATION_ENV) or CALIBRATION_FILE
+    cm = load_cost_model(path)
+    if cm is None:
+        return TPUCostModel()
+    if path not in _calib_logged:
+        _calib_logged.add(path)
+        logger.info(
+            "Cost model loaded from calibration %s: "
+            "mxu_efficiency=%.3f, hbm_bw=%.0f GB/s", path,
+            cm.mxu_efficiency, cm.hbm_bandwidth / 1e9)
+    return cm
+
+
 @dataclasses.dataclass
 class MFCWorkload:
     """What one MFC costs, independent of layout."""
@@ -183,12 +234,19 @@ def exec_time(w: MFCWorkload, tp: int, dp: int,
               cm: TPUCostModel, pp: int = 1) -> float:
     """Seconds for one execution of the MFC on dp*tp*pp chips.
 
-    Pipeline stages add the GPipe bubble: with the engine's default
-    M = 2*pp microbatches the schedule runs M + pp - 1 ticks, a
-    (M + pp - 1) / M slowdown over perfect scaling.
+    Pipeline stages add the schedule's bubble: (M + pp - 1) / M over
+    perfect scaling at the engine's default microbatch count for the
+    default 1F1B schedule (M = 4*pp -- its bounded residual memory
+    affords twice GPipe's M, halving the (pp-1)/M overhead; see
+    parallel/schedule.train_bubble_factor). pp candidates therefore
+    price cheaper than under the old GPipe term and re-rank.
     """
     chips = tp * dp * pp
-    bubble = (2 * pp + pp - 1) / (2 * pp) if pp > 1 else 1.0
+    if pp > 1:
+        from realhf_tpu.parallel.schedule import train_bubble_factor
+        bubble = train_bubble_factor(pp)
+    else:
+        bubble = 1.0
     if w.interface_type == ModelInterfaceType.TRAIN_STEP:
         flops = 3.0 * w.fwd_flops          # fwd + bwd (2x)
         return bubble * flops / (chips * cm.peak_flops
@@ -365,7 +423,7 @@ def search_rpc_allocations(
     ``deps[name]`` lists MFCs that must finish before ``name`` starts
     (the DFG edges).
     """
-    cm = cost_model or TPUCostModel()
+    cm = cost_model or default_cost_model()
     lib = load_native()
     p = _flatten(workloads, deps, n_devices, cm)
 
@@ -391,7 +449,7 @@ def simulate_named_assignment(
     """Simulated step seconds for an explicit assignment (the same
     native simulator the search uses -- dependency + device-contention
     scheduling with realloc charges)."""
-    cm = cost_model or TPUCostModel()
+    cm = cost_model or default_cost_model()
     lib = load_native()
     p = _flatten(workloads, deps, n_devices, cm)
 

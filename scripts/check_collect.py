@@ -36,7 +36,10 @@ REQUIRED_DIRS = (
     "tests/base",
     "tests/engine",
     "tests/observability",
+    "tests/ops",
+    "tests/parallel",
     "tests/recovery",
+    "tests/search",
     "tests/serving",
     "tests/system",
 )

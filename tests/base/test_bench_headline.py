@@ -1,0 +1,168 @@
+"""bench.py cold-window contract: the PPO headline is recorded FIRST,
+the payload file is flushed incrementally (partial file on disk before
+any non-headline phase runs), and --headline-only prints a valid
+headline JSON line without touching the non-headline phases."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", ".."))
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch, tmp_path):
+    monkeypatch.syspath_prepend(REPO)
+    monkeypatch.setenv("REALHF_BENCH_FORCE_CPU", "1")
+    monkeypatch.setenv("REALHF_TPU_COMPILE_CACHE", "0")
+    monkeypatch.setenv("REALHF_BENCH_PAYLOAD",
+                       str(tmp_path / "BENCH_partial.json"))
+    import bench
+    return bench
+
+
+def _headline():
+    return {"metric": "ppo_tokens_per_sec_per_chip", "value": 123.4,
+            "unit": "tokens/s", "vs_baseline": 0.99}
+
+
+def _read_payload():
+    with open(os.environ["REALHF_BENCH_PAYLOAD"]) as f:
+        return json.load(f)
+
+
+def test_headline_only_prints_and_skips_nonheadline_phases(
+        bench_mod, monkeypatch, capsys):
+    ran = []
+    monkeypatch.setattr(
+        bench_mod, "bench_ppo",
+        lambda on_tpu: (_headline(), {"ppo_step_time_s": 1.0},
+                        object()))
+
+    def forbidden(name):
+        def _f(*a, **k):
+            ran.append(name)
+            raise AssertionError(f"{name} must not run in "
+                                 "--headline-only mode")
+        return _f
+
+    monkeypatch.setattr(bench_mod, "bench_sft", forbidden("sft"))
+    monkeypatch.setattr(bench_mod, "_reshard_metrics",
+                        forbidden("reshard"))
+    monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules",
+                        forbidden("pipeline"))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--headline-only"])
+    bench_mod.main()
+    assert ran == []
+
+    out_lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+    assert len(out_lines) == 1
+    rec = json.loads(out_lines[0])
+    assert rec["metric"] == "ppo_tokens_per_sec_per_chip"
+    assert rec["extra"]["headline_only"] is True
+    assert rec["extra"]["time_to_first_headline_s"] >= 0
+
+    payload = _read_payload()
+    assert payload["phases_done"] == ["ppo_headline",
+                                      "kernel_disposition"]
+    assert "kernel_disposition" in payload["extra"]
+    assert "sft_mfu" not in payload["extra"]
+
+
+def test_partial_payload_flushed_before_each_nonheadline_phase(
+        bench_mod, monkeypatch, capsys):
+    """The full run flushes after EVERY phase; each later phase can
+    observe the previous flush on disk -- a window dying mid-phase
+    always leaves the newest complete record."""
+    seen_phases = {}
+
+    monkeypatch.setattr(
+        bench_mod, "bench_ppo",
+        lambda on_tpu: (_headline(), {"ppo_step_time_s": 1.0},
+                        object()))
+
+    def spy(name, ret=None, mutate=None):
+        def _f(*a, **k):
+            seen_phases[name] = _read_payload()["phases_done"]
+            if mutate is not None:
+                mutate(*a)
+            return ret
+        return _f
+
+    monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules",
+                        spy("pipeline", ret={"stages": 4}))
+    monkeypatch.setattr(
+        bench_mod, "_reshard_metrics",
+        spy("reshard",
+            mutate=lambda runner, extra: extra.update(
+                reshard_latency_s=0.1)))
+    monkeypatch.setattr(bench_mod, "bench_sft",
+                        spy("sft", ret={"sft_mfu": 0.5}))
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench_mod.main()
+
+    # headline (and disposition) were on disk before the first
+    # non-headline phase ran
+    assert seen_phases["pipeline"] == ["ppo_headline",
+                                       "kernel_disposition"]
+    assert seen_phases["reshard"][-1] == "pipeline_schedules"
+    assert seen_phases["sft"][-1] == "reshard"
+
+    final = _read_payload()
+    assert final["phases_done"] == [
+        "ppo_headline", "kernel_disposition", "pipeline_schedules",
+        "reshard", "sft", "overhead_probe"]
+    assert final["extra"]["pipeline_schedule_bench"] == {"stages": 4}
+    assert final["extra"]["sft_mfu"] == 0.5
+    # final stdout line is the full headline record
+    out_lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+    rec = json.loads(out_lines[-1])
+    assert rec["extra"]["reshard_latency_s"] == 0.1
+
+
+def test_nonheadline_phase_failure_never_voids_headline(
+        bench_mod, monkeypatch, capsys):
+    monkeypatch.setattr(
+        bench_mod, "bench_ppo",
+        lambda on_tpu: (_headline(), {"ppo_step_time_s": 1.0},
+                        object()))
+
+    def boom(*a, **k):
+        raise RuntimeError("window died")
+
+    monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules", boom)
+    monkeypatch.setattr(bench_mod, "bench_sft",
+                        lambda on_tpu: {"sft_mfu": 0.5})
+    monkeypatch.setattr(bench_mod, "_reshard_metrics",
+                        lambda runner, extra: None)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench_mod.main()
+    payload = _read_payload()
+    assert "error" in payload["extra"]["pipeline_schedule_bench"]
+    assert payload["phases_done"][-1] == "overhead_probe"
+
+
+def test_bench_pipeline_script_payload_shape(monkeypatch):
+    """The schedule micro-bench payload: exact analytics plus measured
+    timings (run in-process at the smallest shape; the S=4/M=4
+    acceptance geometry runs from bench.py and in the e2e above the
+    tier)."""
+    monkeypatch.syspath_prepend(os.path.join(REPO, "scripts"))
+    import bench_pipeline
+
+    out = bench_pipeline.run(stages=2, microbatches=2, layers=2,
+                             hidden=32, seqlen=32, reps=1)
+    assert out["ticks_per_pass"] == 3 and out["train_ticks"] == 6
+    assert out["analytic_bubble_fraction"] == pytest.approx(1 / 3,
+                                                            abs=1e-4)
+    assert out["schedules"]["gpipe"]["computed_stage_steps"] == 12
+    assert out["schedules"]["1f1b"]["computed_stage_steps"] == 8
+    for sched in ("gpipe", "1f1b"):
+        assert out["schedules"][sched]["step_s"] > 0
+    assert -1.0 < out["measured_bubble_fraction"] < 1.0
+    json.dumps(out)  # payload-serializable

@@ -179,7 +179,8 @@ def test_sft_trains_on_pipeline_mesh():
                         lr_scheduler_type="constant"),
                     total_train_steps=10)
     assert engine.pipeline_ctx is not None
-    assert engine.n_streams == 2 * 4  # dp * 2*pp microbatches
+    assert engine.pipeline_ctx.schedule == "1f1b"  # train default
+    assert engine.n_streams == 2 * 8  # dp * 4*pp microbatches (1f1b)
     model = model_api.Model(ModelName("actor", 0), engine, None)
 
     rng = np.random.default_rng(0)

@@ -1,0 +1,101 @@
+"""Persisted-calibration plumbing: scripts/calibrate.py writes the
+artifact; TPUCostModel auto-loads it so allocation searches price
+candidates with measured numbers (ROADMAP weak #5)."""
+
+import dataclasses
+import json
+import os
+import sys
+
+import pytest
+
+from realhf_tpu.api.config import ModelInterfaceType
+from realhf_tpu.search.engine import (
+    CALIBRATION_ENV,
+    MFCWorkload,
+    TPUCostModel,
+    default_cost_model,
+    exec_time,
+    load_cost_model,
+)
+
+
+def _write(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def test_load_cost_model_artifact_and_flat_layouts(tmp_path):
+    p = tmp_path / "cal.json"
+    _write(p, {"backend": "tpu",
+               "calibrated": {"mxu_efficiency": 0.55,
+                              "hbm_bandwidth": 700e9,
+                              "not_a_field": 1}})
+    cm = load_cost_model(str(p))
+    assert cm.mxu_efficiency == pytest.approx(0.55)
+    assert cm.hbm_bandwidth == pytest.approx(700e9)
+    # unspecified fields keep defaults
+    assert cm.peak_flops == TPUCostModel().peak_flops
+
+    _write(p, {"mxu_efficiency": 0.33})
+    assert load_cost_model(str(p)).mxu_efficiency == pytest.approx(0.33)
+
+
+def test_load_cost_model_tolerates_missing_and_corrupt(tmp_path):
+    assert load_cost_model(str(tmp_path / "absent.json")) is None
+    p = tmp_path / "bad.json"
+    p.write_text("{truncated")
+    assert load_cost_model(str(p)) is None
+    _write(p, ["not", "a", "dict"])
+    assert load_cost_model(str(p)) is None
+
+
+def test_default_cost_model_env_pickup_changes_exec_time(
+        tmp_path, monkeypatch):
+    p = tmp_path / "cal.json"
+    _write(p, {"calibrated": {"mxu_efficiency": 0.8}})
+    monkeypatch.setenv(CALIBRATION_ENV, str(p))
+    cm = default_cost_model()
+    assert cm.mxu_efficiency == pytest.approx(0.8)
+
+    w = MFCWorkload(name="t", role="actor",
+                    interface_type=ModelInterfaceType.TRAIN_STEP,
+                    fwd_flops=1e15, param_bytes=1e9,
+                    train_state_bytes=9e9, n_layers=8)
+    # doubled efficiency halves the modeled train time
+    assert exec_time(w, 1, 1, cm) == pytest.approx(
+        exec_time(w, 1, 1, TPUCostModel()) * 0.4 / 0.8)
+
+    monkeypatch.setenv(CALIBRATION_ENV, str(tmp_path / "absent.json"))
+    assert default_cost_model().mxu_efficiency == pytest.approx(0.4)
+
+
+def test_calibrate_entry_persists_loadable_artifact(
+        tmp_path, monkeypatch, capsys):
+    """scripts/calibrate.py writes the artifact atomically in the
+    exact layout default_cost_model() loads (the measurement itself is
+    covered by test_calibrate_script_pipeline; here it is stubbed so
+    the persistence contract stays fast to check)."""
+    monkeypatch.syspath_prepend(os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts"))
+    import calibrate as calibrate_entry
+
+    import realhf_tpu.search.engine as se
+
+    fake = dataclasses.replace(TPUCostModel(), mxu_efficiency=0.61,
+                               hbm_bandwidth=555e9)
+    monkeypatch.setattr(se, "calibrate_cost_model",
+                        lambda spec, base=None: fake)
+    out = str(tmp_path / "calibration_tpu.json")
+    monkeypatch.setattr(sys, "argv", ["calibrate.py", "--out", out])
+    assert calibrate_entry.main(["--out", out]) == 0
+
+    with open(out) as f:
+        artifact = json.load(f)
+    assert artifact["base"]["mxu_efficiency"] == 0.4
+    assert artifact["calibrated"]["mxu_efficiency"] == 0.61
+
+    monkeypatch.setenv(CALIBRATION_ENV, out)
+    cm = default_cost_model()
+    assert cm.mxu_efficiency == pytest.approx(0.61)
+    assert cm.hbm_bandwidth == pytest.approx(555e9)

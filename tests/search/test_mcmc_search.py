@@ -137,8 +137,12 @@ def test_pipeline_candidates_enumerated_with_bubble_cost():
         assert state_per_chip <= cm.hbm_budget
     t_pp2 = exec_time(w, tp=8, dp=1, cm=cm, pp=2)
     t_flat = exec_time(w, tp=8, dp=2, cm=cm, pp=1)
-    # same 16 chips; pp=2 pays the (M+S-1)/M = 5/4 bubble
-    assert t_pp2 == pytest.approx(t_flat * 5 / 4, rel=1e-6)
+    # same 16 chips; pp=2 pays the 1F1B bubble (M+S-1)/M = 9/8 at the
+    # schedule's default M = 4*pp (the old GPipe term at M = 2*pp was
+    # 5/4 -- pp candidates re-rank cheaper under 1F1B)
+    from realhf_tpu.parallel.schedule import train_bubble_factor
+    assert train_bubble_factor(2) == pytest.approx(9 / 8)
+    assert t_pp2 == pytest.approx(t_flat * 9 / 8, rel=1e-6)
 
     gen = MFCWorkload(
         name="gen", role="actor",
