@@ -16,6 +16,13 @@ reduce to three mechanical patterns this checker watches:
   either side.
 - ``conc-unjoined-thread``: a non-daemon ``threading.Thread`` that is
   never ``join``-ed -- it outlives shutdown and hides exit hangs.
+- ``conc-shared-zmq-socket``: a ZMQ socket attribute with
+  send/recv/poll calls both in a thread entry point and in another
+  method, with no lock on either side. ZMQ sockets are not
+  thread-safe; concurrent I/O corrupts the socket state machine --
+  exactly the bug class the serving router/server must avoid (their
+  serve loops own each socket exclusively). ``close()`` is NOT
+  counted as I/O: the join-then-close teardown pattern is safe.
 """
 
 import ast
@@ -46,6 +53,14 @@ BLOCKING_CALLS = {
 #: (queue.get(timeout=...) etc. stay flagged -- keep the list tight)
 
 _LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: socket methods that touch the ZMQ state machine concurrently
+#: (close is deliberately absent: join-then-close teardown is safe)
+_SOCKET_IO_METHODS = {
+    "send", "send_multipart", "send_pyobj", "send_string", "send_json",
+    "recv", "recv_multipart", "recv_pyobj", "recv_string", "recv_json",
+    "poll",
+}
 
 #: attribute values that are themselves thread-safe handshakes
 _SAFE_CTORS = ("threading.Event", "threading.Lock", "threading.RLock",
@@ -79,6 +94,8 @@ class ConcurrencyChecker(AstChecker):
         for node in ast.walk(module.tree):
             if isinstance(node, ast.ClassDef):
                 findings.extend(self._check_class_fields(module, node))
+                findings.extend(
+                    self._check_shared_zmq_socket(module, node))
         return findings
 
     # ------------------------------------------------------------------
@@ -210,6 +227,96 @@ class ConcurrencyChecker(AstChecker):
                     f"`{cls.name}.{mname}` without a common lock",
                     symbol=f"{cls.name}.{t_name}"))
                 writes_in_thread.pop(attr)  # one finding per attr
+        return findings
+
+    # ------------------------------------------------------------------
+    def _check_shared_zmq_socket(self, module: Module,
+                                 cls: ast.ClassDef) -> List[Finding]:
+        """ZMQ socket I/O (send/recv/poll) from a thread entry AND
+        from another method of the same class, with no lock on either
+        side. Socket-creation is recognized syntactically: an
+        attribute assigned from a ``*.socket(...)`` call."""
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not methods:
+            return []
+        socket_attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr == "socket"):
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    socket_attrs.add(t.attr)
+        if not socket_attrs:
+            return []
+        entries = self._thread_entry_methods(cls, methods)
+        if not entries:
+            return []
+
+        def io_uses(fn) -> Dict[str, Tuple[bool, ast.AST]]:
+            """socket attr -> (locked?, node) for send/recv/poll calls
+            on it; an unlocked use wins (that's the bug)."""
+            uses: Dict[str, Tuple[bool, ast.AST]] = {}
+
+            def visit(node, lock_depth):
+                if isinstance(node, ast.With) and any(
+                        _is_lock_expr(i.context_expr)
+                        for i in node.items):
+                    lock_depth += 1
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SOCKET_IO_METHODS):
+                    tgt = node.func.value
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr in socket_attrs):
+                        prev = uses.get(tgt.attr)
+                        if prev is None or (prev[0]
+                                            and lock_depth == 0):
+                            uses[tgt.attr] = (lock_depth > 0, node)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, lock_depth)
+
+            visit(fn, 0)
+            return uses
+
+        # attr -> (locked, node, entry method) of thread-side I/O
+        entry_uses: Dict[str, Tuple[bool, ast.AST, str]] = {}
+        for name in sorted(entries):
+            for attr, (locked, node) in io_uses(methods[name]).items():
+                prev = entry_uses.get(attr)
+                if prev is None or (prev[0] and not locked):
+                    entry_uses[attr] = (locked, node, name)
+
+        findings: List[Finding] = []
+        for mname, fn in sorted(methods.items()):
+            if mname in entries or mname == "__init__":
+                continue
+            for attr, (locked, _n) in io_uses(fn).items():
+                hit = entry_uses.get(attr)
+                if hit is None:
+                    continue
+                e_locked, e_node, e_name = hit
+                if locked or e_locked:
+                    continue  # one side synchronized: different bug
+                findings.append(self.finding(
+                    module, "conc-shared-zmq-socket", e_node,
+                    f"ZMQ socket `self.{attr}` used from thread entry "
+                    f"`{cls.name}.{e_name}` and from "
+                    f"`{cls.name}.{mname}` without a common lock; ZMQ "
+                    "sockets are not thread-safe -- confine each "
+                    "socket to one thread or lock every use",
+                    symbol=f"{cls.name}.{e_name}"))
+                entry_uses.pop(attr)  # one finding per socket attr
         return findings
 
     # ------------------------------------------------------------------

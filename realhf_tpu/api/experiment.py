@@ -173,6 +173,29 @@ class ServingSpec:
     stream_tokens: bool = True
     #: seconds drain() waits for in-flight sequences at shutdown
     drain_timeout_secs: float = 30.0
+    # -- resilient fleet mode (docs/serving.md "Fleet, failover &
+    # circuit breakers"): a FleetRouter fronts the n_servers replicas;
+    # replicas register leases in the fleet registry and clients talk
+    # to the router (server_name="router") instead of a replica.
+    fleet_router: bool = False
+    #: replica lease TTL; a replica silent for this long vanishes from
+    #: the registry and its in-flight work fails over
+    lease_ttl_secs: float = 5.0
+    #: dispatch a speculative duplicate when a request has not started
+    #: within this many seconds (None disables hedging)
+    router_hedge_delay_secs: Optional[float] = None
+    router_max_hedges: int = 1
+    #: consecutive failures that open a replica's circuit breaker
+    router_breaker_failures: int = 3
+    #: seconds an open breaker waits before the half-open probe
+    router_breaker_cooldown_secs: float = 5.0
+    #: no reply at all to a dispatched request within this -> failover
+    router_dispatch_timeout_secs: float = 10.0
+    #: an accepted request silent for this long -> failover (None
+    #: disables; covers a dropped terminal-event send)
+    router_response_timeout_secs: Optional[float] = 60.0
+    #: cap on router-tracked in-flight requests (backpressure beyond)
+    router_max_pending: int = 1024
 
 
 @dataclasses.dataclass

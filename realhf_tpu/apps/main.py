@@ -296,7 +296,14 @@ def run_serve(spec: ExperimentSpec,
     ft = getattr(spec, "ft", None) or FaultToleranceConfig()
     env.setdefault(HEARTBEAT_INTERVAL_ENV, str(ft.heartbeat_interval))
 
-    worker_names = [f"gen_server/{i}" for i in range(sv.n_servers)]
+    gen_names = [f"gen_server/{i}" for i in range(sv.n_servers)]
+    # fleet mode (docs/serving.md "Fleet, failover & circuit
+    # breakers"): a RouterWorker fronts the replicas; clients talk to
+    # it (server_name="router") and individual replica deaths are
+    # tolerated -- the router fails their in-flight work over -- as
+    # long as the router itself and at least one replica survive.
+    fleet = bool(getattr(sv, "fleet_router", False))
+    worker_names = gen_names + (["router/0"] if fleet else [])
     sched = make_scheduler("local")
     name_resolve.clear_subtree(
         names.trial_root(spec.experiment_name, spec.trial_name))
@@ -304,16 +311,20 @@ def run_serve(spec: ExperimentSpec,
         for i in range(sv.n_servers):
             sched.submit(f"gen_server/{i}",
                          _worker_cmd("gen_server", i, spec), env=env)
+        if fleet:
+            sched.submit("router/0", _worker_cmd("router", 0, spec),
+                         env=env)
         panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
         panel.connect(worker_names, timeout=120)
-        out = panel.group_request_varied(
-            "configure",
-            {f"gen_server/{i}": dict(config=dict(spec_path=path,
-                                                 server_index=i))
-             for i in range(sv.n_servers)},
-            timeout=600)
+        configs = {f"gen_server/{i}": dict(config=dict(
+            spec_path=path, server_index=i))
+            for i in range(sv.n_servers)}
+        if fleet:
+            configs["router/0"] = dict(config=dict(spec_path=path))
+        out = panel.group_request_varied("configure", configs,
+                                         timeout=600)
         panel.group_request("start")
-        logger.info("All %d rollout servers started: %s.",
+        logger.info("All %d serving workers started: %s.",
                     len(worker_names),
                     {w: r.get("address") for w, r in out.items()
                      if isinstance(r, dict)})
@@ -324,27 +335,44 @@ def run_serve(spec: ExperimentSpec,
             poll_interval=ft.watchdog_poll_secs)
         end = None if duration is None else time.monotonic() + duration
         deadline = time.monotonic() + timeout
+        dead_servers = set()
+
+        def _tolerable(w: str) -> bool:
+            # in fleet mode a replica death is survivable until the
+            # last replica goes; the router's loss is always fatal
+            if not (fleet and w in gen_names):
+                return False
+            if w not in dead_servers:
+                dead_servers.add(w)
+                logger.warning(
+                    "Serving replica %s died; fleet continues on %d "
+                    "survivor(s) (failover at the router).", w,
+                    len(gen_names) - len(dead_servers))
+            return len(dead_servers) < len(gen_names)
+
         while True:
             for w in worker_names:
                 info = sched.find(w)
-                if info.state.value == "FAILED":
-                    raise JobException(w, info.state)
-                if panel.get_worker_status(w) == WorkerServerStatus.ERROR:
+                failed = (info.state.value == "FAILED"
+                          or panel.get_worker_status(w)
+                          == WorkerServerStatus.ERROR)
+                if failed and not _tolerable(w):
                     raise JobException(w, info.state)
             watchdog.poll()
-            lost = watchdog.lost_longer_than(ft.worker_lost_fatal_secs)
-            if lost:
-                raise JobException(lost[0], JobState.LOST)
+            for w in watchdog.lost_longer_than(ft.worker_lost_fatal_secs):
+                if not _tolerable(w):
+                    raise JobException(w, JobState.LOST)
             if end is not None and time.monotonic() > end:
                 break
             if time.monotonic() > deadline:
                 break
             time.sleep(0.2)
 
-        stats = panel.group_request("stats")
+        alive = [w for w in worker_names if w not in dead_servers]
+        stats = panel.group_request("stats", worker_names=alive)
         # exit drains each server (GenServerWorker._exit_hook) before
         # the COMPLETED status lands
-        panel.group_request("exit",
+        panel.group_request("exit", worker_names=alive,
                             timeout=sv.drain_timeout_secs + 60)
         sched.wait(timeout=sv.drain_timeout_secs + 60,
                    check_status=False)

@@ -36,6 +36,10 @@ def main_worker(args):
         from realhf_tpu.serving.worker import GenServerWorker
         cls = GenServerWorker
         name = f"gen_server/{args.index}"
+    elif args.worker_type == "router":
+        from realhf_tpu.serving.worker import RouterWorker
+        cls = RouterWorker
+        name = f"router/{args.index}"
     else:
         raise ValueError(args.worker_type)
     cls(args.experiment_name, args.trial_name, name).run()
@@ -47,7 +51,7 @@ def main():
     w = sub.add_parser("worker")
     w.add_argument("--worker_type", required=True,
                    choices=["model_worker", "master_worker",
-                            "gen_server"])
+                            "gen_server", "router"])
     w.add_argument("--index", type=int, default=0)
     w.add_argument("--experiment_name", required=True)
     w.add_argument("--trial_name", required=True)

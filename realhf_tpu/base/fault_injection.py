@@ -28,24 +28,50 @@ flips bytes in a shard of the checkpoint that was just committed
 proving the checksum-verify + fallback-to-previous-manifest load
 path.
 
+Network chaos (PR 7, docs/serving.md "Chaos drills") rides on the
+same spec grammar but fires at the ZMQ send/recv shims
+(``serving/server.py``, ``serving/router.py``,
+``system/request_reply_stream.py``) instead of the request handler::
+
+    net_drop:gen_server/1:send\\:done:1     # discard ONE outgoing done
+    net_delay:gen_server/0:recv:2:0.5      # 2nd inbound msg +0.5s
+    partition:gen_server/2:*:1:6.0         # 6s window: ALL of this
+                                           # worker's traffic drops AND
+                                           # its name_resolve lease
+                                           # renewals fail (visibility
+                                           # partition)
+
+For net faults ``handle`` matches a CHANNEL string (``send:<kind>``,
+``recv``, ``post:<handle_name>``, ``reply:<handle_name>``) rather
+than an MFC handle. ``partition`` opens a time window on its matching
+worker; the window outlives the one-shot firing.
+
 ``worker`` and ``handle`` are fnmatch patterns (``*`` = any). Faults
 are one-shot: each fires exactly once per matching spec. For
 crash-then-recover tests the injector persists fired fault ids to
 ``REALHF_TPU_FAULTS_STATE`` (a plain text file, one id per line) so a
-relaunched worker does not re-fire the same fault and crash-loop.
+relaunched worker does not re-fire the same fault and crash-loop;
+``net_*`` specs share the same state file, so a recovered process
+does not re-drop the same message.
 """
 
 import dataclasses
 import fnmatch
 import os
-from typing import Dict, List, Optional
+import threading
+import time
+from typing import Callable, Dict, List, Optional
 
 from realhf_tpu.base import logging
 
 logger = logging.getLogger("fault_injection")
 
+#: network-level kinds, executed by the wire shims (NetChaos) -- never
+#: by a worker's request handler
+NET_KINDS = ("net_drop", "net_delay", "partition")
+
 KINDS = ("crash", "die", "drop_reply", "delay_reply", "preempt",
-         "corrupt_ckpt")
+         "corrupt_ckpt") + NET_KINDS
 
 FAULTS_ENV = "REALHF_TPU_FAULTS"
 FAULTS_STATE_ENV = "REALHF_TPU_FAULTS_STATE"
@@ -69,6 +95,23 @@ class FaultSpec:
                              f"(known: {KINDS})")
         if self.nth < 1:
             raise ValueError(f"Fault nth must be >= 1, got {self.nth}")
+        # net kinds get actionable validation: a silently-zero window
+        # or delay would make a chaos drill pass without testing
+        # anything
+        if self.kind in ("net_delay", "partition") and self.seconds <= 0:
+            what = ("delay" if self.kind == "net_delay"
+                    else "partition window length")
+            raise ValueError(
+                f"Fault kind {self.kind!r} needs a positive seconds "
+                f"field (the {what}): write "
+                f"{self.kind}:{self.worker}:{self.handle}:{self.nth}"
+                f":<seconds>, got seconds={self.seconds}")
+        if self.kind == "net_drop" and self.seconds:
+            raise ValueError(
+                "Fault kind 'net_drop' discards exactly one matching "
+                "message and takes no seconds field (got "
+                f"seconds={self.seconds}); use net_delay for delays "
+                "or partition for time windows")
 
     @property
     def fault_id(self) -> str:
@@ -134,11 +177,19 @@ class FaultInjector:
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        """Injector over the request-handler kinds. ``net_*`` specs in
+        the same env var are EXCLUDED here -- they execute at the wire
+        shims (:func:`default_net_chaos`), not in a request handler
+        (a ``net_drop:*:*:1`` spec must never be consumed -- and
+        silently ignored -- by a model worker's Nth train_step)."""
         env = os.environ if env is None else env
         raw = env.get(FAULTS_ENV)
         if not raw:
             return None
-        return cls(parse_faults(raw), state_path=env.get(FAULTS_STATE_ENV))
+        specs = [s for s in parse_faults(raw) if s.kind not in NET_KINDS]
+        if not specs:
+            return None
+        return cls(specs, state_path=env.get(FAULTS_STATE_ENV))
 
     def _load_state(self) -> set:
         if not self.state_path or not os.path.isfile(self.state_path):
@@ -171,3 +222,137 @@ class FaultInjector:
                                s.nth)
                 return s
         return None
+
+
+class NetChaos:
+    """Network-level chaos, applied at the ZMQ send/recv shims.
+
+    One instance per process (or per in-process drill fleet); the
+    shims call :meth:`check` for every message with the local worker
+    name and a channel string. Deterministic: faults fire by event
+    COUNT (the spec's ``nth``), not wall time -- only partition window
+    LENGTH uses the clock, which is injectable.
+
+    - ``net_drop``: the nth matching message is discarded (one-shot).
+    - ``net_delay``: the nth matching message is delivered after an
+      inline sleep of ``seconds`` (one-shot).
+    - ``partition``: the nth matching event opens a window of
+      ``seconds`` during which EVERY message of any matching worker is
+      dropped and :meth:`partitioned` reports True -- the lease-renewal
+      paths consult it, so a partitioned replica also loses
+      name_resolve visibility and its fleet lease expires.
+
+    Thread-safe: shims in the serve loop and a worker's command thread
+    may consult it concurrently.
+    """
+
+    def __init__(self, specs: List[FaultSpec],
+                 state_path: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        specs = [s for s in specs if s.kind in NET_KINDS]
+        self._inj = FaultInjector(specs, state_path=state_path)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        #: active partition windows: (spec, end-time)
+        self._windows: List[tuple] = []
+        self.stats = dict(dropped=0, delayed=0, partitions=0)
+
+    @classmethod
+    def from_env(cls, env=None, **kwargs) -> Optional["NetChaos"]:
+        env = os.environ if env is None else env
+        raw = env.get(FAULTS_ENV)
+        if not raw:
+            return None
+        specs = [s for s in parse_faults(raw) if s.kind in NET_KINDS]
+        if not specs:
+            return None
+        return cls(specs, state_path=env.get(FAULTS_STATE_ENV),
+                   **kwargs)
+
+    # ------------------------------------------------------------------
+    def _prune_windows(self, now: float):
+        """Caller holds the lock."""
+        self._windows = [(s, e) for (s, e) in self._windows if e > now]
+
+    def open_partition(self, worker_pattern: str, seconds: float):
+        """Programmatically open a partition window (the chaos-drill
+        runner schedules partitions at exact drill ticks this way;
+        env-driven runs open them via ``partition`` specs)."""
+        with self._lock:
+            self.stats["partitions"] += 1
+            self._windows.append((
+                FaultSpec(kind="partition", worker=worker_pattern,
+                          seconds=seconds),
+                self._clock() + seconds))
+        logger.warning("Partition opened for worker %r: %.1fs.",
+                       worker_pattern, seconds)
+
+    def partitioned(self, worker: str) -> bool:
+        """Is ``worker`` inside an active partition window? Gates
+        name_resolve visibility (lease renewal/registration) as well
+        as the socket shims."""
+        with self._lock:
+            self._prune_windows(self._clock())
+            return any(fnmatch.fnmatchcase(worker, s.worker)
+                       for s, _ in self._windows)
+
+    def check(self, worker: str, channel: str) -> Optional[str]:
+        """Consult chaos for one message on (worker, channel).
+        Returns ``"drop"`` when the shim must discard the message;
+        sleeps inline for a firing ``net_delay``; None = deliver."""
+        delay = None
+        with self._lock:
+            now = self._clock()
+            self._prune_windows(now)
+            spec = self._inj.on_event(worker, channel)
+            if spec is not None:
+                if spec.kind == "net_drop":
+                    self.stats["dropped"] += 1
+                    return "drop"
+                if spec.kind == "net_delay":
+                    self.stats["delayed"] += 1
+                    delay = spec.seconds
+                elif spec.kind == "partition":
+                    self.stats["partitions"] += 1
+                    self._windows.append((spec, now + spec.seconds))
+            # an active window drops ALL of a matching worker's
+            # traffic, including the very message that opened it
+            for s, _ in self._windows:
+                if fnmatch.fnmatchcase(worker, s.worker):
+                    self.stats["dropped"] += 1
+                    return "drop"
+        if delay is not None:
+            # sleep OUTSIDE the lock: delaying one message must not
+            # stall other threads' chaos checks
+            self._sleep(delay)
+        return None
+
+
+# Process-wide NetChaos singleton, lazily built from REALHF_TPU_FAULTS
+# (the wire shims consult it so env-driven chaos needs no plumbing);
+# tests and in-process drills install their own via set_net_chaos.
+_net_chaos: Optional[NetChaos] = None
+_net_chaos_loaded = False
+_net_chaos_lock = threading.Lock()
+
+
+def default_net_chaos() -> Optional[NetChaos]:
+    global _net_chaos, _net_chaos_loaded
+    with _net_chaos_lock:
+        if not _net_chaos_loaded:
+            _net_chaos = NetChaos.from_env()
+            _net_chaos_loaded = True
+        return _net_chaos
+
+
+def set_net_chaos(chaos: Optional[NetChaos]) -> Optional[NetChaos]:
+    """Install (or clear, with None) the process-wide NetChaos;
+    returns the previous one so tests can restore it."""
+    global _net_chaos, _net_chaos_loaded
+    with _net_chaos_lock:
+        prev = _net_chaos if _net_chaos_loaded else None
+        _net_chaos = chaos
+        _net_chaos_loaded = True
+        return prev

@@ -6,6 +6,17 @@ add/get/delete/wait/get_subtree API with in-memory and shared-filesystem
 ``realhf_tpu.base.names``; peers poll or wait on them. The NFS backend
 is the default for multi-host TPU pods (any shared FS works); the
 memory backend serves single-process tests and the inline runner.
+
+Lease semantics: ``add(..., keepalive_ttl=N)`` creates an entry that
+EXPIRES -- reads treat it as absent once ``N`` seconds pass without a
+refresh (``touch`` or a replacing ``add``). The memory and NFS
+backends enforce this lazily at read time (no reaper thread); the
+Redis backend uses native key TTLs. On top of leases,
+``register_with_epoch`` keeps a monotonically increasing *fencing
+epoch* per name: every (re-)registration bumps it, so a consumer that
+remembers the epoch it rendezvoused at can reject a zombie holder
+that re-appears after its lease expired (docs/serving.md "Fleet,
+failover & circuit breakers").
 """
 
 import os
@@ -62,6 +73,43 @@ class NameRecordRepository(ABC):
         self.add(sub, value, **kwargs)
         return sub
 
+    def touch(self, name: str):
+        """Refresh the lease of a TTL'd entry (keepalive) without
+        rewriting its value. Raises NameEntryNotFoundError when the
+        entry is absent -- including when its lease already expired:
+        the holder must then re-register (and, if it used
+        ``register_with_epoch``, gets a NEW fencing epoch)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support touch()")
+
+    def register_with_epoch(self, name: str, value,
+                            epoch_name: Optional[str] = None,
+                            keepalive_ttl: Optional[float] = None) -> int:
+        """Register ``name`` under a lease and bump its fencing epoch.
+
+        The epoch is a monotonically increasing counter stored at
+        ``epoch_name`` (default ``name + ".fencing_epoch"``) that
+        survives lease expiry: every call returns ``previous + 1``.
+        ``value`` may be a callable taking the new epoch (so the
+        stored value can embed it, e.g. ``f"{epoch}:{address}"``).
+
+        Not atomic across racing registrants -- two concurrent callers
+        may observe the same previous epoch. For the intended use (one
+        replica process re-registering itself after losing its lease)
+        the bump itself is what fences: consumers pin the HIGHEST
+        epoch they have seen and reject anything older.
+        """
+        epoch_name = epoch_name or name + ".fencing_epoch"
+        try:
+            epoch = int(self.get(epoch_name)) + 1
+        except (NameEntryNotFoundError, ValueError):
+            epoch = 1
+        self.add(epoch_name, str(epoch), replace=True,
+                 delete_on_exit=False)
+        v = value(epoch) if callable(value) else value
+        self.add(name, str(v), replace=True, keepalive_ttl=keepalive_ttl)
+        return epoch
+
     def wait(self, name: str, timeout: Optional[float] = None,
              poll_frequency: float = 0.1) -> str:
         """Block until the entry exists, then return its value."""
@@ -107,23 +155,51 @@ class NameRecordRepository(ABC):
 
 
 class MemoryNameRecordRepository(NameRecordRepository):
-    """Single-process in-memory backend (reference :181)."""
+    """Single-process in-memory backend (reference :181).
 
-    def __init__(self):
-        self.__store: Dict[str, str] = {}
+    Lease-aware: entries added with ``keepalive_ttl`` expire (reads
+    treat them as absent) unless refreshed with ``touch`` or a
+    replacing ``add``. ``clock`` is injectable so lease expiry is
+    deterministic in tests and chaos drills."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        # name -> (value, expiry-or-None, ttl-or-None)
+        self.__store: Dict[str, tuple] = {}
         self.__lock = threading.Lock()
+        self.__clock = clock
+
+    def __alive(self, name) -> bool:
+        """Caller holds the lock. Lazily drops expired entries."""
+        ent = self.__store.get(name)
+        if ent is None:
+            return False
+        if ent[1] is not None and self.__clock() >= ent[1]:
+            del self.__store[name]
+            return False
+        return True
 
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
             replace=False):
         name = name.rstrip("/")
         with self.__lock:
-            if name in self.__store and not replace:
+            if self.__alive(name) and not replace:
                 raise NameEntryExistsError(name)
-            self.__store[name] = str(value)
+            expiry = (None if keepalive_ttl is None
+                      else self.__clock() + keepalive_ttl)
+            self.__store[name] = (str(value), expiry, keepalive_ttl)
+
+    def touch(self, name):
+        name = name.rstrip("/")
+        with self.__lock:
+            if not self.__alive(name):
+                raise NameEntryNotFoundError(name)
+            value, _, ttl = self.__store[name]
+            expiry = None if ttl is None else self.__clock() + ttl
+            self.__store[name] = (value, expiry, ttl)
 
     def delete(self, name):
         with self.__lock:
-            if name not in self.__store:
+            if not self.__alive(name):
                 raise NameEntryNotFoundError(name)
             del self.__store[name]
 
@@ -135,18 +211,20 @@ class MemoryNameRecordRepository(NameRecordRepository):
     def get(self, name):
         name = name.rstrip("/")
         with self.__lock:
-            if name not in self.__store:
+            if not self.__alive(name):
                 raise NameEntryNotFoundError(name)
-            return self.__store[name]
+            return self.__store[name][0]
 
     def get_subtree(self, name_root):
         with self.__lock:
-            return [v for k, v in sorted(self.__store.items())
-                    if k.startswith(name_root)]
+            return [self.__store[k][0]
+                    for k in sorted(self.__store)
+                    if k.startswith(name_root) and self.__alive(k)]
 
     def find_subtree(self, name_root):
         with self.__lock:
-            return sorted(k for k in self.__store if k.startswith(name_root))
+            return sorted(k for k in list(self.__store)
+                          if k.startswith(name_root) and self.__alive(k))
 
     def reset(self):
         self.__store = {}
@@ -157,6 +235,12 @@ class NfsNameRecordRepository(NameRecordRepository):
 
     Works on any POSIX FS visible to all hosts (NFS, GCS-fuse, local FS
     for single-host runs).
+
+    Leases: an entry with ``keepalive_ttl`` carries a ``TTL`` sidecar
+    file; the entry counts as expired once ``ENTRY``'s mtime plus the
+    TTL passes (wall clock -- the FS is shared across hosts, so keep
+    them NTP-disciplined as for heartbeats). ``touch`` refreshes the
+    mtime. Expiry is enforced lazily at read time.
     """
 
     def __init__(self, record_root: Optional[str] = None):
@@ -170,31 +254,93 @@ class NfsNameRecordRepository(NameRecordRepository):
     def __file_path(self, name: str) -> str:
         return os.path.join(self.__dir_path(name), "ENTRY")
 
+    def __ttl_path(self, name: str) -> str:
+        return os.path.join(self.__dir_path(name), "TTL")
+
+    def __expired(self, name: str) -> bool:
+        try:
+            with open(self.__ttl_path(name), "r") as f:
+                ttl = float(f.read())
+        except (FileNotFoundError, ValueError):
+            return False  # no lease: persistent entry
+        try:
+            mtime = os.path.getmtime(self.__file_path(name))
+        except FileNotFoundError:
+            return True
+        return time.time() >= mtime + ttl
+
+    def __alive(self, name: str) -> bool:
+        if not os.path.isfile(self.__file_path(name)):
+            return False
+        if self.__expired(name):
+            # lazy reap so the dead entry stops shadowing re-adds and
+            # subtree walks (best effort: a concurrent reaper is fine)
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+            return False
+        return True
+
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
             replace=False):
         name = name.rstrip("/")
         path = self.__file_path(name)
-        if os.path.isfile(path) and not replace:
+        if self.__alive(name) and not replace:
             raise NameEntryExistsError(name)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
-        with open(tmp, "w") as f:
-            f.write(str(value))
-        os.replace(tmp, path)  # atomic on POSIX
+        ttl_path = self.__ttl_path(name)
+        # retried: a concurrent delete() of a SIBLING key may prune
+        # the freshly-created parent dir between makedirs and open
+        # (registries share subtree roots across workers)
+        for attempt in range(8):
+            tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+            try:
+                # makedirs itself can lose the race: a concurrent
+                # prune may remove an intermediate dir mid-creation
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(tmp, "w") as f:
+                    f.write(str(value))
+                if keepalive_ttl is not None:
+                    with open(ttl_path + ".tmp", "w") as f:
+                        f.write(str(float(keepalive_ttl)))
+                    os.replace(ttl_path + ".tmp", ttl_path)
+                else:
+                    # re-registering without a TTL makes the entry
+                    # persistent
+                    try:
+                        os.remove(ttl_path)
+                    except FileNotFoundError:
+                        pass
+                # atomic on POSIX; mtime starts the lease
+                os.replace(tmp, path)
+                break
+            except FileNotFoundError:
+                if attempt == 7:
+                    raise
         if delete_on_exit:
             self.__to_delete.add(name)
 
+    def touch(self, name):
+        name = name.rstrip("/")
+        if not self.__alive(name):
+            raise NameEntryNotFoundError(name)
+        os.utime(self.__file_path(name), None)
+
     def delete(self, name):
         path = self.__file_path(name)
-        if not os.path.isfile(path):
-            raise NameEntryNotFoundError(name)
-        os.remove(path)
+        try:
+            os.remove(self.__ttl_path(name))
+        except FileNotFoundError:
+            pass
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            raise NameEntryNotFoundError(name) from None
         self.__to_delete.discard(name)
-        # Prune now-empty parent dirs for tidiness.
-        d = os.path.dirname(path)
-        while d != self.record_root and os.path.isdir(d) and not os.listdir(d):
-            os.rmdir(d)
-            d = os.path.dirname(d)
+        # Deliberately NO parent-dir pruning: concurrent writers share
+        # subtree roots (fleet registries, heartbeats), and an rmdir
+        # here races every sibling's makedirs+create. Empty dirs cost
+        # nothing and vanish with clear_subtree/reset.
 
     def clear_subtree(self, name_root):
         d = self.__dir_path(name_root)
@@ -203,9 +349,10 @@ class NfsNameRecordRepository(NameRecordRepository):
 
     def get(self, name):
         name = name.rstrip("/")
-        path = self.__file_path(name)
+        if not self.__alive(name):
+            raise NameEntryNotFoundError(name)
         try:
-            with open(path, "r") as f:
+            with open(self.__file_path(name), "r") as f:
                 return f.read()
         except FileNotFoundError:
             raise NameEntryNotFoundError(name)
@@ -218,11 +365,19 @@ class NfsNameRecordRepository(NameRecordRepository):
         for root, _, files in os.walk(d):
             if "ENTRY" in files:
                 key = os.path.relpath(root, self.record_root)
-                out.append(key)
+                if not self.__expired(key):
+                    out.append(key)
         return sorted(out)
 
     def get_subtree(self, name_root):
-        return [self.get(k) for k in self._walk_entries(name_root)]
+        out = []
+        for k in self._walk_entries(name_root):
+            # entries may expire between walk and read: skip them
+            try:
+                out.append(self.get(k))
+            except NameEntryNotFoundError:
+                pass
+        return out
 
     def find_subtree(self, name_root):
         return self._walk_entries(name_root)
@@ -315,6 +470,14 @@ class RedisNameRecordRepository(NameRecordRepository):
             self.__keepalive_ttl.pop(name, None)
         if delete_on_exit:
             self.__to_delete.add(name)
+
+    def touch(self, name):
+        name = name.rstrip("/")
+        if self.__client.get(name) is None:
+            raise NameEntryNotFoundError(name)
+        ttl = self.__keepalive_ttl.get(name)
+        if ttl is not None:
+            self.__client.expire(name, int(max(1, ttl)))
 
     def delete(self, name):
         if self.__client.delete(name) == 0:
@@ -417,6 +580,14 @@ def get_subtree(name_root):
 
 def find_subtree(name_root):
     return default().find_subtree(name_root)
+
+
+def touch(name):
+    return default().touch(name)
+
+
+def register_with_epoch(name, value, **kwargs):
+    return default().register_with_epoch(name, value, **kwargs)
 
 
 def wait(name, **kwargs):

@@ -8,7 +8,9 @@ injectable for deterministic tests.
 """
 
 import dataclasses
+import queue as queue_mod
 import random
+import threading
 import time
 from typing import Callable, Iterator, Optional, Tuple, Type
 
@@ -92,3 +94,117 @@ def retry_call(fn: Callable, policy: Optional[RetryPolicy] = None,
             if on_retry is not None:
                 on_retry(attempt, e)
             sleep(delay)
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class HedgeAttempt:
+    """Handed to every hedged call: ``index`` is the launch order (0 =
+    the primary), ``cancelled`` is set the moment another attempt wins
+    (poll it between blocking slices -- cancellation is cooperative),
+    ``deadline`` is the ABSOLUTE total deadline on the caller's clock
+    (from ``max_elapsed``), propagated so the call can bound its own
+    blocking primitives instead of overrunning the budget."""
+    index: int
+    cancelled: threading.Event
+    deadline: Optional[float] = None
+
+
+def hedged(call: Callable[[HedgeAttempt], object], delay: float,
+           max_hedges: int = 1, *,
+           max_elapsed: Optional[float] = None,
+           retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+           clock: Callable[[], float] = time.monotonic,
+           what: str = ""):
+    """First-success-wins hedging (tail-latency insurance for
+    idempotent calls: the serving router's replica health probes, a
+    client racing two replicas).
+
+    Launches ``call(attempt)`` in a worker thread; whenever no attempt
+    has returned after another ``delay`` seconds, launches one more
+    (at most ``1 + max_hedges`` in total). The first attempt to RETURN
+    wins: its value is returned and every other attempt's
+    ``attempt.cancelled`` event is set. An attempt raising one of
+    ``retry_on`` merely drops out of the race -- and, when every
+    launched attempt has failed, triggers the next hedge immediately
+    rather than waiting out the stagger. The last failure re-raises
+    only once ALL ``1 + max_hedges`` attempts have failed.
+
+    ``max_elapsed`` is the total wall-clock budget across all hedges
+    (the ``RetryPolicy.max_elapsed`` deadline discipline): each
+    attempt sees the absolute deadline via ``attempt.deadline``, and
+    on expiry everything is cancelled and TimeoutError raises.
+
+    Loser threads are daemons: a loser ignoring its cancelled event
+    can only leak until its own call returns, never hang shutdown.
+    """
+    if delay < 0:
+        raise ValueError(f"hedge delay must be >= 0, got {delay}")
+    results: "queue_mod.Queue" = queue_mod.Queue()
+    start = clock()
+    deadline = None if max_elapsed is None else start + max_elapsed
+    attempts: list = []
+
+    def _runner(att: HedgeAttempt):
+        try:
+            results.put((att, True, call(att)))
+        except retry_on as e:  # a losing attempt, not a verdict
+            results.put((att, False, e))
+        except BaseException as e:  # noqa: BLE001 - NOT hedgeable:
+            # propagate to the caller instead of vanishing in the
+            # thread (which would strand the waiter forever)
+            results.put((att, "fatal", e))
+
+    def _launch():
+        att = HedgeAttempt(index=len(attempts),
+                           cancelled=threading.Event(),
+                           deadline=deadline)
+        attempts.append(att)
+        threading.Thread(
+            target=_runner, args=(att,), daemon=True,
+            name=f"hedge-{what or 'call'}-{att.index}").start()
+        if att.index:
+            logger.info("Hedging %s: attempt #%d launched after "
+                        "%.2fs.", what or "call", att.index,
+                        clock() - start)
+
+    _launch()
+    failures = 0
+    last_exc: Optional[BaseException] = None
+    while True:
+        now = clock()
+        waits = []
+        if len(attempts) < 1 + max_hedges:
+            waits.append(max(0.0, start + delay * len(attempts) - now))
+        if deadline is not None:
+            waits.append(max(0.0, deadline - now))
+        try:
+            att, ok, val = results.get(
+                timeout=min(waits) if waits else None)
+        except queue_mod.Empty:
+            if deadline is not None and clock() >= deadline:
+                for a in attempts:
+                    a.cancelled.set()
+                raise TimeoutError(
+                    f"hedged {what or 'call'}: no attempt of "
+                    f"{len(attempts)} succeeded within max_elapsed="
+                    f"{max_elapsed:.2f}s") from last_exc
+            if (len(attempts) < 1 + max_hedges
+                    and clock() >= start + delay * len(attempts)):
+                _launch()
+            continue
+        if ok == "fatal":
+            for a in attempts:
+                a.cancelled.set()
+            raise val
+        if ok:
+            for a in attempts:
+                if a is not att:
+                    a.cancelled.set()
+            return val
+        failures += 1
+        last_exc = val
+        if failures >= 1 + max_hedges:
+            raise val
+        if failures == len(attempts) and len(attempts) < 1 + max_hedges:
+            _launch()  # everyone in flight failed: hedge immediately

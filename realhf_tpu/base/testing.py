@@ -32,3 +32,62 @@ class IntegerTokenizer:
 
     def decode(self, ids, **kw):
         return " ".join(map(str, ids))
+
+
+class FakeSlotBackend:
+    """Deterministic slot backend implementing the
+    ``engine.inflight.InflightBatchingGenerator`` step API without a
+    model: ``prompt[0]`` encodes how many tokens the sequence needs,
+    and every ``decode_chunk`` advances each live slot by up to
+    ``chunk`` tokens. Used by scheduler unit tests and the
+    chaos-drill harness (scripts/chaos_drill.py), where thousands of
+    serve iterations must run in milliseconds."""
+
+    def __init__(self, n_slots: int = 2, chunk: int = 4,
+                 max_prompt_len: int = 64):
+        self.n_slots = n_slots
+        self.chunk = chunk
+        self.max_prompt_len = max_prompt_len
+        self.params = "v0"
+        self._slots = {}  # slot -> [int_id, need, got]
+
+    def free_slots(self):
+        return [s for s in range(self.n_slots) if s not in self._slots]
+
+    def fill_slot(self, slot, int_id, prompt):
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} > {self.max_prompt_len}")
+        self._slots[slot] = [int_id, int(prompt[0]), 0]
+
+    def decode_chunk(self, key):
+        for v in self._slots.values():
+            v[2] = min(v[1], v[2] + self.chunk)
+
+    def harvest(self):
+        import numpy as np
+
+        from realhf_tpu.engine.inflight import FinishedSequence
+        out = []
+        for slot, (i, need, got) in list(self._slots.items()):
+            if got >= need:
+                out.append(FinishedSequence(
+                    request_id=i, tokens=np.arange(got),
+                    logprobs=np.zeros(got), no_eos=True))
+                del self._slots[slot]
+        return out
+
+    def release_slot(self, slot):
+        self._slots.pop(slot, None)
+
+    def swap_params(self, p):
+        self.params = p
+
+    def snapshot_slot(self, slot):
+        import numpy as np
+        _, _, got = self._slots[slot]
+        return np.arange(got), np.zeros(got)
+
+    @property
+    def n_live(self):
+        return len(self._slots)

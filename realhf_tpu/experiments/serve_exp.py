@@ -41,6 +41,18 @@ class ServeConfig(CommonExperimentConfig):
     pad_token_id: int = 0
     stream_tokens: bool = True
     drain_timeout_secs: float = 30.0
+    # resilient fleet mode: front the n_servers replicas with a
+    # health-aware FleetRouter (failover, hedging, circuit breakers --
+    # docs/serving.md); clients then use server_name="router"
+    fleet_router: bool = False
+    lease_ttl_secs: float = 5.0
+    router_hedge_delay_secs: Optional[float] = None
+    router_max_hedges: int = 1
+    router_breaker_failures: int = 3
+    router_breaker_cooldown_secs: float = 5.0
+    router_dispatch_timeout_secs: float = 10.0
+    router_response_timeout_secs: Optional[float] = 60.0
+    router_max_pending: int = 1024
     # sampling defaults for every request (per-request overrides ride
     # on the request itself in a future PR)
     max_new_tokens: int = 256
@@ -66,6 +78,15 @@ class ServeConfig(CommonExperimentConfig):
             pad_token_id=self.pad_token_id,
             stream_tokens=self.stream_tokens,
             drain_timeout_secs=self.drain_timeout_secs,
+            fleet_router=self.fleet_router,
+            lease_ttl_secs=self.lease_ttl_secs,
+            router_hedge_delay_secs=self.router_hedge_delay_secs,
+            router_max_hedges=self.router_max_hedges,
+            router_breaker_failures=self.router_breaker_failures,
+            router_breaker_cooldown_secs=self.router_breaker_cooldown_secs,
+            router_dispatch_timeout_secs=self.router_dispatch_timeout_secs,
+            router_response_timeout_secs=self.router_response_timeout_secs,
+            router_max_pending=self.router_max_pending,
             gconfig=dict(
                 max_new_tokens=self.max_new_tokens,
                 min_new_tokens=self.min_new_tokens,

@@ -6,11 +6,21 @@ iteration-level scheduler with weight hot-swap and bounded staleness,
 and a ZMQ streaming server/client pair wired into the worker stack.
 """
 
+from realhf_tpu.serving.fleet import (  # noqa: F401
+    FleetRegistry,
+    LeaseLostError,
+    ReplicaInfo,
+)
 from realhf_tpu.serving.request_queue import (  # noqa: F401
     AdmissionVerdict,
     GenRequest,
     Priority,
     RequestQueue,
+)
+from realhf_tpu.serving.router import (  # noqa: F401
+    BreakerState,
+    CircuitBreaker,
+    FleetRouter,
 )
 from realhf_tpu.serving.scheduler import (  # noqa: F401
     ContinuousScheduler,

@@ -1,0 +1,137 @@
+"""Fleet membership: leased replica registry with fencing epochs.
+
+The rendezvous layer of the resilient serving fleet (docs/serving.md
+"Fleet, failover & circuit breakers"). Every ``RolloutServer`` replica
+registers itself under a name_resolve subtree with a ``keepalive_ttl``
+lease and renews it from its serve loop; the ``FleetRouter`` reads the
+subtree to discover live replicas. A replica that dies, hangs, or is
+partitioned away stops renewing, its lease expires, and it simply
+vanishes from the registry -- the router's loss signal needs no extra
+protocol.
+
+Fencing: each registration bumps a per-replica *epoch* (persistent --
+it survives lease expiry, see
+``name_resolve.NameRecordRepository.register_with_epoch``). The
+stored value embeds the epoch (``"<epoch>:<address>"``), so one
+subtree read gives the router a consistent (address, epoch) pair. A
+zombie replica that lost its lease and re-registers gets a HIGHER
+epoch; consumers pin the highest epoch seen per name and fence out
+anything older.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+from realhf_tpu.base import logging, name_resolve, names
+
+logger = logging.getLogger("serving.fleet", "system")
+
+
+class LeaseLostError(RuntimeError):
+    """A replica's lease expired (or was never held) when it tried to
+    renew: the holder is fenced out and must re-register, obtaining a
+    new fencing epoch, before serving again."""
+
+
+def fleet_root(experiment_name: str, trial_name: str) -> str:
+    return (names.trial_root(experiment_name, trial_name)
+            + "/serving_fleet")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaInfo:
+    """One live fleet member, as read from the registry."""
+    name: str
+    address: str
+    epoch: int
+
+
+class FleetRegistry:
+    """Leased replica membership over one name_resolve repository.
+
+    ``repo`` defaults to the process-wide name_resolve default; drills
+    and tests pass a private ``MemoryNameRecordRepository`` (with an
+    injectable clock, making lease expiry deterministic).
+    """
+
+    def __init__(self, experiment_name: str, trial_name: str, *,
+                 lease_ttl: float = 5.0,
+                 repo: Optional[name_resolve.NameRecordRepository] = None):
+        if lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
+        self.lease_ttl = lease_ttl
+        self._root = fleet_root(experiment_name, trial_name)
+        self._repo = repo if repo is not None else name_resolve.default()
+
+    # -- key layout ----------------------------------------------------
+    # replicas/ holds the leased entries; epochs/ the persistent
+    # fencing counters. Separate subtrees so a replica listing never
+    # mixes in epoch bookkeeping.
+    def _replica_key(self, name: str) -> str:
+        return f"{self._root}/replicas/{name}"
+
+    def _epoch_key(self, name: str) -> str:
+        return f"{self._root}/epochs/{name}"
+
+    # ------------------------------------------------------------------
+    def register(self, name: str, address: str) -> int:
+        """(Re-)register a replica; returns its NEW fencing epoch."""
+        epoch = self._repo.register_with_epoch(
+            self._replica_key(name),
+            lambda e: f"{e}:{address}",
+            epoch_name=self._epoch_key(name),
+            keepalive_ttl=self.lease_ttl)
+        logger.info("Fleet replica %s registered at %s (epoch %d, "
+                    "lease %.1fs).", name, address, epoch,
+                    self.lease_ttl)
+        return epoch
+
+    def renew(self, name: str):
+        """Refresh the replica's lease. Raises LeaseLostError when the
+        lease already expired -- the caller is fenced and must
+        ``register`` again (new epoch) before serving."""
+        try:
+            self._repo.touch(self._replica_key(name))
+        except name_resolve.NameEntryNotFoundError:
+            raise LeaseLostError(
+                f"Replica {name}: lease expired (ttl="
+                f"{self.lease_ttl:.1f}s); re-register for a new "
+                "fencing epoch before serving.") from None
+
+    def deregister(self, name: str):
+        """Graceful departure (drain/exit): drop the lease now instead
+        of letting it time out. The epoch counter stays."""
+        try:
+            self._repo.delete(self._replica_key(name))
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+    # ------------------------------------------------------------------
+    def replicas(self) -> Dict[str, ReplicaInfo]:
+        """Live (unexpired) replicas as {name: ReplicaInfo}."""
+        root = f"{self._root}/replicas"
+        out: Dict[str, ReplicaInfo] = {}
+        for key in self._repo.find_subtree(root):
+            name = key[len(root) + 1:] if key.startswith(root + "/") \
+                else key
+            try:
+                raw = self._repo.get(key)
+            except name_resolve.NameEntryNotFoundError:
+                continue  # expired between walk and read
+            try:
+                epoch_s, address = str(raw).split(":", 1)
+                out[name] = ReplicaInfo(name=name, address=address,
+                                        epoch=int(epoch_s))
+            except ValueError:
+                logger.warning("Fleet registry: malformed replica "
+                               "entry %s=%r ignored.", key, raw)
+        return out
+
+    def epoch_of(self, name: str) -> Optional[int]:
+        """Current fencing epoch counter for a replica name (None if
+        it never registered). Advances only on registration, so a
+        holder can cheaply verify it is still the newest registrant."""
+        try:
+            return int(self._repo.get(self._epoch_key(name)))
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None

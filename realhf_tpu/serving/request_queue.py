@@ -191,6 +191,12 @@ class RequestQueue:
         with self._lock:
             return sum(len(q) for q in self._by_class.values())
 
+    def __bool__(self):
+        # an EMPTY queue is still a queue: without this, __len__ makes
+        # `queue or default` silently replace a caller-provided empty
+        # queue (the PR-2 `queue if queue is not None` footgun)
+        return True
+
     def depth_by_class(self) -> Dict[str, int]:
         with self._lock:
             return {p.name: len(q) for p, q in self._by_class.items()}

@@ -1,0 +1,784 @@
+"""FleetRouter: health-aware routing in front of N rollout replicas.
+
+The resilience layer of the serving fleet (docs/serving.md "Fleet,
+failover & circuit breakers"). Clients speak the ordinary
+``RolloutClient`` wire protocol to the router's front ROUTER socket;
+the router holds one DEALER per live replica (discovered through the
+:class:`~realhf_tpu.serving.fleet.FleetRegistry` lease subtree) and
+keeps the fleet correct and available while replicas die, hang, and
+partition underneath it:
+
+- **Health-aware least-loaded dispatch**: new requests go to the
+  healthy replica with the fewest router-tracked in-flight requests.
+- **Per-replica circuit breakers**: consecutive failures/timeouts open
+  the breaker; after a cooldown it half-opens and a single in-loop
+  ping probe decides between closing and re-opening.
+- **Idempotent request ids**: the client's rid travels unchanged to
+  every replica that ever works on it, so hedged duplicates and
+  failover re-dispatches are safe -- duplicate terminal events are
+  deduped at the router and the client sees exactly one (at-most-once
+  delivery).
+- **In-flight failover**: when a lease expires (or a watchdog calls
+  :meth:`notify_lost`), the LOST replica's un-harvested requests are
+  re-dispatched to healthy replicas with a ``retried_from`` stamp
+  instead of vanishing. A streaming client is told via a ``retrying``
+  event that its token stream restarts.
+- **Hedging**: a request that has not started within ``hedge_delay``
+  is speculatively dispatched to a second replica; the first terminal
+  event wins and the loser is cancelled.
+- **Fencing**: each replica connection is pinned to the fencing epoch
+  it rendezvoused at. A re-registration (new epoch) atomically swaps
+  the connection; the old socket is closed, so a zombie incarnation
+  cannot deliver anything through the router.
+
+Single-threaded like ``RolloutServer``: drive :meth:`route_step` from
+a worker poll loop (``RouterWorker``) or a dedicated thread. The only
+blocking entry point is :meth:`probe`, a hedged health check meant for
+startup/ops use outside the serve loop.
+"""
+
+import dataclasses
+import enum
+import pickle
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+import numpy as np
+import zmq
+
+from realhf_tpu.base import fault_injection, logging, name_resolve, \
+    network, retry
+from realhf_tpu.obs import metrics
+from realhf_tpu.serving.fleet import FleetRegistry, ReplicaInfo
+from realhf_tpu.serving.server import TERMINAL_KINDS, rollout_server_key
+
+logger = logging.getLogger("serving.router", "system")
+
+
+class BreakerState(enum.Enum):
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+
+class CircuitBreaker:
+    """Per-replica failure gate: ``failure_threshold`` consecutive
+    failures open it; after ``cooldown`` seconds it may half-open for
+    exactly one probe, whose outcome closes or re-opens it. Successes
+    in any state reset the failure count and close."""
+
+    def __init__(self, failure_threshold: int = 3, cooldown: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable] = None):
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self._clock = clock
+        self._on_transition = on_transition
+        self.state = BreakerState.CLOSED
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+
+    def _to(self, state: BreakerState):
+        if state is self.state:
+            return
+        prev, self.state = self.state, state
+        if self._on_transition is not None:
+            self._on_transition(prev, state)
+
+    def record_success(self):
+        self.failures = 0
+        self._to(BreakerState.CLOSED)
+
+    def record_failure(self):
+        self.failures += 1
+        if self.state is BreakerState.HALF_OPEN \
+                or self.failures >= self.failure_threshold:
+            self._to(BreakerState.OPEN)
+            self.opened_at = self._clock()
+
+    def force_open(self):
+        """Immediate open (lease expiry / watchdog LOST): no need to
+        accumulate failures against a replica known dead."""
+        self.failures = max(self.failures, self.failure_threshold)
+        self._to(BreakerState.OPEN)
+        self.opened_at = self._clock()
+
+    def allow(self) -> bool:
+        return self.state is BreakerState.CLOSED
+
+    def ready_to_probe(self) -> bool:
+        return (self.state is BreakerState.OPEN
+                and self.opened_at is not None
+                and self._clock() - self.opened_at >= self.cooldown)
+
+    def half_open(self):
+        if self.state is BreakerState.OPEN:
+            self._to(BreakerState.HALF_OPEN)
+
+
+@dataclasses.dataclass
+class _Replica:
+    name: str
+    address: str
+    epoch: int
+    sock: object
+    breaker: CircuitBreaker
+    inflight: Set[str] = dataclasses.field(default_factory=set)
+    lost: bool = False
+    probe_sent_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _RouterRequest:
+    rid: str
+    ident: bytes
+    prompt: np.ndarray
+    priority: int
+    min_weight_version: int
+    trace: Optional[dict]
+    created_at: float
+    deadline: Optional[float]
+    #: replica -> dispatch time, for every dispatch still outstanding
+    assigned: Dict[str, float] = dataclasses.field(default_factory=dict)
+    accepted: Set[str] = dataclasses.field(default_factory=set)
+    #: replicas excluded from further dispatch of THIS rid
+    failed: Set[str] = dataclasses.field(default_factory=set)
+    #: hedge losers we cancelled (their `cancelled` terminal is
+    #: bookkeeping, not the client's outcome)
+    losers: Set[str] = dataclasses.field(default_factory=set)
+    owner: Optional[str] = None
+    primary: Optional[str] = None
+    retried_from: List[str] = dataclasses.field(default_factory=list)
+    hedged: bool = False
+    accepted_fwd: bool = False
+    started_fwd: bool = False
+    last_event_at: float = 0.0
+    client_cancelled: bool = False
+
+
+_BREAKER_GAUGE = {BreakerState.CLOSED: 0, BreakerState.HALF_OPEN: 1,
+                  BreakerState.OPEN: 2}
+
+
+class FleetRouter:
+    """Front a fleet of ``RolloutServer`` replicas (module doc)."""
+
+    def __init__(self, registry: FleetRegistry, *,
+                 router_name: str = "router/0",
+                 experiment_name: Optional[str] = None,
+                 trial_name: Optional[str] = None,
+                 publish_name: str = "router",
+                 max_pending: int = 1024,
+                 dispatch_timeout: float = 10.0,
+                 response_timeout: Optional[float] = 60.0,
+                 pending_timeout: float = 60.0,
+                 hedge_delay: Optional[float] = None,
+                 max_hedges: int = 1,
+                 breaker_failures: int = 3,
+                 breaker_cooldown: float = 5.0,
+                 probe_timeout: float = 2.0,
+                 fleet_poll_interval: float = 0.5,
+                 chaos: Optional[fault_injection.NetChaos] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.router_name = router_name
+        self.registry = registry
+        self.max_pending = max_pending
+        self.dispatch_timeout = dispatch_timeout
+        self.response_timeout = response_timeout
+        self.pending_timeout = pending_timeout
+        self.hedge_delay = hedge_delay
+        self.max_hedges = max_hedges
+        self.breaker_failures = breaker_failures
+        self.breaker_cooldown = breaker_cooldown
+        self.probe_timeout = probe_timeout
+        self.fleet_poll_interval = fleet_poll_interval
+        self._clock = clock
+        self._chaos = chaos if chaos is not None \
+            else fault_injection.default_net_chaos()
+        self._ctx = zmq.Context.instance()
+        self._front = self._ctx.socket(zmq.ROUTER)
+        port = self._front.bind_to_random_port("tcp://*")
+        self.address = f"tcp://{network.gethostip()}:{port}"
+        if experiment_name is not None and trial_name is not None:
+            # clients rendezvous exactly as they would with a single
+            # server: RolloutClient(..., server_name="router")
+            name_resolve.add(
+                rollout_server_key(experiment_name, trial_name,
+                                   publish_name),
+                self.address, replace=True)
+        self._replicas: Dict[str, _Replica] = {}
+        self._requests: Dict[str, _RouterRequest] = {}
+        self._pending: List[str] = []      # rids awaiting a replica
+        #: recently-finished rids for duplicate-terminal dedupe,
+        #: bounded so a long-lived router cannot grow without limit
+        self._done: Dict[str, str] = {}    # rid -> outcome kind
+        self._done_cap = 8192
+        self._last_fleet_poll = -1e9
+        self._draining = False
+        self._closed = False
+        self.stats_counters = dict(
+            requests=0, dispatches=0, failovers=0, hedges=0,
+            hedge_wins=0, duplicate_terminals=0, stale_events=0,
+            fenced_reconnects=0)
+        logger.info("Fleet router %s listening on %s.", router_name,
+                    self.address)
+
+    # -- fleet membership ----------------------------------------------
+    def _set_breaker_gauge(self, name: str, state: BreakerState):
+        metrics.set_gauge("router_breaker_state",
+                          _BREAKER_GAUGE[state], replica=name)
+
+    def _make_breaker(self, name: str) -> CircuitBreaker:
+        def on_transition(prev, new, _name=name):
+            metrics.inc("router_breaker_transitions_total",
+                        replica=_name, to=new.name.lower())
+            self._set_breaker_gauge(_name, new)
+            logger.info("Router breaker for %s: %s -> %s.", _name,
+                        prev.name, new.name)
+
+        br = CircuitBreaker(self.breaker_failures, self.breaker_cooldown,
+                            clock=self._clock,
+                            on_transition=on_transition)
+        self._set_breaker_gauge(name, br.state)
+        return br
+
+    def _connect(self, info: ReplicaInfo) -> object:
+        sock = self._ctx.socket(zmq.DEALER)
+        sock.connect(info.address)
+        return sock
+
+    def _refresh_fleet(self, force: bool = False):
+        now = self._clock()
+        if not force and now - self._last_fleet_poll \
+                < self.fleet_poll_interval:
+            return
+        self._last_fleet_poll = now
+        live = self.registry.replicas()
+        for name, info in live.items():
+            rep = self._replicas.get(name)
+            if rep is None:
+                self._replicas[name] = _Replica(
+                    name=name, address=info.address, epoch=info.epoch,
+                    sock=self._connect(info),
+                    breaker=self._make_breaker(name))
+                logger.info("Router: replica %s joined (epoch %d, "
+                            "%s).", name, info.epoch, info.address)
+                continue
+            if info.epoch != rep.epoch or info.address != rep.address:
+                # re-registration: the old connection belongs to a
+                # fenced-out incarnation -- swap it atomically so the
+                # zombie cannot deliver anything, and fail over work
+                # that was riding on it
+                logger.warning(
+                    "Router: replica %s re-registered (epoch %d -> "
+                    "%d); fencing the old connection.", name,
+                    rep.epoch, info.epoch)
+                self.stats_counters["fenced_reconnects"] += 1
+                metrics.inc("router_fenced_reconnects_total",
+                            replica=name)
+                self._failover_replica(rep, why="re-registered")
+                rep.sock.close(0)
+                rep.sock = self._connect(info)
+                rep.address, rep.epoch = info.address, info.epoch
+                rep.lost = False
+            elif rep.lost:
+                # lease reappeared with the SAME epoch: renewals
+                # resumed before expiry was observed consistently
+                rep.lost = False
+        for name, rep in self._replicas.items():
+            if name not in live and not rep.lost:
+                self._mark_lost(rep, why="lease expired")
+        n_healthy = sum(1 for r in self._replicas.values()
+                        if not r.lost and r.breaker.allow())
+        metrics.set_gauge("router_replicas", len(live), state="live")
+        metrics.set_gauge("router_replicas", n_healthy, state="healthy")
+
+    def notify_lost(self, name: str):
+        """Watchdog hook: mark a replica LOST now, without waiting for
+        its lease to expire (``Watchdog(on_lost=router.notify_lost)``
+        when both live in one process)."""
+        rep = self._replicas.get(name)
+        if rep is not None and not rep.lost:
+            self._mark_lost(rep, why="watchdog LOST")
+
+    def _mark_lost(self, rep: _Replica, why: str):
+        logger.warning("Router: replica %s LOST (%s); failing over "
+                       "%d in-flight request(s).", rep.name, why,
+                       len(rep.inflight))
+        rep.lost = True
+        rep.breaker.force_open()
+        # close the socket NOW: anything the dead/zombie incarnation
+        # still emits must not reach the router (fencing)
+        rep.sock.close(0)
+        self._failover_replica(rep, why=why)
+
+    def _failover_replica(self, rep: _Replica, why: str):
+        for rid in sorted(rep.inflight):
+            req = self._requests.get(rid)
+            if req is None:
+                continue
+            self._fail_assignment(req, rep.name, why=why)
+        rep.inflight.clear()
+
+    # -- client side ---------------------------------------------------
+    def route_step(self, poll_timeout: float = 0.0) -> int:
+        """One router iteration: refresh membership, pump the client
+        socket (waiting up to ``poll_timeout`` seconds when idle) and
+        every replica socket, then run dispatch/hedge/timeout/probe
+        maintenance. Returns how many client messages were handled."""
+        self._refresh_fleet()
+        handled = self._pump_front(poll_timeout)
+        self._pump_replicas()
+        now = self._clock()
+        self._check_timeouts(now)
+        self._maybe_hedge(now)
+        self._dispatch_pending()
+        self._probe_breakers(now)
+        metrics.set_gauge("router_pending", len(self._pending))
+        metrics.set_gauge("router_inflight", len(self._requests))
+        return handled
+
+    def _pump_front(self, poll_timeout: float) -> int:
+        n = 0
+        while self._front.poll(poll_timeout * 1000 if n == 0 else 0):
+            ident, raw = self._front.recv_multipart()
+            if self._chaos is not None and self._chaos.check(
+                    self.router_name, "recv") == "drop":
+                continue
+            try:
+                self._handle_client(ident, pickle.loads(raw))
+            except Exception as e:  # noqa: BLE001 - a malformed client
+                # message must not kill the routing loop
+                logger.error("Router: bad client message: %r", e)
+            n += 1
+        return n
+
+    def _handle_client(self, ident: bytes, msg: tuple):
+        kind = msg[0]
+        if kind == "submit":
+            _, rid, prompt, priority, ttl, min_wv = msg[:6]
+            trace = msg[6] if len(msg) > 6 else None
+            now = self._clock()
+            if rid in self._requests or rid in self._done:
+                # idempotency: a duplicate submit of a known rid is
+                # dropped, never double-dispatched
+                self.stats_counters["stale_events"] += 1
+                return
+            if self._draining:
+                self._reply(ident, "rejected", rid,
+                            dict(reason="draining", retry_after=None))
+                return
+            if len(self._requests) >= self.max_pending:
+                metrics.inc("router_rejections_total",
+                            reason="backpressure")
+                self._reply(ident, "rejected", rid,
+                            dict(reason="backpressure", retry_after=1.0))
+                return
+            req = _RouterRequest(
+                rid=rid, ident=ident,
+                prompt=np.asarray(prompt, np.int32),
+                priority=int(priority),
+                min_weight_version=min_wv, trace=trace,
+                created_at=now,
+                deadline=None if ttl is None else now + ttl,
+                last_event_at=now)
+            self._requests[rid] = req
+            self._pending.append(rid)
+            self.stats_counters["requests"] += 1
+            metrics.inc("router_requests_total")
+        elif kind == "cancel":
+            rid = msg[1]
+            req = self._requests.get(rid)
+            if req is None:
+                return
+            req.client_cancelled = True
+            if not req.assigned:
+                self._finish(req, "cancelled", {}, from_replica=None)
+            else:
+                for rname in list(req.assigned):
+                    self._send_replica(rname, ("cancel", rid))
+        elif kind == "ping":
+            self._reply(ident, "pong", "", {})
+        else:
+            logger.warning("Router: unknown client message kind %r.",
+                           kind)
+
+    # -- replica side --------------------------------------------------
+    def _pump_replicas(self):
+        for rep in list(self._replicas.values()):
+            if rep.lost:
+                continue
+            try:
+                while rep.sock.poll(0):
+                    raw = rep.sock.recv()
+                    try:
+                        kind, rid, data = pickle.loads(raw)
+                    except Exception as e:  # noqa: BLE001
+                        logger.error("Router: bad replica message "
+                                     "from %s: %r", rep.name, e)
+                        continue
+                    self._on_replica_event(rep, kind, rid, data)
+            except zmq.ZMQError as e:
+                logger.warning("Router: recv from %s failed: %s.",
+                               rep.name, e)
+                rep.breaker.record_failure()
+
+    def _on_replica_event(self, rep: _Replica, kind: str, rid: str,
+                          data: dict):
+        # any traffic proves the replica's serve loop is alive
+        rep.breaker.record_success()
+        rep.probe_sent_at = None
+        if kind == "pong":
+            return
+        req = self._requests.get(rid)
+        if req is None:
+            rep.inflight.discard(rid)
+            if rid in self._done and kind in TERMINAL_KINDS:
+                # the hedge/failover twin already delivered: dedupe
+                self.stats_counters["duplicate_terminals"] += 1
+                metrics.inc("router_duplicate_terminals_total",
+                            replica=rep.name)
+            else:
+                self.stats_counters["stale_events"] += 1
+                metrics.inc("router_stale_events_total",
+                            replica=rep.name)
+            return
+        req.last_event_at = self._clock()
+        if kind == "accepted":
+            req.accepted.add(rep.name)
+            if not req.accepted_fwd:
+                req.accepted_fwd = True
+                self._forward(req, kind, data)
+            return
+        if kind == "started":
+            if req.owner is None:
+                req.owner = rep.name
+                if not req.started_fwd:
+                    req.started_fwd = True
+                    self._forward(req, kind, data)
+            elif req.owner != rep.name:
+                # hedge race: someone else leads; cancel this copy
+                req.losers.add(rep.name)
+                self._send_replica(rep.name, ("cancel", rid))
+            return
+        if kind == "tokens":
+            if req.owner is None:
+                req.owner = rep.name
+            if req.owner == rep.name:
+                self._forward(req, kind, data)
+            return
+        if kind in TERMINAL_KINDS:
+            rep.inflight.discard(rid)
+            req.assigned.pop(rep.name, None)
+            if kind == "cancelled" and rep.name in req.losers \
+                    and not req.client_cancelled:
+                return  # a hedge loser acking our cancel: bookkeeping
+            if kind in ("rejected", "draining") \
+                    and not req.client_cancelled:
+                self._on_replica_reject(rep, req, kind, data)
+                return
+            self._finish(req, kind, data, from_replica=rep.name)
+            return
+        # unknown event kinds pass through to the owner's client
+        if req.owner in (None, rep.name):
+            self._forward(req, kind, data)
+
+    def _on_replica_reject(self, rep: _Replica, req: _RouterRequest,
+                           kind: str, data: dict):
+        reason = data.get("reason", kind)
+        if reason in ("prompt_too_long", "expired"):
+            # deterministic verdicts every replica would agree on:
+            # forward, do not shop around
+            self._finish(req, "rejected" if kind == "rejected" else kind,
+                         data, from_replica=rep.name)
+            return
+        # transient (backpressure / draining / weights_behind): try
+        # another replica; only when nobody is left does the client
+        # see the rejection
+        req.failed.add(rep.name)
+        if self._dispatch(req):
+            return
+        if req.assigned:
+            return  # a hedge twin is still working on it
+        self._finish(req, kind, data, from_replica=rep.name)
+
+    # -- dispatch ------------------------------------------------------
+    def _candidates(self, req: _RouterRequest) -> List[_Replica]:
+        out = [r for r in self._replicas.values()
+               if not r.lost and r.breaker.allow()
+               and r.name not in req.assigned
+               and r.name not in req.failed]
+        # least-loaded, name as the deterministic tie-break
+        out.sort(key=lambda r: (len(r.inflight), r.name))
+        return out
+
+    def _dispatch(self, req: _RouterRequest) -> bool:
+        cands = self._candidates(req)
+        if not cands:
+            return False
+        rep = cands[0]
+        now = self._clock()
+        ttl = None if req.deadline is None \
+            else max(0.05, req.deadline - now)
+        env = ("submit", req.rid, req.prompt, req.priority, ttl,
+               req.min_weight_version, req.trace)
+        if not self._send_replica(rep.name, env):
+            return False
+        req.assigned[rep.name] = now
+        req.last_event_at = now
+        if req.primary is None:
+            req.primary = rep.name
+        rep.inflight.add(req.rid)
+        self.stats_counters["dispatches"] += 1
+        metrics.inc("router_dispatches_total", replica=rep.name)
+        return True
+
+    def _dispatch_pending(self):
+        still: List[str] = []
+        now = self._clock()
+        for rid in self._pending:
+            req = self._requests.get(rid)
+            if req is None:
+                continue
+            if req.assigned or self._dispatch(req):
+                continue
+            if now - req.created_at > self.pending_timeout:
+                metrics.inc("router_rejections_total",
+                            reason="no_healthy_replica")
+                self._finish(req, "rejected",
+                             dict(reason="no_healthy_replica",
+                                  retry_after=self.breaker_cooldown),
+                             from_replica=None)
+                continue
+            still.append(rid)
+        self._pending = still
+
+    def _send_replica(self, rname: str, envelope: tuple) -> bool:
+        rep = self._replicas.get(rname)
+        if rep is None or rep.lost:
+            return False
+        if self._chaos is not None and self._chaos.check(
+                self.router_name,
+                f"dispatch.{envelope[0]}") == "drop":
+            return True  # the wire ate it; timeouts must recover
+        try:
+            rep.sock.send(pickle.dumps(envelope))
+            return True
+        except zmq.ZMQError as e:
+            logger.warning("Router: send to %s failed: %s.", rname, e)
+            rep.breaker.record_failure()
+            return False
+
+    def _fail_assignment(self, req: _RouterRequest, rname: str,
+                         why: str):
+        """One replica's copy of a request is gone (loss, stall,
+        dispatch timeout): exclude the replica for this rid and
+        re-dispatch unless a twin is still live."""
+        req.assigned.pop(rname, None)
+        req.failed.add(rname)
+        if req.owner == rname:
+            req.owner = None
+        if req.rid in self._done or req.client_cancelled:
+            return
+        req.retried_from.append(rname)
+        self.stats_counters["failovers"] += 1
+        metrics.inc("router_failovers_total", replica=rname)
+        if req.started_fwd:
+            # a streaming client must reset its token accumulation:
+            # the replacement replica re-generates from the prompt,
+            # and its own `started` is forwarded again
+            req.started_fwd = False
+            self._forward(req, "retrying",
+                          dict(retried_from=list(req.retried_from),
+                               reason=why))
+        if not self._dispatch(req) and not req.assigned \
+                and req.rid not in self._pending:
+            self._pending.append(req.rid)
+
+    # -- maintenance ---------------------------------------------------
+    def _check_timeouts(self, now: float):
+        for req in list(self._requests.values()):
+            if req.deadline is not None and now >= req.deadline:
+                for rname in list(req.assigned):
+                    self._send_replica(rname, ("cancel", req.rid))
+                metrics.inc("router_expired_total")
+                self._finish(req, "expired", {}, from_replica=None)
+                continue
+            for rname, at in list(req.assigned.items()):
+                if rname not in req.accepted \
+                        and now - at > self.dispatch_timeout:
+                    rep = self._replicas.get(rname)
+                    if rep is not None:
+                        rep.breaker.record_failure()
+                        rep.inflight.discard(req.rid)
+                    self._fail_assignment(req, rname,
+                                          why="dispatch timeout")
+            if (self.response_timeout is not None and req.assigned
+                    and now - req.last_event_at > self.response_timeout):
+                # accepted but gone quiet (e.g. a dropped terminal
+                # send): treat the laggard copies as failed
+                for rname in list(req.assigned):
+                    rep = self._replicas.get(rname)
+                    if rep is not None:
+                        rep.breaker.record_failure()
+                        rep.inflight.discard(req.rid)
+                    self._send_replica(rname, ("cancel", req.rid))
+                    self._fail_assignment(req, rname,
+                                          why="response timeout")
+
+    def _maybe_hedge(self, now: float):
+        if self.hedge_delay is None:
+            return
+        for req in list(self._requests.values()):
+            if req.owner is not None or not req.assigned \
+                    or req.client_cancelled:
+                continue
+            n_extra = len(req.assigned) - 1
+            if n_extra >= self.max_hedges:
+                continue
+            first_at = min(req.assigned.values())
+            if now - first_at < self.hedge_delay * (n_extra + 1):
+                continue
+            if self._dispatch(req):
+                req.hedged = True
+                self.stats_counters["hedges"] += 1
+                metrics.inc("router_hedges_total")
+
+    def _probe_breakers(self, now: float):
+        for rep in self._replicas.values():
+            if rep.lost:
+                continue
+            br = rep.breaker
+            if br.ready_to_probe():
+                br.half_open()
+                rep.probe_sent_at = now
+                self._send_replica(rep.name, ("ping",))
+            elif (br.state is BreakerState.HALF_OPEN
+                  and rep.probe_sent_at is not None
+                  and now - rep.probe_sent_at > self.probe_timeout):
+                rep.probe_sent_at = None
+                br.record_failure()  # probe unanswered: re-open
+
+    # -- delivery ------------------------------------------------------
+    def _forward(self, req: _RouterRequest, kind: str, data: dict):
+        self._send_ident(req.ident, kind, req.rid, data)
+
+    def _send_ident(self, ident: bytes, kind: str, rid: str,
+                    data: dict):
+        if self._chaos is not None and self._chaos.check(
+                self.router_name, f"send.{kind}") == "drop":
+            return
+        payload = pickle.dumps((kind, rid, data))
+        try:
+            self._front.send_multipart([ident, payload])
+        except zmq.ZMQError as e:
+            logger.warning("Router: dropping %s for %s: %s", kind,
+                           rid, e)
+
+    def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
+        self._send_ident(ident, kind, rid, data)
+
+    def _finish(self, req: _RouterRequest, kind: str, data: dict,
+                from_replica: Optional[str]):
+        """Deliver THE terminal event for a request (at-most-once) and
+        retire its state; twins still running are cancelled and their
+        later terminals dedupe against ``_done``."""
+        if req.rid in self._done:
+            return
+        data = dict(data or {})
+        if req.retried_from:
+            data["retried_from"] = list(req.retried_from)
+        if req.hedged and from_replica is not None \
+                and from_replica != req.primary:
+            self.stats_counters["hedge_wins"] += 1
+            metrics.inc("router_hedge_wins_total")
+        self._forward(req, kind, data)
+        metrics.inc("router_terminals_total", kind=kind)
+        self._done[req.rid] = kind
+        while len(self._done) > self._done_cap:
+            self._done.pop(next(iter(self._done)))
+        for rname in list(req.assigned):
+            if rname != from_replica:
+                self._send_replica(rname, ("cancel", req.rid))
+            rep = self._replicas.get(rname)
+            if rep is not None:
+                rep.inflight.discard(req.rid)
+        self._requests.pop(req.rid, None)
+        if req.rid in self._pending:
+            self._pending.remove(req.rid)
+
+    # -- blocking health probe (startup / ops, not the serve loop) -----
+    def probe(self, name: str, timeout: float = 2.0,
+              max_hedges: int = 1) -> bool:
+        """Hedged blocking health check of one replica: each attempt
+        opens a fresh DEALER (attempts must not share a socket across
+        threads), pings, and waits for the pong; the first pong wins
+        and the losers are cancelled (``base.retry.hedged``). Returns
+        False when no attempt succeeds within ``timeout``."""
+        info = self.registry.replicas().get(name)
+        if info is None:
+            return False
+
+        def attempt(att: retry.HedgeAttempt) -> bool:
+            sock = self._ctx.socket(zmq.DEALER)
+            try:
+                sock.connect(info.address)
+                sock.send(pickle.dumps(("ping",)))
+                while not att.cancelled.is_set():
+                    if att.deadline is not None \
+                            and time.monotonic() >= att.deadline:
+                        raise TimeoutError(f"probe {name}: deadline")
+                    if sock.poll(25):
+                        kind = pickle.loads(sock.recv())[0]
+                        if kind == "pong":
+                            return True
+                raise TimeoutError(f"probe {name}: cancelled")
+            finally:
+                sock.close(0)
+
+        try:
+            return bool(retry.hedged(
+                attempt, delay=timeout / (1 + max_hedges),
+                max_hedges=max_hedges, max_elapsed=timeout,
+                what=f"probe:{name}"))
+        except Exception:  # noqa: BLE001 - a failed probe is an answer
+            return False
+
+    # -- lifecycle -----------------------------------------------------
+    def drain(self, timeout: float = 30.0):
+        """Stop admitting, give in-flight requests ``timeout`` seconds
+        to finish, then expire what remains (clients always get a
+        terminal event)."""
+        if self._draining:
+            return
+        self._draining = True
+        deadline = self._clock() + timeout
+        while self._requests and self._clock() < deadline:
+            self.route_step(poll_timeout=0.01)
+        for req in list(self._requests.values()):
+            for rname in list(req.assigned):
+                self._send_replica(rname, ("cancel", req.rid))
+            self._finish(req, "expired", dict(reason="router_drain"),
+                         from_replica=None)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self._replicas.values():
+            if not rep.lost:
+                rep.sock.close(0)
+        self._front.close(0)
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        return dict(
+            self.stats_counters,
+            pending=len(self._pending),
+            inflight=len(self._requests),
+            draining=self._draining,
+            replicas={
+                name: dict(epoch=rep.epoch, lost=rep.lost,
+                           breaker=rep.breaker.state.name,
+                           inflight=len(rep.inflight))
+                for name, rep in sorted(self._replicas.items())})

@@ -37,7 +37,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 import zmq
 
-from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.base import fault_injection, logging, name_resolve, \
+    names, network
 from realhf_tpu.obs import metrics, tracing
 from realhf_tpu.serving.request_queue import (
     AdmissionVerdict,
@@ -81,11 +82,14 @@ class RolloutServer:
                  max_staleness: Optional[int] = None,
                  stream_tokens: bool = True,
                  seed: int = 0,
+                 fleet=None,
+                 chaos: Optional[fault_injection.NetChaos] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.server_name = server_name
         self._clock = clock
-        # explicit None check: an EMPTY RequestQueue is falsy (__len__)
-        self.queue = queue if queue is not None else RequestQueue(
+        # RequestQueue.__bool__ is True even when empty, so `or` no
+        # longer swallows a caller-provided empty queue
+        self.queue = queue or RequestQueue(
             n_slots=getattr(backend, "n_slots", 1))
         if self.queue.max_prompt_len is None:
             # oversized prompts must be rejected at admission -- past
@@ -124,6 +128,23 @@ class RolloutServer:
         self._key = jax.random.PRNGKey(seed)
         self._draining = False
         self._closed = False
+        # network chaos shim (docs/serving.md "Chaos drills"): None in
+        # production unless REALHF_TPU_FAULTS carries net_* specs
+        self._chaos = chaos if chaos is not None \
+            else fault_injection.default_net_chaos()
+        # fleet membership (serving/fleet.py): register under a
+        # keepalive lease and renew it from the serve loop; losing the
+        # lease fences this replica out until it re-registers (and the
+        # router reconnects at the new epoch)
+        self._fleet = fleet
+        self.fencing_epoch: Optional[int] = None
+        self._lease_renewed_at = self._clock()
+        #: set (from any thread) when a renewal found the lease gone;
+        #: the serve loop turns it into fence-flush + re-register
+        self._lease_lost = False
+        if fleet is not None:
+            self.fencing_epoch = fleet.register(server_name,
+                                                self.address)
         logger.info("Rollout server %s listening on %s.", server_name,
                     self.address)
 
@@ -133,6 +154,9 @@ class RolloutServer:
         ``poll_timeout`` seconds for the first message when idle), run
         the scheduler, deliver events. Returns how many client
         messages were handled."""
+        # lease upkeep FIRST: a fenced-out replica must discard its
+        # pre-fence work before it pumps or serves anything
+        self._renew_lease()
         handled = self._pump_socket(poll_timeout)
         metrics.set_gauge("serving_queue_depth", len(self.queue),
                           server=self.server_name)
@@ -162,10 +186,94 @@ class RolloutServer:
         self.drain(timeout=drain_timeout)
 
     # ------------------------------------------------------------------
+    def lease_beat(self):
+        """One fleet-lease renewal, safe from ANY thread -- meant to
+        ride the worker's heartbeat beacon
+        (``WorkerServer.add_beat_hook``) so the lease keeps beating
+        while the serve loop sits in a multi-minute jit compile or a
+        long decode chunk. Renewal failure only RECORDS the loss; the
+        serve loop owns the fence-flush + re-registration (scheduler
+        state is confined to it)."""
+        if self._fleet is None or self._lease_lost or self._draining:
+            return
+        if self._chaos is not None \
+                and self._chaos.partitioned(self.server_name):
+            return  # registry invisible: lease keeps decaying
+        from realhf_tpu.serving.fleet import LeaseLostError
+        try:
+            self._fleet.renew(self.server_name)
+            self._lease_renewed_at = self._clock()
+        except LeaseLostError:
+            self._lease_lost = True
+
+    def _renew_lease(self):
+        """Fleet-mode lease upkeep, called from the serve loop. Renews
+        on a ttl/3 cadence (on top of any heartbeat-thread
+        ``lease_beat``); a lost lease means this replica is FENCED:
+        its in-flight work was (or is being) failed over by the
+        router, so it drops everything un-delivered and re-registers
+        for a fresh fencing epoch before serving again. During a
+        ``partition`` chaos window the registry is unreachable, so the
+        lease decays exactly as it would on a real network split."""
+        if self._fleet is None:
+            return
+        if not self._lease_lost:
+            now = self._clock()
+            if now - self._lease_renewed_at \
+                    < self._fleet.lease_ttl / 3.0:
+                return
+            self.lease_beat()
+            if not self._lease_lost:
+                return
+        # fenced: discard pre-fence work, then rejoin under a new epoch
+        dropped = self._flush_fenced()
+        self.fencing_epoch = self._fleet.register(self.server_name,
+                                                  self.address)
+        self._lease_renewed_at = self._clock()
+        self._lease_lost = False
+        metrics.inc("serving_fenced_total", server=self.server_name)
+        logger.warning(
+            "Rollout server %s lost its fleet lease: %d in-flight/"
+            "queued request(s) dropped (already failed over); "
+            "re-registered with fencing epoch %d.", self.server_name,
+            dropped, self.fencing_epoch)
+
+    def _flush_fenced(self) -> int:
+        """Drop every queued and in-flight request WITHOUT sending
+        terminal events: a fenced-out replica must serve nothing --
+        the router has already failed this work over, and a late
+        terminal from here would be a duplicate delivery."""
+        dropped = 0
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                break
+            dropped += 1
+        dropped += len(self.queue.take_expired())
+        for rid in self.scheduler.active_rids():
+            # evicts immediately and emits no event -- nothing from
+            # before the fence may leave this replica
+            self.scheduler.cancel(rid)
+            dropped += 1
+        with self._routes_lock:
+            self._routes.clear()
+        for sp in self._request_spans.values():
+            sp.set_attribute("outcome", "fenced")
+            sp.finish()
+        self._request_spans.clear()
+        metrics.inc("serving_fenced_dropped_total", amount=dropped,
+                    server=self.server_name)
+        return dropped
+
+    # ------------------------------------------------------------------
     def _pump_socket(self, poll_timeout: float) -> int:
         n = 0
         while self._sock.poll(poll_timeout * 1000 if n == 0 else 0):
             ident, raw = self._sock.recv_multipart()
+            if self._chaos is not None and self._chaos.check(
+                    self.server_name, "recv") == "drop":
+                n += 1
+                continue
             try:
                 msg = pickle.loads(raw)
                 self._handle(ident, msg)
@@ -242,6 +350,14 @@ class RolloutServer:
             ident = self._routes.get(rid)
         if ident is None:
             return
+        if self._chaos is not None and self._chaos.check(
+                self.server_name, f"send.{kind}") == "drop":
+            # the wire ate it; same contract as a zmq send failure:
+            # the route survives so a later terminal can still close
+            # the stream (and the router's timeouts drive failover)
+            metrics.inc("serving_chaos_dropped_total",
+                        server=self.server_name)
+            return
         # pickle + send OUTSIDE the lock: serialization of token
         # arrays and a blocking peer must not hold up other threads'
         # route lookups
@@ -266,6 +382,11 @@ class RolloutServer:
                 sp.finish()
 
     def _reply(self, ident: bytes, kind: str, rid: str, data: dict):
+        if self._chaos is not None and self._chaos.check(
+                self.server_name, f"send.{kind}") == "drop":
+            metrics.inc("serving_chaos_dropped_total",
+                        server=self.server_name)
+            return
         payload = pickle.dumps((kind, rid, data))
         self._sock.send_multipart([ident, payload])
         if kind in TERMINAL_KINDS:
@@ -289,6 +410,10 @@ class RolloutServer:
         for rid in self.scheduler.active_rids():
             self.scheduler.cancel(rid)
             self._send(rid, "cancelled", {})
+        if self._fleet is not None:
+            # leave the fleet NOW instead of letting the lease decay:
+            # the router stops dispatching here immediately
+            self._fleet.deregister(self.server_name)
         logger.info(
             "Rollout server %s drained: %d queued bounced, stats=%s.",
             self.server_name, len(bounced), self.stats())
@@ -296,6 +421,8 @@ class RolloutServer:
     def close(self):
         if not self._closed:
             self._closed = True
+            if self._fleet is not None and not self._draining:
+                self._fleet.deregister(self.server_name)
             self._sock.close(0)
 
     # ------------------------------------------------------------------
@@ -306,6 +433,7 @@ class RolloutServer:
                     queue_stats=dict(self.queue.stats),
                     n_live=self.scheduler.n_live,
                     weight_version=self.weight_sync.version,
+                    fencing_epoch=self.fencing_epoch,
                     draining=self._draining)
 
 

@@ -33,6 +33,7 @@ class GenServerWorker(worker_base.Worker):
         from realhf_tpu.api.experiment import ExperimentSpec
         from realhf_tpu.engine.inflight import InflightBatchingGenerator
         from realhf_tpu.ops.sampling import GenerationHyperparameters
+        from realhf_tpu.serving.fleet import FleetRegistry
         from realhf_tpu.serving.request_queue import RequestQueue
         from realhf_tpu.serving.server import RolloutServer
         from realhf_tpu.system.model_host import build_model
@@ -60,6 +61,12 @@ class GenServerWorker(worker_base.Worker):
             n_slots=sv.n_slots, max_prompt_len=sv.max_prompt_len,
             eos_token_id=sv.eos_token_id, pad_token_id=sv.pad_token_id,
             chunk_size=sv.chunk_size)
+        # fleet mode: register this replica under a keepalive lease so
+        # the FleetRouter discovers it (and fails its work over the
+        # moment the lease lapses)
+        fleet = FleetRegistry(
+            spec.experiment_name, spec.trial_name,
+            lease_ttl=sv.lease_ttl_secs) if sv.fleet_router else None
         self.rollout_server = RolloutServer(
             backend,
             experiment_name=spec.experiment_name,
@@ -69,11 +76,18 @@ class GenServerWorker(worker_base.Worker):
                                n_slots=sv.n_slots),
             max_staleness=sv.max_staleness,
             stream_tokens=sv.stream_tokens,
+            fleet=fleet,
             seed=spec.seed + self.server_index)
         self._drain_timeout = sv.drain_timeout_secs
+        if fleet is not None:
+            # ride the heartbeat beacon: the fleet lease must keep
+            # beating while the serve loop sits in a long jit compile,
+            # exactly like the PR-1 worker heartbeat itself
+            self.server.add_beat_hook(self.rollout_server.lease_beat)
         logger.info("Gen server %s configured: role=%s slots=%d "
-                    "staleness=%s.", self.worker_name, sv.model_role,
-                    sv.n_slots, sv.max_staleness)
+                    "staleness=%s fleet=%s.", self.worker_name,
+                    sv.model_role, sv.n_slots, sv.max_staleness,
+                    sv.fleet_router)
         return dict(address=self.rollout_server.address)
 
     # ------------------------------------------------------------------
@@ -121,3 +135,84 @@ class GenServerWorker(worker_base.Worker):
         if getattr(self, "rollout_server", None) is not None:
             self.rollout_server.drain(timeout=self._drain_timeout)
             self.rollout_server.close()
+
+
+class RouterWorker(worker_base.Worker):
+    """The serving fleet's front door: one FleetRouter in the worker
+    stack (docs/serving.md "Fleet, failover & circuit breakers").
+
+    Same PR-1 plumbing as every worker (heartbeats, watchdog
+    attribution, preemption notices); the poll loop IS the routing
+    loop. Clients rendezvous exactly like against a single server::
+
+        RolloutClient(experiment_name=..., trial_name=...,
+                      server_name="router")
+
+    Extra commands: ``stats`` (router + per-replica breaker view),
+    ``drain`` (stop admission, flush in-flight), ``probe {name}``
+    (hedged blocking health check of one replica).
+    """
+
+    def _configure(self, config: Dict):
+        from realhf_tpu.api.experiment import ExperimentSpec
+        from realhf_tpu.serving.fleet import FleetRegistry
+        from realhf_tpu.serving.router import FleetRouter
+
+        with open(config["spec_path"], "rb") as f:
+            spec: ExperimentSpec = pickle.load(f)
+        self.spec = spec
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+        sv = spec.serving
+        if sv is None:
+            raise ValueError(
+                "RouterWorker needs ExperimentSpec.serving (see "
+                "experiments/serve_exp.py).")
+        registry = FleetRegistry(spec.experiment_name, spec.trial_name,
+                                 lease_ttl=sv.lease_ttl_secs)
+        self.router = FleetRouter(
+            registry,
+            router_name=self.worker_name,
+            experiment_name=spec.experiment_name,
+            trial_name=spec.trial_name,
+            max_pending=sv.router_max_pending,
+            dispatch_timeout=sv.router_dispatch_timeout_secs,
+            response_timeout=sv.router_response_timeout_secs,
+            hedge_delay=sv.router_hedge_delay_secs,
+            max_hedges=sv.router_max_hedges,
+            breaker_failures=sv.router_breaker_failures,
+            breaker_cooldown=sv.router_breaker_cooldown_secs,
+            fleet_poll_interval=min(0.5, sv.lease_ttl_secs / 4.0))
+        self._drain_timeout = sv.drain_timeout_secs
+        logger.info("Router %s configured: lease_ttl=%.1fs hedge=%s "
+                    "breaker=%d/%.1fs.", self.worker_name,
+                    sv.lease_ttl_secs, sv.router_hedge_delay_secs,
+                    sv.router_breaker_failures,
+                    sv.router_breaker_cooldown_secs)
+        return dict(address=self.router.address)
+
+    def _poll(self) -> worker_base.PollResult:
+        n = self.router.route_step(poll_timeout=0.02)
+        return worker_base.PollResult(sample_count=n,
+                                      batch_count=1 if n else 0)
+
+    def _handle_command(self, cmd: str, kwargs: Dict) -> Any:
+        if cmd == "stats":
+            return self.router.stats()
+        if cmd == "drain":
+            self.router.drain(timeout=self._drain_timeout)
+            return self.router.stats()
+        if cmd == "probe":
+            return dict(alive=self.router.probe(**(kwargs or {})))
+        return super()._handle_command(cmd, kwargs)
+
+    def _preempt_hook(self, grace: float):
+        budget = max(0.0, min(self._drain_timeout, grace * 0.8))
+        logger.warning("Router %s preempted: draining within %.1fs.",
+                       self.worker_name, budget)
+        self.router.drain(timeout=budget)
+
+    def _exit_hook(self):
+        if getattr(self, "router", None) is not None:
+            self.router.drain(timeout=self._drain_timeout)
+            self.router.close()

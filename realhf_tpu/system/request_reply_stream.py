@@ -21,7 +21,8 @@ from typing import Any, Dict, List, Optional
 
 import zmq
 
-from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.base import fault_injection, logging, name_resolve, \
+    names, network
 from realhf_tpu.obs import tracing
 
 logger = logging.getLogger("request_reply_stream")
@@ -117,6 +118,18 @@ class NameResolvingRequestClient:
                     f"Subscribers never connected: {sorted(pending)}")
 
     def post(self, payload: Payload) -> str:
+        # network chaos shim (base/fault_injection.py net_* kinds):
+        # the worker name is the TARGET, so a spec like
+        # `partition:model_worker/1:*:1:5` cuts the master->worker
+        # direction for that worker
+        chaos = fault_injection.default_net_chaos()
+        if chaos is not None and chaos.check(
+                payload.handler,
+                f"post.{payload.handle_name}") == "drop":
+            logger.warning("Chaos dropped request %s -> %s (%s).",
+                           payload.request_id, payload.handler,
+                           payload.handle_name)
+            return payload.request_id
         # NUL-terminated topic: ZMQ SUB matches by prefix, so a bare
         # "x/1" subscription would also receive "x/10".."x/19".
         self._pub.send_multipart([
@@ -309,6 +322,17 @@ class NameResolvingReplyServer:
             return payload
 
     def reply(self, payload: Payload):
+        # worker->master chaos shim: here the worker name is the
+        # SENDER (this handler), mirroring the handler-level
+        # `drop_reply` fault one layer down, at the wire
+        chaos = fault_injection.default_net_chaos()
+        if chaos is not None and chaos.check(
+                self.handler_name,
+                f"reply.{payload.handle_name}") == "drop":
+            logger.warning("Chaos dropped reply %s from %s (%s).",
+                           payload.request_id, self.handler_name,
+                           payload.handle_name)
+            return
         self._push.send(pickle.dumps(payload))
 
     def respond(self, request: Payload, data: Any = None):

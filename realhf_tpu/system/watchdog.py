@@ -66,18 +66,24 @@ class Watchdog:
     ``poll_interval``: ``poll()`` rate-limits actual store reads to
     this cadence so calling it from a hot master loop is free.
     ``clock``: injectable wall-clock for deterministic tests.
+    ``on_lost``: optional callback invoked (with the worker name) on
+    the ALIVE->LOST edge -- e.g. ``FleetRouter.notify_lost``, so a
+    co-located serving router fails work over immediately instead of
+    waiting for the replica's fleet lease to expire.
     """
 
     def __init__(self, experiment_name: str, trial_name: str,
                  workers: Iterable[str], timeout: float = 20.0,
                  grace: float = 120.0, poll_interval: float = 1.0,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 on_lost: Optional[Callable[[str], None]] = None):
         self._exp, self._trial = experiment_name, trial_name
         self.workers = sorted(set(workers))
         self.timeout = timeout
         self.grace = grace
         self.poll_interval = poll_interval
         self._clock = clock
+        self._on_lost = on_lost
         self._start = clock()
         self._ever_beat: Dict[str, float] = {}   # worker -> last fresh ts
         self._lost_since: Dict[str, float] = {}
@@ -136,6 +142,13 @@ class Watchdog:
                         "(last beat %s).", w, self.timeout,
                         "%.1fs ago" % (now - self._ever_beat[w])
                         if w in self._ever_beat else "never seen")
+                    if self._on_lost is not None:
+                        try:
+                            self._on_lost(w)
+                        except Exception as e:  # noqa: BLE001 - the
+                            # hook must not break liveness accounting
+                            logger.error("on_lost hook failed for "
+                                         "%s: %r", w, e)
             elif w in self._lost_since:
                 del self._lost_since[w]
                 metrics.inc("watchdog_flap_recovered_total", worker=w)

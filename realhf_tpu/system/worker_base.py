@@ -99,11 +99,23 @@ class WorkerServer:
         # presence as "this worker is retiring"
         self.clear_preempt_notice()
         self._hb_stop = threading.Event()
+        # extra per-beat callbacks (e.g. a rollout server's fleet
+        # lease renewal, serving/fleet.py): liveness signals that must
+        # keep beating while the poll loop is stuck in a long jit
+        # compile or a multi-minute MFC execution ride the SAME
+        # beacon thread as the heartbeat
+        self._beat_hooks = []
         self.beat()  # visible before the first interval elapses
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop,
             name=f"heartbeat[{worker_name}]", daemon=True)
         self._hb_thread.start()
+
+    def add_beat_hook(self, fn):
+        """Invoke ``fn()`` on every heartbeat (beacon thread!). The
+        hook must be thread-safe and non-blocking; exceptions are
+        swallowed (the next beat retries)."""
+        self._beat_hooks.append(fn)
 
     def beat(self):
         """Publish one heartbeat (current wall-clock seconds). Wall
@@ -116,6 +128,13 @@ class WorkerServer:
         except Exception as e:  # noqa: BLE001 - next beat retries
             logger.warning("Heartbeat publish failed for %s: %s",
                            self.worker_name, e)
+        for hook in list(self._beat_hooks):
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001 - next beat retries
+                logger.warning("Beat hook %r failed for %s: %s",
+                               getattr(hook, "__name__", hook),
+                               self.worker_name, e)
 
     def _heartbeat_loop(self):
         while not self._hb_stop.wait(self._hb_interval):

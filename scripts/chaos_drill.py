@@ -1,0 +1,416 @@
+#!/usr/bin/env python
+"""Deterministic network-chaos drills for the serving fleet.
+
+Replays a SCRIPTED fault schedule (replica death, network partitions,
+one-shot message drops/delays) against an in-process fleet -- N
+``RolloutServer`` replicas on ``FakeSlotBackend``s behind one
+``FleetRouter`` -- and asserts the fleet-robustness invariants
+(docs/serving.md "Chaos drills"):
+
+1. **No lost terminals**: every submitted request reaches >= 1
+   terminal event at the client.
+2. **At-most-once delivery**: no request reaches more than one.
+3. **Fencing**: no terminal is delivered from a replica the router
+   has fenced out (lost lease / stale epoch), and a fenced replica
+   serves nothing after rejoin until it re-leases.
+4. **Failover completes**: requests failed over from a dead or
+   partitioned replica still finish on survivors.
+
+Everything runs single-threaded in lockstep on an injected fake
+clock: lease expiry, breaker cooldowns, hedge delays, and timeouts
+are all deterministic functions of the drill tick, and net faults
+fire by event COUNT (``FaultSpec.nth``), never wall time.
+
+Usage::
+
+    python scripts/chaos_drill.py [--scenario standard] [--json]
+
+Exit code 0 iff every invariant holds. ``tests/chaos/`` runs a
+scaled-down drill in tier-1 and the full acceptance scenario under
+``-m slow``.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from realhf_tpu.base import name_resolve  # noqa: E402
+from realhf_tpu.base.fault_injection import (  # noqa: E402
+    NetChaos,
+    parse_faults,
+)
+from realhf_tpu.base.testing import FakeSlotBackend  # noqa: E402
+from realhf_tpu.obs import metrics  # noqa: E402
+from realhf_tpu.serving.fleet import FleetRegistry  # noqa: E402
+from realhf_tpu.serving.request_queue import RequestQueue  # noqa: E402
+from realhf_tpu.serving.router import FleetRouter  # noqa: E402
+from realhf_tpu.serving.server import (  # noqa: E402
+    TERMINAL_KINDS,
+    RolloutClient,
+    RolloutServer,
+)
+
+
+class DrillClock:
+    """Controllable monotonic clock: the drill's single time source."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+@dataclasses.dataclass
+class DrillEvent:
+    """One scheduled fault: at ``tick``, apply ``action`` to replica
+    ``target``. Actions: ``die`` (hard process death: socket gone, no
+    deregistration, lease decays), ``revive`` (a replacement registers
+    under the same name -> new fencing epoch), ``partition`` (open a
+    ``seconds``-long window dropping ALL the replica's traffic and
+    its lease renewals)."""
+    tick: int
+    action: str
+    target: str
+    seconds: float = 0.0
+
+
+@dataclasses.dataclass
+class DrillRequest:
+    """One scripted client request: submitted at ``tick``, needing
+    ``need`` decode tokens, with an optional ttl."""
+    tick: int
+    need: int = 24
+    ttl: Optional[float] = 120.0
+
+
+@dataclasses.dataclass
+class Delivery:
+    """One terminal delivered to a client, as seen at the router."""
+    tick: int
+    rid: str
+    kind: str
+    from_replica: Optional[str]
+    replica_lost: bool = False
+    epoch_stale: bool = False
+
+
+@dataclasses.dataclass
+class DrillReport:
+    n_requests: int = 0
+    terminals: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+    lost_rids: List[str] = dataclasses.field(default_factory=list)
+    duplicate_rids: List[str] = dataclasses.field(default_factory=list)
+    fenced_deliveries: List[dict] = dataclasses.field(
+        default_factory=list)
+    outcomes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    failovers: int = 0
+    hedges: int = 0
+    hedge_wins: int = 0
+    fenced_reconnects: int = 0
+    server_fence_drops: float = 0.0
+    breaker_transitions: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+    ticks: int = 0
+    router_stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.lost_rids or self.duplicate_rids
+                    or self.fenced_deliveries)
+
+    def summary(self) -> dict:
+        return dict(
+            ok=self.ok, n_requests=self.n_requests, ticks=self.ticks,
+            outcomes=self.outcomes, lost=len(self.lost_rids),
+            duplicates=len(self.duplicate_rids),
+            fenced_deliveries=len(self.fenced_deliveries),
+            failovers=self.failovers, hedges=self.hedges,
+            hedge_wins=self.hedge_wins,
+            fenced_reconnects=self.fenced_reconnects,
+            server_fence_drops=self.server_fence_drops,
+            breaker_transitions=self.breaker_transitions)
+
+
+class _RecordingRouter(FleetRouter):
+    """FleetRouter that records every terminal delivery together with
+    the state of the replica it came from -- the fencing invariant is
+    checked on exactly what the client was sent."""
+
+    def __init__(self, *a, drill_clock=None, **kw):
+        self.deliveries: List[Delivery] = []
+        self._drill_clock = drill_clock
+        super().__init__(*a, **kw)
+
+    def _finish(self, req, kind, data, from_replica):
+        if req.rid not in self._done:  # mirrors _finish's dedupe gate
+            rep = self._replicas.get(from_replica) \
+                if from_replica else None
+            live = self.registry.replicas().get(from_replica) \
+                if from_replica else None
+            self.deliveries.append(Delivery(
+                tick=int(self._drill_clock.t * 1000)
+                if self._drill_clock else -1,
+                rid=req.rid, kind=kind, from_replica=from_replica,
+                replica_lost=bool(rep is not None and rep.lost),
+                epoch_stale=bool(
+                    rep is not None and live is not None
+                    and live.epoch != rep.epoch)))
+        super()._finish(req, kind, data, from_replica)
+
+
+class DrillFleet:
+    """An in-process 3-(or N-)replica serving fleet wired for chaos."""
+
+    def __init__(self, n_replicas: int = 3, n_slots: int = 2,
+                 chunk: int = 4, lease_ttl: float = 2.0,
+                 dt: float = 0.05, net_faults: str = "",
+                 hedge_delay: Optional[float] = None,
+                 backend_factory=None,
+                 router_kwargs: Optional[dict] = None):
+        self.clock = DrillClock()
+        self.dt = dt
+        self.n_slots, self.chunk = n_slots, chunk
+        #: () -> slot backend; default FakeSlotBackend. The slow e2e
+        #: passes a real InflightBatchingGenerator factory so the
+        #: drill exercises genuine decode traffic.
+        self.backend_factory = backend_factory or (
+            lambda: FakeSlotBackend(n_slots=self.n_slots,
+                                    chunk=self.chunk))
+        # net_delay "sleeps" advance the FAKE clock: delays stay
+        # deterministic and never slow the drill down
+        self.chaos = NetChaos(parse_faults(net_faults),
+                              clock=self.clock,
+                              sleep=self.clock.advance)
+        # a PRIVATE repository: drills must not touch the process-wide
+        # name_resolve default
+        self.repo = name_resolve.MemoryNameRecordRepository(
+            clock=self.clock)
+        self.registry = FleetRegistry("chaos", "drill",
+                                      lease_ttl=lease_ttl,
+                                      repo=self.repo)
+        self.servers: Dict[str, RolloutServer] = {}
+        self.alive: List[str] = []
+        for i in range(n_replicas):
+            self._spawn(f"gen_server/{i}", seed=i)
+        kw = dict(fleet_poll_interval=dt, dispatch_timeout=1.0,
+                  response_timeout=6.0, pending_timeout=30.0,
+                  breaker_failures=2, breaker_cooldown=1.0,
+                  probe_timeout=1.0, hedge_delay=hedge_delay)
+        kw.update(router_kwargs or {})
+        self.router = _RecordingRouter(
+            self.registry, router_name="router/0", chaos=self.chaos,
+            clock=self.clock, drill_clock=self.clock, **kw)
+        self.clients: List[RolloutClient] = []
+        self.events: Dict[str, List[tuple]] = {}
+
+    # -- fleet actions -------------------------------------------------
+    def _spawn(self, name: str, seed: int = 0):
+        srv = RolloutServer(
+            self.backend_factory(),
+            server_name=name,
+            queue=RequestQueue(max_depth=64, n_slots=self.n_slots,
+                               clock=self.clock),
+            fleet=self.registry, chaos=self.chaos, clock=self.clock,
+            seed=seed)
+        self.servers[name] = srv
+        if name not in self.alive:
+            self.alive.append(name)
+        return srv
+
+    def die(self, name: str):
+        """Hard death: the socket vanishes mid-stream and the lease is
+        left to decay (no graceful deregistration)."""
+        srv = self.servers[name]
+        srv._fleet = None  # a crash never says goodbye
+        srv.close()
+        self.alive.remove(name)
+
+    def revive(self, name: str):
+        """A replacement process re-registers the same replica name,
+        obtaining a new fencing epoch."""
+        self._spawn(name, seed=len(self.servers) + hash(name) % 97)
+
+    def apply(self, ev: DrillEvent):
+        if ev.action == "die":
+            self.die(ev.target)
+        elif ev.action == "revive":
+            self.revive(ev.target)
+        elif ev.action == "partition":
+            self.chaos.open_partition(ev.target, ev.seconds)
+        else:
+            raise ValueError(f"Unknown drill action {ev.action!r} "
+                             "(know: die, revive, partition)")
+
+    # -- lockstep drill loop -------------------------------------------
+    def client(self) -> RolloutClient:
+        c = RolloutClient(self.router.address)
+        self.clients.append(c)
+        return c
+
+    def _pump_clients(self):
+        for c in self.clients:
+            while c._pump(0.002):
+                pass
+            for rid, q in c._events.items():
+                if rid == "":
+                    continue
+                while q:
+                    self.events.setdefault(rid, []).append(q.pop(0))
+
+    def step(self):
+        self.clock.advance(self.dt)
+        self.router.route_step(poll_timeout=0.002)
+        for name in list(self.alive):
+            self.servers[name].serve_step(poll_timeout=0.002)
+        self._pump_clients()
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+        for name in list(self.alive):
+            self.servers[name].close()
+        self.router.close()
+
+
+def run_drill(fleet: DrillFleet, requests: List[DrillRequest],
+              schedule: List[DrillEvent],
+              max_ticks: int = 5000) -> DrillReport:
+    """Replay ``schedule`` while submitting ``requests``; run until
+    every request has a terminal event (or ``max_ticks``)."""
+    client = fleet.client()
+    by_tick_req: Dict[int, List[DrillRequest]] = {}
+    for r in requests:
+        by_tick_req.setdefault(r.tick, []).append(r)
+    by_tick_ev: Dict[int, List[DrillEvent]] = {}
+    for e in schedule:
+        by_tick_ev.setdefault(e.tick, []).append(e)
+    rids: List[str] = []
+    report = DrillReport(n_requests=len(requests))
+
+    def terminals_of(rid):
+        return [k for k, _ in fleet.events.get(rid, [])
+                if k in TERMINAL_KINDS]
+
+    last_submit = max(by_tick_req) if by_tick_req else 0
+    last_event = max(by_tick_ev) if by_tick_ev else 0
+    for tick in range(max_ticks):
+        for ev in by_tick_ev.get(tick, ()):
+            fleet.apply(ev)
+        for r in by_tick_req.get(tick, ()):
+            prompt = np.array([r.need, 3, 5], np.int32)
+            rids.append(client.submit(prompt, ttl=r.ttl))
+        fleet.step()
+        report.ticks = tick + 1
+        if (tick > max(last_submit, last_event)
+                and len(rids) == len(requests)
+                and all(terminals_of(r) for r in rids)):
+            break
+
+    # -- invariants ----------------------------------------------------
+    for rid in rids:
+        ts = terminals_of(rid)
+        report.terminals[rid] = ts
+        if not ts:
+            report.lost_rids.append(rid)
+        elif len(ts) > 1:
+            report.duplicate_rids.append(rid)
+        else:
+            report.outcomes[ts[0]] = report.outcomes.get(ts[0], 0) + 1
+    report.fenced_deliveries = [
+        dataclasses.asdict(d) for d in fleet.router.deliveries
+        if d.replica_lost or d.epoch_stale]
+    sc = fleet.router.stats_counters
+    report.failovers = sc["failovers"]
+    report.hedges = sc["hedges"]
+    report.hedge_wins = sc["hedge_wins"]
+    report.fenced_reconnects = sc["fenced_reconnects"]
+    report.router_stats = fleet.router.stats()
+    snap = metrics.snapshot()
+    drops = snap.get("serving_fenced_dropped_total", {})
+    report.server_fence_drops = float(sum(
+        (drops.get("values") or {}).values()))
+    trans = snap.get("router_breaker_transitions_total", {})
+    for key, n in (trans.get("values") or {}).items():
+        labels = json.loads(key)  # snapshot label keys are JSON
+        rep = labels.get("replica", "?")
+        report.breaker_transitions.setdefault(rep, []).append(
+            f"{labels.get('to', '?')}x{int(n)}")
+    return report
+
+
+# ----------------------------------------------------------------------
+def standard_scenario(scale: float = 1.0):
+    """The acceptance drill: a 3-replica fleet; one replica DIEs
+    mid-stream, another is partitioned past its lease TTL (fenced,
+    then rejoins), and a one-shot net_drop eats a terminal event.
+    ``scale < 1`` shrinks request count/length for the tier-1 tier."""
+    n_req = max(6, int(24 * scale))
+    need = max(8, int(24 * scale))
+    requests = [DrillRequest(tick=2 + 2 * i, need=need)
+                for i in range(n_req)]
+    # the revive tick (and with it the drill length) scales down with
+    # the request load, but stays past the partition window + lease
+    # decay so the rejoin path is always exercised
+    revive_tick = max(160, int(400 * scale))
+    schedule = [
+        DrillEvent(tick=10, action="die", target="gen_server/1"),
+        DrillEvent(tick=30, action="partition", target="gen_server/2",
+                   seconds=4.0),
+        DrillEvent(tick=revive_tick, action="revive",
+                   target="gen_server/1"),
+    ]
+    # one dropped terminal send from the healthy replica: the router's
+    # response timeout must fail it over, and the replica's later
+    # duplicate must dedupe
+    net_faults = "net_drop:gen_server/0:send.done:3"
+    fleet = DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05,
+                       net_faults=net_faults,
+                       router_kwargs=dict(response_timeout=4.0))
+    return fleet, requests, schedule
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("chaos_drill")
+    ap.add_argument("--scenario", default="standard",
+                    choices=["standard"])
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--max-ticks", type=int, default=5000)
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    args = ap.parse_args(argv)
+    metrics.reset_default()
+    fleet, requests, schedule = standard_scenario(scale=args.scale)
+    try:
+        report = run_drill(fleet, requests, schedule,
+                           max_ticks=args.max_ticks)
+    finally:
+        fleet.close()
+    out = report.summary()
+    if args.json:
+        out = dict(out, terminals=report.terminals,
+                   fenced_deliveries=report.fenced_deliveries,
+                   router_stats=report.router_stats)
+    print(json.dumps(out, indent=2, default=str))
+    if not report.ok:
+        print("DRILL FAILED: invariants violated "
+              f"(lost={report.lost_rids} dup={report.duplicate_rids} "
+              f"fenced={report.fenced_deliveries})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ import sys
 REQUIRED_DIRS = (
     "tests/analysis",
     "tests/base",
+    "tests/chaos",
     "tests/engine",
     "tests/observability",
     "tests/ops",

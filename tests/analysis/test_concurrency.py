@@ -185,3 +185,106 @@ def test_str_join_under_lock_is_clean(make_module):
                 return ", ".join(parts)
     """)
     assert fs == []
+
+
+# ----------------------------------------------------------------------
+# conc-shared-zmq-socket (PR 7): a ZMQ socket used for I/O from a
+# thread entry AND another method without a lock
+# ----------------------------------------------------------------------
+def test_shared_zmq_socket_flagged(make_module, codes_of):
+    """The router bug class: the serve loop runs in a thread while a
+    command handler sends on the same socket."""
+    fs = check(make_module, """
+        import pickle
+        import threading
+        import zmq
+
+        class Server:
+            def __init__(self):
+                self._ctx = zmq.Context.instance()
+                self._sock = self._ctx.socket(zmq.ROUTER)
+                self._t = threading.Thread(target=self._serve_loop,
+                                           daemon=True)
+                self._t.start()
+
+            def _serve_loop(self):
+                while True:
+                    if self._sock.poll(10):
+                        self._sock.recv_multipart()
+
+            def broadcast(self, data):
+                self._sock.send(pickle.dumps(data))
+    """)
+    assert "conc-shared-zmq-socket" in codes_of(fs)
+    assert any("_sock" in f.message and "broadcast" in f.message
+               for f in fs)
+
+
+def test_shared_zmq_socket_locked_both_sides_ok(make_module, codes_of):
+    fs = check(make_module, """
+        import threading
+        import zmq
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sock = zmq.Context.instance().socket(zmq.REP)
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                while True:
+                    with self._lock:
+                        self._sock.recv()
+
+            def send(self, raw):
+                with self._lock:
+                    self._sock.send(raw)
+    """)
+    assert "conc-shared-zmq-socket" not in codes_of(fs)
+
+
+def test_shared_zmq_socket_close_after_join_ok(make_module, codes_of):
+    """The DataServer teardown idiom: stop() joins the thread, then
+    closes the socket -- close is not I/O, no finding."""
+    fs = check(make_module, """
+        import threading
+        import zmq
+
+        class DataServer(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True)
+                self._sock = zmq.Context.instance().socket(zmq.REP)
+                self._stop = threading.Event()
+
+            def run(self):
+                while not self._stop.is_set():
+                    if self._sock.poll(100):
+                        self._sock.send(self._sock.recv())
+
+            def stop(self):
+                self._stop.set()
+                self.join(timeout=2)
+                self._sock.close(0)
+    """)
+    assert "conc-shared-zmq-socket" not in codes_of(fs)
+
+
+def test_single_threaded_socket_owner_ok(make_module, codes_of):
+    """No thread entry in the class: the serve loop owns the socket
+    exclusively (RolloutServer/FleetRouter shape)."""
+    fs = check(make_module, """
+        import zmq
+
+        class Router:
+            def __init__(self):
+                self._front = zmq.Context.instance().socket(zmq.ROUTER)
+
+            def route_step(self):
+                if self._front.poll(0):
+                    self._front.recv_multipart()
+
+            def reply(self, frames):
+                self._front.send_multipart(frames)
+    """)
+    assert "conc-shared-zmq-socket" not in codes_of(fs)

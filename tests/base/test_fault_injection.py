@@ -73,3 +73,136 @@ def test_from_env(tmp_path, monkeypatch):
     inj = FaultInjector.from_env()
     assert inj is not None
     assert inj.on_event("w/0", "anything").kind == "die"
+
+
+# ----------------------------------------------------------------------
+# network chaos: net_drop / net_delay / partition (PR 7)
+# ----------------------------------------------------------------------
+from realhf_tpu.base.fault_injection import (  # noqa: E402
+    NET_KINDS,
+    NetChaos,
+    default_net_chaos,
+    set_net_chaos,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_parse_net_kinds():
+    specs = parse_faults(
+        "net_drop:gen_server/0:send.done:3;"
+        "net_delay:*:recv:1:0.5;"
+        "partition:gen_server/2:*:1:6.0")
+    assert [s.kind for s in specs] == list(NET_KINDS)
+    assert specs[2].seconds == 6.0
+
+
+@pytest.mark.parametrize("bad,hint", [
+    ("net_delay:w:*:1", "positive seconds"),
+    ("net_delay:w:*:1:0", "positive seconds"),
+    ("partition:w:*:1", "positive seconds"),
+    ("partition:w:*:2:-1.0", "positive seconds"),
+    ("net_drop:w:*:1:2.0", "takes no seconds"),
+])
+def test_net_spec_validation_is_actionable(bad, hint):
+    with pytest.raises(ValueError, match=hint):
+        parse_faults(bad)
+
+
+def test_net_drop_fires_on_nth_then_never_again():
+    chaos = NetChaos(parse_faults("net_drop:s0:send.done:2"),
+                     clock=_Clock())
+    assert chaos.check("s0", "send.done") is None        # 1st passes
+    assert chaos.check("s0", "send.tokens") is None      # no match
+    assert chaos.check("s0", "send.done") == "drop"      # 2nd: fires
+    assert chaos.check("s0", "send.done") is None        # one-shot
+    assert chaos.stats["dropped"] == 1
+
+
+def test_net_delay_sleeps_inline():
+    clock = _Clock()
+    slept = []
+    chaos = NetChaos(parse_faults("net_delay:s0:recv:1:0.7"),
+                     clock=clock, sleep=slept.append)
+    assert chaos.check("s0", "recv") is None
+    assert slept == [0.7]
+    assert chaos.stats["delayed"] == 1
+
+
+def test_partition_window_drops_everything_then_heals():
+    clock = _Clock()
+    chaos = NetChaos(parse_faults("partition:s1:*:1:5.0"),
+                     clock=clock)
+    # the opening event itself is dropped, and so is all of s1's
+    # traffic inside the window, on every channel
+    assert chaos.check("s1", "send.done") == "drop"
+    assert chaos.partitioned("s1")
+    assert chaos.check("s1", "recv") == "drop"
+    assert chaos.check("s1", "send.tokens") == "drop"
+    # other workers are unaffected
+    assert chaos.check("s0", "send.done") is None
+    assert not chaos.partitioned("s0")
+    clock.advance(5.1)  # window closes
+    assert not chaos.partitioned("s1")
+    assert chaos.check("s1", "send.done") is None
+
+
+def test_open_partition_programmatic():
+    clock = _Clock()
+    chaos = NetChaos([], clock=clock)
+    chaos.open_partition("s2", 2.0)
+    assert chaos.partitioned("s2")
+    assert chaos.check("s2", "recv") == "drop"
+    clock.advance(2.5)
+    assert not chaos.partitioned("s2")
+
+
+def test_net_kinds_split_between_injector_and_chaos(monkeypatch):
+    """FaultInjector.from_env must NOT consume net_* specs (they
+    execute at the wire shims), and NetChaos.from_env takes ONLY
+    them."""
+    monkeypatch.setenv(
+        "REALHF_TPU_FAULTS",
+        "crash:w0:train_step:1;net_drop:w0:send.done:1")
+    inj = FaultInjector.from_env()
+    assert [s.kind for s in inj.specs] == ["crash"]
+    chaos = NetChaos.from_env()
+    assert [s.kind for s in chaos._inj.specs] == ["net_drop"]
+    # a handler-side event stream never trips the net spec
+    assert inj.on_event("w0", "send.done") is None
+    monkeypatch.setenv("REALHF_TPU_FAULTS", "crash:w0:train_step:1")
+    assert NetChaos.from_env() is None
+
+
+def test_net_state_file_dedup_across_relaunch(tmp_path):
+    """Cross-relaunch once-semantics cover the net_* kinds: a
+    recovered process must not re-drop the same message."""
+    state = str(tmp_path / "faults_state")
+    chaos = NetChaos(parse_faults("net_drop:s0:send.done:1"),
+                     state_path=state, clock=_Clock())
+    assert chaos.check("s0", "send.done") == "drop"
+    # "relaunch": fresh NetChaos over the same state file
+    chaos2 = NetChaos(parse_faults("net_drop:s0:send.done:1"),
+                      state_path=state, clock=_Clock())
+    assert chaos2.check("s0", "send.done") is None
+    assert chaos2.stats["dropped"] == 0
+
+
+def test_default_net_chaos_singleton(monkeypatch):
+    prev = set_net_chaos(None)
+    try:
+        assert default_net_chaos() is None
+        mine = NetChaos([], clock=_Clock())
+        set_net_chaos(mine)
+        assert default_net_chaos() is mine
+    finally:
+        set_net_chaos(prev)

@@ -1,0 +1,170 @@
+"""name_resolve lease semantics: keepalive_ttl expiry, touch-based
+renewal, get_subtree under concurrent add/delete, and fencing epochs
+on re-registration -- on BOTH the in-memory and filesystem backends
+(the serving fleet's registry runs on either)."""
+
+import threading
+import time
+
+import pytest
+
+from realhf_tpu.base import name_resolve
+from realhf_tpu.base.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo_clock(request, tmp_path):
+    """(repository, advance(dt)) pairs. The memory backend runs on a
+    fake clock (exact expiry); NFS uses real file mtimes, so its
+    `advance` sleeps wall-clock time and the TTLs below stay >= 0.3s
+    to keep mtime granularity out of the picture."""
+    if request.param == "memory":
+        clk = FakeClock()
+        yield MemoryNameRecordRepository(clock=clk), clk.advance
+    else:
+        repo = NfsNameRecordRepository(record_root=str(tmp_path))
+        yield repo, time.sleep
+        repo.reset()
+
+
+def test_keepalive_ttl_expires(repo_clock):
+    repo, advance = repo_clock
+    repo.add("fleet/replicas/r0", "addr0", keepalive_ttl=0.4)
+    assert repo.get("fleet/replicas/r0") == "addr0"
+    advance(0.6)
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("fleet/replicas/r0")
+    assert repo.find_subtree("fleet/replicas") == []
+    assert repo.get_subtree("fleet/replicas") == []
+    # an expired key is re-addable even without replace=True
+    repo.add("fleet/replicas/r0", "addr1", keepalive_ttl=0.4)
+    assert repo.get("fleet/replicas/r0") == "addr1"
+
+
+def test_no_ttl_means_persistent(repo_clock):
+    repo, advance = repo_clock
+    repo.add("k", "v")
+    advance(0.7)
+    assert repo.get("k") == "v"
+
+
+def test_touch_refreshes_lease(repo_clock):
+    repo, advance = repo_clock
+    repo.add("lease/r0", "v", keepalive_ttl=0.5)
+    for _ in range(3):
+        advance(0.3)
+        repo.touch("lease/r0")  # keeps beating inside the ttl
+    assert repo.get("lease/r0") == "v"  # 0.9s after add: still alive
+    advance(0.7)  # stop touching: lease decays
+    with pytest.raises(NameEntryNotFoundError):
+        repo.touch("lease/r0")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("lease/r0")
+
+
+def test_touch_missing_entry_raises(repo_clock):
+    repo, _ = repo_clock
+    with pytest.raises(NameEntryNotFoundError):
+        repo.touch("never/registered")
+
+
+def test_get_subtree_under_concurrent_add_delete(repo_clock):
+    """Readers walking the subtree while writers add/delete must never
+    crash and must only ever see values that were actually stored."""
+    repo, _ = repo_clock
+    valid = {f"v{i}" for i in range(8)}
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        try:
+            while not stop.is_set():
+                repo.add(f"sub/tree/k{i}", f"v{i}", replace=True)
+                try:
+                    repo.delete(f"sub/tree/k{i}")
+                except NameEntryNotFoundError:
+                    pass
+        except Exception as e:  # noqa: BLE001 - fail the test below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 1.0
+    reads = 0
+    while time.monotonic() < deadline:
+        vals = repo.get_subtree("sub/tree")
+        keys = repo.find_subtree("sub/tree")
+        assert all(v in valid for v in vals), vals
+        assert all(k.startswith("sub/tree/") for k in keys), keys
+        reads += 1
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors
+    assert reads > 10
+
+
+def test_register_with_epoch_bumps_across_expiry(repo_clock):
+    """The fencing story: every (re-)registration returns a HIGHER
+    epoch, and the counter survives lease expiry."""
+    repo, advance = repo_clock
+    e1 = repo.register_with_epoch("f/replicas/r0", "addr",
+                                  epoch_name="f/epochs/r0",
+                                  keepalive_ttl=0.4)
+    assert e1 == 1
+    # live re-registration (e.g. restart before expiry) also bumps
+    e2 = repo.register_with_epoch("f/replicas/r0", "addr",
+                                  epoch_name="f/epochs/r0",
+                                  keepalive_ttl=0.4)
+    assert e2 == 2
+    advance(0.6)  # lease decays ...
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("f/replicas/r0")
+    # ... but the epoch counter does not
+    assert repo.get("f/epochs/r0") == "2"
+    e3 = repo.register_with_epoch("f/replicas/r0", "addr2",
+                                  epoch_name="f/epochs/r0",
+                                  keepalive_ttl=0.4)
+    assert e3 == 3
+    assert repo.get("f/replicas/r0") == "addr2"
+
+
+def test_register_with_epoch_callable_value(repo_clock):
+    """The stored value may embed the epoch (one atomic read gives
+    consumers a consistent (epoch, payload) pair)."""
+    repo, _ = repo_clock
+    e = repo.register_with_epoch("f/replicas/r1",
+                                 lambda ep: f"{ep}:tcp://h:1",
+                                 epoch_name="f/epochs/r1",
+                                 keepalive_ttl=5.0)
+    assert repo.get("f/replicas/r1") == f"{e}:tcp://h:1"
+
+
+def test_module_level_touch_and_epoch(tmp_path, monkeypatch):
+    """The module-level wrappers reach the default repository."""
+    name_resolve.reconfigure("memory")
+    try:
+        e = name_resolve.register_with_epoch("m/k", "v",
+                                             keepalive_ttl=10.0)
+        assert e == 1
+        name_resolve.touch("m/k")
+        assert name_resolve.get("m/k") == "v"
+    finally:
+        name_resolve.reconfigure(None)  # back to the env default

@@ -152,3 +152,99 @@ def test_max_elapsed_generous_budget_does_not_interfere():
                                   max_elapsed=100.0),
                       sleep=clock.sleep, clock=clock) == "ok"
     assert len(calls) == 3
+
+
+# ----------------------------------------------------------------------
+# hedged(): first-success-wins with cooperative cancellation
+# ----------------------------------------------------------------------
+import threading
+import time as _time
+
+from realhf_tpu.base.retry import HedgeAttempt, hedged  # noqa: E402
+
+
+def test_hedged_primary_wins_no_hedge_launched():
+    seen = []
+
+    def call(att: HedgeAttempt):
+        seen.append(att.index)
+        return f"ok-{att.index}"
+
+    assert hedged(call, delay=10.0, max_hedges=2) == "ok-0"
+    assert seen == [0]  # fast primary: the stagger never elapsed
+
+
+def test_hedged_slow_primary_loses_and_is_cancelled():
+    events = {}
+
+    def call(att: HedgeAttempt):
+        events[att.index] = att
+        if att.index == 0:
+            # slow primary: parks until cancelled by the winner
+            att.cancelled.wait(timeout=30.0)
+            raise TimeoutError("cancelled")
+        return "hedge-won"
+
+    t0 = _time.monotonic()
+    assert hedged(call, delay=0.05, max_hedges=1) == "hedge-won"
+    assert _time.monotonic() - t0 < 5.0
+    # the loser's cancellation event fired
+    assert events[0].cancelled.wait(timeout=5.0)
+
+
+def test_hedged_failure_triggers_immediate_next_attempt():
+    order = []
+
+    def call(att: HedgeAttempt):
+        order.append((att.index, _time.monotonic()))
+        if att.index == 0:
+            raise ConnectionError("replica down")
+        return "ok"
+
+    t0 = _time.monotonic()
+    assert hedged(call, delay=30.0, max_hedges=1) == "ok"
+    # the hedge launched on FAILURE, not after the 30s stagger
+    assert _time.monotonic() - t0 < 5.0
+    assert [i for i, _ in order] == [0, 1]
+
+
+def test_hedged_all_fail_raises_last():
+    def call(att: HedgeAttempt):
+        raise ValueError(f"boom-{att.index}")
+
+    with pytest.raises(ValueError, match="boom-"):
+        hedged(call, delay=0.01, max_hedges=2)
+
+
+def test_hedged_max_elapsed_deadline_cancels_everyone():
+    attempts = []
+
+    def call(att: HedgeAttempt):
+        attempts.append(att)
+        # deadline propagated: every attempt sees the SAME absolute
+        # total budget
+        assert att.deadline is not None
+        att.cancelled.wait(timeout=30.0)
+        raise TimeoutError("cancelled")
+
+    t0 = _time.monotonic()
+    with pytest.raises(TimeoutError):
+        hedged(call, delay=0.05, max_hedges=1, max_elapsed=0.3)
+    assert _time.monotonic() - t0 < 5.0
+    assert len(attempts) == 2  # primary + one hedge, both launched
+    for att in attempts:
+        assert att.cancelled.wait(timeout=5.0)
+
+
+def test_hedged_non_retryable_exception_propagates():
+    def call(att: HedgeAttempt):
+        raise KeyError("not in retry_on")
+
+    with pytest.raises(KeyError):
+        hedged(call, delay=0.01, max_hedges=3,
+               retry_on=(ConnectionError,))
+
+
+def test_hedged_rejects_negative_delay():
+    with pytest.raises(ValueError):
+        hedged(lambda att: 1, delay=-1.0)

@@ -1,0 +1,245 @@
+"""Chaos-drill e2e: scripted fault schedules against a 3-replica
+fleet behind the FleetRouter (ISSUE 7 acceptance).
+
+Tier-1 runs scaled-down drills on ``FakeSlotBackend`` (milliseconds
+per serve step); the full acceptance scenario -- including a drill
+over REAL tiny-model replicas -- is ``-m slow``.
+
+Invariants asserted on every drill (scripts/chaos_drill.py):
+every submitted request reaches EXACTLY one terminal event, no
+duplicate client deliveries, no delivery from a fenced-out replica,
+failed-over requests complete on survivors, and the router's
+Prometheus metrics show the breaker open -> half-open -> closed chain
+plus a nonzero failover counter.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from realhf_tpu.obs import metrics
+
+
+def _load_drill():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "chaos_drill.py")
+    spec = importlib.util.spec_from_file_location("chaos_drill", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_default()
+    yield
+
+
+def test_tier1_scaled_drill_die_and_partition():
+    """The acceptance schedule, scaled down: one replica dies
+    mid-stream, another is partitioned past its lease TTL (fenced,
+    then rejoins with a new epoch)."""
+    cd = _load_drill()
+    requests = [cd.DrillRequest(tick=2 + 2 * i, need=16)
+                for i in range(8)]
+    schedule = [
+        cd.DrillEvent(tick=8, action="die", target="gen_server/1"),
+        cd.DrillEvent(tick=20, action="partition",
+                      target="gen_server/2", seconds=4.0),
+        cd.DrillEvent(tick=130, action="revive",
+                      target="gen_server/1"),
+    ]
+    fleet = cd.DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=1500)
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    # exactly one terminal each, all successful despite the chaos
+    assert report.outcomes == {"done": len(requests)}, report.outcomes
+    assert report.lost_rids == [] and report.duplicate_rids == []
+    assert report.fenced_deliveries == []
+    # the dead replica's in-flight work moved to survivors
+    assert report.failovers >= 1
+    # both faulted replicas re-registered under a new fencing epoch
+    assert report.fenced_reconnects >= 2
+    # breaker chain: open (loss) -> half-open (probe) -> closed (pong)
+    for rep in ("gen_server/1", "gen_server/2"):
+        states = {s.split("x")[0]
+                  for s in report.breaker_transitions.get(rep, [])}
+        assert {"open", "half_open", "closed"} <= states, (
+            rep, report.breaker_transitions)
+    # and the fenced-out replica served nothing after rejoin until
+    # re-leased: every delivery came from a live, current-epoch member
+    for d in fleet.router.deliveries:
+        assert not d.replica_lost and not d.epoch_stale
+
+
+def test_tier1_dropped_terminal_recovers_and_dedupes():
+    """A one-shot net_drop eats a `done` send: the router's response
+    timeout re-dispatches, the twin completes, and the client still
+    sees exactly one terminal."""
+    cd = _load_drill()
+    requests = [cd.DrillRequest(tick=2 + 2 * i, need=12)
+                for i in range(4)]
+    fleet = cd.DrillFleet(
+        n_replicas=2, lease_ttl=5.0, dt=0.05,
+        net_faults="net_drop:gen_server/*:send.done:2",
+        router_kwargs=dict(response_timeout=2.0))
+    try:
+        report = cd.run_drill(fleet, requests, [], max_ticks=1500)
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": 4}
+    assert fleet.chaos.stats["dropped"] >= 1
+    assert report.failovers >= 1
+
+
+def test_tier1_hedge_covers_slow_start():
+    """Hedging: the wire eats a dispatch, the hedge twin wins."""
+    cd = _load_drill()
+    requests = [cd.DrillRequest(tick=2, need=12)]
+    fleet = cd.DrillFleet(
+        n_replicas=2, lease_ttl=5.0, dt=0.05,
+        net_faults="net_drop:router/0:dispatch.submit:1",
+        hedge_delay=0.5,
+        router_kwargs=dict(dispatch_timeout=30.0))
+    try:
+        report = cd.run_drill(fleet, requests, [], max_ticks=600)
+    finally:
+        fleet.close()
+    assert report.ok
+    assert report.outcomes == {"done": 1}
+    assert report.hedges == 1 and report.hedge_wins == 1
+
+
+def test_prometheus_export_carries_router_metrics():
+    """The PR-5 Prometheus surface exposes the fleet counters the
+    acceptance criteria name."""
+    cd = _load_drill()
+    requests = [cd.DrillRequest(tick=2 + i, need=8) for i in range(4)]
+    schedule = [cd.DrillEvent(tick=6, action="die",
+                              target="gen_server/1")]
+    fleet = cd.DrillFleet(n_replicas=2, lease_ttl=1.0, dt=0.05)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=800)
+        text = metrics.to_prometheus()
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert "router_breaker_state" in text
+    assert "router_breaker_transitions_total" in text
+    assert 'router_failovers_total{replica="gen_server/1"}' in text
+    assert "router_requests_total" in text
+
+
+def test_cli_main_standard_scenario_scaled():
+    """scripts/chaos_drill.py as a CLI: exit 0, valid JSON report."""
+    cd = _load_drill()
+    rc = cd.main(["--scale", "0.3", "--max-ticks", "1200"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_full_acceptance_drill():
+    """Full-scale acceptance: 24 requests, die + partition + dropped
+    terminal, every invariant, breaker chains on both faulted
+    replicas, nonzero failover counter."""
+    cd = _load_drill()
+    fleet, requests, schedule = cd.standard_scenario(scale=1.0)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=5000)
+        text = metrics.to_prometheus()
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": 24}
+    assert report.failovers >= 1
+    for rep in ("gen_server/1", "gen_server/2"):
+        states = {s.split("x")[0]
+                  for s in report.breaker_transitions.get(rep, [])}
+        assert {"open", "half_open", "closed"} <= states
+    assert "router_failovers_total" in text
+
+
+@pytest.mark.slow
+def test_drill_is_deterministic():
+    """Same schedule, same seed fleet -> byte-identical outcome
+    summary (the 'deterministic' in deterministic chaos drill)."""
+    cd = _load_drill()
+    outs = []
+    for _ in range(2):
+        metrics.reset_default()
+        fleet, requests, schedule = cd.standard_scenario(scale=0.4)
+        try:
+            report = cd.run_drill(fleet, requests, schedule,
+                                  max_ticks=2000)
+        finally:
+            fleet.close()
+        s = report.summary()
+        s.pop("breaker_transitions")  # label order stable anyway
+        outs.append(json.dumps(
+            dict(s, outcomes=sorted(s["outcomes"].items())),
+            sort_keys=True))
+    assert outs[0] == outs[1]
+
+
+@pytest.mark.slow
+def test_full_drill_over_real_model_replicas():
+    """The same die+partition schedule over REAL tiny-model replicas
+    (InflightBatchingGenerator on CPU): genuine prefill/decode traffic
+    under chaos, same invariants."""
+    import jax
+
+    from realhf_tpu.engine.inflight import InflightBatchingGenerator
+    from realhf_tpu.models import transformer as T
+    from realhf_tpu.models.config import TransformerConfig
+    from realhf_tpu.ops.sampling import GenerationHyperparameters
+
+    cfg = TransformerConfig(
+        n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+        intermediate_dim=64, vocab_size=97, apply_rotary=True,
+        layer_norm_type="rms", mlp_type="llama",
+        use_attention_bias=False, use_attn_proj_bias=False,
+        use_mlp_bias=False, activation_function="silu",
+        compute_dtype="float32")
+    params = T.init_params(cfg, jax.random.PRNGKey(0))
+    g = GenerationHyperparameters(
+        max_new_tokens=10, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+
+    def backend():
+        return InflightBatchingGenerator(
+            cfg, params, g, n_slots=2, max_prompt_len=32,
+            eos_token_id=None, pad_token_id=0, chunk_size=2)
+
+    cd = _load_drill()
+    # a tight burst (2 per tick, 10 total over 6 slots) so every
+    # replica holds in-flight work when gen_server/1 dies mid-stream
+    requests = [cd.DrillRequest(tick=2 + i // 2, need=11)
+                for i in range(10)]
+    schedule = [
+        cd.DrillEvent(tick=5, action="die", target="gen_server/1"),
+        cd.DrillEvent(tick=9, action="partition",
+                      target="gen_server/2", seconds=4.0),
+    ]
+    fleet = cd.DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05,
+                          backend_factory=backend)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=3000)
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": 10}
+    assert report.failovers >= 1
+    # real tokens came back (max_new_tokens of them, greedy)
+    some_rid = next(iter(report.terminals))
+    done = [d for k, d in fleet.events[some_rid] if k == "done"]
+    assert len(done) == 1 and len(done[0]["tokens"]) == 10

@@ -1,0 +1,127 @@
+"""FleetRegistry: leased membership, fencing epochs, and the fenced
+replica's flush-and-rejoin path on the server."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.serving.fleet import FleetRegistry, LeaseLostError
+from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
+from realhf_tpu.serving.server import RolloutServer
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture()
+def reg():
+    clock = Clock()
+    repo = MemoryNameRecordRepository(clock=clock)
+    return FleetRegistry("e", "t", lease_ttl=2.0, repo=repo), clock
+
+
+def test_register_renew_expire_reregister(reg):
+    registry, clock = reg
+    e1 = registry.register("gen_server/0", "tcp://h:1")
+    assert e1 == 1
+    assert registry.replicas()["gen_server/0"].address == "tcp://h:1"
+    assert registry.replicas()["gen_server/0"].epoch == 1
+    clock.advance(1.5)
+    registry.renew("gen_server/0")  # keeps the lease alive
+    clock.advance(1.5)
+    assert "gen_server/0" in registry.replicas()
+    clock.advance(2.5)  # silent past the ttl: gone
+    assert registry.replicas() == {}
+    with pytest.raises(LeaseLostError):
+        registry.renew("gen_server/0")
+    # fencing: the re-registration bumps the epoch
+    e2 = registry.register("gen_server/0", "tcp://h:2")
+    assert e2 == 2
+    assert registry.epoch_of("gen_server/0") == 2
+
+
+def test_deregister_is_graceful_and_epoch_persists(reg):
+    registry, _ = reg
+    registry.register("gen_server/1", "a")
+    registry.deregister("gen_server/1")
+    assert registry.replicas() == {}
+    registry.deregister("gen_server/1")  # idempotent
+    assert registry.register("gen_server/1", "b") == 2
+
+
+def test_multiple_replicas_listed_sorted(reg):
+    registry, _ = reg
+    for i in (2, 0, 1):
+        registry.register(f"gen_server/{i}", f"addr{i}")
+    reps = registry.replicas()
+    assert sorted(reps) == [f"gen_server/{i}" for i in range(3)]
+    assert reps["gen_server/2"].address == "addr2"
+
+
+def test_bad_lease_ttl_rejected():
+    with pytest.raises(ValueError):
+        FleetRegistry("e", "t", lease_ttl=0.0,
+                      repo=MemoryNameRecordRepository())
+
+
+# ----------------------------------------------------------------------
+def test_server_fence_flush_and_rejoin():
+    """A replica that misses its renewals gets fenced: it drops every
+    queued and in-flight request WITHOUT emitting terminal events
+    (the router already failed them over; a late terminal would be a
+    duplicate delivery) and rejoins under a NEW fencing epoch."""
+    clock = Clock()
+    repo = MemoryNameRecordRepository(clock=clock)
+    registry = FleetRegistry("e", "t", lease_ttl=1.0, repo=repo)
+    server = RolloutServer(
+        FakeSlotBackend(n_slots=2, chunk=4),
+        server_name="gen_server/0",
+        queue=RequestQueue(max_depth=16, n_slots=2, clock=clock),
+        fleet=registry, clock=clock, seed=0)
+    assert server.fencing_epoch == 1
+    try:
+        # work in flight AND queued when the fence lands
+        for i in range(4):
+            assert server.queue.submit(GenRequest(
+                rid=f"r{i}",
+                prompt=np.array([40, 3, 4], np.int32))).accepted
+            server._routes[f"r{i}"] = b"ident"
+        server.serve_step()  # fills both slots, 2 stay queued
+        assert server.scheduler.n_live == 2
+        # the lease decays silently (e.g. the renewal path is
+        # partitioned away) ...
+        clock.advance(5.0)
+        assert registry.replicas() == {}
+        sent = []
+        server._sock = type("S", (), {
+            "poll": lambda *a, **k: 0,
+            "send_multipart": lambda self, f: sent.append(f),
+            "close": lambda *a, **k: None})()
+        # ... and the next serve_step notices, flushes, re-registers
+        server.serve_step()
+        assert server.fencing_epoch == 2
+        assert server.scheduler.n_live == 0
+        assert len(server.queue) == 0
+        assert server._routes == {}
+        assert sent == []  # NOTHING left this replica post-fence
+        assert registry.replicas()["gen_server/0"].epoch == 2
+        # back in business: new work is served normally
+        assert server.queue.submit(GenRequest(
+            rid="fresh", prompt=np.array([4, 3], np.int32))).accepted
+        server._routes["fresh"] = b"ident"
+        for _ in range(5):
+            server.serve_step()
+        kinds = [__import__("pickle").loads(f[1])[0] for f in sent]
+        assert "done" in kinds
+    finally:
+        server._fleet = None
+        server.close()

@@ -120,3 +120,28 @@ def test_retry_after_scales_with_depth_and_service_time():
         q.note_service_time(10.0)  # slow server -> longer hint
     after = q.submit(_req("c")).retry_after
     assert after > before
+
+
+def test_empty_queue_is_truthy():
+    """Regression (PR 2 footgun): an empty RequestQueue was falsy via
+    __len__, so `queue or default` silently replaced a caller's empty
+    queue and forced the `queue if queue is not None` workaround."""
+    q = RequestQueue(max_depth=4)
+    assert len(q) == 0
+    assert bool(q) is True
+    assert (q or None) is q
+
+
+def test_server_keeps_caller_provided_empty_queue():
+    """The RolloutServer workaround is gone: `queue or ...` now keeps
+    the provided (empty) instance."""
+    from realhf_tpu.base.testing import FakeSlotBackend
+    from realhf_tpu.serving.server import RolloutServer
+
+    q = RequestQueue(max_depth=4, n_slots=2)
+    server = RolloutServer(FakeSlotBackend(), server_name="bool/0",
+                           queue=q, seed=0)
+    try:
+        assert server.queue is q
+    finally:
+        server.close()

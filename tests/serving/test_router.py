@@ -1,0 +1,382 @@
+"""FleetRouter unit/integration tests: breaker state machine,
+least-loaded dispatch, failover with retried_from stamps, hedging,
+duplicate-terminal dedupe, and the hedged blocking probe.
+
+In-process fleets on ``FakeSlotBackend`` with an injected fake clock:
+lease expiry and every router timeout are deterministic. The full
+scripted-schedule drills live in tests/chaos/."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.fault_injection import NetChaos, parse_faults
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.obs import metrics
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.serving.request_queue import RequestQueue
+from realhf_tpu.serving.router import (
+    BreakerState,
+    CircuitBreaker,
+    FleetRouter,
+)
+from realhf_tpu.serving.server import (
+    TERMINAL_KINDS,
+    RolloutClient,
+    RolloutServer,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ----------------------------------------------------------------------
+# CircuitBreaker state machine
+# ----------------------------------------------------------------------
+def test_breaker_opens_after_threshold_and_probes():
+    clock = Clock()
+    trans = []
+    br = CircuitBreaker(failure_threshold=3, cooldown=2.0, clock=clock,
+                        on_transition=lambda p, n: trans.append(
+                            (p.name, n.name)))
+    assert br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state is BreakerState.CLOSED  # below threshold
+    br.record_failure()
+    assert br.state is BreakerState.OPEN
+    assert not br.allow() and not br.ready_to_probe()
+    clock.advance(2.5)
+    assert br.ready_to_probe()
+    br.half_open()
+    assert br.state is BreakerState.HALF_OPEN
+    br.record_failure()  # probe failed: back to OPEN, cooldown re-arms
+    assert br.state is BreakerState.OPEN and not br.ready_to_probe()
+    clock.advance(2.5)
+    br.half_open()
+    br.record_success()  # probe answered: closed, failures reset
+    assert br.state is BreakerState.CLOSED and br.failures == 0
+    assert trans == [("CLOSED", "OPEN"), ("OPEN", "HALF_OPEN"),
+                     ("HALF_OPEN", "OPEN"), ("OPEN", "HALF_OPEN"),
+                     ("HALF_OPEN", "CLOSED")]
+
+
+def test_breaker_force_open_skips_threshold():
+    br = CircuitBreaker(failure_threshold=5, clock=Clock())
+    br.force_open()
+    assert br.state is BreakerState.OPEN and not br.allow()
+
+
+# ----------------------------------------------------------------------
+# router over an in-process fleet
+# ----------------------------------------------------------------------
+class Fleet:
+    def __init__(self, n=2, n_slots=2, chunk=4, lease_ttl=2.0,
+                 net_faults="", **router_kwargs):
+        self.clock = Clock()
+        self.repo = MemoryNameRecordRepository(clock=self.clock)
+        self.registry = FleetRegistry("e", "t", lease_ttl=lease_ttl,
+                                      repo=self.repo)
+        self.chaos = NetChaos(parse_faults(net_faults),
+                              clock=self.clock)
+        self.servers = {}
+        self.alive = []
+        for i in range(n):
+            self.spawn(f"gen_server/{i}", n_slots=n_slots, chunk=chunk)
+        kw = dict(fleet_poll_interval=0.05, dispatch_timeout=1.0,
+                  response_timeout=5.0, pending_timeout=3.0,
+                  breaker_failures=2, breaker_cooldown=1.0,
+                  probe_timeout=1.0)
+        kw.update(router_kwargs)
+        self.router = FleetRouter(self.registry, chaos=self.chaos,
+                                  clock=self.clock, **kw)
+        self.client = RolloutClient(self.router.address)
+        self.events = {}
+
+    def spawn(self, name, n_slots=2, chunk=4):
+        srv = RolloutServer(
+            FakeSlotBackend(n_slots=n_slots, chunk=chunk),
+            server_name=name,
+            queue=RequestQueue(max_depth=32, n_slots=n_slots,
+                               clock=self.clock),
+            fleet=self.registry, chaos=self.chaos, clock=self.clock,
+            seed=len(self.servers))
+        self.servers[name] = srv
+        if name not in self.alive:
+            self.alive.append(name)
+        return srv
+
+    def die(self, name):
+        srv = self.servers[name]
+        srv._fleet = None  # crash: the lease decays
+        srv.close()
+        self.alive.remove(name)
+
+    def step(self, dt=0.05):
+        self.clock.advance(dt)
+        self.router.route_step(poll_timeout=0.002)
+        for name in list(self.alive):
+            self.servers[name].serve_step(poll_timeout=0.002)
+        while self.client._pump(0.002):
+            pass
+        for rid, q in self.client._events.items():
+            while q:
+                self.events.setdefault(rid, []).append(q.pop(0))
+
+    def run_until_terminal(self, rids, max_steps=600, dt=0.05):
+        for _ in range(max_steps):
+            self.step(dt)
+            if all(any(k in TERMINAL_KINDS
+                       for k, _ in self.events.get(r, []))
+                   for r in rids):
+                return
+        raise AssertionError(
+            f"no terminal for {[r for r in rids if not any(k in TERMINAL_KINDS for k, _ in self.events.get(r, []))]}")
+
+    def terminal(self, rid):
+        ts = [(k, d) for k, d in self.events.get(rid, [])
+              if k in TERMINAL_KINDS]
+        assert len(ts) == 1, (rid, ts)
+        return ts[0]
+
+    def close(self):
+        self.client.close()
+        for name in list(self.alive):
+            self.servers[name].close()
+        self.router.close()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_default()
+    yield
+
+
+def test_basic_dispatch_and_least_loaded():
+    f = Fleet(n=2)
+    try:
+        rids = [f.client.submit(np.array([8, 3], np.int32),
+                                ttl=60.0) for _ in range(6)]
+        f.run_until_terminal(rids)
+        for r in rids:
+            k, d = f.terminal(r)
+            assert k == "done" and len(d["tokens"]) == 8
+        st = f.router.stats()
+        # both replicas took work (least-loaded spreads the burst)
+        assert st["requests"] == 6 and st["dispatches"] == 6
+        per = {n: 0 for n in f.servers}
+        for n, srv in f.servers.items():
+            per[n] = srv.stats()["finished"]
+        assert all(v > 0 for v in per.values()), per
+    finally:
+        f.close()
+
+
+def test_duplicate_submit_is_idempotent():
+    f = Fleet(n=1)
+    try:
+        rid = f.client.submit(np.array([8, 3], np.int32), ttl=60.0)
+        # a retrying client re-sends the SAME rid: must not
+        # double-dispatch or double-deliver
+        f.client._sock.send(__import__("pickle").dumps(
+            ("submit", rid, np.array([8, 3], np.int32), 1, 60.0, 0,
+             None)))
+        f.run_until_terminal([rid])
+        assert f.terminal(rid)[0] == "done"
+        assert f.router.stats()["requests"] == 1
+    finally:
+        f.close()
+
+
+def test_failover_on_replica_death_with_retried_from():
+    """A replica dies with requests in flight: the router re-queues
+    them to the survivor and stamps the terminal with retried_from
+    (the acceptance invariant: nothing vanishes)."""
+    f = Fleet(n=2, n_slots=2, chunk=2, lease_ttl=1.0)
+    try:
+        # long requests so they are still running at the kill
+        rids = [f.client.submit(np.array([60, 3], np.int32), ttl=120.0)
+                for _ in range(4)]
+        for _ in range(6):
+            f.step()
+        victim = "gen_server/0"
+        in_flight_there = {
+            r for r in rids
+            if victim in f.router._requests[r].assigned} \
+            if all(r in f.router._requests for r in rids) else set()
+        f.die(victim)
+        f.run_until_terminal(rids)
+        failed_over = 0
+        for r in rids:
+            k, d = f.terminal(r)
+            assert k == "done", (r, k, d)
+            if d.get("retried_from"):
+                assert d["retried_from"] == [victim]
+                failed_over += 1
+        assert failed_over >= 1
+        assert failed_over >= len(in_flight_there) - 1
+        st = f.router.stats()
+        assert st["failovers"] >= failed_over
+        assert st["replicas"][victim]["lost"] is True
+        assert st["replicas"][victim]["breaker"] == "OPEN"
+    finally:
+        f.close()
+
+
+def test_rejoin_probes_breaker_closed_and_fenced_epoch():
+    """Kill a replica, let its lease decay, then revive it under the
+    same name: the router reconnects at the NEW epoch and the breaker
+    walks OPEN -> HALF_OPEN -> CLOSED via the in-loop ping probe --
+    the acceptance metric chain."""
+    f = Fleet(n=2, lease_ttl=1.0)
+    try:
+        f.die("gen_server/0")
+        for _ in range(30):
+            f.step()  # lease decays; breaker forced open
+        assert f.router.stats()["replicas"]["gen_server/0"][
+            "breaker"] == "OPEN"
+        f.spawn("gen_server/0")  # revive: re-registers, epoch 2
+        for _ in range(60):
+            f.step()
+            if f.router.stats()["replicas"]["gen_server/0"][
+                    "breaker"] == "CLOSED":
+                break
+        st = f.router.stats()["replicas"]["gen_server/0"]
+        assert st["breaker"] == "CLOSED" and st["epoch"] == 2
+        snap = metrics.snapshot()
+        trans = snap["router_breaker_transitions_total"]["values"]
+        states = {__import__("json").loads(k)["to"]
+                  for k in trans
+                  if __import__("json").loads(k)["replica"]
+                  == "gen_server/0"}
+        assert {"open", "half_open", "closed"} <= states
+        # and the revived replica actually serves
+        rid = f.client.submit(np.array([8, 3], np.int32), ttl=60.0)
+        f.run_until_terminal([rid])
+        assert f.terminal(rid)[0] == "done"
+    finally:
+        f.close()
+
+
+def test_hedge_wins_when_dispatch_is_dropped():
+    """The wire eats the first dispatch: the hedge (same rid, second
+    replica) wins; the client sees exactly one terminal."""
+    f = Fleet(n=2, hedge_delay=0.5, max_hedges=1,
+              dispatch_timeout=30.0,  # hedging must beat the timeout
+              net_faults="net_drop:router/0:dispatch.submit:1")
+    try:
+        rid = f.client.submit(np.array([8, 3], np.int32), ttl=60.0)
+        f.run_until_terminal([rid])
+        assert f.terminal(rid)[0] == "done"
+        st = f.router.stats()
+        assert st["hedges"] == 1
+        assert st["hedge_wins"] == 1
+        assert len([k for k, _ in f.events[rid]
+                    if k in TERMINAL_KINDS]) == 1
+    finally:
+        f.close()
+
+
+def test_no_healthy_replica_rejection_after_pending_timeout():
+    f = Fleet(n=1, lease_ttl=1.0, pending_timeout=2.0)
+    try:
+        f.die("gen_server/0")
+        for _ in range(30):
+            f.step()  # lease gone, nobody left
+        rid = f.client.submit(np.array([8, 3], np.int32), ttl=60.0)
+        f.run_until_terminal([rid])
+        k, d = f.terminal(rid)
+        assert k == "rejected"
+        assert d["reason"] == "no_healthy_replica"
+        assert d["retry_after"] > 0
+    finally:
+        f.close()
+
+
+def test_router_backpressure_cap():
+    f = Fleet(n=1, max_pending=2)
+    try:
+        rids = [f.client.submit(np.array([200, 3], np.int32),
+                                ttl=60.0) for _ in range(5)]
+        f.run_until_terminal(rids, max_steps=2000)
+        kinds = [f.terminal(r)[0] for r in rids]
+        assert kinds.count("rejected") >= 1
+        rejected = [f.terminal(r)[1] for r in rids
+                    if f.terminal(r)[0] == "rejected"]
+        assert all(d["reason"] == "backpressure" for d in rejected)
+    finally:
+        f.close()
+
+
+def test_client_cancel_through_router():
+    f = Fleet(n=1, n_slots=1, chunk=1)
+    try:
+        rid = f.client.submit(np.array([500, 3], np.int32), ttl=60.0)
+        for _ in range(10):
+            f.step()
+        f.client.cancel(rid)
+        f.run_until_terminal([rid])
+        assert f.terminal(rid)[0] == "cancelled"
+    finally:
+        f.close()
+
+
+def test_router_drain_terminates_everything():
+    f = Fleet(n=1, n_slots=1, chunk=1)
+    try:
+        rids = [f.client.submit(np.array([500, 3], np.int32),
+                                ttl=None) for _ in range(2)]
+        for _ in range(5):
+            f.step()
+        # timeout=0 on the fake clock: the grace loop is skipped and
+        # everything still in flight is expired deterministically
+        f.router.drain(timeout=0.0)
+        for _ in range(10):
+            f.step()
+        for r in rids:
+            assert f.terminal(r)[0] in ("expired", "cancelled", "done")
+        # post-drain submissions bounce
+        rid = f.client.submit(np.array([4, 3], np.int32), ttl=60.0)
+        f.run_until_terminal([rid])
+        k, d = f.terminal(rid)
+        assert k == "rejected" and d["reason"] == "draining"
+    finally:
+        f.close()
+
+
+# ----------------------------------------------------------------------
+def test_probe_hedged_blocking():
+    """FleetRouter.probe: the retry.hedged-based health check against
+    a replica served from a real thread."""
+    clock = Clock()
+    repo = MemoryNameRecordRepository(clock=clock)
+    registry = FleetRegistry("e", "t", lease_ttl=60.0, repo=repo)
+    server = RolloutServer(
+        FakeSlotBackend(), server_name="gen_server/0",
+        queue=RequestQueue(clock=clock), fleet=registry, clock=clock,
+        seed=0)
+    router = FleetRouter(registry, clock=clock)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=lambda: [server.serve_step(poll_timeout=0.01)
+                        for _ in iter(lambda: stop.is_set(), True)],
+        daemon=True)
+    t.start()
+    try:
+        assert router.probe("gen_server/0", timeout=10.0) is True
+        assert router.probe("no/such/replica", timeout=0.2) is False
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        server.close()
+        router.close()

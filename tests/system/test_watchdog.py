@@ -191,3 +191,33 @@ def test_gather_replies_liveness_hook_aborts_promptly():
         assert time.monotonic() - t0 < 5.0
     finally:
         master.close()
+
+
+def test_on_lost_hook_fires_on_edge_only():
+    """The PR-7 router hook: on_lost fires exactly once per
+    ALIVE->LOST edge (e.g. FleetRouter.notify_lost), and a raising
+    hook never breaks the liveness sweep."""
+    name_resolve.reconfigure("memory")
+    clock = FakeClock(1000.0)
+    lost = []
+
+    def hook(w):
+        lost.append(w)
+        raise RuntimeError("hook explodes on purpose")
+
+    wd = Watchdog(EXP, TRIAL, ["w/0", "w/1"], timeout=10.0,
+                  grace=30.0, poll_interval=0.0, clock=clock,
+                  on_lost=hook)
+    _beat("w/0", 999.0)
+    _beat("w/1", 999.0)
+    assert wd.check() == {"w/0": ALIVE, "w/1": ALIVE}
+    assert lost == []
+    clock.t = 1020.0  # both beats stale now
+    _beat("w/1", 1019.0)  # but w/1 kept beating
+    assert wd.check() == {"w/0": LOST, "w/1": ALIVE}
+    assert lost == ["w/0"]
+    wd.check()  # steady-state LOST: no re-fire
+    assert lost == ["w/0"]
+    clock.t = 1040.0
+    assert wd.check()["w/1"] == LOST  # the hook exception above did
+    assert lost == ["w/0", "w/1"]     # not poison later edges
