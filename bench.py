@@ -728,6 +728,26 @@ def _bench_pipeline_schedules():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def _bench_serving_hotpath():
+    """Serving hot-path load bench in a CPU-forced subprocess
+    (scripts/bench_serving.py): shared-prefix vs disjoint traffic
+    through a real RolloutServer, reporting tokens/sec, radix-cache
+    prefill tokens saved, and the speculative accept rate."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_serving.py")
+    r = subprocess.run(
+        [sys.executable, script, "--clients", "4", "--requests", "3",
+         "--spec-k", "3"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench_serving exited {r.returncode}: {r.stderr[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def main():
     headline_only = "--headline-only" in sys.argv[1:]
     use_accel = _accelerator_usable()
@@ -813,6 +833,16 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort phase
         extra["pipeline_schedule_bench"] = {"error": repr(e)}
     phases_done.append("pipeline_schedules")
+    _flush_payload(headline, extra, phases_done)
+
+    # Serving hot path (prefix cache + spec decoding): the per-replica
+    # tokens/sec lever of ROADMAP #2; backend-independent signals are
+    # prefill_tokens_saved and the accept rate.
+    try:
+        extra["serving_bench"] = _bench_serving_hotpath()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["serving_bench"] = {"error": repr(e)}
+    phases_done.append("serving_bench")
     _flush_payload(headline, extra, phases_done)
 
     # Reshard + cross-group sync (north-star metric): best-effort on

@@ -171,6 +171,18 @@ class ServingSpec:
     gconfig: dict = dataclasses.field(default_factory=dict)
     #: send incremental token deltas after every decode chunk
     stream_tokens: bool = True
+    # -- serving hot path (docs/serving.md "Prefix cache &
+    # speculative decoding") --------------------------------------
+    #: byte budget for the radix prefix/KV cache (host memory):
+    #: requests sharing a cached prefix skip its prefill and only run
+    #: the uncached suffix. 0 disables reuse entirely (behaviorally
+    #: identical to a cache-less server).
+    prefix_cache_bytes: int = 64 * 1024 * 1024
+    #: prompt-lookup speculative decoding: draft k tokens per round
+    #: from the request's own history and verify them in one forward
+    #: (greedy-exact; ignored unless gconfig is greedy). 0 disables.
+    #: The REALHF_TPU_SPEC_K env var overrides at worker start.
+    spec_decode_k: int = 0
     #: seconds drain() waits for in-flight sequences at shutdown
     drain_timeout_secs: float = 30.0
     # -- resilient fleet mode (docs/serving.md "Fleet, failover &
@@ -196,6 +208,12 @@ class ServingSpec:
     router_response_timeout_secs: Optional[float] = 60.0
     #: cap on router-tracked in-flight requests (backpressure beyond)
     router_max_pending: int = 1024
+    #: prefix-affinity dispatch: hash a request's first N tokens and
+    #: prefer the replica that last served that hash, so fleet traffic
+    #: concentrates prefix-cache hits instead of spraying a shared
+    #: system prompt across every replica. 0 disables (pure
+    #: least-loaded). Health/breaker/fencing gates always win.
+    router_affinity_prefix_len: int = 16
 
 
 @dataclasses.dataclass

@@ -41,44 +41,67 @@ class FakeSlotBackend:
     and every ``decode_chunk`` advances each live slot by up to
     ``chunk`` tokens. Used by scheduler unit tests and the
     chaos-drill harness (scripts/chaos_drill.py), where thousands of
-    serve iterations must run in milliseconds."""
+    serve iterations must run in milliseconds.
+
+    With ``prefix_capable=True`` it also implements the prefix-cache
+    extensions (``supports_prefix_fill`` / ``fill_slot(cached_len,
+    prefix_kv)`` / ``harvest(export_kv=True)``): exported KV blocks
+    are tiny ``[1, 1, seq_len, 1]`` float32 arrays (4 bytes per
+    token+layer-head), enough to drive radix-tree byte accounting
+    without a model."""
 
     def __init__(self, n_slots: int = 2, chunk: int = 4,
-                 max_prompt_len: int = 64):
+                 max_prompt_len: int = 64,
+                 prefix_capable: bool = False):
         self.n_slots = n_slots
         self.chunk = chunk
         self.max_prompt_len = max_prompt_len
+        self.supports_prefix_fill = prefix_capable
         self.params = "v0"
         self._slots = {}  # slot -> [int_id, need, got]
+        self._prompts = {}  # slot -> prompt copy (prefix mode)
+        self.fills = []  # (slot, int_id, cached_len) fill audit trail
 
     def free_slots(self):
         return [s for s in range(self.n_slots) if s not in self._slots]
 
-    def fill_slot(self, slot, int_id, prompt):
+    def fill_slot(self, slot, int_id, prompt, cached_len=0,
+                  prefix_kv=None):
         if len(prompt) > self.max_prompt_len:
             raise ValueError(
                 f"prompt length {len(prompt)} > {self.max_prompt_len}")
         self._slots[slot] = [int_id, int(prompt[0]), 0]
+        if self.supports_prefix_fill:
+            import numpy as np
+            self._prompts[slot] = np.asarray(prompt).copy()
+        self.fills.append((slot, int_id, int(cached_len)))
 
     def decode_chunk(self, key):
         for v in self._slots.values():
             v[2] = min(v[1], v[2] + self.chunk)
 
-    def harvest(self):
+    def harvest(self, export_kv=False):
         import numpy as np
 
         from realhf_tpu.engine.inflight import FinishedSequence
         out = []
         for slot, (i, need, got) in list(self._slots.items()):
             if got >= need:
-                out.append(FinishedSequence(
+                fs = FinishedSequence(
                     request_id=i, tokens=np.arange(got),
-                    logprobs=np.zeros(got), no_eos=True))
+                    logprobs=np.zeros(got), no_eos=True)
+                if export_kv and self.supports_prefix_fill:
+                    n = len(self._prompts[slot]) + got
+                    fs.kv = (np.zeros((1, 1, n, 1), np.float32),
+                             np.zeros((1, 1, n, 1), np.float32))
+                out.append(fs)
                 del self._slots[slot]
+                self._prompts.pop(slot, None)
         return out
 
     def release_slot(self, slot):
         self._slots.pop(slot, None)
+        self._prompts.pop(slot, None)
 
     def swap_params(self, p):
         self.params = p

@@ -23,12 +23,13 @@ rollout generation (GRPO / ReMax / gen experiments).
 
 import dataclasses
 import functools
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from realhf_tpu.base import logging
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.obs import tracing
@@ -38,12 +39,21 @@ from realhf_tpu.ops.sampling import (
     top_k_top_p_logits,
 )
 
+logger = logging.getLogger("engine.inflight")
+
 
 def _bucket(n: int, buckets=(64, 128, 256, 512, 1024, 2048, 4096)) -> int:
     for b in buckets:
         if n <= b:
             return b
     return n
+
+
+#: finer ladder for the partial-prefill (prefix-cache hit) path: the
+#: donor window and the uncached suffix each get their own bucket, so
+#: a coarse floor would waste most of the win -- a 95%-hit request
+#: must pay a SMALL suffix bucket, not the full-prompt one
+_PARTIAL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
 @dataclasses.dataclass
@@ -54,17 +64,31 @@ class FinishedSequence:
     no_eos: bool           # True iff the sequence never emitted EOS
                            # (hit max_new_tokens), matching the batch
                            # path's seq_no_eos_mask semantics.
+    #: speculative-decoding accounting for THIS sequence (0 when the
+    #: drafter is off): drafts proposed / drafts accepted by verify
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    #: host copies of the sequence's KV rows ([nl, nkv, len(prompt)+
+    #: len(tokens), hd] each), present only for ``harvest(
+    #: export_kv=True)`` -- the serving scheduler publishes them into
+    #: the radix prefix cache (serving/prefix_cache.py)
+    kv: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
 
 class InflightBatchingGenerator:
     """Slot-machine generation over a queue of prompts."""
+
+    #: the serving scheduler feature-detects the prefix-cache fill /
+    #: KV-export extensions on this attribute (test fakes may lack it)
+    supports_prefix_fill = True
 
     def __init__(self, cfg: TransformerConfig, params,
                  gconfig: GenerationHyperparameters,
                  *, n_slots: int, max_prompt_len: int,
                  eos_token_id: Optional[int], pad_token_id: int,
                  chunk_size: int = 32, moe_constraint=None,
-                 mesh=None, attention_fn=None):
+                 mesh=None, attention_fn=None,
+                 spec_decode_k: int = 0, drafter=None):
         if not gconfig.force_no_logits_mask:
             raise ValueError(
                 "inflight batching does not produce the PPO logits "
@@ -84,6 +108,34 @@ class InflightBatchingGenerator:
         self._prefill = jax.jit(functools.partial(
             _prefill_into_slot, self.cfg, self.cache_len,
             moe_constraint, attention_fn))
+        # partial-prefill entry for radix prefix-cache hits: donor KV
+        # seeds rows [0, c_b) and only the uncached suffix runs the
+        # forward (one compilation per (donor-bucket, suffix-bucket))
+        self._prefill_suffix = jax.jit(functools.partial(
+            _prefill_suffix_into_slot, self.cfg, self.cache_len,
+            moe_constraint))
+
+        # prompt-lookup speculative decoding (greedy-exact verify):
+        # k drafts per round, all verified in ONE forward over the
+        # carry. Sampling-based generation falls back to the plain
+        # decode loop -- acceptance is only exact under argmax.
+        self._spec_k = int(spec_decode_k or 0)
+        if self._spec_k > 0 and not gconfig.greedy:
+            logger.warning(
+                "spec_decode_k=%d requested but gconfig.greedy is "
+                "False; speculative decoding is greedy-exact only -- "
+                "disabling.", self._spec_k)
+            self._spec_k = 0
+        self._drafter = None
+        self._verify = None
+        if self._spec_k > 0:
+            if drafter is None:
+                from realhf_tpu.engine.drafter import NGramDrafter
+                drafter = NGramDrafter(self._spec_k)
+            self._drafter = drafter
+            self._verify = jax.jit(functools.partial(
+                _verify_chunk, cfg, gconfig, eos_token_id,
+                self._spec_k, moe_constraint))
 
         nm = gconfig.max_new_tokens
         self.state = dict(
@@ -97,8 +149,20 @@ class InflightBatchingGenerator:
             hit_eos=jnp.zeros((n_slots,), bool),
             out_tokens=jnp.full((n_slots, nm), pad_token_id, jnp.int32),
             out_logprobs=jnp.zeros((n_slots, nm), jnp.float32),
+            spec_proposed=jnp.zeros((n_slots,), jnp.int32),
+            spec_accepted=jnp.zeros((n_slots,), jnp.int32),
         )
         self._slot_req = [-1] * n_slots  # host: request id per slot
+        #: host copy of each slot's prompt: the n-gram drafter needs
+        #: the full history, and the scheduler needs it to key KV
+        #: publications
+        self._slot_prompt: List[Optional[np.ndarray]] = [None] * n_slots
+        #: how the last fill_slot was lowered (bucket REGRESSION
+        #: surface: a 95%-cached prompt must compile/pay the SUFFIX
+        #: bucket, not the full-prompt one)
+        self.last_fill: Dict = {}
+        self.fill_stats = dict(prefill_tokens=0, prefill_tokens_saved=0)
+        self.spec_stats = dict(rounds=0)
 
         self._decode_chunk = jax.jit(functools.partial(
             _decode_chunk, cfg, gconfig, eos_token_id, pad_token_id,
@@ -123,8 +187,51 @@ class InflightBatchingGenerator:
 
     def decode_chunk(self, key: jax.Array):
         """Advance every live slot by up to ``chunk_size`` decode
-        steps (one host<->device sync)."""
-        self.state = self._decode_chunk(self.params, self.state, key)
+        steps (one host<->device sync). With ``spec_decode_k > 0``
+        (greedy only) the chunk runs speculative verify rounds
+        instead: each round drafts k tokens per slot on the host
+        (prompt lookup) and verifies them in ONE forward, emitting
+        1..k+1 tokens per live slot per device call."""
+        if self._spec_k > 0 and self.n_live:
+            self._spec_chunk()
+        else:
+            self.state = self._decode_chunk(self.params, self.state,
+                                            key)
+
+    def _spec_chunk(self):
+        """ceil(chunk / (k+1)) verify rounds == the plain chunk's
+        token budget when every draft is accepted. Each round pays one
+        bundled D2H (the drafter consumes the history on the host) and
+        one verify forward -- versus ``chunk`` sequential forwards on
+        the plain path."""
+        nm = self.g.max_new_tokens
+        rounds = -(-self.chunk // (self._spec_k + 1))
+        for _ in range(rounds):
+            # host drafting needs the emitted tokens each round; this
+            # is the one bundled readback the speculative loop is
+            # built around (it replaces k+1 sequential forwards)
+            host = self._host_view()  # graft-lint: disable=purity-sync-in-loop
+            drafts = np.zeros((self.n_slots, self._spec_k), np.int32)
+            n_live = 0
+            for slot in range(self.n_slots):
+                if (self._slot_req[slot] < 0
+                        or not host["active"][slot]
+                        or not host["unfinished"][slot]
+                        or host["emitted"][slot] >= nm):
+                    continue
+                n_live += 1
+                e = int(host["emitted"][slot])
+                hist = np.concatenate(
+                    [self._slot_prompt[slot],
+                     host["out_tokens"][slot, :e].astype(np.int64)])
+                drafts[slot] = self._drafter.propose(hist)
+            if n_live == 0:
+                break
+            with tracing.span("serve:spec_verify", n_live=n_live,
+                              k=self._spec_k):
+                self.state = self._verify(self.params, self.state,
+                                          jnp.asarray(drafts))
+            self.spec_stats["rounds"] += 1
 
     def swap_params(self, params):
         """Hot-swap the weights used from the next decode/prefill on.
@@ -138,6 +245,7 @@ class InflightBatchingGenerator:
         slot immediately becomes free and the partial output is
         dropped."""
         self._slot_req[slot] = -1
+        self._slot_prompt[slot] = None
         self.state["active"] = self.state["active"].at[slot].set(False)
 
     def _host_view(self) -> Dict[str, np.ndarray]:
@@ -153,7 +261,8 @@ class InflightBatchingGenerator:
         return jax.device_get({
             k: self.state[k]
             for k in ("active", "unfinished", "emitted", "hit_eos",
-                      "out_tokens", "out_logprobs")})
+                      "out_tokens", "out_logprobs", "spec_proposed",
+                      "spec_accepted")})
 
     def snapshot_slot(self, slot: int):
         """(tokens_so_far, logprobs_so_far) of the sequence in
@@ -176,13 +285,21 @@ class InflightBatchingGenerator:
                          host["out_logprobs"][slot, :n])
         return out
 
-    def harvest(self) -> List[FinishedSequence]:
+    def harvest(self, export_kv: bool = False) -> List[FinishedSequence]:
         """Collect every finished sequence and free its slot (one
-        bundled host transfer, not four per finished slot)."""
+        bundled host transfer, not four per finished slot).
+
+        ``export_kv=True`` additionally downloads each finished slot's
+        KV rows (prompt + generated, in token order) in ONE bundled
+        fetch and attaches them as ``FinishedSequence.kv`` so the
+        serving scheduler can publish them into the radix prefix
+        cache. This is a full slot-cache D2H -- only ask for it when a
+        prefix cache is actually configured."""
         out: List[FinishedSequence] = []
         if self.n_live == 0:
             return out
         host = self._host_view()
+        slots: List[int] = []
         for slot in range(self.n_slots):
             rid = self._slot_req[slot]
             if rid < 0 or (host["active"][slot]
@@ -193,7 +310,24 @@ class InflightBatchingGenerator:
                 request_id=rid,
                 tokens=host["out_tokens"][slot, :n],
                 logprobs=host["out_logprobs"][slot, :n],
-                no_eos=not bool(host["hit_eos"][slot])))
+                no_eos=not bool(host["hit_eos"][slot]),
+                spec_proposed=int(host["spec_proposed"][slot]),
+                spec_accepted=int(host["spec_accepted"][slot])))
+            slots.append(slot)
+        if export_kv and slots:
+            idx = jnp.asarray(slots)
+            cache = self.state["cache"]
+            kv = jax.device_get(dict(k=cache["k"][:, idx],
+                                     v=cache["v"][:, idx],
+                                     valid=cache["valid"][idx]))
+            for i, fs in enumerate(out):
+                # valid rows in row order ARE token order: donor
+                # prefix rows, then the left-padded suffix's real
+                # tail, then sequentially appended decode rows
+                rows = np.flatnonzero(kv["valid"][i])
+                fs.kv = (np.ascontiguousarray(kv["k"][:, i][:, :, rows, :]),
+                         np.ascontiguousarray(kv["v"][:, i][:, :, rows, :]))
+        for slot in slots:
             self.release_slot(slot)
         return out
 
@@ -206,30 +340,92 @@ class InflightBatchingGenerator:
 
     # ------------------------------------------------------------------
     def fill_slot(self, slot: int, request_id: int,
-                  prompt: np.ndarray):
+                  prompt: np.ndarray, cached_len: int = 0,
+                  prefix_kv=None):
+        """Prefill ``prompt`` into ``slot``. With ``cached_len > 0``
+        the first ``cached_len`` positions are seeded from ``prefix_kv``
+        (``(k, v)``, each ``[nl, nkv, >=cached_len, hd]`` host arrays
+        from the radix prefix cache) and ONLY the uncached suffix runs
+        the forward -- bucketed by SUFFIX length, so a 95%-hit request
+        compiles and pays the small bucket, not the full-prompt one."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = len(prompt)
         max_prompt = self.max_prompt_len
-        if len(prompt) > max_prompt:
+        if n > max_prompt:
             raise ValueError(
-                f"prompt of {len(prompt)} tokens exceeds max_prompt_len "
+                f"prompt of {n} tokens exceeds max_prompt_len "
                 f"{max_prompt}")
-        lp = min(_bucket(len(prompt)), max_prompt)
-        ids = np.full((1, lp), self.pad, np.int32)
-        seg = np.zeros((1, lp), np.int32)
-        pos = np.zeros((1, lp), np.int32)
-        ids[0, lp - len(prompt):] = prompt          # left padding
-        seg[0, lp - len(prompt):] = 1
-        pos[0, lp - len(prompt):] = np.arange(len(prompt))
-        # one bundled upload (a relayed platform pays fixed latency
-        # per transfer; see Engine._globalize_tree). `slot` keeps its
-        # host int for the list index below -- indexing with a device
-        # scalar would force a blocking D2H readback per fill.
-        with tracing.span("serve:prefill", slot=slot,
-                          prompt_len=len(prompt), bucket=lp):
-            dev_slot, ids, seg, pos = jax.device_put((slot, ids, seg,
-                                                      pos))
-            self.state = self._prefill(self.params, self.state,
-                                       dev_slot, ids, seg, pos)
+        c = int(cached_len)
+        if c > 0 and prefix_kv is None:
+            raise ValueError("cached_len > 0 requires prefix_kv")
+        # the hidden state feeding the first decode step is NOT in the
+        # KV cache: at least one real token must always prefill
+        c = min(c, n - 1)
+        nm = self.g.max_new_tokens
+        c_b = s_b = 0
+        while c > 0:
+            # donor rows are padded to their own bucket so jit sees a
+            # bounded set of (donor, suffix) shapes instead of one
+            # compilation per distinct cached_len
+            c_b = _bucket(c, _PARTIAL_BUCKETS)
+            s_b = _bucket(n - c, _PARTIAL_BUCKETS)
+            if c_b + s_b + nm <= self.cache_len:
+                break
+            # donor rounding overflows the cache row: TRIM the donor
+            # to the next-lower bucket boundary (a shorter cached
+            # prefix is still a valid prefix) rather than throwing
+            # the whole hit away
+            smaller = [b for b in _PARTIAL_BUCKETS if b < c_b]
+            c = smaller[-1] if smaller else 0
+        if c <= 0:
+            lp = min(_bucket(n), max_prompt)
+            ids = np.full((1, lp), self.pad, np.int32)
+            seg = np.zeros((1, lp), np.int32)
+            pos = np.zeros((1, lp), np.int32)
+            ids[0, lp - n:] = prompt          # left padding
+            seg[0, lp - n:] = 1
+            pos[0, lp - n:] = np.arange(n)
+            # one bundled upload (a relayed platform pays fixed
+            # latency per transfer; see Engine._globalize_tree).
+            # `slot` keeps its host int for the list index below --
+            # indexing with a device scalar would force a blocking
+            # D2H readback per fill.
+            with tracing.span("serve:prefill", slot=slot,
+                              prompt_len=n, bucket=lp):
+                dev_slot, ids, seg, pos = jax.device_put(
+                    (slot, ids, seg, pos))
+                self.state = self._prefill(self.params, self.state,
+                                           dev_slot, ids, seg, pos)
+            self.last_fill = dict(bucket=lp, prompt_len=n,
+                                  cached_len=0, prefilled=n)
+            self.fill_stats["prefill_tokens"] += n
+        else:
+            s = n - c
+            kdtype = self.state["cache"]["k"].dtype
+            dk = np.zeros((self.cfg.n_layers, self.cfg.n_kv_heads,
+                           c_b, self.cfg.head_dim), kdtype)
+            dv = np.zeros_like(dk)
+            dk[:, :, :c] = np.asarray(prefix_kv[0])[:, :, :c]
+            dv[:, :, :c] = np.asarray(prefix_kv[1])[:, :, :c]
+            dvalid = np.arange(c_b) < c
+            ids = np.full((1, s_b), self.pad, np.int32)
+            seg = np.zeros((1, s_b), np.int32)
+            pos = np.zeros((1, s_b), np.int32)
+            ids[0, s_b - s:] = prompt[c:]        # left padding within
+            seg[0, s_b - s:] = 1                 # the suffix window
+            pos[0, s_b - s:] = c + np.arange(s)
+            with tracing.span("serve:prefill", slot=slot,
+                              prompt_len=n, bucket=s_b, cached_len=c):
+                dev = jax.device_put((slot, dk, dv, dvalid, ids, seg,
+                                      pos))
+                self.state = self._prefill_suffix(self.params,
+                                                  self.state, *dev)
+            self.last_fill = dict(bucket=s_b, prompt_len=n,
+                                  cached_len=c, prefilled=s)
+            self.fill_stats["prefill_tokens"] += s
+            self.fill_stats["prefill_tokens_saved"] += c
         self._slot_req[slot] = request_id
+        self._slot_prompt[slot] = prompt
 
     # ------------------------------------------------------------------
     def generate_all(self, prompts: List[np.ndarray], key: jax.Array
@@ -286,7 +482,295 @@ def _prefill_into_slot(cfg, cache_len, moe_constraint, attention_fn,
     new["out_tokens"] = state["out_tokens"].at[slot].set(
         jnp.full((state["out_tokens"].shape[1],), 0, jnp.int32))
     new["out_logprobs"] = state["out_logprobs"].at[slot].set(0.0)
+    new["spec_proposed"] = state["spec_proposed"].at[slot].set(0)
+    new["spec_accepted"] = state["spec_accepted"].at[slot].set(0)
     return new
+
+
+def _extend_rows(cfg, moe_constraint, params, k_all, v_all, valid0,
+                 tokens, positions, rows, tok_mask):
+    """Multi-token carry extension: run ``m`` new tokens per stream
+    through the transformer IN ONE forward against the existing KV
+    rows -- the shared primitive under partial prefill (suffix after a
+    radix-cache donor) and speculative verify (k drafts + 1 committed
+    token). ``decode_step`` is the ``m == 1`` special case of this.
+
+    k_all/v_all: [nl, B, nkv, S, hd] rows (the full slot batch for
+    verify; a batch-1 local window for suffix prefill).
+    valid0: [B, S] validity BEFORE the new tokens.
+    tokens/positions/rows: [B, m]; ``rows`` are the cache rows the new
+    tokens write (pre-clamped to S-1 by the caller).
+    tok_mask: [B, m] -- False lanes (padding / capped lanes) neither
+    write KV nor count; their hidden outputs are garbage and must not
+    be read.
+
+    Returns (hidden [B, m, H] after the final norm, k_all, v_all).
+    Attention is the plain XLA einsum path (scores masked per query:
+    old valid rows plus new rows i <= j); on TPU meshes GSPMD
+    partitions it like any other einsum -- the Pallas single-query
+    decode kernels stay on the one-token hot path."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    b, m = tokens.shape
+    s_len = valid0.shape[1]
+
+    x = params["embed"]["wte"].astype(cdt)[tokens]  # [B, m, H]
+    if cfg.uses_absolute_position:
+        x = x + params["embed"]["wpe"].astype(cdt)[
+            positions + cfg.abs_position_embedding_offset]
+    if cfg.normalize_embed:
+        x = x * jnp.asarray(cfg.hidden_dim ** 0.5, dtype=cdt)
+
+    if cfg.apply_rotary:
+        cos, sin = T.rotary_freqs(positions, cfg.head_dim,
+                                  cfg.rotary_base, cfg.rotary_scaling,
+                                  cfg.rotary_scaling_type,
+                                  cfg.n_positions)
+    else:
+        half = cfg.head_dim // 2
+        cos = jnp.ones((b, m, half), jnp.float32)
+        sin = jnp.zeros((b, m, half), jnp.float32)
+
+    # per-query attendable rows: everything valid before this call,
+    # plus new rows written at lane i <= the query's lane j
+    written = ((rows[:, :, None] == jnp.arange(s_len)[None, None, :])
+               & tok_mask[:, :, None])                     # [B, m, S]
+    upto = jnp.cumsum(written.astype(jnp.int32), axis=1) > 0
+    qmask = valid0[:, None, :] | upto
+    if cfg.sliding_window is not None:
+        idx = jnp.arange(s_len, dtype=jnp.int32)[None, None, :]
+        qmask = qmask & ((rows[:, :, None] - idx) < cfg.sliding_window)
+
+    barr = jnp.arange(b)[:, None]
+    group = cfg.n_q_heads // cfg.n_kv_heads
+
+    def layer_body(x, k_all, v_all, lp, layer_idx, static_l=None):
+        ln1 = T._norm(cfg, x, lp["ln1"]["scale"], lp["ln1"].get("bias"))
+        q, k, v = T._qkv(cfg, lp, ln1)  # q [B,m,nq,hd]; k/v [B,m,nkv,hd]
+        if cfg.apply_rotary:
+            q = T.apply_rotary(q, cos, sin, cfg.rotary_interleaved)
+            k = T.apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+        l = layer_idx if static_l is None else static_l
+        k_l = k_all[l]  # [B, nkv, S, hd]
+        v_l = v_all[l]
+        # masked scatter of the new rows: padded lanes share clamped
+        # row indices, so their writes must keep the existing values
+        kw = k.astype(k_l.dtype)
+        vw = v.astype(v_l.dtype)
+        keep = tok_mask[:, :, None, None]
+        cur_k = k_l[barr, :, rows]      # [B, m, nkv, hd]
+        cur_v = v_l[barr, :, rows]
+        k_l = k_l.at[barr, :, rows].set(jnp.where(keep, kw, cur_k))
+        v_l = v_l.at[barr, :, rows].set(jnp.where(keep, vw, cur_v))
+        k_all = k_all.at[l].set(k_l)
+        v_all = v_all.at[l].set(v_l)
+        base = cfg.head_dim ** -0.5 if cfg.scale_attn_weights else 1.0
+        if not cfg.scale_attn_by_inverse_layer_idx:
+            scale = base
+        elif static_l is not None:
+            scale = base / (static_l + 1)
+        else:
+            scale = T._attn_scale(cfg, layer_idx)
+        qg = q.reshape(b, m, cfg.n_kv_heads, group, cfg.head_dim)
+        scores = jnp.einsum("bmhgd,bhsd->bmhgs", qg, k_l,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(qmask[:, :, None, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bmhgs,bhsd->bmhgd",
+                          probs.astype(v_l.dtype), v_l)
+        proj = attn.reshape(b, m, -1) @ lp["attn"]["wo"].astype(x.dtype)
+        if "bo" in lp["attn"]:
+            proj = proj + lp["attn"]["bo"].astype(x.dtype)
+        x = x + proj
+        ln2 = T._norm(cfg, x, lp["ln2"]["scale"], lp["ln2"].get("bias"))
+        x = x + T._mlp(cfg, lp, ln2, moe_constraint)
+        return x, k_all, v_all
+
+    if cfg.n_layers <= T._DECODE_UNROLL_MAX_LAYERS:
+        for li in range(cfg.n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[li],
+                                        params["blocks"])
+            x, k_all, v_all = layer_body(x, k_all, v_all, lp, li,
+                                         static_l=li)
+    else:
+        def body(carry, layer):
+            xc, kc, vc = carry
+            lp, layer_idx = layer
+            xc, kc, vc = layer_body(xc, kc, vc, lp, layer_idx)
+            return (xc, kc, vc), None
+
+        layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+        (x, k_all, v_all), _ = jax.lax.scan(
+            body, (x, k_all, v_all), (params["blocks"], layer_ids))
+    x = T._norm(cfg, x, params["ln_f"]["scale"],
+                params["ln_f"].get("bias"))
+    return x, k_all, v_all
+
+
+def _prefill_suffix_into_slot(cfg, cache_len, moe_constraint, params,
+                              state, slot, donor_k, donor_v,
+                              donor_valid, ids, seg, pos):
+    """Partial prefill for a radix prefix-cache hit: donor KV seeds a
+    local window's rows [0, c_b); the left-padded suffix runs
+    :func:`_extend_rows` against it (rows [c_b, c_b + s_b)); the
+    finished window then scatters into ``slot``'s cache rows. One
+    compilation per (c_b, s_b) bucket pair."""
+    nl, nkv, c_b, hd = donor_k.shape
+    s_b = ids.shape[1]
+    win = c_b + s_b
+    kdt = state["cache"]["k"].dtype
+    local_k = jnp.concatenate(
+        [donor_k[:, None].astype(kdt),
+         jnp.zeros((nl, 1, nkv, s_b, hd), kdt)], axis=3)
+    local_v = jnp.concatenate(
+        [donor_v[:, None].astype(kdt),
+         jnp.zeros((nl, 1, nkv, s_b, hd), kdt)], axis=3)
+    valid0 = jnp.concatenate(
+        [donor_valid[None, :], jnp.zeros((1, s_b), bool)], axis=1)
+    rows = (c_b + jnp.arange(s_b, dtype=jnp.int32))[None, :]
+    tok_mask = seg != 0
+    hidden, local_k, local_v = _extend_rows(
+        cfg, moe_constraint, params, local_k, local_v, valid0, ids,
+        pos, rows, tok_mask)
+
+    full_valid = jnp.zeros((cache_len,), bool)
+    full_valid = full_valid.at[:c_b].set(donor_valid)
+    full_valid = full_valid.at[c_b:win].set(seg[0] != 0)
+    plen = (donor_valid.sum() + (seg[0] != 0).sum()).astype(jnp.int32)
+
+    cache = dict(state["cache"])
+    cache["k"] = cache["k"].at[:, slot, :, :win].set(local_k[:, 0])
+    cache["v"] = cache["v"].at[:, slot, :, :win].set(local_v[:, 0])
+    cache["valid"] = cache["valid"].at[slot].set(full_valid)
+    cache["length"] = cache["length"].at[slot].set(win)  # write index
+    new = dict(state)
+    new["cache"] = cache
+    new["last_hidden"] = state["last_hidden"].at[slot].set(
+        hidden[0, -1])
+    new["prompt_len"] = state["prompt_len"].at[slot].set(plen)
+    new["emitted"] = state["emitted"].at[slot].set(0)
+    new["active"] = state["active"].at[slot].set(True)
+    new["unfinished"] = state["unfinished"].at[slot].set(True)
+    new["hit_eos"] = state["hit_eos"].at[slot].set(False)
+    new["out_tokens"] = state["out_tokens"].at[slot].set(
+        jnp.full((state["out_tokens"].shape[1],), 0, jnp.int32))
+    new["out_logprobs"] = state["out_logprobs"].at[slot].set(0.0)
+    new["spec_proposed"] = state["spec_proposed"].at[slot].set(0)
+    new["spec_accepted"] = state["spec_accepted"].at[slot].set(0)
+    return new
+
+
+def _verify_chunk(cfg, g, eos, k_spec, moe_constraint, params, state,
+                  drafts):
+    """One speculative round: commit the greedy token from
+    ``last_hidden`` (free -- no forward needed), then verify the k
+    host-drafted tokens behind it in ONE :func:`_extend_rows` forward.
+    Greedy-exact: a draft is accepted iff it equals the argmax the
+    plain decode loop would have produced at that position, so the
+    emitted stream is token-for-token identical to non-speculative
+    greedy decoding; rejected tails are rolled back (rows invalidated,
+    ``length`` rewound)."""
+    nm = g.max_new_tokens
+    m = 1 + k_spec
+    st = state
+    cache = st["cache"]
+    s_len = cache["valid"].shape[1]
+    b = drafts.shape[0]
+    barr = jnp.arange(b)
+
+    live = st["active"] & st["unfinished"] & (st["emitted"] < nm)
+
+    # the committed token: identical math to _decode_chunk's body()
+    logits0 = T.lm_logits(cfg, params, st["last_hidden"]) \
+        .astype(jnp.float32)
+    if eos is not None and g.min_new_tokens > 0:
+        suppress = ((st["emitted"] < g.min_new_tokens)[:, None]
+                    & (jnp.arange(logits0.shape[-1])[None, :] == eos))
+        logits0 = jnp.where(suppress, NEG_INF, logits0)
+    f0 = jnp.argmax(logits0, -1).astype(jnp.int32)
+    logp0 = jnp.take_along_axis(jax.nn.log_softmax(logits0, -1),
+                                f0[:, None], -1)[:, 0]
+
+    tokens_seq = jnp.concatenate(
+        [f0[:, None], drafts.astype(jnp.int32)], axis=1)  # [B, m]
+    j = jnp.arange(m, dtype=jnp.int32)[None, :]
+    allowed = jnp.clip(nm - st["emitted"], 0, m)           # [B]
+    write_mask = live[:, None] & (j < allowed[:, None])
+    rows = jnp.minimum(st["cache"]["length"][:, None] + j, s_len - 1)
+    positions = st["prompt_len"][:, None] + st["emitted"][:, None] + j
+
+    hidden, k_all, v_all = _extend_rows(
+        cfg, moe_constraint, params, cache["k"], cache["v"],
+        cache["valid"], tokens_seq, positions, rows, write_mask)
+
+    logits = T.lm_logits(cfg, params, hidden).astype(jnp.float32)
+    if eos is not None and g.min_new_tokens > 0:
+        # position j's candidate is sampled with emitted0 + j + 1
+        # tokens already out -- same suppression rule as the loop
+        sup = ((st["emitted"][:, None] + j + 1 < g.min_new_tokens)
+               [:, :, None]
+               & (jnp.arange(logits.shape[-1])[None, None, :] == eos))
+        logits = jnp.where(sup, NEG_INF, logits)
+    cand = jnp.argmax(logits, -1).astype(jnp.int32)        # [B, m]
+    # draft i (tokens_seq[:, i+1]) was sampled from position i's
+    # logits (the state after consuming tokens_seq[0..i])
+    logp_steps = jnp.take_along_axis(
+        jax.nn.log_softmax(logits[:, :-1], -1),
+        tokens_seq[:, 1:, None], -1)[:, :, 0]              # [B, k]
+    # shift: draft i must equal the model's choice AFTER consuming
+    # tokens_seq[0..i] (cand[:, i]); acceptance is prefix-closed
+    draft_ok = tokens_seq[:, 1:] == cand[:, :-1]
+    acc = jnp.cumprod(draft_ok.astype(jnp.int32), axis=1)
+    n_emit = jnp.minimum(acc.sum(1) + 1, allowed)
+    n_emit = jnp.where(live, n_emit, 0)
+    hit_now = jnp.zeros((b,), bool)
+    if eos is not None:
+        is_eos = (tokens_seq == eos) & (j < n_emit[:, None])
+        hit_now = is_eos.any(axis=1)
+        first_eos = jnp.argmax(is_eos, axis=1)
+        n_emit = jnp.where(hit_now,
+                           jnp.minimum(n_emit, first_eos + 1), n_emit)
+
+    emit_mask = j < n_emit[:, None]
+    lps = jnp.concatenate([logp0[:, None], logp_steps], axis=1)
+    # write emitted lanes into out[emitted0 : emitted0 + n_emit]
+    # as a gather + where over the whole output row -- a scatter
+    # would clamp out-of-range lanes onto live indices and the
+    # duplicate-index write order is unspecified
+    p = jnp.arange(st["out_tokens"].shape[1], dtype=jnp.int32)[None, :]
+    rel = p - st["emitted"][:, None]                       # [B, nm]
+    take = (rel >= 0) & (rel < n_emit[:, None])
+    gidx = jnp.clip(rel, 0, m - 1)
+    out_tokens = jnp.where(
+        take, jnp.take_along_axis(tokens_seq, gidx, axis=1),
+        st["out_tokens"])
+    out_logprobs = jnp.where(
+        take, jnp.take_along_axis(lps, gidx, axis=1),
+        st["out_logprobs"])
+
+    emitted = st["emitted"] + n_emit
+    unfinished = st["unfinished"] & ~hit_now & (emitted < nm)
+    hit_eos = st["hit_eos"] | hit_now
+
+    # cache rollback: only the emitted lanes' rows stay valid; the
+    # rejected tail's rows are overwritten by the next rounds anyway
+    kept = ((rows[:, :, None] == jnp.arange(s_len)[None, None, :])
+            & emit_mask[:, :, None]).any(axis=1)
+    valid = cache["valid"] | kept
+    length = cache["length"] + n_emit
+    last_hidden = jnp.where(
+        live[:, None],
+        hidden[barr, jnp.maximum(n_emit - 1, 0)], st["last_hidden"])
+
+    new_cache = dict(cache, k=k_all, v=v_all, valid=valid,
+                     length=length)
+    return dict(
+        st, cache=new_cache, last_hidden=last_hidden, emitted=emitted,
+        unfinished=unfinished, hit_eos=hit_eos, out_tokens=out_tokens,
+        out_logprobs=out_logprobs,
+        spec_proposed=st["spec_proposed"]
+        + jnp.where(live, k_spec, 0).astype(jnp.int32),
+        spec_accepted=st["spec_accepted"]
+        + jnp.maximum(n_emit - 1, 0).astype(jnp.int32))
 
 
 def _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, mesh, params,

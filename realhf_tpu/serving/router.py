@@ -178,6 +178,7 @@ class FleetRouter:
                  breaker_cooldown: float = 5.0,
                  probe_timeout: float = 2.0,
                  fleet_poll_interval: float = 0.5,
+                 affinity_prefix_len: int = 16,
                  chaos: Optional[fault_injection.NetChaos] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.router_name = router_name
@@ -192,6 +193,18 @@ class FleetRouter:
         self.breaker_cooldown = breaker_cooldown
         self.probe_timeout = probe_timeout
         self.fleet_poll_interval = fleet_poll_interval
+        # prefix-affinity dispatch: requests whose first
+        # `affinity_prefix_len` tokens hash alike prefer the replica
+        # that last served that hash, concentrating that replica's
+        # radix prefix-cache hits (serving/prefix_cache.py). Strictly
+        # a PREFERENCE among healthy candidates -- lost/fenced/open-
+        # breaker replicas are filtered before affinity looks, and a
+        # cold hash falls back to least-loaded. 0 disables.
+        self.affinity_prefix_len = affinity_prefix_len
+        #: prefix hash -> replica that last served it (bounded,
+        #: insertion-ordered for cheap oldest-first trimming)
+        self._affinity: Dict[int, str] = {}
+        self._affinity_cap = 8192
         self._clock = clock
         self._chaos = chaos if chaos is not None \
             else fault_injection.default_net_chaos()
@@ -219,7 +232,7 @@ class FleetRouter:
         self.stats_counters = dict(
             requests=0, dispatches=0, failovers=0, hedges=0,
             hedge_wins=0, duplicate_terminals=0, stale_events=0,
-            fenced_reconnects=0)
+            fenced_reconnects=0, affinity_hits=0)
         logger.info("Fleet router %s listening on %s.", router_name,
                     self.address)
 
@@ -512,11 +525,28 @@ class FleetRouter:
         out.sort(key=lambda r: (len(r.inflight), r.name))
         return out
 
+    def _prefix_hash(self, req: _RouterRequest) -> Optional[int]:
+        if self.affinity_prefix_len <= 0 or len(req.prompt) == 0:
+            return None
+        return hash(req.prompt[:self.affinity_prefix_len].tobytes())
+
     def _dispatch(self, req: _RouterRequest) -> bool:
         cands = self._candidates(req)
         if not cands:
             return False
         rep = cands[0]
+        # prefix affinity: prefer the replica that last served this
+        # prompt's leading tokens, IF it survived the health filters
+        h = self._prefix_hash(req)
+        if h is not None:
+            preferred = self._affinity.get(h)
+            match = [r for r in cands if r.name == preferred] \
+                if preferred is not None else []
+            if match:
+                rep = match[0]
+                self.stats_counters["affinity_hits"] += 1
+                metrics.inc("router_affinity_hits_total",
+                            replica=rep.name)
         now = self._clock()
         ttl = None if req.deadline is None \
             else max(0.05, req.deadline - now)
@@ -529,6 +559,13 @@ class FleetRouter:
         if req.primary is None:
             req.primary = rep.name
         rep.inflight.add(req.rid)
+        if h is not None:
+            # last-served wins (re-insert refreshes recency); bounded
+            # so a long-lived router's table cannot grow without limit
+            self._affinity.pop(h, None)
+            self._affinity[h] = rep.name
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.pop(next(iter(self._affinity)))
         self.stats_counters["dispatches"] += 1
         metrics.inc("router_dispatches_total", replica=rep.name)
         return True

@@ -75,6 +75,10 @@ class FinishedRollout:
     weight_version_final: int
     queued_secs: float = 0.0
     serve_secs: float = 0.0
+    #: speculative-decoding accounting for this request (0/0 when the
+    #: drafter is off); accept rate = spec_accepted / spec_proposed
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclasses.dataclass
@@ -93,12 +97,26 @@ class ContinuousScheduler:
                  weight_sync: Optional[WeightSync] = None,
                  max_staleness: Optional[int] = None,
                  stream_tokens: bool = True,
+                 prefix_cache=None,
                  clock: Callable[[], float] = time.monotonic):
         self.backend = backend
         self.queue = queue
         self.weight_sync = weight_sync or WeightSync()
         self.max_staleness = max_staleness
         self.stream_tokens = stream_tokens
+        # radix prefix/KV reuse (serving/prefix_cache.py): only
+        # engaged when the backend implements the prefix fill + KV
+        # export extensions (InflightBatchingGenerator does; minimal
+        # test fakes may not)
+        self.prefix_cache = prefix_cache
+        self._prefix_capable = (
+            prefix_cache is not None
+            and getattr(backend, "supports_prefix_fill", False))
+        if prefix_cache is not None and not self._prefix_capable:
+            logger.warning(
+                "prefix cache configured but backend %s lacks "
+                "supports_prefix_fill; running without reuse.",
+                type(backend).__name__)
         self._clock = clock
         self._active: Dict[int, _ActiveSeq] = {}  # int_id -> seq
         self._by_slot: Dict[int, int] = {}        # slot -> int_id
@@ -106,7 +124,10 @@ class ContinuousScheduler:
         self.stats = dict(prefills=0, decode_chunks=0, decode_steps=0,
                           tokens_out=0, finished=0, expired=0, stale=0,
                           cancelled=0, swaps=0, fill_failed=0,
-                          sequential_equiv_steps=0)
+                          sequential_equiv_steps=0,
+                          prefix_hits=0, prefix_misses=0,
+                          prefix_evictions=0, prefix_tokens_saved=0,
+                          spec_proposed=0, spec_accepted=0)
 
     def _count(self, key: str, n: int = 1):
         """Bump a scheduler counter AND its mirror in the process
@@ -153,6 +174,15 @@ class ContinuousScheduler:
         swapped = self.weight_sync.poll(self.backend.swap_params)
         if swapped is not None:
             self._count("swaps")
+            if self.prefix_cache is not None:
+                # cached KV is a function of (tokens, WEIGHTS): donor
+                # rows computed under the old version must never seed
+                # a sequence under the new one
+                dropped = self.prefix_cache.clear()
+                if dropped:
+                    self._count("prefix_evictions", dropped)
+                logger.info("Weight swap to v%d flushed %d prefix-"
+                            "cache block(s).", swapped, dropped)
         return swapped
 
     # ------------------------------------------------------------------
@@ -189,7 +219,7 @@ class ContinuousScheduler:
                 int_id = self._next_id
                 self._next_id += 1
                 try:
-                    self.backend.fill_slot(slot, int_id, req.prompt)
+                    self._fill_slot(slot, int_id, req)
                 except Exception as e:  # noqa: BLE001 - one bad
                     # request must not crash the serve loop and drop
                     # every other in-flight sequence
@@ -222,14 +252,21 @@ class ContinuousScheduler:
             self._count("decode_chunks")
             self._count("decode_steps", self.backend.chunk)
 
-        # 5. harvest + streaming deltas
-        for fs in self.backend.harvest():
+        # 5. harvest + streaming deltas (KV export only when a prefix
+        #    cache is there to receive the publication)
+        harvested = self.backend.harvest(export_kv=True) \
+            if self._prefix_capable else self.backend.harvest()
+        for fs in harvested:
             seq = self._active.pop(fs.request_id, None)
             if seq is None:
                 continue  # evicted this very step
             self._by_slot.pop(seq.slot, None)
             self._count("tokens_out", len(fs.tokens))
             self._count("sequential_equiv_steps", len(fs.tokens))
+            if getattr(fs, "spec_proposed", 0):
+                self._count("spec_proposed", fs.spec_proposed)
+                self._count("spec_accepted", fs.spec_accepted)
+            self._publish_kv(seq, fs, version)
             if self._is_stale(seq, version):
                 self._count("stale")
                 events.append(ServeEvent("stale", seq.req.rid,
@@ -240,6 +277,8 @@ class ContinuousScheduler:
                 rid=seq.req.rid, tokens=fs.tokens, logprobs=fs.logprobs,
                 no_eos=fs.no_eos, weight_version=seq.version_start,
                 weight_version_final=version,
+                spec_proposed=getattr(fs, "spec_proposed", 0),
+                spec_accepted=getattr(fs, "spec_accepted", 0),
                 queued_secs=max(0.0, (seq.req.started_at or now)
                                 - seq.req.submitted_at),
                 serve_secs=max(0.0, now - (seq.req.started_at or now)))
@@ -260,6 +299,50 @@ class ContinuousScheduler:
                              offset=seq.streamed)))
                     seq.streamed = len(tokens)
         return events
+
+    # ------------------------------------------------------------------
+    def _fill_slot(self, slot: int, int_id: int, req: GenRequest):
+        """Prefill a request into a slot, consulting the radix prefix
+        cache first: on a hit, the donor KV seeds the slot and only
+        the uncached suffix runs the forward. The donor pin lives for
+        exactly the match->fill window."""
+        if not self._prefix_capable:
+            self.backend.fill_slot(slot, int_id, req.prompt)
+            return
+        # the model still needs >= 1 real token to produce the hidden
+        # state feeding the first decode step
+        m = self.prefix_cache.match(req.prompt,
+                                    max_len=len(req.prompt) - 1)
+        try:
+            if m.cached_len > 0:
+                self._count("prefix_hits")
+                self._count("prefix_tokens_saved", m.cached_len)
+                self.backend.fill_slot(slot, int_id, req.prompt,
+                                       cached_len=m.cached_len,
+                                       prefix_kv=(m.k, m.v))
+            else:
+                self._count("prefix_misses")
+                self.backend.fill_slot(slot, int_id, req.prompt)
+        finally:
+            self.prefix_cache.release(m.handle)
+
+    def _publish_kv(self, seq: _ActiveSeq, fs, version: int):
+        """Credit a finished sequence's KV back to the prefix cache.
+        Skipped when the sequence lived through a weight swap: its
+        rows mix weight versions and must not seed future requests."""
+        if (not self._prefix_capable or getattr(fs, "kv", None) is None
+                or seq.version_start != version):
+            return
+        ev0 = self.prefix_cache.stats["evictions"]
+        self.prefix_cache.insert(
+            np.concatenate([np.asarray(seq.req.prompt, np.int64),
+                            np.asarray(fs.tokens, np.int64)]),
+            fs.kv[0], fs.kv[1])
+        ev = self.prefix_cache.stats["evictions"] - ev0
+        if ev:
+            self._count("prefix_evictions", ev)
+        obs_metrics.set_gauge("serving_prefix_bytes",
+                              self.prefix_cache.bytes_used)
 
     # ------------------------------------------------------------------
     def _snapshot_active(self) -> Dict[int, tuple]:

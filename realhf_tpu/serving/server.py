@@ -81,6 +81,7 @@ class RolloutServer:
                  weight_sync: Optional[WeightSync] = None,
                  max_staleness: Optional[int] = None,
                  stream_tokens: bool = True,
+                 prefix_cache=None,
                  seed: int = 0,
                  fleet=None,
                  chaos: Optional[fault_injection.NetChaos] = None,
@@ -101,7 +102,7 @@ class RolloutServer:
         self.scheduler = ContinuousScheduler(
             backend, self.queue, self.weight_sync,
             max_staleness=max_staleness, stream_tokens=stream_tokens,
-            clock=clock)
+            prefix_cache=prefix_cache, clock=clock)
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
         port = self._sock.bind_to_random_port("tcp://*")
@@ -342,7 +343,9 @@ class RolloutServer:
                             weight_version=r.weight_version,
                             weight_version_final=r.weight_version_final,
                             queued_secs=r.queued_secs,
-                            serve_secs=r.serve_secs)
+                            serve_secs=r.serve_secs,
+                            spec_proposed=r.spec_proposed,
+                            spec_accepted=r.spec_accepted)
             self._send(ev.rid, ev.kind, data)
 
     def _send(self, rid: str, kind: str, data: dict):
@@ -427,14 +430,17 @@ class RolloutServer:
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:
-        return dict(self.scheduler.stats,
-                    queue_depth=len(self.queue),
-                    queue_by_class=self.queue.depth_by_class(),
-                    queue_stats=dict(self.queue.stats),
-                    n_live=self.scheduler.n_live,
-                    weight_version=self.weight_sync.version,
-                    fencing_epoch=self.fencing_epoch,
-                    draining=self._draining)
+        out = dict(self.scheduler.stats,
+                   queue_depth=len(self.queue),
+                   queue_by_class=self.queue.depth_by_class(),
+                   queue_stats=dict(self.queue.stats),
+                   n_live=self.scheduler.n_live,
+                   weight_version=self.weight_sync.version,
+                   fencing_epoch=self.fencing_epoch,
+                   draining=self._draining)
+        if self.scheduler.prefix_cache is not None:
+            out["prefix_cache"] = self.scheduler.prefix_cache.snapshot()
+        return out
 
 
 # ----------------------------------------------------------------------

@@ -17,6 +17,7 @@ Extra worker commands beyond the base set:
 - ``drain``: early graceful drain without exiting.
 """
 
+import os
 import pickle
 from typing import Any, Dict
 
@@ -34,6 +35,7 @@ class GenServerWorker(worker_base.Worker):
         from realhf_tpu.engine.inflight import InflightBatchingGenerator
         from realhf_tpu.ops.sampling import GenerationHyperparameters
         from realhf_tpu.serving.fleet import FleetRegistry
+        from realhf_tpu.serving.prefix_cache import RadixPrefixCache
         from realhf_tpu.serving.request_queue import RequestQueue
         from realhf_tpu.serving.server import RolloutServer
         from realhf_tpu.system.model_host import build_model
@@ -56,11 +58,17 @@ class GenServerWorker(worker_base.Worker):
                                  total_steps=1, init_seed=spec.seed)
         gconfig = GenerationHyperparameters(
             **dict(sv.gconfig, force_no_logits_mask=True))
+        # hot-path knobs (docs/serving.md "Prefix cache & speculative
+        # decoding"): REALHF_TPU_SPEC_K overrides the spec for drills
+        spec_k = int(os.environ.get("REALHF_TPU_SPEC_K",
+                                    sv.spec_decode_k))
         backend = InflightBatchingGenerator(
             self.model.config, self.model.engine.params, gconfig,
             n_slots=sv.n_slots, max_prompt_len=sv.max_prompt_len,
             eos_token_id=sv.eos_token_id, pad_token_id=sv.pad_token_id,
-            chunk_size=sv.chunk_size)
+            chunk_size=sv.chunk_size, spec_decode_k=spec_k)
+        prefix_cache = RadixPrefixCache(sv.prefix_cache_bytes) \
+            if sv.prefix_cache_bytes > 0 else None
         # fleet mode: register this replica under a keepalive lease so
         # the FleetRouter discovers it (and fails its work over the
         # moment the lease lapses)
@@ -76,6 +84,7 @@ class GenServerWorker(worker_base.Worker):
                                n_slots=sv.n_slots),
             max_staleness=sv.max_staleness,
             stream_tokens=sv.stream_tokens,
+            prefix_cache=prefix_cache,
             fleet=fleet,
             seed=spec.seed + self.server_index)
         self._drain_timeout = sv.drain_timeout_secs
@@ -85,9 +94,10 @@ class GenServerWorker(worker_base.Worker):
             # exactly like the PR-1 worker heartbeat itself
             self.server.add_beat_hook(self.rollout_server.lease_beat)
         logger.info("Gen server %s configured: role=%s slots=%d "
-                    "staleness=%s fleet=%s.", self.worker_name,
-                    sv.model_role, sv.n_slots, sv.max_staleness,
-                    sv.fleet_router)
+                    "staleness=%s fleet=%s prefix_cache=%dB "
+                    "spec_k=%d.", self.worker_name, sv.model_role,
+                    sv.n_slots, sv.max_staleness, sv.fleet_router,
+                    sv.prefix_cache_bytes, spec_k)
         return dict(address=self.rollout_server.address)
 
     # ------------------------------------------------------------------
@@ -182,6 +192,7 @@ class RouterWorker(worker_base.Worker):
             max_hedges=sv.router_max_hedges,
             breaker_failures=sv.router_breaker_failures,
             breaker_cooldown=sv.router_breaker_cooldown_secs,
+            affinity_prefix_len=sv.router_affinity_prefix_len,
             fleet_poll_interval=min(0.5, sv.lease_ttl_secs / 4.0))
         self._drain_timeout = sv.drain_timeout_secs
         logger.info("Router %s configured: lease_ttl=%.1fs hedge=%s "

@@ -205,10 +205,16 @@ class DrillFleet:
         self.alive: List[str] = []
         for i in range(n_replicas):
             self._spawn(f"gen_server/{i}", seed=i)
+        # affinity off: the drill's lost/fenced/failover invariants
+        # are written against deterministic least-loaded SPREADING --
+        # identical drill prompts would otherwise pin to one replica
+        # and a die() against any other replica finds nothing in
+        # flight (prefix locality has its own tests in tests/serving)
         kw = dict(fleet_poll_interval=dt, dispatch_timeout=1.0,
                   response_timeout=6.0, pending_timeout=30.0,
                   breaker_failures=2, breaker_cooldown=1.0,
-                  probe_timeout=1.0, hedge_delay=hedge_delay)
+                  probe_timeout=1.0, hedge_delay=hedge_delay,
+                  affinity_prefix_len=0)
         kw.update(router_kwargs or {})
         self.router = _RecordingRouter(
             self.registry, router_name="router/0", chaos=self.chaos,

@@ -54,6 +54,8 @@ def test_headline_only_prints_and_skips_nonheadline_phases(
                         forbidden("reshard"))
     monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules",
                         forbidden("pipeline"))
+    monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
+                        forbidden("serving"))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--headline-only"])
     bench_mod.main()
     assert ran == []
@@ -95,6 +97,8 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
 
     monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules",
                         spy("pipeline", ret={"stages": 4}))
+    monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
+                        spy("serving", ret={"shared": {}}))
     monkeypatch.setattr(
         bench_mod, "_reshard_metrics",
         spy("reshard",
@@ -109,14 +113,16 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
     # non-headline phase ran
     assert seen_phases["pipeline"] == ["ppo_headline",
                                        "kernel_disposition"]
-    assert seen_phases["reshard"][-1] == "pipeline_schedules"
+    assert seen_phases["serving"][-1] == "pipeline_schedules"
+    assert seen_phases["reshard"][-1] == "serving_bench"
     assert seen_phases["sft"][-1] == "reshard"
 
     final = _read_payload()
     assert final["phases_done"] == [
         "ppo_headline", "kernel_disposition", "pipeline_schedules",
-        "reshard", "sft", "overhead_probe"]
+        "serving_bench", "reshard", "sft", "overhead_probe"]
     assert final["extra"]["pipeline_schedule_bench"] == {"stages": 4}
+    assert final["extra"]["serving_bench"] == {"shared": {}}
     assert final["extra"]["sft_mfu"] == 0.5
     # final stdout line is the full headline record
     out_lines = [l for l in capsys.readouterr().out.splitlines()
@@ -136,6 +142,8 @@ def test_nonheadline_phase_failure_never_voids_headline(
         raise RuntimeError("window died")
 
     monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules", boom)
+    monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
+                        lambda: {"shared": {}})
     monkeypatch.setattr(bench_mod, "bench_sft",
                         lambda on_tpu: {"sft_mfu": 0.5})
     monkeypatch.setattr(bench_mod, "_reshard_metrics",

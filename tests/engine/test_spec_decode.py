@@ -1,0 +1,239 @@
+"""Speculative decoding + prefix-fill engine tests.
+
+The load-bearing guarantee: prompt-lookup speculative decoding is
+GREEDY-EXACT -- the emitted stream is token-for-token (and
+logprob-for-logprob) identical to the plain decode loop on the same
+weights -- and a prefix-cache partial fill decodes exactly like a full
+prefill of the same prompt. Plus the _bucket regression: a mostly-
+cached prompt must pay the SUFFIX bucket, not the full-prompt one.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from realhf_tpu.engine.drafter import NGramDrafter
+from realhf_tpu.engine.inflight import (
+    _PARTIAL_BUCKETS,
+    InflightBatchingGenerator,
+    _bucket,
+)
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+
+CFG = TransformerConfig(
+    n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+    intermediate_dim=64, vocab_size=97, apply_rotary=True,
+    layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+    use_attn_proj_bias=False, use_mlp_bias=False,
+    activation_function="silu", compute_dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gen(params, eos=1, spec_k=0, n_slots=2, greedy=True, nm=8,
+         max_prompt_len=64, **kw):
+    g = GenerationHyperparameters(
+        max_new_tokens=nm, min_new_tokens=1, greedy=greedy,
+        force_no_logits_mask=True, **({} if greedy else
+                                      dict(top_k=20, temperature=1.0)))
+    return InflightBatchingGenerator(
+        CFG, params, g, n_slots=n_slots, max_prompt_len=max_prompt_len,
+        eos_token_id=eos, pad_token_id=0, chunk_size=4,
+        spec_decode_k=spec_k)
+
+
+def _prompts(seed, n, lo=5, hi=14):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(2, CFG.vocab_size,
+                         size=int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+# ----------------------------------------------------------------------
+# drafter
+# ----------------------------------------------------------------------
+def test_drafter_prompt_lookup():
+    d = NGramDrafter(k=3, max_ngram=3)
+    # ... 5 6 7 8 ... 5 6 7 -> the 3-gram [5,6,7] recurs; propose [8,9,2]
+    h = np.array([1, 5, 6, 7, 8, 9, 2, 5, 6, 7])
+    np.testing.assert_array_equal(d.propose(h), [8, 9, 2])
+
+
+def test_drafter_prefers_most_recent_match():
+    d = NGramDrafter(k=2, max_ngram=2)
+    h = np.array([3, 4, 10, 7, 3, 4, 20, 8, 3, 4])
+    np.testing.assert_array_equal(d.propose(h), [20, 8])
+
+
+def test_drafter_fallback_repeats_last_token():
+    d = NGramDrafter(k=4)
+    np.testing.assert_array_equal(d.propose(np.array([9, 8, 7])),
+                                  [7, 7, 7, 7])
+    np.testing.assert_array_equal(d.propose(np.array([], np.int64)),
+                                  [0, 0, 0, 0])
+
+
+def test_drafter_short_continuation_pads():
+    d = NGramDrafter(k=4, max_ngram=1)
+    # [5] recurs; only [9, 5] follows it -> padded with the last token
+    h = np.array([5, 9, 5])
+    np.testing.assert_array_equal(d.propose(h), [9, 5, 5, 5])
+
+
+# ----------------------------------------------------------------------
+# greedy-exact speculative decoding
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("spec_k", [1, 3])
+@pytest.mark.parametrize("eos", [None, 1])
+def test_spec_decode_bit_exact_vs_plain_greedy(params, spec_k, eos):
+    prompts = _prompts(0, 5)
+    base = _gen(params, eos=eos).generate_all(prompts,
+                                              jax.random.PRNGKey(7))
+    g = _gen(params, eos=eos, spec_k=spec_k)
+    spec = g.generate_all(prompts, jax.random.PRNGKey(7))
+    assert g.spec_stats["rounds"] > 0
+    for a, b in zip(base, spec):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_allclose(a.logprobs, b.logprobs,
+                                   rtol=1e-5, atol=1e-6)
+        assert a.no_eos == b.no_eos
+        assert b.spec_proposed > 0
+        assert 0 <= b.spec_accepted <= b.spec_proposed
+
+
+def test_spec_accepts_on_repetitive_prompt(params):
+    """A looping prompt is the drafter's best case: with no EOS the
+    model tends to keep cycling, so some drafts must be accepted and
+    the accept counter must move."""
+    p = np.tile(np.array([11, 12, 13], np.int32), 6)
+    g = _gen(params, eos=None, spec_k=3, n_slots=1, nm=12)
+    out = g.generate_all([p], jax.random.PRNGKey(0))
+    assert out[0].spec_proposed > 0
+    # fewer verify rounds than emitted tokens == real speedup signal
+    base = _gen(params, eos=None, spec_k=0, n_slots=1, nm=12)
+    ref = base.generate_all([p], jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(out[0].tokens, ref[0].tokens)
+
+
+def test_spec_disabled_for_sampling(params):
+    g = _gen(params, greedy=False, spec_k=3)
+    assert g._spec_k == 0  # greedy-exact only: sampling falls back
+    out = g.generate_all(_prompts(1, 3), jax.random.PRNGKey(2))
+    assert all(len(fs.tokens) > 0 for fs in out)
+
+
+# ----------------------------------------------------------------------
+# prefix fill + bucket regression
+# ----------------------------------------------------------------------
+def _finish_one(g, prompt, **fill_kw):
+    g.fill_slot(0, 0, prompt, **fill_kw)
+    out = []
+    while not out:
+        g.decode_chunk(jax.random.PRNGKey(0))
+        out = g.harvest(export_kv=True)
+    return out[0]
+
+
+def test_prefix_fill_matches_full_prefill(params):
+    donor_prompt = _prompts(2, 1, lo=10, hi=11)[0]
+    fs = _finish_one(_gen(params, n_slots=1), donor_prompt)
+    k, v = fs.kv
+    assert k.shape[2] == len(donor_prompt) + len(fs.tokens)
+
+    new_prompt = np.concatenate(
+        [donor_prompt, _prompts(3, 1, lo=4, hi=5)[0]])
+    c = len(donor_prompt)
+    ref = _finish_one(_gen(params, n_slots=1), new_prompt)
+    got = _finish_one(_gen(params, n_slots=1), new_prompt,
+                      cached_len=c, prefix_kv=(k[:, :, :c], v[:, :, :c]))
+    np.testing.assert_array_equal(ref.tokens, got.tokens)
+    np.testing.assert_allclose(ref.logprobs, got.logprobs,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_prefix_fill_with_spec_decode_still_exact(params):
+    """The two hot-path features compose: partial fill + speculative
+    decode == plain full prefill + plain decode, token-for-token."""
+    donor_prompt = np.tile(np.array([21, 22, 23], np.int32), 4)
+    fs = _finish_one(_gen(params, n_slots=1), donor_prompt)
+    k, v = fs.kv
+    new_prompt = np.concatenate([donor_prompt, [31, 32, 33]])
+    c = len(donor_prompt)
+    ref = _finish_one(_gen(params, n_slots=1), new_prompt)
+    got = _finish_one(_gen(params, n_slots=1, spec_k=2), new_prompt,
+                      cached_len=c, prefix_kv=(k[:, :, :c], v[:, :, :c]))
+    np.testing.assert_array_equal(ref.tokens, got.tokens)
+
+
+def test_bucket_uses_suffix_not_full_prompt(params):
+    """REGRESSION (the _bucket x partial-prefill interaction): a
+    98%-cached prompt must be lowered at the small suffix bucket --
+    before the fix it compiled and paid the full-prompt bucket."""
+    g = _gen(params, n_slots=1, max_prompt_len=448, nm=8)
+    long_prompt = np.arange(2, 202, dtype=np.int32) % 90 + 2  # 200 toks
+    fs = _finish_one(g, long_prompt)
+    # full prefill pays the big bucket
+    assert g.last_fill["bucket"] >= 200
+    k, v = fs.kv
+    c = len(long_prompt)
+    new_prompt = np.concatenate([long_prompt, [5, 6, 7, 8]])
+    g2 = _gen(params, n_slots=1, max_prompt_len=448, nm=8)
+    g2.fill_slot(0, 0, new_prompt, cached_len=c,
+                 prefix_kv=(k[:, :, :c], v[:, :, :c]))
+    assert g2.last_fill["cached_len"] == c
+    assert g2.last_fill["prefilled"] == 4
+    # the suffix bucket, not _bucket(204) == 256
+    assert g2.last_fill["bucket"] == _bucket(4, _PARTIAL_BUCKETS) == 16
+    assert g2.fill_stats["prefill_tokens_saved"] == c
+
+
+def test_donor_trimmed_when_bucket_overflows_cache(params):
+    """A donor whose bucket rounding would overflow the cache row is
+    TRIMMED to the largest fitting bucket instead of being discarded:
+    most of the hit survives and the result stays exact."""
+    g = _gen(params, n_slots=1, max_prompt_len=448, nm=8)
+    long_prompt = np.arange(2, 302, dtype=np.int32) % 90 + 2  # 300 toks
+    fs = _finish_one(g, long_prompt)
+    k, v = fs.kv
+    c = len(long_prompt)  # _bucket(300) rounds to 512 > cache room
+    new_prompt = np.concatenate([long_prompt, [5, 6, 7, 8]])
+    g2 = _gen(params, n_slots=1, max_prompt_len=448, nm=8)
+    g2.fill_slot(0, 0, new_prompt, cached_len=c,
+                 prefix_kv=(k[:, :, :c], v[:, :, :c]))
+    assert g2.last_fill["cached_len"] == 256  # trimmed, not dropped
+    assert g2.last_fill["prefilled"] == len(new_prompt) - 256
+    out = []
+    while not out:
+        g2.decode_chunk(jax.random.PRNGKey(0))
+        out = g2.harvest()
+    ref = _finish_one(_gen(params, n_slots=1, max_prompt_len=448,
+                           nm=8), new_prompt)
+    np.testing.assert_array_equal(ref.tokens, out[0].tokens)
+
+
+def test_cached_len_capped_below_full_prompt(params):
+    """Even a 100%-cached prompt must prefill >= 1 token: the hidden
+    state feeding the first decode step is not in the KV cache."""
+    p = _prompts(4, 1, lo=8, hi=9)[0]
+    fs = _finish_one(_gen(params, n_slots=1), p)
+    k, v = fs.kv
+    g = _gen(params, n_slots=1)
+    ref = _finish_one(_gen(params, n_slots=1), p)
+    got = _finish_one(g, p, cached_len=len(p),
+                      prefix_kv=(k[:, :, :len(p)], v[:, :, :len(p)]))
+    assert g.last_fill["cached_len"] == len(p) - 1
+    assert g.last_fill["prefilled"] == 1
+    np.testing.assert_array_equal(ref.tokens, got.tokens)
+
+
+def test_fill_slot_rejects_missing_donor(params):
+    g = _gen(params, n_slots=1)
+    with pytest.raises(ValueError, match="prefix_kv"):
+        g.fill_slot(0, 0, np.arange(2, 10, dtype=np.int32),
+                    cached_len=4)

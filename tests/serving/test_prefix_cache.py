@@ -1,0 +1,182 @@
+"""Radix prefix/KV-cache unit tests: insert/match/split semantics,
+ref-count pinning, LRU eviction under a byte budget (property-style
+churn), and weight-swap flushes. Pure host data structure -- no jax.
+"""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.serving.prefix_cache import RadixPrefixCache
+
+NL, NKV, HD = 2, 2, 4
+TOK_BYTES = 2 * NL * NKV * HD * 4  # k+v float32 bytes per token
+
+
+def _kv(tokens, seed=0):
+    """Deterministic per-position KV so donor content is checkable:
+    k[..., t, :] == tokens[t] everywhere."""
+    t = np.asarray(tokens, np.float32)
+    k = np.broadcast_to(t[None, None, :, None],
+                        (NL, NKV, len(t), HD)).copy()
+    return k, k.copy()
+
+
+def _seq(*toks):
+    return np.asarray(toks, np.int64)
+
+
+def test_match_empty_tree_is_miss():
+    c = RadixPrefixCache(1 << 20)
+    m = c.match(_seq(1, 2, 3))
+    assert m.cached_len == 0 and m.k is None
+    c.release(m.handle)
+    assert c.stats["misses"] == 1
+
+
+def test_insert_then_match_full_and_partial():
+    c = RadixPrefixCache(1 << 20)
+    seq = _seq(5, 6, 7, 8)
+    c.insert(seq, *_kv(seq))
+    assert c.bytes_used == 4 * TOK_BYTES
+
+    m = c.match(_seq(5, 6, 7, 8, 9, 10))
+    assert m.cached_len == 4
+    np.testing.assert_array_equal(m.k[0, 0, :, 0], [5, 6, 7, 8])
+    c.release(m.handle)
+
+    # divergence mid-edge: only the agreeing part is reused
+    m = c.match(_seq(5, 6, 99, 1))
+    assert m.cached_len == 2
+    np.testing.assert_array_equal(m.k[0, 0, :, 0], [5, 6])
+    c.release(m.handle)
+
+    # max_len cap (admission leaves >= 1 token to prefill)
+    m = c.match(seq, max_len=3)
+    assert m.cached_len == 3
+    c.release(m.handle)
+
+
+def test_insert_suffix_shares_prefix_storage():
+    c = RadixPrefixCache(1 << 20)
+    a = _seq(1, 2, 3)
+    c.insert(a, *_kv(a))
+    b = _seq(1, 2, 3, 4, 5)
+    new = c.insert(b, *_kv(b))
+    assert new == 2  # only the new tail is stored
+    assert c.bytes_used == 5 * TOK_BYTES
+    m = c.match(b)
+    assert m.cached_len == 5
+    np.testing.assert_array_equal(m.k[0, 0, :, 0], [1, 2, 3, 4, 5])
+    c.release(m.handle)
+
+
+def test_split_preserves_both_branches():
+    c = RadixPrefixCache(1 << 20)
+    a = _seq(1, 2, 3, 4)
+    c.insert(a, *_kv(a))
+    b = _seq(1, 2, 9, 9)
+    c.insert(b, *_kv(b))
+    for seq in (a, b):
+        m = c.match(seq)
+        assert m.cached_len == 4
+        np.testing.assert_array_equal(m.k[0, 0, :, 0], seq)
+        c.release(m.handle)
+    assert c.bytes_used == 6 * TOK_BYTES  # [1,2] shared once
+
+
+def test_kv_row_count_mismatch_is_skipped():
+    c = RadixPrefixCache(1 << 20)
+    k, v = _kv(_seq(1, 2))
+    assert c.insert(_seq(1, 2, 3), k, v) == 0
+    assert c.stats["insert_skipped"] == 1 and c.bytes_used == 0
+
+
+def test_lru_eviction_respects_budget():
+    c = RadixPrefixCache(3 * TOK_BYTES)
+    c.insert(_seq(1), *_kv(_seq(1)))
+    c.insert(_seq(2), *_kv(_seq(2)))
+    c.insert(_seq(3), *_kv(_seq(3)))
+    assert c.bytes_used == 3 * TOK_BYTES
+    # touch 1 so 2 becomes LRU
+    m = c.match(_seq(1))
+    c.release(m.handle)
+    c.insert(_seq(4), *_kv(_seq(4)))
+    assert c.bytes_used <= c.capacity_bytes
+    assert c.match(_seq(2)).cached_len == 0  # the LRU victim
+    assert c.match(_seq(1)).cached_len == 1  # recently used survived
+    assert c.stats["evictions"] == 1
+
+
+def test_eviction_never_frees_a_pinned_block():
+    c = RadixPrefixCache(2 * TOK_BYTES)
+    c.insert(_seq(1), *_kv(_seq(1)))
+    c.insert(_seq(2), *_kv(_seq(2)))
+    pin = c.match(_seq(1))  # outstanding pin on block 1
+    assert pin.cached_len == 1
+    # over-budget insert: 2 is evictable, 1 is NOT
+    c.insert(_seq(3, 4), *_kv(_seq(3, 4)))
+    m1 = c.match(_seq(1), max_len=1)
+    assert m1.cached_len == 1  # pinned block survived the churn
+    c.release(m1.handle)
+    c.release(pin.handle)
+    # unpinned now: the next insert may evict it to meet the budget
+    c.insert(_seq(5, 6), *_kv(_seq(5, 6)))
+    assert c.bytes_used <= c.capacity_bytes
+
+
+def test_oversized_block_is_rejected():
+    c = RadixPrefixCache(TOK_BYTES)
+    seq = _seq(1, 2, 3)
+    assert c.insert(seq, *_kv(seq)) == 0
+    assert c.bytes_used == 0 and c.stats["insert_skipped"] == 1
+
+
+def test_pinned_node_is_never_split():
+    c = RadixPrefixCache(1 << 20)
+    a = _seq(1, 2, 3, 4)
+    c.insert(a, *_kv(a))
+    pin = c.match(a)
+    # would need to split [1,2,3,4] at 2 -- refused while pinned
+    b = _seq(1, 2, 9)
+    assert c.insert(b, *_kv(b)) == 0
+    c.release(pin.handle)
+    assert c.insert(b, *_kv(b)) == 1  # fine once released
+
+
+def test_clear_flushes_everything():
+    c = RadixPrefixCache(1 << 20)
+    c.insert(_seq(1, 2), *_kv(_seq(1, 2)))
+    c.insert(_seq(1, 3), *_kv(_seq(1, 3)))
+    dropped = c.clear()
+    assert dropped >= 2 and c.bytes_used == 0 and c.n_nodes == 0
+    assert c.match(_seq(1, 2)).cached_len == 0
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_budget_respected_under_random_churn(seed):
+    """Property-style: hundreds of random insert/match/release cycles
+    from a tiny alphabet (maximum edge splitting); whenever no pins
+    are outstanding, bytes_used must be within budget and must equal
+    the sum of live blocks."""
+    rng = np.random.default_rng(seed)
+    cap = 40 * TOK_BYTES
+    c = RadixPrefixCache(cap)
+    for _ in range(300):
+        n = int(rng.integers(1, 12))
+        seq = rng.integers(0, 3, size=n)  # tiny alphabet -> splits
+        m = c.match(seq)
+        assert m.cached_len <= n
+        if m.cached_len:
+            np.testing.assert_array_equal(m.k[0, 0, :, 0],
+                                          seq[:m.cached_len])
+        c.release(m.handle)
+        c.insert(seq, *_kv(seq))
+        assert c.bytes_used <= cap, "budget violated with no pins out"
+    # accounting invariant: recompute from the live tree
+    total = 0
+    stack = [c._root]
+    while stack:
+        nd = stack.pop()
+        total += nd.nbytes
+        stack.extend(nd.children[t] for t in sorted(nd.children))
+    assert total == c.bytes_used

@@ -165,8 +165,10 @@ def _fresh_metrics():
 def test_basic_dispatch_and_least_loaded():
     f = Fleet(n=2)
     try:
-        rids = [f.client.submit(np.array([8, 3], np.int32),
-                                ttl=60.0) for _ in range(6)]
+        # DISTINCT prefixes: identical prompts would (correctly) pin
+        # to one replica via prefix affinity -- see the test below
+        rids = [f.client.submit(np.array([8, 3 + i], np.int32),
+                                ttl=60.0) for i in range(6)]
         f.run_until_terminal(rids)
         for r in rids:
             k, d = f.terminal(r)
@@ -178,6 +180,48 @@ def test_basic_dispatch_and_least_loaded():
         for n, srv in f.servers.items():
             per[n] = srv.stats()["finished"]
         assert all(v > 0 for v in per.values()), per
+    finally:
+        f.close()
+
+
+def test_prefix_affinity_concentrates_shared_prompts():
+    """Requests sharing their leading tokens land on the replica that
+    last served that prefix (cache locality), while disjoint prefixes
+    still spread least-loaded; a dead preferred replica falls back to
+    a healthy one (health gates beat affinity)."""
+    f = Fleet(n=2)
+    try:
+        shared = [f.client.submit(np.array([8, 3], np.int32),
+                                  ttl=60.0) for _ in range(4)]
+        f.run_until_terminal(shared)
+        st = f.router.stats()
+        assert st["affinity_hits"] >= 3, st
+        per = [srv.stats()["finished"] for srv in f.servers.values()]
+        # every shared-prefix request on ONE replica
+        assert sorted(per) == [0, 4], per
+        # affinity is only a preference: kill the preferred replica
+        # and the same prefix must fail over to the survivor
+        owner = max(f.servers, key=lambda n: f.servers[n]
+                    .stats()["finished"])
+        f.die(owner)
+        rid = f.client.submit(np.array([8, 3], np.int32), ttl=60.0)
+        f.run_until_terminal([rid])
+        k, d = f.terminal(rid)
+        assert k == "done" and len(d["tokens"]) == 8
+    finally:
+        f.close()
+
+
+def test_affinity_disabled_with_zero_prefix_len():
+    f = Fleet(n=2, affinity_prefix_len=0)
+    try:
+        rids = [f.client.submit(np.array([8, 3], np.int32),
+                                ttl=60.0) for _ in range(6)]
+        f.run_until_terminal(rids)
+        st = f.router.stats()
+        assert st["affinity_hits"] == 0
+        per = [srv.stats()["finished"] for srv in f.servers.values()]
+        assert all(v > 0 for v in per), per  # pure least-loaded again
     finally:
         f.close()
 
