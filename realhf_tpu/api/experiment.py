@@ -133,6 +133,12 @@ class FaultToleranceConfig:
     # at most this many adopted (migrated) MFC replicas per survivor:
     # each adoption is a full extra weight copy in HBM
     max_adopted_per_worker: int = 2
+    # --- host failure domains (system/pod.py) ------------------------
+    # workers of one host whose heartbeats go stale within this many
+    # seconds of each other are attributed as ONE HOST_LOST (one
+    # flight event, one backoff entry) instead of N independent
+    # losses. None -> the watchdog defaults to heartbeat_timeout.
+    host_lost_window_secs: Optional[float] = None
     # --- durable checkpoints (system/ckpt_manager.py) ----------------
     # route model-worker saves through the sharded-manifest manager
     # (per-shard checksums, atomic COMMITTED marker, verified load
@@ -185,6 +191,11 @@ class ServingSpec:
     spec_decode_k: int = 0
     #: seconds drain() waits for in-flight sequences at shutdown
     drain_timeout_secs: float = 30.0
+    #: log-only autoscaling advisory (ROADMAP item 2, smallest useful
+    #: slice): when a server's queue depth stays above this threshold,
+    #: an ElasticPlanner GROW suggestion is emitted (counter + flight
+    #: event + warning log -- no mesh or fleet change). 0 disables.
+    autoscale_queue_threshold: int = 0
     # -- resilient fleet mode (docs/serving.md "Fleet, failover &
     # circuit breakers"): a FleetRouter fronts the n_servers replicas;
     # replicas register leases in the fleet registry and clients talk

@@ -19,10 +19,12 @@ from typing import Dict, Optional
 
 from realhf_tpu.api.experiment import ExperimentSpec, FaultToleranceConfig
 from realhf_tpu.base import constants, logging, name_resolve, names
-from realhf_tpu.obs import tracing
+from realhf_tpu.obs import flight, tracing
+from realhf_tpu.system.pod import PodController
 from realhf_tpu.system.scheduler import (
     JobException,
     JobState,
+    SchedulerClient,
     make_scheduler,
 )
 from realhf_tpu.system.watchdog import Watchdog
@@ -52,10 +54,18 @@ def _spec_path(spec: ExperimentSpec) -> str:
 
 def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
               env: Optional[Dict[str, str]] = None,
-              timeout: float = 3600.0) -> Dict:
+              timeout: float = 3600.0,
+              sched: Optional[SchedulerClient] = None) -> Dict:
     """One trial attempt: spawn workers, run to completion, tear down.
     Raises JobException/TimeoutError on worker failure (the caller's
-    recover loop relaunches)."""
+    recover loop relaunches).
+
+    ``sched`` overrides the default local subprocess scheduler -- pass
+    a ``MultiHostLocalScheduler`` (``system/pod.py``) to run the trial
+    across emulated pod hosts: submission then goes through the
+    :class:`PodController` with per-host env namespaces, the watchdog
+    aggregates losses per host (HOST_LOST), and teardown writes the
+    per-host Prometheus scrape targets + merged flight dumps."""
     bad = {r: spec.workers_of_role(r) for r in spec.worker_assignment
            if not all(0 <= w < spec.n_model_workers
                       for w in spec.workers_of_role(r))}
@@ -99,7 +109,13 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
     worker_names = ([f"model_worker/{i}"
                      for i in range(spec.n_model_workers)]
                     + ["master_worker/0"])
-    sched = make_scheduler("local")
+    if sched is None:
+        sched = make_scheduler("local")
+    # pod supervision layer (system/pod.py): submission with
+    # retry/backoff, bring-up deadline with host-attributed errors,
+    # per-host obs artifacts at teardown. Over a plain local
+    # scheduler it degrades to a single synthetic host.
+    controller = PodController(sched)
     # Stale keys from a previous run of the same trial (worker
     # addresses, steps_per_epoch, experiment status) must not leak
     # into this one (reference main.py:138-147 clear_subtree).
@@ -110,10 +126,14 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
 
     try:
         for i in range(spec.n_model_workers):
-            sched.submit(f"model_worker/{i}",
-                         _worker_cmd("model_worker", i, spec), env=env)
-        sched.submit("master_worker/0",
-                     _worker_cmd("master_worker", 0, spec), env=env)
+            controller.submit(f"model_worker/{i}",
+                              _worker_cmd("model_worker", i, spec),
+                              env=env)
+        controller.submit("master_worker/0",
+                          _worker_cmd("master_worker", 0, spec),
+                          env=env)
+        controller.wait_ready(spec.experiment_name, spec.trial_name,
+                              worker_names, deadline=120)
 
         panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
         panel.connect(worker_names, timeout=120)
@@ -143,7 +163,11 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         watchdog = Watchdog(
             spec.experiment_name, spec.trial_name, worker_names,
             timeout=ft.heartbeat_timeout, grace=ft.startup_grace_secs,
-            poll_interval=ft.watchdog_poll_secs)
+            poll_interval=ft.watchdog_poll_secs,
+            # host failure domains: with a host-aware scheduler a
+            # whole-host kill is ONE HOST_LOST attribution here too
+            host_of=getattr(sched, "host_of", None),
+            host_window=getattr(ft, "host_lost_window_secs", None))
         deadline = time.monotonic() + timeout
         # elastic rejoin (ft.elastic_rejoin): once a PREEMPTED model
         # worker's process exits, resubmit it; the relaunched
@@ -240,7 +264,28 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
         return stats["master_worker/0"]
     finally:
         sched.stop_all()
-        _merge_run_traces()
+        _teardown_obs(controller)
+
+
+def _teardown_obs(controller: Optional[PodController] = None):
+    """Teardown observability sweep (success or failure -- the
+    artifacts of a crashed trial are the ones you want most): merge
+    per-process traces into one Perfetto timeline, fold per-worker
+    flight-recorder dumps into one incident record, and write the
+    per-host Prometheus scrape-target file. Never raises."""
+    _merge_run_traces()
+    try:
+        merged = flight.merge_dumps()
+        if merged:
+            logger.info("Flight dumps merged: %s.", merged)
+    except Exception as e:  # noqa: BLE001 - teardown must not mask
+        # the trial's real outcome
+        logger.warning("Flight-dump merge failed: %s", e)
+    if controller is not None:
+        path = controller.write_scrape_targets()
+        if path:
+            logger.info("Prometheus scrape targets written: %s "
+                        "(file_sd_configs).", path)
 
 
 def _merge_run_traces():
@@ -305,15 +350,17 @@ def run_serve(spec: ExperimentSpec,
     fleet = bool(getattr(sv, "fleet_router", False))
     worker_names = gen_names + (["router/0"] if fleet else [])
     sched = make_scheduler("local")
+    controller = PodController(sched)
     name_resolve.clear_subtree(
         names.trial_root(spec.experiment_name, spec.trial_name))
     try:
         for i in range(sv.n_servers):
-            sched.submit(f"gen_server/{i}",
-                         _worker_cmd("gen_server", i, spec), env=env)
+            controller.submit(f"gen_server/{i}",
+                              _worker_cmd("gen_server", i, spec),
+                              env=env)
         if fleet:
-            sched.submit("router/0", _worker_cmd("router", 0, spec),
-                         env=env)
+            controller.submit("router/0", _worker_cmd("router", 0, spec),
+                              env=env)
         panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
         panel.connect(worker_names, timeout=120)
         configs = {f"gen_server/{i}": dict(config=dict(
@@ -379,7 +426,7 @@ def run_serve(spec: ExperimentSpec,
         return stats
     finally:
         sched.stop_all(grace=sv.drain_timeout_secs + 10)
-        _merge_run_traces()
+        _teardown_obs(controller)
 
 
 def main_start(spec: ExperimentSpec, recover_mode: str = "disabled",
@@ -407,6 +454,69 @@ def main_start(spec: ExperimentSpec, recover_mode: str = "disabled",
             time.sleep(2)
 
 
+def pod_manifest_main(argv: Optional[list] = None) -> int:
+    """``python -m realhf_tpu.apps.main pod-manifest ...`` (also
+    wrapped by ``scripts/gen_pod_manifest.py``): generate the
+    deterministic per-host launch manifest (docs/distributed.md "Pod
+    deployment"). The output round-trips through
+    ``MultiHostLocalScheduler(manifest=...)`` for single-box
+    emulation, or drives a GKE/xmanager template for a real pod."""
+    import argparse
+
+    from realhf_tpu.system import pod
+
+    parser = argparse.ArgumentParser(
+        "realhf_tpu pod-manifest",
+        description="Generate a deterministic pod launch manifest.")
+    parser.add_argument("--experiment_name", required=True)
+    parser.add_argument("--trial_name", required=True)
+    parser.add_argument("--n_hosts", type=int, required=True)
+    parser.add_argument("--n_model_workers", type=int, required=True)
+    parser.add_argument("--n_chips_per_host", type=int, default=None)
+    parser.add_argument("--base_scrape_port", type=int,
+                        default=pod.DEFAULT_SCRAPE_BASE_PORT)
+    parser.add_argument("--no_master", action="store_true",
+                        help="omit master_worker/0 (serving-only pod)")
+    parser.add_argument("--out", default="-",
+                        help="output path ('-' = stdout)")
+    parser.add_argument("--scrape_out", default=None,
+                        help="also write the Prometheus file_sd "
+                             "scrape-target file here")
+    args = parser.parse_args(argv)
+    manifest = pod.build_pod_manifest(
+        args.experiment_name, args.trial_name,
+        n_hosts=args.n_hosts, n_model_workers=args.n_model_workers,
+        include_master=not args.no_master,
+        n_chips_per_host=args.n_chips_per_host,
+        base_scrape_port=args.base_scrape_port)
+    text = manifest.to_json()
+    if args.out == "-":
+        sys.stdout.write(text)
+    else:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text)
+        logger.info("Pod manifest written: %s (%d hosts, %d workers).",
+                    args.out, manifest.n_hosts, len(manifest.workers))
+    if args.scrape_out:
+        pod.write_scrape_targets(
+            manifest.hosts, args.scrape_out,
+            labels=dict(experiment=args.experiment_name,
+                        trial=args.trial_name))
+    return 0
+
+
+def _cli(argv: Optional[list] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "pod-manifest":
+        return pod_manifest_main(argv[1:])
+    sys.stderr.write(
+        "usage: python -m realhf_tpu.apps.main pod-manifest ...\n"
+        "(training launches go through run_trial/main_start; see "
+        "docs/distributed.md)\n")
+    return 2
+
+
 def main_stop(experiment_name: str, trial_name: str):
     """Best-effort teardown of a running trial (reference
     main_stop:233): ask every registered worker to exit."""
@@ -424,3 +534,7 @@ def main_stop(experiment_name: str, trial_name: str):
         panel.group_request("exit", timeout=10)
     except Exception as e:  # noqa: BLE001 - best effort
         logger.warning("main_stop: %s", e)
+
+
+if __name__ == "__main__":
+    sys.exit(_cli())

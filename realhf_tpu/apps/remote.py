@@ -15,7 +15,7 @@ def main_worker(args):
         from realhf_tpu.base.backend import force_cpu_backend
         force_cpu_backend()
 
-    from realhf_tpu.base import name_resolve
+    from realhf_tpu.base import cluster, logging, name_resolve
     from realhf_tpu.base.importing import import_usercode
 
     import_usercode()  # custom interfaces must register in workers too
@@ -23,6 +23,15 @@ def main_worker(args):
     if os.environ.get("REALHF_TPU_NAME_RESOLVE_ROOT"):
         name_resolve.reconfigure(
             "nfs", record_root=os.environ["REALHF_TPU_NAME_RESOLVE_ROOT"])
+
+    host = cluster.current_host_id()
+    if host:
+        # pod launch (system/pod.py): name the failure domain up front
+        # so a host-grouped postmortem can match launcher/orchestrator
+        # logs against worker boots
+        logging.getLogger("remote").info(
+            "Worker %s/%d booting on pod host %s (pid %d).",
+            args.worker_type, args.index, host, os.getpid())
 
     if args.worker_type == "model_worker":
         from realhf_tpu.system.model_worker import ModelWorker

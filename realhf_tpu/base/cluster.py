@@ -10,6 +10,19 @@ import json
 import os
 from typing import Dict, Optional
 
+#: Host identity of this process inside a pod launch. Injected per
+#: host by the pod manifest / MultiHostLocalScheduler
+#: (``system/pod.py``); every worker on one TPU VM shares the value,
+#: so the runtime can treat the VM -- the real preemption granularity
+#: -- as a failure domain (``HOST_LOST`` attribution, host-level
+#: exclusion backoff, per-host obs artifacts).
+HOST_ID_ENV = "REALHF_TPU_HOST_ID"
+
+
+def current_host_id() -> Optional[str]:
+    """This process's pod host id, or None outside a pod launch."""
+    return os.environ.get(HOST_ID_ENV) or None
+
 
 @dataclasses.dataclass
 class ClusterSpec:
@@ -32,6 +45,16 @@ class ClusterSpec:
             d = json.load(f)
         return cls(**{k: v for k, v in d.items()
                       if k in {f.name for f in dataclasses.fields(cls)}})
+
+    @classmethod
+    def for_pod(cls, n_hosts: int, n_chips_per_host: int,
+                cluster_name: str = "pod",
+                slice_topology: Optional[str] = None) -> "ClusterSpec":
+        """The fleet a pod manifest (``system/pod.py``) describes:
+        one process per host, ``n_chips_per_host`` local chips each."""
+        return cls(cluster_type="tpu_pod", cluster_name=cluster_name,
+                   n_hosts=n_hosts, n_chips_per_host=n_chips_per_host,
+                   slice_topology=slice_topology)
 
     @classmethod
     def local(cls) -> "ClusterSpec":

@@ -44,6 +44,27 @@ def heartbeat_root(experiment_name: str, trial_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/heartbeat/"
 
 
+def worker_host(experiment_name: str, trial_name: str,
+                worker_name: str) -> str:
+    """Host-domain membership: each worker publishes the pod host id
+    it runs on (``REALHF_TPU_HOST_ID``, injected by the pod manifest /
+    MultiHostLocalScheduler) so the master-side watchdog can aggregate
+    per-host -- a whole host going stale is ONE ``HOST_LOST``, not N
+    independent worker losses."""
+    return f"{_root(experiment_name, trial_name)}/host/{worker_name}"
+
+
+def host_root(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/host/"
+
+
+def train_progress(experiment_name: str, trial_name: str) -> str:
+    """Master-published global step (updated per finished batch): the
+    pod controller / harnesses can watch trial progress without a
+    control-panel socket."""
+    return f"{_root(experiment_name, trial_name)}/train_progress"
+
+
 def worker_preempt(experiment_name: str, trial_name: str,
                    worker_name: str) -> str:
     """Preemption notice: the worker publishes ``"<ts>:<grace>"``

@@ -44,11 +44,23 @@ class FlightRecorder:
     def configure(self, name: str):
         self.name = name
 
-    def record(self, kind: str, **detail):
-        # detail keys must not collide with the positional event kind
-        # ("kind" in detail would TypeError at the call site -- use a
-        # qualified key like fault_kind instead)
-        ev = dict(ts=time.time(), kind=kind, **detail)
+    def record(self, event_kind: Optional[str] = None, **detail):
+        """Append one event. The positional is the EVENT kind (stored
+        under ``"kind"`` in the dump); it used to be named ``kind``,
+        which made any ``kind=`` detail kwarg a TypeError at the call
+        site. Now a ``kind=`` detail is legal: with a positional event
+        kind present it lands in the event as ``kind_detail`` (the
+        event kind owns the ``"kind"`` slot); without one it is taken
+        as the event kind itself (deprecated keyword spelling)."""
+        if event_kind is None:
+            if "kind" not in detail:
+                raise TypeError("record() needs an event kind "
+                                "(positional event_kind)")
+            event_kind = detail.pop("kind")
+            _warn_kind_kwarg_once()
+        elif "kind" in detail:
+            detail["kind_detail"] = detail.pop("kind")
+        ev = dict(ts=time.time(), kind=event_kind, **detail)
         with self._lock:
             self._events.append(ev)
 
@@ -95,6 +107,18 @@ class FlightRecorder:
         return path
 
 
+_warned_kind_kwarg = False
+
+
+def _warn_kind_kwarg_once():
+    global _warned_kind_kwarg
+    if not _warned_kind_kwarg:
+        _warned_kind_kwarg = True
+        logger.warning(
+            "flight.record(kind=...) as the event kind is deprecated; "
+            "pass it positionally (record(event_kind, **detail)).")
+
+
 def flight_dir(experiment: Optional[str] = None,
                trial: Optional[str] = None) -> str:
     from realhf_tpu.base import constants
@@ -128,9 +152,63 @@ def configure(name: str):
     _default.configure(name)
 
 
-def record(kind: str, **detail):
-    _default.record(kind, **detail)
+def record(event_kind: Optional[str] = None, **detail):
+    _default.record(event_kind, **detail)
 
 
 def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
     return _default.dump(reason, path=path)
+
+
+MERGED_DUMP_NAME = "merged_flight.json"
+
+
+def merge_dumps(directory: Optional[str] = None,
+                out_path: Optional[str] = None,
+                experiment: Optional[str] = None,
+                trial: Optional[str] = None) -> Optional[str]:
+    """Fold every per-worker ``*.flight.json`` under ``directory``
+    (default: this run's flight dir) into one time-sorted postmortem
+    (``merged_flight.json``): each event gains its worker (and, when
+    the dump recorded one, host) label so a pod-wide incident reads as
+    a single interleaved story. Returns the merged path, or None when
+    there was nothing to merge; unreadable dumps are skipped -- a
+    worker killed mid-dump must not void everyone else's ring."""
+    directory = directory or flight_dir(experiment, trial)
+    if not os.path.isdir(directory):
+        return None
+    merged_events: List[Dict] = []
+    workers: List[str] = []
+    reasons: Dict[str, str] = {}
+    for fn in sorted(os.listdir(directory)):
+        if not fn.endswith(".flight.json"):
+            continue
+        try:
+            with open(os.path.join(directory, fn)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        worker = rec.get("worker", fn[:-len(".flight.json")])
+        workers.append(worker)
+        reasons[worker] = rec.get("reason", "")
+        for ev in rec.get("events", ()):
+            if isinstance(ev, dict):
+                merged_events.append(dict(ev, worker=worker))
+    if not workers:
+        return None
+    merged_events.sort(key=lambda e: (e.get("ts") or 0.0))
+    out_path = out_path or os.path.join(directory, MERGED_DUMP_NAME)
+    record = dict(n_dumps=len(workers), workers=sorted(workers),
+                  reasons=reasons, n_events=len(merged_events),
+                  events=merged_events)
+    tmp = f"{out_path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, default=str)
+        os.replace(tmp, out_path)
+    except OSError as e:
+        logger.warning("Flight merge to %s failed: %s", out_path, e)
+        return None
+    logger.info("Merged %d flight events from %d dumps into %s.",
+                len(merged_events), len(workers), out_path)
+    return out_path

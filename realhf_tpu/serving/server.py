@@ -85,6 +85,7 @@ class RolloutServer:
                  seed: int = 0,
                  fleet=None,
                  chaos: Optional[fault_injection.NetChaos] = None,
+                 grow_advisor=None,
                  clock: Callable[[], float] = time.monotonic):
         self.server_name = server_name
         self._clock = clock
@@ -138,6 +139,7 @@ class RolloutServer:
         # lease fences this replica out until it re-registers (and the
         # router reconnects at the new epoch)
         self._fleet = fleet
+        self._grow_advisor = grow_advisor
         self.fencing_epoch: Optional[int] = None
         self._lease_renewed_at = self._clock()
         #: set (from any thread) when a renewal found the lease gone;
@@ -163,6 +165,12 @@ class RolloutServer:
                           server=self.server_name)
         metrics.set_gauge("serving_live_slots", self.scheduler.n_live,
                           server=self.server_name)
+        if self._grow_advisor is not None:
+            # autoscaling advisory (system/elastic.py GrowAdvisor):
+            # sustained queue depth above threshold -> log-only
+            # ElasticPlanner grow suggestion
+            self._grow_advisor.observe(len(self.queue),
+                                       server=self.server_name)
         if self.scheduler.n_live or len(self.queue):
             import jax
             self._key, sub = jax.random.split(self._key)

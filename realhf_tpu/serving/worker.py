@@ -75,6 +75,10 @@ class GenServerWorker(worker_base.Worker):
         fleet = FleetRegistry(
             spec.experiment_name, spec.trial_name,
             lease_ttl=sv.lease_ttl_secs) if sv.fleet_router else None
+        grow_advisor = None
+        if getattr(sv, "autoscale_queue_threshold", 0) > 0:
+            from realhf_tpu.system.elastic import GrowAdvisor
+            grow_advisor = GrowAdvisor(sv.autoscale_queue_threshold)
         self.rollout_server = RolloutServer(
             backend,
             experiment_name=spec.experiment_name,
@@ -86,6 +90,7 @@ class GenServerWorker(worker_base.Worker):
             stream_tokens=sv.stream_tokens,
             prefix_cache=prefix_cache,
             fleet=fleet,
+            grow_advisor=grow_advisor,
             seed=spec.seed + self.server_index)
         self._drain_timeout = sv.drain_timeout_secs
         if fleet is not None:

@@ -95,6 +95,30 @@ class SequenceBuffer:
         if e is not None and mfc_name not in e.completed:
             e.dispatched.discard(mfc_name)
 
+    def invalidate_outputs(self, batch_id: int, mfc_name: str, keys):
+        """Un-complete an MFC whose output tensors died with their
+        owning worker (host loss / SIGKILL -- no grace window to hand
+        them off): the keys leave the batch meta and ownership map, so
+        consumers stop being ready until the producer recomputes, and
+        ready_mfcs offers the producer again. Recomputation, not
+        re-consumption: the batch's sample ids were drawn exactly
+        once."""
+        e = self._entries.get(batch_id)
+        if e is None:
+            return
+        e.completed.discard(mfc_name)
+        e.dispatched.discard(mfc_name)
+        for k in keys:
+            e.key_owner.pop(k, None)
+            # SequenceSample invariant: keys == seqlens == shapes ==
+            # dtypes (== data when present); remove from all views
+            e.meta.keys.discard(k)
+            e.meta.seqlens.pop(k, None)
+            e.meta.trailing_shapes.pop(k, None)
+            e.meta.dtypes.pop(k, None)
+            if e.meta.data is not None:
+                e.meta.data.pop(k, None)
+
     def get(self, batch_id: int) -> BufferEntry:
         return self._entries[batch_id]
 

@@ -224,17 +224,30 @@ class DataClient:
     def __init__(self, experiment_name: str, trial_name: str):
         self._exp, self._trial = experiment_name, trial_name
         self._ctx = zmq.Context.instance()
-        self._socks: Dict[str, zmq.Socket] = {}
+        # worker name -> (registered address, REQ socket)
+        self._socks: Dict[str, tuple] = {}
 
     def _sock_for(self, worker_name: str) -> zmq.Socket:
-        if worker_name not in self._socks:
-            addr = name_resolve.wait(
-                data_server_key(self._exp, self._trial, worker_name),
-                timeout=60)
-            s = self._ctx.socket(zmq.REQ)
-            s.connect(addr)
-            self._socks[worker_name] = s
-        return self._socks[worker_name]
+        # revalidate against the peer's CURRENT registration: a
+        # relaunched worker (elastic rejoin, pod host back from
+        # preemption) re-registers its data server at a new address,
+        # and a REQ cached against the dead incarnation would block
+        # the full fetch timeout before healing
+        addr = name_resolve.wait(
+            data_server_key(self._exp, self._trial, worker_name),
+            timeout=60)
+        cached = self._socks.get(worker_name)
+        if cached is not None:
+            if cached[0] == addr:
+                return cached[1]
+            logger.info("Data server %s re-registered (%s -> %s); "
+                        "reconnecting.", worker_name, cached[0], addr)
+            cached[1].close(0)
+            del self._socks[worker_name]
+        s = self._ctx.socket(zmq.REQ)
+        s.connect(addr)
+        self._socks[worker_name] = (addr, s)
+        return s
 
     def fetch(self, worker_name: str, ids: List[Hashable],
               keys: List[str], timeout: float = 300.0) -> SequenceSample:
@@ -289,5 +302,5 @@ class DataClient:
             _time.sleep(0.05)
 
     def close(self):
-        for s in self._socks.values():
+        for _addr, s in self._socks.values():
             s.close(0)

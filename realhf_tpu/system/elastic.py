@@ -254,3 +254,59 @@ class ElasticPlanner:
         whose rejoin we are waiting for)."""
         return {w for d in self.degraded.values()
                 for w in d.original_workers}
+
+
+class GrowAdvisor:
+    """Log-only autoscaling advisory: the first end-to-end wire from
+    the serving metrics to the elastic planner (ROADMAP item 2's
+    smallest useful slice).
+
+    ``observe(queue_depth)`` is called wherever the queue-depth gauge
+    is set (``serving/server.py`` serve loop). A depth above
+    ``threshold`` for ``consecutive`` observations emits ONE grow
+    suggestion -- ``elastic_grow_suggested_total`` counter, an
+    ``elastic_grow_suggestion`` flight event, a warning log -- and
+    then stays quiet for ``cooldown_secs``. No mesh or fleet change
+    happens; an operator (or a future autoscaler) acts on the signal.
+    ``threshold <= 0`` disables the advisor entirely."""
+
+    def __init__(self, threshold: int, consecutive: int = 3,
+                 cooldown_secs: float = 60.0,
+                 clock=time.monotonic):
+        self.threshold = int(threshold)
+        self.consecutive = max(1, int(consecutive))
+        self.cooldown_secs = cooldown_secs
+        self._clock = clock
+        self._streak = 0
+        self._last_suggested: Optional[float] = None
+        self.suggestions = 0
+
+    def observe(self, queue_depth: int, **ctx) -> bool:
+        """Feed one queue-depth observation; True when a grow
+        suggestion was emitted for it."""
+        if self.threshold <= 0:
+            return False
+        if queue_depth <= self.threshold:
+            self._streak = 0
+            return False
+        self._streak += 1
+        if self._streak < self.consecutive:
+            return False
+        now = self._clock()
+        if self._last_suggested is not None \
+                and now - self._last_suggested < self.cooldown_secs:
+            return False
+        self._last_suggested = now
+        self._streak = 0
+        self.suggestions += 1
+        from realhf_tpu.obs import flight, metrics
+        metrics.inc("elastic_grow_suggested_total", **ctx)
+        flight.record("elastic_grow_suggestion",
+                      queue_depth=queue_depth,
+                      threshold=self.threshold, **ctx)
+        logger.warning(
+            "ElasticPlanner GROW suggested: queue depth %d > %d for "
+            "%d consecutive observations (%s). Advisory only -- no "
+            "mesh change.", queue_depth, self.threshold,
+            self.consecutive, ctx or "no context")
+        return True

@@ -142,17 +142,30 @@ class MasterWorker(worker_base.Worker):
                     info.version)
 
         # fault tolerance: heartbeat watchdog over the worker fleet,
-        # excluded-workers bookkeeping, per-MFC requeue accounting
+        # excluded-workers bookkeeping, per-MFC requeue accounting.
+        # Host failure domains (system/pod.py): workers self-publish
+        # their pod host id, and both the watchdog and the exclusion
+        # book aggregate per host -- a preempted VM is ONE HOST_LOST
+        # with one backoff entry, not N independent worker losses.
         self.ft = getattr(spec, "ft", None) or FaultToleranceConfig()
+        from realhf_tpu.system.pod import name_resolve_host_lookup
+        self._host_of = name_resolve_host_lookup(
+            spec.experiment_name, spec.trial_name)
         self.watchdog = Watchdog(
             spec.experiment_name, spec.trial_name, self.all_workers,
             timeout=self.ft.heartbeat_timeout,
             grace=self.ft.startup_grace_secs,
-            poll_interval=self.ft.watchdog_poll_secs)
+            poll_interval=self.ft.watchdog_poll_secs,
+            host_of=self._host_of,
+            host_window=getattr(self.ft, "host_lost_window_secs", None))
         self._exclusions = ExclusionBook(
             base=self.ft.exclude_base_secs,
-            max_delay=self.ft.exclude_max_secs)
+            max_delay=self.ft.exclude_max_secs,
+            host_of=self._host_of)
         self._mfc_requeues: Dict[tuple, int] = {}  # (bid, mfc) -> count
+        # (bid, mfc) -> (failed fetch plan, ts): dispatch cooldown
+        # after a survivor reported fetch_failed for that exact plan
+        self._fetch_failed: Dict[tuple, tuple] = {}
         self._fetch_requeues = 0
         # elastic degraded-mode training (system/elastic.py): re-plan
         # MFCs of preempted/LOST workers onto survivors; re-expand on
@@ -235,10 +248,37 @@ class MasterWorker(worker_base.Worker):
                 return False
         return True
 
+    def _input_plan(self, bid: int, mfc_name: str) -> tuple:
+        """The (key, owner) fetch plan a dispatch of this MFC would
+        use right now (hashable, for staleness comparison)."""
+        node = self.dfg.find(mfc_name)
+        e = self.buffer.get(bid)
+        return tuple(sorted((k, e.key_owner[k])
+                            for k in node.input_keys
+                            if k in e.key_owner))
+
     def _dispatchable(self, bid: int, mfc_name: str) -> bool:
         node = self.dfg.find(mfc_name)
         if not self._workers_eligible(self.node_workers[mfc_name]):
             return False
+        # input owners: never dispatch a fetch plan pointing at a
+        # watchdog-LOST worker (the tensors died with it; invalidation
+        # + recompute will re-home them). Retiring-but-draining owners
+        # stay fetchable -- the preemption grace window exists exactly
+        # so consumers can still pull from them.
+        plan = self._input_plan(bid, mfc_name)
+        if {o for _k, o in plan} & set(self.watchdog.lost_workers()):
+            return False
+        failed = self._fetch_failed.get((bid, mfc_name))
+        if failed is not None:
+            failed_plan, ts = failed
+            cooldown = self.ft.heartbeat_timeout \
+                + 2 * self.ft.watchdog_poll_secs
+            if failed_plan == plan \
+                    and time.monotonic() - ts < cooldown:
+                # same plan just failed; give the watchdog time to
+                # attribute the owner's death before retrying
+                return False
         if node.role in self.train_nodes_of_role:
             return self._train_caught_up(bid, node.role)
         return True
@@ -266,12 +306,20 @@ class MasterWorker(worker_base.Worker):
         requeue or fail work attributed to newly lost workers; enforce
         the fatal deadline for workers that stay lost; re-expand when
         a degraded node's home worker rejoins."""
-        for w in self.watchdog.preempt_notices():
-            if w not in self._preempt_seen:
-                self._preempt_seen.add(w)
-                self._on_worker_preempted(w)
-        for w in self.watchdog.poll():
-            self._on_worker_lost(w)
+        notices = self.watchdog.preempt_notices()
+        new_notices = sorted(w for w in notices
+                             if w not in self._preempt_seen)
+        if new_notices:
+            # all co-preempted workers (a host preemption notices every
+            # worker on the VM at once) are handled as ONE batch: the
+            # whole dying set retires BEFORE any handoff/degrade
+            # planning, so successors and adopters are chosen OFF the
+            # dying host in one shot
+            self._preempt_seen.update(new_notices)
+            self._on_workers_preempted(new_notices, notices)
+        lost_now = self.watchdog.poll()
+        if lost_now:
+            self._on_workers_lost(lost_now)
         fatal = self.watchdog.lost_longer_than(
             self.ft.worker_lost_fatal_secs)
         # a retired worker whose every responsibility was migrated is
@@ -325,41 +373,185 @@ class MasterWorker(worker_base.Worker):
                 if n.name not in entry.completed]
 
     def _on_worker_lost(self, worker: str):
-        """A worker's heartbeat expired: exclude it with backoff,
-        drop its in-flight requests, and requeue the affected MFCs
+        self._on_workers_lost([worker])
+
+    def _on_workers_lost(self, workers):
+        """Heartbeats expired (possibly a whole host at once): exclude
+        with backoff (host-coalesced -- one VM loss is one backoff
+        entry), drop in-flight requests, and requeue the affected MFCs
         (bounded by ft.max_mfc_retries) so a flap heals without
         failing the trial; exhausted retries raise a WorkerLostError
         naming the worker and the MFC. With elastic degradation on,
-        its migratable MFCs are then re-planned onto survivors."""
-        self._exclusions.exclude(worker)
-        self._drop_and_requeue(worker)
+        the WHOLE dying set retires first, then every migratable MFC
+        routed through it is re-planned onto survivors in one shot --
+        completed outputs homed on the dead workers are invalidated
+        (their tensors died with the host) so consumers recompute from
+        the surviving data owner instead of fetching from a corpse."""
+        workers = sorted(set(workers))
         if self.elastic is not None:
-            self._retiring.add(worker)
-            self._elastic_degrade(worker)
+            self._retiring.update(workers)
+        for w in workers:
+            self._exclusions.exclude(w)
+        # order matters: the doomed-consumer scan reads key_owner
+        # before invalidation scrubs it
+        self._requeue_doomed_consumers(set(workers))
+        self._invalidate_lost_outputs(workers)
+        for w in workers:
+            self._drop_and_requeue(w)
+        if self.elastic is not None:
+            # plan over the FULL dead/retiring set, not just this
+            # edge: host members can flip LOST across successive
+            # polls, and an adoption that failed because its target
+            # was a sibling casualty of the same host must be
+            # re-planned now (nodes already migrated have re-routed
+            # groups and are skipped automatically)
+            self._elastic_degrade(
+                set(workers) | self._retiring
+                | set(self.watchdog.lost_workers()))
 
     def _on_worker_preempted(self, worker: str):
-        """A preemption notice arrived (SIGTERM-equivalent, grace
-        window running): stop dispatching to the worker, requeue what
-        was in flight on it (it may still finish -- the duplicate
-        reply drains harmlessly), and migrate its MFCs while the old
-        incarnation is still draining."""
-        notice = self.watchdog.preempt_notice(worker)
-        grace = notice[1] if notice else 0.0
-        metrics.inc("master_preempt_notices_total", worker=worker)
-        flight.record("preempt_notice", worker=worker, grace=grace)
-        logger.warning(
-            "Worker %s announced PREEMPTION (%.1fs grace): retiring "
-            "it from dispatch%s.", worker, grace,
-            "" if self.elastic is None
-            else " and re-planning its MFCs onto survivors")
-        self._retiring.add(worker)
-        self._drop_and_requeue(worker)
-        if self.elastic is not None:
+        self._on_workers_preempted(
+            [worker], self.watchdog.preempt_notices())
+
+    def _on_workers_preempted(self, workers, notices: Dict):
+        """Preemption notices arrived (SIGTERM-equivalent, grace
+        windows running) -- for a host preemption, one per worker on
+        the VM, handled as a single batch: stop dispatching to the
+        whole dying set, requeue what was in flight on it (it may
+        still finish -- the duplicate reply drains harmlessly), hand
+        data ownership OFF the dying set while its data server still
+        answers, and migrate its MFCs while the old incarnations are
+        still draining."""
+        workers = sorted(set(workers))
+        self._retiring.update(workers)
+        by_host: Dict = {}
+        for w in workers:
+            by_host.setdefault(self._host_of(w) or w, []).append(w)
+        for key, ws in sorted(by_host.items()):
+            grace = max((notices.get(w, (0, 0))[1] for w in ws),
+                        default=0.0)
+            for w in ws:
+                metrics.inc("master_preempt_notices_total", worker=w)
+            if len(ws) > 1:
+                flight.record("host_preempt_notice", host=key,
+                              workers=ws, grace=grace)
+            else:
+                flight.record("preempt_notice", worker=ws[0],
+                              grace=grace)
+            logger.warning(
+                "%s announced PREEMPTION (%.1fs grace): retiring "
+                "from dispatch%s.",
+                f"Host {key} ({ws})" if len(ws) > 1
+                else f"Worker {ws[0]}", grace,
+                "" if self.elastic is None
+                else " and re-planning its MFCs onto survivors")
+        if self.elastic is not None and self.data_owner in workers:
             # handoff FIRST: it must land while the draining worker's
-            # data server still answers inside the grace window
-            if worker == self.data_owner:
-                self._handoff_data_owner(worker, grace)
-            self._elastic_degrade(worker)
+            # data server still answers inside the grace window; the
+            # whole dying set is already retiring, so the successor
+            # scan lands off the dying host in one shot
+            grace = max((notices.get(w, (0, 0))[1] for w in workers),
+                        default=0.0)
+            self._handoff_data_owner(self.data_owner, grace)
+        for w in workers:
+            self._drop_and_requeue(w)
+        if self.elastic is not None:
+            self._elastic_degrade(workers)
+
+    def _requeue_doomed_consumers(self, ws):
+        """An MFC in flight on a SURVIVOR whose input fetch plan
+        points at a just-dead worker can only fail its data fetch:
+        drop the dispatch and requeue it (ready_mfcs re-offers it once
+        the producer has recomputed the lost inputs)."""
+        seen = set()
+        for rid, (bid, mfc, w, kind) in list(self._inflight.items()):
+            if kind != "leader" or mfc is None or w in ws:
+                continue  # dead-worker rids are _drop_and_requeue's job
+            if (bid, mfc) in seen:
+                continue
+            try:
+                e = self.buffer.get(bid)
+            except KeyError:
+                continue
+            node = self.dfg.find(mfc)
+            doomed = {e.key_owner.get(k)
+                      for k in node.input_keys} & ws
+            if not doomed:
+                continue
+            seen.add((bid, mfc))
+            siblings = [r for r, ref in list(self._inflight.items())
+                        if ref[0] == bid and ref[1] == mfc]
+            for r in siblings:
+                self._inflight.pop(r, None)
+            self.stream.discard(siblings)
+            self.buffer.mark_undispatched(bid, mfc)
+            logger.warning(
+                "Requeued in-flight MFC %s (batch %d): its input "
+                "fetch plan references dead worker(s) %s.", mfc, bid,
+                sorted(doomed))
+
+    def _on_mfc_fetch_failed(self, bid, mfc_name, worker, error):
+        """A survivor could not assemble an MFC's inputs (their owner
+        died without a grace window): drop the dispatch group and
+        requeue, bounded by the same per-MFC retry budget as worker
+        loss -- a persistent failure still fails the trial with
+        attribution instead of looping forever."""
+        siblings = [r for r, ref in list(self._inflight.items())
+                    if ref[0] == bid and ref[1] == mfc_name]
+        for r in siblings:
+            self._inflight.pop(r, None)
+        self.stream.discard(siblings)
+        try:
+            self._fetch_failed[(bid, mfc_name)] = (
+                self._input_plan(bid, mfc_name), time.monotonic())
+        except KeyError:
+            pass  # batch already popped; nothing to requeue
+        n = self._mfc_requeues.get((bid, mfc_name), 0) + 1
+        self._mfc_requeues[(bid, mfc_name)] = n
+        # fetch failures get a wider budget than worker loss: the
+        # first one typically races the watchdog's attribution of the
+        # dead owner (the dispatch cooldown absorbs the gap)
+        budget = max(3, self.ft.max_mfc_retries)
+        if n > budget:
+            flight.record("fetch_failed_fatal", mfc=mfc_name,
+                          batch_id=bid, worker=worker, error=error)
+            raise WorkerLostError(
+                worker, inflight=[f"{mfc_name}@batch{bid}"],
+                detail=f"MFC {mfc_name} (batch {bid}) input fetch "
+                       f"failed {n}x ({error}); giving up.")
+        self.buffer.mark_undispatched(bid, mfc_name)
+        metrics.inc("master_fetch_failed_requeues_total", mfc=mfc_name)
+        logger.warning(
+            "Requeued MFC %s (batch %d): %s reported fetch_failed "
+            "(%s; attempt %d/%d).", mfc_name, bid, worker, error, n,
+            budget)
+
+    def _invalidate_lost_outputs(self, workers):
+        """Un-complete MFCs whose output tensors were homed on workers
+        that died WITHOUT a grace window (SIGKILL / host loss): the
+        data-plane pieces are gone, so any consumer dispatch would
+        fail its fetch. Re-marking the producer undispatched makes it
+        recompute -- on the adopter once elastic degrade reroutes it
+        -- from inputs still homed on the surviving data owner. This
+        recomputes, it never re-consumes: the batch's sample ids were
+        drawn from the dataset exactly once."""
+        ws = set(workers)
+        for bid in self.buffer.batch_ids():
+            e = self.buffer.get(bid)
+            lost_keys = {k for k, o in e.key_owner.items() if o in ws}
+            if not lost_keys:
+                continue
+            for n in self.dfg.nodes:
+                hit = set(n.output_keys) & lost_keys
+                if hit and n.name in e.completed:
+                    owners = sorted({e.key_owner[k] for k in hit})
+                    self.buffer.invalidate_outputs(bid, n.name, hit)
+                    metrics.inc("master_outputs_invalidated_total",
+                                mfc=n.name)
+                    logger.warning(
+                        "Batch %d: %s outputs %s died with worker(s) "
+                        "%s; re-marked for recompute.", bid, n.name,
+                        sorted(hit), owners)
 
     def _handoff_data_owner(self, worker: str, grace: float):
         """The preempted worker owns the data plane (dataset loader +
@@ -477,20 +669,27 @@ class MasterWorker(worker_base.Worker):
             out.append(int(w.rsplit("/", 1)[1]))
         return sorted(out)
 
-    def _elastic_degrade(self, worker: str):
-        """Re-plan every MFC currently routed through ``worker`` onto
-        a survivor: the adopter builds a replica engine on a degraded
+    def _elastic_degrade(self, workers):
+        """Re-plan every MFC currently routed through the lost/dying
+        ``workers`` (one worker, or a whole host's worth in ONE shot:
+        adopters are chosen with the full dying set excluded, so no
+        plan ever lands on a sibling casualty of the same VM) onto
+        survivors: each adopter builds a replica engine on a degraded
         layout and weights reshard onto it (live primary / verified
         emergency checkpoint / deterministic seed + param-sync
         refresh). Non-migratable nodes (train steps, hit primaries)
         keep the existing requeue/fatal semantics."""
-        widx = int(worker.rsplit("/", 1)[1])
+        if isinstance(workers, str):
+            workers = [workers]
+        workers = sorted(set(workers))
+        lost_idx = {int(w.rsplit("/", 1)[1]) for w in workers
+                    if w.startswith("model_worker/")}
         alive = self._alive_worker_indices()
         for node in self.dfg.nodes:
             group = self.node_workers[node.name]
-            if worker not in group:
+            if not set(group) & set(workers):
                 continue
-            plan = self.elastic.plan_degraded(node.name, lost={widx},
+            plan = self.elastic.plan_degraded(node.name, lost=lost_idx,
                                               alive=alive)
             if plan is None:
                 continue
@@ -525,7 +724,7 @@ class MasterWorker(worker_base.Worker):
                 self.cross_group_nodes.discard(node.name)
             metrics.inc("elastic_degrade_total", node=node.name)
             flight.record("elastic_degrade", node=node.name,
-                          lost_worker=worker, adopters=new_workers)
+                          lost_workers=workers, adopters=new_workers)
             logger.warning(
                 "DEGRADED %s: %s -> %s on layout %s (%s); installed "
                 "weight version %s. Training continues at reduced "
@@ -714,6 +913,9 @@ class MasterWorker(worker_base.Worker):
             self._mfc_requeues = {k: v for k, v in
                                   self._mfc_requeues.items()
                                   if k[0] != e.batch_id}
+            self._fetch_failed = {k: v for k, v in
+                                  self._fetch_failed.items()
+                                  if k[0] != e.batch_id}
             self.global_step += 1
             self._cur_epoch = e.epoch
             self._consumed_ids.extend(e.ids)
@@ -726,6 +928,16 @@ class MasterWorker(worker_base.Worker):
                 step_span.finish()
             metrics.inc("master_steps_total")
             metrics.observe("master_step_secs", dt)
+            # progress beacon: pod controllers / chaos harnesses watch
+            # trial progress without a control-panel socket
+            try:
+                name_resolve.add(
+                    names.train_progress(self.spec.experiment_name,
+                                         self.spec.trial_name),
+                    str(self.global_step), replace=True,
+                    delete_on_exit=False)
+            except Exception:  # noqa: BLE001 - advisory only
+                pass
             logger.info(
                 "Master: batch %d done (global step %d, epoch %d) "
                 "%.2fs since last; stats keys: %s", e.batch_id,
@@ -929,6 +1141,13 @@ class MasterWorker(worker_base.Worker):
             if ref is None:
                 continue
             bid, mfc_name, worker, kind = ref
+            if kind in ("leader", "member") \
+                    and isinstance(p.data, dict) \
+                    and p.data.get("fetch_failed"):
+                self._on_mfc_fetch_failed(bid, mfc_name, worker,
+                                          p.data["fetch_failed"])
+                n += 1
+                continue
             if kind == "fetch":
                 self._on_fetch_reply(p.data)
             elif kind in ("leader", "member"):
@@ -969,7 +1188,11 @@ class MasterWorker(worker_base.Worker):
             return dict(stats=self._step_stats,
                         global_step=self.global_step,
                         complete=self._complete,
-                        exec_log=list(self._exec_history))
+                        exec_log=list(self._exec_history),
+                        # host failure domains: the HOST_LOST
+                        # attribution history ({host, workers, ts}) --
+                        # the pod e2e's acceptance surface
+                        host_lost=self.watchdog.host_lost_events())
         if cmd == "profiler":
             # master control surface for jax.profiler: broadcast the
             # start/stop to every active model worker (the master
@@ -988,6 +1211,12 @@ class MasterWorker(worker_base.Worker):
         return super()._handle_command(cmd, kwargs)
 
     def _exit_hook(self):
+        # a trial that survived host losses leaves its postmortem on
+        # disk even on a CLEAN exit: the launcher's teardown merges
+        # per-host dumps into one incident timeline (obs/flight.py)
+        if getattr(self, "watchdog", None) is not None \
+                and self.watchdog.host_lost_events():
+            flight.dump(reason="host loss survived (postrun record)")
         # close out still-open step spans so the merged trace shows
         # the in-flight batches of an interrupted trial too
         for sp in getattr(self, "_step_spans", {}).values():

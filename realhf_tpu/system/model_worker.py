@@ -483,11 +483,25 @@ class ModelWorker(worker_base.Worker):
                               weight_version=ps["version"]):
                 self._receive_param_sync(node_name, ps)
         keys = [k for k in node.input_keys]
-        with tracing.span("data_fetch", mfc=node_name,
-                          worker=self.worker_name,
-                          n_ids=len(d["ids"]), n_keys=len(keys)):
-            inp = self._assemble_input(d["ids"], keys,
-                                       d.get("fetch_plan", {}))
+        try:
+            with tracing.span("data_fetch", mfc=node_name,
+                              worker=self.worker_name,
+                              n_ids=len(d["ids"]), n_keys=len(keys)):
+                inp = self._assemble_input(d["ids"], keys,
+                                           d.get("fetch_plan", {}))
+        except Exception as e:  # noqa: BLE001 - a fetch from a
+            # just-dead host (SIGKILLed VM: no grace window, tensors
+            # gone) must not take THIS worker down with it; reply a
+            # structured refusal the master converts into a bounded
+            # requeue (the producer recomputes on a survivor first)
+            logger.warning(
+                "ModelWorker %s: input fetch for %s failed (%r); "
+                "replying fetch_failed for requeue.",
+                self.worker_name, node_name, e)
+            flight.record("fetch_failed", mfc=node_name, error=repr(e))
+            metrics.inc("worker_fetch_failed_total", mfc=node_name)
+            self.stream.respond(req, data=dict(fetch_failed=repr(e)))
+            return
         out = self.host.execute(node_name, inp)
         info = getattr(self.host, "last_exec_info", None)
         if info is not None and node_name in self.cross_group_nodes:
@@ -495,9 +509,15 @@ class ModelWorker(worker_base.Worker):
                         param_version=self.host.node_version(node_name))
         elif info is not None and node_name in self.host.adopted_nodes:
             # adopted next to its live primary: fresh every execute
-            # via the replica-refresh pre-hook
-            info = dict(info,
-                        param_version=self.host.role_version(node.role))
+            # via the replica-refresh pre-hook. The adopter does not
+            # necessarily HOST the role's primary model (it may be the
+            # nominal primary worker of a role whose only node lived
+            # on the lost host) -- fall back to the replica's own
+            # installed version then.
+            info = dict(info, param_version=(
+                self.host.role_version(node.role)
+                if node.role in self.host.models
+                else self.host.node_version(node_name)))
         is_leader = node_name in self.leader_nodes
         if isinstance(out, data_api.SequenceSample):
             # members store the (replicated) outputs too: later MFCs on

@@ -312,4 +312,9 @@ def make_scheduler(mode: str = "local", **kwargs) -> SchedulerClient:
         return LocalSchedulerClient()
     if mode == "slurm":
         return SlurmSchedulerClient(**kwargs)
+    if mode == "multihost_local":
+        # emulated N-host pod on one box (system/pod.py): per-host env
+        # namespaces + process groups, kill_host() for failure drills
+        from realhf_tpu.system.pod import MultiHostLocalScheduler
+        return MultiHostLocalScheduler(**kwargs)
     raise NotImplementedError(f"Scheduler mode {mode}")

@@ -70,13 +70,32 @@ class Watchdog:
     the ALIVE->LOST edge -- e.g. ``FleetRouter.notify_lost``, so a
     co-located serving router fails work over immediately instead of
     waiting for the replica's fleet lease to expire.
+
+    **Host failure domains** (``system/pod.py``): with ``host_of`` (a
+    ``worker -> host id | None`` callable, e.g.
+    ``pod.name_resolve_host_lookup``), losses aggregate per host. TPU
+    pods fail at VM granularity -- one preemption takes out every
+    worker on the host simultaneously -- so when ALL workers of a host
+    go stale within ``host_window`` seconds of each other the loss is
+    attributed as ONE ``HOST_LOST`` (one flight event, one counter,
+    one log line, the ``on_host_lost`` callback) instead of N
+    independent worker losses. ``lost_workers``/``poll`` still report
+    every worker immediately (the master must requeue their work
+    without delay); only the *attribution* is aggregated, and an
+    individual worker's event is deferred at most ``host_window``
+    seconds (default: ``timeout``) while its host's fate resolves.
     """
 
     def __init__(self, experiment_name: str, trial_name: str,
                  workers: Iterable[str], timeout: float = 20.0,
                  grace: float = 120.0, poll_interval: float = 1.0,
                  clock: Callable[[], float] = time.time,
-                 on_lost: Optional[Callable[[str], None]] = None):
+                 on_lost: Optional[Callable[[str], None]] = None,
+                 host_of: Optional[
+                     Callable[[str], Optional[str]]] = None,
+                 host_window: Optional[float] = None,
+                 on_host_lost: Optional[
+                     Callable[[str, List[str]], None]] = None):
         self._exp, self._trial = experiment_name, trial_name
         self.workers = sorted(set(workers))
         self.timeout = timeout
@@ -84,10 +103,24 @@ class Watchdog:
         self.poll_interval = poll_interval
         self._clock = clock
         self._on_lost = on_lost
+        self._host_of = host_of
+        self.host_window = timeout if host_window is None \
+            else host_window
+        self._on_host_lost = on_host_lost
         self._start = clock()
         self._ever_beat: Dict[str, float] = {}   # worker -> last fresh ts
         self._lost_since: Dict[str, float] = {}
         self._last_poll = 0.0
+        # host-domain bookkeeping: hosts currently whole-lost, the
+        # attribution history, and lost workers whose individual event
+        # is deferred while their host's fate resolves
+        self._host_lost_since: Dict[str, float] = {}
+        self._host_lost_log: List[Dict] = []
+        self._unattributed: Dict[str, float] = {}
+        # incarnation fencing: last boot id seen per worker (beats are
+        # "<ts>:<boot-id>"; legacy plain-ts beats carry none)
+        self._boot_ids: Dict[str, str] = {}
+        self._lost_reason: Dict[str, str] = {}
 
     # ------------------------------------------------------------------
     def _status_of(self, worker: str) -> Optional[WorkerServerStatus]:
@@ -97,12 +130,36 @@ class Watchdog:
         except (name_resolve.NameEntryNotFoundError, ValueError):
             return None
 
-    def _verdict(self, worker: str, now: float) -> str:
+    def _read_beat(self, worker: str):
+        """The worker's published heartbeat as ``(ts, boot_id)``.
+        Beats are ``"<ts>:<boot-id>"`` (worker_base.py); a legacy
+        plain-timestamp beat yields ``boot_id=None``."""
         try:
-            ts = float(name_resolve.get(names.worker_heartbeat(
+            raw = str(name_resolve.get(names.worker_heartbeat(
                 self._exp, self._trial, worker)))
+            ts_s, _, boot = raw.partition(":")
+            return float(ts_s), (boot or None)
         except (name_resolve.NameEntryNotFoundError, ValueError):
-            ts = None
+            return None, None
+
+    def _relaunch_edge(self, worker: str, boot: Optional[str]) -> bool:
+        """True when the worker's boot id CHANGED since last seen --
+        the previous incarnation died and was relaunched faster than
+        its beat could ever go stale. Without this fence the dead
+        process is a silent message blackhole: requests PUB'd to it
+        are gone, yet the successor's fresh beat hides the death."""
+        if boot is None:
+            return False
+        prev = self._boot_ids.get(worker)
+        self._boot_ids[worker] = boot
+        return prev is not None and prev != boot
+
+    def _verdict(self, worker: str, now: float) -> str:
+        return self._verdict_with(worker, now,
+                                  self._read_beat(worker)[0])
+
+    def _verdict_with(self, worker: str, now: float,
+                      ts: Optional[float]) -> str:
         if ts is not None:
             # any published beat -- fresh or stale -- proves the
             # worker existed; staleness then means loss, never PENDING
@@ -124,35 +181,127 @@ class Watchdog:
             return PENDING
         return LOST
 
+    def _host(self, worker: str) -> Optional[str]:
+        if self._host_of is None:
+            return None
+        try:
+            return self._host_of(worker)
+        except Exception:  # noqa: BLE001 - mapping must not break
+            # liveness accounting
+            return None
+
+    def _host_members(self, host: str) -> List[str]:
+        return [w for w in self.workers if self._host(w) == host]
+
+    def _emit_worker_lost(self, w: str, now: float):
+        reason = self._lost_reason.get(w, "stale")
+        metrics.inc("watchdog_lost_total", worker=w)
+        flight.record("worker_lost", worker=w, reason=reason)
+        if reason == "relaunched":
+            logger.error(
+                "Worker %s LOST (incarnation changed): relaunched "
+                "faster than the %.1fs staleness timeout -- its "
+                "predecessor's in-flight work is gone.", w,
+                self.timeout)
+        else:
+            logger.error(
+                "Worker %s LOST: no heartbeat for > %.1fs "
+                "(last beat %s).", w, self.timeout,
+                "%.1fs ago" % (now - self._ever_beat[w])
+                if w in self._ever_beat else "never seen")
+
+    def _attribute_losses(self, new_lost: List[str], now: float):
+        """Emit loss events: whole-host losses as ONE ``host_lost``
+        event; lone losses (or hosts that never fully fail within
+        ``host_window``) as individual ``worker_lost`` events."""
+        for w in new_lost:
+            h = self._host(w)
+            if h is None or len(self._host_members(h)) <= 1:
+                self._emit_worker_lost(w, now)
+            else:
+                # hold the individual event while the host's fate
+                # resolves (at most host_window seconds)
+                self._unattributed[w] = now
+        # host completion: every member lost, within one window
+        hosts = {self._host(w) for w in self._unattributed}
+        for h in sorted(hosts - {None} - set(self._host_lost_since)):
+            members = self._host_members(h)
+            ts = [self._lost_since.get(m) for m in members]
+            if any(t is None for t in ts):
+                continue
+            if max(ts) - min(ts) > self.host_window:
+                continue
+            self._host_lost_since[h] = now
+            self._host_lost_log.append(dict(
+                host=h, workers=sorted(members), ts=now))
+            metrics.inc("watchdog_host_lost_total", host=h)
+            flight.record("host_lost", host=h,
+                          workers=sorted(members))
+            logger.error(
+                "HOST %s LOST: all %d workers (%s) went stale within "
+                "%.1fs -- attributing as one host failure.", h,
+                len(members), sorted(members), self.host_window)
+            for m in members:
+                self._unattributed.pop(m, None)
+            if self._on_host_lost is not None:
+                try:
+                    self._on_host_lost(h, sorted(members))
+                except Exception as e:  # noqa: BLE001
+                    logger.error("on_host_lost hook failed for %s: "
+                                 "%r", h, e)
+        # deferral expiry: the host never completed -- emit the
+        # individual events after all
+        for w, t0 in sorted(self._unattributed.items()):
+            if now - t0 > self.host_window:
+                del self._unattributed[w]
+                self._emit_worker_lost(w, now)
+
     def check(self) -> Dict[str, str]:
         """Full liveness snapshot {worker: ALIVE|PENDING|LOST|DONE},
         updating loss bookkeeping."""
         now = self._clock()
         out = {}
+        new_lost = []
+
+        def _edge(w, reason):
+            self._lost_since[w] = now
+            self._lost_reason[w] = reason
+            new_lost.append(w)
+            if self._on_lost is not None:
+                try:
+                    self._on_lost(w)
+                except Exception as e:  # noqa: BLE001 - the hook
+                    # must not break liveness accounting
+                    logger.error("on_lost hook failed for %s: %r",
+                                 w, e)
+
         for w in self.workers:
-            v = self._verdict(w, now)
+            ts, boot = self._read_beat(w)
+            relaunched = self._relaunch_edge(w, boot)
+            v = self._verdict_with(w, now, ts)
             out[w] = v
             if v == LOST:
                 if w not in self._lost_since:
-                    self._lost_since[w] = now
-                    metrics.inc("watchdog_lost_total", worker=w)
-                    flight.record("worker_lost", worker=w)
-                    logger.error(
-                        "Worker %s LOST: no heartbeat for > %.1fs "
-                        "(last beat %s).", w, self.timeout,
-                        "%.1fs ago" % (now - self._ever_beat[w])
-                        if w in self._ever_beat else "never seen")
-                    if self._on_lost is not None:
-                        try:
-                            self._on_lost(w)
-                        except Exception as e:  # noqa: BLE001 - the
-                            # hook must not break liveness accounting
-                            logger.error("on_lost hook failed for "
-                                         "%s: %r", w, e)
+                    _edge(w, "stale")
             elif w in self._lost_since:
                 del self._lost_since[w]
+                self._lost_reason.pop(w, None)
+                self._unattributed.pop(w, None)
+                h = self._host(w)
+                if h is not None and h in self._host_lost_since:
+                    # a member returned: the host as a whole is back
+                    # in play (a second full loss re-attributes)
+                    del self._host_lost_since[h]
                 metrics.inc("watchdog_flap_recovered_total", worker=w)
                 logger.warning("Worker %s heartbeat returned (flap).", w)
+            elif relaunched:
+                # incarnation fence: the predecessor died and was
+                # replaced FASTER than its beat could go stale --
+                # report a one-check loss edge (the master requeues
+                # the dead incarnation's in-flight work and re-routes)
+                # that flap-recovers on the next check
+                _edge(w, "relaunched")
+        self._attribute_losses(new_lost, now)
         counts = {v: 0 for v in (ALIVE, PENDING, LOST, DONE)}
         for v in out.values():
             counts[v] += 1
@@ -160,6 +309,15 @@ class Watchdog:
             metrics.set_gauge("watchdog_workers", n,
                               state=verdict.lower())
         return out
+
+    def lost_hosts(self) -> List[str]:
+        """Hosts currently attributed as whole-lost."""
+        return sorted(self._host_lost_since)
+
+    def host_lost_events(self) -> List[Dict]:
+        """Attribution history: one entry per HOST_LOST verdict
+        ({host, workers, ts}), surviving flap recoveries."""
+        return [dict(e) for e in self._host_lost_log]
 
     def poll(self) -> List[str]:
         """Rate-limited edge-triggered check: workers that became LOST
@@ -180,12 +338,8 @@ class Watchdog:
         now -- the rejoin signal for elastic re-expansion (a DONE /
         PREEMPTED verdict can coexist with a fresh beat while a
         relaunched incarnation spins up)."""
-        try:
-            ts = float(name_resolve.get(names.worker_heartbeat(
-                self._exp, self._trial, worker)))
-        except (name_resolve.NameEntryNotFoundError, ValueError):
-            return False
-        return self._clock() - ts <= self.timeout
+        ts, _boot = self._read_beat(worker)
+        return ts is not None and self._clock() - ts <= self.timeout
 
     def preempt_notice(self, worker: str):
         """The worker's active preemption notice as ``(ts, grace)``
@@ -237,46 +391,89 @@ class ExclusionBook:
     """``excluded_workers`` bookkeeping: each loss excludes the worker
     from dispatch for ``base * factor**(losses-1)`` seconds (capped,
     jittered), so a flapping worker is not re-picked the moment its
-    heartbeat reappears."""
+    heartbeat reappears.
+
+    With ``host_of`` (``system/pod.py`` host domains) the bookkeeping
+    keys on the HOST: all workers of a flapping host share one backoff
+    entry, and the N near-simultaneous losses a host failure produces
+    (within ``coalesce_secs`` of each other) count as ONE loss -- a
+    preempted VM must not exponentially bury its own workers N deep.
+    Forgiving any member forgives the host."""
 
     def __init__(self, base: float = 5.0, factor: float = 2.0,
                  max_delay: float = 120.0, jitter: float = 0.25,
                  clock: Callable[[], float] = time.monotonic,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 host_of: Optional[
+                     Callable[[str], Optional[str]]] = None,
+                 coalesce_secs: float = 5.0):
         self.base, self.factor = base, factor
         self.max_delay, self.jitter = max_delay, jitter
         self._clock = clock
         self._rng = rng or random
+        self._host_of = host_of
+        self.coalesce_secs = coalesce_secs
         self._losses: Dict[str, int] = {}
         self._until: Dict[str, float] = {}
+        self._last_loss: Dict[str, float] = {}
+
+    def _key(self, worker: str) -> str:
+        if self._host_of is not None:
+            try:
+                h = self._host_of(worker)
+            except Exception:  # noqa: BLE001 - never break dispatch
+                h = None
+            if h is not None:
+                return h
+        return worker
 
     def exclude(self, worker: str) -> float:
-        """Record one loss; returns the exclusion window length."""
-        n = self._losses.get(worker, 0) + 1
-        self._losses[worker] = n
+        """Record one loss; returns the exclusion window length. A
+        loss against an already-hit host within ``coalesce_secs`` is
+        the SAME failure event: no extra loss count, shared window."""
+        key = self._key(worker)
+        now = self._clock()
+        last = self._last_loss.get(key)
+        if key != worker and last is not None \
+                and now - last <= self.coalesce_secs:
+            remaining = max(0.0, self._until.get(key, now) - now)
+            logger.info(
+                "Worker %s loss coalesced into host %s's existing "
+                "exclusion (%.1fs left).", worker, key, remaining)
+            return remaining
+        n = self._losses.get(key, 0) + 1
+        self._losses[key] = n
+        self._last_loss[key] = now
         d = min(self.base * self.factor ** (n - 1), self.max_delay)
         d += self._rng.uniform(0.0, self.jitter * d)
-        self._until[worker] = self._clock() + d
-        logger.warning("Worker %s excluded from dispatch for %.1fs "
-                       "(loss #%d).", worker, d, n)
+        self._until[key] = now + d
+        logger.warning("%s %s excluded from dispatch for %.1fs "
+                       "(loss #%d).",
+                       "Host" if key != worker else "Worker", key, d, n)
         return d
 
     def is_excluded(self, worker: str) -> bool:
-        until = self._until.get(worker)
+        key = self._key(worker)
+        until = self._until.get(key)
         if until is None:
             return False
         if self._clock() >= until:
-            del self._until[worker]  # window over; loss count persists
+            del self._until[key]  # window over; loss count persists
             return False
         return True
 
     def excluded(self) -> List[str]:
-        return sorted(w for w in list(self._until) if self.is_excluded(w))
+        """Currently-excluded keys (host ids for host-keyed entries,
+        else worker names)."""
+        return sorted(k for k in list(self._until) if self.is_excluded(k))
 
     def loss_count(self, worker: str) -> int:
-        return self._losses.get(worker, 0)
+        return self._losses.get(self._key(worker), 0)
 
     def forgive(self, worker: str):
-        """Clear history (e.g. after a long stretch of good health)."""
-        self._losses.pop(worker, None)
-        self._until.pop(worker, None)
+        """Clear history (e.g. after a long stretch of good health).
+        Host-keyed books forgive the whole host."""
+        key = self._key(worker)
+        self._losses.pop(key, None)
+        self._until.pop(key, None)
+        self._last_loss.pop(key, None)

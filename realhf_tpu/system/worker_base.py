@@ -14,12 +14,13 @@ import pickle
 import signal
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import zmq
 
 from realhf_tpu import obs
-from realhf_tpu.base import logging, name_resolve, names, network
+from realhf_tpu.base import cluster, logging, name_resolve, names, network
 from realhf_tpu.obs import flight, metrics, tracing
 
 logger = logging.getLogger("worker_base")
@@ -80,6 +81,15 @@ class WorkerServer:
         name_resolve.add(
             names.worker_key(experiment_name, trial_name, worker_name),
             f"tcp://{host}:{port}", replace=True)
+        # host failure domain (system/pod.py): a pod launch injects
+        # REALHF_TPU_HOST_ID per host; republish it so the master-side
+        # watchdog can attribute whole-host losses as ONE HOST_LOST
+        self.host_id = cluster.current_host_id()
+        if self.host_id:
+            name_resolve.add(
+                names.worker_host(experiment_name, trial_name,
+                                  worker_name),
+                self.host_id, replace=True, delete_on_exit=False)
         self.set_status(WorkerServerStatus.READY)
         # liveness beacon: a daemon thread re-publishes a wall-clock
         # timestamp so the controller-side watchdog (system/watchdog.py)
@@ -90,6 +100,14 @@ class WorkerServer:
             heartbeat_interval = float(os.environ.get(
                 HEARTBEAT_INTERVAL_ENV, DEFAULT_HEARTBEAT_INTERVAL))
         self._hb_interval = heartbeat_interval
+        # incarnation fencing: every beat carries this process's boot
+        # id. A worker that dies and is relaunched FASTER than the
+        # watchdog's staleness timeout would otherwise be a silent
+        # message blackhole -- in-flight PUB'd requests died with the
+        # old process, yet the fresh beat hides the death. The
+        # watchdog treats a boot-id change as a loss edge
+        # (system/watchdog.py) so the master requeues and re-routes.
+        self.boot_id = uuid.uuid4().hex[:12]
         self._hb_key = names.worker_heartbeat(experiment_name, trial_name,
                                               worker_name)
         self._preempt_key = names.worker_preempt(
@@ -118,12 +136,13 @@ class WorkerServer:
         self._beat_hooks.append(fn)
 
     def beat(self):
-        """Publish one heartbeat (current wall-clock seconds). Wall
-        clock, not monotonic: the watchdog lives in another process."""
+        """Publish one heartbeat: ``"<wall-ts>:<boot-id>"`` (wall
+        clock, not monotonic: the watchdog lives in another process;
+        the boot id fences incarnations)."""
         try:
             name_resolve.add(
-                self._hb_key, f"{time.time():.3f}", replace=True,
-                delete_on_exit=False,
+                self._hb_key, f"{time.time():.3f}:{self.boot_id}",
+                replace=True, delete_on_exit=False,
                 keepalive_ttl=self._hb_interval * HEARTBEAT_TTL_FACTOR)
         except Exception as e:  # noqa: BLE001 - next beat retries
             logger.warning("Heartbeat publish failed for %s: %s",

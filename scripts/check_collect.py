@@ -39,6 +39,7 @@ REQUIRED_DIRS = (
     "tests/observability",
     "tests/ops",
     "tests/parallel",
+    "tests/pod",
     "tests/recovery",
     "tests/search",
     "tests/serving",

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Generate a deterministic pod launch manifest (thin wrapper around
+``python -m realhf_tpu.apps.main pod-manifest``; docs/distributed.md
+"Pod deployment").
+
+Usage::
+
+    python scripts/gen_pod_manifest.py --experiment_name e \
+        --trial_name t --n_hosts 4 --n_model_workers 8 \
+        --n_chips_per_host 4 --out pod_manifest.json \
+        --scrape_out scrape_targets.json
+
+The output is byte-stable for identical inputs (diffable, committable)
+and round-trips through ``MultiHostLocalScheduler(manifest=...)`` for
+single-box emulation of the whole pod controller path.
+"""
+
+import sys
+
+from realhf_tpu.apps.main import pod_manifest_main
+
+if __name__ == "__main__":
+    sys.exit(pod_manifest_main(sys.argv[1:]))

@@ -23,6 +23,18 @@ from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.parallel.mesh import ParallelismConfig, make_mesh
 
+# Capability detect: interpret-mode coverage of the Pallas decode
+# wiring needs jax's force_tpu_interpret_mode (newer Pallas API). On
+# older jax these tests cannot run the kernel plumbing at all --
+# report an attributed skip, not a permanent expected failure; the
+# XLA-fallback paths stay covered by tests/engine/test_inflight.py
+# and the kernel-level compiled tier in tests/ops.
+pytestmark = pytest.mark.skipif(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason="jax.experimental.pallas.tpu lacks force_tpu_interpret_mode "
+           "(old Pallas API): interpret-mode kernel plumbing cannot "
+           "be exercised on this jax; XLA fallbacks covered elsewhere")
+
 
 def _cfg():
     # head_dim 64: the kernel gates require hd >= 64

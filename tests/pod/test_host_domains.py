@@ -1,0 +1,334 @@
+"""Host-level failure domains: worker host publishing, watchdog
+HOST_LOST aggregation (one event, not N worker losses), host-coalesced
+exclusion backoff, buffer output invalidation, and the log-only
+autoscale GrowAdvisor."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base import cluster, name_resolve, names
+from realhf_tpu.obs import flight
+from realhf_tpu.system.watchdog import (
+    ALIVE,
+    LOST,
+    ExclusionBook,
+    Watchdog,
+)
+
+EXP, TRIAL = "poddom", "t0"
+
+
+def _beat(worker, ts):
+    name_resolve.add(names.worker_heartbeat(EXP, TRIAL, worker),
+                     f"{ts:.3f}", replace=True)
+
+
+HOSTS = {"w/0": "host-A", "w/1": "host-A",
+         "w/2": "host-B", "w/3": "host-B"}
+
+
+def _dog(now, host_window=None, **kw):
+    return Watchdog(EXP, TRIAL, list(HOSTS), timeout=10.0, grace=5.0,
+                    poll_interval=0.0, clock=lambda: now[0],
+                    host_of=HOSTS.get, host_window=host_window, **kw)
+
+
+def _kinds():
+    return [e["kind"] for e in flight.default_recorder().events()]
+
+
+def test_whole_host_loss_is_one_attribution():
+    flight.reset_default()
+    now = [100.0]
+    seen = []
+    dog = _dog(now, on_host_lost=lambda h, ws: seen.append((h, ws)))
+    for w in HOSTS:
+        _beat(w, now[0])
+    assert set(dog.check().values()) == {ALIVE}
+
+    # host-A's workers both go silent; host-B keeps beating
+    now[0] = 130.0
+    for w in ("w/2", "w/3"):
+        _beat(w, now[0])
+    verdicts = dog.check()
+    assert verdicts["w/0"] == verdicts["w/1"] == LOST
+    # loss REPORTING is immediate (the master must requeue now) ...
+    assert dog.lost_workers() == ["w/0", "w/1"]
+    # ... but the attribution is ONE host event, zero worker events
+    assert dog.lost_hosts() == ["host-A"]
+    assert _kinds() == ["host_lost"]
+    ev = flight.default_recorder().events()[0]
+    assert ev["host"] == "host-A" and ev["workers"] == ["w/0", "w/1"]
+    assert seen == [("host-A", ["w/0", "w/1"])]
+    log = dog.host_lost_events()
+    assert len(log) == 1 and log[0]["host"] == "host-A"
+    # repeated checks do not re-emit
+    dog.check()
+    assert _kinds() == ["host_lost"]
+
+
+def test_partial_host_loss_emits_individual_after_window():
+    flight.reset_default()
+    now = [100.0]
+    dog = _dog(now, host_window=10.0)
+    for w in HOSTS:
+        _beat(w, now[0])
+    dog.check()
+    # only w/2 goes stale; w/3 keeps beating
+    now[0] = 130.0
+    for w in ("w/0", "w/1", "w/3"):
+        _beat(w, now[0])
+    dog.check()
+    assert dog.lost_workers() == ["w/2"]
+    assert _kinds() == []  # deferred while host-B's fate resolves
+    # window passes without the host completing -> individual event
+    now[0] = 141.0
+    for w in ("w/0", "w/1", "w/3"):
+        _beat(w, now[0])
+    dog.check()
+    assert _kinds() == ["worker_lost"]
+    assert dog.lost_hosts() == []
+
+
+def test_unmapped_worker_loss_is_immediate():
+    flight.reset_default()
+    now = [100.0]
+    dog = Watchdog(EXP, TRIAL, ["solo/0"], timeout=10.0, grace=5.0,
+                   poll_interval=0.0, clock=lambda: now[0],
+                   host_of=lambda w: None)
+    _beat("solo/0", now[0])
+    dog.check()
+    now[0] = 130.0
+    dog.check()
+    assert _kinds() == ["worker_lost"]
+
+
+def test_host_flap_recovery_rearms_attribution():
+    flight.reset_default()
+    now = [100.0]
+    dog = _dog(now)
+    for w in HOSTS:
+        _beat(w, now[0])
+    dog.check()
+    now[0] = 130.0
+    for w in ("w/2", "w/3"):
+        _beat(w, now[0])
+    dog.check()
+    assert dog.lost_hosts() == ["host-A"]
+    # one member returns: the host is back in play
+    now[0] = 135.0
+    _beat("w/0", now[0])
+    for w in ("w/2", "w/3"):
+        _beat(w, now[0])
+    dog.check()
+    assert dog.lost_hosts() == []
+    # history survives the flap
+    assert len(dog.host_lost_events()) == 1
+
+
+# ----------------------------------------------------------------------
+def test_worker_server_publishes_host_id(monkeypatch):
+    monkeypatch.setenv(cluster.HOST_ID_ENV, "host-0042")
+    assert cluster.current_host_id() == "host-0042"
+    from realhf_tpu.system.worker_base import WorkerServer
+
+    srv = WorkerServer(EXP, TRIAL, "mw/7", heartbeat_interval=60.0)
+    try:
+        assert srv.host_id == "host-0042"
+        assert name_resolve.get(
+            names.worker_host(EXP, TRIAL, "mw/7")) == "host-0042"
+        from realhf_tpu.system.pod import name_resolve_host_lookup
+        lookup = name_resolve_host_lookup(EXP, TRIAL)
+        assert lookup("mw/7") == "host-0042"
+        assert lookup("mw/99") is None
+    finally:
+        srv.stop_heartbeat()
+
+
+def test_worker_server_no_host_outside_pod(monkeypatch):
+    monkeypatch.delenv(cluster.HOST_ID_ENV, raising=False)
+    from realhf_tpu.system.worker_base import WorkerServer
+
+    srv = WorkerServer(EXP, TRIAL, "mw/8", heartbeat_interval=60.0)
+    try:
+        assert srv.host_id is None
+        with pytest.raises(name_resolve.NameEntryNotFoundError):
+            name_resolve.get(names.worker_host(EXP, TRIAL, "mw/8"))
+    finally:
+        srv.stop_heartbeat()
+
+
+# ----------------------------------------------------------------------
+def test_exclusion_book_coalesces_host_losses():
+    now = [0.0]
+    book = ExclusionBook(base=10.0, jitter=0.0,
+                         clock=lambda: now[0], host_of=HOSTS.get,
+                         coalesce_secs=5.0)
+    d0 = book.exclude("w/0")
+    assert d0 == 10.0
+    # sibling casualty of the same host within the coalesce window:
+    # SAME failure event -- no loss-count bump, shared window
+    now[0] = 1.0
+    book.exclude("w/1")
+    assert book.loss_count("w/0") == book.loss_count("w/1") == 1
+    # every worker of the host shares the exclusion
+    assert book.is_excluded("w/0") and book.is_excluded("w/1")
+    assert not book.is_excluded("w/2")  # other host untouched
+    assert book.excluded() == ["host-A"]
+    # a SECOND failure past the coalesce window backs off exponentially
+    now[0] = 20.0
+    assert not book.is_excluded("w/0")
+    assert book.exclude("w/1") == 20.0
+    assert book.loss_count("w/0") == 2
+    # forgiving any member forgives the host
+    book.forgive("w/0")
+    assert book.loss_count("w/1") == 0
+    assert not book.is_excluded("w/1")
+
+
+def test_exclusion_book_unmapped_workers_unchanged():
+    now = [0.0]
+    book = ExclusionBook(base=5.0, jitter=0.0, clock=lambda: now[0])
+    book.exclude("x/0")
+    book.exclude("x/0")
+    assert book.loss_count("x/0") == 2  # no coalescing without hosts
+    assert book.excluded() == ["x/0"]
+
+
+# ----------------------------------------------------------------------
+def test_buffer_invalidate_outputs_forces_recompute():
+    from realhf_tpu.api.data import SequenceSample
+    from realhf_tpu.system.buffer import SequenceBuffer
+
+    def meta(keys, ids):
+        return SequenceSample(
+            keys=list(keys), trailing_shapes={k: () for k in keys},
+            dtypes={k: np.int32 for k in keys}, ids=list(ids),
+            seqlens={k: [[4] for _ in ids] for k in keys})
+
+    buf = SequenceBuffer(["gen", "train"], capacity=2)
+    bid = buf.put_batch(meta(["prompts"], ["a", "b"]), "mw/0", 0, False)
+    buf.mark_dispatched(bid, "gen")
+    buf.amend_batch(bid, meta(["tokens"], ["a", "b"]), "mw/1", "gen")
+    # train is ready: gen's outputs are present
+    assert (bid, "train") in [
+        t for t in buf.ready_mfcs({"gen": ("prompts",),
+                                   "train": ("tokens",)})]
+    # mw/1 dies without grace: its outputs are gone
+    buf.invalidate_outputs(bid, "gen", ["tokens"])
+    e = buf.get(bid)
+    assert "gen" not in e.completed and "gen" not in e.dispatched
+    assert "tokens" not in e.key_owner and "tokens" not in e.meta.keys
+    ready = buf.ready_mfcs({"gen": ("prompts",), "train": ("tokens",)})
+    # the producer recomputes; the consumer waits for it
+    assert (bid, "gen") in ready and (bid, "train") not in ready
+
+
+# ----------------------------------------------------------------------
+def test_grow_advisor_emits_after_streak_with_cooldown():
+    from realhf_tpu.system.elastic import GrowAdvisor
+
+    flight.reset_default()
+    now = [0.0]
+    adv = GrowAdvisor(threshold=2, consecutive=3, cooldown_secs=30.0,
+                      clock=lambda: now[0])
+    assert not adv.observe(5) and not adv.observe(5)
+    assert adv.observe(5, server="s/0")  # third consecutive breach
+    assert adv.suggestions == 1
+    ev = [e for e in flight.default_recorder().events()
+          if e["kind"] == "elastic_grow_suggestion"]
+    assert len(ev) == 1 and ev[0]["queue_depth"] == 5 \
+        and ev[0]["threshold"] == 2 and ev[0]["server"] == "s/0"
+    # cooldown suppresses while the breach persists ...
+    assert not (adv.observe(9) or adv.observe(9) or adv.observe(9))
+    # ... and a sustained breach re-emits the moment it expires
+    now[0] = 31.0
+    assert adv.observe(9)
+    assert adv.suggestions == 2
+    # a dip resets the streak
+    assert not adv.observe(1)
+    assert adv._streak == 0
+
+
+def test_grow_advisor_disabled_and_below_threshold():
+    from realhf_tpu.system.elastic import GrowAdvisor
+
+    off = GrowAdvisor(threshold=0)
+    assert not any(off.observe(10 ** 6) for _ in range(10))
+    adv = GrowAdvisor(threshold=8, consecutive=1)
+    assert not adv.observe(8)  # boundary: depth must EXCEED
+    assert adv.observe(9)
+
+
+# ----------------------------------------------------------------------
+def _beat_boot(worker, ts, boot):
+    name_resolve.add(names.worker_heartbeat(EXP, TRIAL, worker),
+                     f"{ts:.3f}:{boot}", replace=True)
+
+
+def test_fast_relaunch_is_a_loss_edge_then_recovers():
+    """Incarnation fencing: a worker relaunched FASTER than the
+    staleness timeout (fresh beat, new boot id) is reported as a
+    one-check loss edge -- its predecessor's in-flight work died with
+    it -- and flap-recovers on the next check."""
+    flight.reset_default()
+    now = [100.0]
+    dog = Watchdog(EXP, TRIAL, ["solo/0"], timeout=10.0, grace=5.0,
+                   poll_interval=0.0, clock=lambda: now[0])
+    _beat_boot("solo/0", now[0], "boot-a")
+    assert dog.check()["solo/0"] == ALIVE
+    # new incarnation beats BEFORE the old beat ever went stale
+    now[0] = 103.0
+    _beat_boot("solo/0", now[0], "boot-b")
+    v = dog.check()
+    assert v["solo/0"] == ALIVE          # the successor is healthy...
+    assert dog.lost_workers() == ["solo/0"]  # ...but the edge fired
+    ev = [e for e in flight.default_recorder().events()
+          if e["kind"] == "worker_lost"]
+    assert len(ev) == 1 and ev[0]["reason"] == "relaunched"
+    # next check: flap recovery; same boot id never re-fires
+    now[0] = 104.0
+    dog.check()
+    assert dog.lost_workers() == []
+    now[0] = 105.0
+    dog.check()
+    assert dog.lost_workers() == []
+
+
+def test_fast_host_relaunch_attributes_host_lost():
+    """Both workers of a host relaunching under the staleness timeout
+    (a preempted VM coming straight back) still yields ONE HOST_LOST
+    attribution."""
+    flight.reset_default()
+    now = [100.0]
+    dog = _dog(now)
+    for w in HOSTS:
+        _beat_boot(w, now[0], f"{w}-boot1")
+    dog.check()
+    now[0] = 102.0
+    for w in ("w/0", "w/1"):
+        _beat_boot(w, now[0], f"{w}-boot2")  # host-A came back fast
+    for w in ("w/2", "w/3"):
+        _beat_boot(w, now[0], f"{w}-boot1")
+    dog.check()
+    assert dog.lost_hosts() == ["host-A"]
+    assert [e["kind"] for e in flight.default_recorder().events()] \
+        == ["host_lost"]
+    log = dog.host_lost_events()
+    assert len(log) == 1 and log[0]["workers"] == ["w/0", "w/1"]
+    # recovery on the next sweep
+    now[0] = 103.0
+    dog.check()
+    assert dog.lost_workers() == [] and dog.lost_hosts() == []
+
+
+def test_legacy_plain_ts_beats_never_fence():
+    now = [100.0]
+    dog = Watchdog(EXP, TRIAL, ["solo/1"], timeout=10.0, grace=5.0,
+                   poll_interval=0.0, clock=lambda: now[0])
+    _beat("solo/1", now[0])
+    dog.check()
+    now[0] = 105.0
+    _beat("solo/1", now[0])  # still no boot id
+    dog.check()
+    assert dog.lost_workers() == []

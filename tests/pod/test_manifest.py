@@ -1,0 +1,195 @@
+"""Pod manifest generation (system/pod.py): determinism, host
+assignment, scheduler round-trip, scrape targets, CLI, and the
+PodController's submit-retry / bring-up-deadline supervision."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from realhf_tpu.base import name_resolve, names
+from realhf_tpu.base.cluster import HOST_ID_ENV
+from realhf_tpu.base.retry import RetryPolicy
+from realhf_tpu.system import pod
+from realhf_tpu.system.scheduler import JobInfo, JobState, SchedulerClient
+
+
+def _build(**kw):
+    args = dict(n_hosts=2, n_model_workers=3)
+    args.update(kw)
+    return pod.build_pod_manifest("exp", "t0", **args)
+
+
+def test_manifest_deterministic_bytes():
+    a = _build(n_chips_per_host=4).to_json()
+    b = _build(n_chips_per_host=4).to_json()
+    assert a == b
+    # byte-stable across a json round-trip too (sorted keys, no
+    # timestamps): committable / diffable
+    m = pod.PodManifest.from_json(a)
+    assert m.to_json() == a
+
+
+def test_manifest_contiguous_assignment_and_env():
+    m = _build(n_hosts=2, n_model_workers=4, n_chips_per_host=8)
+    # master controller-adjacent on host 0; model workers in
+    # contiguous blocks (pod-slice shape)
+    assert m.host_of("master_worker/0") == "host-0000"
+    assert m.host_of("model_worker/0") == "host-0000"
+    assert m.host_of("model_worker/1") == "host-0000"
+    assert m.host_of("model_worker/2") == "host-0001"
+    assert m.host_of("model_worker/3") == "host-0001"
+    assert m.host_of("model_worker/99") is None
+    for h in m.hosts:
+        assert h.env[HOST_ID_ENV] == h.host_id
+        assert h.env["REALHF_TPU_LOCAL_DEVICE_COUNT"] == "8"
+    # distinct per-host scrape ports
+    assert len({h.scrape_port for h in m.hosts}) == m.n_hosts
+
+
+def test_manifest_assignment_override_and_validation():
+    m = _build(assignment={"model_worker/1": "host-0001"})
+    assert m.host_of("model_worker/1") == "host-0001"
+    with pytest.raises(ValueError, match="unknown workers"):
+        _build(assignment={"model_worker/77": "host-0000"})
+
+
+def test_manifest_round_trips_through_scheduler(tmp_path):
+    m = _build(n_hosts=3, n_model_workers=5)
+    sched = pod.MultiHostLocalScheduler(manifest=m)
+    try:
+        # host mapping agrees with the manifest for every worker
+        for w in m.workers:
+            assert sched.host_of(w) == m.host_of(w)
+        assert sched.hosts() == sorted(h.host_id for h in m.hosts)
+        # submission injects the host env namespace
+        sched.submit("model_worker/4", ["sleep", "0"])
+        _cmd, env = sched._specs["model_worker/4"]
+        assert env[HOST_ID_ENV] == m.host_of("model_worker/4")
+        assert "model_worker/4" in sched.workers_on(
+            m.host_of("model_worker/4"))
+    finally:
+        sched.stop_all(grace=0.5)
+
+
+def test_scrape_targets_file(tmp_path):
+    m = _build(n_hosts=2, n_model_workers=2)
+    path = str(tmp_path / "targets.json")
+    assert pod.write_scrape_targets(
+        m.hosts, path, labels=dict(experiment="exp")) == path
+    entries = json.loads(open(path).read())
+    assert [e["labels"]["host"] for e in entries] == \
+        ["host-0000", "host-0001"]
+    assert entries[0]["targets"] == ["127.0.0.1:9100"]
+    assert entries[1]["targets"] == ["127.0.0.1:9101"]
+    assert all(e["labels"]["experiment"] == "exp" for e in entries)
+    # deterministic output
+    first = open(path).read()
+    pod.write_scrape_targets(m.hosts, path, labels=dict(experiment="exp"))
+    assert open(path).read() == first
+
+
+def test_cli_round_trips_deterministically(tmp_path, capsys):
+    from realhf_tpu.apps.main import pod_manifest_main
+
+    out = str(tmp_path / "m.json")
+    scrape = str(tmp_path / "s.json")
+    argv = ["--experiment_name", "exp", "--trial_name", "t0",
+            "--n_hosts", "2", "--n_model_workers", "3",
+            "--n_chips_per_host", "4", "--out", out,
+            "--scrape_out", scrape]
+    assert pod_manifest_main(argv) == 0
+    text1 = open(out).read()
+    assert pod_manifest_main(argv) == 0
+    assert open(out).read() == text1  # byte-identical rerun
+    m = pod.PodManifest.from_json(text1)
+    assert m.to_json() == _build(n_chips_per_host=4).to_json()
+    assert len(json.loads(open(scrape).read())) == 2
+    # '-' prints the same bytes to stdout
+    assert pod_manifest_main(argv[:-4] + ["--out", "-"]) == 0
+    assert capsys.readouterr().out == text1
+    # round-trip into the emulator
+    sched = pod.MultiHostLocalScheduler(manifest=m)
+    assert sched.host_of("model_worker/2") == m.host_of("model_worker/2")
+
+
+# ----------------------------------------------------------------------
+class FlakySched(SchedulerClient):
+    """Fails the first ``fail`` submits with OSError, then records."""
+
+    def __init__(self, fail=0):
+        self.fail = fail
+        self.submitted = []
+
+    def submit(self, name, cmd, env=None):
+        if self.fail > 0:
+            self.fail -= 1
+            raise OSError("transient fork failure")
+        self.submitted.append((name, list(cmd), dict(env or {})))
+
+    def find(self, name):
+        return JobInfo(name, JobState.RUNNING)
+
+    def stop_all(self, grace=10.0):
+        pass
+
+
+def test_controller_submit_retries_transient_failures():
+    sched = FlakySched(fail=2)
+    ctl = pod.PodController(sched, submit_retry=RetryPolicy(
+        max_attempts=3, base_delay=0.001, max_delay=0.01))
+    ctl.submit("model_worker/0", ["x"], env={"A": "1"})
+    assert [s[0] for s in sched.submitted] == ["model_worker/0"]
+
+    sched2 = FlakySched(fail=3)
+    ctl2 = pod.PodController(sched2, submit_retry=RetryPolicy(
+        max_attempts=3, base_delay=0.001, max_delay=0.01))
+    with pytest.raises(OSError):
+        ctl2.submit("model_worker/0", ["x"])
+
+
+def test_controller_bringup_deadline_names_missing_by_host():
+    m = _build(n_hosts=2, n_model_workers=2)
+    sched = pod.MultiHostLocalScheduler(manifest=m)
+    ctl = pod.PodController(sched)
+    # only host-0000's workers registered their endpoints
+    for w in ("master_worker/0", "model_worker/0"):
+        name_resolve.add(names.worker_key("exp", "t0", w), "tcp://x",
+                         replace=True)
+    with pytest.raises(pod.PodBringupError) as ei:
+        ctl.wait_ready("exp", "t0", m.workers, deadline=0.05,
+                       poll_interval=0.01)
+    assert ei.value.missing_by_host == {
+        "host-0001": ["model_worker/1"]}
+    assert "host-0001" in str(ei.value)
+    # once everyone registers, wait_ready returns
+    name_resolve.add(names.worker_key("exp", "t0", "model_worker/1"),
+                     "tcp://y", replace=True)
+    ctl.wait_ready("exp", "t0", m.workers, deadline=1.0,
+                   poll_interval=0.01)
+
+
+def test_controller_single_host_fallback(tmp_path):
+    """Over a plain scheduler the controller degrades to one synthetic
+    host and still writes a scrape-target file."""
+    sched = FlakySched()
+    ctl = pod.PodController(sched)
+    assert not ctl.multi_host
+    ctl.submit("model_worker/0", ["x"])
+    assert ctl.hosts() == ["host-0000"]
+    assert ctl.host_of("model_worker/0") == "host-0000"
+    path = ctl.write_scrape_targets(path=str(tmp_path / "s.json"))
+    assert path and json.loads(open(path).read())[0]["labels"][
+        "host"] == "host-0000"
+
+
+def test_make_scheduler_multihost_mode():
+    from realhf_tpu.system.scheduler import make_scheduler
+
+    sched = make_scheduler("multihost_local", n_hosts=3)
+    assert isinstance(sched, pod.MultiHostLocalScheduler)
+    assert sched.n_hosts == 3
+    # count-free fallback: round-robin by index, controller types on 0
+    assert sched.host_of("master_worker/0") == "host-0000"
+    assert sched.host_of("model_worker/4") == "host-0001"

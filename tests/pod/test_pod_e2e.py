@@ -1,0 +1,248 @@
+"""Pod-scale controller path, end-to-end over real OS processes.
+
+Tier-1 keeps to the cheap process-group mechanics of the emulated
+hosts (kill_host takes the whole host down at once; resubmit keeps a
+worker's host). The full 2-host PPO drill -- SIGKILL one emulated
+host mid-trial -> single HOST_LOST attribution -> elastic degrade
+around the missing host -> rejoin -> re-expand -> merged obs
+artifacts -- is ``slow``-marked (ISSUE 9 acceptance; run directly:
+``pytest -m slow tests/pod/test_pod_e2e.py``)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "system"))
+from tiny_model import TINY, write_jsonl  # noqa: E402
+
+from realhf_tpu.base.cluster import HOST_ID_ENV  # noqa: E402
+from realhf_tpu.system import pod  # noqa: E402
+
+WORKER_ENV = {
+    "REALHF_TPU_BACKEND": "cpu",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": "/root/repo",
+    "REALHF_TPU_TRACE": "1",
+}
+
+
+def _wait_state(sched, name, states, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        info = sched.find(name)
+        if info.state.value in states:
+            return info
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{name} never reached {states}: {sched.find(name)}")
+
+
+def test_kill_host_takes_down_whole_process_group():
+    sched = pod.MultiHostLocalScheduler(
+        n_hosts=2, assign={"a/0": "host-0000", "b/0": "host-0001",
+                           "b/1": "host-0001"})
+    try:
+        for n in ("a/0", "b/0", "b/1"):
+            sched.submit(n, ["sleep", "30"])
+        for n in ("a/0", "b/0", "b/1"):
+            assert sched.find(n).state.value == "RUNNING"
+        killed = sched.kill_host("host-0001")
+        assert killed == ["b/0", "b/1"]
+        # the whole emulated VM dies at once; the other host survives
+        for n in ("b/0", "b/1"):
+            assert _wait_state(sched, n, ("FAILED",)).returncode != 0
+        assert sched.find("a/0").state.value == "RUNNING"
+        # resubmit (the launcher's elastic-rejoin primitive) keeps the
+        # worker on its host, env included
+        sched.resubmit("b/0")
+        assert sched.find("b/0").state.value == "RUNNING"
+        assert sched._specs["b/0"][1][HOST_ID_ENV] == "host-0001"
+        assert sched.host_of("b/0") == "host-0001"
+        # resubmit_host relaunches the remaining dead job only
+        assert sched.resubmit_host("host-0001") == ["b/1"]
+        assert sched.find("b/1").state.value == "RUNNING"
+    finally:
+        sched.stop_all(grace=0.5)
+
+
+def test_kill_host_unknown_or_idle_host_is_noop():
+    sched = pod.MultiHostLocalScheduler(n_hosts=2)
+    assert sched.kill_host("host-0001") == []
+    assert sched.kill_host("no-such-host") == []
+
+
+# ----------------------------------------------------------------------
+@pytest.fixture
+def prompt_data(tmp_path):
+    rng = np.random.default_rng(1)
+    path = tmp_path / "prompts.jsonl"
+    # one epoch covers the whole trial: sample ids repeat across
+    # epochs, and with max_concurrent_batches > 1 an epoch boundary
+    # lets a finishing batch's clear_data_cache delete an id an
+    # in-flight next-epoch batch still needs (pre-existing runtime
+    # limitation, noted in ROADMAP item 1's buffer-granularity work)
+    write_jsonl(path, [
+        {"id": i,
+         "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
+        for i in range(160)])
+    return str(path)
+
+
+@pytest.mark.slow
+def test_pod_host_loss_degrade_rejoin_e2e(prompt_data, tmp_path,
+                                          monkeypatch):
+    """ISSUE 9 acceptance: a 2-host emulated pod runs PPO with ref_inf
+    and rew_inf placed on host-0001; SIGKILL that host mid-trial. The
+    watchdog attributes ONE HOST_LOST for its two workers, the elastic
+    planner degrades both MFCs onto the surviving host without
+    re-consuming data (exact global_step), the relaunched host rejoins
+    and re-expands to the original layout, and teardown leaves a
+    merged trace spanning both hosts, a merged flight dump recording
+    the host loss, and the per-host Prometheus scrape-target file."""
+    from realhf_tpu.api.experiment import (
+        FaultToleranceConfig,
+        MFCAllocation,
+    )
+    from realhf_tpu.apps.main import run_trial
+    from realhf_tpu.base import constants, name_resolve, names
+    from realhf_tpu.base.testing import IntegerTokenizer
+    from realhf_tpu.engine.optim import OptimizerConfig
+    from realhf_tpu.experiments.common import apply_overrides
+    from realhf_tpu.experiments.ppo_exp import PPOConfig
+    from realhf_tpu.parallel.mesh import ParallelismConfig
+
+    monkeypatch.setenv("REALHF_TPU_TRACE", "1")  # launcher-side merge
+    exp, trial = "pode2e", "t0"
+    cfg = PPOConfig(experiment_name=exp, trial_name=trial,
+                    total_train_epochs=1, benchmark_steps=16)
+    apply_overrides(cfg, {
+        "dataset.path": prompt_data,
+        "dataset.train_bs_n_seqs": "8",
+        "dataset.max_seqlen": "16",
+        "ppo.max_new_tokens": "8",
+        "ppo.min_new_tokens": "1",
+        "ppo.top_k": "16",
+        "ppo.ppo_n_minibatches": "2",
+    })
+    spec = cfg.build()
+    for _role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        mspec.parallel = ParallelismConfig(data_parallel_size=2)
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=1e-3, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = IntegerTokenizer()
+    spec.n_model_workers = 3
+    # every primary (and the data owner, actor_gen's leader) on
+    # worker 0 / host-0000; the two migratable inference MFCs on the
+    # doomed host-0001
+    spec.worker_assignment = {"actor": 0, "critic": 0, "ref": 0,
+                              "reward": 0}
+    spec.allocations = dict(
+        spec.allocations,
+        ref_inf=MFCAllocation(ParallelismConfig(data_parallel_size=2),
+                              workers=[1]),
+        rew_inf=MFCAllocation(ParallelismConfig(data_parallel_size=2),
+                              workers=[2]))
+    spec.ft = FaultToleranceConfig(
+        heartbeat_interval=0.5, heartbeat_timeout=8.0,
+        watchdog_poll_secs=0.5, elastic_degrade=True,
+        elastic_rejoin=True, worker_lost_fatal_secs=120.0,
+        gather_timeout_secs=300.0, host_lost_window_secs=20.0)
+    assert spec.is_cross_group("ref_inf", "ref")
+    assert spec.is_cross_group("rew_inf", "reward")
+
+    manifest = pod.build_pod_manifest(
+        exp, trial, n_hosts=2, n_model_workers=3,
+        assignment={"model_worker/1": "host-0001",
+                    "model_worker/2": "host-0001"})
+    assert manifest.host_of("model_worker/0") == "host-0000"
+    assert manifest.host_of("master_worker/0") == "host-0000"
+    sched = pod.MultiHostLocalScheduler(manifest=manifest)
+
+    killed = {}
+
+    def _killer():
+        # SIGKILL the emulated host once training has made progress
+        # (>= 2 finished batches: both doomed MFCs proved they run on
+        # host-0001 first)
+        end = time.monotonic() + 900
+        while time.monotonic() < end:
+            try:
+                if int(name_resolve.get(names.train_progress(
+                        exp, trial))) >= 2:
+                    break
+            except Exception:  # noqa: BLE001 - not published yet
+                pass
+            time.sleep(0.5)
+        else:
+            return
+        killed["jobs"] = sched.kill_host("host-0001")
+        killed["at_step"] = int(name_resolve.get(
+            names.train_progress(exp, trial)))
+
+    killer = threading.Thread(target=_killer, daemon=True)
+    killer.start()
+    out = run_trial(spec, env=dict(WORKER_ENV), timeout=1800,
+                    sched=sched)
+    killer.join(timeout=10)
+
+    # the kill really happened, mid-trial
+    assert sorted(killed["jobs"]) == ["model_worker/1",
+                                      "model_worker/2"]
+    assert 2 <= killed["at_step"] < 16
+    # no data re-consumption across the host loss: exact step count
+    assert out["complete"]
+    assert out["global_step"] == 16
+    assert np.isfinite(out["stats"]["actor_train"]["actor_loss"])
+
+    # ONE HOST_LOST attribution for the host's two workers
+    assert len(out["host_lost"]) == 1
+    assert out["host_lost"][0]["host"] == "host-0001"
+    assert out["host_lost"][0]["workers"] == ["model_worker/1",
+                                              "model_worker/2"]
+
+    # the doomed MFCs ran on host-0001 first, then on the survivor
+    rows = {m: sorted((r["bid"], r["worker"]) for r in out["exec_log"]
+                      if r["mfc"] == m)
+            for m in ("ref_inf", "rew_inf")}
+    assert rows["ref_inf"][0][1] == "model_worker/1"
+    assert rows["rew_inf"][0][1] == "model_worker/2"
+    assert "model_worker/0" in {w for _b, w in rows["ref_inf"]}
+    assert "model_worker/0" in {w for _b, w in rows["rew_inf"]}
+    # rejoin re-expanded to the original layout: the relaunched host
+    # served its MFCs again for later batches
+    reexpanded = [m for m in ("ref_inf", "rew_inf")
+                  if rows[m][-1][1] != "model_worker/0"]
+    assert reexpanded, (
+        "no MFC returned to host-0001 after rejoin: "
+        f"{rows}")
+
+    # teardown obs artifacts
+    log_dir = constants.run_log_path(exp, trial)
+    merged_trace = os.path.join(log_dir, "obs", "trace",
+                                "merged_trace.json")
+    assert os.path.exists(merged_trace)
+    pids = {e.get("pid") for e in
+            json.load(open(merged_trace))["traceEvents"]}
+    assert len(pids) >= 3  # master + workers from BOTH hosts
+    merged_flight = os.path.join(log_dir, "obs", "flight",
+                                 "merged_flight.json")
+    assert os.path.exists(merged_flight)
+    fl = json.load(open(merged_flight))
+    assert any(e["kind"] == "host_lost" and e["host"] == "host-0001"
+               for e in fl["events"])
+    scrape = os.path.join(log_dir, "obs", "scrape_targets.json")
+    entries = json.load(open(scrape))
+    assert [e["labels"]["host"] for e in entries] == \
+        ["host-0000", "host-0001"]

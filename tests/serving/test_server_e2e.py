@@ -374,3 +374,37 @@ def test_backpressure_over_the_wire(params):
     finally:
         c.close()
         server.close()
+
+
+def test_grow_advisor_wired_into_serve_loop():
+    """Sustained queue depth above the autoscale threshold emits a
+    log-only ElasticPlanner grow suggestion (counter + flight event)
+    from the serve loop itself (ISSUE 9 satellite; the GrowAdvisor
+    unit behavior lives in tests/pod/test_host_domains.py)."""
+    from realhf_tpu.base.testing import FakeSlotBackend
+    from realhf_tpu.obs import flight, metrics
+    from realhf_tpu.system.elastic import GrowAdvisor
+
+    flight.reset_default()
+    adv = GrowAdvisor(threshold=1, consecutive=2, cooldown_secs=0.0)
+    server = RolloutServer(
+        FakeSlotBackend(n_slots=1, chunk=4), server_name="adv/0",
+        queue=RequestQueue(max_depth=16, n_slots=1),
+        grow_advisor=adv, seed=0)
+    c = RolloutClient(server.address)
+    try:
+        rids = [c.submit(p, ttl=300.0) for p in _prompts(5)]
+        for _ in range(30):
+            server.serve_step(poll_timeout=0.002)
+            if adv.suggestions:
+                break
+        assert adv.suggestions >= 1
+        assert metrics.default_registry().counter(
+            "elastic_grow_suggested_total").value(server="adv/0") >= 1
+        assert any(e["kind"] == "elastic_grow_suggestion"
+                   and e["server"] == "adv/0"
+                   for e in flight.default_recorder().events())
+        assert rids  # requests still progress normally afterwards
+    finally:
+        c.close()
+        server.close()

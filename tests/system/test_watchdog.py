@@ -43,12 +43,21 @@ def test_worker_server_publishes_heartbeat():
     server = WorkerServer(EXP, TRIAL, "hb/0", heartbeat_interval=0.05)
     try:
         key = names.worker_heartbeat(EXP, TRIAL, "hb/0")
-        t0 = float(name_resolve.get(key))
+
+        def read():
+            # beat format: "<wall-ts>:<boot-id>" (incarnation fence)
+            ts_s, _, boot = str(name_resolve.get(key)).partition(":")
+            return float(ts_s), boot
+
+        t0, boot0 = read()
         assert abs(time.time() - t0) < 5.0
+        assert boot0 == server.boot_id
         deadline = time.time() + 5.0
-        while float(name_resolve.get(key)) == t0:
+        while read()[0] == t0:
             assert time.time() < deadline, "heartbeat never refreshed"
             time.sleep(0.02)
+        # the boot id is stable across beats of one incarnation
+        assert read()[1] == boot0
     finally:
         server.stop_heartbeat()
 
