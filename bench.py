@@ -728,6 +728,29 @@ def _bench_pipeline_schedules():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def _bench_async():
+    """Sync-vs-async PPO throughput in a CPU-forced subprocess
+    (scripts/bench_async.py): the ISSUE-10 overlap harness -- steps/s
+    both ways through the same RolloutServer + per-sample buffer,
+    rollout-idle fraction, staleness histogram, clipped-IS stats."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_async.py")
+    r = subprocess.run(
+        [sys.executable, script, "--steps", "4"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench_async exited {r.returncode}: {r.stderr[-500:]}")
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    # the per-step curves matter for the e2e, not the payload record
+    out.pop("sync_curve", None)
+    out.pop("async_curve", None)
+    return out
+
+
 def _bench_serving_hotpath():
     """Serving hot-path load bench in a CPU-forced subprocess
     (scripts/bench_serving.py): shared-prefix vs disjoint traffic
@@ -843,6 +866,17 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort phase
         extra["serving_bench"] = {"error": repr(e)}
     phases_done.append("serving_bench")
+    _flush_payload(headline, extra, phases_done)
+
+    # Async RLHF overlap (ISSUE 10): generation streaming into the
+    # per-sample buffer while training drains it off-policy -- the
+    # backend-independent signals are async steps/s >= sync and the
+    # staleness histogram.
+    try:
+        extra["async_bench"] = _bench_async()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["async_bench"] = {"error": repr(e)}
+    phases_done.append("async_bench")
     _flush_payload(headline, extra, phases_done)
 
     # Reshard + cross-group sync (north-star metric): best-effort on

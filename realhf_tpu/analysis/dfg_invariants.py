@@ -11,9 +11,15 @@ model rests on:
   validated.
 - ``dfg-cycle`` / ``dfg-duplicate-key``: graph resolution errors
   (cyclic MFC dependencies, two producers for one data key).
-- ``dfg-batch-mismatch``: a producer/consumer edge whose ``n_seqs``
-  don't divide -- the consumer cannot split the producer's batch into
-  whole per-DP-shard groups.
+- ``dfg-batch-mismatch``: an MFC's ``n_seqs`` violates the per-sample
+  buffer contract. Producer and consumer n_seqs need only SHARE
+  samples (the buffer assembles each MFC's batch from ready samples,
+  spanning dataset batches), so the old pairwise-divisibility rule is
+  gone; what must still hold is (a) every ``n_seqs`` > 0 and (b) no
+  MFC asks for more samples than the buffer window can ever hold at
+  once (``max_concurrent_batches * source n_seqs``) -- such an MFC
+  could never assemble a full batch and would deadlock the dispatch
+  loop short of the end-of-data flush.
 - ``dfg-mesh-mismatch``: two MFCs placed on the SAME worker group
   whose layouts multiply to different world sizes -- a group has a
   fixed device count, so all layouts on it must use all of it.
@@ -83,15 +89,31 @@ def validate_spec(name: str, spec, path: str, line: int
                 else "dfg-build-failed")
         return [finding(code, f"graph resolution failed: {e}")]
 
-    # --- per-edge batch-size compatibility -----------------------------
-    for u, v, data in sorted(G.edges(data=True)):
-        nu, nv = G.nodes[u]["object"], G.nodes[v]["object"]
-        a, b = nu.n_seqs, nv.n_seqs
-        if a <= 0 or b <= 0 or max(a, b) % min(a, b) != 0:
+    # --- per-MFC n_seqs vs the per-sample buffer contract ---------------
+    # (system/buffer.py): any positive n_seqs combination flows --
+    # assemblies span dataset batches -- but an MFC whose n_seqs
+    # exceeds the buffer window (capacity * source n_seqs samples)
+    # can never assemble a full batch.
+    sources = [n for n in spec.mfcs
+               if not any(k in G.graph["data_producers"]
+                          for k in n.input_keys)]
+    src_n = min((n.n_seqs for n in sources), default=0)
+    window = max(1, getattr(spec, "max_concurrent_batches", 1)) * src_n
+    for node in spec.mfcs:
+        if node.n_seqs <= 0:
             findings.append(finding(
                 "dfg-batch-mismatch",
-                f"edge {u}->{v} (key `{data.get('key')}`): producer "
-                f"n_seqs={a} and consumer n_seqs={b} do not divide"))
+                f"MFC `{node.name}`: n_seqs={node.n_seqs} must be "
+                "positive"))
+        elif window > 0 and node.n_seqs > window:
+            findings.append(finding(
+                "dfg-batch-mismatch",
+                f"MFC `{node.name}`: n_seqs={node.n_seqs} exceeds the "
+                f"buffer window of {window} samples "
+                f"(max_concurrent_batches="
+                f"{getattr(spec, 'max_concurrent_batches', 1)} x "
+                f"source n_seqs={src_n}) -- it can never assemble a "
+                "full batch"))
 
     # --- allocations name real MFCs, normalize cleanly -----------------
     node_names = {n.name for n in spec.mfcs}

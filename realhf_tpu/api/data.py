@@ -242,7 +242,7 @@ class SequenceSample:
     _KEYS_LEN_MINUS_1 = {
         "packed_logprobs", "logprobs", "packed_ref_logprobs", "ref_logprobs",
         "old_logp", "ref_logp", "advantages", "ppo_loss_mask", "kl_rewards",
-        "returns",
+        "returns", "staleness",
     }
 
     @classmethod
@@ -290,6 +290,35 @@ class SequenceSample:
     def __repr__(self):
         return (f"SequenceSample(bs={self.bs}, keys={sorted(self.keys)}, "
                 f"meta_only={self.data is None})")
+
+
+def epoch_qualified(batch: "SequenceSample", epoch: int
+                    ) -> "SequenceSample":
+    """A view of ``batch`` whose ids are ``(epoch, raw_id)`` tuples.
+
+    Dataset sample ids REPEAT across epochs, so raw ids cannot key the
+    data plane once batches of consecutive epochs are live at the same
+    time (``max_concurrent_batches > 1``): a finishing batch's
+    ``clear_data_cache`` would delete an id an in-flight next-epoch
+    batch still needs, and a per-sample assembly spanning the epoch
+    boundary would hold duplicate ids. Qualification happens once, at
+    the data owner's fetch reply; everything downstream (stores,
+    buffer, dispatch, cache clears) speaks qualified ids."""
+    with SequenceSample.disable_validation():
+        return SequenceSample(
+            keys=batch.keys, trailing_shapes=batch.trailing_shapes,
+            dtypes=batch.dtypes,
+            ids=[(int(epoch), i) for i in batch.ids],
+            seqlens=batch.seqlens, data=batch.data,
+            metadata=batch.metadata)
+
+
+def raw_ids(ids) -> list:
+    """Strip epoch qualification (inverse of ``epoch_qualified`` for
+    id lists): consumed-id skipping on resume compares against the
+    dataset's raw ids."""
+    return [i[1] if isinstance(i, tuple) and len(i) == 2 else i
+            for i in ids]
 
 
 def drop_ids(batch: "SequenceSample", skip_ids) -> Optional["SequenceSample"]:

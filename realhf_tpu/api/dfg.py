@@ -53,6 +53,13 @@ class MFCDef:
 
     :param name: unique node name.
     :param n_seqs: batch size in sequences pulled from the buffer.
+        PER-MFC: the per-sample SequenceBuffer assembles each MFC's
+        batch from whichever ready samples exist (possibly spanning
+        dataset batches), so producer and consumer n_seqs need only
+        SHARE samples, not be equal -- generation can stream at 2x the
+        train batch while training drains at 1x. The graft-lint
+        ``dfg-batch-mismatch`` checker validates each MFC's n_seqs
+        against the buffer-capacity contract.
     :param interface_type: generate / inference / train_step.
     :param interface_impl: registry config of the algorithm interface.
     :param model_name: which model executes this call (str role is

@@ -265,10 +265,14 @@ class ExperimentSpec:
         default_factory=dict)
     # Buffer capacity: how many dataset batches may be in flight at
     # once (>=2 lets MFCs of consecutive steps overlap on disjoint
-    # meshes; reference AsyncIOSequenceBuffer pipelining).
+    # meshes; reference AsyncIOSequenceBuffer pipelining). With the
+    # per-sample buffer this also bounds the largest per-MFC n_seqs an
+    # assembly can ever satisfy: capacity * source n_seqs samples.
     max_concurrent_batches: int = 2
-    # How many steps a non-train MFC may run ahead of its role's train
-    # MFC (reference master_worker.py:503-509 staleness guard).
+    # How many of its OWN batches a non-train MFC may run ahead of its
+    # role's train MFCs, measured on per-sample consumption watermarks
+    # (reference master_worker.py:503-509 staleness guard; with
+    # uniform n_seqs this is exactly "k-1-offpolicyness batches").
     max_head_offpolicyness: int = 0
     # Auto-resolve OffloadHooks: non-trainable roles (ref/reward) move
     # their weights to host after their last MFC of a step, freeing

@@ -29,7 +29,13 @@ logger = logging.getLogger("recover")
 #:      dataloader_state (epoch accounting)
 #:   3: + ckpt_manifests (role -> committed durable-checkpoint
 #:      manifest path, system/ckpt_manager.py)
-RECOVER_INFO_VERSION = 3
+#:   4: buffer_state switches to the PER-SAMPLE SequenceBuffer
+#:      snapshot (schema key "batches" with per-sample completion
+#:      records; the v3-era per-batch "entries" form is upgraded in
+#:      place by SequenceBuffer.load_state_dict). No dataclass fields
+#:      changed -- the bump marks the nested-payload schema so a
+#:      FUTURE v4 dump is never misread by v3 code.
+RECOVER_INFO_VERSION = 4
 
 
 @dataclasses.dataclass

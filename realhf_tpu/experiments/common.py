@@ -82,6 +82,12 @@ class CommonExperimentConfig:
     recover_retries: int = 1
     # inline (single process) | distributed (master + model workers)
     mode: str = "inline"
+    # distributed-mode pipelining (api/experiment.ExperimentSpec):
+    # dataset batches in flight at once, and how many of its own
+    # batches a non-train MFC may run ahead of its role's train MFCs
+    # (the off-policyness budget of the per-sample buffer)
+    max_concurrent_batches: int = 2
+    max_head_offpolicyness: int = 0
     # manual (per-MFC *_alloc flags / role parallel configs) |
     # heuristic (size-based decoupled layouts, reference
     # ppo_exp.py:419; requires n_devices)

@@ -44,6 +44,11 @@ class PPOHyperparameters:
     reward_output_bias: float = 0.0
     early_stop_imp_ratio: float = 5.0
     use_adaptive_kl_ctl: bool = False
+    # async/off-policy consumption (docs/distributed.md "Async RLHF"):
+    # drop sequences staler than this many trainer versions; bound the
+    # clipped-IS correction for the stale remainder (None disables)
+    max_staleness: Optional[int] = None
+    staleness_is_clip: Optional[float] = 2.0
     adv_norm: bool = True
     value_norm: bool = True
     value_norm_type: str = "exp"
@@ -69,6 +74,13 @@ class PPOConfig(CommonExperimentConfig):
     critic_train_n_mbs: int = 1
     rew_inf_n_mbs: int = 1
     ref_inf_n_mbs: int = 1
+    # Per-MFC batch size (api/dfg.MFCDef.n_seqs): actor_gen may run at
+    # a LARGER granularity than the train MFCs (e.g. 2x the train
+    # batch) -- the per-sample buffer assembles each MFC's batch from
+    # whichever ready samples exist, so generation streams ahead while
+    # training drains at train_bs_n_seqs. None keeps the aligned
+    # (lockstep) default.
+    actor_gen_n_seqs: Optional[int] = None
     # Per-MFC layout overrides in the reference's "d4t2"-style shorthand
     # (decoupled allocation => weight replicas + parameter reallocation).
     actor_gen_alloc: Optional[str] = None
@@ -89,6 +101,8 @@ class PPOConfig(CommonExperimentConfig):
             kl_ctl=p.kl_ctl, discount=p.discount, gae_lambda=p.gae_lambda,
             eps_clip=p.eps_clip, max_reward_clip=p.max_reward_clip,
             early_stop_imp_ratio=p.early_stop_imp_ratio,
+            max_staleness=p.max_staleness,
+            staleness_is_clip=p.staleness_is_clip,
             adv_norm=p.adv_norm,
             use_adaptive_kl_ctl=p.use_adaptive_kl_ctl,
             value_norm=p.value_norm, value_norm_type=p.value_norm_type,
@@ -110,6 +124,10 @@ class PPOConfig(CommonExperimentConfig):
                               output_bias=p.reward_output_bias,
                               enable_save=False))
         n = self.dataset.train_bs_n_seqs
+        # actor_gen (the source MFC) may run at its own granularity:
+        # the dataset loader batches at the SOURCE n_seqs, and the
+        # per-sample buffer lets the downstream MFCs drain at theirs
+        n_gen = self.actor_gen_n_seqs or n
         gen_outputs = ["seq_no_eos_mask", "packed_input_ids",
                        "packed_logprobs", "prompt_mask"]
         if not p.force_no_logits_mask:
@@ -121,7 +139,7 @@ class PPOConfig(CommonExperimentConfig):
                         "packed_ref_logprobs", "rewards", "values",
                         "prompt_mask", "seq_no_eos_mask")
         mfcs = [
-            MFCDef(name="actor_gen", n_seqs=n,
+            MFCDef(name="actor_gen", n_seqs=n_gen,
                    interface_type=ModelInterfaceType.GENERATE,
                    interface_impl=actor_itf, model_name="actor",
                    input_keys=("packed_prompts",),
@@ -188,6 +206,8 @@ class PPOConfig(CommonExperimentConfig):
             tokenizer_path=self.tokenizer_path or self.actor.path,
             total_train_epochs=self.total_train_epochs,
             seed=self.seed,
+            max_concurrent_batches=self.max_concurrent_batches,
+            max_head_offpolicyness=self.max_head_offpolicyness,
             ctl=self.ctl())
 
 

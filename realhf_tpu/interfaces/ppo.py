@@ -84,6 +84,19 @@ class PPOActorInterface(model_api.ModelInterface):
     value_norm_beta: float = 0.99995
     value_norm_eps: float = 1e-5
     enable_save: bool = True
+    # -- async / off-policy consumption (docs/distributed.md "Async
+    # RLHF") ----------------------------------------------------------
+    #: drop sequences whose generation weight version lags the
+    #: trainer's current version by more than this (the training-side
+    #: mirror of ServingSpec.max_staleness); None keeps everything
+    max_staleness: Optional[int] = None
+    #: truncated importance-sampling bound for STALE sequences: each
+    #: stale token's advantage is scaled by
+    #: clip(pi_current/pi_behavior, 1/c, c) with the ratio
+    #: stop-gradiented (decoupled-PPO style -- the ordinary PPO ratio
+    #: still does the proximal clipping on top). None disables the
+    #: correction; fresh (staleness 0) sequences are never touched.
+    staleness_is_clip: Optional[float] = 2.0
 
     def __post_init__(self):
         if isinstance(self.gconfig, dict):
@@ -223,6 +236,30 @@ class PPOActorInterface(model_api.ModelInterface):
         denorm_values[ends] = np.where(seq_no_eos, denorm_values[ends], 0.0)
 
         loss_mask = _shifted_loss_mask(prompt_mask, seqlens)
+
+        # -- staleness accounting (docs/distributed.md "Async RLHF"):
+        # async rollouts stamp each sample's generation weight_version
+        # into metadata; staleness = trainer version - that stamp.
+        # Over-stale sequences drop out of the loss entirely; the rest
+        # get the clipped-IS correction inside the loss fn below.
+        versions = input_.metadata.get("weight_version")
+        cur_version = model.version.global_step
+        seq_staleness = np.zeros(n_seqs, np.int64)
+        if versions:
+            seq_staleness = np.array(
+                [max(0, cur_version - int(v)) for v in versions],
+                np.int64)
+        n_dropped = 0
+        if versions and self.max_staleness is not None:
+            drop = seq_staleness > self.max_staleness
+            if drop.any():
+                off = 0
+                for i, l in enumerate(seqlens):
+                    if drop[i]:
+                        loss_mask[off:off + l - 1] = False
+                    off += l - 1
+                n_dropped = int(drop.sum())
+
         old_logp = old_logp * loss_mask
         ref_logp = ref_logp * loss_mask
 
@@ -240,8 +277,9 @@ class PPOActorInterface(model_api.ModelInterface):
             self.rms.update(returns, mask=loss_mask)
         if self.adv_norm:
             m = loss_mask.astype(np.float64)
-            mean = (advantages * m).sum() / m.sum()
-            var = ((advantages - mean) ** 2 * m).sum() / m.sum()
+            denom = max(m.sum(), 1.0)  # every seq dropped as stale
+            mean = (advantages * m).sum() / denom
+            var = ((advantages - mean) ** 2 * m).sum() / denom
             advantages = ((advantages - mean) /
                           np.sqrt(var + 1e-5)).astype(np.float32) * loss_mask
 
@@ -259,6 +297,12 @@ class PPOActorInterface(model_api.ModelInterface):
             n_tokens=n_tokens,
             n_seqs=n_seqs,
         )
+        if versions:
+            global_stats.update(
+                staleness_mean=float(seq_staleness.mean()),
+                staleness_max=int(seq_staleness.max()),
+                stale_seq_frac=float((seq_staleness > 0).mean()),
+                n_dropped_stale=n_dropped)
 
         train_data = dict(
             advantages=advantages,
@@ -267,6 +311,13 @@ class PPOActorInterface(model_api.ModelInterface):
             packed_input_ids=input_.data["packed_input_ids"],
             kl_rewards=kl_rewards,
         )
+        # per-token staleness (shifted, length l-1) rides the
+        # minibatch so the clipped-IS correction runs inside the loss
+        has_stale = bool(versions) and self.staleness_is_clip is not None
+        if has_stale:
+            train_data["staleness"] = np.repeat(
+                seq_staleness, [l - 1 for l in seqlens]
+            ).astype(np.float32)
         has_mask = ("packed_logits_mask" in input_.keys and
                     input_.data.get("packed_logits_mask") is not None)
         if has_mask:
@@ -288,6 +339,8 @@ class PPOActorInterface(model_api.ModelInterface):
         pipeline = engine.pipeline_ctx
         moe_constraint = engine.moe_constraint
 
+        is_clip = self.staleness_is_clip
+
         def loss_fn(params, mb):
             h, aux = common.forward_with_aux(cfg, params, mb["input_ids"],
                                              mb["seg_ids"], attention_fn,
@@ -296,9 +349,27 @@ class PPOActorInterface(model_api.ModelInterface):
             lp = F.shifted_logprobs_from_hidden(
                 cfg, params, h, mb["input_ids"], mb["seg_ids"],
                 temperature=temperature, logits_mask=lmask)
+            adv = mb["advantages"]
+            stale_stats = {}
+            if has_stale:
+                # staleness-aware truncated IS (decoupled-PPO style):
+                # stale tokens' advantages scale by
+                # clip(pi_current/pi_behavior, 1/c, c), stop-gradiented
+                # so the ordinary PPO ratio still does the proximal
+                # clipping; fresh tokens keep weight 1
+                behav_ratio = jnp.exp(
+                    jax.lax.stop_gradient(lp) - mb["old_logp"])
+                w = jnp.where(
+                    mb["staleness"] > 0,
+                    jnp.clip(behav_ratio, 1.0 / is_clip, is_clip),
+                    1.0)
+                adv = adv * w
+                lm = mb["loss_mask"] > 0
+                stale_stats["stale_is_weight"] = (
+                    (w * lm).sum() / jnp.maximum(lm.sum(), 1))
             loss, stats = ppo_functional.actor_loss_fn(
                 logprobs=lp, old_logprobs=mb["old_logp"],
-                advantages=mb["advantages"], eps_clip=eps_clip,
+                advantages=adv, eps_clip=eps_clip,
                 loss_mask=mb["loss_mask"] > 0)
             # Early stop SKIPS the whole optimizer update (reference
             # semantics) via the engine's reserved stat -- a zeroed
@@ -317,25 +388,29 @@ class PPOActorInterface(model_api.ModelInterface):
                 actor_loss=loss,
                 ppo_approx_kl=stats["approx_kl"],
                 actor_clip_ratio=stats["clip_ratio"],
-                importance_weight=stats["importance_weight"], **aux)
+                importance_weight=stats["importance_weight"],
+                **stale_stats, **aux)
             if early_imp is not None or early_kl is not None:
                 out_stats["__skip_update__"] = skip
             return loss + sum(aux.values()), out_stats
 
         loss_key = ("ppo_actor", has_mask, temperature, eps_clip,
-                    early_kl, early_imp)
+                    early_kl, early_imp, has_stale, is_clip)
 
         def build_sb(minibatch):
             mb_lens = common.flat_seqlens(minibatch)
+            shifted = dict(
+                advantages=minibatch.data["advantages"],
+                old_logp=minibatch.data["old_logp"],
+                loss_mask=minibatch.data["ppo_loss_mask"]
+                .astype(np.float32))
+            if has_stale:
+                shifted["staleness"] = minibatch.data["staleness"]
             sb = common.build_stream_batch(
                 mb_lens,
                 token_keys=dict(
                     input_ids=minibatch.data["packed_input_ids"]),
-                shifted_keys=dict(
-                    advantages=minibatch.data["advantages"],
-                    old_logp=minibatch.data["old_logp"],
-                    loss_mask=minibatch.data["ppo_loss_mask"]
-                    .astype(np.float32)),
+                shifted_keys=shifted,
                 n_streams=engine.n_streams)
             if has_mask:
                 sb.arrays["logits_mask"] = packing.pack_tokens(

@@ -565,5 +565,25 @@ class RolloutClient:
                 return RolloutResult(rid=rid, status=kind, data=data)
         raise AssertionError("stream ended without a terminal event")
 
+    def poll_results(self, timeout: float = 0.0) -> List[RolloutResult]:
+        """Non-blocking harvest (waiting up to ``timeout`` for the
+        first reply): every request that has reached a terminal state,
+        in arrival order. Intermediate events (accepted / started /
+        token deltas) of harvested requests are discarded -- this is
+        the fire-hose surface the ``RolloutController`` drains to keep
+        training fed; use ``stream``/``next_event`` when the
+        incremental events matter."""
+        self._pump(timeout)
+        out: List[RolloutResult] = []
+        for rid in list(self._events):
+            terminal = next(
+                ((k, d) for k, d in self._events[rid]
+                 if k in TERMINAL_KINDS), None)
+            if terminal is not None:
+                del self._events[rid]
+                out.append(RolloutResult(
+                    rid=rid, status=terminal[0], data=terminal[1]))
+        return out
+
     def close(self):
         self._sock.close(0)

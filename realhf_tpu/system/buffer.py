@@ -2,128 +2,514 @@
 
 TPU-native counterpart of reference ``realhf/system/buffer.py``
 (AsyncIOSequenceBuffer:117): holds metadata-only SequenceSamples
-(tensors stay on the model workers), tracks which data keys are ready
-for every sample, and hands each MFC its batch once all of the MFC's
-input keys exist. Granularity here is one dataset batch (all MFCs of
-our experiment graphs share ``n_seqs``); the reference's per-sample
-indicator arrays collapse to per-batch key accounting, and the buffer
-may hold several batches at once so MFCs of consecutive steps overlap
-on disjoint meshes (the decoupled-allocation concurrency that is the
-reference's core throughput claim).
+(tensors stay on the model workers) at PER-SAMPLE granularity. Each
+sample tracks its own per-key readiness mask and per-MFC
+dispatch/consumption state (the reference's numpy indicator arrays);
+each MFC declares its own ``n_seqs`` and the buffer assembles that
+MFC's next batch from whichever ready samples exist -- possibly
+spanning dataset batches (and, with epoch-qualified ids, epochs) --
+instead of waiting for a full dataset batch to complete every
+upstream key. This is the lockstep->pipeline transition: generation
+can stream samples in at one granularity while training drains them
+at another, and per-MFC consumption watermarks feed the master's
+off-policyness guard.
+
+Dataset batches remain a first-class grouping for the data-plane
+lifecycle (epoch accounting, ``clear_data_cache`` when every sample of
+a batch retires, crash-recovery snapshots); the legacy per-batch API
+(``ready_mfcs`` / ``amend_batch`` / ...) is kept as a thin layer over
+the per-sample state for callers that still think in aligned batches.
 """
 
 import dataclasses
-from typing import Dict, List, Optional, Set
+from typing import Dict, Hashable, Iterable, List, Optional, Set, Tuple
 
 from realhf_tpu.api.data import SequenceSample
 
 
 @dataclasses.dataclass
-class BufferEntry:
-    batch_id: int
-    meta: SequenceSample                  # metadata only (ids/seqlens/keys)
-    key_owner: Dict[str, str]             # data key -> worker name holding it
+class SampleState:
+    """Per-sample readiness/consumption record (reference buffer.py
+    per-sample indicator rows)."""
+    sid: Hashable
+    seqno: int                 # global arrival order
+    batch_id: int              # dataset batch it arrived in
+    epoch: int
+    is_epoch_last: bool
+    meta: SequenceSample       # bs=1 view; keys merge as MFCs complete
+    key_owner: Dict[str, str]  # data key -> worker holding the tensors
+    #: MFCs that CLAIMED this sample (reserved into an assembly or
+    #: legacy-dispatched); completed is a subset once they finish
     dispatched: Set[str] = dataclasses.field(default_factory=set)
     completed: Set[str] = dataclasses.field(default_factory=set)
-    epoch: int = 0
-    is_epoch_last: bool = False
+
+    def ready_for(self, mfc: str, input_keys: Tuple) -> bool:
+        return (mfc not in self.dispatched and mfc not in self.completed
+                and all(k in self.meta.keys for k in input_keys))
+
+
+@dataclasses.dataclass
+class Assembly:
+    """One dispatch unit of one MFC: its ``n_seqs`` (or a flushed
+    tail) drawn FIFO from the ready pool, possibly spanning dataset
+    batches."""
+    aid: int
+    mfc: str
+    sids: List[Hashable]
+    #: dataset batch of the FIRST sample (step-span / exec-log anchor)
+    primary_bid: int
+    #: cumulative samples claimed by this MFC up to and including this
+    #: assembly -- the consumption watermark the off-policyness guard
+    #: compares against the role's train watermark
+    end_mark: int
+    dispatched: bool = False
 
     @property
-    def ids(self):
-        return self.meta.ids
+    def ids(self) -> List[Hashable]:
+        return list(self.sids)
+
+
+class BufferEntry:
+    """Per-dataset-batch view over the live samples (legacy surface +
+    data-plane lifecycle: epoch accounting, rescue plans, cache
+    clears)."""
+
+    def __init__(self, batch_id: int, samples: List[SampleState],
+                 epoch: int, is_epoch_last: bool):
+        self.batch_id = batch_id
+        self.samples = samples
+        self.epoch = epoch
+        self.is_epoch_last = is_epoch_last
+
+    @property
+    def ids(self) -> List[Hashable]:
+        return [s.sid for s in self.samples]
+
+    @property
+    def key_owner(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for s in self.samples:
+            out.update(s.key_owner)
+        return out
+
+    @property
+    def completed(self) -> Set[str]:
+        """MFCs completed on EVERY sample of the batch."""
+        if not self.samples:
+            return set()
+        out = set(self.samples[0].completed)
+        for s in self.samples[1:]:
+            out &= s.completed
+        return out
+
+    @property
+    def dispatched(self) -> Set[str]:
+        """MFCs claimed on every sample of the batch."""
+        if not self.samples:
+            return set()
+        out = set(self.samples[0].dispatched)
+        for s in self.samples[1:]:
+            out &= s.dispatched
+        return out
+
+    @property
+    def meta(self) -> SequenceSample:
+        """Batch metadata gathered over the keys common to every
+        sample (under the legacy aligned API all samples progress
+        together, so this is the full key set)."""
+        common = set(self.samples[0].meta.keys)
+        for s in self.samples[1:]:
+            common &= s.meta.keys
+        return SequenceSample.gather(
+            [s.meta.select(sorted(common)) for s in self.samples])
 
 
 class SequenceBuffer:
-    """Per-batch key-readiness accounting (reference buffer.py:117)."""
+    """Per-sample key-readiness accounting (reference buffer.py:117).
 
-    def __init__(self, mfc_names: List[str], capacity: int = 4):
+    ``n_seqs_of`` / ``input_keys_of`` / ``producers_of`` enable the
+    assembly API the master's dispatch loop uses; buffers constructed
+    without them still serve the legacy per-batch API.
+    """
+
+    def __init__(self, mfc_names: List[str], capacity: int = 4,
+                 n_seqs_of: Optional[Dict[str, int]] = None,
+                 input_keys_of: Optional[Dict[str, Tuple]] = None,
+                 producers_of: Optional[Dict[str, Tuple]] = None):
         self._mfcs = list(mfc_names)
-        self.capacity = capacity
-        self._entries: Dict[int, BufferEntry] = {}
+        self.capacity = capacity           # dataset batches in flight
+        self._samples: Dict[Hashable, SampleState] = {}
+        self._order: List[Hashable] = []   # arrival order (FIFO)
+        self._batches: Dict[int, List[Hashable]] = {}
+        self._batch_info: Dict[int, Tuple[int, bool]] = {}
         self._next_id = 0
+        self._next_seqno = 0
+        self._next_aid = 0
+        self._assemblies: Dict[int, Assembly] = {}
+        self._n_seqs_of = dict(n_seqs_of or {})
+        self._input_keys_of = {m: tuple(v) for m, v in
+                               (input_keys_of or {}).items()}
+        self._producers_of = {m: tuple(v) for m, v in
+                              (producers_of or {}).items()}
+        # consumption watermark base: samples COMPLETED by each MFC
+        # that have since retired out of the live window
+        self._retired_consumed = {m: 0 for m in self._mfcs}
+        # claim watermark: samples ever claimed per MFC (monotone;
+        # feeds Assembly.end_mark)
+        self._claimed = {m: 0 for m in self._mfcs}
 
     def __len__(self):
-        return len(self._entries)
+        return len(self._batches)
 
     @property
     def has_space(self) -> bool:
-        return len(self._entries) < self.capacity
+        return len(self._batches) < self.capacity
 
+    @property
+    def n_samples(self) -> int:
+        return len(self._samples)
+
+    # -- intake ---------------------------------------------------------
     def put_batch(self, meta: SequenceSample, owner: str, epoch: int,
                   is_epoch_last: bool) -> int:
         bid = self._next_id
         self._next_id += 1
-        self._entries[bid] = BufferEntry(
-            batch_id=bid, meta=meta,
-            key_owner={k: owner for k in meta.keys},
-            epoch=epoch, is_epoch_last=is_epoch_last)
+        sids = []
+        for piece in meta.unpack():
+            sid = piece.ids[0]
+            self._samples[sid] = SampleState(
+                sid=sid, seqno=self._next_seqno, batch_id=bid,
+                epoch=epoch, is_epoch_last=is_epoch_last, meta=piece,
+                key_owner={k: owner for k in piece.keys})
+            self._next_seqno += 1
+            self._order.append(sid)
+            sids.append(sid)
+        self._batches[bid] = sids
+        self._batch_info[bid] = (epoch, is_epoch_last)
         return bid
 
-    def amend_batch(self, batch_id: int, out_meta: Optional[SequenceSample],
-                    owner: str, mfc_name: str):
-        """Record an MFC's completion (+ its output keys' location)."""
-        e = self._entries[batch_id]
-        e.completed.add(mfc_name)
+    # -- assembly API (the master's dispatch surface) -------------------
+    def ready_count(self, mfc: str) -> int:
+        """Unclaimed samples whose input keys for ``mfc`` are all
+        present (the ``buffer_ready_samples`` observability surface)."""
+        keys = self._input_keys_of.get(mfc, ())
+        return sum(1 for sid in self._order
+                   if self._samples[sid].ready_for(mfc, keys))
+
+    def _ready_sids(self, mfc: str) -> List[Hashable]:
+        keys = self._input_keys_of.get(mfc, ())
+        return [sid for sid in self._order
+                if self._samples[sid].ready_for(mfc, keys)]
+
+    def _upstream_drained(self, mfc: str) -> bool:
+        """True when no producer of ``mfc``'s inputs can still emit
+        samples from the live window (every producer completed every
+        live sample) -- the gate for flushing a partial tail."""
+        for p in self._producers_of.get(mfc, ()):
+            for s in self._samples.values():
+                if p not in s.completed:
+                    return False
+        return True
+
+    def ready_assemblies(self, flush: Iterable[str] = ()
+                         ) -> List[Assembly]:
+        """Undispatched assemblies, FIFO: previously released ones
+        first, then new assemblies formed from ready samples. An MFC in
+        ``flush`` (the master sets it once fetching is done) may form a
+        PARTIAL tail assembly when its upstream is fully drained --
+        per-MFC ``n_seqs`` need not divide the dataset."""
+        flush = set(flush)
+        out = [a for a in sorted(self._assemblies.values(),
+                                 key=lambda a: a.aid)
+               if not a.dispatched]
+        for m in self._mfcs:
+            n = self._n_seqs_of.get(m)
+            if n is None or n <= 0:
+                continue
+            while True:
+                ready = self._ready_sids(m)
+                if len(ready) >= n:
+                    take = ready[:n]
+                elif ready and m in flush and self._upstream_drained(m):
+                    take = ready
+                else:
+                    break
+                self._claimed[m] += len(take)
+                asm = Assembly(
+                    aid=self._next_aid, mfc=m, sids=list(take),
+                    primary_bid=self._samples[take[0]].batch_id,
+                    end_mark=self._claimed[m])
+                self._next_aid += 1
+                for sid in take:
+                    self._samples[sid].dispatched.add(m)
+                self._assemblies[asm.aid] = asm
+                out.append(asm)
+                if len(take) < n:
+                    break
+        return out
+
+    def assembly(self, aid: int) -> Optional[Assembly]:
+        return self._assemblies.get(aid)
+
+    def assembly_ready(self, aid: int) -> bool:
+        """Every sample of the assembly still holds every input key
+        (an upstream invalidation revokes readiness until the producer
+        recomputes)."""
+        asm = self._assemblies.get(aid)
+        if asm is None:
+            return False
+        keys = self._input_keys_of.get(asm.mfc, ())
+        return all(k in self._samples[sid].meta.keys
+                   for sid in asm.sids for k in keys
+                   if sid in self._samples)
+
+    def assembly_plan(self, aid: int) -> Dict[str, Dict[str, list]]:
+        """Per-key fetch plan: key -> {owner -> [sample ids]}. Samples
+        of one assembly may be homed on different workers (elastic
+        reroute mid-window), so the plan is owner-exact rather than
+        one owner per key."""
+        asm = self._assemblies[aid]
+        plan: Dict[str, Dict[str, list]] = {}
+        for sid in asm.sids:
+            s = self._samples.get(sid)
+            if s is None:
+                continue
+            for k in self._input_keys_of.get(asm.mfc, ()):
+                o = s.key_owner.get(k)
+                if o is not None:
+                    plan.setdefault(k, {}).setdefault(o, []).append(sid)
+        return plan
+
+    def gather_assembly(self, aid: int,
+                        keys: Optional[Iterable[str]] = None
+                        ) -> SequenceSample:
+        """The assembly's input batch gathered from the per-sample
+        metas (data rides along when the samples carry it -- the
+        inline async runner stores full samples; the distributed
+        master stores metadata only and workers fetch tensors over
+        the data plane instead)."""
+        asm = self._assemblies[aid]
+        pieces = [self._samples[sid].meta for sid in asm.sids]
+        if keys is not None:
+            pieces = [p.select(sorted(set(keys))) for p in pieces]
+        return SequenceSample.gather(pieces)
+
+    def plan_owners(self, aid: int) -> Set[str]:
+        return {o for owners in self.assembly_plan(aid).values()
+                for o in owners}
+
+    def mark_assembly_dispatched(self, aid: int):
+        self._assemblies[aid].dispatched = True
+
+    def release_assembly(self, aid: int):
+        """Requeue an in-flight assembly (worker lost / fetch failed
+        before replying): it is re-offered by ready_assemblies with
+        the same samples once dispatchable again."""
+        asm = self._assemblies.get(aid)
+        if asm is not None:
+            asm.dispatched = False
+
+    def complete_assembly(self, aid: int,
+                          out_meta: Optional[SequenceSample],
+                          owner: str) -> Optional[Assembly]:
+        """Record an assembly's completion: per-sample consumption
+        watermarks advance, produced keys merge into each sample's
+        meta with their owner."""
+        asm = self._assemblies.pop(aid, None)
+        if asm is None:
+            return None
+        pieces = {}
         if out_meta is not None:
-            e.meta.update_(out_meta)
-            for k in out_meta.keys:
-                e.key_owner[k] = owner
+            for piece in out_meta.unpack():
+                pieces[piece.ids[0]] = piece
+        for sid in asm.sids:
+            s = self._samples.get(sid)
+            if s is None:
+                continue
+            s.completed.add(asm.mfc)
+            piece = pieces.get(sid)
+            if piece is not None:
+                s.meta.update_(piece)
+                for k in piece.keys:
+                    s.key_owner[k] = owner
+        return asm
+
+    # -- per-MFC consumption watermarks ---------------------------------
+    def consumed(self, mfc: str) -> int:
+        """Samples COMPLETED by ``mfc`` since buffer creation
+        (monotone except for host-loss invalidation rollback)."""
+        return self._retired_consumed.get(mfc, 0) + sum(
+            1 for s in self._samples.values() if mfc in s.completed)
+
+    def claimed(self, mfc: str) -> int:
+        """Samples ever claimed by ``mfc`` (completed + in flight +
+        reserved)."""
+        return self._claimed.get(mfc, 0)
+
+    # -- retirement (data-plane lifecycle) ------------------------------
+    def pop_retired(self) -> List[BufferEntry]:
+        """Remove and return dataset batches every sample of which has
+        been completed by every MFC. Oldest first -- step/epoch
+        accounting and cache clears key off these."""
+        done = []
+        all_mfcs = set(self._mfcs)
+        for bid in sorted(self._batches):
+            sids = self._batches[bid]
+            if all(self._samples[sid].completed >= all_mfcs
+                   for sid in sids):
+                done.append(bid)
+        out = []
+        for bid in done:
+            sids = self._batches.pop(bid)
+            epoch, last = self._batch_info.pop(bid)
+            samples = [self._samples.pop(sid) for sid in sids]
+            for s in samples:
+                for m in s.completed:
+                    if m in self._retired_consumed:
+                        self._retired_consumed[m] += 1
+            keep = set(self._samples)
+            self._order = [sid for sid in self._order if sid in keep]
+            out.append(BufferEntry(bid, samples, epoch, last))
+        return out
+
+    # legacy name
+    def pop_finished(self) -> List[BufferEntry]:
+        return self.pop_retired()
+
+    # -- fault paths ----------------------------------------------------
+    def invalidate_outputs(self, batch_id: int, mfc_name: str, keys):
+        """Un-complete an MFC whose output tensors died with their
+        owning worker (host loss / SIGKILL): the keys leave the
+        affected samples' meta and ownership maps, so consumers stop
+        being ready until the producer recomputes. The samples return
+        to the unclaimed pool (their completed producer assembly is
+        long gone) and re-assemble for recompute -- recomputation, not
+        re-consumption: the sample ids were drawn exactly once."""
+        sids = self._batches.get(batch_id)
+        if sids is None:
+            return
+        for sid in sids:
+            s = self._samples[sid]
+            s.completed.discard(mfc_name)
+            # unclaim unless a LIVE assembly of this MFC still holds
+            # the sample (in-flight recompute already underway)
+            held = any(sid in a.sids for a in self._assemblies.values()
+                       if a.mfc == mfc_name)
+            if not held:
+                s.dispatched.discard(mfc_name)
+            for k in keys:
+                s.key_owner.pop(k, None)
+                # SequenceSample invariant: keys == seqlens == shapes
+                # == dtypes (== data when present); drop from all views
+                s.meta.keys.discard(k)
+                s.meta.seqlens.pop(k, None)
+                s.meta.trailing_shapes.pop(k, None)
+                s.meta.dtypes.pop(k, None)
+                if s.meta.data is not None:
+                    s.meta.data.pop(k, None)
+
+    def invalidate_worker_outputs(self, workers: Iterable[str],
+                                  key_producer: Dict[str, str]
+                                  ) -> List[Tuple[int, str, List[str]]]:
+        """Sample-granular sweep after a grace-less worker death: every
+        key homed on a dead worker is invalidated and its producer
+        un-completed on the affected samples. Returns
+        ``[(batch_id, mfc, keys)]`` records for attribution."""
+        ws = set(workers)
+        hits: Dict[Tuple[int, str], Set[str]] = {}
+        for s in self._samples.values():
+            for k, o in list(s.key_owner.items()):
+                if o in ws and k in key_producer:
+                    hits.setdefault(
+                        (s.batch_id, key_producer[k]), set()).add(k)
+        out = []
+        for (bid, mfc), keys in sorted(
+                hits.items(), key=lambda kv: (kv[0][0], kv[0][1])):
+            self.invalidate_outputs(bid, mfc, sorted(keys))
+            out.append((bid, mfc, sorted(keys)))
+        return out
+
+    def rehome_owner(self, old: str, new: str):
+        """Data-owner handoff: every key homed on ``old`` re-homes to
+        ``new`` (the successor pulled the pieces already)."""
+        for s in self._samples.values():
+            for k, o in list(s.key_owner.items()):
+                if o == old:
+                    s.key_owner[k] = new
+
+    def rescue_plan(self, worker: str) -> List[Dict]:
+        """Per-batch (ids, keys) groups still homed on ``worker`` --
+        what a data-owner successor must pull before the grace window
+        closes. Samples of one batch are grouped by their owned-key
+        set (mid-assembly batches can be non-uniform)."""
+        out = []
+        for bid in sorted(self._batches):
+            groups: Dict[Tuple, List[Hashable]] = {}
+            for sid in self._batches[bid]:
+                s = self._samples[sid]
+                keys = tuple(sorted(k for k, o in s.key_owner.items()
+                                    if o == worker))
+                if keys:
+                    groups.setdefault(keys, []).append(sid)
+            for keys in sorted(groups):
+                out.append(dict(ids=list(groups[keys]),
+                                keys=list(keys)))
+        return out
+
+    # -- legacy per-batch API (aligned callers + old tests) -------------
+    def amend_batch(self, batch_id: int,
+                    out_meta: Optional[SequenceSample], owner: str,
+                    mfc_name: str):
+        """Record an MFC's completion over a whole dataset batch."""
+        pieces = {}
+        if out_meta is not None:
+            for piece in out_meta.unpack():
+                pieces[piece.ids[0]] = piece
+        for sid in self._batches[batch_id]:
+            s = self._samples[sid]
+            s.completed.add(mfc_name)
+            piece = pieces.get(sid)
+            if piece is not None:
+                s.meta.update_(piece)
+                for k in piece.keys:
+                    s.key_owner[k] = owner
 
     def ready_mfcs(self, input_keys_of: Dict[str, tuple]
                    ) -> List[tuple]:
-        """(batch_id, mfc_name) pairs whose inputs are all present and
-        which are neither dispatched nor completed. Oldest batch first
-        (FIFO keeps step ordering for trainable models)."""
+        """(batch_id, mfc_name) pairs whose inputs are present on
+        every sample and which are neither claimed nor completed
+        anywhere in the batch. Oldest batch first."""
         out = []
-        for bid in sorted(self._entries):
-            e = self._entries[bid]
+        for bid in sorted(self._batches):
+            samples = [self._samples[sid] for sid in self._batches[bid]]
             for m in self._mfcs:
-                if m in e.dispatched or m in e.completed:
-                    continue
-                if all(k in e.meta.keys for k in input_keys_of[m]):
+                if all(s.ready_for(m, input_keys_of.get(m, ()))
+                       for s in samples):
                     out.append((bid, m))
         return out
 
     def mark_dispatched(self, batch_id: int, mfc_name: str):
-        self._entries[batch_id].dispatched.add(mfc_name)
+        for sid in self._batches[batch_id]:
+            self._samples[sid].dispatched.add(mfc_name)
 
     def mark_undispatched(self, batch_id: int, mfc_name: str):
-        """Requeue an in-flight MFC (its worker was lost before
-        replying): ready_mfcs offers it again once its group is
-        eligible. No-op for completed MFCs."""
-        e = self._entries.get(batch_id)
-        if e is not None and mfc_name not in e.completed:
-            e.dispatched.discard(mfc_name)
-
-    def invalidate_outputs(self, batch_id: int, mfc_name: str, keys):
-        """Un-complete an MFC whose output tensors died with their
-        owning worker (host loss / SIGKILL -- no grace window to hand
-        them off): the keys leave the batch meta and ownership map, so
-        consumers stop being ready until the producer recomputes, and
-        ready_mfcs offers the producer again. Recomputation, not
-        re-consumption: the batch's sample ids were drawn exactly
-        once."""
-        e = self._entries.get(batch_id)
+        e = self._batches.get(batch_id)
         if e is None:
             return
-        e.completed.discard(mfc_name)
-        e.dispatched.discard(mfc_name)
-        for k in keys:
-            e.key_owner.pop(k, None)
-            # SequenceSample invariant: keys == seqlens == shapes ==
-            # dtypes (== data when present); remove from all views
-            e.meta.keys.discard(k)
-            e.meta.seqlens.pop(k, None)
-            e.meta.trailing_shapes.pop(k, None)
-            e.meta.dtypes.pop(k, None)
-            if e.meta.data is not None:
-                e.meta.data.pop(k, None)
+        for sid in e:
+            s = self._samples[sid]
+            if mfc_name not in s.completed:
+                s.dispatched.discard(mfc_name)
 
     def get(self, batch_id: int) -> BufferEntry:
-        return self._entries[batch_id]
+        epoch, last = self._batch_info[batch_id]
+        return BufferEntry(
+            batch_id, [self._samples[sid]
+                       for sid in self._batches[batch_id]],
+            epoch, last)
 
     def batch_ids(self) -> List[int]:
-        return sorted(self._entries)
+        return sorted(self._batches)
 
     @property
     def next_batch_id(self) -> int:
@@ -132,42 +518,82 @@ class SequenceBuffer:
         return self._next_id
 
     # -- crash-recovery snapshot ----------------------------------------
+    #: snapshot schema: 1 = per-batch entries (pre-ISSUE-10);
+    #: 2 = per-sample records. RecoverInfo v4 carries schema-2 dumps.
+    STATE_VERSION = 2
+
     def state_dict(self) -> Dict:
-        """Picklable in-flight snapshot for RecoverInfo. Dispatch
-        state is intentionally NOT saved: after a crash every
-        uncompleted MFC must re-dispatch, and the data-plane tensors
-        behind these entries died with the workers anyway -- the
-        snapshot records identity/accounting (ids, completion, epoch
-        position, batch-id watermark), not payloads."""
+        """Picklable in-flight snapshot for RecoverInfo. Claim state
+        is intentionally NOT saved: after a crash every uncompleted
+        MFC must re-assemble and re-dispatch, and the data-plane
+        tensors behind these samples died with the workers anyway --
+        the snapshot records identity/accounting (ids, per-sample
+        completion, epoch position, batch-id watermark), not
+        payloads."""
+        batches = []
+        for bid in sorted(self._batches):
+            epoch, last = self._batch_info[bid]
+            batches.append(dict(
+                batch_id=bid, epoch=epoch, is_epoch_last=last,
+                samples=[dict(sid=s.sid, meta=s.meta,
+                              key_owner=dict(s.key_owner),
+                              completed=sorted(s.completed))
+                         for s in (self._samples[sid]
+                                   for sid in self._batches[bid])]))
         return {
+            "version": self.STATE_VERSION,
             "next_id": self._next_id,
-            "entries": [
-                dict(batch_id=e.batch_id, meta=e.meta,
-                     key_owner=dict(e.key_owner),
-                     completed=sorted(e.completed), epoch=e.epoch,
-                     is_epoch_last=e.is_epoch_last)
-                for bid, e in sorted(self._entries.items())
-            ],
+            "batches": batches,
         }
 
     def load_state_dict(self, state: Dict):
-        """Restore a snapshot. Uncompleted MFCs come back
-        undispatched (they re-run); the batch-id counter resumes past
-        the watermark so ids stay monotonic across restarts."""
-        self._entries = {}
-        for d in state.get("entries", ()):
-            self._entries[d["batch_id"]] = BufferEntry(
-                batch_id=d["batch_id"], meta=d["meta"],
-                key_owner=dict(d["key_owner"]),
-                dispatched=set(d["completed"]),
-                completed=set(d["completed"]),
-                epoch=d["epoch"], is_epoch_last=d["is_epoch_last"])
+        """Restore a snapshot. Uncompleted MFCs come back unclaimed
+        (they re-assemble and re-run); the batch-id counter resumes
+        past the watermark so ids stay monotonic across restarts.
+        Schema-1 (per-batch ``entries``) dumps are upgraded in place:
+        batch-level completion becomes uniform per-sample completion."""
+        self._samples = {}
+        self._order = []
+        self._batches = {}
+        self._batch_info = {}
+        self._assemblies = {}
+        self._next_seqno = 0
+        if "entries" in state and "batches" not in state:  # schema 1
+            for d in state.get("entries", ()):
+                bid = d["batch_id"]
+                sids = []
+                for piece in d["meta"].unpack():
+                    sid = piece.ids[0]
+                    self._samples[sid] = SampleState(
+                        sid=sid, seqno=self._next_seqno, batch_id=bid,
+                        epoch=d["epoch"],
+                        is_epoch_last=d["is_epoch_last"], meta=piece,
+                        key_owner={k: o for k, o in d["key_owner"]
+                                   .items() if k in piece.keys},
+                        dispatched=set(d["completed"]),
+                        completed=set(d["completed"]))
+                    self._next_seqno += 1
+                    self._order.append(sid)
+                    sids.append(sid)
+                self._batches[bid] = sids
+                self._batch_info[bid] = (d["epoch"], d["is_epoch_last"])
+        else:
+            for b in state.get("batches", ()):
+                bid = b["batch_id"]
+                sids = []
+                for sd in b["samples"]:
+                    sid = sd["sid"]
+                    self._samples[sid] = SampleState(
+                        sid=sid, seqno=self._next_seqno, batch_id=bid,
+                        epoch=b["epoch"],
+                        is_epoch_last=b["is_epoch_last"],
+                        meta=sd["meta"],
+                        key_owner=dict(sd["key_owner"]),
+                        dispatched=set(sd["completed"]),
+                        completed=set(sd["completed"]))
+                    self._next_seqno += 1
+                    self._order.append(sid)
+                    sids.append(sid)
+                self._batches[bid] = sids
+                self._batch_info[bid] = (b["epoch"], b["is_epoch_last"])
         self._next_id = int(state.get("next_id", 0))
-
-    def pop_finished(self) -> List[BufferEntry]:
-        """Remove and return entries every MFC has completed."""
-        done = [e for e in self._entries.values()
-                if e.completed >= set(self._mfcs)]
-        for e in done:
-            del self._entries[e.batch_id]
-        return done

@@ -4,15 +4,22 @@ TPU-native counterpart of reference ``realhf/system/master_worker.py``
 (MasterWorker:841). The reference runs one asyncio coroutine per MFC
 against an AsyncIOSequenceBuffer; here the same dataflow is an explicit
 event-driven state machine stepped from ``_poll``: dispatch data
-fetches and every input-ready MFC (requests carry metadata only), poll
-replies, amend the buffer, account epochs/steps, trigger save/eval,
-and record recover info. MFCs of the same or consecutive steps whose
-models live on different workers execute CONCURRENTLY -- the decoupled
-allocation concurrency that is the reference's core throughput claim.
+fetches, ASSEMBLE each MFC's next batch from whichever ready samples
+exist (per-sample buffer granularity -- an assembly may span dataset
+batches, so training drains trajectories the moment they are ready
+instead of waiting for a full batch to complete every upstream key),
+poll replies, advance per-sample consumption watermarks, account
+epochs/steps on batch retirement, trigger save/eval, and record
+recover info. MFCs of the same or consecutive steps whose models live
+on different workers execute CONCURRENTLY -- the decoupled allocation
+concurrency that is the reference's core throughput claim.
 
-Off-policyness guard (reference master_worker.py:503-509): an MFC for
-batch k may only dispatch once every train MFC of the same role has
-completed batch k-1-max_head_offpolicyness and earlier.
+Off-policyness guard (reference master_worker.py:503-509), restated on
+watermarks: an MFC of a trainable role may claim samples only up to
+``trained + (1 + max_head_offpolicyness) * n_seqs`` where ``trained``
+is the role's train-MFC consumption watermark -- with uniform n_seqs
+this reduces exactly to "batch k dispatches once the train MFCs
+completed batch k-1-max_head_offpolicyness".
 """
 
 import pickle
@@ -59,6 +66,18 @@ class MasterWorker(worker_base.Worker):
         self.dfg = DFG(spec.mfcs)
         self.input_keys_of = {n.name: tuple(n.input_keys)
                               for n in self.dfg.nodes}
+        # per-MFC batch size (api/dfg.MFCDef.n_seqs): each MFC drains
+        # the buffer at its own granularity; assemblies may span
+        # dataset batches
+        self.n_seqs_of = {n.name: int(n.n_seqs) for n in self.dfg.nodes}
+        producers = self.dfg.G.graph["data_producers"]
+        self.producers_of = {
+            n.name: tuple(sorted({producers[k].name
+                                  for k in n.input_keys
+                                  if k in producers}))
+            for n in self.dfg.nodes}
+        # data key -> producing MFC (host-loss output invalidation)
+        self.key_producer = {k: p.name for k, p in producers.items()}
         # EXEC worker group per node: the role's group, or the MFC
         # allocation's own group (per-MFC device-subset placement).
         # Requests go to every member; the leader -- first in the
@@ -96,7 +115,10 @@ class MasterWorker(worker_base.Worker):
 
         self.buffer = SequenceBuffer(
             [n.name for n in self.dfg.nodes],
-            capacity=max(1, spec.max_concurrent_batches))
+            capacity=max(1, spec.max_concurrent_batches),
+            n_seqs_of=self.n_seqs_of,
+            input_keys_of=self.input_keys_of,
+            producers_of=self.producers_of)
 
         self.stream = NameResolvingRequestClient(
             spec.experiment_name, spec.trial_name)
@@ -132,7 +154,7 @@ class MasterWorker(worker_base.Worker):
                     # workers, and their ids are absent from
                     # hash_vals_to_ignore so the data refetches
                     self.buffer.load_state_dict(dict(
-                        info.buffer_state, entries=[]))
+                        info.buffer_state, entries=[], batches=[]))
                 logger.info(
                     "Master resuming at global step %d (epoch %d, %d "
                     "consumed ids, %d batches were in flight, recover "
@@ -162,8 +184,8 @@ class MasterWorker(worker_base.Worker):
             base=self.ft.exclude_base_secs,
             max_delay=self.ft.exclude_max_secs,
             host_of=self._host_of)
-        self._mfc_requeues: Dict[tuple, int] = {}  # (bid, mfc) -> count
-        # (bid, mfc) -> (failed fetch plan, ts): dispatch cooldown
+        self._mfc_requeues: Dict[tuple, int] = {}  # (aid, mfc) -> count
+        # (aid, mfc) -> (failed fetch plan, ts): dispatch cooldown
         # after a survivor reported fetch_failed for that exact plan
         self._fetch_failed: Dict[tuple, tuple] = {}
         self._fetch_requeues = 0
@@ -189,9 +211,14 @@ class MasterWorker(worker_base.Worker):
         # number of dataloader advances a data-owner successor must
         # replay to take over mid-epoch (elastic handoff)
         self._fetches_done = 0
-        # request_id -> (bid, mfc_name, worker, kind); kind in
+        # request_id -> (aid, mfc_name, worker, kind); kind in
         # {leader, member, fetch, clear, sync}
         self._inflight: Dict[str, tuple] = {}
+        # assembly id -> primary dataset batch id (exec-log / span
+        # anchoring; assemblies pop from the buffer on completion but
+        # member replies can still arrive afterwards). Bounded sweep
+        # keeps it from growing with the trial.
+        self._aid_bid: Dict[int, int] = {}
         # per-MFC per-worker execution spans + peak HBM (reference
         # __log_gpu_stats table, model_worker.py:999-1094)
         self._exec_log: list = []
@@ -209,13 +236,10 @@ class MasterWorker(worker_base.Worker):
         # in the merged Chrome trace. Opened on put_batch, finished
         # when the batch completes (or the master exits).
         self._step_spans: Dict[int, tracing.Span] = {}
-        # batch_id -> highest batch whose train MFCs finished, per role
-        self._train_done_upto: Dict[str, Dict[int, set]] = {
-            role: {} for role in self.train_nodes_of_role}
         # On resume the live window starts at the restored batch-id
-        # watermark: every pre-crash bid is finished or refetched
-        # under a NEW bid, so the staleness guard must never wait on
-        # one (it would deadlock the resumed trial).
+        # watermark (exec-log sweeping); the off-policyness guard runs
+        # on this incarnation's consumption watermarks, which restart
+        # at zero together -- no pre-crash batch can deadlock it.
         self._min_live_bid = min(self.buffer.batch_ids()
                                  + [self.buffer.next_batch_id])
         # cross-group param sync bookkeeping: how often each role has
@@ -235,53 +259,57 @@ class MasterWorker(worker_base.Worker):
                                     self.spec.trial_name),
             status, replace=True, delete_on_exit=False)
 
-    def _train_caught_up(self, bid: int, role: str) -> bool:
-        """All train MFCs of `role` finished every batch older than
-        bid - max_head_offpolicyness (live batches only)."""
-        horizon = bid - self.spec.max_head_offpolicyness
-        done = self._train_done_upto[role]
-        for old_bid in range(self._min_live_bid, horizon):
-            if old_bid >= bid:
-                break
-            finished = done.get(old_bid, set())
-            if not finished >= set(self.train_nodes_of_role[role]):
-                return False
-        return True
+    def _offpolicy_ok(self, asm) -> bool:
+        """Watermark form of the reference off-policyness guard: an
+        MFC of a trainable role may run ahead of the role's train
+        MFCs by at most (1 + max_head_offpolicyness) of its own
+        batches, measured in SAMPLES (per-MFC consumption
+        watermarks)."""
+        node = self.dfg.find(asm.mfc)
+        train_nodes = self.train_nodes_of_role.get(node.role)
+        if not train_nodes:
+            return True
+        trained = min(self.buffer.consumed(t) for t in train_nodes)
+        budget = (1 + self.spec.max_head_offpolicyness) \
+            * self.n_seqs_of[asm.mfc]
+        return asm.end_mark <= trained + budget
 
-    def _input_plan(self, bid: int, mfc_name: str) -> tuple:
-        """The (key, owner) fetch plan a dispatch of this MFC would
-        use right now (hashable, for staleness comparison)."""
-        node = self.dfg.find(mfc_name)
-        e = self.buffer.get(bid)
-        return tuple(sorted((k, e.key_owner[k])
-                            for k in node.input_keys
-                            if k in e.key_owner))
+    def _input_plan(self, aid: int) -> tuple:
+        """The per-key/per-owner fetch plan a dispatch of this
+        assembly would use right now (hashable, for fetch-failure
+        staleness comparison)."""
+        return tuple(sorted(
+            (k, o, tuple(oids))
+            for k, owners in self.buffer.assembly_plan(aid).items()
+            for o, oids in owners.items()))
 
-    def _dispatchable(self, bid: int, mfc_name: str) -> bool:
-        node = self.dfg.find(mfc_name)
+    def _dispatchable(self, asm) -> bool:
+        mfc_name = asm.mfc
         if not self._workers_eligible(self.node_workers[mfc_name]):
+            return False
+        # an upstream invalidation may have revoked readiness between
+        # assembly and dispatch (host loss): wait for the recompute
+        if not self.buffer.assembly_ready(asm.aid):
             return False
         # input owners: never dispatch a fetch plan pointing at a
         # watchdog-LOST worker (the tensors died with it; invalidation
         # + recompute will re-home them). Retiring-but-draining owners
         # stay fetchable -- the preemption grace window exists exactly
         # so consumers can still pull from them.
-        plan = self._input_plan(bid, mfc_name)
-        if {o for _k, o in plan} & set(self.watchdog.lost_workers()):
+        if self.buffer.plan_owners(asm.aid) \
+                & set(self.watchdog.lost_workers()):
             return False
-        failed = self._fetch_failed.get((bid, mfc_name))
+        failed = self._fetch_failed.get((asm.aid, mfc_name))
         if failed is not None:
             failed_plan, ts = failed
             cooldown = self.ft.heartbeat_timeout \
                 + 2 * self.ft.watchdog_poll_secs
-            if failed_plan == plan \
+            if failed_plan == self._input_plan(asm.aid) \
                     and time.monotonic() - ts < cooldown:
                 # same plan just failed; give the watchdog time to
                 # attribute the owner's death before retrying
                 return False
-        if node.role in self.train_nodes_of_role:
-            return self._train_caught_up(bid, node.role)
-        return True
+        return self._offpolicy_ok(asm)
 
     # -- fault tolerance -----------------------------------------------
     def _workers_eligible(self, workers) -> bool:
@@ -358,8 +386,8 @@ class MasterWorker(worker_base.Worker):
         """MFC names in flight on, or queued for, any of ``workers``
         (for attributed error messages)."""
         ws = set(workers)
-        out = {f"{mfc}@batch{bid}"
-               for bid, mfc, w, kind in self._inflight.values()
+        out = {f"{mfc}@assembly{aid}"
+               for aid, mfc, w, kind in self._inflight.values()
                if w in ws and mfc is not None}
         for bid in self.buffer.batch_ids():
             e = self.buffer.get(bid)
@@ -461,69 +489,62 @@ class MasterWorker(worker_base.Worker):
     def _requeue_doomed_consumers(self, ws):
         """An MFC in flight on a SURVIVOR whose input fetch plan
         points at a just-dead worker can only fail its data fetch:
-        drop the dispatch and requeue it (ready_mfcs re-offers it once
-        the producer has recomputed the lost inputs)."""
+        drop the dispatch and release the assembly (ready_assemblies
+        re-offers it once the producer has recomputed the lost
+        inputs)."""
         seen = set()
-        for rid, (bid, mfc, w, kind) in list(self._inflight.items()):
+        for rid, (aid, mfc, w, kind) in list(self._inflight.items()):
             if kind != "leader" or mfc is None or w in ws:
                 continue  # dead-worker rids are _drop_and_requeue's job
-            if (bid, mfc) in seen:
+            if aid in seen or self.buffer.assembly(aid) is None:
                 continue
-            try:
-                e = self.buffer.get(bid)
-            except KeyError:
-                continue
-            node = self.dfg.find(mfc)
-            doomed = {e.key_owner.get(k)
-                      for k in node.input_keys} & ws
+            doomed = self.buffer.plan_owners(aid) & ws
             if not doomed:
                 continue
-            seen.add((bid, mfc))
+            seen.add(aid)
             siblings = [r for r, ref in list(self._inflight.items())
-                        if ref[0] == bid and ref[1] == mfc]
+                        if ref[0] == aid and ref[1] == mfc]
             for r in siblings:
                 self._inflight.pop(r, None)
             self.stream.discard(siblings)
-            self.buffer.mark_undispatched(bid, mfc)
+            self.buffer.release_assembly(aid)
             logger.warning(
-                "Requeued in-flight MFC %s (batch %d): its input "
-                "fetch plan references dead worker(s) %s.", mfc, bid,
+                "Requeued in-flight MFC %s (assembly %d): its input "
+                "fetch plan references dead worker(s) %s.", mfc, aid,
                 sorted(doomed))
 
-    def _on_mfc_fetch_failed(self, bid, mfc_name, worker, error):
+    def _on_mfc_fetch_failed(self, aid, mfc_name, worker, error):
         """A survivor could not assemble an MFC's inputs (their owner
         died without a grace window): drop the dispatch group and
         requeue, bounded by the same per-MFC retry budget as worker
         loss -- a persistent failure still fails the trial with
         attribution instead of looping forever."""
         siblings = [r for r, ref in list(self._inflight.items())
-                    if ref[0] == bid and ref[1] == mfc_name]
+                    if ref[0] == aid and ref[1] == mfc_name]
         for r in siblings:
             self._inflight.pop(r, None)
         self.stream.discard(siblings)
-        try:
-            self._fetch_failed[(bid, mfc_name)] = (
-                self._input_plan(bid, mfc_name), time.monotonic())
-        except KeyError:
-            pass  # batch already popped; nothing to requeue
-        n = self._mfc_requeues.get((bid, mfc_name), 0) + 1
-        self._mfc_requeues[(bid, mfc_name)] = n
+        if self.buffer.assembly(aid) is not None:
+            self._fetch_failed[(aid, mfc_name)] = (
+                self._input_plan(aid), time.monotonic())
+        n = self._mfc_requeues.get((aid, mfc_name), 0) + 1
+        self._mfc_requeues[(aid, mfc_name)] = n
         # fetch failures get a wider budget than worker loss: the
         # first one typically races the watchdog's attribution of the
         # dead owner (the dispatch cooldown absorbs the gap)
         budget = max(3, self.ft.max_mfc_retries)
         if n > budget:
             flight.record("fetch_failed_fatal", mfc=mfc_name,
-                          batch_id=bid, worker=worker, error=error)
+                          assembly=aid, worker=worker, error=error)
             raise WorkerLostError(
-                worker, inflight=[f"{mfc_name}@batch{bid}"],
-                detail=f"MFC {mfc_name} (batch {bid}) input fetch "
+                worker, inflight=[f"{mfc_name}@assembly{aid}"],
+                detail=f"MFC {mfc_name} (assembly {aid}) input fetch "
                        f"failed {n}x ({error}); giving up.")
-        self.buffer.mark_undispatched(bid, mfc_name)
+        self.buffer.release_assembly(aid)
         metrics.inc("master_fetch_failed_requeues_total", mfc=mfc_name)
         logger.warning(
-            "Requeued MFC %s (batch %d): %s reported fetch_failed "
-            "(%s; attempt %d/%d).", mfc_name, bid, worker, error, n,
+            "Requeued MFC %s (assembly %d): %s reported fetch_failed "
+            "(%s; attempt %d/%d).", mfc_name, aid, worker, error, n,
             budget)
 
     def _invalidate_lost_outputs(self, workers):
@@ -535,23 +556,13 @@ class MasterWorker(worker_base.Worker):
         -- from inputs still homed on the surviving data owner. This
         recomputes, it never re-consumes: the batch's sample ids were
         drawn from the dataset exactly once."""
-        ws = set(workers)
-        for bid in self.buffer.batch_ids():
-            e = self.buffer.get(bid)
-            lost_keys = {k for k, o in e.key_owner.items() if o in ws}
-            if not lost_keys:
-                continue
-            for n in self.dfg.nodes:
-                hit = set(n.output_keys) & lost_keys
-                if hit and n.name in e.completed:
-                    owners = sorted({e.key_owner[k] for k in hit})
-                    self.buffer.invalidate_outputs(bid, n.name, hit)
-                    metrics.inc("master_outputs_invalidated_total",
-                                mfc=n.name)
-                    logger.warning(
-                        "Batch %d: %s outputs %s died with worker(s) "
-                        "%s; re-marked for recompute.", bid, n.name,
-                        sorted(hit), owners)
+        ws = sorted(set(workers))
+        for bid, mfc, keys in self.buffer.invalidate_worker_outputs(
+                ws, self.key_producer):
+            metrics.inc("master_outputs_invalidated_total", mfc=mfc)
+            logger.warning(
+                "Batch %d: %s outputs %s died with worker(s) %s; "
+                "re-marked for recompute.", bid, mfc, keys, ws)
 
     def _handoff_data_owner(self, worker: str, grace: float):
         """The preempted worker owns the data plane (dataset loader +
@@ -572,13 +583,7 @@ class MasterWorker(worker_base.Worker):
                          "take over; relaunch-level recovery applies.",
                          worker)
             return
-        rescue = []
-        for bid in self.buffer.batch_ids():
-            e = self.buffer.get(bid)
-            keys = sorted(k for k, o in e.key_owner.items()
-                          if o == worker)
-            if keys:
-                rescue.append(dict(ids=list(e.ids), keys=keys))
+        rescue = self.buffer.rescue_plan(worker)
         payload = dict(from_worker=worker,
                        fetches_done=self._fetches_done,
                        rescue=rescue,
@@ -602,11 +607,7 @@ class MasterWorker(worker_base.Worker):
                 worker, succ, e, worker)
             return
         self.data_owner = succ
-        for bid in self.buffer.batch_ids():
-            e = self.buffer.get(bid)
-            for k, o in list(e.key_owner.items()):
-                if o == worker:
-                    e.key_owner[k] = succ
+        self.buffer.rehome_owner(worker, succ)
         logger.warning(
             "DATA OWNERSHIP handed off %s -> %s: %d live batches "
             "rescued, loader replayed to fetch %d.", worker, succ,
@@ -615,7 +616,7 @@ class MasterWorker(worker_base.Worker):
     def _drop_and_requeue(self, worker: str):
         lost_refs = [(rid, ref) for rid, ref in self._inflight.items()
                      if ref[2] == worker]
-        for rid, (bid, mfc_name, _w, kind) in lost_refs:
+        for rid, (aid, mfc_name, _w, kind) in lost_refs:
             self._inflight.pop(rid, None)
             self.stream.discard([rid])
             if kind in ("leader", "member"):
@@ -624,25 +625,25 @@ class MasterWorker(worker_base.Worker):
                 # unknown-rid path harmlessly, and the whole MFC
                 # re-dispatches as one group
                 siblings = [r for r, ref in list(self._inflight.items())
-                            if ref[0] == bid and ref[1] == mfc_name]
+                            if ref[0] == aid and ref[1] == mfc_name]
                 for r in siblings:
                     self._inflight.pop(r, None)
                 self.stream.discard(siblings)
-                n = self._mfc_requeues.get((bid, mfc_name), 0) + 1
-                self._mfc_requeues[(bid, mfc_name)] = n
+                n = self._mfc_requeues.get((aid, mfc_name), 0) + 1
+                self._mfc_requeues[(aid, mfc_name)] = n
                 if n > self.ft.max_mfc_retries:
                     flight.record("worker_lost_fatal", worker=worker,
-                                  mfc=mfc_name, batch_id=bid,
+                                  mfc=mfc_name, assembly=aid,
                                   requeues=n - 1)
                     raise WorkerLostError(
-                        worker, inflight=[f"{mfc_name}@batch{bid}"],
-                        detail=f"MFC {mfc_name} (batch {bid}) already "
-                               f"requeued {n - 1}x; giving up.")
-                self.buffer.mark_undispatched(bid, mfc_name)
+                        worker, inflight=[f"{mfc_name}@assembly{aid}"],
+                        detail=f"MFC {mfc_name} (assembly {aid}) "
+                               f"already requeued {n - 1}x; giving up.")
+                self.buffer.release_assembly(aid)
                 logger.warning(
-                    "Requeued MFC %s (batch %d) after losing worker "
-                    "%s (attempt %d/%d).", mfc_name, bid, worker, n,
-                    self.ft.max_mfc_retries)
+                    "Requeued MFC %s (assembly %d) after losing "
+                    "worker %s (attempt %d/%d).", mfc_name, aid,
+                    worker, n, self.ft.max_mfc_retries)
             elif kind == "fetch":
                 self._fetch_requeues += 1
                 if self._fetch_requeues > self.ft.max_mfc_retries:
@@ -797,37 +798,45 @@ class MasterWorker(worker_base.Worker):
                 "forward.", rec.node, rec.adopted_workers,
                 rec.original_workers, time.monotonic() - rec.since)
 
-    def _dispatch_mfc(self, bid: int, mfc_name: str):
-        e = self.buffer.get(bid)
+    def _dispatch_mfc(self, asm):
+        mfc_name = asm.mfc
         node = self.dfg.find(mfc_name)
         workers = self.node_workers[mfc_name]
         leader = self.node_worker[mfc_name]
-        fetch_plan = {k: e.key_owner[k] for k in node.input_keys
-                      if k in e.key_owner}
-        payload = dict(node=mfc_name, ids=list(e.ids),
+        # per-key/per-owner plan: samples of one assembly may span
+        # dataset batches and (after an elastic reroute) be homed on
+        # different workers
+        fetch_plan = {k: {o: list(oids) for o, oids in owners.items()}
+                      for k, owners
+                      in self.buffer.assembly_plan(asm.aid).items()}
+        payload = dict(node=mfc_name, ids=list(asm.sids),
                        fetch_plan=fetch_plan)
         if mfc_name in self.cross_group_nodes \
                 and node.role in self._role_version:
             payload["param_sync"] = self._attach_param_sync(node)
-        # the dispatch span parents to the batch's step span; its
-        # context rides in the payloads so worker-side MFC spans nest
-        # under it across the process boundary
-        step_span = self._step_spans.get(bid)
+        # the dispatch span parents to the step span of the assembly's
+        # FIRST sample's batch; its context rides in the payloads so
+        # worker-side MFC spans nest under it across the process
+        # boundary
+        step_span = self._step_spans.get(asm.primary_bid)
         with tracing.span(
                 f"dispatch:{mfc_name}",
                 parent=step_span.context if step_span else None,
-                batch_id=bid, mfc=mfc_name, role=node.role,
+                batch_id=asm.primary_bid, assembly=asm.aid,
+                n_seqs=len(asm.sids), mfc=mfc_name, role=node.role,
                 workers=",".join(workers)) as sp:
             rids = self.stream.request(
                 workers, node.interface_type.value,
                 datas=[payload] * len(workers),
                 trace_ctx=sp.context.to_dict() if sp.context else None)
         for w, rid in zip(workers, rids):
-            self._inflight[rid] = (bid, mfc_name, w,
+            self._inflight[rid] = (asm.aid, mfc_name, w,
                                    "leader" if w == leader else "member")
-        self.buffer.mark_dispatched(bid, mfc_name)
-        logger.debug("Dispatched %s (batch %d) to %s.", mfc_name, bid,
-                     workers)
+        self._aid_bid[asm.aid] = asm.primary_bid
+        self.buffer.mark_assembly_dispatched(asm.aid)
+        logger.debug("Dispatched %s (assembly %d: %d seqs, batch %d) "
+                     "to %s.", mfc_name, asm.aid, len(asm.sids),
+                     asm.primary_bid, workers)
 
     def _attach_param_sync(self, node) -> Dict:
         """Cross-group weight flow (reference param_realloc hooks,
@@ -885,10 +894,12 @@ class MasterWorker(worker_base.Worker):
         self._step_spans[bid] = tracing.start_span(
             "step", batch_id=bid, epoch=epoch, worker=self.worker_name)
 
-    def _on_mfc_reply(self, bid: int, mfc_name: str, data: Dict):
+    def _on_mfc_reply(self, aid: int, mfc_name: str, data: Dict):
         node = self.dfg.find(mfc_name)
         worker = self.node_worker[mfc_name]
-        self.buffer.amend_batch(bid, data.get("meta"), worker, mfc_name)
+        self.buffer.complete_assembly(aid, data.get("meta"), worker)
+        self._mfc_requeues.pop((aid, mfc_name), None)
+        self._fetch_failed.pop((aid, mfc_name), None)
         stats = data.get("stats")
         if stats:
             self._step_stats.setdefault(mfc_name, {}).update(stats)
@@ -896,26 +907,25 @@ class MasterWorker(worker_base.Worker):
                 # structured JSONL through the metrics registry is the
                 # record of record; the human-readable line drops to
                 # DEBUG (docs/observability.md)
-                metrics.event("mfc_stats", mfc=mfc_name, batch_id=bid,
+                metrics.event("mfc_stats", mfc=mfc_name, assembly=aid,
+                              batch_id=self._aid_bid.get(aid),
                               role=node.role, stats=stats)
                 logger.debug(
-                    "MFC %s (batch %d) stats: %s", mfc_name, bid,
+                    "MFC %s (assembly %d) stats: %s", mfc_name, aid,
                     {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in stats.items()})
         if node.interface_type == ModelInterfaceType.TRAIN_STEP:
-            self._train_done_upto[node.role].setdefault(bid, set()).add(
-                mfc_name)
             self._role_version[node.role] += 1
 
     def _finish_batches(self):
         for e in self.buffer.pop_finished():
             self._min_live_bid = max(self._min_live_bid, e.batch_id + 1)
-            self._mfc_requeues = {k: v for k, v in
-                                  self._mfc_requeues.items()
-                                  if k[0] != e.batch_id}
-            self._fetch_failed = {k: v for k, v in
-                                  self._fetch_failed.items()
-                                  if k[0] != e.batch_id}
+            # requeue/fetch-cooldown records are pruned per assembly on
+            # completion; the aid->bid anchor map is swept by size (a
+            # member reply can trail its assembly arbitrarily)
+            if len(self._aid_bid) > 4096:
+                for aid in sorted(self._aid_bid)[:-2048]:
+                    del self._aid_bid[aid]
             self.global_step += 1
             self._cur_epoch = e.epoch
             self._consumed_ids.extend(e.ids)
@@ -1126,11 +1136,23 @@ class MasterWorker(worker_base.Worker):
             self._dispatch_fetch()
             n += 1
 
-        # 2. dispatch every input-ready MFC (subject to staleness)
-        for bid, mfc_name in self.buffer.ready_mfcs(self.input_keys_of):
-            if self._dispatchable(bid, mfc_name):
-                self._dispatch_mfc(bid, mfc_name)
+        # 2. assemble + dispatch every input-ready MFC batch from the
+        # per-sample pool (subject to the off-policyness guard). Once
+        # fetching is done and upstream MFCs drain, partial tail
+        # assemblies flush so per-MFC n_seqs need not divide the data.
+        flush = ([n_.name for n_ in self.dfg.nodes]
+                 if self._done_fetching and not self._fetch_inflight
+                 else ())
+        for asm in self.buffer.ready_assemblies(flush=flush):
+            if self._dispatchable(asm):
+                self._dispatch_mfc(asm)
                 n += 1
+        # overlap observability: how many samples sit ready per MFC
+        # (docs/observability.md; the Perfetto timeline pairs this
+        # with the dispatch/step spans)
+        for m in self.n_seqs_of:
+            metrics.set_gauge("buffer_ready_samples",
+                              self.buffer.ready_count(m), mfc=m)
 
         # 3. collect replies
         for p in self.stream.poll_batch(timeout=0.05):
@@ -1140,11 +1162,11 @@ class MasterWorker(worker_base.Worker):
             ref = self._inflight.pop(p.request_id, None)
             if ref is None:
                 continue
-            bid, mfc_name, worker, kind = ref
+            aid, mfc_name, worker, kind = ref
             if kind in ("leader", "member") \
                     and isinstance(p.data, dict) \
                     and p.data.get("fetch_failed"):
-                self._on_mfc_fetch_failed(bid, mfc_name, worker,
+                self._on_mfc_fetch_failed(aid, mfc_name, worker,
                                           p.data["fetch_failed"])
                 n += 1
                 continue
@@ -1155,7 +1177,7 @@ class MasterWorker(worker_base.Worker):
                         if isinstance(p.data, dict) else None)
                 if info:
                     row = dict(info, mfc=mfc_name, worker=worker,
-                               bid=bid)
+                               bid=self._aid_bid.get(aid))
                     self._exec_log.append(row)
                     # history is appended ON ARRIVAL (bounded): a
                     # member row landing after its batch was logged
@@ -1163,7 +1185,7 @@ class MasterWorker(worker_base.Worker):
                     self._exec_history.append(row)
                     del self._exec_history[:-512]
                 if kind == "leader":
-                    self._on_mfc_reply(bid, mfc_name, p.data)
+                    self._on_mfc_reply(aid, mfc_name, p.data)
             n += 1
 
         # 4. batch completion accounting

@@ -424,17 +424,26 @@ class ModelWorker(worker_base.Worker):
         return batch, is_epoch_last
 
     def _handle_fetch_data(self, req: Payload):
-        """Load the next dataset batch, keep tensors locally, reply
-        metadata (ids/seqlens/keys) + epoch accounting."""
+        """Load the next dataset batch, keep tensors locally under
+        EPOCH-QUALIFIED ids, reply metadata (ids/seqlens/keys) + epoch
+        accounting. Qualification makes cross-epoch id reuse safe with
+        concurrent batches (a finishing batch's cache clear can no
+        longer delete a next-epoch sample) and keeps ids unique inside
+        per-sample assemblies spanning the epoch boundary."""
         assert self.owns_data
         batch, is_epoch_last = self._advance_loader()
-        batch = data_api.drop_ids(batch,
-                                  req.data.get("skip_ids") or ())
+        # skip ids arrive qualified (the master's consumed list);
+        # strip to the raw dataset ids -- skipping only applies to the
+        # resumed epoch, which the master clears at its boundary
+        batch = data_api.drop_ids(
+            batch, set(data_api.raw_ids(req.data.get("skip_ids")
+                                        or ())))
         if batch is None:
             self.stream.respond(req, data=dict(
                 empty=True, epoch=self._epoch,
                 is_epoch_last=is_epoch_last))
             return
+        batch = data_api.epoch_qualified(batch, self._epoch)
         self.store.put(batch)
         self.stream.respond(req, data=dict(
             empty=False, meta=batch.meta(), epoch=self._epoch,
@@ -443,17 +452,28 @@ class ModelWorker(worker_base.Worker):
     def _assemble_input(self, ids, keys, fetch_plan) -> data_api.SequenceSample:
         """Gather the MFC input from local storage, fetching missing
         keys from their owner workers (the data_transfer pre-hook,
-        reference model_worker.py:782-814)."""
+        reference model_worker.py:782-814).
+
+        ``fetch_plan[k]`` is either one owner name (legacy, whole
+        batch homed together) or an owner->ids map: a per-sample
+        assembly can span dataset batches whose pieces live on
+        different workers (elastic reroute mid-window), so the master
+        ships an owner-exact plan."""
         # owner -> key -> ids actually missing locally; fetch only the
         # union of missing ids per owner (cached pieces never re-ship)
         missing: Dict[str, Dict[str, list]] = {}
         for k in keys:
-            owner = fetch_plan.get(k, self.worker_name)
-            if owner == self.worker_name:
-                continue
-            need = [i for i in ids if not self.store.has(i, [k])]
-            if need:
-                missing.setdefault(owner, {})[k] = need
+            spec = fetch_plan.get(k, self.worker_name)
+            by_owner = (spec if isinstance(spec, dict)
+                        else {spec: list(ids)})
+            for owner, oids in by_owner.items():
+                if owner == self.worker_name:
+                    continue
+                need = [i for i in oids
+                        if not self.store.has(i, [k])]
+                if need:
+                    missing.setdefault(owner, {}).setdefault(
+                        k, []).extend(need)
         for owner, by_key in missing.items():
             need_union = sorted({i for v in by_key.values() for i in v},
                                 key=lambda x: ids.index(x))

@@ -1,0 +1,278 @@
+"""RolloutController: overlap generation with training.
+
+The loop-closer of ROADMAP item 1 (fully-async RLHF). The serving
+subsystem (PR 2/7/8) already provides everything an async trainer
+needs -- continuous batching, weight hot-swap with monotonic versions,
+per-sequence ``weight_version`` stamps, ``max_staleness`` eviction --
+but nothing kept the GenServer fleet saturated while the train mesh
+consumed trajectories off-policy. This module does exactly that:
+
+- :class:`RolloutController` pumps prompts into one or more
+  :class:`~realhf_tpu.serving.server.RolloutClient` connections
+  (round-robin across a fleet or through the PR 7 router), keeps a
+  target number of requests in flight, and harvests finished
+  trajectories as they complete -- stamped with the ``weight_version``
+  they were generated under and, via ``harvest(export_kv=True)`` on
+  the server side, the PR 8 spec-decoding stats riding the done event.
+- Trajectories whose staleness (trainer version minus generation
+  version) exceeds ``max_staleness`` are DROPPED and their prompts
+  resubmitted -- the client-side mirror of the server's eviction
+  policy, for the case where weights advanced after the sequence
+  finished but before training consumed it.
+- :func:`trajectories_to_sample` packs harvested trajectories into the
+  actor-gen ``SequenceSample`` layout (``packed_input_ids`` /
+  ``packed_logprobs`` / ``prompt_mask`` / ``seq_no_eos_mask``) with
+  per-sample ``weight_version`` metadata, ready to stream into the
+  per-sample :class:`~realhf_tpu.system.buffer.SequenceBuffer` while
+  training drains it at its own ``n_seqs``.
+
+Metrics (``serving_rollout_*``, docs/observability.md) and
+``rollout:*`` trace spans make the generation/training overlap visible
+in the PR 5 Perfetto timeline.
+"""
+
+import dataclasses
+import time
+from typing import Callable, Dict, Hashable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics, tracing
+
+logger = logging.getLogger("rollout", "system")
+
+
+@dataclasses.dataclass
+class Trajectory:
+    """One finished rollout, as training consumes it."""
+    sid: Hashable
+    prompt: np.ndarray
+    tokens: np.ndarray
+    logprobs: np.ndarray
+    no_eos: bool
+    #: weight version installed when generation STARTED (the behavior
+    #: policy label the PPO staleness correction keys on)
+    weight_version: int
+    #: trainer_version - weight_version at harvest time
+    staleness: int
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+
+
+class RolloutController:
+    """Keeps a GenServer fleet saturated and streams back trajectories.
+
+    ``prompt_source`` yields ``(sample_id, prompt_tokens)``;
+    ``current_version`` reports the trainer's weight version (for
+    staleness stamping/drops). ``max_inflight`` is the saturation
+    target -- set it to a multiple of the train batch so generation
+    runs ahead of consumption (e.g. 2x for the ISSUE-10 acceptance
+    geometry).
+    """
+
+    def __init__(self, clients: List,
+                 prompt_source: Iterator[Tuple[Hashable, np.ndarray]],
+                 *, max_inflight: int = 8,
+                 max_staleness: Optional[int] = None,
+                 current_version: Callable[[], int] = lambda: 0,
+                 ttl: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not clients:
+            raise ValueError("RolloutController needs >= 1 client.")
+        self.clients = list(clients)
+        self._source = iter(prompt_source)
+        self.max_inflight = max(1, int(max_inflight))
+        self.max_staleness = max_staleness
+        self._current_version = current_version
+        self._ttl = ttl
+        self._clock = clock
+        # rid -> (sid, prompt, client index)
+        self._pending: Dict[str, tuple] = {}
+        #: prompts bounced back (rejected / stale / dropped) -- they
+        #: resubmit ahead of fresh source prompts
+        self._requeue: List[Tuple[Hashable, np.ndarray]] = []
+        self._rr = 0
+        self._exhausted = False
+        # stats
+        self.submitted = 0
+        self.completed = 0
+        self.dropped_stale = 0
+        self.resubmits = 0
+        self.staleness_seen: List[int] = []
+        #: wall-clock with zero requests in flight while the source
+        #: still had prompts (the rollout-idle fraction numerator)
+        self.idle_secs = 0.0
+        self._last_pump = self._clock()
+
+    # ------------------------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        return len(self._pending)
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the prompt source is drained AND nothing is in
+        flight or waiting to resubmit."""
+        return (self._exhausted and not self._pending
+                and not self._requeue)
+
+    def _next_prompt(self):
+        if self._requeue:
+            return self._requeue.pop(0)
+        if self._exhausted:
+            return None
+        try:
+            return next(self._source)
+        except StopIteration:
+            self._exhausted = True
+            return None
+
+    def pump(self) -> int:
+        """Submit prompts until ``max_inflight`` are in flight (or the
+        source is drained). Returns how many were submitted."""
+        now = self._clock()
+        if self.inflight == 0 and not self.exhausted:
+            self.idle_secs += now - self._last_pump
+        self._last_pump = now
+        n = 0
+        spans_attrs = None
+        while self.inflight < self.max_inflight:
+            item = self._next_prompt()
+            if item is None:
+                break
+            sid, prompt = item
+            ci = self._rr % len(self.clients)
+            self._rr += 1
+            rid = self.clients[ci].submit(
+                np.asarray(prompt, np.int32), ttl=self._ttl)
+            self._pending[rid] = (sid, np.asarray(prompt, np.int32), ci)
+            self.submitted += 1
+            n += 1
+        if n:
+            spans_attrs = dict(n=n, inflight=self.inflight)
+            with tracing.span("rollout:submit", **spans_attrs):
+                pass
+            metrics.inc("serving_rollout_submitted_total", amount=n)
+        metrics.set_gauge("serving_rollout_inflight", self.inflight)
+        return n
+
+    def poll(self, timeout: float = 0.0) -> List[Trajectory]:
+        """Harvest every finished trajectory (waiting up to
+        ``timeout`` seconds for the first). Stale results are dropped
+        and resubmitted; rejected/bounced requests resubmit too."""
+        out: List[Trajectory] = []
+        cur = self._current_version()
+        for ci, client in enumerate(self.clients):
+            for res in client.poll_results(timeout=timeout):
+                ref = self._pending.pop(res.rid, None)
+                if ref is None:
+                    continue
+                sid, prompt, _ci = ref
+                if not res.ok:
+                    # rejected (backpressure), draining, expired,
+                    # server-side stale eviction: the prompt goes back
+                    # in line
+                    self._requeue.append((sid, prompt))
+                    self.resubmits += 1
+                    metrics.inc("serving_rollout_resubmits_total",
+                                reason=res.status)
+                    continue
+                wv = int(res.data.get("weight_version") or 0)
+                staleness = max(0, cur - wv)
+                if self.max_staleness is not None \
+                        and staleness > self.max_staleness:
+                    # finished under weights now too old to train on:
+                    # drop + regenerate under the fresh version
+                    self.dropped_stale += 1
+                    self.resubmits += 1
+                    self._requeue.append((sid, prompt))
+                    metrics.inc(
+                        "serving_rollout_dropped_stale_total")
+                    continue
+                self.completed += 1
+                self.staleness_seen.append(staleness)
+                metrics.inc("serving_rollout_completed_total")
+                metrics.observe("serving_rollout_staleness",
+                                staleness)
+                out.append(Trajectory(
+                    sid=sid, prompt=prompt,
+                    tokens=np.asarray(res.data["tokens"], np.int32),
+                    logprobs=np.asarray(
+                        res.data.get("logprobs", ()), np.float32),
+                    no_eos=bool(res.data.get("no_eos", False)),
+                    weight_version=wv, staleness=staleness,
+                    spec_proposed=int(
+                        res.data.get("spec_proposed") or 0),
+                    spec_accepted=int(
+                        res.data.get("spec_accepted") or 0)))
+            timeout = 0.0  # only the first client may block
+        if out:
+            with tracing.span("rollout:harvest", n=len(out),
+                              inflight=self.inflight):
+                pass
+        return out
+
+    def drain(self, timeout: float = 60.0) -> List[Trajectory]:
+        """Stop feeding and collect everything still in flight."""
+        deadline = self._clock() + timeout
+        out: List[Trajectory] = []
+        while self._pending and self._clock() < deadline:
+            out.extend(self.poll(timeout=0.05))
+        return out
+
+    def stats(self) -> dict:
+        stale = self.staleness_seen
+        return dict(
+            submitted=self.submitted, completed=self.completed,
+            dropped_stale=self.dropped_stale,
+            resubmits=self.resubmits, inflight=self.inflight,
+            idle_secs=round(self.idle_secs, 4),
+            staleness_mean=(float(np.mean(stale)) if stale else 0.0),
+            staleness_max=(int(max(stale)) if stale else 0),
+            staleness_hist={str(k): int(v) for k, v in zip(
+                *np.unique(stale, return_counts=True))} if stale
+            else {})
+
+
+# ----------------------------------------------------------------------
+def trajectories_to_sample(trajs: List[Trajectory]) -> SequenceSample:
+    """Pack harvested trajectories into the actor-gen output layout
+    (mirrors ``PPOActorInterface.generate``): per sequence,
+    ``packed_input_ids`` = prompt + generated tokens,
+    ``packed_logprobs`` (length l-1, zeros over the prompt) carries
+    the BEHAVIOR policy's sampling logprobs, ``prompt_mask`` marks the
+    prompt span, and ``seq_no_eos_mask`` the truncated sequences.
+    ``metadata['weight_version']`` stamps each sample for the
+    staleness-aware importance correction in ``interfaces/ppo.py``."""
+    if not trajs:
+        raise ValueError("no trajectories to pack")
+    seqlens, ids, in_ids, logprobs, prompt_mask = [], [], [], [], []
+    no_eos, versions, staleness = [], [], []
+    for t in trajs:
+        g = len(t.tokens)
+        l = len(t.prompt) + g
+        seqlens.append(l)
+        ids.append(t.sid)
+        in_ids.append(np.concatenate(
+            [np.asarray(t.prompt, np.int32),
+             np.asarray(t.tokens, np.int32)]))
+        lp = np.zeros(l - 1, np.float32)
+        lp[len(t.prompt) - 1:] = np.asarray(t.logprobs,
+                                            np.float32)[:g]
+        logprobs.append(lp)
+        prompt_mask.append(np.concatenate(
+            [np.ones(len(t.prompt), bool), np.zeros(g, bool)]))
+        no_eos.append(bool(t.no_eos))
+        versions.append(int(t.weight_version))
+        staleness.append(int(t.staleness))
+    data = dict(
+        seq_no_eos_mask=np.asarray(no_eos),
+        packed_input_ids=np.concatenate(in_ids).astype(np.int32),
+        packed_logprobs=np.concatenate(logprobs).astype(np.float32),
+        prompt_mask=np.concatenate(prompt_mask),
+    )
+    return SequenceSample.from_default(
+        ids=ids, seqlens=seqlens, data=data,
+        metadata=dict(weight_version=versions, staleness=staleness))

@@ -33,6 +33,7 @@ import sys
 #: an ERROR -- would otherwise vanish from CI silently.
 REQUIRED_DIRS = (
     "tests/analysis",
+    "tests/async_rlhf",
     "tests/base",
     "tests/chaos",
     "tests/engine",

@@ -65,14 +65,40 @@ def test_duplicate_producer_is_flagged():
     assert _codes(fs) == ["dfg-duplicate-key"]
 
 
-def test_batch_mismatch_is_flagged():
+def test_batch_mismatch_nondivisible_edge_is_now_fine():
+    """Per-sample buffer contract: producer and consumer n_seqs need
+    only share samples, not divide -- 10 -> 4 assembles across batch
+    boundaries and flushes the tail."""
     gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
                outputs=["seq"], n_seqs=10)
     train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
-                 inputs=["seq"], n_seqs=4)  # 10 % 4 != 0
+                 inputs=["seq"], n_seqs=4)
+    fs = validate_spec("bm", _spec([gen, train]), "exp.py", 1)
+    assert "dfg-batch-mismatch" not in _codes(fs)
+
+
+def test_batch_mismatch_flags_n_seqs_beyond_buffer_window():
+    """An MFC asking for more samples than max_concurrent_batches x
+    source n_seqs can never assemble a full batch (deadlock short of
+    the end-of-data flush)."""
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"], n_seqs=8)
+    train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
+                 inputs=["seq"], n_seqs=64)  # window = 2 * 8 = 16
+    spec = _spec([gen, train])
+    assert spec.max_concurrent_batches == 2
+    fs = validate_spec("bm", spec, "exp.py", 1)
+    assert "dfg-batch-mismatch" in _codes(fs)
+    assert any("buffer window" in f.message for f in fs)
+
+
+def test_batch_mismatch_flags_nonpositive_n_seqs():
+    gen = _mfc("gen", "actor", ModelInterfaceType.GENERATE,
+               outputs=["seq"], n_seqs=8)
+    train = _mfc("train", "actor", ModelInterfaceType.TRAIN_STEP,
+                 inputs=["seq"], n_seqs=0)
     fs = validate_spec("bm", _spec([gen, train]), "exp.py", 1)
     assert "dfg-batch-mismatch" in _codes(fs)
-    assert any("gen->train" in f.message for f in fs)
 
 
 def test_mesh_mismatch_on_shared_group_is_flagged():

@@ -1,0 +1,149 @@
+"""Staleness-aware off-policy consumption in PPOActorInterface
+(ISSUE 10 tentpole c): weight_version metadata -> staleness stats +
+clipped-IS correction stats, the max_staleness drop policy zeroing
+over-stale sequences out of the loss, and back-compat (no metadata =>
+no new stats, bit-identical sync path)."""
+
+import numpy as np
+
+import jax
+
+from realhf_tpu.api import model as model_api
+from realhf_tpu.api.config import ModelName
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.engine.engine import Engine
+from realhf_tpu.engine.optim import OptimizerConfig
+from realhf_tpu.interfaces.ppo import PPOActorInterface
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+from realhf_tpu.parallel.mesh import MeshContext, ParallelismConfig, \
+    make_mesh
+
+VOCAB = 64
+
+
+class FakeTokenizer:
+    pad_token_id = 0
+    eos_token_id = 1
+
+
+def build_actor(lr=1e-3, seed=0):
+    cfg = TransformerConfig(
+        n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+        intermediate_dim=64, vocab_size=VOCAB, apply_rotary=True,
+        layer_norm_type="rms", mlp_type="llama",
+        use_attention_bias=False, use_attn_proj_bias=False,
+        use_mlp_bias=False, activation_function="silu",
+        compute_dtype="float32")
+    parallel = ParallelismConfig(data_parallel_size=2,
+                                 tensor_parallel_size=4)
+    ctx = MeshContext(ModelName("actor", 0), make_mesh(parallel),
+                      parallel)
+    params = T.init_params(cfg, jax.random.PRNGKey(seed))
+    engine = Engine(cfg, ctx, params,
+                    optimizer=OptimizerConfig(
+                        lr=lr, warmup_steps_proportion=0.0,
+                        lr_scheduler_type="constant"),
+                    total_train_steps=1000)
+    return model_api.Model(ModelName("actor", 0), engine,
+                           FakeTokenizer())
+
+
+def train_sample(rng, n=4, versions=None):
+    """Synthetic post-rollout train batch (no generation needed)."""
+    seqlens, flat_ids, logp, pmask, values = [], [], [], [], []
+    for _ in range(n):
+        pl, gl = 3, 5
+        l = pl + gl
+        seqlens.append(l)
+        flat_ids.append(rng.integers(2, VOCAB, size=l)
+                        .astype(np.int32))
+        lp = np.zeros(l - 1, np.float32)
+        lp[pl - 1:] = rng.normal(-1.0, 0.1, gl).astype(np.float32)
+        logp.append(lp)
+        pmask.append(np.concatenate(
+            [np.ones(pl, bool), np.zeros(gl, bool)]))
+        values.append(rng.normal(0, 0.1, l).astype(np.float32))
+    data = dict(
+        packed_input_ids=np.concatenate(flat_ids),
+        packed_logprobs=np.concatenate(logp),
+        packed_ref_logprobs=np.concatenate(logp) * 0.9,
+        prompt_mask=np.concatenate(pmask),
+        rewards=rng.normal(0, 1, n).astype(np.float32),
+        values=np.concatenate(values),
+        seq_no_eos_mask=np.zeros(n, bool),
+    )
+    metadata = None
+    if versions is not None:
+        metadata = dict(weight_version=list(versions))
+    return SequenceSample.from_default(
+        ids=list(range(n)), seqlens=seqlens, data=data,
+        metadata=metadata)
+
+
+def _advance_version(model, k):
+    for _ in range(k):
+        model.inc_version()
+
+
+def test_fresh_metadata_reports_zero_staleness():
+    actor = build_actor()
+    itf = PPOActorInterface(n_minibatches=1,
+                            gconfig=GenerationHyperparameters(),
+                            adv_norm=True, max_staleness=2)
+    rng = np.random.default_rng(0)
+    stats = itf.train_step(actor, train_sample(rng, versions=[0] * 4))
+    assert stats["staleness_mean"] == 0.0
+    assert stats["stale_seq_frac"] == 0.0
+    assert stats["n_dropped_stale"] == 0
+    assert np.isclose(stats["stale_is_weight"], 1.0)
+    assert np.isfinite(stats["actor_loss"])
+    assert "importance_weight" in stats
+
+
+def test_stale_samples_get_clipped_is_and_stats():
+    actor = build_actor()
+    itf = PPOActorInterface(n_minibatches=1,
+                            gconfig=GenerationHyperparameters(),
+                            adv_norm=True, max_staleness=10,
+                            staleness_is_clip=2.0)
+    _advance_version(actor, 3)  # trainer at v3
+    rng = np.random.default_rng(1)
+    stats = itf.train_step(
+        actor, train_sample(rng, versions=[3, 2, 1, 0]))
+    assert np.isclose(stats["staleness_mean"], (0 + 1 + 2 + 3) / 4)
+    assert stats["staleness_max"] == 3
+    assert stats["stale_seq_frac"] == 0.75
+    assert stats["n_dropped_stale"] == 0
+    # synthetic behavior logprobs differ from the current policy's, so
+    # the truncated-IS weight moves off 1 but stays inside the clip
+    w = stats["stale_is_weight"]
+    assert np.isfinite(w) and 0.5 <= w <= 2.0 and w != 1.0
+
+
+def test_overstale_sequences_drop_out_of_the_loss():
+    actor = build_actor()
+    itf = PPOActorInterface(n_minibatches=1,
+                            gconfig=GenerationHyperparameters(),
+                            adv_norm=True, max_staleness=1)
+    _advance_version(actor, 5)  # trainer at v5
+    rng = np.random.default_rng(2)
+    stats = itf.train_step(
+        actor, train_sample(rng, versions=[5, 4, 0, 0]))
+    assert stats["n_dropped_stale"] == 2
+    # dropped sequences leave the token count (5 loss tokens/seq:
+    # l-1 = 7 shifted positions minus 2 prompt-predicted ones)
+    assert stats["n_tokens"] == 2 * 5
+
+
+def test_no_metadata_is_the_unchanged_sync_path():
+    actor = build_actor()
+    itf = PPOActorInterface(n_minibatches=1,
+                            gconfig=GenerationHyperparameters(),
+                            adv_norm=True, max_staleness=2)
+    rng = np.random.default_rng(3)
+    stats = itf.train_step(actor, train_sample(rng, versions=None))
+    assert "staleness_mean" not in stats
+    assert "stale_is_weight" not in stats
+    assert np.isfinite(stats["actor_loss"])

@@ -1,0 +1,140 @@
+"""RolloutController on a fake client: saturation pumping,
+staleness-aware drops + resubmits, rejected-request requeue, and the
+trajectory -> actor-gen SequenceSample packing (weight_version
+metadata for the PPO clipped-IS correction)."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.serving.server import RolloutResult
+from realhf_tpu.system.rollout import (
+    RolloutController,
+    trajectories_to_sample,
+)
+
+
+class FakeClient:
+    """Scriptable client: submitted requests finish when the test says
+    so, with a configurable weight_version per completion."""
+
+    def __init__(self):
+        self.submitted = {}     # rid -> prompt
+        self._done = []
+        self._n = 0
+
+    def submit(self, prompt, ttl=None, **kw):
+        rid = f"r{self._n}"
+        self._n += 1
+        self.submitted[rid] = np.asarray(prompt, np.int32)
+        return rid
+
+    def finish(self, rid, *, weight_version=0, status="done",
+               new_tokens=3):
+        p = self.submitted.pop(rid)
+        data = {}
+        if status == "done":
+            data = dict(tokens=np.arange(2, 2 + new_tokens,
+                                         dtype=np.int32),
+                        logprobs=np.full(new_tokens, -0.5, np.float32),
+                        no_eos=True, weight_version=weight_version)
+        self._done.append(RolloutResult(rid, status, data))
+
+    def poll_results(self, timeout=0.0):
+        out, self._done = self._done, []
+        return out
+
+
+def prompts(n, start=0):
+    return iter([(f"s{i}", np.full(4, 7, np.int32))
+                 for i in range(start, start + n)])
+
+
+def test_pump_saturates_and_harvest_stamps_staleness():
+    cl = FakeClient()
+    version = [3]
+    ctl = RolloutController([cl], prompts(10), max_inflight=4,
+                            current_version=lambda: version[0])
+    assert ctl.pump() == 4
+    assert ctl.inflight == 4
+    assert ctl.pump() == 0          # already saturated
+    for rid in list(cl.submitted):
+        cl.finish(rid, weight_version=2)
+    trajs = ctl.poll()
+    assert len(trajs) == 4
+    assert all(t.weight_version == 2 and t.staleness == 1
+               for t in trajs)
+    assert ctl.inflight == 0
+    ctl.pump()
+    assert ctl.inflight == 4        # keeps the fleet saturated
+    st = ctl.stats()
+    assert st["submitted"] == 8 and st["completed"] == 4
+    assert st["staleness_hist"] == {"1": 4}
+
+
+def test_overstale_results_drop_and_resubmit():
+    cl = FakeClient()
+    version = [10]
+    ctl = RolloutController([cl], prompts(2), max_inflight=2,
+                            max_staleness=1,
+                            current_version=lambda: version[0])
+    ctl.pump()
+    rids = list(cl.submitted)
+    cl.finish(rids[0], weight_version=8)   # staleness 2 > 1 -> drop
+    cl.finish(rids[1], weight_version=9)   # staleness 1 -> keep
+    trajs = ctl.poll()
+    assert [t.staleness for t in trajs] == [1]
+    assert ctl.dropped_stale == 1
+    # the dropped prompt resubmits ahead of fresh source prompts
+    ctl.pump()
+    assert ctl.inflight == 1
+    (rid,) = list(cl.submitted)
+    cl.finish(rid, weight_version=10)
+    (t,) = ctl.poll()
+    assert t.sid == "s0" and t.staleness == 0
+    assert ctl.exhausted
+
+
+def test_rejected_requests_requeue():
+    cl = FakeClient()
+    ctl = RolloutController([cl], prompts(1), max_inflight=1)
+    ctl.pump()
+    (rid,) = list(cl.submitted)
+    cl.finish(rid, status="rejected")
+    assert ctl.poll() == []
+    assert ctl.resubmits == 1
+    ctl.pump()
+    (rid2,) = list(cl.submitted)
+    cl.finish(rid2, weight_version=0)
+    (t,) = ctl.poll()
+    assert t.sid == "s0"
+
+
+def test_trajectories_to_sample_matches_actor_gen_layout():
+    from realhf_tpu.system.rollout import Trajectory
+
+    trajs = [
+        Trajectory(sid=(0, i), prompt=np.full(4, 7, np.int32),
+                   tokens=np.arange(2, 2 + 3, dtype=np.int32),
+                   logprobs=np.full(3, -0.5, np.float32),
+                   no_eos=bool(i % 2), weight_version=i, staleness=i)
+        for i in range(2)]
+    s = trajectories_to_sample(trajs)
+    assert s.bs == 2
+    assert s.keys == {"seq_no_eos_mask", "packed_input_ids",
+                      "packed_logprobs", "prompt_mask"}
+    assert s.metadata["weight_version"] == [0, 1]
+    assert s.metadata["staleness"] == [0, 1]
+    # per sequence: l = 4 + 3; logprobs length l-1 with zeros over the
+    # prompt span and the sampling logprobs over the generated span
+    lp = s.data["packed_logprobs"]
+    assert lp.shape == (12,)
+    np.testing.assert_allclose(lp[:3], 0.0)
+    np.testing.assert_allclose(lp[3:6], -0.5)
+    pm = s.data["prompt_mask"]
+    assert pm[:4].all() and not pm[4:7].any()
+    assert list(s.data["seq_no_eos_mask"]) == [False, True]
+
+
+def test_empty_pack_raises():
+    with pytest.raises(ValueError):
+        trajectories_to_sample([])

@@ -1,0 +1,233 @@
+"""Per-sample SequenceBuffer (ISSUE 10 tentpole): readiness masks,
+per-MFC n_seqs assembly across dataset-batch boundaries, consumption
+watermarks, partial-tail flush, invalidation rollback, and the
+state_dict round-trip incl. the v3->v4 RecoverInfo (schema-1 ->
+schema-2 buffer payload) upgrade. Synthetic metadata only -- no
+models, no engines."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.api.data import SequenceSample
+from realhf_tpu.base import constants, recover
+from realhf_tpu.system.buffer import SequenceBuffer
+
+
+@pytest.fixture(autouse=True)
+def _trial_names():
+    constants.set_experiment_trial_names("asyncbuf", "t0")
+    yield
+
+
+def meta(ids, keys=("packed_prompts",)):
+    return SequenceSample(
+        keys=list(keys), trailing_shapes={k: () for k in keys},
+        dtypes={k: np.int32 for k in keys}, ids=list(ids),
+        seqlens={k: [[4] for _ in ids] for k in keys})
+
+
+GEN, TRAIN = "gen", "train"
+
+
+def make_buf(n_gen=4, n_train=2, capacity=4):
+    return SequenceBuffer(
+        [GEN, TRAIN], capacity=capacity,
+        n_seqs_of={GEN: n_gen, TRAIN: n_train},
+        input_keys_of={GEN: ("packed_prompts",), TRAIN: ("tokens",)},
+        producers_of={GEN: (), TRAIN: (GEN,)})
+
+
+def complete(buf, asm, out_keys=()):
+    out = meta(asm.sids, keys=out_keys) if out_keys else None
+    buf.mark_assembly_dispatched(asm.aid)
+    buf.complete_assembly(asm.aid, out, "w/1")
+
+
+# ----------------------------------------------------------------------
+def test_per_sample_readiness_and_watermarks():
+    buf = make_buf()
+    buf.put_batch(meta(["a", "b", "c", "d"]), "w/0", 0, False)
+    assert buf.ready_count(GEN) == 4
+    assert buf.ready_count(TRAIN) == 0  # tokens not produced yet
+    (asm,) = buf.ready_assemblies()
+    assert asm.mfc == GEN and asm.sids == ["a", "b", "c", "d"]
+    assert buf.claimed(GEN) == 4 and buf.consumed(GEN) == 0
+    complete(buf, asm, out_keys=("tokens",))
+    assert buf.consumed(GEN) == 4
+    # gen's outputs make train ready at ITS granularity (2): two
+    # assemblies drain the four samples
+    asms = buf.ready_assemblies()
+    assert [a.mfc for a in asms] == [TRAIN, TRAIN]
+    assert [a.sids for a in asms] == [["a", "b"], ["c", "d"]]
+    assert [a.end_mark for a in asms] == [2, 4]
+
+
+def test_assembly_spans_dataset_batches():
+    """train n_seqs=2 over two 3-sample dataset batches: the middle
+    assembly takes one sample from each batch -- the lockstep->
+    pipeline transition in miniature."""
+    buf = make_buf(n_gen=3, n_train=2)
+    buf.put_batch(meta(["a", "b", "c"]), "w/0", 0, False)
+    buf.put_batch(meta(["d", "e", "f"]), "w/0", 0, False)
+    for asm in buf.ready_assemblies():
+        complete(buf, asm, out_keys=("tokens",))
+    asms = buf.ready_assemblies()
+    assert [a.sids for a in asms] == [["a", "b"], ["c", "d"],
+                                      ["e", "f"]]
+    # the spanning assembly anchors to its FIRST sample's batch
+    assert asms[1].primary_bid == 0
+    for asm in asms:
+        complete(buf, asm)
+    retired = buf.pop_retired()
+    assert [e.batch_id for e in retired] == [0, 1]
+    assert buf.n_samples == 0
+    # watermarks survive retirement
+    assert buf.consumed(TRAIN) == 6 and buf.consumed(GEN) == 6
+
+
+def test_partial_tail_flush_requires_drained_upstream():
+    buf = make_buf(n_gen=3, n_train=2)
+    buf.put_batch(meta(["a", "b", "c"]), "w/0", 0, True)
+    (g,) = buf.ready_assemblies()
+    complete(buf, g, out_keys=("tokens",))
+    # 3 ready, n_train=2 -> one full assembly; the tail of 1 only
+    # flushes when asked AND upstream is drained
+    (t1,) = buf.ready_assemblies()
+    assert t1.sids == ["a", "b"]
+    buf.mark_assembly_dispatched(t1.aid)
+    assert buf.ready_assemblies() == []          # no flush requested
+    (t2,) = buf.ready_assemblies(flush=[TRAIN])  # tail of one
+    assert t2.sids == ["c"]
+    buf.mark_assembly_dispatched(t2.aid)
+    buf.complete_assembly(t1.aid, None, "w/1")
+    buf.complete_assembly(t2.aid, None, "w/1")
+    assert [e.batch_id for e in buf.pop_retired()] == [0]
+
+
+def test_no_flush_while_upstream_pending():
+    buf = make_buf(n_gen=2, n_train=2)
+    buf.put_batch(meta(["a", "b"]), "w/0", 0, False)
+    buf.put_batch(meta(["c", "d"]), "w/0", 0, False)
+    g1, g2 = buf.ready_assemblies()
+    buf.mark_assembly_dispatched(g2.aid)  # in flight on a worker
+    complete(buf, g1, out_keys=("tokens",))
+    # g2 still pending: a flush must NOT emit a short train batch
+    # that upstream work could still fill
+    asms = buf.ready_assemblies(flush=[TRAIN])
+    assert [a.sids for a in asms] == [["a", "b"]]
+
+
+def test_release_and_redispatch_same_assembly():
+    buf = make_buf(n_gen=2, n_train=2)
+    buf.put_batch(meta(["a", "b"]), "w/0", 0, False)
+    (asm,) = buf.ready_assemblies()
+    buf.mark_assembly_dispatched(asm.aid)
+    assert buf.ready_assemblies() == []   # in flight
+    buf.release_assembly(asm.aid)         # worker lost
+    (again,) = buf.ready_assemblies()
+    assert again.aid == asm.aid and again.sids == ["a", "b"]
+    assert buf.claimed(GEN) == 2          # claims never double-count
+
+
+def test_owner_exact_plan_and_invalidation():
+    buf = make_buf(n_gen=2, n_train=2)
+    buf.put_batch(meta(["a", "b"]), "w/0", 0, False)
+    (g,) = buf.ready_assemblies()
+    buf.mark_assembly_dispatched(g.aid)
+    buf.complete_assembly(g.aid, meta(["a", "b"], keys=("tokens",)),
+                          "w/1")
+    (t,) = buf.ready_assemblies()
+    assert buf.assembly_plan(t.aid) == {"tokens": {"w/1": ["a", "b"]}}
+    assert buf.plan_owners(t.aid) == {"w/1"}
+    # w/1 dies without grace: tokens invalidated, producer re-marked,
+    # the reserved consumer assembly loses readiness until recompute
+    recs = buf.invalidate_worker_outputs(["w/1"], {"tokens": GEN})
+    assert recs == [(0, GEN, ["tokens"])]
+    assert not buf.assembly_ready(t.aid)
+    assert buf.consumed(GEN) == 0         # watermark rolled back
+    # the producer re-assembles; the reserved consumer assembly is
+    # re-offered but stays undispatchable until the recompute lands
+    # (the master's _dispatchable gates on assembly_ready)
+    fresh = [a for a in buf.ready_assemblies()
+             if buf.assembly_ready(a.aid)]
+    assert [(a.mfc, a.sids) for a in fresh] == [(GEN, ["a", "b"])]
+    complete(buf, fresh[0], out_keys=("tokens",))
+    assert buf.assembly_ready(t.aid)      # consumer ready again
+
+
+def test_rescue_plan_and_rehome():
+    buf = make_buf()
+    buf.put_batch(meta(["a", "b", "c", "d"]), "w/0", 0, False)
+    assert buf.rescue_plan("w/0") == [
+        dict(ids=["a", "b", "c", "d"], keys=["packed_prompts"])]
+    buf.rehome_owner("w/0", "w/9")
+    assert buf.rescue_plan("w/0") == []
+    e = buf.get(0)
+    assert set(e.key_owner.values()) == {"w/9"}
+
+
+# ----------------------------------------------------------------------
+def test_state_dict_round_trip_per_sample():
+    buf = make_buf(n_gen=2, n_train=2)
+    buf.put_batch(meta(["a", "b"]), "w/0", 0, False)
+    buf.put_batch(meta(["c", "d"]), "w/0", 0, True)
+    (g1, g2) = buf.ready_assemblies()
+    complete(buf, g1, out_keys=("tokens",))  # batch 0 gen done
+    state = buf.state_dict()
+    assert state["version"] == SequenceBuffer.STATE_VERSION == 2
+
+    buf2 = make_buf(n_gen=2, n_train=2)
+    buf2.load_state_dict(state)
+    assert buf2.batch_ids() == [0, 1]
+    assert buf2.next_batch_id == 2
+    # completion survived per sample; unfinished work re-assembles
+    assert buf2.consumed(GEN) == 2
+    asms = buf2.ready_assemblies()
+    assert sorted((a.mfc, tuple(a.sids)) for a in asms) == [
+        (GEN, ("c", "d")), (TRAIN, ("a", "b"))]
+
+
+def test_v3_to_v4_recover_upgrade():
+    """A v3-era RecoverInfo carries the per-batch 'entries' buffer
+    payload; v4 code loads it and upgrades to uniform per-sample
+    completion."""
+    legacy_state = {
+        "next_id": 5,
+        "entries": [dict(
+            batch_id=3, meta=meta(["x", "y"]),
+            key_owner={"packed_prompts": "w/0"},
+            completed=[GEN], epoch=1, is_epoch_last=False)],
+    }
+    info = recover.RecoverInfo(buffer_state=legacy_state)
+    info.version = 3
+    recover.dump(info)
+    back = recover.load_safe()
+    assert back is not None and back.version == 3
+
+    buf = make_buf(n_gen=2, n_train=2)
+    buf.load_state_dict(back.buffer_state)
+    assert buf.next_batch_id == 5
+    assert buf.batch_ids() == [3]
+    e = buf.get(3)
+    assert e.completed == {GEN}
+    assert e.epoch == 1
+    assert buf.consumed(GEN) == 2 and buf.consumed(TRAIN) == 0
+    # and the re-dump is schema 2
+    assert buf.state_dict()["version"] == 2
+
+
+def test_legacy_batch_api_still_aligned():
+    """ready_mfcs/amend_batch/mark_dispatched keep their per-batch
+    semantics over the per-sample state (old callers + PR1-9 tests)."""
+    buf = SequenceBuffer([GEN, TRAIN], capacity=2)
+    bid = buf.put_batch(meta(["a", "b"]), "w/0", 0, False)
+    keys = {GEN: ("packed_prompts",), TRAIN: ("tokens",)}
+    assert buf.ready_mfcs(keys) == [(bid, GEN)]
+    buf.mark_dispatched(bid, GEN)
+    assert buf.ready_mfcs(keys) == []
+    buf.amend_batch(bid, meta(["a", "b"], keys=("tokens",)), "w/1",
+                    GEN)
+    assert buf.ready_mfcs(keys) == [(bid, TRAIN)]
+    buf.mark_dispatched(bid, TRAIN)
+    buf.amend_batch(bid, None, "w/0", TRAIN)
+    assert [e.batch_id for e in buf.pop_finished()] == [bid]

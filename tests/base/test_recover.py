@@ -49,10 +49,11 @@ def test_recover_info_v2_round_trip(tmp_path, monkeypatch):
     recover.dump(info)
     assert recover.exists()
     back = recover.load()
-    # v3 adds ckpt_manifests (tests/recovery/test_recover_schema.py
-    # covers the v1->v2->v3 upgrade chain); the v2-era payload must
-    # keep round-tripping unchanged
-    assert back.version == recover.RECOVER_INFO_VERSION == 3
+    # v3 added ckpt_manifests, v4 switched buffer_state to the
+    # per-sample snapshot (tests/recovery/test_recover_schema.py and
+    # tests/async_rlhf cover the upgrade chain); the v2-era payload
+    # must keep round-tripping unchanged
+    assert back.version == recover.RECOVER_INFO_VERSION == 4
     assert back.ckpt_manifests is None
     assert back.recover_start == info.recover_start
     assert back.last_step_info == info.last_step_info

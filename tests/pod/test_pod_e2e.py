@@ -83,15 +83,15 @@ def test_kill_host_unknown_or_idle_host_is_noop():
 def prompt_data(tmp_path):
     rng = np.random.default_rng(1)
     path = tmp_path / "prompts.jsonl"
-    # one epoch covers the whole trial: sample ids repeat across
-    # epochs, and with max_concurrent_batches > 1 an epoch boundary
-    # lets a finishing batch's clear_data_cache delete an id an
-    # in-flight next-epoch batch still needs (pre-existing runtime
-    # limitation, noted in ROADMAP item 1's buffer-granularity work)
+    # 80 prompts / bs 8 = 10 batches per epoch: the 16-step trial now
+    # CROSSES an epoch boundary with max_concurrent_batches=2 -- safe
+    # since ISSUE 10 epoch-qualified the data ids (a finishing batch's
+    # clear_data_cache can no longer delete a raw id an in-flight
+    # next-epoch batch still needs)
     write_jsonl(path, [
         {"id": i,
          "prompt": " ".join(f"w{int(x)}" for x in rng.integers(0, 50, 4))}
-        for i in range(160)])
+        for i in range(80)])
     return str(path)
 
 
@@ -121,7 +121,7 @@ def test_pod_host_loss_degrade_rejoin_e2e(prompt_data, tmp_path,
     monkeypatch.setenv("REALHF_TPU_TRACE", "1")  # launcher-side merge
     exp, trial = "pode2e", "t0"
     cfg = PPOConfig(experiment_name=exp, trial_name=trial,
-                    total_train_epochs=1, benchmark_steps=16)
+                    total_train_epochs=2, benchmark_steps=16)
     apply_overrides(cfg, {
         "dataset.path": prompt_data,
         "dataset.train_bs_n_seqs": "8",

@@ -1,7 +1,9 @@
 """RecoverInfo schema-upgrade coverage (ISSUE 4 satellite): the
-v1 -> v2 -> v3 `_upgrade` chain round-trips, truncated dumps degrade
-to fresh starts, and future versions are tolerated -- each vintage
-simulated exactly as pickle restores it (__dict__ verbatim)."""
+v1 -> v2 -> v3 -> v4 `_upgrade` chain round-trips, truncated dumps
+degrade to fresh starts, and future versions are tolerated -- each
+vintage simulated exactly as pickle restores it (__dict__ verbatim).
+The v3 -> v4 buffer-payload upgrade (per-batch "entries" -> per-sample
+"batches") is covered in tests/async_rlhf/test_sample_buffer.py."""
 
 import pytest
 
@@ -28,17 +30,33 @@ def _strip_to_vintage(info, version):
     return info
 
 
-def test_v3_round_trip_with_ckpt_manifests():
+def test_v4_round_trip_with_ckpt_manifests():
     info = recover.RecoverInfo(
         recover_start=recover.StepInfo(epoch=1, global_step=5),
         hash_vals_to_ignore=["a"],
         ckpt_manifests={"actor": "/ckpt/actor/step_00000005/manifest.json"})
     recover.dump(info)
     back = recover.load()
-    assert back.version == recover.RECOVER_INFO_VERSION == 3
+    assert back.version == recover.RECOVER_INFO_VERSION == 4
     assert back.ckpt_manifests == {
         "actor": "/ckpt/actor/step_00000005/manifest.json"}
     assert back.recover_start.global_step == 5
+
+
+def test_v3_pickle_upgrades_preserving_version_label():
+    """A v3 dump (per-batch buffer entries) loads under v4 code: no
+    dataclass fields changed, so the upgrade only has to preserve the
+    payload -- SequenceBuffer.load_state_dict converts the nested
+    entries form (tests/async_rlhf/test_sample_buffer.py)."""
+    info = recover.RecoverInfo(
+        recover_start=recover.StepInfo(epoch=1, global_step=9),
+        buffer_state={"next_id": 3, "entries": []})
+    info.version = 3
+    recover.dump(info)
+    back = recover.load_safe()
+    assert back is not None
+    assert back.version == 3               # written-by label preserved
+    assert back.buffer_state == {"next_id": 3, "entries": []}
 
 
 def test_v2_pickle_upgrades_preserving_version_label():
@@ -83,7 +101,7 @@ def test_upgraded_v1_redump_becomes_current_schema():
     back.ckpt_manifests = {"default": "/m.json"}
     recover.dump(back)
     again = recover.load()
-    assert again.version == 3
+    assert again.version == 4
     assert again.ckpt_manifests == {"default": "/m.json"}
 
 

@@ -269,7 +269,7 @@ def test_durable_ckpt_corruption_falls_back_on_recovery_e2e(
 
     info = recover.load_safe()
     assert info is not None
-    assert info.version == recover.RECOVER_INFO_VERSION == 3
+    assert info.version == recover.RECOVER_INFO_VERSION == 4
     assert info.ckpt_manifests and "default" in info.ckpt_manifests
 
     from realhf_tpu.base import constants
