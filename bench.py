@@ -751,6 +751,25 @@ def _bench_async():
     return out
 
 
+def _bench_agentic():
+    """Multi-turn env-in-the-loop rollout bench in a CPU-forced
+    subprocess (scripts/bench_agentic.py): tool-game episodes through
+    a real RolloutServer vs the inline local backend, reporting
+    turns/s and the env-step/generation overlap fraction."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_agentic.py")
+    r = subprocess.run(
+        [sys.executable, script],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench_agentic exited {r.returncode}: {r.stderr[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def _bench_serving_hotpath():
     """Serving hot-path load bench in a CPU-forced subprocess
     (scripts/bench_serving.py): shared-prefix vs disjoint traffic
@@ -877,6 +896,16 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort phase
         extra["async_bench"] = {"error": repr(e)}
     phases_done.append("async_bench")
+    _flush_payload(headline, extra, phases_done)
+
+    # Agentic multi-turn rollouts (ISSUE 11): env-in-the-loop episodes
+    # through the serving path vs the inline backend -- turns/s and
+    # the env-step/generation overlap fraction.
+    try:
+        extra["agentic_bench"] = _bench_agentic()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["agentic_bench"] = {"error": repr(e)}
+    phases_done.append("agentic_bench")
     _flush_payload(headline, extra, phases_done)
 
     # Reshard + cross-group sync (north-star metric): best-effort on

@@ -1,0 +1,38 @@
+"""Environment-in-the-loop agentic RL (docs/agentic.md).
+
+The multi-turn / tool-use workload subsystem (ROADMAP item 2): token-
+level :class:`Env` protocol + registry with a verifiable-reward
+checker task and a multi-turn tool-call game, an
+:class:`EpisodeRunner` driving concurrent episodes through the
+``RolloutClient`` protocol (serving fleet or the in-process
+:class:`LocalRolloutBackend`), and trajectory-structured
+``SequenceSample`` assembly feeding the existing per-sample buffer /
+PPO pipeline unchanged. Importing this package registers the
+``agentic_actor`` interface and the envs."""
+
+from realhf_tpu.agentic.env import (  # noqa: F401
+    ALL_ENV_CLASSES,
+    CheckerEnv,
+    Env,
+    EnvStep,
+    ToolGameEnv,
+    make_env,
+    register_env,
+)
+from realhf_tpu.agentic.episode import (  # noqa: F401
+    Episode,
+    EpisodeRunner,
+    Turn,
+)
+from realhf_tpu.agentic.local import (  # noqa: F401
+    GenResult,
+    LocalRolloutBackend,
+    engine_generate_fn,
+)
+from realhf_tpu.agentic.trajectory import (  # noqa: F401
+    episode_to_trajectory,
+    episodes_to_sample,
+    turn_segments,
+)
+
+import realhf_tpu.agentic.interface  # noqa: F401  (registers itself)

@@ -1,0 +1,133 @@
+"""In-process rollout backend speaking the ``RolloutClient`` protocol.
+
+The :class:`~realhf_tpu.agentic.episode.EpisodeRunner` drives episodes
+through whatever implements ``submit / poll_results / abandon /
+close`` -- in production that is the ZMQ
+:class:`~realhf_tpu.serving.server.RolloutClient` against the
+GenServer fleet; for the inline runner and tier-1 tests this module
+provides :class:`LocalRolloutBackend`, which fulfils requests by
+calling a batched ``generate_fn`` directly (no sockets, no threads, no
+server).
+
+``generate_fn`` takes a list of prompt-token arrays and returns one
+:class:`GenResult` per prompt; :func:`engine_generate_fn` builds one
+from a real :class:`~realhf_tpu.engine.engine.Engine` (the
+AgenticActorInterface path), and tests pass scripted callables."""
+
+import dataclasses
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from realhf_tpu.serving.server import RolloutResult
+
+
+@dataclasses.dataclass
+class GenResult:
+    """One prompt's generation, as ``generate_fn`` returns it."""
+    tokens: np.ndarray
+    logprobs: np.ndarray
+    no_eos: bool = False
+
+
+class LocalRolloutBackend:
+    """Batched, in-process stand-in for ``RolloutClient``.
+
+    Submissions queue up; every ``poll_results`` call runs ONE batched
+    ``generate_fn`` over everything pending (continuous batching's
+    degenerate, synchronous form) and returns the finished
+    ``RolloutResult`` s stamped with ``version_fn()`` -- the weight
+    version the batch was generated under."""
+
+    def __init__(self, generate_fn: Callable[[List[np.ndarray]],
+                                             List[GenResult]],
+                 *, version_fn: Callable[[], int] = lambda: 0,
+                 max_batch: Optional[int] = None):
+        self._generate_fn = generate_fn
+        self._version_fn = version_fn
+        self._max_batch = max_batch
+        self._queue: Dict[str, np.ndarray] = {}
+        self.generated = 0
+        self.batches = 0
+
+    # -- RolloutClient protocol ----------------------------------------
+    def submit(self, prompt, priority=None, ttl=None,
+               rid: Optional[str] = None,
+               min_weight_version: int = 0) -> str:
+        rid = rid or uuid.uuid4().hex
+        self._queue[rid] = np.asarray(prompt, np.int32)
+        return rid
+
+    def cancel(self, rid: str):
+        self._queue.pop(rid, None)
+
+    def abandon(self, rid: str):
+        """Cancel + forget -- mirror of ``RolloutClient.abandon``; the
+        local queue IS the only state, so dropping the entry is the
+        whole contract."""
+        self._queue.pop(rid, None)
+
+    def poll_results(self, timeout: float = 0.0) -> List[RolloutResult]:
+        if not self._queue:
+            return []
+        rids = list(self._queue)
+        if self._max_batch is not None:
+            rids = rids[:self._max_batch]
+        prompts = [self._queue.pop(r) for r in rids]
+        version = int(self._version_fn())
+        outs = self._generate_fn(prompts)
+        if len(outs) != len(prompts):
+            raise ValueError(
+                f"generate_fn returned {len(outs)} results for "
+                f"{len(prompts)} prompts")
+        self.generated += len(outs)
+        self.batches += 1
+        return [
+            RolloutResult(rid=rid, status="done", data=dict(
+                tokens=np.asarray(o.tokens, np.int32),
+                logprobs=np.asarray(o.logprobs, np.float32),
+                no_eos=bool(o.no_eos), weight_version=version))
+            for rid, o in zip(rids, outs)
+        ]
+
+    def close(self):
+        self._queue.clear()
+
+
+def engine_generate_fn(model, gconfig) -> Callable[[List[np.ndarray]],
+                                                   List[GenResult]]:
+    """A ``generate_fn`` over a real engine: left-padded batched
+    prefill + decode exactly like ``PPOActorInterface.generate``, one
+    fresh fold of the experiment-seeded PRNG per batch (SPMD-safe:
+    every worker-group member derives identical keys)."""
+    import jax
+
+    from realhf_tpu.engine import packing
+    from realhf_tpu.interfaces.ppo import _base_key
+
+    tok = model.tokenizer
+    calls = [0]
+
+    def generate(prompts: List[np.ndarray]) -> List[GenResult]:
+        ids, seg, pos = packing.left_padded_prompts(
+            prompts, pad_id=tok.pad_token_id)
+        calls[0] += 1
+        key = jax.random.fold_in(
+            jax.random.fold_in(_base_key(), calls[0]), 0x5EED)
+        out = model.engine.generate(
+            ids, seg, pos, key, gconfig,
+            eos_token_id=tok.eos_token_id,
+            pad_token_id=tok.pad_token_id).to_host()
+        gen_tokens = np.asarray(out.tokens)
+        gen_lp = np.asarray(out.logprobs)
+        gen_lens = np.asarray(out.lengths)
+        no_eos = np.asarray(out.no_eos_mask)
+        return [
+            GenResult(tokens=gen_tokens[i, :int(gen_lens[i])],
+                      logprobs=gen_lp[i, :int(gen_lens[i])],
+                      no_eos=bool(no_eos[i]))
+            for i in range(len(prompts))
+        ]
+
+    return generate

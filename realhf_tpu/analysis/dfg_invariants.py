@@ -20,6 +20,13 @@ model rests on:
   once (``max_concurrent_batches * source n_seqs``) -- such an MFC
   could never assemble a full batch and would deadlock the dispatch
   loop short of the end-of-data flush.
+- ``dfg-multiturn-batch``: an environment-in-the-loop generate MFC
+  (interface declares ``max_turns > 1``, ``realhf_tpu/agentic/``)
+  either is not a SOURCE node (episodes must enter the buffer from
+  the dataset, not from upstream MFC outputs), or some MFC's
+  ``n_seqs`` exceeds the episode window
+  ``max_concurrent_batches * gen n_seqs`` -- one episode yields one
+  buffer sample, so a larger batch can never assemble.
 - ``dfg-mesh-mismatch``: two MFCs placed on the SAME worker group
   whose layouts multiply to different world sizes -- a group has a
   fixed device count, so all layouts on it must use all of it.
@@ -114,6 +121,45 @@ def validate_spec(name: str, spec, path: str, line: int
                 f"{getattr(spec, 'max_concurrent_batches', 1)} x "
                 f"source n_seqs={src_n}) -- it can never assemble a "
                 "full batch"))
+
+    # --- multi-turn (agentic) MFCs vs the buffer window -----------------
+    # An env-in-the-loop generate MFC (interface declares max_turns>1,
+    # realhf_tpu/agentic/) emits exactly ONE buffer sample per episode,
+    # so it must be the graph's sample entry point (a source: episodes
+    # cannot be re-generated from upstream MFC outputs) and ITS n_seqs
+    # -- not the min over all sources -- bounds the ready-pool window
+    # every consumer draws from.
+    for node in spec.mfcs:
+        iargs = getattr(node.interface_impl, "args", None) or {}
+        if int(iargs.get("max_turns") or 1) <= 1:
+            continue
+        if str(getattr(node.interface_type, "value",
+                       node.interface_type)) != "generate":
+            continue
+        if any(k in G.graph["data_producers"] for k in node.input_keys):
+            producers = sorted({
+                G.graph["data_producers"][k].name
+                for k in node.input_keys
+                if k in G.graph["data_producers"]})
+            findings.append(finding(
+                "dfg-multiturn-batch",
+                f"multi-turn MFC `{node.name}` consumes keys produced "
+                f"by {producers} -- episodes must enter the per-sample "
+                "buffer as a SOURCE (dataset-fed) MFC"))
+            continue
+        mt_window = max(1, getattr(spec, "max_concurrent_batches", 1)) \
+            * node.n_seqs
+        for other in spec.mfcs:
+            if other.n_seqs > mt_window:
+                findings.append(finding(
+                    "dfg-multiturn-batch",
+                    f"MFC `{other.name}`: n_seqs={other.n_seqs} "
+                    f"exceeds the multi-turn episode window of "
+                    f"{mt_window} samples (max_concurrent_batches="
+                    f"{getattr(spec, 'max_concurrent_batches', 1)} x "
+                    f"`{node.name}` n_seqs={node.n_seqs}) -- episodes "
+                    "are produced one sample each, so it could never "
+                    "assemble a full batch"))
 
     # --- allocations name real MFCs, normalize cleanly -----------------
     node_names = {n.name for n in spec.mfcs}

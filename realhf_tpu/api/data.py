@@ -242,7 +242,7 @@ class SequenceSample:
     _KEYS_LEN_MINUS_1 = {
         "packed_logprobs", "logprobs", "packed_ref_logprobs", "ref_logprobs",
         "old_logp", "ref_logp", "advantages", "ppo_loss_mask", "kl_rewards",
-        "returns", "staleness",
+        "returns", "staleness", "dense_rewards",
     }
 
     @classmethod
@@ -379,6 +379,27 @@ def load_hf_tokenizer(path: str, fast: bool = True, padding_side: str = "left"):
     if tok.pad_token_id is None:
         tok.pad_token_id = tok.eos_token_id
     return tok
+
+
+def require_record_fields(records: List[Dict], required: Tuple[str, ...],
+                          loader: str, hint: str = "") -> List[Dict]:
+    """Validate loaded records up front so a malformed file fails with
+    the offending record named instead of a bare ``KeyError`` deep in
+    tokenization/collation. ``required`` fields must be present and
+    non-None on every record."""
+    for i, rec in enumerate(records):
+        if not isinstance(rec, dict):
+            raise ValueError(
+                f"{loader}: record {i} is {type(rec).__name__}, expected "
+                f"an object with fields {list(required)}.{hint}")
+        missing = [f for f in required if rec.get(f) is None]
+        if missing:
+            ident = rec.get("id", f"index {i}")
+            raise ValueError(
+                f"{loader}: record {ident!r} is missing required field"
+                f"{'s' if len(missing) > 1 else ''} {missing} "
+                f"(present: {sorted(rec)}).{hint}")
+    return records
 
 
 def load_shuffle_split_dataset(util: DatasetUtility, dataset_path: str,

@@ -2,10 +2,12 @@
 
 Parity with reference ``realhf/impl/dataset/__init__.py``: registered
 names are "prompt", "prompt_answer", "rw_pair", and "random_prompt"
-(synthetic data for profile/mock mode).
+(synthetic data for profile/mock mode); plus the agentic task
+datasets "checker_task" and "tool_game" (docs/agentic.md).
 """
 
 import realhf_tpu.datasets.prompt  # noqa: F401
 import realhf_tpu.datasets.prompt_answer  # noqa: F401
 import realhf_tpu.datasets.rw_paired  # noqa: F401
 import realhf_tpu.datasets.random_prompt  # noqa: F401
+import realhf_tpu.datasets.agentic  # noqa: F401

@@ -28,6 +28,10 @@ class PromptDataset:
 
         records = data_api.load_shuffle_split_dataset(
             util, dataset_path, dataset_builder)
+        data_api.require_record_fields(
+            records, ("prompt",), "PromptDataset",
+            hint=" Expected JSONL objects with a unique `id` and a "
+                 "text `prompt`.")
         self.ids = [x["id"] for x in records]
         util.tokenizer.padding_side = "left"
         enc = util.tokenizer(

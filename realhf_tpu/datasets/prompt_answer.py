@@ -27,6 +27,10 @@ class PromptAnswerDataset:
 
         records = data_api.load_shuffle_split_dataset(
             util, dataset_path, dataset_builder)
+        data_api.require_record_fields(
+            records, ("prompt", "answer"), "PromptAnswerDataset",
+            hint=" Expected JSONL objects with `id`, text `prompt` "
+                 "and text `answer`.")
         self.ids = [x["id"] for x in records]
         seqs = [x["prompt"] + x["answer"] + tokenizer.eos_token for x in records]
         self.tokens = tokenizer(

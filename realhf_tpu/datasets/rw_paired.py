@@ -31,6 +31,11 @@ class RewardModelingPairedDataset:
 
         records = data_api.load_shuffle_split_dataset(
             util, dataset_path, dataset_builder)
+        data_api.require_record_fields(
+            records, ("prompt", "pos_answers", "neg_answers"),
+            "RewardModelingPairedDataset",
+            hint=" Expected JSONL objects with `id`, text `prompt`, "
+                 "and paired `pos_answers`/`neg_answers` lists.")
         self.ids = [x["id"] for x in records]
 
         pos = [[x["prompt"] + c + tokenizer.eos_token for c in x["pos_answers"]]

@@ -8,6 +8,7 @@ import realhf_tpu.experiments.gen_exp  # noqa: F401
 import realhf_tpu.experiments.profile_exp  # noqa: F401
 import realhf_tpu.experiments.grpo_exp  # noqa: F401
 import realhf_tpu.experiments.serve_exp  # noqa: F401
+import realhf_tpu.experiments.agentic_exp  # noqa: F401
 
 from realhf_tpu.experiments.common import (  # noqa: F401
     ALL_EXPERIMENT_CLASSES,

@@ -2,7 +2,7 @@
 
 Registered names match the reference (``impl/model/interface/``):
 "sft", "paired_rw", "dpo", "ppo_actor", "ppo_critic", "generation",
-"grpo".
+"grpo"; plus the TPU-native "agentic_actor" (realhf_tpu/agentic/).
 """
 
 import realhf_tpu.interfaces.sft  # noqa: F401
@@ -12,3 +12,4 @@ import realhf_tpu.interfaces.ppo  # noqa: F401
 import realhf_tpu.interfaces.gen  # noqa: F401
 import realhf_tpu.interfaces.grpo  # noqa: F401
 import realhf_tpu.interfaces.reinforce  # noqa: F401
+import realhf_tpu.agentic.interface  # noqa: F401 - "agentic_actor"

@@ -109,21 +109,50 @@ class GRPOInterface(PPOActorInterface):
         # Clipping applies to the NORMALIZED advantage (reference
         # grpo_interface.py:379), not the raw reward.
         grp = rewards.reshape(-1, g)
-        adv_seq = ((grp - grp.mean(axis=1, keepdims=True))
-                   / (grp.std(axis=1, ddof=1, keepdims=True)
-                      + 1e-5)).reshape(-1)
-        adv_seq = np.clip(adv_seq, -self.max_reward_clip,
-                          self.max_reward_clip)
         lens_m1 = np.asarray(seqlens) - 1
-        advantages = np.repeat(adv_seq, lens_m1).astype(np.float32)
-        if self.discount != 1.0:
-            # spread the terminal advantage backwards with
-            # discount^(T-1-t) decay (the reference reuses its GAE
-            # spreader with lam=discount on a terminal-only reward)
-            decay = np.concatenate([
-                self.discount ** np.arange(l - 1, -1, -1, dtype=np.float32)
-                for l in lens_m1])
-            advantages = advantages * decay
+        dense = None
+        if self.turn_level_credit and "dense_rewards" in input_.keys \
+                and input_.data.get("dense_rewards") is not None:
+            dense = np.asarray(input_.data["dense_rewards"], np.float32)
+        if dense is not None:
+            # turn-level credit (docs/agentic.md): per-token
+            # discounted reward-to-go over the turn rewards, centered
+            # and scaled by the GROUP's total-reward statistics -- at
+            # the first slot this reduces to the seq-level form, and
+            # tokens after a turn boundary stop being credited for
+            # rewards already banked
+            rtg = np.zeros_like(dense)
+            off = 0
+            for l in lens_m1:
+                acc = 0.0
+                for t in range(l - 1, -1, -1):
+                    acc = float(dense[off + t]) + self.discount * acc
+                    rtg[off + t] = acc
+                off += l
+            mean_seq = np.repeat(
+                np.repeat(grp.mean(axis=1), g), lens_m1)
+            std_seq = np.repeat(
+                np.repeat(grp.std(axis=1, ddof=1), g), lens_m1)
+            advantages = ((rtg - mean_seq) / (std_seq + 1e-5)) \
+                .astype(np.float32)
+            advantages = np.clip(advantages, -self.max_reward_clip,
+                                 self.max_reward_clip)
+        else:
+            adv_seq = ((grp - grp.mean(axis=1, keepdims=True))
+                       / (grp.std(axis=1, ddof=1, keepdims=True)
+                          + 1e-5)).reshape(-1)
+            adv_seq = np.clip(adv_seq, -self.max_reward_clip,
+                              self.max_reward_clip)
+            advantages = np.repeat(adv_seq, lens_m1).astype(np.float32)
+            if self.discount != 1.0:
+                # spread the terminal advantage backwards with
+                # discount^(T-1-t) decay (the reference reuses its GAE
+                # spreader with lam=discount on a terminal-only reward)
+                decay = np.concatenate([
+                    self.discount ** np.arange(l - 1, -1, -1,
+                                               dtype=np.float32)
+                    for l in lens_m1])
+                advantages = advantages * decay
         advantages = advantages * loss_mask
         if self.adv_norm:
             m = loss_mask.astype(np.float64)

@@ -97,6 +97,14 @@ class PPOActorInterface(model_api.ModelInterface):
     #: still does the proximal clipping on top). None disables the
     #: correction; fresh (staleness 0) sequences are never touched.
     staleness_is_clip: Optional[float] = 2.0
+    # -- agentic / multi-turn credit assignment (docs/agentic.md) ------
+    #: place reward at each turn's last action token (the
+    #: ``dense_rewards`` key packed by agentic trajectories) instead
+    #: of at end-of-sequence; GAE then propagates credit across the
+    #: masked observation gaps. Default False = existing
+    #: end-of-sequence behavior, also used when the batch carries no
+    #: ``dense_rewards``.
+    turn_level_credit: bool = False
 
     def __post_init__(self):
         if isinstance(self.gconfig, dict):
@@ -263,12 +271,25 @@ class PPOActorInterface(model_api.ModelInterface):
         old_logp = old_logp * loss_mask
         ref_logp = ref_logp * loss_mask
 
-        kl_rewards, rewards = ppo_functional.get_packed_rewards(
-            kl_ctl=self.kl_adapter.value,
-            clip_reward_value=self.max_reward_clip,
-            log_probs=old_logp, ref_log_probs=ref_logp,
-            reward_score=reward_score, short1cu_seqlens=short1,
-            seq_no_eos_mask=seq_no_eos)
+        dense = None
+        if self.turn_level_credit and "dense_rewards" in input_.keys \
+                and input_.data.get("dense_rewards") is not None:
+            dense = np.asarray(input_.data["dense_rewards"],
+                               np.float32)
+        if dense is not None:
+            kl_rewards, rewards = \
+                ppo_functional.get_packed_dense_rewards(
+                    kl_ctl=self.kl_adapter.value,
+                    clip_reward_value=self.max_reward_clip,
+                    log_probs=old_logp, ref_log_probs=ref_logp,
+                    dense_rewards=dense)
+        else:
+            kl_rewards, rewards = ppo_functional.get_packed_rewards(
+                kl_ctl=self.kl_adapter.value,
+                clip_reward_value=self.max_reward_clip,
+                log_probs=old_logp, ref_log_probs=ref_logp,
+                reward_score=reward_score, short1cu_seqlens=short1,
+                seq_no_eos_mask=seq_no_eos)
         advantages, returns = gae_packed_numpy(
             rewards, denorm_values, short1, seq_no_eos.astype(np.float32),
             gamma=self.discount, lam=self.gae_lambda)
@@ -303,6 +324,11 @@ class PPOActorInterface(model_api.ModelInterface):
                 staleness_max=int(seq_staleness.max()),
                 stale_seq_frac=float((seq_staleness > 0).mean()),
                 n_dropped_stale=n_dropped)
+        if dense is not None:
+            global_stats["dense_reward_sum"] = float(dense.sum())
+        if input_.metadata.get("n_turns"):
+            global_stats["avg_turns"] = float(
+                np.mean(input_.metadata["n_turns"]))
 
         train_data = dict(
             advantages=advantages,
@@ -455,6 +481,9 @@ class PPOCriticInterface(model_api.ModelInterface):
     value_norm_beta: float = 0.99995
     value_norm_eps: float = 1e-5
     enable_save: bool = True
+    #: must match the actor's knob: the critic's regression target is
+    #: computed from the same reward placement (docs/agentic.md)
+    turn_level_credit: bool = False
 
     def __post_init__(self):
         if self.use_adaptive_kl_ctl:
@@ -513,12 +542,25 @@ class PPOCriticInterface(model_api.ModelInterface):
         old_logp = old_logp * loss_mask
         ref_logp = ref_logp * loss_mask
 
-        kl_rewards, rewards = ppo_functional.get_packed_rewards(
-            kl_ctl=self.kl_adapter.value,
-            clip_reward_value=self.max_reward_clip,
-            log_probs=old_logp, ref_log_probs=ref_logp,
-            reward_score=reward_score, short1cu_seqlens=short1,
-            seq_no_eos_mask=seq_no_eos)
+        dense = None
+        if self.turn_level_credit and "dense_rewards" in input_.keys \
+                and input_.data.get("dense_rewards") is not None:
+            dense = np.asarray(input_.data["dense_rewards"],
+                               np.float32)
+        if dense is not None:
+            kl_rewards, rewards = \
+                ppo_functional.get_packed_dense_rewards(
+                    kl_ctl=self.kl_adapter.value,
+                    clip_reward_value=self.max_reward_clip,
+                    log_probs=old_logp, ref_log_probs=ref_logp,
+                    dense_rewards=dense)
+        else:
+            kl_rewards, rewards = ppo_functional.get_packed_rewards(
+                kl_ctl=self.kl_adapter.value,
+                clip_reward_value=self.max_reward_clip,
+                log_probs=old_logp, ref_log_probs=ref_logp,
+                reward_score=reward_score, short1cu_seqlens=short1,
+                seq_no_eos_mask=seq_no_eos)
         # Keep the critic's adaptive KL coefficient in sync with the
         # actor's (reference updates it inside the critic loss too,
         # ppo_interface.py:629).

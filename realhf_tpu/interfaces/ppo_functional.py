@@ -120,6 +120,28 @@ def get_packed_rewards(
     return kl_rewards, tot
 
 
+def get_packed_dense_rewards(
+    kl_ctl: float,
+    clip_reward_value: float,
+    log_probs: np.ndarray,       # flat, per-seq length l-1
+    ref_log_probs: np.ndarray,
+    dense_rewards: np.ndarray,   # flat l-1: reward at turn boundaries
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Turn-level variant of :func:`get_packed_rewards` for agentic
+    trajectories (docs/agentic.md): instead of one terminal score per
+    sequence, ``dense_rewards`` already places each turn's reward at
+    that turn's last action token's prediction slot
+    (``agentic/trajectory.py``), so the total reward is simply KL
+    penalty + clipped dense rewards. Environment rewards are granted
+    by the checker/tool regardless of how the sequence ended, so no
+    ``seq_no_eos_mask`` gating applies (truncation only zeroes the
+    bootstrap value, in GAE)."""
+    kl_rewards = -kl_ctl * (log_probs - ref_log_probs)
+    tot = kl_rewards + np.clip(dense_rewards, -clip_reward_value,
+                               clip_reward_value)
+    return kl_rewards, tot
+
+
 # ----------------------------------------------------------------------
 # Running mean-std (value normalization, reference modules/rms.py)
 # ----------------------------------------------------------------------

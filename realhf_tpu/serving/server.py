@@ -27,6 +27,7 @@ back to their clients (``draining``), lets in-flight slots finish (or
 cancels them past the timeout), and leaves no orphaned queue entries.
 """
 
+import collections
 import dataclasses
 import pickle
 import threading
@@ -495,12 +496,21 @@ class RolloutClient:
         self._sock = self._ctx.socket(zmq.DEALER)
         self._sock.connect(address)
         self._events: Dict[str, List[tuple]] = {}
+        # rids abandoned mid-stream (cancel + forget): late events for
+        # them are dropped instead of resurrecting an _events entry
+        # nobody will ever read. Bounded: a tombstone retires when its
+        # terminal event arrives, or FIFO past the cap (a terminal
+        # lost on the wire must not pin the tombstone forever).
+        self._abandoned: "collections.OrderedDict[str, bool]" = \
+            collections.OrderedDict()
+        self._abandoned_cap = 4096
 
     # ------------------------------------------------------------------
     def submit(self, prompt, priority: Priority = Priority.BATCH,
                ttl: Optional[float] = None, rid: Optional[str] = None,
                min_weight_version: int = 0) -> str:
         rid = rid or uuid.uuid4().hex
+        self._abandoned.pop(rid, None)  # rid reuse revives the stream
         self._events.setdefault(rid, [])
         # trailing trace-context carrier (None when tracing is off):
         # the server parents its serve:request span there, stitching
@@ -512,6 +522,20 @@ class RolloutClient:
         return rid
 
     def cancel(self, rid: str):
+        self._sock.send(pickle.dumps(("cancel", rid)))
+
+    def abandon(self, rid: str):
+        """Cancel AND forget: drop the request's local event state and
+        suppress its late replies (mid-episode drop path, see
+        ``agentic/episode.py``). Unlike plain ``cancel`` -- whose
+        ``cancelled`` terminal the caller is expected to consume --
+        nobody will ever read this rid's stream again, so without the
+        tombstone a late token/terminal event would silently re-create
+        ``_events[rid]`` and leak it forever."""
+        self._events.pop(rid, None)
+        self._abandoned[rid] = True
+        while len(self._abandoned) > self._abandoned_cap:
+            self._abandoned.popitem(last=False)
         self._sock.send(pickle.dumps(("cancel", rid)))
 
     def ping(self, timeout: float = 10.0) -> bool:
@@ -533,8 +557,13 @@ class RolloutClient:
         got = False
         while self._sock.poll(0 if got else max(0.0, timeout) * 1000):
             kind, rid, data = pickle.loads(self._sock.recv())
-            self._events.setdefault(rid, []).append((kind, data))
             got = True
+            if rid in self._abandoned:
+                if kind in TERMINAL_KINDS:
+                    # stream closed server-side: tombstone retires
+                    self._abandoned.pop(rid, None)
+                continue
+            self._events.setdefault(rid, []).append((kind, data))
         return got
 
     def next_event(self, rid: str, timeout: float = 60.0) -> tuple:

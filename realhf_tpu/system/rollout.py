@@ -59,6 +59,26 @@ class Trajectory:
     staleness: int
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # -- multi-turn / agentic extension (realhf_tpu/agentic/,
+    # docs/agentic.md). When ``prompt_mask`` is set, the trajectory is
+    # TRAJECTORY-STRUCTURED: ``prompt`` holds only the first
+    # observation, ``tokens`` the remaining turns (actions + env/tool
+    # observations interleaved), and the fields below carry the turn
+    # structure. ``logprobs`` is then the FULL shifted (l-1) array,
+    # zeros on non-action slots.
+    #: full-length (l) bool mask: True on tokens the policy did NOT
+    #: emit (initial prompt + env/tool observations) -- the same
+    #: semantics single-turn samples give the key, so the PPO
+    #: shifted-loss-mask excludes observation tokens unchanged
+    prompt_mask: Optional[np.ndarray] = None
+    #: shifted (l-1) per-position rewards: each turn's reward at its
+    #: last action token's prediction slot, zeros elsewhere
+    dense_rewards: Optional[np.ndarray] = None
+    #: scalar episode reward (sum of turn rewards)
+    reward: Optional[float] = None
+    #: per-turn (start, n_obs, n_action, weight_version) spans over
+    #: the flattened sequence, in turn order
+    turns: Optional[List[tuple]] = None
 
 
 class RolloutController:
@@ -245,11 +265,27 @@ def trajectories_to_sample(trajs: List[Trajectory]) -> SequenceSample:
     the BEHAVIOR policy's sampling logprobs, ``prompt_mask`` marks the
     prompt span, and ``seq_no_eos_mask`` the truncated sequences.
     ``metadata['weight_version']`` stamps each sample for the
-    staleness-aware importance correction in ``interfaces/ppo.py``."""
+    staleness-aware importance correction in ``interfaces/ppo.py``.
+
+    Multi-turn trajectories (``Trajectory.prompt_mask`` set -- built
+    by ``realhf_tpu.agentic.trajectory``) pack through the SAME layout
+    so the per-sample buffer and the PPO staleness machinery consume
+    them unchanged; the batch additionally carries ``rewards`` (scalar
+    episode reward -- no reward-model MFC exists in agentic graphs)
+    and ``dense_rewards`` (shifted per-position turn rewards for the
+    ``turn_level_credit`` knob), plus per-sample ``n_turns`` /
+    ``turn_spans`` metadata. Single- and multi-turn trajectories must
+    not mix in one batch (the data keys differ)."""
     if not trajs:
         raise ValueError("no trajectories to pack")
+    agentic = trajs[0].prompt_mask is not None
+    if any((t.prompt_mask is not None) != agentic for t in trajs):
+        raise ValueError(
+            "cannot pack single-turn and multi-turn trajectories into "
+            "one batch: their data keys differ")
     seqlens, ids, in_ids, logprobs, prompt_mask = [], [], [], [], []
     no_eos, versions, staleness = [], [], []
+    rewards, dense, n_turns, turn_spans = [], [], [], []
     for t in trajs:
         g = len(t.tokens)
         l = len(t.prompt) + g
@@ -258,12 +294,30 @@ def trajectories_to_sample(trajs: List[Trajectory]) -> SequenceSample:
         in_ids.append(np.concatenate(
             [np.asarray(t.prompt, np.int32),
              np.asarray(t.tokens, np.int32)]))
-        lp = np.zeros(l - 1, np.float32)
-        lp[len(t.prompt) - 1:] = np.asarray(t.logprobs,
-                                            np.float32)[:g]
-        logprobs.append(lp)
-        prompt_mask.append(np.concatenate(
-            [np.ones(len(t.prompt), bool), np.zeros(g, bool)]))
+        if agentic:
+            lp = np.asarray(t.logprobs, np.float32)
+            pm = np.asarray(t.prompt_mask, bool)
+            dr = np.asarray(t.dense_rewards, np.float32)
+            if len(lp) != l - 1 or len(pm) != l or len(dr) != l - 1:
+                raise ValueError(
+                    f"trajectory {t.sid}: multi-turn arrays must be "
+                    f"full-length (l={l}): logprobs {len(lp)} "
+                    f"(want {l - 1}), prompt_mask {len(pm)} (want {l}),"
+                    f" dense_rewards {len(dr)} (want {l - 1})")
+            logprobs.append(lp)
+            prompt_mask.append(pm)
+            dense.append(dr)
+            rewards.append(np.float32(t.reward if t.reward is not None
+                                      else dr.sum()))
+            n_turns.append(len(t.turns or ()))
+            turn_spans.append(list(t.turns or ()))
+        else:
+            lp = np.zeros(l - 1, np.float32)
+            lp[len(t.prompt) - 1:] = np.asarray(t.logprobs,
+                                                np.float32)[:g]
+            logprobs.append(lp)
+            prompt_mask.append(np.concatenate(
+                [np.ones(len(t.prompt), bool), np.zeros(g, bool)]))
         no_eos.append(bool(t.no_eos))
         versions.append(int(t.weight_version))
         staleness.append(int(t.staleness))
@@ -273,6 +327,11 @@ def trajectories_to_sample(trajs: List[Trajectory]) -> SequenceSample:
         packed_logprobs=np.concatenate(logprobs).astype(np.float32),
         prompt_mask=np.concatenate(prompt_mask),
     )
+    metadata = dict(weight_version=versions, staleness=staleness)
+    if agentic:
+        data["rewards"] = np.asarray(rewards, np.float32)
+        data["dense_rewards"] = np.concatenate(dense).astype(np.float32)
+        metadata["n_turns"] = n_turns
+        metadata["turn_spans"] = turn_spans
     return SequenceSample.from_default(
-        ids=ids, seqlens=seqlens, data=data,
-        metadata=dict(weight_version=versions, staleness=staleness))
+        ids=ids, seqlens=seqlens, data=data, metadata=metadata)

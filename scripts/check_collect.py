@@ -32,6 +32,7 @@ import sys
 #: import breaks in a way pytest reports as "0 collected" rather than
 #: an ERROR -- would otherwise vanish from CI silently.
 REQUIRED_DIRS = (
+    "tests/agentic",
     "tests/analysis",
     "tests/async_rlhf",
     "tests/base",

@@ -1,0 +1,204 @@
+"""ISSUE 11 acceptance: the agentic experiment trains end-to-end on
+the inline runner with mean episode reward increasing on the
+verifiable-reward (checker) task, and multi-turn tool-game episodes
+flow through the full PPO graph. Tier-1 covers the cheap spec-level
+contracts; the real training runs are slow-marked (tiny model, ~10s
+each after compile, per the tier-1 budget note)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                "scripts"))
+
+TINY = dict(
+    n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+    intermediate_dim=64, vocab_size=29, apply_rotary=True,
+    layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+    use_attn_proj_bias=False, use_mlp_bias=False,
+    activation_function="silu")
+
+
+# ----------------------------------------------------------------------
+# tier-1: spec-level contracts (no model, no compile)
+# ----------------------------------------------------------------------
+def test_agentic_experiment_registered_and_builds():
+    from realhf_tpu.experiments import ALL_EXPERIMENT_CLASSES
+
+    assert "agentic" in ALL_EXPERIMENT_CLASSES
+    cfg = ALL_EXPERIMENT_CLASSES["agentic"](
+        experiment_name="t", trial_name="t")
+    spec = cfg.build()
+    names = [m.name for m in spec.mfcs]
+    assert names == ["actor_gen", "ref_inf", "critic_inf",
+                     "actor_train", "critic_train"]
+    # no reward model anywhere: the env IS the reward model
+    assert "reward" not in spec.models
+    assert not any("rew" in n for n in names)
+    gen = spec.mfcs[0]
+    assert gen.interface_impl.type_ == "agentic_actor"
+    assert "dense_rewards" in gen.output_keys
+    assert "rewards" in gen.output_keys
+    # credit knob propagates to BOTH train interfaces
+    assert gen.interface_impl.args["turn_level_credit"] is True
+    assert spec.mfcs[4].interface_impl.args["turn_level_credit"] is True
+
+
+def test_agentic_spec_passes_dfg_invariants_and_window_check():
+    from realhf_tpu.analysis.dfg_invariants import (
+        build_default_spec,
+        validate_spec,
+    )
+    from realhf_tpu.experiments import ALL_EXPERIMENT_CLASSES
+
+    spec = build_default_spec(ALL_EXPERIMENT_CLASSES["agentic"])
+    assert validate_spec("agentic", spec, "x.py", 1) == []
+    # the multi-turn window check fires when a consumer outgrows the
+    # episode window
+    cfg = ALL_EXPERIMENT_CLASSES["agentic"](
+        experiment_name="t", trial_name="t")
+    cfg.agentic.max_turns = 3
+    cfg.actor_gen_n_seqs = 4
+    cfg.dataset.train_bs_n_seqs = 64
+    cfg.max_concurrent_batches = 2
+    bad = cfg.build()
+    findings = validate_spec("agentic", bad, "x.py", 1)
+    assert any(f.code == "dfg-multiturn-batch" for f in findings), \
+        findings
+    # ... and stays quiet for single-turn interfaces regardless
+    cfg.agentic.max_turns = 1
+    ok_codes = [f.code for f in validate_spec(
+        "agentic", cfg.build(), "x.py", 1)]
+    assert "dfg-multiturn-batch" not in ok_codes
+
+
+# ----------------------------------------------------------------------
+# slow: real training
+# ----------------------------------------------------------------------
+def _build_runner(*, steps, train_bs, lr, seed, env="checker_task",
+                  max_turns=1, new_tokens=2, name="agentic-e2e"):
+    from realhf_tpu.base import testing
+    from realhf_tpu.engine.optim import OptimizerConfig
+    from realhf_tpu.experiments.agentic_exp import AgenticPPOConfig
+    from realhf_tpu.experiments.common import apply_overrides
+    from realhf_tpu.parallel.mesh import ParallelismConfig
+    from realhf_tpu.system.inline import InlineRunner
+
+    cfg = AgenticPPOConfig(experiment_name=f"{name}-{seed}",
+                           trial_name="t0",
+                           total_train_epochs=1000, seed=seed)
+    apply_overrides(cfg, {
+        "dataset.train_bs_n_seqs": str(train_bs),
+        "ppo.max_new_tokens": str(new_tokens),
+        "ppo.min_new_tokens": str(new_tokens),
+        "ppo.ppo_n_minibatches": "1",
+        # raw sampling: the episode path cannot replay logits masks,
+        # so warped sampling logprobs would bias the PPO ratio
+        "ppo.top_p": "1.0",
+        "ppo.top_k": "0",
+        "ppo.early_stop_imp_ratio": "100.0",
+        "agentic.env": env,
+        "agentic.max_turns": str(max_turns),
+        "agentic.n_prompts": str(train_bs),
+        "benchmark_steps": str(steps),
+    })
+    spec = cfg.build()
+    spec.dataset.args["vocab_size"] = TINY["vocab_size"]
+    for _role, mspec in spec.models.items():
+        mspec.path = None
+        mspec.random_init_config = dict(TINY)
+        mspec.bf16 = False
+        mspec.parallel = ParallelismConfig()
+        if mspec.optimizer is not None:
+            mspec.optimizer = OptimizerConfig(
+                lr=lr, warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant")
+    spec.tokenizer = testing.IntegerTokenizer(
+        vocab_size=TINY["vocab_size"])
+    return InlineRunner(spec)
+
+
+def _train(runner, steps):
+    rewards, stats = [], []
+    done = False
+    for _epoch in range(1000):
+        for batch in runner.dataloader:
+            st = runner.run_step(batch)
+            runner.global_step += 1
+            rewards.append(st["actor_train"]["task_reward"])
+            stats.append(st["actor_train"])
+            if runner.global_step >= steps:
+                done = True
+                break
+        if done:
+            break
+    return rewards, stats
+
+
+@pytest.mark.slow
+def test_checker_task_reward_increases_e2e():
+    """The acceptance run: verifiable-reward (copy-checker) task, 50
+    PPO steps, mean episode reward strictly increasing (first-third
+    vs last-third, plus a positive fitted slope)."""
+    steps = 50
+    runner = _build_runner(steps=steps, train_bs=32, lr=1e-2, seed=1)
+    rewards, stats = _train(runner, steps)
+    assert len(rewards) == steps
+    assert np.all(np.isfinite(rewards))
+    third = steps // 3
+    first, last = np.mean(rewards[:third]), np.mean(rewards[-third:])
+    slope = float(np.polyfit(np.arange(steps), rewards, 1)[0])
+    assert last > first + 0.03, (first, last, rewards)
+    assert slope > 0, (slope, rewards)
+    # the turn-level credit path was really active
+    assert all("dense_reward_sum" in st for st in stats)
+    assert all(st["avg_turns"] == 1.0 for st in stats)
+    # behavior/ratio sanity: raw sampling keeps IS near 1 at step 1
+    assert 0.9 < stats[0]["importance_weight"] < 1.1
+
+
+@pytest.mark.slow
+def test_tool_game_multi_turn_trains_through_full_graph():
+    """Multi-turn episodes through the SAME PPO graph: 2-turn tool
+    game, observation tokens masked, per-turn rewards at boundaries;
+    training must run and the data model must be visibly multi-turn."""
+    steps = 8
+    runner = _build_runner(steps=steps, train_bs=16, lr=2e-3, seed=1,
+                           env="tool_game", max_turns=2, new_tokens=2,
+                           name="agentic-tool")
+    rewards, stats = _train(runner, steps)
+    assert len(rewards) == steps and np.all(np.isfinite(rewards))
+    # every episode ran exactly max_turns turns (tool game truncates
+    # at the runner's cap, status max_turns -> still a trajectory)
+    assert all(st["avg_turns"] == 2.0 for st in stats)
+    # sequences carry obs+action interleavings: prompt_mask tokens
+    # (prompt + tool observations) dominate the 2-token actions
+    assert all(st["avg_prompt_len"] > st["avg_seq_len"] / 2
+               for st in stats)
+    assert all(np.isfinite(st["importance_weight"]) for st in stats)
+
+
+@pytest.mark.slow
+def test_agentic_serving_path_e2e():
+    """EpisodeRunner against a REAL RolloutServer (bench_agentic's
+    serving scenario): all episodes finish, per-turn weight versions
+    are stamped, and env steps overlap other episodes' generation."""
+    import argparse
+
+    import bench_agentic
+
+    out = bench_agentic.run(argparse.Namespace(
+        episodes=12, turns=3, concurrent=6, new_tokens=4,
+        env_delay_ms=2.0, seed=0))
+    srv = out["serving"]
+    assert srv["episodes"] == 12
+    assert srv["turns"] == 36
+    assert srv["turns_per_sec"] > 0
+    assert srv["env_errors"] == 0 and srv["abandoned"] == 0
+    # env/generation overlap is real on the serving path and
+    # structurally impossible on the batched local path
+    assert srv["env_gen_overlap_frac"] > 0.2
+    assert out["local"]["env_gen_overlap_frac"] == 0.0

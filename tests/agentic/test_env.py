@@ -1,0 +1,110 @@
+"""Env protocol + registry + the two shipped envs: determinism,
+reward semantics (exact / partial / malformed), and multi-turn
+lifecycle. Pure host-side python -- no model, no jax."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.agentic.env import (
+    ALL_ENV_CLASSES,
+    CALL_TOKEN,
+    OBS_TOKEN,
+    PAYLOAD_BASE,
+    CheckerEnv,
+    EnvStep,
+    ToolGameEnv,
+    make_env,
+    register_env,
+)
+
+
+def test_registry_has_shipped_envs_and_rejects_duplicates():
+    assert "checker_task" in ALL_ENV_CLASSES
+    assert "tool_game" in ALL_ENV_CLASSES
+    with pytest.raises(ValueError, match="already registered"):
+        register_env("checker_task", CheckerEnv)
+    with pytest.raises(ValueError, match="Unknown env"):
+        make_env("no_such_env", prompt=[5])
+
+
+def test_checker_copy_reward_exact_partial_and_out_of_range():
+    env = make_env("checker_task", prompt=np.array([10, 11, 42]),
+                   vocab_size=97)
+    obs = env.reset()
+    np.testing.assert_array_equal(obs, [10, 11, 42])
+    assert env.target == 42
+    # exact answer: full reward, episode done
+    st = env.step(np.array([42, 7, 7]))  # only the first token counts
+    assert isinstance(st, EnvStep)
+    assert st.reward == 1.0 and st.done
+    assert len(st.observation) == 0
+    # near-miss earns shaped partial credit, strictly below exact
+    env2 = make_env("checker_task", prompt=np.array([10, 11, 42]),
+                    vocab_size=97)
+    env2.reset()
+    near = env2.step(np.array([43])).reward
+    assert 0.0 < near < 1.0
+    # far answer earns less than a near one
+    env3 = make_env("checker_task", prompt=np.array([10, 11, 42]),
+                    vocab_size=97)
+    env3.reset()
+    far = env3.step(np.array([88])).reward
+    assert far < near
+    # out-of-payload answer (special token) earns exactly 0
+    env4 = make_env("checker_task", prompt=np.array([10, 11, 42]),
+                    vocab_size=97)
+    env4.reset()
+    assert env4.step(np.array([1])).reward == 0.0
+
+
+def test_checker_add_task_is_deterministic_function_of_prompt():
+    p = np.array([PAYLOAD_BASE + 5, PAYLOAD_BASE + 7])
+    env = make_env("checker_task", prompt=p, vocab_size=20,
+                   task="add")
+    # (5 + 7) mod (20-4) = 12 -> PAYLOAD_BASE + 12
+    assert env.target == PAYLOAD_BASE + 12
+    # double-stepping a finished episode is a bug, not a silent no-op
+    env.reset()
+    env.step(np.array([env.target]))
+    with pytest.raises(RuntimeError, match="finished"):
+        env.step(np.array([env.target]))
+
+
+def test_tool_game_multi_turn_lifecycle_and_structured_calls():
+    prompt = np.array([5, 6, 7], np.int32)
+    env = make_env("tool_game", prompt=prompt, seed=3, vocab_size=97,
+                   n_turns=3)
+    obs = env.reset()
+    # reset = prompt ++ [OBS, t_1]
+    np.testing.assert_array_equal(obs[:3], prompt)
+    assert obs[3] == OBS_TOKEN
+    t1 = int(obs[4])
+    assert t1 == env.targets[0]
+    # correct structured call: full turn reward, next observation
+    st = env.step(np.array([CALL_TOKEN, t1]))
+    assert st.reward == 1.0 and not st.done
+    assert st.observation[0] == OBS_TOKEN
+    assert int(st.observation[1]) == env.targets[1]
+    # malformed call (no CALL token): zero, flagged, game continues
+    st2 = env.step(np.array([t1, t1]))
+    assert st2.reward == 0.0 and st2.info["malformed"]
+    # wrong arg in a well-formed call: shaped partial credit
+    wrong = env.targets[2] + 1 if env.targets[2] + 1 < 97 \
+        else env.targets[2] - 1
+    st3 = env.step(np.array([CALL_TOKEN, wrong]))
+    assert 0.0 <= st3.reward < 1.0
+    assert st3.done and len(st3.observation) == 0
+    with pytest.raises(RuntimeError, match="finished"):
+        env.step(np.array([CALL_TOKEN, 5]))
+
+
+def test_tool_game_targets_deterministic_in_prompt_and_seed():
+    p = np.array([9, 9, 9], np.int32)
+    a = ToolGameEnv(p, seed=1, vocab_size=97, n_turns=4)
+    b = ToolGameEnv(p, seed=1, vocab_size=97, n_turns=4)
+    c = ToolGameEnv(p, seed=2, vocab_size=97, n_turns=4)
+    d = ToolGameEnv(np.array([9, 9, 10], np.int32), seed=1,
+                    vocab_size=97, n_turns=4)
+    assert a.targets == b.targets
+    assert a.targets != c.targets or a.targets != d.targets
+    assert all(PAYLOAD_BASE <= t < 97 for t in a.targets)

@@ -1,0 +1,189 @@
+"""EpisodeRunner over a scripted local backend (tier-1, no model):
+concurrency bounds, turn interleaving, per-turn weight-version
+stamping, bounded resubmits, and the drop paths (env error, deadline,
+stop) abandoning in-flight requests."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.agentic.env import CALL_TOKEN, Env, EnvStep, make_env
+from realhf_tpu.agentic.episode import EpisodeRunner
+from realhf_tpu.agentic.local import GenResult, LocalRolloutBackend
+from realhf_tpu.serving.server import RolloutResult
+
+
+def _echo_policy(prompts):
+    """Scripted optimal tool-game policy: call with the last observed
+    token."""
+    return [GenResult(tokens=np.array([CALL_TOKEN, p[-1]], np.int32),
+                      logprobs=np.array([-0.1, -0.2], np.float32))
+            for p in prompts]
+
+
+def _tool_episodes(n, n_turns=3, vocab=97):
+    for i in range(n):
+        yield i, make_env("tool_game",
+                          prompt=np.array([5 + i, 6, 7], np.int32),
+                          seed=i, vocab_size=vocab, n_turns=n_turns)
+
+
+def test_concurrent_episodes_complete_with_turn_structure():
+    versions = iter(range(100))
+    backend = LocalRolloutBackend(_echo_policy,
+                                  version_fn=lambda: next(versions))
+    runner = EpisodeRunner(backend, _tool_episodes(6, n_turns=3),
+                           max_concurrent=2, max_turns=4)
+    eps = runner.run_all()
+    assert len(eps) == 6
+    assert all(ep.status == "done" and ep.n_turns == 3 for ep in eps)
+    # the scripted policy is optimal: every turn earns 1.0
+    assert all(ep.total_reward == pytest.approx(3.0) for ep in eps)
+    # concurrency bound respected: 6 episodes x 3 turns each through
+    # a max_concurrent=2 window -> at least 9 backend batches
+    assert backend.batches >= 9
+    # every turn is stamped with the version its batch decoded under,
+    # and versions advance across a single episode's turns
+    for ep in eps:
+        wvs = [t.weight_version for t in ep.turns]
+        assert wvs == sorted(wvs)
+    all_wvs = {t.weight_version for ep in eps for t in ep.turns}
+    assert len(all_wvs) > 1
+
+
+def test_checker_env_single_turn_and_max_turns_status():
+    backend = LocalRolloutBackend(_echo_policy)
+
+    def episodes():
+        yield "c", make_env("checker_task",
+                            prompt=np.array([9, 10, 11], np.int32),
+                            vocab_size=97)
+        # a 5-turn game under a 2-turn cap finishes as "max_turns"
+        yield "t", make_env("tool_game",
+                            prompt=np.array([5, 6, 7], np.int32),
+                            vocab_size=97, n_turns=5)
+
+    runner = EpisodeRunner(backend, episodes(), max_turns=2)
+    eps = {ep.sid: ep for ep in runner.run_all()}
+    assert eps["c"].status == "done" and eps["c"].n_turns == 1
+    # scripted policy answers CALL_TOKEN, not the copy target, so the
+    # checker scores it but the episode still completes
+    assert eps["t"].status == "max_turns" and eps["t"].n_turns == 2
+
+
+def test_env_error_drops_only_that_episode_and_abandons():
+    class BoomEnv(Env):
+        def __init__(self, when):
+            self.when = when
+            self.k = 0
+
+        def reset(self):
+            return np.array([5, 6], np.int32)
+
+        def step(self, action):
+            self.k += 1
+            if self.k >= self.when:
+                raise RuntimeError("tool executor crashed")
+            return EnvStep(np.array([7], np.int32), 1.0, False)
+
+    backend = LocalRolloutBackend(_echo_policy)
+
+    def episodes():
+        yield "boom", BoomEnv(when=2)
+        yield from _tool_episodes(2, n_turns=2)
+
+    runner = EpisodeRunner(backend, episodes(), max_concurrent=3,
+                           max_turns=5)
+    eps = runner.run_all()
+    assert sorted(ep.sid for ep in eps) == [0, 1]
+    assert runner.env_errors == 1
+    assert ("boom", "env_error") in runner.dropped
+
+
+def test_stop_abandons_in_flight_requests():
+    class NeverDone(Env):
+        def reset(self):
+            return np.array([5], np.int32)
+
+        def step(self, action):
+            return EnvStep(np.array([6], np.int32), 0.0, False)
+
+    abandoned = []
+
+    class Backend(LocalRolloutBackend):
+        def abandon(self, rid):
+            abandoned.append(rid)
+            super().abandon(rid)
+
+    backend = Backend(_echo_policy)
+    runner = EpisodeRunner(backend, ((i, NeverDone()) for i in range(3)),
+                           max_concurrent=3, max_turns=100)
+    runner.pump()  # 3 requests in flight
+    assert runner.inflight == 3
+    n = runner.stop()
+    assert n == 3 and runner.live == 0 and runner.inflight == 0
+    assert len(abandoned) == 3 and runner.abandoned == 3
+    # the backend queue really dropped them: nothing generates later
+    assert backend.poll_results() == []
+
+
+def test_episode_deadline_abandons_and_length_cap_finishes():
+    class SlowClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = SlowClock()
+
+    class NeverDone(Env):
+        def reset(self):
+            return np.array([5], np.int32)
+
+        def step(self, action):
+            return EnvStep(np.array([6], np.int32), 0.5, False)
+
+    backend = LocalRolloutBackend(_echo_policy)
+    runner = EpisodeRunner(backend, [("d", NeverDone())],
+                           max_turns=100, episode_ttl=10.0,
+                           clock=clock)
+    runner.pump()
+    runner.poll()      # one turn happens
+    clock.t = 11.0     # deadline passes with a request in flight
+    runner.pump()
+    runner.poll()
+    assert ("d", "deadline") in runner.dropped
+    assert runner.live == 0
+
+    # length cap: a growing context hits max_seq_len and the episode
+    # keeps its banked turns as status "length"
+    backend2 = LocalRolloutBackend(_echo_policy)
+    runner2 = EpisodeRunner(backend2, [("l", NeverDone())],
+                            max_turns=100, max_seq_len=7)
+    eps = runner2.run_all()
+    assert len(eps) == 1 and eps[0].status == "length"
+    assert eps[0].n_turns >= 1
+
+
+def test_rejected_results_resubmit_bounded():
+    calls = {"n": 0}
+
+    class FlakyBackend(LocalRolloutBackend):
+        def poll_results(self, timeout=0.0):
+            out = super().poll_results(timeout)
+            bounced = []
+            for r in out:
+                calls["n"] += 1
+                if calls["n"] <= 2:  # first two answers bounce
+                    bounced.append(RolloutResult(
+                        rid=r.rid, status="rejected", data={}))
+                else:
+                    bounced.append(r)
+            return bounced
+
+    backend = FlakyBackend(_echo_policy)
+    runner = EpisodeRunner(backend, _tool_episodes(1, n_turns=2),
+                           max_retries=5)
+    eps = runner.run_all()
+    assert len(eps) == 1 and eps[0].status == "done"
+    assert runner.resubmits == 2

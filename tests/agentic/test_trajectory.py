@@ -1,0 +1,191 @@
+"""Trajectory data model (tier-1, pure data -- no model, no compile):
+turn segmentation, per-turn loss masks (observation tokens excluded
+from the policy loss), reward-at-boundary assembly, the
+trajectories_to_sample round-trip, and the per-sample buffer flowing
+multi-turn samples exactly like single-turn ones."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.agentic.episode import Episode, Turn
+from realhf_tpu.agentic.trajectory import (
+    episode_to_trajectory,
+    episodes_to_sample,
+    turn_segments,
+)
+from realhf_tpu.interfaces.ppo import _shifted_loss_mask
+from realhf_tpu.system.rollout import Trajectory, trajectories_to_sample
+
+
+def _turn(obs, action, reward, wv=0, lp=None, no_eos=False):
+    action = np.asarray(action, np.int32)
+    return Turn(obs=np.asarray(obs, np.int32), action=action,
+                logprobs=(np.asarray(lp, np.float32) if lp is not None
+                          else -0.5 * np.ones(len(action), np.float32)),
+                reward=reward, weight_version=wv, no_eos=no_eos)
+
+
+def _episode(sid="e0", status="done"):
+    """2-turn episode: obs [10,11,12] act [20,21] | obs [13,14] act
+    [22,23,24]. Flat length 10."""
+    return Episode(sid=sid, status=status, turns=[
+        _turn([10, 11, 12], [20, 21], reward=0.25, wv=3,
+              lp=[-0.1, -0.2]),
+        _turn([13, 14], [22, 23, 24], reward=1.0, wv=4,
+              lp=[-0.3, -0.4, -0.5]),
+    ])
+
+
+def test_flattening_and_turn_segmentation():
+    tr = episode_to_trajectory(_episode(), trainer_version=5)
+    full = np.concatenate([tr.prompt, tr.tokens])
+    np.testing.assert_array_equal(
+        full, [10, 11, 12, 20, 21, 13, 14, 22, 23, 24])
+    # prompt = first observation only
+    np.testing.assert_array_equal(tr.prompt, [10, 11, 12])
+    # spans: (start, n_obs, n_action, weight_version) per turn
+    assert tr.turns == [(0, 3, 2, 3), (5, 2, 3, 4)]
+    # conservative staleness label: MIN version over turns
+    assert tr.weight_version == 3 and tr.staleness == 2
+
+
+def test_observation_tokens_excluded_from_policy_loss():
+    tr = episode_to_trajectory(_episode())
+    # prompt_mask True exactly on obs tokens (incl. mid-episode ones)
+    np.testing.assert_array_equal(
+        tr.prompt_mask,
+        [True, True, True, False, False, True, True, False, False,
+         False])
+    # the PPO loss mask (shifted) must be True exactly on slots that
+    # PREDICT action tokens -- i.e. not the prompt, not the tool obs
+    lm = _shifted_loss_mask(tr.prompt_mask, [len(tr.prompt_mask)])
+    # slot t predicts token t+1: actions at abs 3,4 and 7,8,9
+    expect = np.zeros(9, bool)
+    expect[[2, 3]] = True      # predict tokens 3,4
+    expect[[6, 7, 8]] = True   # predict tokens 7,8,9
+    np.testing.assert_array_equal(lm, expect)
+    # behavior logprobs live exactly on the loss slots
+    np.testing.assert_allclose(tr.logprobs[lm],
+                               [-0.1, -0.2, -0.3, -0.4, -0.5])
+    assert np.all(tr.logprobs[~lm] == 0.0)
+
+
+def test_reward_lands_at_each_turns_last_action_slot():
+    tr = episode_to_trajectory(_episode())
+    dense = tr.dense_rewards
+    # turn 1's last action token is abs index 4 -> slot 3;
+    # turn 2's last action token is abs index 9 -> slot 8
+    assert dense[3] == pytest.approx(0.25)
+    assert dense[8] == pytest.approx(1.0)
+    others = np.delete(dense, [3, 8])
+    assert np.all(others == 0.0)
+    assert tr.reward == pytest.approx(1.25)
+    # reward slots are always loss slots (credit lands on actions)
+    lm = _shifted_loss_mask(tr.prompt_mask, [len(tr.prompt_mask)])
+    assert np.all(lm[dense != 0.0])
+
+
+def test_episodes_to_sample_round_trip_and_id_ordering():
+    eps = [_episode("a"), Episode(sid="b", status="done", turns=[
+        _turn([7, 8], [30], reward=0.5, wv=9, lp=[-1.0])])]
+    s = episodes_to_sample(eps, trainer_version=9, ids=["b", "a"])
+    assert s.ids == ["b", "a"]
+    assert s.bs == 2
+    # per-key packed lengths follow the standard naming rules
+    assert s.seqlens["packed_input_ids"] == [[3], [10]]
+    assert s.seqlens["dense_rewards"] == [[2], [9]]
+    assert s.seqlens["rewards"] == [[1], [1]]
+    np.testing.assert_allclose(s.data["rewards"], [0.5, 1.25])
+    # unpack -> gather round-trips every key and metadata
+    parts = s.unpack()
+    assert [p.ids for p in parts] == [["b"], ["a"]]
+    re = type(s).gather(parts)
+    for k in s.keys:
+        np.testing.assert_array_equal(re.data[k], s.data[k])
+    assert re.metadata["weight_version"] == s.metadata["weight_version"]
+    assert turn_segments(s, 1) == [(0, 3, 2, 3), (5, 2, 3, 4)]
+    # missing episodes for requested ids fail loudly
+    with pytest.raises(ValueError, match="missing"):
+        episodes_to_sample(eps, ids=["a", "zzz"])
+
+
+def test_single_and_multi_turn_cannot_mix():
+    single = Trajectory(sid="s", prompt=np.arange(3),
+                        tokens=np.array([5, 6]),
+                        logprobs=np.array([-1.0, -1.0]), no_eos=False,
+                        weight_version=0, staleness=0)
+    multi = episode_to_trajectory(_episode())
+    with pytest.raises(ValueError, match="single-turn and multi-turn"):
+        trajectories_to_sample([single, multi])
+
+
+def test_degenerate_episodes_rejected():
+    with pytest.raises(ValueError, match="no turns"):
+        episode_to_trajectory(Episode(sid="x", turns=[], status="done"))
+    with pytest.raises(ValueError, match="status"):
+        episode_to_trajectory(
+            Episode(sid="x", turns=[_turn([1], [2], 0.0)],
+                    status="env_error"))
+    with pytest.raises(ValueError, match="empty action"):
+        episode_to_trajectory(Episode(sid="x", status="done", turns=[
+            _turn([5], [], 0.0)]))
+    with pytest.raises(ValueError, match="first observation"):
+        episode_to_trajectory(Episode(sid="x", status="done", turns=[
+            _turn([], [5], 0.0)]))
+
+
+def test_multi_turn_samples_flow_through_per_sample_buffer():
+    """Acceptance criterion: multi-turn episodes use the SAME buffer
+    and assembly path as single-turn rollouts -- no parallel
+    pipeline."""
+    from realhf_tpu.system.buffer import SequenceBuffer
+
+    names = ["ref_inf", "actor_train"]
+    buffer = SequenceBuffer(
+        names, capacity=100,
+        n_seqs_of={"ref_inf": 2, "actor_train": 2},
+        input_keys_of={"ref_inf": ("packed_input_ids",),
+                       "actor_train": ("packed_input_ids",
+                                       "dense_rewards", "rewards",
+                                       "packed_ref_logprobs")},
+        producers_of={"ref_inf": (), "actor_train": ("ref_inf",)})
+    eps = [_episode(f"e{i}") for i in range(4)]
+    sample = episodes_to_sample(eps, trainer_version=6)
+    buffer.put_batch(sample, "local", 0, True)
+
+    asms = buffer.ready_assemblies()
+    ref_asms = [a for a in asms if a.mfc == "ref_inf"]
+    assert len(ref_asms) == 2  # 4 samples at n_seqs=2
+    for a in ref_asms:
+        buffer.mark_assembly_dispatched(a.aid)
+        inp = buffer.gather_assembly(a.aid, ("packed_input_ids",))
+        assert inp.bs == 2
+        # fake the ref MFC's output so actor_train becomes ready
+        nested_m1 = [[l - 1 for l in lens]
+                     for lens in inp.seqlens["packed_input_ids"]]
+        from realhf_tpu.api.data import SequenceSample
+        with SequenceSample.disable_validation():
+            out = SequenceSample(
+                keys=["packed_ref_logprobs"],
+                trailing_shapes=dict(packed_ref_logprobs=()),
+                dtypes=dict(packed_ref_logprobs=np.float32),
+                ids=list(inp.ids),
+                seqlens=dict(packed_ref_logprobs=nested_m1),
+                data=dict(packed_ref_logprobs=np.zeros(
+                    sum(sum(l) for l in nested_m1), np.float32)),
+                metadata={})
+        buffer.complete_assembly(a.aid, out, "local")
+
+    train = [a for a in buffer.ready_assemblies()
+             if a.mfc == "actor_train"]
+    assert len(train) == 2
+    buffer.mark_assembly_dispatched(train[0].aid)
+    inp = buffer.gather_assembly(
+        train[0].aid, ("packed_input_ids", "dense_rewards", "rewards",
+                       "packed_ref_logprobs"))
+    # the trajectory-structured keys and staleness metadata survived
+    # the buffer round-trip intact
+    assert "dense_rewards" in inp.keys and "rewards" in inp.keys
+    assert len(inp.metadata["weight_version"]) == 2
+    assert inp.metadata["weight_version"] == [3, 3]
+    assert all(int(v) >= 0 for v in inp.metadata["staleness"])

@@ -1,0 +1,134 @@
+"""Turn-level credit assignment math (tier-1: numpy + the tiny GAE
+jit already exercised across the suite): dense reward assembly at
+turn boundaries, GAE propagating credit across masked observation
+gaps, default end-of-sequence behavior unchanged, and the GRPO
+reward-to-go variant."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.agentic.episode import Episode, Turn
+from realhf_tpu.agentic.trajectory import episode_to_trajectory
+from realhf_tpu.interfaces import ppo_functional
+from realhf_tpu.interfaces.ppo import _shifted_loss_mask
+from realhf_tpu.ops.gae import gae_packed_numpy
+
+
+def _episode():
+    return Episode(sid="e", status="done", turns=[
+        Turn(obs=np.array([10, 11, 12], np.int32),
+             action=np.array([20, 21], np.int32),
+             logprobs=np.array([-0.1, -0.2], np.float32),
+             reward=0.25, weight_version=0, no_eos=False),
+        Turn(obs=np.array([13, 14], np.int32),
+             action=np.array([22, 23, 24], np.int32),
+             logprobs=np.array([-0.3, -0.4, -0.5], np.float32),
+             reward=1.0, weight_version=0, no_eos=False),
+    ])
+
+
+def test_dense_rewards_add_kl_and_clip():
+    tr = episode_to_trajectory(_episode())
+    l1 = len(tr.dense_rewards)
+    logp = np.full(l1, -0.5, np.float32)
+    ref = np.full(l1, -0.7, np.float32)
+    kl_rewards, tot = ppo_functional.get_packed_dense_rewards(
+        kl_ctl=0.1, clip_reward_value=0.5, log_probs=logp,
+        ref_log_probs=ref, dense_rewards=tr.dense_rewards)
+    np.testing.assert_allclose(kl_rewards, -0.1 * (logp - ref),
+                               atol=1e-6)
+    # rewards at the two turn-boundary slots, CLIPPED to 0.5
+    np.testing.assert_allclose(tot - kl_rewards,
+                               np.where(tr.dense_rewards > 0,
+                                        np.minimum(tr.dense_rewards,
+                                                   0.5), 0.0),
+                               atol=1e-6)
+    # unlike the end-of-sequence path, no no_eos gating: both turn
+    # rewards survive even if the sequence was truncated
+    assert (tot != kl_rewards).sum() == 2
+
+
+def test_gae_propagates_credit_across_masked_observation_gap():
+    """The mid-episode observation tokens sit between turn 1's reward
+    and turn 2's actions; with gamma=lambda=1 the advantage at turn
+    1's action slots must include turn 2's reward -- GAE bridges the
+    gap while the loss mask keeps the gap's slots out of the
+    surrogate."""
+    tr = episode_to_trajectory(_episode())
+    l = len(tr.prompt_mask)
+    rewards = tr.dense_rewards  # no KL for clarity
+    values = np.zeros(l, np.float32)  # l-1 slots + bootstrap
+    cu = np.array([0, l - 1])
+    adv, ret = gae_packed_numpy(rewards, values, cu,
+                                np.array([0.0]), gamma=1.0, lam=1.0)
+    # reward-to-go: every slot before the first boundary sees 1.25
+    assert adv[0] == pytest.approx(1.25)
+    assert adv[3] == pytest.approx(1.25)   # turn-1 boundary slot
+    assert adv[4] == pytest.approx(1.0)    # after turn-1 reward banked
+    assert adv[8] == pytest.approx(1.0)    # turn-2 boundary slot
+    # the observation-gap slots carry advantage but are NOT loss slots
+    lm = _shifted_loss_mask(tr.prompt_mask, [l])
+    assert not lm[4] and not lm[5]
+    # with gamma<1 credit decays across the gap instead of vanishing
+    adv_g, _ = gae_packed_numpy(rewards, values, cu,
+                                np.array([0.0]), gamma=0.9, lam=1.0)
+    assert 0.0 < adv_g[4] < adv_g[8]
+
+
+def test_end_of_sequence_default_unchanged():
+    """turn_level_credit=False must reproduce get_packed_rewards
+    exactly -- the knob defaults to existing behavior."""
+    from realhf_tpu.interfaces.ppo import PPOActorInterface
+    itf = PPOActorInterface()
+    assert itf.turn_level_credit is False
+    l1 = 9
+    logp = np.zeros(l1, np.float32)
+    ref = np.zeros(l1, np.float32)
+    score = np.array([1.25], np.float32)
+    kl, tot = ppo_functional.get_packed_rewards(
+        kl_ctl=0.1, clip_reward_value=20.0, log_probs=logp,
+        ref_log_probs=ref, reward_score=score,
+        short1cu_seqlens=np.array([0, l1]),
+        seq_no_eos_mask=np.array([False]))
+    expect = np.zeros(l1, np.float32)
+    expect[-1] = 1.25
+    np.testing.assert_allclose(tot, expect, atol=1e-6)
+
+
+def test_grpo_turn_level_reward_to_go_reduces_to_total_at_start():
+    """GRPO's turn-level variant: the reward-to-go at a sequence's
+    first slot equals the episode total, so group-centered advantages
+    at slot 0 match the sequence-level form; later slots stop being
+    credited for rewards already banked."""
+    g = 2
+    # group of 2 sequences, each 2 slots; dense rewards at both slots
+    dense = np.array([0.25, 1.0, 0.0, 0.5], np.float32)
+    lens_m1 = np.array([2, 2])
+    totals = np.array([1.25, 0.5], np.float32)
+    rtg = np.zeros_like(dense)
+    off = 0
+    for l in lens_m1:
+        acc = 0.0
+        for t in range(l - 1, -1, -1):
+            acc = float(dense[off + t]) + 1.0 * acc
+            rtg[off + t] = acc
+        off += l
+    grp = totals.reshape(-1, g)
+    mean_seq = np.repeat(np.repeat(grp.mean(axis=1), g), lens_m1)
+    std_seq = np.repeat(np.repeat(grp.std(axis=1, ddof=1), g),
+                        lens_m1)
+    adv = (rtg - mean_seq) / (std_seq + 1e-5)
+    # slot 0 of each sequence == the classic seq-level advantage
+    classic = (totals - grp.mean(axis=1).repeat(g)) \
+        / (grp.std(axis=1, ddof=1).repeat(g) + 1e-5)
+    assert adv[0] == pytest.approx(classic[0])
+    assert adv[2] == pytest.approx(classic[1])
+    # after turn 1's reward banked, seq 1's slot-1 credit shrinks
+    assert rtg[1] < rtg[0]
+
+
+def test_critic_knob_matches_actor_defaults():
+    from realhf_tpu.interfaces.ppo import PPOCriticInterface
+    assert PPOCriticInterface().turn_level_credit is False
+    assert PPOCriticInterface(
+        turn_level_credit=True).turn_level_credit is True

@@ -58,6 +58,8 @@ def test_headline_only_prints_and_skips_nonheadline_phases(
                         forbidden("serving"))
     monkeypatch.setattr(bench_mod, "_bench_async",
                         forbidden("async"))
+    monkeypatch.setattr(bench_mod, "_bench_agentic",
+                        forbidden("agentic"))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--headline-only"])
     bench_mod.main()
     assert ran == []
@@ -103,6 +105,8 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
                         spy("serving", ret={"shared": {}}))
     monkeypatch.setattr(bench_mod, "_bench_async",
                         spy("async", ret={"async_speedup": 1.1}))
+    monkeypatch.setattr(bench_mod, "_bench_agentic",
+                        spy("agentic", ret={"serving": {}}))
     monkeypatch.setattr(
         bench_mod, "_reshard_metrics",
         spy("reshard",
@@ -119,17 +123,19 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
                                        "kernel_disposition"]
     assert seen_phases["serving"][-1] == "pipeline_schedules"
     assert seen_phases["async"][-1] == "serving_bench"
-    assert seen_phases["reshard"][-1] == "async_bench"
+    assert seen_phases["agentic"][-1] == "async_bench"
+    assert seen_phases["reshard"][-1] == "agentic_bench"
     assert seen_phases["sft"][-1] == "reshard"
 
     final = _read_payload()
     assert final["phases_done"] == [
         "ppo_headline", "kernel_disposition", "pipeline_schedules",
-        "serving_bench", "async_bench", "reshard", "sft",
-        "overhead_probe"]
+        "serving_bench", "async_bench", "agentic_bench", "reshard",
+        "sft", "overhead_probe"]
     assert final["extra"]["pipeline_schedule_bench"] == {"stages": 4}
     assert final["extra"]["serving_bench"] == {"shared": {}}
     assert final["extra"]["async_bench"] == {"async_speedup": 1.1}
+    assert final["extra"]["agentic_bench"] == {"serving": {}}
     assert final["extra"]["sft_mfu"] == 0.5
     # final stdout line is the full headline record
     out_lines = [l for l in capsys.readouterr().out.splitlines()
@@ -153,6 +159,8 @@ def test_nonheadline_phase_failure_never_voids_headline(
                         lambda: {"shared": {}})
     monkeypatch.setattr(bench_mod, "_bench_async",
                         lambda: {"async_speedup": 1.0})
+    monkeypatch.setattr(bench_mod, "_bench_agentic",
+                        lambda: {"serving": {}})
     monkeypatch.setattr(bench_mod, "bench_sft",
                         lambda on_tpu: {"sft_mfu": 0.5})
     monkeypatch.setattr(bench_mod, "_reshard_metrics",

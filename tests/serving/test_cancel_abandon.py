@@ -1,0 +1,200 @@
+"""Mid-episode cancel/abandon regression (ISSUE 11 satellite): an
+EpisodeRunner-style caller dropping a request must cancel it
+server-side and leak NO client, server, or router state -- event maps,
+route tables, in-flight sets, idempotency entries all retire."""
+
+import numpy as np
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.serving.request_queue import RequestQueue
+from realhf_tpu.serving.router import FleetRouter
+from realhf_tpu.serving.server import (
+    TERMINAL_KINDS,
+    RolloutClient,
+    RolloutServer,
+)
+
+
+def _server(n_slots=2, chunk=2, name="abandon/0"):
+    return RolloutServer(
+        FakeSlotBackend(n_slots=n_slots, chunk=chunk,
+                        max_prompt_len=64),
+        server_name=name,
+        queue=RequestQueue(max_depth=32, n_slots=n_slots),
+        stream_tokens=True)
+
+
+def _prompt(need):
+    # FakeSlotBackend: prompt[0] = tokens the sequence needs
+    return np.array([need, 5, 6], np.int32)
+
+
+def test_abandon_in_flight_clears_client_and_server_state():
+    server = _server()
+    client = RolloutClient(server.address)
+    try:
+        # a long request that will be mid-decode when we abandon it
+        rid = client.submit(_prompt(40))
+        for _ in range(4):
+            server.serve_step(poll_timeout=0.01)
+        client._pump(0.1)  # accepted/started/token events arrive
+        assert rid in client._events
+        client.abandon(rid)
+        # local state dropped IMMEDIATELY, tombstone armed
+        assert rid not in client._events
+        assert rid in client._abandoned
+        # server processes the cancel; late events (tokens already on
+        # the wire + the cancelled terminal) must NOT resurrect state
+        for _ in range(20):
+            server.serve_step(poll_timeout=0.01)
+            client._pump(0.01)
+        assert rid not in client._events
+        # terminal arrived -> tombstone retired (bounded by design)
+        assert rid not in client._abandoned
+        # server side fully clean: no live slot, no queued entry, no
+        # client route
+        assert server.scheduler.n_live == 0
+        assert len(server.queue) == 0
+        assert server._routes == {}
+        assert server.scheduler.stats["cancelled"] == 1
+    finally:
+        client.close()
+        server.close()
+
+
+def test_abandon_queued_request_and_rid_reuse():
+    server = _server(n_slots=1, chunk=2)
+    client = RolloutClient(server.address)
+    try:
+        busy = client.submit(_prompt(30))   # occupies the only slot
+        queued = client.submit(_prompt(4))  # waits in the queue
+        for _ in range(3):
+            server.serve_step(poll_timeout=0.01)
+        client.abandon(queued)
+        for _ in range(30):
+            server.serve_step(poll_timeout=0.01)
+            client._pump(0.01)
+        assert queued not in client._events
+        assert len(server.queue) == 0
+        # resubmitting the same rid later revives a fresh stream
+        client.abandon(busy)
+        for _ in range(30):
+            server.serve_step(poll_timeout=0.01)
+            client._pump(0.01)
+        rid2 = client.submit(_prompt(4), rid=busy)
+        assert rid2 == busy and busy not in client._abandoned
+        done = None
+        for _ in range(60):
+            server.serve_step(poll_timeout=0.01)
+            for res in client.poll_results(timeout=0.01):
+                if res.rid == busy:
+                    done = res
+            if done:
+                break
+        assert done is not None and done.ok
+        assert server._routes == {} and server.scheduler.n_live == 0
+    finally:
+        client.close()
+        server.close()
+
+
+def test_abandoned_tombstones_bounded():
+    server = _server()
+    client = RolloutClient(server.address)
+    try:
+        client._abandoned_cap = 8
+        for i in range(20):
+            client.abandon(f"ghost-{i}")  # never-submitted rids
+        assert len(client._abandoned) == 8
+        # FIFO: the newest tombstones survive
+        assert "ghost-19" in client._abandoned
+        assert "ghost-0" not in client._abandoned
+    finally:
+        client.close()
+        server.close()
+
+
+class _Fleet:
+    """Minimal router-over-one-replica harness (real clocks: the
+    drill-style fake-clock fleets live in test_router/chaos)."""
+
+    def __init__(self):
+        self.repo = MemoryNameRecordRepository()
+        self.registry = FleetRegistry("e", "t", lease_ttl=60.0,
+                                      repo=self.repo)
+        self.server = RolloutServer(
+            FakeSlotBackend(n_slots=2, chunk=2, max_prompt_len=64),
+            server_name="gen_server/0",
+            queue=RequestQueue(max_depth=32, n_slots=2),
+            fleet=self.registry)
+        self.router = FleetRouter(self.registry,
+                                  fleet_poll_interval=0.01,
+                                  dispatch_timeout=5.0,
+                                  response_timeout=10.0,
+                                  pending_timeout=5.0)
+        self.client = RolloutClient(self.router.address)
+
+    def step(self, n=1):
+        for _ in range(n):
+            self.router.route_step(poll_timeout=0.002)
+            self.server.serve_step(poll_timeout=0.002)
+
+    def close(self):
+        self.client.close()
+        self.router.close()
+        self.server.close()
+
+
+def test_router_cancel_retires_all_request_state():
+    f = _Fleet()
+    try:
+        f.step(5)  # discover the replica
+        rid = f.client.submit(_prompt(40))
+        f.step(5)
+        assert rid in f.router._requests
+        f.client.abandon(rid)
+        for _ in range(40):
+            f.step()
+            f.client._pump(0.005)
+        # router: no live request, nothing pending, idempotency entry
+        # recorded exactly once, replica in-flight set empty
+        assert rid not in f.router._requests
+        assert rid not in f.router._pending
+        assert f.router._done.get(rid) == "cancelled"
+        for rep in f.router._replicas.values():
+            assert rid not in rep.inflight
+        # replica: slot released, no routes
+        assert f.server.scheduler.n_live == 0
+        assert f.server._routes == {}
+        # client: stream state gone (tombstone retired by the
+        # cancelled terminal the router forwarded)
+        assert rid not in f.client._events
+        assert rid not in f.client._abandoned
+        # a duplicate cancel for a retired rid is a no-op
+        f.client.cancel(rid)
+        f.step(5)
+        assert rid not in f.router._requests
+    finally:
+        f.close()
+
+
+def test_router_cancel_pending_unassigned_request():
+    f = _Fleet()
+    try:
+        # cancel BEFORE the router ever dispatches (no route_step
+        # between submit and cancel): the request dies in _pending
+        rid = f.client.submit(_prompt(6))
+        f.client.cancel(rid)
+        for _ in range(30):
+            f.step()
+            f.client._pump(0.005)
+        assert rid not in f.router._requests
+        assert rid not in f.router._pending
+        assert f.router._done.get(rid) == "cancelled"
+        # the client that did NOT abandon still gets the terminal
+        evs = f.client._events.get(rid, [])
+        assert any(k in TERMINAL_KINDS for k, _ in evs)
+    finally:
+        f.close()
