@@ -191,11 +191,45 @@ class ServingSpec:
     spec_decode_k: int = 0
     #: seconds drain() waits for in-flight sequences at shutdown
     drain_timeout_secs: float = 30.0
-    #: log-only autoscaling advisory (ROADMAP item 2, smallest useful
-    #: slice): when a server's queue depth stays above this threshold,
-    #: an ElasticPlanner GROW suggestion is emitted (counter + flight
-    #: event + warning log -- no mesh or fleet change). 0 disables.
+    #: HARD deadline on any drain: in-flight sequences still running
+    #: past it are force-fenced with explicit
+    #: ``cancelled(reason=drain_deadline)`` terminals (never silent
+    #: loss) and a flight event names the abandoned rids. None = the
+    #: drain timeout itself is the deadline.
+    drain_deadline_secs: Optional[float] = None
+    #: log-only autoscaling advisory (superseded by the closed loop
+    #: below, kept for single-server deployments): when a server's
+    #: queue depth stays above this threshold, an ElasticPlanner GROW
+    #: suggestion is emitted (counter + flight event + warning log --
+    #: no fleet change). 0 disables.
     autoscale_queue_threshold: int = 0
+    # -- closed-loop autoscaling (docs/serving.md "Autoscaling"):
+    # run_serve supervises an AutoscaleController that spawns/retires
+    # GenServer replicas from live router signals. Requires
+    # fleet_router (the router is both the signal source and the
+    # discovery path for new replicas).
+    autoscale: bool = False
+    #: replica-count bounds; scale-down never goes below the floor
+    #: (and never takes the last healthy replica while traffic is in
+    #: flight, even with floor 0)
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    #: seconds between policy observations in the launcher loop
+    autoscale_interval_secs: float = 2.0
+    #: scale-up pressure: queued requests per live replica above this
+    autoscale_up_queue_per_replica: int = 8
+    #: scale-up pressure: response-latency EWMA above this (None off)
+    autoscale_up_latency_secs: Optional[float] = None
+    #: consecutive pressured/idle observations before acting
+    autoscale_consecutive_up: int = 3
+    autoscale_consecutive_down: int = 10
+    #: scale-down idle bound: in-flight per REMAINING replica
+    autoscale_down_idle_per_replica: float = 1.0
+    #: same-direction re-arm time between actions
+    autoscale_cooldown_secs: float = 30.0
+    #: seconds a spawned replica gets to register before the spawn is
+    #: written off as failed
+    autoscale_spawn_deadline_secs: float = 180.0
     # -- resilient fleet mode (docs/serving.md "Fleet, failover &
     # circuit breakers"): a FleetRouter fronts the n_servers replicas;
     # replicas register leases in the fleet registry and clients talk

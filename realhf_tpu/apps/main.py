@@ -309,6 +309,91 @@ def _merge_run_traces():
                     "chrome://tracing).", merged)
 
 
+class _ServeFleetActuator:
+    """:class:`~realhf_tpu.system.autoscale.ReplicaActuator` over the
+    launcher's PodController + WorkerControlPanel: the production
+    spawn/retire path for GenServer replicas (docs/serving.md
+    "Autoscaling").
+
+    ``spawn`` submits the worker process (with the PodController's
+    retry/backoff); bring-up completes asynchronously in
+    :meth:`poll_bringup`, which configures + starts each replica the
+    moment its control endpoint registers and hands it to
+    ``on_started`` (watchdog + membership bookkeeping). ``retire``
+    first calls ``on_retiring`` (the worker must leave the watchdog
+    BEFORE its planned exit can look like a death), then commands
+    ``exit`` -- the worker's exit hook runs the graceful drain
+    (bounce queued, harvest in-flight, force-fence past the hard
+    deadline, release the lease) and the process exits COMPLETED."""
+
+    def __init__(self, controller: PodController, panel, sched,
+                 spec: ExperimentSpec, spec_path: str,
+                 env: Dict[str, str], *,
+                 on_started, on_retiring, reap_grace: float = 10.0):
+        self._controller = controller
+        self._panel = panel
+        self._sched = sched
+        self._spec = spec
+        self._spec_path = spec_path
+        self._env = env
+        self._on_started = on_started
+        self._on_retiring = on_retiring
+        self._reap_grace = reap_grace
+        #: submitted but not yet configured+started
+        self.pending: Dict[str, float] = {}
+
+    def spawn(self, name: str):
+        idx = int(name.rsplit("/", 1)[-1])
+        self._controller.submit(
+            name, _worker_cmd("gen_server", idx, self._spec),
+            env=self._env)
+        self.pending[name] = time.monotonic()
+
+    def poll_bringup(self):
+        """Configure + start every submitted replica whose control
+        endpoint has appeared (non-blocking probe); a replica whose
+        process already died is dropped (the controller's spawn
+        deadline writes it off)."""
+        for name in sorted(self.pending):
+            if self._sched.find(name).state == JobState.FAILED:
+                logger.error("Autoscale: spawned replica %s died "
+                             "before registering.", name)
+                del self.pending[name]
+                continue
+            try:
+                self._panel.connect([name], timeout=0.2)
+            except Exception:  # noqa: BLE001 - still booting
+                continue
+            idx = int(name.rsplit("/", 1)[-1])
+            self._panel.group_request_varied(
+                "configure",
+                {name: dict(config=dict(spec_path=self._spec_path,
+                                        server_index=idx))},
+                timeout=600)
+            self._panel.group_request("start", worker_names=[name])
+            del self.pending[name]
+            self._on_started(name)
+            logger.info("Autoscale: replica %s configured and "
+                        "started.", name)
+
+    def retire(self, name: str):
+        self._on_retiring(name)
+        # "exit" replies immediately; the drain runs in the worker's
+        # exit hook and the process exits COMPLETED when done
+        self._panel.group_request("exit", worker_names=[name],
+                                  timeout=60)
+
+    def gone(self, name: str) -> bool:
+        if name in self.pending:
+            return False
+        return self._sched.find(name).state not in (JobState.RUNNING,
+                                                    JobState.PENDING)
+
+    def reap(self, name: str):
+        self.pending.pop(name, None)
+        self._controller.stop(name, grace=self._reap_grace)
+
+
 def run_serve(spec: ExperimentSpec,
               env: Optional[Dict[str, str]] = None,
               duration: Optional[float] = None,
@@ -328,6 +413,13 @@ def run_serve(spec: ExperimentSpec,
         raise ValueError(
             "run_serve needs ExperimentSpec.serving (build one with "
             "the `serve` experiment, experiments/serve_exp.py).")
+    if getattr(sv, "autoscale", False) \
+            and not getattr(sv, "fleet_router", False):
+        raise ValueError(
+            "ServingSpec.autoscale needs fleet_router=True: the "
+            "router is both the autoscale signal source and how "
+            "clients discover spawned replicas (docs/serving.md "
+            "\"Autoscaling\").")
     constants.set_experiment_trial_names(spec.experiment_name,
                                          spec.trial_name)
     path = _spec_path(spec)
@@ -383,6 +475,7 @@ def run_serve(spec: ExperimentSpec,
         end = None if duration is None else time.monotonic() + duration
         deadline = time.monotonic() + timeout
         dead_servers = set()
+        autoscaler = None
 
         def _tolerable(w: str) -> bool:
             # in fleet mode a replica death is survivable until the
@@ -391,14 +484,99 @@ def run_serve(spec: ExperimentSpec,
                 return False
             if w not in dead_servers:
                 dead_servers.add(w)
+                if autoscaler is not None:
+                    # capacity accounting must track reality: the
+                    # policy re-fires a scale-up if load needs it
+                    autoscaler.forget(w)
                 logger.warning(
                     "Serving replica %s died; fleet continues on %d "
                     "survivor(s) (failover at the router).", w,
                     len(gen_names) - len(dead_servers))
             return len(dead_servers) < len(gen_names)
 
+        # -- closed-loop autoscaling (docs/serving.md "Autoscaling"):
+        # an AutoscaleController in THIS supervision loop turns live
+        # router signals into replica spawns/retires
+        if getattr(sv, "autoscale", False):
+            from realhf_tpu.serving.fleet import FleetRegistry
+            from realhf_tpu.system.autoscale import AutoscaleController
+            from realhf_tpu.system.elastic import (
+                AutoscalePolicy,
+                AutoscaleSignals,
+            )
+
+            def _member_add(w: str):
+                if w not in gen_names:
+                    gen_names.append(w)
+                if w not in worker_names:
+                    worker_names.append(w)
+                watchdog.add_workers([w])
+
+            def _member_remove(w: str):
+                # BEFORE the exit command: a planned departure must
+                # not read as a death in the failure loop
+                watchdog.remove_workers([w])
+                if w in worker_names:
+                    worker_names.remove(w)
+                if w in gen_names:
+                    gen_names.remove(w)
+                dead_servers.discard(w)
+
+            registry = FleetRegistry(spec.experiment_name,
+                                     spec.trial_name,
+                                     lease_ttl=sv.lease_ttl_secs)
+            actuator = _ServeFleetActuator(
+                controller, panel, sched, spec, path, env,
+                on_started=_member_add, on_retiring=_member_remove,
+                reap_grace=sv.drain_timeout_secs + 10)
+            autoscaler = AutoscaleController(
+                AutoscalePolicy(
+                    min_replicas=sv.autoscale_min_replicas,
+                    max_replicas=sv.autoscale_max_replicas,
+                    up_queue_per_replica=(
+                        sv.autoscale_up_queue_per_replica),
+                    up_latency_secs=sv.autoscale_up_latency_secs,
+                    consecutive_up=sv.autoscale_consecutive_up,
+                    consecutive_down=sv.autoscale_consecutive_down,
+                    down_idle_per_replica=(
+                        sv.autoscale_down_idle_per_replica),
+                    cooldown_secs=sv.autoscale_cooldown_secs),
+                actuator, registry, initial=list(gen_names),
+                spawn_deadline_secs=sv.autoscale_spawn_deadline_secs,
+                retire_deadline_secs=sv.drain_timeout_secs + 60)
+            _last_rej = [0]
+            _next_obs = [time.monotonic()
+                         + sv.autoscale_interval_secs]
+
+            def _autoscale_tick():
+                actuator.poll_bringup()
+                now = time.monotonic()
+                if now < _next_obs[0]:
+                    return
+                _next_obs[0] = now + sv.autoscale_interval_secs
+                try:
+                    st = panel.group_request(
+                        "stats", worker_names=["router/0"],
+                        timeout=30)["router/0"]
+                except Exception as e:  # noqa: BLE001 - a missed
+                    # observation must not kill supervision
+                    logger.warning("Autoscale: router stats "
+                                   "unavailable this tick: %s", e)
+                    return
+                rej = int(st.get("rejections", 0))
+                pending = int(st.get("pending", 0))
+                sig = AutoscaleSignals(
+                    queue_depth=pending,
+                    inflight=max(0, int(st.get("inflight", 0))
+                                 - pending),
+                    rejections=max(0, rej - _last_rej[0]),
+                    latency_secs=float(
+                        st.get("latency_ewma_secs") or 0.0))
+                _last_rej[0] = rej
+                autoscaler.step(sig, source="run_serve")
+
         while True:
-            for w in worker_names:
+            for w in list(worker_names):
                 info = sched.find(w)
                 failed = (info.state.value == "FAILED"
                           or panel.get_worker_status(w)
@@ -409,6 +587,8 @@ def run_serve(spec: ExperimentSpec,
             for w in watchdog.lost_longer_than(ft.worker_lost_fatal_secs):
                 if not _tolerable(w):
                     raise JobException(w, JobState.LOST)
+            if autoscaler is not None:
+                _autoscale_tick()
             if end is not None and time.monotonic() > end:
                 break
             if time.monotonic() > deadline:
@@ -417,6 +597,10 @@ def run_serve(spec: ExperimentSpec,
 
         alive = [w for w in worker_names if w not in dead_servers]
         stats = panel.group_request("stats", worker_names=alive)
+        if autoscaler is not None:
+            import dataclasses as _dc
+            stats["autoscale_events"] = [
+                _dc.asdict(e) for e in autoscaler.events]
         # exit drains each server (GenServerWorker._exit_hook) before
         # the COMPLETED status lands
         panel.group_request("exit", worker_names=alive,

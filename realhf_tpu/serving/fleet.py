@@ -44,6 +44,11 @@ class ReplicaInfo:
     name: str
     address: str
     epoch: int
+    #: deliberately draining toward a scale-down/shutdown: consumers
+    #: stop dispatching NEW work here, and when the lease finally
+    #: disappears they treat it as a planned departure (no breaker
+    #: trip, no failover storm) instead of a loss
+    retiring: bool = False
 
 
 class FleetRegistry:
@@ -73,9 +78,15 @@ class FleetRegistry:
     def _epoch_key(self, name: str) -> str:
         return f"{self._root}/epochs/{name}"
 
+    def _retiring_key(self, name: str) -> str:
+        return f"{self._root}/retiring/{name}"
+
     # ------------------------------------------------------------------
     def register(self, name: str, address: str) -> int:
-        """(Re-)register a replica; returns its NEW fencing epoch."""
+        """(Re-)register a replica; returns its NEW fencing epoch. A
+        fresh registration is never retiring -- a revived replica of a
+        previously drained name starts clean."""
+        self.clear_retiring(name)
         epoch = self._repo.register_with_epoch(
             self._replica_key(name),
             lambda e: f"{e}:{address}",
@@ -85,6 +96,29 @@ class FleetRegistry:
                     "lease %.1fs).", name, address, epoch,
                     self.lease_ttl)
         return epoch
+
+    # -- deliberate scale-down (docs/serving.md "Autoscaling") ---------
+    def mark_retiring(self, name: str):
+        """Flag a replica as deliberately draining (scale-down /
+        graceful shutdown). The flag has NO lease: it must survive the
+        replica's own deregistration so a consumer polling after the
+        lease vanished still classifies the departure as planned. It
+        is cleared by the next :meth:`register` of the same name."""
+        self._repo.add(self._retiring_key(name), "1", replace=True)
+        logger.info("Fleet replica %s marked retiring.", name)
+
+    def clear_retiring(self, name: str):
+        try:
+            self._repo.delete(self._retiring_key(name))
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+    def is_retiring(self, name: str) -> bool:
+        try:
+            self._repo.get(self._retiring_key(name))
+            return True
+        except name_resolve.NameEntryNotFoundError:
+            return False
 
     def renew(self, name: str):
         """Refresh the replica's lease. Raises LeaseLostError when the
@@ -110,6 +144,10 @@ class FleetRegistry:
     def replicas(self) -> Dict[str, ReplicaInfo]:
         """Live (unexpired) replicas as {name: ReplicaInfo}."""
         root = f"{self._root}/replicas"
+        rroot = f"{self._root}/retiring"
+        retiring = {k[len(rroot) + 1:] for k in
+                    self._repo.find_subtree(rroot)
+                    if k.startswith(rroot + "/")}
         out: Dict[str, ReplicaInfo] = {}
         for key in self._repo.find_subtree(root):
             name = key[len(root) + 1:] if key.startswith(root + "/") \
@@ -121,7 +159,8 @@ class FleetRegistry:
             try:
                 epoch_s, address = str(raw).split(":", 1)
                 out[name] = ReplicaInfo(name=name, address=address,
-                                        epoch=int(epoch_s))
+                                        epoch=int(epoch_s),
+                                        retiring=name in retiring)
             except ValueError:
                 logger.warning("Fleet registry: malformed replica "
                                "entry %s=%r ignored.", key, raw)

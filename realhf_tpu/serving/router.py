@@ -125,6 +125,10 @@ class _Replica:
     breaker: CircuitBreaker
     inflight: Set[str] = dataclasses.field(default_factory=set)
     lost: bool = False
+    #: deliberately draining (FleetRegistry retiring flag): no NEW
+    #: dispatch, but its socket keeps pumping so in-flight work still
+    #: delivers; its eventual disappearance is a planned departure
+    retiring: bool = False
     probe_sent_at: Optional[float] = None
 
 
@@ -232,7 +236,10 @@ class FleetRouter:
         self.stats_counters = dict(
             requests=0, dispatches=0, failovers=0, hedges=0,
             hedge_wins=0, duplicate_terminals=0, stale_events=0,
-            fenced_reconnects=0, affinity_hits=0)
+            fenced_reconnects=0, affinity_hits=0, rejections=0,
+            retired=0, retire_redispatches=0)
+        #: EWMA of done-request end-to-end latency (autoscale signal)
+        self.latency_ewma_secs: Optional[float] = None
         logger.info("Fleet router %s listening on %s.", router_name,
                     self.address)
 
@@ -273,10 +280,16 @@ class FleetRouter:
                 self._replicas[name] = _Replica(
                     name=name, address=info.address, epoch=info.epoch,
                     sock=self._connect(info),
-                    breaker=self._make_breaker(name))
+                    breaker=self._make_breaker(name),
+                    retiring=info.retiring)
                 logger.info("Router: replica %s joined (epoch %d, "
                             "%s).", name, info.epoch, info.address)
                 continue
+            if info.retiring and not rep.retiring:
+                logger.info("Router: replica %s retiring (%d in "
+                            "flight finish there; no new dispatch).",
+                            name, len(rep.inflight))
+            rep.retiring = info.retiring
             if info.epoch != rep.epoch or info.address != rep.address:
                 # re-registration: the old connection belongs to a
                 # fenced-out incarnation -- swap it atomically so the
@@ -298,21 +311,55 @@ class FleetRouter:
                 # lease reappeared with the SAME epoch: renewals
                 # resumed before expiry was observed consistently
                 rep.lost = False
-        for name, rep in self._replicas.items():
+        for name, rep in list(self._replicas.items()):
             if name not in live and not rep.lost:
-                self._mark_lost(rep, why="lease expired")
+                if rep.retiring or self.registry.is_retiring(name):
+                    # deliberate departure (scale-down drain finished
+                    # and the lease was released): NOT a loss -- no
+                    # breaker trip, no failover accounting
+                    self._retire_replica(rep)
+                else:
+                    self._mark_lost(rep, why="lease expired")
         n_healthy = sum(1 for r in self._replicas.values()
-                        if not r.lost and r.breaker.allow())
+                        if not r.lost and not r.retiring
+                        and r.breaker.allow())
         metrics.set_gauge("router_replicas", len(live), state="live")
         metrics.set_gauge("router_replicas", n_healthy, state="healthy")
 
     def notify_lost(self, name: str):
         """Watchdog hook: mark a replica LOST now, without waiting for
         its lease to expire (``Watchdog(on_lost=router.notify_lost)``
-        when both live in one process)."""
+        when both live in one process). A replica mid-retire is exempt
+        -- its drain already stopped the heartbeat-adjacent work the
+        watchdog keys on, and :meth:`_retire_replica` (or the lease
+        fallback) recovers anything it leaves behind."""
         rep = self._replicas.get(name)
-        if rep is not None and not rep.lost:
+        if rep is not None and not rep.lost and not rep.retiring:
             self._mark_lost(rep, why="watchdog LOST")
+
+    def _retire_replica(self, rep: _Replica):
+        """Planned departure (docs/serving.md "Autoscaling"): the
+        replica drained and released its lease. No breaker
+        transition, no failover counter -- a clean scale-down is
+        indistinguishable from nothing having happened, except that
+        any request the drain abandoned past its hard deadline is
+        quietly re-dispatched (``retire_redispatches``) so nothing is
+        ever orphaned by a scale-down."""
+        leftovers = sorted(rep.inflight)
+        logger.info("Router: replica %s retired cleanly (%d leftover "
+                    "request(s) re-dispatched).", rep.name,
+                    len(leftovers))
+        self.stats_counters["retired"] += 1
+        metrics.inc("router_replicas_retired_total", replica=rep.name)
+        for rid in leftovers:
+            req = self._requests.get(rid)
+            if req is None:
+                continue
+            self._fail_assignment(req, rep.name, why="retired",
+                                  counter="retire_redispatches")
+        rep.inflight.clear()
+        rep.sock.close(0)
+        self._replicas.pop(rep.name, None)
 
     def _mark_lost(self, rep: _Replica, why: str):
         logger.warning("Router: replica %s LOST (%s); failing over "
@@ -378,10 +425,12 @@ class FleetRouter:
                 self.stats_counters["stale_events"] += 1
                 return
             if self._draining:
+                self.stats_counters["rejections"] += 1
                 self._reply(ident, "rejected", rid,
                             dict(reason="draining", retry_after=None))
                 return
             if len(self._requests) >= self.max_pending:
+                self.stats_counters["rejections"] += 1
                 metrics.inc("router_rejections_total",
                             reason="backpressure")
                 self._reply(ident, "rejected", rid,
@@ -486,6 +535,16 @@ class FleetRouter:
             if kind == "cancelled" and rep.name in req.losers \
                     and not req.client_cancelled:
                 return  # a hedge loser acking our cancel: bookkeeping
+            if kind == "cancelled" \
+                    and data.get("reason") == "drain_deadline" \
+                    and not req.client_cancelled:
+                # the replica's drain hit its hard deadline and
+                # force-fenced this request (explicit terminal, never
+                # silent): shop it to a survivor like any transient
+                # bounce -- the client only sees the cancellation when
+                # nobody is left to take it
+                self._on_replica_reject(rep, req, kind, data)
+                return
             if kind in ("rejected", "draining") \
                     and not req.client_cancelled:
                 self._on_replica_reject(rep, req, kind, data)
@@ -518,7 +577,7 @@ class FleetRouter:
     # -- dispatch ------------------------------------------------------
     def _candidates(self, req: _RouterRequest) -> List[_Replica]:
         out = [r for r in self._replicas.values()
-               if not r.lost and r.breaker.allow()
+               if not r.lost and not r.retiring and r.breaker.allow()
                and r.name not in req.assigned
                and r.name not in req.failed]
         # least-loaded, name as the deterministic tie-break
@@ -607,9 +666,10 @@ class FleetRouter:
             return False
 
     def _fail_assignment(self, req: _RouterRequest, rname: str,
-                         why: str):
+                         why: str, counter: str = "failovers"):
         """One replica's copy of a request is gone (loss, stall,
-        dispatch timeout): exclude the replica for this rid and
+        dispatch timeout -- or, with ``counter="retire_redispatches"``,
+        a planned retire): exclude the replica for this rid and
         re-dispatch unless a twin is still live."""
         req.assigned.pop(rname, None)
         req.failed.add(rname)
@@ -618,8 +678,8 @@ class FleetRouter:
         if req.rid in self._done or req.client_cancelled:
             return
         req.retried_from.append(rname)
-        self.stats_counters["failovers"] += 1
-        metrics.inc("router_failovers_total", replica=rname)
+        self.stats_counters[counter] += 1
+        metrics.inc(f"router_{counter}_total", replica=rname)
         if req.started_fwd:
             # a streaming client must reset its token accumulation:
             # the replacement replica re-generates from the prompt,
@@ -683,7 +743,7 @@ class FleetRouter:
 
     def _probe_breakers(self, now: float):
         for rep in self._replicas.values():
-            if rep.lost:
+            if rep.lost or rep.retiring:
                 continue
             br = rep.breaker
             if br.ready_to_probe():
@@ -729,6 +789,17 @@ class FleetRouter:
                 and from_replica != req.primary:
             self.stats_counters["hedge_wins"] += 1
             metrics.inc("router_hedge_wins_total")
+        if kind == "rejected":
+            self.stats_counters["rejections"] += 1
+        elif kind == "done":
+            # end-to-end latency EWMA: the autoscale policy's
+            # latency signal (docs/serving.md "Autoscaling")
+            lat = max(0.0, self._clock() - req.created_at)
+            self.latency_ewma_secs = lat \
+                if self.latency_ewma_secs is None \
+                else 0.2 * lat + 0.8 * self.latency_ewma_secs
+            metrics.set_gauge("router_latency_ewma_secs",
+                              self.latency_ewma_secs)
         self._forward(req, kind, data)
         metrics.inc("router_terminals_total", kind=kind)
         self._done[req.rid] = kind
@@ -814,8 +885,10 @@ class FleetRouter:
             pending=len(self._pending),
             inflight=len(self._requests),
             draining=self._draining,
+            latency_ewma_secs=self.latency_ewma_secs,
             replicas={
                 name: dict(epoch=rep.epoch, lost=rep.lost,
+                           retiring=rep.retiring,
                            breaker=rep.breaker.state.name,
                            inflight=len(rep.inflight))
                 for name, rep in sorted(self._replicas.items())})

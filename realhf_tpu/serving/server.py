@@ -87,9 +87,13 @@ class RolloutServer:
                  fleet=None,
                  chaos: Optional[fault_injection.NetChaos] = None,
                  grow_advisor=None,
+                 drain_deadline_secs: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.server_name = server_name
         self._clock = clock
+        #: hard cap on how long any drain may wait for in-flight work
+        #: before force-fencing it with explicit terminals
+        self.drain_deadline_secs = drain_deadline_secs
         # RequestQueue.__bool__ is True even when empty, so `or` no
         # longer swallows a caller-provided empty queue
         self.queue = queue or RequestQueue(
@@ -406,29 +410,79 @@ class RolloutServer:
                 self._routes.pop(rid, None)
 
     # ------------------------------------------------------------------
-    def drain(self, timeout: float = 30.0):
-        """Graceful shutdown: refuse new work, bounce queued requests,
-        finish (or cancel) in-flight sequences, leave nothing
-        orphaned."""
+    def begin_drain(self) -> int:
+        """Start a graceful drain WITHOUT blocking: mark this replica
+        retiring in the fleet registry (the router stops dispatching
+        here but keeps pumping our in-flight work -- and treats our
+        eventual departure as planned, not LOST), refuse new work, and
+        bounce queued requests back to their clients as ``draining``.
+        In-flight sequences keep finishing through subsequent
+        ``serve_step`` calls; callers end with :meth:`finish_drain`.
+        Returns how many queued requests were bounced."""
         if self._draining:
-            return
+            return 0
         self._draining = True
+        if self._fleet is not None:
+            self._fleet.mark_retiring(self.server_name)
         bounced = self.queue.start_drain()
         for req in bounced:
             self._send(req.rid, "draining", {})
-        deadline = self._clock() + timeout
-        while self.scheduler.n_live and self._clock() < deadline:
-            self.serve_step(poll_timeout=0.0)
-        for rid in self.scheduler.active_rids():
-            self.scheduler.cancel(rid)
-            self._send(rid, "cancelled", {})
+        return len(bounced)
+
+    def finish_drain(self, force: bool = False) -> List[str]:
+        """Close out a drain: with ``force``, any sequence still in
+        flight (the drain exceeded its hard deadline) is force-fenced
+        with an EXPLICIT ``cancelled(reason=drain_deadline)`` terminal
+        -- never silent loss -- and a flight event names the abandoned
+        rids; a fronting router shops those requests to survivors.
+        Finally the fleet lease is released so the router sees a
+        planned departure. Returns the abandoned rids."""
+        abandoned: List[str] = []
+        if force:
+            for rid in self.scheduler.active_rids():
+                self.scheduler.cancel(rid)
+                self._send(rid, "cancelled",
+                           dict(reason="drain_deadline"))
+                abandoned.append(rid)
+            if abandoned:
+                from realhf_tpu.obs import flight
+                metrics.inc("serving_drain_abandoned_total",
+                            amount=len(abandoned),
+                            server=self.server_name)
+                flight.record("serving_drain_abandoned",
+                              server=self.server_name,
+                              rids=sorted(abandoned),
+                              n=len(abandoned))
+                logger.warning(
+                    "Rollout server %s: drain deadline exceeded; %d "
+                    "in-flight request(s) force-fenced with explicit "
+                    "terminals: %s.", self.server_name,
+                    len(abandoned), sorted(abandoned))
         if self._fleet is not None:
             # leave the fleet NOW instead of letting the lease decay:
             # the router stops dispatching here immediately
             self._fleet.deregister(self.server_name)
+        return abandoned
+
+    def drain(self, timeout: float = 30.0):
+        """Graceful shutdown: refuse new work, bounce queued requests,
+        finish in-flight sequences, leave nothing orphaned. In-flight
+        work past the hard deadline (``min(timeout,
+        drain_deadline_secs)``) is force-fenced with explicit
+        terminals (:meth:`finish_drain`), never silently dropped."""
+        if self.drain_deadline_secs is not None:
+            timeout = min(timeout, self.drain_deadline_secs)
+        # re-runnable: a drain after an earlier begin_drain() (e.g. a
+        # `drain` worker command followed by the exit hook) must still
+        # wait out in-flight work and release the lease
+        bounced = self.begin_drain()
+        deadline = self._clock() + timeout
+        while self.scheduler.n_live and self._clock() < deadline:
+            self.serve_step(poll_timeout=0.0)
+        self.finish_drain(force=True)
         logger.info(
             "Rollout server %s drained: %d queued bounced, stats=%s.",
-            self.server_name, len(bounced), self.stats())
+            self.server_name, bounced, self.stats())
 
     def close(self):
         if not self._closed:

@@ -91,6 +91,7 @@ class GenServerWorker(worker_base.Worker):
             prefix_cache=prefix_cache,
             fleet=fleet,
             grow_advisor=grow_advisor,
+            drain_deadline_secs=sv.drain_deadline_secs,
             seed=spec.seed + self.server_index)
         self._drain_timeout = sv.drain_timeout_secs
         if fleet is not None:

@@ -1,0 +1,285 @@
+"""Closed-loop fleet autoscaling: suggestion -> action.
+
+PR 9's :class:`~realhf_tpu.system.elastic.GrowAdvisor` only logged
+"you should scale up"; this module closes the loop
+(docs/serving.md "Autoscaling"). An :class:`AutoscaleController`
+drives an :class:`~realhf_tpu.system.elastic.AutoscalePolicy` with
+live fleet signals and acts on its decisions through a small
+*actuator* interface, so the same controller runs:
+
+- in the launcher (``apps.main.run_serve``): the actuator submits new
+  ``GenServerWorker`` processes through the
+  :class:`~realhf_tpu.system.pod.PodController` and retires replicas
+  by commanding their graceful exit (drain -> bounce -> harvest ->
+  lease release -> process reaped);
+- in-process (``scripts/bench_serving.py`` bursty harness,
+  ``scripts/chaos_drill.py`` churn schedules): the actuator spawns
+  ``RolloutServer`` replicas on threads.
+
+Scale-UP: spawn a replica under the next free name; it registers a
+fresh lease + fencing epoch in the
+:class:`~realhf_tpu.serving.fleet.FleetRegistry` and the
+``FleetRouter`` discovers it on its next registry poll -- no router
+restart, no client change. Scale-DOWN: the victim is FIRST marked
+``retiring`` in the registry (the router immediately stops
+dispatching there and will treat the departure as planned -- no
+breaker trip, no failover storm), then told to drain: queued requests
+bounce as ``draining``, in-flight sequences are harvested (or, past
+the hard drain deadline, force-fenced with explicit terminals the
+router shops to survivors), the lease is released, and the process is
+reaped. No request is ever orphaned by a scale event.
+
+The controller itself is single-threaded and non-blocking: call
+:meth:`AutoscaleController.step` from the supervising loop. It spawns
+NO threads of its own.
+"""
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from realhf_tpu.base import logging
+from realhf_tpu.obs import flight, metrics
+from realhf_tpu.system.elastic import AutoscalePolicy, AutoscaleSignals, \
+    ScaleDecision
+
+logger = logging.getLogger("autoscale", "system")
+
+
+class ReplicaActuator:
+    """What the controller needs from the environment to act. Duck
+    typing is fine; this base class just documents the contract (and
+    lets tests subclass)."""
+
+    def spawn(self, name: str):
+        """Begin bringing up one replica under ``name`` (async OK:
+        the controller watches the fleet registry for its lease)."""
+        raise NotImplementedError
+
+    def retire(self, name: str):
+        """Begin a graceful retire: drain (bounce queued, finish
+        in-flight, release the lease) then shut the replica down.
+        Must not block the caller for the full drain."""
+        raise NotImplementedError
+
+    def gone(self, name: str) -> bool:
+        """True once the replica's process/thread has fully exited."""
+        raise NotImplementedError
+
+    def reap(self, name: str):
+        """Force-stop a replica that failed to spawn or failed to
+        retire within its deadline. Best effort, must not raise."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class ScaleEvent:
+    """One controller action, kept for payloads/tests (flight events
+    and metrics are the durable record)."""
+    t: float
+    action: str          # spawn | retire | retired | spawn_failed | ...
+    replica: str
+    n_replicas: int
+    reason: str = ""
+
+
+class AutoscaleController:
+    """Drive policy decisions into fleet actions (module docstring).
+
+    ``registry`` is the fleet's :class:`FleetRegistry`: the controller
+    marks scale-down victims ``retiring`` there *before* telling them
+    to drain (closing the router race), and uses the lease subtree to
+    confirm a spawned replica came up.
+
+    Replica naming: managed replicas are ``{prefix}/{index}``; new
+    spawns take the next index above everything ever managed, so a
+    name is never reused within a run (fencing epochs make reuse safe,
+    but unique names keep flight records unambiguous).
+    """
+
+    def __init__(self, policy: AutoscalePolicy,
+                 actuator: ReplicaActuator, registry, *,
+                 initial: Sequence[str] = (),
+                 name_prefix: str = "gen_server",
+                 spawn_deadline_secs: float = 180.0,
+                 retire_deadline_secs: float = 120.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self.actuator = actuator
+        self.registry = registry
+        self.name_prefix = name_prefix
+        self.spawn_deadline_secs = spawn_deadline_secs
+        self.retire_deadline_secs = retire_deadline_secs
+        self._clock = clock
+        self._replicas: List[str] = list(initial)
+        self._booting: Dict[str, float] = {}
+        self._retiring: Dict[str, float] = {}
+        self._reaped: set = set()
+        self._next_index = 1 + max(
+            [self._index_of(n) for n in self._replicas] + [-1])
+        self.events: List[ScaleEvent] = []
+
+    @staticmethod
+    def _index_of(name: str) -> int:
+        tail = name.rsplit("/", 1)[-1]
+        return int(tail) if tail.isdigit() else -1
+
+    # -- views ---------------------------------------------------------
+    @property
+    def n_replicas(self) -> int:
+        """Replicas the policy should size against: managed and not
+        on their way out (booting ones count -- a decision was already
+        spent on them)."""
+        return len(self._replicas) - len(self._retiring)
+
+    def replicas(self) -> List[str]:
+        return list(self._replicas)
+
+    def retiring(self) -> List[str]:
+        return sorted(self._retiring)
+
+    def forget(self, name: str):
+        """A managed replica died outside the controller's doing (a
+        tolerated fleet death): drop it from capacity accounting so
+        the policy sizes against reality -- load that needed it will
+        re-fire the scale-up trigger."""
+        if name in self._replicas:
+            self._replicas.remove(name)
+        self._booting.pop(name, None)
+        self._retiring.pop(name, None)
+        self._reaped.discard(name)
+        self._record("died", name)
+
+    def busy(self) -> bool:
+        """A scale action is still in flight (boot or drain): the
+        supervising loop may want to hold further decisions."""
+        return bool(self._booting or self._retiring)
+
+    # -- one supervision tick ------------------------------------------
+    def step(self, signals: AutoscaleSignals, **ctx) -> ScaleDecision:
+        """Advance in-flight transitions, feed the policy one
+        observation (``n_replicas`` is overwritten with the
+        controller's own view), and act on its decision."""
+        self._poll_transitions()
+        signals = dataclasses.replace(signals,
+                                      n_replicas=self.n_replicas)
+        decision = self.policy.observe(signals, **ctx)
+        if decision.action == "up":
+            self._scale_up(decision, ctx)
+        elif decision.action == "down":
+            self._scale_down(decision, ctx)
+        metrics.set_gauge("serving_autoscale_replicas",
+                          self.n_replicas)
+        return decision
+
+    def _record(self, action: str, replica: str, reason: str = ""):
+        self.events.append(ScaleEvent(
+            t=self._clock(), action=action, replica=replica,
+            n_replicas=self.n_replicas, reason=reason))
+
+    def _poll_transitions(self):
+        now = self._clock()
+        live = set(self.registry.replicas()) \
+            if self.registry is not None else None
+        for name, t0 in sorted(self._booting.items()):
+            if live is not None and name in live:
+                del self._booting[name]
+                self._record("up_live", name)
+                flight.record("autoscale_replica_up", replica=name,
+                              boot_secs=round(now - t0, 3))
+                logger.info("Autoscale: replica %s is up (%.1fs).",
+                            name, now - t0)
+            elif now - t0 > self.spawn_deadline_secs:
+                # the spawn never registered: write it off so the
+                # policy can try again (capacity stays honest)
+                del self._booting[name]
+                if name in self._replicas:
+                    self._replicas.remove(name)
+                metrics.inc("serving_autoscale_spawn_failed_total")
+                flight.record("autoscale_spawn_failed", replica=name,
+                              deadline_secs=self.spawn_deadline_secs)
+                logger.error(
+                    "Autoscale: replica %s failed to register within "
+                    "%.0fs; reaping.", name, self.spawn_deadline_secs)
+                self._record("spawn_failed", name)
+                self.actuator.reap(name)
+        for name, t0 in sorted(self._retiring.items()):
+            if self.actuator.gone(name):
+                del self._retiring[name]
+                self._reaped.discard(name)
+                if name in self._replicas:
+                    self._replicas.remove(name)
+                self._record("retired", name)
+                flight.record("autoscale_replica_retired",
+                              replica=name,
+                              drain_secs=round(now - t0, 3))
+                logger.info("Autoscale: replica %s retired (%.1fs).",
+                            name, now - t0)
+            elif now - t0 > self.retire_deadline_secs \
+                    and name not in self._reaped:
+                # drain overstayed its welcome: force-stop once, keep
+                # polling for the exit
+                self._reaped.add(name)
+                flight.record("autoscale_retire_forced", replica=name,
+                              deadline_secs=self.retire_deadline_secs)
+                logger.warning(
+                    "Autoscale: replica %s still draining after "
+                    "%.0fs; force-stopping.", name,
+                    self.retire_deadline_secs)
+                self.actuator.reap(name)
+
+    def _scale_up(self, decision: ScaleDecision, ctx: Dict):
+        name = f"{self.name_prefix}/{self._next_index}"
+        self._next_index += 1
+        try:
+            self.actuator.spawn(name)
+        except Exception as e:  # noqa: BLE001 - a failed spawn must
+            # not kill the supervising loop; the policy will re-fire
+            metrics.inc("serving_autoscale_spawn_failed_total")
+            flight.record("autoscale_spawn_failed", replica=name,
+                          error=repr(e))
+            logger.error("Autoscale: spawn of %s failed: %r", name, e)
+            self._record("spawn_failed", name, reason=repr(e))
+            return
+        self._replicas.append(name)
+        self._booting[name] = self._clock()
+        self._record("spawn", name, reason=decision.reason)
+        flight.record("autoscale_spawn", replica=name,
+                      target=decision.target, reason=decision.reason,
+                      **ctx)
+
+    def _choose_victim(self) -> Optional[str]:
+        """Newest-first (LIFO): the most recently added replica goes
+        first -- it holds the least prefix-cache/affinity value, and a
+        spike's extra capacity unwinds in reverse order. Replicas
+        already booting or retiring are not candidates."""
+        cands = [n for n in self._replicas
+                 if n not in self._retiring and n not in self._booting]
+        if not cands:
+            return None
+        return max(cands, key=self._index_of)
+
+    def _scale_down(self, decision: ScaleDecision, ctx: Dict):
+        victim = self._choose_victim()
+        if victim is None:
+            logger.info("Autoscale: down decision with no drainable "
+                        "replica (all booting/retiring); holding.")
+            return
+        # ORDER MATTERS: mark retiring BEFORE the drain command, so
+        # the router stops dispatching to the victim before its queue
+        # starts bouncing (and classifies the departure as planned)
+        if self.registry is not None:
+            self.registry.mark_retiring(victim)
+        try:
+            self.actuator.retire(victim)
+        except Exception as e:  # noqa: BLE001 - same contract as spawn
+            flight.record("autoscale_retire_failed", replica=victim,
+                          error=repr(e))
+            logger.error("Autoscale: retire of %s failed: %r; "
+                         "force-stopping.", victim, e)
+            self.actuator.reap(victim)
+        self._retiring[victim] = self._clock()
+        self._record("retire", victim, reason=decision.reason)
+        flight.record("autoscale_retire", replica=victim,
+                      target=decision.target, reason=decision.reason,
+                      **ctx)

@@ -256,57 +256,297 @@ class ElasticPlanner:
                 for w in d.original_workers}
 
 
-class GrowAdvisor:
-    """Log-only autoscaling advisory: the first end-to-end wire from
-    the serving metrics to the elastic planner (ROADMAP item 2's
-    smallest useful slice).
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSignals:
+    """One observation of the serving fleet's live load, as consumed
+    by :class:`AutoscalePolicy.observe`. All fields are plain numbers
+    so policy tests are pure data on a fake clock."""
+    #: requests waiting for a replica (router pending + replica queues)
+    queue_depth: int = 0
+    #: requests currently being served fleet-wide
+    inflight: int = 0
+    #: NEW admission rejections since the previous observation
+    #: (backpressure / no_healthy_replica -- a shed request is the
+    #: strongest possible scale-up signal)
+    rejections: int = 0
+    #: recent end-to-end response latency (e.g. the router's EWMA)
+    latency_secs: float = 0.0
+    #: live, non-retiring replicas the decision applies to
+    n_replicas: int = 1
 
-    ``observe(queue_depth)`` is called wherever the queue-depth gauge
-    is set (``serving/server.py`` serve loop). A depth above
-    ``threshold`` for ``consecutive`` observations emits ONE grow
-    suggestion -- ``elastic_grow_suggested_total`` counter, an
-    ``elastic_grow_suggestion`` flight event, a warning log -- and
-    then stays quiet for ``cooldown_secs``. No mesh or fleet change
-    happens; an operator (or a future autoscaler) acts on the signal.
+
+@dataclasses.dataclass(frozen=True)
+class ScaleDecision:
+    """The policy's verdict for one observation. ``action`` is
+    ``"up"``/``"down"`` only when the controller should act NOW;
+    a triggered-but-vetoed decision comes back as ``"hold"`` with
+    ``suppressed`` naming the veto (cooldown / flap / floor / ceiling
+    / last_healthy)."""
+    action: str                     # "up" | "down" | "hold"
+    target: int                     # desired replica count
+    reason: str = ""
+    suppressed: Optional[str] = None
+
+    @property
+    def acted(self) -> bool:
+        return self.action in ("up", "down")
+
+
+class AutoscalePolicy:
+    """Closed-loop scale decisions from live serving signals
+    (docs/serving.md "Autoscaling"): the promotion of the log-only
+    :class:`GrowAdvisor` into the decision engine the
+    ``AutoscaleController`` (``system/autoscale.py``) acts on.
+
+    **Scale-up** triggers when any pressure signal holds for
+    ``consecutive_up`` observations: queue depth above
+    ``up_queue_per_replica`` per live replica, any admission
+    rejections (``up_rejections`` per observation), or response
+    latency above ``up_latency_secs``. **Scale-down** triggers when
+    the fleet has been idle for ``consecutive_down`` observations:
+    zero queued requests AND the in-flight load would fit on one
+    replica fewer (``down_idle_per_replica`` in-flight requests per
+    remaining replica).
+
+    A triggered decision still has to clear the vetoes, each recorded
+    as ``serving_autoscale_suppressed_total{reason=...}``:
+
+    - **floor/ceiling**: the replica count never leaves
+      ``[min_replicas, max_replicas]``, and the last replica is never
+      retired while traffic is queued or in flight (``last_healthy``)
+      even when ``min_replicas == 0``.
+    - **cooldown**: after an action, the SAME direction re-arms only
+      after ``cooldown_secs``.
+    - **flap**: every action excludes the OPPOSITE direction through
+      an :class:`~realhf_tpu.system.watchdog.ExclusionBook` window
+      (``flap_base_secs``, doubling per repeat, capped) -- the
+      up/down/up oscillation a bursty workload invites gets
+      exponentially longer dead time, exactly the cooldown discipline
+      flapping workers get. A ``flap_forgive_secs`` stretch with no
+      actions clears the escalation history.
+
+    Emitted decisions and suppressions are recorded as flight events
+    plus ``serving_autoscale_{up,down,suppressed}_total`` metrics.
+    The clock is injectable; all hysteresis tests run on a fake clock
+    in milliseconds."""
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 up_queue_per_replica: int = 8,
+                 up_rejections: int = 1,
+                 up_latency_secs: Optional[float] = None,
+                 consecutive_up: int = 3,
+                 down_idle_per_replica: float = 1.0,
+                 consecutive_down: int = 10,
+                 cooldown_secs: float = 60.0,
+                 flap_base_secs: Optional[float] = None,
+                 flap_max_secs: float = 600.0,
+                 flap_forgive_secs: Optional[float] = None,
+                 clock=time.monotonic):
+        if min_replicas < 0:
+            raise ValueError(
+                f"min_replicas must be >= 0, got {min_replicas}")
+        if max_replicas < max(1, min_replicas):
+            raise ValueError(
+                f"max_replicas ({max_replicas}) must be >= "
+                f"max(1, min_replicas={min_replicas})")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_queue_per_replica = int(up_queue_per_replica)
+        self.up_rejections = int(up_rejections)
+        self.up_latency_secs = up_latency_secs
+        self.consecutive_up = max(1, int(consecutive_up))
+        self.down_idle_per_replica = float(down_idle_per_replica)
+        self.consecutive_down = int(consecutive_down)
+        self.cooldown_secs = float(cooldown_secs)
+        flap_base = cooldown_secs if flap_base_secs is None \
+            else flap_base_secs
+        self.flap_forgive_secs = 10.0 * float(cooldown_secs) \
+            if flap_forgive_secs is None else float(flap_forgive_secs)
+        self._clock = clock
+        # the flap guard IS an ExclusionBook: each action "excludes"
+        # the opposite direction, with the book's exponential-backoff
+        # discipline escalating repeated reversals (jitter pinned to 0
+        # -- scale decisions must be deterministic in the clock)
+        from realhf_tpu.system.watchdog import ExclusionBook
+        self._book = ExclusionBook(base=flap_base, factor=2.0,
+                                   max_delay=flap_max_secs,
+                                   jitter=0.0, clock=clock)
+        self._streak_up = 0
+        self._streak_down = 0
+        self._last_action: Optional[tuple] = None  # (direction, t)
+        #: (direction, reason) of the current suppression episode --
+        #: the flight event fires once per episode, the counter every
+        #: suppressed observation
+        self._suppress_episode: Optional[tuple] = None
+        self.decisions = dict(up=0, down=0, suppressed=0)
+
+    # -- triggers ------------------------------------------------------
+    def _up_pressure(self, s: AutoscaleSignals) -> Optional[str]:
+        n = max(1, s.n_replicas)
+        if self.up_queue_per_replica > 0 \
+                and s.queue_depth > self.up_queue_per_replica * n:
+            return (f"queue_depth {s.queue_depth} > "
+                    f"{self.up_queue_per_replica}/replica x {n}")
+        if self.up_rejections > 0 and s.rejections >= self.up_rejections:
+            return f"rejections {s.rejections}"
+        if self.up_latency_secs is not None \
+                and s.latency_secs > self.up_latency_secs:
+            return (f"latency {s.latency_secs:.3f}s > "
+                    f"{self.up_latency_secs:.3f}s")
+        return None
+
+    def _down_idle(self, s: AutoscaleSignals) -> Optional[str]:
+        if self.consecutive_down <= 0:
+            return None  # scale-down disabled
+        if s.queue_depth > 0:
+            return None
+        capacity_after = self.down_idle_per_replica \
+            * max(0, s.n_replicas - 1)
+        if s.inflight <= capacity_after:
+            return (f"idle: {s.inflight} in flight fits "
+                    f"{s.n_replicas - 1} replica(s)")
+        return None
+
+    # -- decision ------------------------------------------------------
+    def observe(self, signals: AutoscaleSignals, **ctx) -> ScaleDecision:
+        """Feed one observation; returns the decision for it. The
+        controller acts on ``action in ("up", "down")``; everything
+        else is bookkeeping."""
+        up_why = self._up_pressure(signals)
+        down_why = None if up_why else self._down_idle(signals)
+        self._streak_up = self._streak_up + 1 if up_why else 0
+        self._streak_down = self._streak_down + 1 if down_why else 0
+        if up_why and self._streak_up >= self.consecutive_up:
+            return self._decide("up", signals, up_why, ctx)
+        if down_why and self.consecutive_down > 0 \
+                and self._streak_down >= self.consecutive_down:
+            return self._decide("down", signals, down_why, ctx)
+        self._suppress_episode = None
+        return ScaleDecision("hold", signals.n_replicas,
+                             reason="no_trigger")
+
+    def _decide(self, direction: str, s: AutoscaleSignals, why: str,
+                ctx: Dict) -> ScaleDecision:
+        now = self._clock()
+        if direction == "up":
+            if s.n_replicas >= self.max_replicas:
+                return self._suppress(direction, s, "ceiling", ctx)
+        else:
+            if s.n_replicas <= self.min_replicas:
+                return self._suppress(direction, s, "floor", ctx)
+            if s.n_replicas <= 1 and (s.inflight > 0
+                                      or s.queue_depth > 0):
+                # even with floor 0: never take the last healthy
+                # replica while traffic is in flight
+                return self._suppress(direction, s, "last_healthy", ctx)
+        la = self._last_action
+        if la is not None and now - la[1] >= self.flap_forgive_secs:
+            # a long stable stretch forgives the flap escalation
+            self._book.forgive("up")
+            self._book.forgive("down")
+        if la is not None and la[0] == direction \
+                and now - la[1] < self.cooldown_secs:
+            return self._suppress(direction, s, "cooldown", ctx)
+        if self._book.is_excluded(direction):
+            return self._suppress(direction, s, "flap", ctx)
+        target = s.n_replicas + (1 if direction == "up" else -1)
+        self._last_action = (direction, now)
+        self._book.exclude("down" if direction == "up" else "up")
+        self._streak_up = self._streak_down = 0
+        self._suppress_episode = None
+        self.decisions[direction] += 1
+        self._emit(direction, target, s, why, ctx)
+        return ScaleDecision(direction, target, reason=why)
+
+    # -- recording (subclass hooks: GrowAdvisor keeps legacy names) ----
+    def _emit(self, direction: str, target: int, s: AutoscaleSignals,
+              why: str, ctx: Dict):
+        from realhf_tpu.obs import flight, metrics
+        metrics.inc(f"serving_autoscale_{direction}_total", **ctx)
+        flight.record("autoscale_decision", action=direction,
+                      target=target, reason=why,
+                      queue_depth=s.queue_depth, inflight=s.inflight,
+                      rejections=s.rejections,
+                      n_replicas=s.n_replicas, **ctx)
+        logger.warning(
+            "Autoscale %s: %d -> %d replicas (%s).", direction.upper(),
+            s.n_replicas, target, why)
+
+    def _suppress(self, direction: str, s: AutoscaleSignals,
+                  reason: str, ctx: Dict) -> ScaleDecision:
+        self.decisions["suppressed"] += 1
+        self._suppress_emit(direction, s, reason, ctx)
+        return ScaleDecision("hold", s.n_replicas,
+                             reason=f"{direction} suppressed: {reason}",
+                             suppressed=reason)
+
+    def _suppress_emit(self, direction: str, s: AutoscaleSignals,
+                       reason: str, ctx: Dict):
+        from realhf_tpu.obs import flight, metrics
+        metrics.inc("serving_autoscale_suppressed_total",
+                    direction=direction, reason=reason, **ctx)
+        episode = (direction, reason)
+        if self._suppress_episode != episode:
+            # one flight event per suppression EPISODE; the counter
+            # above still counts every suppressed observation
+            self._suppress_episode = episode
+            flight.record("autoscale_suppressed", action=direction,
+                          reason=reason, queue_depth=s.queue_depth,
+                          n_replicas=s.n_replicas, **ctx)
+            logger.info("Autoscale %s suppressed (%s): queue=%d "
+                        "inflight=%d replicas=%d.", direction, reason,
+                        s.queue_depth, s.inflight, s.n_replicas)
+
+
+class GrowAdvisor(AutoscalePolicy):
+    """Log-only autoscaling advisory (the PR-9 slice, now a thin
+    :class:`AutoscalePolicy` in advisory clothing): sustained queue
+    depth above ``threshold`` emits ONE grow suggestion --
+    ``elastic_grow_suggested_total`` counter, an
+    ``elastic_grow_suggestion`` flight event, a warning log -- then
+    stays quiet for ``cooldown_secs``. No fleet change happens here;
+    the closed loop lives in ``system/autoscale.py``.
     ``threshold <= 0`` disables the advisor entirely."""
 
     def __init__(self, threshold: int, consecutive: int = 3,
                  cooldown_secs: float = 60.0,
                  clock=time.monotonic):
+        super().__init__(
+            min_replicas=1, max_replicas=1_000_000,
+            up_queue_per_replica=int(threshold),
+            up_rejections=0, up_latency_secs=None,
+            consecutive_up=consecutive, consecutive_down=0,
+            cooldown_secs=cooldown_secs, clock=clock)
         self.threshold = int(threshold)
         self.consecutive = max(1, int(consecutive))
-        self.cooldown_secs = cooldown_secs
-        self._clock = clock
-        self._streak = 0
-        self._last_suggested: Optional[float] = None
         self.suggestions = 0
+
+    @property
+    def _streak(self) -> int:
+        return self._streak_up
 
     def observe(self, queue_depth: int, **ctx) -> bool:
         """Feed one queue-depth observation; True when a grow
         suggestion was emitted for it."""
         if self.threshold <= 0:
             return False
-        if queue_depth <= self.threshold:
-            self._streak = 0
-            return False
-        self._streak += 1
-        if self._streak < self.consecutive:
-            return False
-        now = self._clock()
-        if self._last_suggested is not None \
-                and now - self._last_suggested < self.cooldown_secs:
-            return False
-        self._last_suggested = now
-        self._streak = 0
+        decision = super().observe(
+            AutoscaleSignals(queue_depth=int(queue_depth),
+                             n_replicas=1), **ctx)
+        return decision.action == "up"
+
+    def _emit(self, direction, target, s, why, ctx):
         self.suggestions += 1
         from realhf_tpu.obs import flight, metrics
         metrics.inc("elastic_grow_suggested_total", **ctx)
         flight.record("elastic_grow_suggestion",
-                      queue_depth=queue_depth,
+                      queue_depth=s.queue_depth,
                       threshold=self.threshold, **ctx)
         logger.warning(
             "ElasticPlanner GROW suggested: queue depth %d > %d for "
             "%d consecutive observations (%s). Advisory only -- no "
-            "mesh change.", queue_depth, self.threshold,
+            "mesh change.", s.queue_depth, self.threshold,
             self.consecutive, ctx or "no context")
-        return True
+
+    def _suppress_emit(self, direction, s, reason, ctx):
+        pass  # advisory stays silent while suppressed (PR-9 contract)

@@ -421,6 +421,23 @@ class PodController:
                    what=f"submit {name}")
         self._submitted.append(name)
 
+    def stop(self, name: str, grace: float = 10.0):
+        """Stop ONE worker (the autoscale scale-down reaper,
+        ``system/autoscale.py``): delegates to the scheduler's
+        single-job stop when it has one (``LocalSchedulerClient.stop``
+        SIGTERMs the process group and escalates to SIGKILL after
+        ``grace``). Best effort -- never raises."""
+        stop = getattr(self.sched, "stop", None)
+        if stop is None:
+            logger.warning("Scheduler %s has no single-job stop; "
+                           "cannot reap %s.", type(self.sched).__name__,
+                           name)
+            return
+        try:
+            stop(name, grace=grace)
+        except Exception as e:  # noqa: BLE001 - reaping is best effort
+            logger.warning("Stop of %s failed: %s", name, e)
+
     def wait_ready(self, experiment_name: str, trial_name: str,
                    workers: Optional[Sequence[str]] = None,
                    deadline: float = 120.0, poll_interval: float = 0.5,

@@ -121,6 +121,25 @@ class LocalSchedulerClient(SchedulerClient):
         state = JobState.COMPLETED if rc == 0 else JobState.FAILED
         return JobInfo(name, state, pid=p.pid, returncode=rc)
 
+    def stop(self, name: str, grace: float = 10.0):
+        """Stop ONE job (autoscale scale-down reaping): SIGTERM its
+        process group, escalate to SIGKILL after ``grace`` seconds.
+        The job stays findable (COMPLETED/FAILED) until forgotten."""
+        p = self._procs.get(name)
+        if p is None or p.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        try:
+            p.wait(timeout=max(0.1, grace))
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
     def stop_all(self, grace: float = 10.0):
         """SIGTERM every job, escalate to SIGKILL after ``grace``
         seconds. Serving deployments pass a longer grace so a

@@ -121,6 +121,33 @@ class Watchdog:
         # "<ts>:<boot-id>"; legacy plain-ts beats carry none)
         self._boot_ids: Dict[str, str] = {}
         self._lost_reason: Dict[str, str] = {}
+        #: per-worker watch-start for DYNAMIC membership (autoscale):
+        #: a replica added mid-run gets its startup grace from its add
+        #: time, not from watchdog construction
+        self._added_at: Dict[str, float] = {}
+
+    # -- dynamic membership (closed-loop autoscaling) ------------------
+    def add_workers(self, workers: Iterable[str]):
+        """Start watching more workers (scale-up). Each gets the
+        startup grace measured from NOW."""
+        now = self._clock()
+        for w in workers:
+            if w not in self.workers:
+                self.workers.append(w)
+                self._added_at[w] = now
+        self.workers.sort()
+
+    def remove_workers(self, workers: Iterable[str]):
+        """Stop watching workers (planned scale-down): their pending
+        exit must not read as a loss. Clears all bookkeeping so a
+        later re-add starts clean."""
+        drop = set(workers)
+        self.workers = [w for w in self.workers if w not in drop]
+        for w in drop:
+            for d in (self._ever_beat, self._lost_since,
+                      self._boot_ids, self._lost_reason,
+                      self._unattributed, self._added_at):
+                d.pop(w, None)
 
     # ------------------------------------------------------------------
     def _status_of(self, worker: str) -> Optional[WorkerServerStatus]:
@@ -176,7 +203,8 @@ class Watchdog:
                       WorkerServerStatus.ERROR,
                       WorkerServerStatus.PREEMPTED):
             return DONE
-        if worker not in self._ever_beat and now - self._start <= max(
+        start = self._added_at.get(worker, self._start)
+        if worker not in self._ever_beat and now - start <= max(
                 self.grace, self.timeout):
             return PENDING
         return LOST

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Serving hot-path load bench: prefix-cache reuse + spec decoding.
+"""Serving load bench: hot-path scenarios + bursty autoscale harness.
 
 Drives a REAL in-process serving stack -- tiny transformer backend
 (``InflightBatchingGenerator``), ``RolloutServer`` replica(s) on
@@ -19,13 +19,32 @@ BENCH payload as ``serving_bench``. On this box (CPU, tiny model) the
 are prefill_tokens_saved > 0 on shared traffic and the accept rate,
 which are backend-independent.
 
+**Bursty autoscale harness** (``--bursty``, docs/serving.md
+"Autoscaling"): replays an OPEN-LOOP synthetic arrival schedule --
+ramp, plateau, spike, trough, the diurnal shape in miniature --
+against an in-process fleet whose replica count is driven by the
+closed autoscaling loop (``AutoscalePolicy`` +
+``AutoscaleController``). Requests arrive on the schedule's clock
+regardless of completions, so overload really sheds (bounded
+rejections) until the fleet grows, and the trough really drains the
+fleet back down through graceful retires. The JSON payload carries
+``replica_timeline`` (replica-count-over-time), every scale event,
+the terminal census (every rid must reach exactly one terminal), and
+``rejection_rate``; ``--rejection-bound`` turns the bound into the
+exit code. Runs on the deterministic ``FakeSlotBackend`` with a
+configurable per-chunk decode delay -- the autoscale loop, drain
+protocol, and router behavior are backend-independent.
+
 Usage::
 
     python scripts/bench_serving.py [--clients 4] [--requests 3]
         [--fleet 1] [--spec-k 3] [--prefix-mb 16] [--new-tokens 8]
         [--prefix-len 48] [--tail-len 4] [--slots 4]
+    python scripts/bench_serving.py --bursty [--time-scale 1.0]
+        [--rejection-bound 0.35] [--max-replicas 4]
 """
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -259,6 +278,331 @@ def run(args) -> dict:
     return out
 
 
+# ----------------------------------------------------------------------
+# Bursty/diurnal autoscale harness (docs/serving.md "Autoscaling")
+# ----------------------------------------------------------------------
+class _SlowFakeBackend:
+    """FakeSlotBackend with a real per-chunk decode delay, so an
+    in-process replica has genuine, configurable capacity (tokens/s)
+    the open-loop schedule can overwhelm."""
+
+    def __init__(self, n_slots, chunk, decode_delay):
+        from realhf_tpu.base.testing import FakeSlotBackend
+        self._inner = FakeSlotBackend(n_slots=n_slots, chunk=chunk,
+                                      max_prompt_len=64)
+        self._delay = decode_delay
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def decode_chunk(self, key):
+        time.sleep(self._delay)
+        self._inner.decode_chunk(key)
+
+
+class AutoscaledStack:
+    """An in-process autoscaled serving fleet: replicas on threads
+    behind a ``FleetRouter``, with an ``AutoscaleController`` driven
+    from the monitor loop. Doubles as the controller's actuator:
+    ``spawn`` starts a new replica thread (fresh lease + fencing
+    epoch -- the router discovers it through the registry), ``retire``
+    flips the replica's drain event so its OWN serve thread runs the
+    graceful drain (bounce queued, finish in-flight, force-fence past
+    the hard deadline, release the lease) and exits."""
+
+    def __init__(self, *, slots, chunk, decode_delay, queue_depth,
+                 drain_timeout, drain_deadline, policy, registry_repo,
+                 initial=1):
+        from realhf_tpu.serving.fleet import FleetRegistry
+        from realhf_tpu.serving.router import FleetRouter
+        from realhf_tpu.system.autoscale import AutoscaleController
+
+        self._mk = dict(slots=slots, chunk=chunk,
+                        decode_delay=decode_delay,
+                        queue_depth=queue_depth)
+        self.drain_timeout = drain_timeout
+        self.drain_deadline = drain_deadline
+        self._repo = registry_repo
+        self.registry = FleetRegistry("bench", "bursty",
+                                      lease_ttl=30.0, repo=self._repo)
+        #: name -> dict(server, thread, stop, drain)
+        self._replicas = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        names = [f"gen_server/{i}" for i in range(initial)]
+        for name in names:
+            self.spawn(name)
+        self.router = FleetRouter(
+            self.registry, router_name="bursty-router",
+            dispatch_timeout=10.0, response_timeout=60.0,
+            pending_timeout=60.0, fleet_poll_interval=0.05,
+            affinity_prefix_len=0)
+        self._router_thread = threading.Thread(target=self._route_loop,
+                                               daemon=True)
+        self._router_thread.start()
+        self.controller = AutoscaleController(
+            policy, self, self.registry, initial=names,
+            spawn_deadline_secs=30.0,
+            retire_deadline_secs=drain_timeout + 10.0)
+
+    # -- actuator ------------------------------------------------------
+    def spawn(self, name):
+        from realhf_tpu.serving.fleet import FleetRegistry
+        from realhf_tpu.serving.request_queue import RequestQueue
+        from realhf_tpu.serving.server import RolloutServer
+
+        backend = _SlowFakeBackend(self._mk["slots"], self._mk["chunk"],
+                                   self._mk["decode_delay"])
+        srv = RolloutServer(
+            backend, server_name=name,
+            queue=RequestQueue(max_depth=self._mk["queue_depth"],
+                               n_slots=self._mk["slots"]),
+            fleet=FleetRegistry("bench", "bursty", lease_ttl=30.0,
+                                repo=self._repo),
+            drain_deadline_secs=self.drain_deadline,
+            seed=len(self._replicas))
+        stop, drain = threading.Event(), threading.Event()
+        th = threading.Thread(target=self._serve_loop,
+                              args=(srv, stop, drain), daemon=True)
+        with self._lock:
+            self._replicas[name] = dict(server=srv, thread=th,
+                                        stop=stop, drain=drain)
+        th.start()
+
+    def retire(self, name):
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is not None:
+            rep["drain"].set()
+
+    def gone(self, name):
+        with self._lock:
+            rep = self._replicas.get(name)
+        return rep is None or not rep["thread"].is_alive()
+
+    def reap(self, name):
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is not None:
+            rep["stop"].set()
+
+    # -- threads -------------------------------------------------------
+    def _serve_loop(self, srv, stop, drain):
+        while not (stop.is_set() or self._stop.is_set()):
+            if drain.is_set():
+                # the graceful retire runs ON the serve thread (the
+                # scheduler is single-threaded state), then the
+                # thread exits -- that IS the process reap here
+                srv.drain(timeout=self.drain_timeout)
+                break
+            srv.serve_step(poll_timeout=0.005)
+        srv.close()
+
+    def _route_loop(self):
+        while not self._stop.is_set():
+            self.router.route_step(poll_timeout=0.005)
+
+    # -- live signals (in-process: read the real queues) ---------------
+    def signals(self, rejections: int):
+        from realhf_tpu.system.elastic import AutoscaleSignals
+        with self._lock:
+            live = [r["server"] for n, r in self._replicas.items()
+                    if r["thread"].is_alive() and not r["drain"].is_set()]
+        queued = sum(len(s.queue) for s in live) \
+            + len(self.router._pending)
+        inflight = sum(s.scheduler.n_live for s in live)
+        return AutoscaleSignals(
+            queue_depth=queued, inflight=inflight,
+            rejections=rejections,
+            latency_secs=self.router.latency_ewma_secs or 0.0)
+
+    def n_alive(self):
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r["thread"].is_alive()
+                       and not r["drain"].is_set())
+
+    def close(self):
+        self._stop.set()
+        with self._lock:
+            threads = [r["thread"] for r in self._replicas.values()]
+        for t in threads:
+            t.join(timeout=10.0)
+        self._router_thread.join(timeout=10.0)
+        self.router.close()
+
+
+def bursty_schedule(time_scale=1.0, rate_scale=1.0):
+    """The diurnal shape in miniature: (name, duration_s, rps_start,
+    rps_end) phases, linearly interpolated."""
+    s, r = time_scale, rate_scale
+    return [
+        ("ramp", 2.0 * s, 2.0 * r, 30.0 * r),
+        ("plateau", 2.0 * s, 30.0 * r, 30.0 * r),
+        ("spike", 2.0 * s, 90.0 * r, 90.0 * r),
+        ("trough", 4.0 * s, 2.0 * r, 1.0 * r),
+    ]
+
+
+def _arrival_times(phases):
+    """Open-loop arrivals for the phase schedule: deterministic
+    integration of the (piecewise-linear) rate."""
+    out, t0, acc = [], 0.0, 0.0
+    dt = 0.005
+    for _, dur, r0, r1 in phases:
+        steps = max(1, int(dur / dt))
+        for i in range(steps):
+            rate = r0 + (r1 - r0) * (i / steps)
+            acc += rate * (dur / steps)
+            while acc >= 1.0:
+                acc -= 1.0
+                out.append(t0 + (i + 0.5) * (dur / steps))
+        t0 += dur
+    return out
+
+
+def run_bursty(args) -> dict:
+    from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+    from realhf_tpu.obs import metrics
+    from realhf_tpu.serving.server import RolloutClient
+    from realhf_tpu.system.elastic import AutoscalePolicy
+
+    metrics.reset_default()
+    phases = bursty_schedule(args.time_scale, args.rate_scale)
+    arrivals = _arrival_times(phases)
+    policy = AutoscalePolicy(
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        up_queue_per_replica=args.up_queue,
+        consecutive_up=2,
+        down_idle_per_replica=2.0,
+        consecutive_down=8,
+        cooldown_secs=1.5 * args.time_scale,
+        flap_base_secs=3.0 * args.time_scale)
+    stack = AutoscaledStack(
+        slots=args.slots, chunk=args.chunk,
+        decode_delay=args.decode_delay,
+        queue_depth=args.queue_depth,
+        drain_timeout=8.0, drain_deadline=6.0,
+        policy=policy, registry_repo=MemoryNameRecordRepository(),
+        initial=args.min_replicas)
+
+    results = {}          # rid -> list of terminal statuses
+    res_lock = threading.Lock()
+    n_clients = args.clients
+    per_client = [arrivals[i::n_clients] for i in range(n_clients)]
+    t_start = time.monotonic() + 0.5  # let the router see the fleet
+
+    def client_main(ci):
+        cl = RolloutClient(stack.router.address)
+        mine = []
+        try:
+            for at in per_client[ci]:
+                delay = t_start + at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                rid = cl.submit([40, 3, 5], ttl=args.ttl)
+                with res_lock:
+                    results[rid] = []
+                mine.append(rid)
+                for r in cl.poll_results():
+                    with res_lock:
+                        results[r.rid].append(r.status)
+            # drain: wait for every outstanding terminal
+            deadline = time.monotonic() + args.ttl + 20.0
+            while time.monotonic() < deadline:
+                with res_lock:
+                    if all(results[r] for r in mine):
+                        break
+                for r in cl.poll_results(timeout=0.05):
+                    with res_lock:
+                        results[r.rid].append(r.status)
+        finally:
+            cl.close()
+
+    cthreads = [threading.Thread(target=client_main, args=(i,))
+                for i in range(n_clients)]
+    for t in cthreads:
+        t.start()
+
+    # monitor loop: drive the autoscale controller on live signals,
+    # sample the replica count over time
+    total = sum(p[1] for p in phases)
+    timeline = []
+    last_rej = 0
+    tail_deadline = t_start + total + args.tail
+    while time.monotonic() < tail_deadline:
+        rej = int(stack.router.stats_counters["rejections"])
+        stack.controller.step(stack.signals(rej - last_rej),
+                              source="bursty_bench")
+        last_rej = rej
+        timeline.append(dict(
+            t=round(time.monotonic() - t_start, 3),
+            replicas=stack.controller.n_replicas,
+            alive=stack.n_alive(),
+            queue=stack.signals(0).queue_depth))
+        if (time.monotonic() - t_start > total
+                and not stack.controller.busy()
+                and stack.controller.n_replicas <= args.min_replicas):
+            with res_lock:
+                if all(v for v in results.values()) \
+                        and len(results) == len(arrivals):
+                    break  # everything terminal and fleet back down
+        time.sleep(args.interval)
+    for t in cthreads:
+        t.join(timeout=60.0)
+    router_stats = stack.router.stats()
+    events = [dataclasses.asdict(e) for e in stack.controller.events]
+    stack.close()
+
+    census = {}
+    orphans, duplicates = [], []
+    with res_lock:
+        for rid, terms in results.items():
+            if not terms:
+                orphans.append(rid)
+            elif len(terms) > 1:
+                duplicates.append(rid)
+            else:
+                census[terms[0]] = census.get(terms[0], 0) + 1
+    n = len(results)
+    rejected = census.get("rejected", 0) + census.get("draining", 0)
+    snap = metrics.snapshot()
+
+    def _metric_total(name):
+        vals = (snap.get(name) or {}).get("values") or {}
+        return float(sum(vals.values()))
+
+    peak = max((p["replicas"] for p in timeline), default=0)
+    return dict(
+        phases=[dict(zip(("name", "secs", "rps_start", "rps_end"), p))
+                for p in phases],
+        n_requests=n, submitted=len(arrivals),
+        outcomes=census, orphans=orphans, duplicates=duplicates,
+        rejection_rate=round(rejected / max(1, n), 4),
+        replica_timeline=timeline,
+        peak_replicas=peak,
+        final_replicas=timeline[-1]["replicas"] if timeline else 0,
+        scale_events=events,
+        autoscale_metrics=dict(
+            up=_metric_total("serving_autoscale_up_total"),
+            down=_metric_total("serving_autoscale_down_total"),
+            suppressed=_metric_total(
+                "serving_autoscale_suppressed_total"),
+            drain_abandoned=_metric_total(
+                "serving_drain_abandoned_total")),
+        router=dict(failovers=router_stats["failovers"],
+                    retired=router_stats["retired"],
+                    retire_redispatches=router_stats[
+                        "retire_redispatches"],
+                    rejections=router_stats["rejections"]),
+        ok=not orphans and not duplicates,
+        note=("open-loop bursty harness on the fake backend: the "
+              "load-bearing signals are the 1->N->peak->1 replica "
+              "timeline, every rid reaching exactly one terminal, "
+              "and the bounded rejection rate"))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=4)
@@ -273,7 +617,48 @@ def main(argv=None):
     ap.add_argument("--prefix-mb", type=int, default=16)
     ap.add_argument("--prefix-len", type=int, default=48)
     ap.add_argument("--tail-len", type=int, default=4)
+    # -- bursty autoscale harness --------------------------------------
+    ap.add_argument("--bursty", action="store_true",
+                    help="run the open-loop autoscale harness instead "
+                         "of the hot-path scenarios")
+    ap.add_argument("--time-scale", type=float, default=1.0)
+    ap.add_argument("--rate-scale", type=float, default=1.0)
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--up-queue", type=int, default=6,
+                    help="queued requests per replica that count as "
+                         "scale-up pressure")
+    ap.add_argument("--queue-depth", type=int, default=64,
+                    help="per-replica admission queue bound")
+    ap.add_argument("--decode-delay", type=float, default=0.005,
+                    help="seconds per fake decode chunk (sets replica "
+                         "capacity)")
+    ap.add_argument("--ttl", type=float, default=10.0)
+    ap.add_argument("--interval", type=float, default=0.25,
+                    help="autoscale observation interval")
+    ap.add_argument("--tail", type=float, default=25.0,
+                    help="max seconds after the schedule for the "
+                         "fleet to drain back down")
+    ap.add_argument("--rejection-bound", type=float, default=None,
+                    help="exit 1 when the rejection rate exceeds this")
     args = ap.parse_args(argv)
+    if args.bursty:
+        args.slots = min(args.slots, 2) if args.slots == 4 else args.slots
+        args.chunk = 4 if args.chunk == 8 else args.chunk
+        out = dict(bursty=run_bursty(args))
+        print(json.dumps(out))
+        b = out["bursty"]
+        if not b["ok"]:
+            print(f"BURSTY FAILED: orphans={b['orphans']} "
+                  f"duplicates={b['duplicates']}", file=sys.stderr)
+            return 1
+        if args.rejection_bound is not None \
+                and b["rejection_rate"] > args.rejection_bound:
+            print(f"BURSTY FAILED: rejection_rate "
+                  f"{b['rejection_rate']} > {args.rejection_bound}",
+                  file=sys.stderr)
+            return 1
+        return 0
     out = run(args)
     print(json.dumps(out))
     return 0

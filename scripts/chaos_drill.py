@@ -74,12 +74,17 @@ class DrillClock:
 
 @dataclasses.dataclass
 class DrillEvent:
-    """One scheduled fault: at ``tick``, apply ``action`` to replica
-    ``target``. Actions: ``die`` (hard process death: socket gone, no
-    deregistration, lease decays), ``revive`` (a replacement registers
-    under the same name -> new fencing epoch), ``partition`` (open a
-    ``seconds``-long window dropping ALL the replica's traffic and
-    its lease renewals)."""
+    """One scheduled fault or scale event: at ``tick``, apply
+    ``action`` to replica ``target``. Actions: ``die`` (hard process
+    death: socket gone, no deregistration, lease decays), ``revive``
+    (a replacement registers under the same name -> new fencing
+    epoch), ``partition`` (open a ``seconds``-long window dropping
+    ALL the replica's traffic and its lease renewals), ``spawn``
+    (autoscale scale-up: a NEW replica name registers a fresh lease +
+    epoch mid-drill), ``retire`` (autoscale scale-down: graceful
+    drain -- queued bounced as draining, in-flight finish, leftovers
+    force-fenced with explicit terminals past ``seconds`` worth of
+    ticks, lease released as a planned departure)."""
     tick: int
     action: str
     target: str
@@ -120,6 +125,9 @@ class DrillReport:
     hedges: int = 0
     hedge_wins: int = 0
     fenced_reconnects: int = 0
+    retired: List[str] = dataclasses.field(default_factory=list)
+    retire_redispatches: int = 0
+    drain_abandoned: float = 0.0
     server_fence_drops: float = 0.0
     breaker_transitions: Dict[str, List[str]] = dataclasses.field(
         default_factory=dict)
@@ -140,6 +148,9 @@ class DrillReport:
             failovers=self.failovers, hedges=self.hedges,
             hedge_wins=self.hedge_wins,
             fenced_reconnects=self.fenced_reconnects,
+            retired=self.retired,
+            retire_redispatches=self.retire_redispatches,
+            drain_abandoned=self.drain_abandoned,
             server_fence_drops=self.server_fence_drops,
             breaker_transitions=self.breaker_transitions)
 
@@ -203,6 +214,11 @@ class DrillFleet:
                                       repo=self.repo)
         self.servers: Dict[str, RolloutServer] = {}
         self.alive: List[str] = []
+        #: retiring replica -> drain-deadline tick (scale-down churn)
+        self.retiring: Dict[str, int] = {}
+        self.retired: List[str] = []
+        self._tick = 0
+        self.drain_deadline_ticks = 80
         for i in range(n_replicas):
             self._spawn(f"gen_server/{i}", seed=i)
         # affinity off: the drill's lost/fenced/failover invariants
@@ -243,11 +259,30 @@ class DrillFleet:
         srv._fleet = None  # a crash never says goodbye
         srv.close()
         self.alive.remove(name)
+        self.retiring.pop(name, None)
 
     def revive(self, name: str):
         """A replacement process re-registers the same replica name,
         obtaining a new fencing epoch."""
         self._spawn(name, seed=len(self.servers) + hash(name) % 97)
+
+    def spawn_new(self, name: str):
+        """Autoscale scale-up mid-drill: a brand-new replica joins
+        with a fresh lease + fencing epoch; the router discovers it on
+        its next registry poll."""
+        if name in self.servers and name in self.alive:
+            raise ValueError(f"spawn target {name!r} already alive")
+        self._spawn(name, seed=len(self.servers) + 11)
+
+    def retire(self, name: str, drain_ticks: int = 0):
+        """Autoscale scale-down: begin the graceful drain NOW (mark
+        retiring, bounce queued); :meth:`step` keeps serving it until
+        in-flight work finishes (or the drain-deadline tick forces the
+        fence), then releases the lease and closes it."""
+        srv = self.servers[name]
+        srv.begin_drain()
+        self.retiring[name] = self._tick + (
+            drain_ticks or self.drain_deadline_ticks)
 
     def apply(self, ev: DrillEvent):
         if ev.action == "die":
@@ -256,9 +291,15 @@ class DrillFleet:
             self.revive(ev.target)
         elif ev.action == "partition":
             self.chaos.open_partition(ev.target, ev.seconds)
+        elif ev.action == "spawn":
+            self.spawn_new(ev.target)
+        elif ev.action == "retire":
+            self.retire(ev.target, drain_ticks=int(ev.seconds / self.dt)
+                        if ev.seconds else 0)
         else:
             raise ValueError(f"Unknown drill action {ev.action!r} "
-                             "(know: die, revive, partition)")
+                             "(know: die, revive, partition, spawn, "
+                             "retire)")
 
     # -- lockstep drill loop -------------------------------------------
     def client(self) -> RolloutClient:
@@ -277,10 +318,26 @@ class DrillFleet:
                     self.events.setdefault(rid, []).append(q.pop(0))
 
     def step(self):
+        self._tick += 1
         self.clock.advance(self.dt)
         self.router.route_step(poll_timeout=0.002)
         for name in list(self.alive):
             self.servers[name].serve_step(poll_timeout=0.002)
+        # advance scale-down drains: a retiring replica finishes when
+        # its in-flight work does, or at its drain-deadline tick when
+        # leftovers are force-fenced with explicit terminals
+        for name, deadline in list(self.retiring.items()):
+            if name not in self.alive:
+                del self.retiring[name]
+                continue
+            srv = self.servers[name]
+            if srv.scheduler.n_live == 0 or self._tick >= deadline:
+                srv.finish_drain(force=True)
+                srv.serve_step(poll_timeout=0.0)  # flush late sends
+                srv.close()
+                self.alive.remove(name)
+                del self.retiring[name]
+                self.retired.append(name)
         self._pump_clients()
 
     def close(self):
@@ -343,11 +400,16 @@ def run_drill(fleet: DrillFleet, requests: List[DrillRequest],
     report.hedges = sc["hedges"]
     report.hedge_wins = sc["hedge_wins"]
     report.fenced_reconnects = sc["fenced_reconnects"]
+    report.retired = list(fleet.retired)
+    report.retire_redispatches = sc["retire_redispatches"]
     report.router_stats = fleet.router.stats()
     snap = metrics.snapshot()
     drops = snap.get("serving_fenced_dropped_total", {})
     report.server_fence_drops = float(sum(
         (drops.get("values") or {}).values()))
+    aband = snap.get("serving_drain_abandoned_total", {})
+    report.drain_abandoned = float(sum(
+        (aband.get("values") or {}).values()))
     trans = snap.get("router_breaker_transitions_total", {})
     for key, n in (trans.get("values") or {}).items():
         labels = json.loads(key)  # snapshot label keys are JSON
@@ -388,23 +450,87 @@ def standard_scenario(scale: float = 1.0):
     return fleet, requests, schedule
 
 
+def churn_scenario(scale: float = 1.0):
+    """Membership-churn drill (docs/serving.md "Autoscaling"): the
+    fleet RESIZES while dying. Scale-ups and graceful scale-downs
+    interleave with hard kills and a partition, under a steady
+    request stream -- the exact traffic shape a closed autoscaling
+    loop produces in production. The invariants are unchanged:
+    exactly-once terminal delivery, no fenced delivery, no orphaned
+    rids -- and retired replicas must leave ZERO breaker transitions
+    behind (a clean scale-down is not a failure)."""
+    n_req = max(8, int(30 * scale))
+    need = max(8, int(20 * scale))
+    last = 4 + 10 * (n_req - 1)
+    requests = [DrillRequest(tick=4 + 10 * i, need=need)
+                for i in range(n_req)]
+    t = max(1, int(scale * 10))  # churn cadence scales with load
+
+    def _tick(i):
+        return min(i * t + 10, last)
+
+    schedule = [
+        # grow under load: a brand-new replica joins mid-stream
+        DrillEvent(tick=_tick(2), action="spawn",
+                   target="gen_server/3"),
+        # clean scale-down of an ORIGINAL replica while requests are
+        # in flight on it (drain must harvest, not orphan)
+        DrillEvent(tick=_tick(5), action="retire",
+                   target="gen_server/0"),
+        # a hard kill interleaved with the churn
+        DrillEvent(tick=_tick(8), action="die",
+                   target="gen_server/2"),
+        # grow again while a corpse is still being failed over
+        DrillEvent(tick=_tick(9), action="spawn",
+                   target="gen_server/4"),
+        # partition the newest member past its lease TTL
+        DrillEvent(tick=_tick(12), action="partition",
+                   target="gen_server/3", seconds=4.0),
+        # retire the spike capacity while the partition is open
+        DrillEvent(tick=_tick(16), action="retire",
+                   target="gen_server/4"),
+        # the killed replica's replacement rejoins at a fresh epoch
+        DrillEvent(tick=_tick(22), action="revive",
+                   target="gen_server/2"),
+    ]
+    fleet = DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05,
+                       router_kwargs=dict(response_timeout=4.0))
+    return fleet, requests, schedule
+
+
+SCENARIOS = dict(standard=standard_scenario, churn=churn_scenario)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("chaos_drill")
     ap.add_argument("--scenario", default="standard",
-                    choices=["standard"])
+                    choices=sorted(SCENARIOS))
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--max-ticks", type=int, default=5000)
     ap.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
     args = ap.parse_args(argv)
     metrics.reset_default()
-    fleet, requests, schedule = standard_scenario(scale=args.scale)
+    fleet, requests, schedule = SCENARIOS[args.scenario](
+        scale=args.scale)
     try:
         report = run_drill(fleet, requests, schedule,
                            max_ticks=args.max_ticks)
     finally:
         fleet.close()
     out = report.summary()
+    if args.scenario == "churn":
+        # churn-specific invariant: a clean scale-down must not look
+        # like a failure -- retired replicas leave no breaker trail
+        dirty = sorted(set(report.retired)
+                       & set(report.breaker_transitions))
+        if dirty:
+            report.fenced_deliveries = report.fenced_deliveries or []
+            print(f"CHURN FAILED: retired replicas tripped breakers: "
+                  f"{dirty}", file=sys.stderr)
+            out["retired_breaker_violations"] = dirty
+            print(json.dumps(out, indent=2, default=str))
+            return 1
     if args.json:
         out = dict(out, terminals=report.terminals,
                    fenced_deliveries=report.fenced_deliveries,

@@ -35,6 +35,7 @@ REQUIRED_DIRS = (
     "tests/agentic",
     "tests/analysis",
     "tests/async_rlhf",
+    "tests/autoscale",
     "tests/base",
     "tests/chaos",
     "tests/engine",

@@ -1,0 +1,80 @@
+"""Slow acceptance e2e (ISSUE 12): the bursty open-loop traffic
+harness against a REAL in-process fleet (threaded RolloutServer
+replicas behind a FleetRouter) with the closed autoscaling loop
+driving replica count. The fleet must scale 1 -> N tracking the load
+and drain back to 1, the rejection rate must stay under the bound,
+scale-down must orphan nothing (every submitted rid reaches exactly
+one terminal), and every scale decision must appear as both a flight
+event and a metric."""
+
+import importlib.util
+import os
+import types
+
+import pytest
+
+from realhf_tpu.obs import flight, metrics
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_bursty_fleet_tracks_load_and_drains_back():
+    bs = _load_bench()
+    flight.reset_default()
+    args = types.SimpleNamespace(
+        time_scale=0.5, rate_scale=1.0, min_replicas=1,
+        max_replicas=4, up_queue=6, queue_depth=64,
+        decode_delay=0.005, ttl=10.0, interval=0.25, tail=30.0,
+        clients=4, slots=2, chunk=4)
+    out = bs.run_bursty(args)
+
+    # -- no request orphaned or duplicated by any scale event --------
+    assert out["ok"], (out["orphans"], out["duplicates"])
+    assert out["orphans"] == [] and out["duplicates"] == []
+    assert sum(out["outcomes"].values()) == out["n_requests"] \
+        == out["submitted"]
+
+    # -- the fleet tracked the load: 1 -> N -> 1 ----------------------
+    assert out["peak_replicas"] >= 2, out["replica_timeline"]
+    assert out["final_replicas"] == 1
+    ups = [e for e in out["scale_events"] if e["action"] == "spawn"]
+    downs = [e for e in out["scale_events"]
+             if e["action"] == "retired"]
+    assert len(ups) >= 1 and len(downs) >= 1
+
+    # -- bounded rejection rate under the spike -----------------------
+    assert out["rejection_rate"] <= 0.35, out
+
+    # -- clean scale-downs: no failover storm, planned departures -----
+    assert out["router"]["failovers"] == 0
+    assert out["router"]["retired"] == len(downs)
+
+    # -- every decision is a metric AND a flight event ----------------
+    m = out["autoscale_metrics"]
+    assert m["up"] == len(ups) and m["down"] >= len(downs)
+    evs = flight.default_recorder().events()
+    decided = [e for e in evs if e["kind"] == "autoscale_decision"]
+    assert len(decided) == int(m["up"] + m["down"])
+    assert {e["action"] for e in decided} == {"up", "down"}
+    spawn_evs = [e for e in evs if e["kind"] == "autoscale_spawn"]
+    retire_evs = [e for e in evs
+                  if e["kind"] == "autoscale_replica_retired"]
+    assert len(spawn_evs) == len(ups)
+    assert len(retire_evs) == len(downs)
+
+
+@pytest.mark.slow
+def test_bursty_cli_exit_code_enforces_rejection_bound():
+    bs = _load_bench()
+    rc = bs.main(["--bursty", "--time-scale", "0.25",
+                  "--rate-scale", "0.6", "--tail", "25",
+                  "--rejection-bound", "0.5"])
+    assert rc == 0

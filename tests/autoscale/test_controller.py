@@ -1,0 +1,253 @@
+"""AutoscaleController: decisions -> actuator actions, booting/
+retiring state machines, registry retiring marks -- fake actuator,
+fake clock, memory-repo registry."""
+
+import pytest
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.obs import flight, metrics
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.system.autoscale import AutoscaleController, \
+    ReplicaActuator
+from realhf_tpu.system.elastic import AutoscalePolicy, AutoscaleSignals
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeActuator(ReplicaActuator):
+    """Registers spawned replicas in the registry (like a booted
+    process would) unless told to be a dud; retire() asserts the
+    retiring mark was set FIRST (the router race the mark closes)."""
+
+    def __init__(self, registry, register_on_spawn=True):
+        self.registry = registry
+        self.register_on_spawn = register_on_spawn
+        self.spawned, self.retired, self.reaped = [], [], []
+        self.dead = set()
+
+    def spawn(self, name):
+        self.spawned.append(name)
+        if self.register_on_spawn:
+            self.registry.register(name, f"tcp://x:{len(self.spawned)}")
+
+    def retire(self, name):
+        assert self.registry.is_retiring(name), \
+            "victim must be marked retiring BEFORE the drain command"
+        self.retired.append(name)
+        self.registry.deregister(name)
+        self.dead.add(name)
+
+    def gone(self, name):
+        return name in self.dead
+
+    def reap(self, name):
+        self.reaped.append(name)
+        self.dead.add(name)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+
+
+def build(clock, *, register_on_spawn=True, initial=1, **pkw):
+    repo = MemoryNameRecordRepository(clock=clock)
+    registry = FleetRegistry("e", "t", lease_ttl=1e9, repo=repo)
+    base = dict(min_replicas=1, max_replicas=4,
+                up_queue_per_replica=2, consecutive_up=2,
+                down_idle_per_replica=4.0, consecutive_down=2,
+                cooldown_secs=5.0, clock=clock)
+    base.update(pkw)
+    names = [f"gen_server/{i}" for i in range(initial)]
+    for n in names:
+        registry.register(n, f"tcp://seed:{n}")
+    act = FakeActuator(registry, register_on_spawn=register_on_spawn)
+    ctl = AutoscaleController(
+        AutoscalePolicy(**base), act, registry, initial=names,
+        spawn_deadline_secs=30.0, retire_deadline_secs=20.0,
+        clock=clock)
+    return ctl, act, registry
+
+
+HOT = AutoscaleSignals(queue_depth=100)
+IDLE = AutoscaleSignals(queue_depth=0, inflight=0)
+
+
+def _run(ctl, signals, n, clock, dt=1.0):
+    out = []
+    for _ in range(n):
+        clock.advance(dt)
+        out.append(ctl.step(signals))
+    return out
+
+
+def test_up_spawns_next_index_and_registry_confirms_boot():
+    clock = Clock()
+    ctl, act, _ = build(clock)
+    _run(ctl, HOT, 2, clock)
+    assert act.spawned == ["gen_server/1"]
+    assert ctl.n_replicas == 2            # booting counts as capacity
+    clock.advance(1.0)
+    ctl.step(IDLE)                        # registry shows it live
+    assert not ctl.busy()
+    assert [e.action for e in ctl.events] == ["spawn", "up_live"]
+
+
+def test_down_marks_retiring_then_retires_lifo_victim():
+    clock = Clock()
+    ctl, act, registry = build(clock, initial=3, min_replicas=1)
+    _run(ctl, IDLE, 2, clock)
+    assert act.retired == ["gen_server/2"]      # newest goes first
+    assert ctl.n_replicas == 2                  # retiring not counted
+    clock.advance(1.0)
+    ctl.step(AutoscaleSignals(queue_depth=1))   # poll: gone -> retired
+    assert "gen_server/2" not in ctl.replicas()
+    acts = [e.action for e in ctl.events]
+    assert acts == ["retire", "retired"]
+    # the retiring mark persists past deregistration (the router must
+    # classify the vanished lease as planned)
+    assert registry.is_retiring("gen_server/2")
+
+
+def test_spawn_deadline_writes_off_and_reaps():
+    clock = Clock()
+    ctl, act, _ = build(clock, register_on_spawn=False)
+    _run(ctl, HOT, 2, clock)
+    assert act.spawned == ["gen_server/1"] and ctl.n_replicas == 2
+    clock.advance(31.0)
+    ctl.step(AutoscaleSignals(queue_depth=1))
+    assert ctl.n_replicas == 1 and act.reaped == ["gen_server/1"]
+    snap = metrics.snapshot()
+    assert sum((snap["serving_autoscale_spawn_failed_total"]
+                ["values"]).values()) == 1
+    # the policy can try again once its cooldown re-arms
+    clock.advance(10.0)
+    _run(ctl, HOT, 2, clock)
+    assert act.spawned == ["gen_server/1", "gen_server/2"]
+
+
+def test_retire_deadline_forces_reap_once():
+    clock = Clock()
+
+    class StuckActuator(FakeActuator):
+        def retire(self, name):
+            assert self.registry.is_retiring(name)
+            self.retired.append(name)   # ... but never exits
+
+        def reap(self, name):
+            super().reap(name)          # reap DOES kill it
+
+    repo = MemoryNameRecordRepository(clock=clock)
+    registry = FleetRegistry("e", "t", lease_ttl=1e9, repo=repo)
+    for i in range(2):
+        registry.register(f"gen_server/{i}", f"a{i}")
+    act = StuckActuator(registry)
+    ctl = AutoscaleController(
+        AutoscalePolicy(min_replicas=1, max_replicas=4,
+                        consecutive_down=1, down_idle_per_replica=9,
+                        cooldown_secs=1.0, clock=clock),
+        act, registry, initial=["gen_server/0", "gen_server/1"],
+        retire_deadline_secs=20.0, clock=clock)
+    clock.advance(1.0)
+    ctl.step(IDLE)
+    assert act.retired == ["gen_server/1"] and act.reaped == []
+    clock.advance(21.0)
+    ctl.step(AutoscaleSignals(queue_depth=1))
+    assert act.reaped == ["gen_server/1"]
+    clock.advance(1.0)
+    ctl.step(AutoscaleSignals(queue_depth=1))   # now gone -> retired
+    assert "gen_server/1" not in ctl.replicas()
+    assert act.reaped == ["gen_server/1"]       # reaped exactly once
+
+
+def test_forget_drops_dead_replica_from_capacity():
+    clock = Clock()
+    ctl, act, _ = build(clock, initial=3)
+    assert ctl.n_replicas == 3
+    ctl.forget("gen_server/1")
+    assert ctl.n_replicas == 2
+    assert [e.action for e in ctl.events] == ["died"]
+
+
+def test_no_victim_when_everything_is_in_transition():
+    clock = Clock()
+    ctl, act, registry = build(clock, initial=2, min_replicas=0,
+                               consecutive_down=1, cooldown_secs=0.5,
+                               flap_base_secs=0.5)
+    clock.advance(1.0)
+    ctl.step(IDLE)
+    assert act.retired == ["gen_server/1"]
+    # the one remaining replica drains next (floor 0, no traffic)...
+    clock.advance(1.0)
+    ctl.step(IDLE)
+    # ...after which a down decision finds nothing to drain and holds
+    clock.advance(1.0)
+    d = ctl.step(IDLE)
+    assert len(act.retired) == 2
+    assert d.action in ("hold", "down")
+    assert ctl._choose_victim() is None
+
+
+def test_run_serve_rejects_autoscale_without_fleet_router():
+    import types
+
+    from realhf_tpu.api.experiment import ServingSpec
+    from realhf_tpu.apps.main import run_serve
+
+    spec = types.SimpleNamespace(
+        serving=ServingSpec(autoscale=True, fleet_router=False),
+        experiment_name="e", trial_name="t")
+    with pytest.raises(ValueError, match="fleet_router"):
+        run_serve(spec)
+
+
+def test_serving_spec_autoscale_knobs_have_sane_defaults():
+    from realhf_tpu.api.experiment import ServingSpec
+
+    sv = ServingSpec()
+    assert sv.autoscale is False
+    assert sv.autoscale_min_replicas >= 1
+    assert sv.autoscale_max_replicas >= sv.autoscale_min_replicas
+    assert sv.drain_deadline_secs is None
+
+
+def test_pod_controller_single_job_stop_reaps_process():
+    import sys
+
+    from realhf_tpu.system.pod import PodController
+    from realhf_tpu.system.scheduler import JobState, \
+        LocalSchedulerClient
+
+    sched = LocalSchedulerClient()
+    ctl = PodController(sched)
+    try:
+        ctl.submit("gen_server/9",
+                   [sys.executable, "-c", "import time; time.sleep(60)"])
+        assert sched.find("gen_server/9").state == JobState.RUNNING
+        ctl.stop("gen_server/9", grace=0.3)
+        assert sched.find("gen_server/9").state != JobState.RUNNING
+    finally:
+        sched.stop_all(grace=0.2)
+
+
+def test_scale_events_carry_flight_records():
+    clock = Clock()
+    ctl, act, _ = build(clock)
+    _run(ctl, HOT, 2, clock)
+    clock.advance(1.0)
+    ctl.step(IDLE)
+    kinds = [e["kind"] for e in flight.default_recorder().events()]
+    assert "autoscale_decision" in kinds      # the policy's record
+    assert "autoscale_spawn" in kinds         # the controller's act
+    assert "autoscale_replica_up" in kinds    # boot confirmed

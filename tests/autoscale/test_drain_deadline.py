@@ -1,0 +1,169 @@
+"""Scale-down drain hard deadline: in-flight work past
+``drain_deadline_secs`` is force-fenced with EXPLICIT
+``cancelled(reason=drain_deadline)`` terminals -- never silent loss
+-- plus a flight event naming the abandoned rids and a metric."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.obs import flight, metrics
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
+from realhf_tpu.serving.server import RolloutServer
+
+
+class TickingClock:
+    """Advances a little on every read, so wall-clock drain loops
+    terminate deterministically without sleeping."""
+
+    def __init__(self, dt=0.05):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+class StuckBackend(FakeSlotBackend):
+    """Decodes forever: sequences never finish, so any drain must hit
+    its deadline."""
+
+    def decode_chunk(self, key):
+        pass
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+
+
+def _capture_sends(server):
+    sent = []
+    server._sock = type("S", (), {
+        "poll": lambda *a, **k: 0,
+        "send_multipart": lambda self, frames: sent.append(frames),
+        "close": lambda *a, **k: None})()
+    return sent
+
+
+def _sent_kinds(sent):
+    import pickle
+    return [pickle.loads(p)[:2] + (pickle.loads(p)[2],)
+            for _, p in sent]
+
+
+def test_drain_deadline_force_fences_with_explicit_terminals():
+    clock = TickingClock()
+    repo = MemoryNameRecordRepository(clock=lambda: clock.t)
+    registry = FleetRegistry("e", "t", lease_ttl=1e9, repo=repo)
+    server = RolloutServer(
+        StuckBackend(n_slots=2, chunk=4),
+        server_name="gen_server/0",
+        queue=RequestQueue(max_depth=16, n_slots=2,
+                           clock=lambda: clock.t),
+        fleet=registry, drain_deadline_secs=3.0, clock=clock, seed=0)
+    sent = _capture_sends(server)
+    try:
+        for i in range(4):   # 2 fill slots (stuck), 2 stay queued
+            assert server.queue.submit(GenRequest(
+                rid=f"r{i}",
+                prompt=np.array([40, 3, 4], np.int32))).accepted
+            server._routes[f"r{i}"] = b"ident"
+        server.serve_step()
+        assert server.scheduler.n_live == 2
+        server.drain(timeout=1000.0)   # deadline caps it at 3s
+    finally:
+        server.close()
+    events = _sent_kinds(sent)
+    # queued requests bounced as draining...
+    bounced = {rid for k, rid, _ in events if k == "draining"}
+    assert len(bounced) == 2
+    # ...and the stuck in-flight pair force-fenced EXPLICITLY
+    cancelled = {rid: d for k, rid, d in events if k == "cancelled"}
+    assert set(cancelled) == {"r0", "r1"} or len(cancelled) == 2
+    assert all(d.get("reason") == "drain_deadline"
+               for d in cancelled.values())
+    # the drain honored the hard deadline despite timeout=1000
+    assert clock.t < 60.0
+    # flight event names the abandoned rids; the metric counts them
+    evs = [e for e in flight.default_recorder().events()
+           if e["kind"] == "serving_drain_abandoned"]
+    assert len(evs) == 1
+    assert sorted(evs[0]["rids"]) == sorted(cancelled)
+    assert evs[0]["server"] == "gen_server/0" and evs[0]["n"] == 2
+    snap = metrics.snapshot()
+    assert sum((snap["serving_drain_abandoned_total"]["values"])
+               .values()) == 2
+    # lease released + retiring mark persisted: a router polling now
+    # classifies this as a planned departure
+    assert registry.replicas() == {}
+    assert registry.is_retiring("gen_server/0")
+
+
+def test_clean_drain_abandons_nothing():
+    clock = TickingClock()
+    server = RolloutServer(
+        FakeSlotBackend(n_slots=2, chunk=4),
+        server_name="gen_server/0",
+        queue=RequestQueue(max_depth=16, n_slots=2,
+                           clock=lambda: clock.t),
+        drain_deadline_secs=30.0, clock=clock, seed=0)
+    sent = _capture_sends(server)
+    try:
+        for i in range(2):
+            assert server.queue.submit(GenRequest(
+                rid=f"r{i}",
+                prompt=np.array([6, 3, 4], np.int32))).accepted
+            server._routes[f"r{i}"] = b"ident"
+        server.serve_step()
+        server.drain(timeout=30.0)
+    finally:
+        server.close()
+    kinds = [k for k, _, _ in _sent_kinds(sent)]
+    assert kinds.count("done") == 2 and "cancelled" not in kinds
+    assert len(flight.default_recorder().events()) == 0 or all(
+        e["kind"] != "serving_drain_abandoned"
+        for e in flight.default_recorder().events())
+    snap = metrics.snapshot()
+    assert "serving_drain_abandoned_total" not in snap
+
+
+def test_begin_finish_drain_split_is_nonblocking():
+    """The drill/bench path: begin_drain bounces queued immediately
+    and returns; in-flight work finishes across subsequent
+    serve_steps; finish_drain(force=True) is a no-op when nothing is
+    left."""
+    clock = TickingClock()
+    repo = MemoryNameRecordRepository(clock=lambda: clock.t)
+    registry = FleetRegistry("e", "t", lease_ttl=1e9, repo=repo)
+    server = RolloutServer(
+        FakeSlotBackend(n_slots=1, chunk=4),
+        server_name="gen_server/0",
+        queue=RequestQueue(max_depth=16, n_slots=1,
+                           clock=lambda: clock.t),
+        fleet=registry, clock=clock, seed=0)
+    sent = _capture_sends(server)
+    try:
+        for i in range(2):
+            assert server.queue.submit(GenRequest(
+                rid=f"r{i}",
+                prompt=np.array([8, 3, 4], np.int32))).accepted
+            server._routes[f"r{i}"] = b"ident"
+        server.serve_step()          # r0 in the slot, r1 queued
+        assert server.begin_drain() == 1     # r1 bounced
+        assert registry.is_retiring("gen_server/0")
+        assert "gen_server/0" in registry.replicas()  # lease lives on
+        for _ in range(6):
+            server.serve_step()
+        assert server.scheduler.n_live == 0
+        assert server.finish_drain(force=True) == []
+        assert registry.replicas() == {}
+    finally:
+        server.close()
+    kinds = [k for k, _, _ in _sent_kinds(sent)]
+    assert kinds.count("draining") == 1 and kinds.count("done") == 1

@@ -1,0 +1,211 @@
+"""AutoscalePolicy decision-table tests: threshold crossing,
+hysteresis suppression, floor/ceiling clamps, cooldown re-arm --
+all pure data on a fake clock (milliseconds, no sleeping)."""
+
+import json
+
+import pytest
+
+from realhf_tpu.obs import flight, metrics
+from realhf_tpu.system.elastic import AutoscalePolicy, AutoscaleSignals
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+
+
+def mk(clock, **kw):
+    base = dict(min_replicas=1, max_replicas=4,
+                up_queue_per_replica=4, consecutive_up=3,
+                down_idle_per_replica=1.0, consecutive_down=3,
+                cooldown_secs=10.0, clock=clock)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def sig(q=0, i=0, r=0, lat=0.0, n=1):
+    return AutoscaleSignals(queue_depth=q, inflight=i, rejections=r,
+                            latency_secs=lat, n_replicas=n)
+
+
+# -- threshold crossing -------------------------------------------------
+def test_up_needs_consecutive_breaches_and_dip_resets():
+    clock = Clock()
+    p = mk(clock)
+    assert p.observe(sig(q=9)).action == "hold"
+    assert p.observe(sig(q=9)).action == "hold"
+    d = p.observe(sig(q=9))     # third consecutive breach
+    assert d.action == "up" and d.target == 2
+    assert "queue_depth" in d.reason
+    # streak reset on emit; a dip mid-streak also resets
+    clock.advance(60.0)
+    assert p.observe(sig(q=9)).action == "hold"
+    assert p.observe(sig(q=0)).action == "hold"   # dip
+    assert p.observe(sig(q=9)).action == "hold"
+    assert p.observe(sig(q=9)).action == "hold"
+    assert p.observe(sig(q=9)).action == "up"
+
+
+def test_threshold_scales_with_replica_count_and_must_exceed():
+    p = mk(Clock(), consecutive_up=1)
+    # 4/replica x 2 replicas = 8: equal is NOT pressure
+    assert p.observe(sig(q=8, n=2)).action == "hold"
+    d = p.observe(sig(q=9, n=2))
+    assert d.action == "up" and d.target == 3
+
+
+def test_rejections_and_latency_trigger_up():
+    p = mk(Clock(), consecutive_up=1)
+    assert p.observe(sig(r=1)).action == "up"
+    p2 = mk(Clock(), consecutive_up=1, up_latency_secs=0.5)
+    assert p2.observe(sig(lat=0.4)).action == "hold"
+    d = p2.observe(sig(lat=0.6))
+    assert d.action == "up" and "latency" in d.reason
+
+
+# -- scale-down ---------------------------------------------------------
+def test_down_after_idle_streak_requires_empty_queue():
+    clock = Clock()
+    p = mk(clock, down_idle_per_replica=2.0)
+    for _ in range(2):
+        assert p.observe(sig(q=0, i=1, n=2)).action == "hold"
+    d = p.observe(sig(q=0, i=1, n=2))   # 1 inflight fits 1 replica
+    assert d.action == "down" and d.target == 1
+    # queued work forbids scale-down no matter how idle the slots
+    clock.advance(60.0)
+    for _ in range(5):
+        assert p.observe(sig(q=1, i=0, n=2)).action == "hold"
+
+
+def test_down_disabled_when_consecutive_down_zero():
+    p = mk(Clock(), consecutive_down=0)
+    for _ in range(50):
+        assert p.observe(sig(q=0, i=0, n=3)).action == "hold"
+
+
+# -- clamps -------------------------------------------------------------
+def test_ceiling_and_floor_clamp():
+    clock = Clock()
+    p = mk(clock, consecutive_up=1, consecutive_down=1)
+    d = p.observe(sig(q=99, n=4))   # already at max_replicas
+    assert d.action == "hold" and d.suppressed == "ceiling"
+    d = p.observe(sig(q=0, i=0, n=1))   # already at min_replicas
+    assert d.action == "hold" and d.suppressed == "floor"
+    assert p.decisions["suppressed"] == 2
+
+
+def test_last_healthy_replica_never_taken_with_traffic_in_flight():
+    p = mk(Clock(), min_replicas=0, consecutive_down=1)
+    d = p._decide("down", sig(q=0, i=3, n=1), "forced", {})
+    assert d.action == "hold" and d.suppressed == "last_healthy"
+    # with zero traffic, floor 0 genuinely allows draining to zero
+    d = p.observe(sig(q=0, i=0, n=1))
+    assert d.action == "down" and d.target == 0
+
+
+# -- cooldown re-arm ----------------------------------------------------
+def test_same_direction_cooldown_rearms_after_window():
+    clock = Clock()
+    p = mk(clock, consecutive_up=1, cooldown_secs=10.0)
+    assert p.observe(sig(q=9)).action == "up"
+    clock.advance(5.0)
+    d = p.observe(sig(q=9))
+    assert d.action == "hold" and d.suppressed == "cooldown"
+    clock.advance(5.1)   # window over: sustained pressure re-fires
+    assert p.observe(sig(q=9)).action == "up"
+    assert p.decisions == dict(up=2, down=0, suppressed=1)
+
+
+# -- flap hysteresis (ExclusionBook discipline) -------------------------
+def test_reversal_suppressed_by_flap_window_with_escalation():
+    clock = Clock()
+    p = mk(clock, consecutive_up=1, consecutive_down=1,
+           cooldown_secs=2.0, flap_base_secs=10.0,
+           flap_forgive_secs=10_000.0)
+    assert p.observe(sig(q=9, n=1)).action == "up"
+    clock.advance(5.0)
+    # idle now -- but the up action excluded "down" for 10s
+    d = p.observe(sig(q=0, i=0, n=2))
+    assert d.action == "hold" and d.suppressed == "flap"
+    clock.advance(5.1)   # first flap window (10s) over
+    assert p.observe(sig(q=0, i=0, n=2)).action == "down"
+    clock.advance(2.1)
+    assert p.observe(sig(q=9, n=1)).action == "hold"  # up flapped now
+    clock.advance(8.0)
+    assert p.observe(sig(q=9, n=1)).action == "up"
+    # second reversal: the book escalates the window (10 -> 20s)
+    clock.advance(10.1)
+    d = p.observe(sig(q=0, i=0, n=2))
+    assert d.action == "hold" and d.suppressed == "flap"
+    clock.advance(10.1)  # 20.2s since the up: escalated window over
+    assert p.observe(sig(q=0, i=0, n=2)).action == "down"
+
+
+def test_flap_escalation_forgiven_after_stable_stretch():
+    clock = Clock()
+    p = mk(clock, consecutive_up=1, consecutive_down=1,
+           cooldown_secs=2.0, flap_base_secs=10.0,
+           flap_forgive_secs=100.0)
+    assert p.observe(sig(q=9, n=1)).action == "up"
+    clock.advance(10.1)
+    assert p.observe(sig(q=0, i=0, n=2)).action == "down"
+    clock.advance(10.1)
+    assert p.observe(sig(q=9, n=1)).action == "up"
+    # loss count is 2 per direction now; a LONG stable stretch
+    # forgives it -- the next reversal waits only the base window
+    clock.advance(150.0)
+    assert p.observe(sig(q=0, i=0, n=2)).action == "down"
+    clock.advance(10.1)  # base window, NOT the escalated one
+    assert p.observe(sig(q=9, n=1)).action == "up"
+
+
+# -- recording ----------------------------------------------------------
+def test_decisions_recorded_as_flight_events_and_metrics():
+    clock = Clock()
+    p = mk(clock, consecutive_up=1, consecutive_down=1,
+           cooldown_secs=1.0, flap_base_secs=1.0)
+    p.observe(sig(q=9), source="test")
+    clock.advance(5.0)
+    p.observe(sig(q=0, i=0, n=2), source="test")
+    for _ in range(3):   # cooldown: one episode, three observations
+        p.observe(sig(q=0, i=0, n=2), source="test")
+    snap = metrics.snapshot()
+
+    def total(name):
+        return sum((snap.get(name, {}).get("values") or {}).values())
+
+    assert total("serving_autoscale_up_total") == 1
+    assert total("serving_autoscale_down_total") == 1
+    assert total("serving_autoscale_suppressed_total") == 3
+    sup = snap["serving_autoscale_suppressed_total"]["values"]
+    reasons = {json.loads(k)["reason"] for k in sup}
+    assert reasons == {"cooldown"}
+    evs = flight.default_recorder().events()
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("autoscale_decision") == 2
+    # flight spam guard: ONE event for the 3-observation episode
+    assert kinds.count("autoscale_suppressed") == 1
+    up_ev = next(e for e in evs if e["kind"] == "autoscale_decision"
+                 and e["action"] == "up")
+    assert up_ev["source"] == "test" and up_ev["target"] == 2
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=-1)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)

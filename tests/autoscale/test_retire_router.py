@@ -1,0 +1,124 @@
+"""Router-side scale-down regression (the retire race): a replica
+deliberately drained out of the fleet must NOT look like a loss --
+zero failovers, zero circuit-breaker transitions, quiet removal --
+and its queued/abandoned work must still reach exactly one terminal
+on survivors. Runs on the deterministic drill harness
+(scripts/chaos_drill.py) with a fake clock."""
+
+import importlib.util
+import os
+
+import pytest
+
+from realhf_tpu.obs import flight, metrics
+
+
+def _load_drill():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "chaos_drill.py")
+    spec = importlib.util.spec_from_file_location("chaos_drill", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+
+
+def test_clean_scale_down_zero_failovers_zero_breaker_transitions():
+    """The satellite regression: retire a replica while requests are
+    queued and in flight on it. Every request completes, the router
+    records the departure as `retired` (not lost), and neither the
+    failover counter nor any breaker moves."""
+    cd = _load_drill()
+    requests = [cd.DrillRequest(tick=2 + i, need=16) for i in range(8)]
+    schedule = [cd.DrillEvent(tick=6, action="retire",
+                              target="gen_server/1")]
+    fleet = cd.DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=1500)
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": len(requests)}
+    assert report.failovers == 0
+    assert report.breaker_transitions == {}, report.breaker_transitions
+    assert report.retired == ["gen_server/1"]
+    assert report.router_stats["retired"] == 1
+    # the replica left the router's table entirely (no zombie entry)
+    assert "gen_server/1" not in report.router_stats["replicas"]
+    # and nothing was delivered from a lost/stale source
+    assert report.fenced_deliveries == []
+
+
+def test_retiring_replica_gets_no_new_dispatch_but_finishes_inflight():
+    cd = _load_drill()
+    fleet = cd.DrillFleet(n_replicas=2, lease_ttl=5.0, dt=0.05)
+    try:
+        client = fleet.client()
+        import numpy as np
+        first = [client.submit(np.array([30, 1, 2], np.int32),
+                               ttl=60.0) for _ in range(2)]
+        for _ in range(4):   # both replicas now hold work
+            fleet.step()
+        inflight_at_retire = {
+            n: len(r.inflight)
+            for n, r in fleet.router._replicas.items()}
+        assert inflight_at_retire.get("gen_server/1", 0) >= 1
+        fleet.retire("gen_server/1")
+        late = [client.submit(np.array([12, 1, 2], np.int32),
+                              ttl=60.0) for _ in range(4)]
+        for _ in range(200):
+            fleet.step()
+            if all(any(k in cd.TERMINAL_KINDS
+                       for k, _ in fleet.events.get(r, []))
+                   for r in first + late):
+                break
+        # everyone done, and every post-retire dispatch avoided the
+        # retiring replica
+        snap = metrics.snapshot()
+        disp = snap["router_dispatches_total"]["values"]
+        import json as _json
+        by_rep = {}
+        for k, v in disp.items():
+            by_rep[_json.loads(k)["replica"]] = v
+        assert by_rep.get("gen_server/0", 0) >= 4 + 1
+        # the retiring replica saw only its pre-retire dispatches
+        assert by_rep.get("gen_server/1", 0) <= len(first) + len(late)
+        assert fleet.router.stats_counters["failovers"] == 0
+        assert fleet.retired == ["gen_server/1"]
+    finally:
+        fleet.close()
+
+
+def test_spawned_replica_is_discovered_and_takes_traffic():
+    """Scale-up end: a mid-run spawn registers a fresh lease + epoch
+    and the router starts dispatching to it without restart."""
+    cd = _load_drill()
+    # wave 1 saturates the two original replicas; the spawn lands,
+    # then wave 2 finds the empty newcomer least-loaded
+    requests = ([cd.DrillRequest(tick=2, need=40) for _ in range(6)]
+                + [cd.DrillRequest(tick=10, need=12)
+                   for _ in range(4)])
+    schedule = [cd.DrillEvent(tick=4, action="spawn",
+                              target="gen_server/2")]
+    fleet = cd.DrillFleet(n_replicas=2, n_slots=1, lease_ttl=5.0,
+                          dt=0.05)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=2500)
+        snap = metrics.snapshot()
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": 10}
+    import json as _json
+    disp = {(_json.loads(k)["replica"]): v for k, v in
+            snap["router_dispatches_total"]["values"].items()}
+    assert disp.get("gen_server/2", 0) >= 1, disp
+    assert fleet.registry.epoch_of("gen_server/2") == 1

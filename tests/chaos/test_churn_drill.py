@@ -1,0 +1,114 @@
+"""Churn-hardened failover: the chaos drill while the fleet RESIZES
+-- scale-ups and graceful scale-downs interleaved with hard kills and
+partitions. The PR 7 invariants (exactly-once terminal delivery, no
+fenced delivery, no orphaned rids) must hold while membership churns,
+and retired replicas must leave no breaker trail behind."""
+
+import importlib.util
+import os
+
+import pytest
+
+from realhf_tpu.obs import metrics
+
+
+def _load_drill():
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "scripts", "chaos_drill.py")
+    spec = importlib.util.spec_from_file_location("chaos_drill", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_default()
+    yield
+
+
+def _assert_churn_invariants(report):
+    assert report.ok, report.summary()
+    assert report.lost_rids == [] and report.duplicate_rids == []
+    assert report.fenced_deliveries == []
+    # every request exactly one terminal, all successful
+    assert set(report.outcomes) == {"done"}
+    # clean scale-downs happened and left no breaker trail
+    assert len(report.retired) >= 1
+    dirty = set(report.retired) & set(report.breaker_transitions)
+    assert not dirty, (report.retired, report.breaker_transitions)
+
+
+def test_tier1_scaled_churn_drill():
+    cd = _load_drill()
+    fleet, requests, schedule = cd.churn_scenario(scale=0.3)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=2000)
+    finally:
+        fleet.close()
+    _assert_churn_invariants(report)
+    assert report.retired == ["gen_server/0", "gen_server/4"]
+
+
+def test_tier1_churn_with_kill_of_loaded_replica():
+    """A retire and a die of replicas that BOTH hold in-flight work:
+    the retire drains cleanly (no failover accounting), the kill
+    fails over -- and the two paths stay distinguishable."""
+    cd = _load_drill()
+    # all-at-once burst: every replica holds work when the churn hits
+    requests = [cd.DrillRequest(tick=2, need=60) for _ in range(6)]
+    schedule = [
+        cd.DrillEvent(tick=6, action="retire", target="gen_server/1"),
+        cd.DrillEvent(tick=8, action="die", target="gen_server/2"),
+        cd.DrillEvent(tick=10, action="spawn",
+                      target="gen_server/3"),
+    ]
+    fleet = cd.DrillFleet(n_replicas=3, n_slots=1, lease_ttl=2.0,
+                          dt=0.05)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=2500)
+    finally:
+        fleet.close()
+    assert report.ok, report.summary()
+    assert report.outcomes == {"done": 6}
+    # the kill failed work over; the retire did NOT count as failover
+    assert report.failovers >= 1
+    assert report.retired == ["gen_server/1"]
+    assert "gen_server/1" not in report.breaker_transitions
+    # the dead replica's breaker opened (a real loss still looks like
+    # one)
+    states = {s.split("x")[0] for s in
+              report.breaker_transitions.get("gen_server/2", [])}
+    assert "open" in states
+
+
+def test_cli_churn_scenario_scaled():
+    cd = _load_drill()
+    rc = cd.main(["--scenario", "churn", "--scale", "0.3",
+                  "--max-ticks", "2000"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_full_churn_acceptance():
+    """Full-scale churn acceptance (ISSUE 12): 30 requests under
+    interleaved spawn/retire/die/partition/revive; every invariant
+    holds, the graceful retires show zero retire-leftovers
+    re-dispatched OR every leftover re-dispatched reaches a terminal
+    anyway (the drill's ok flag covers both)."""
+    cd = _load_drill()
+    fleet, requests, schedule = cd.churn_scenario(scale=1.0)
+    try:
+        report = cd.run_drill(fleet, requests, schedule,
+                              max_ticks=6000)
+        text = metrics.to_prometheus()
+    finally:
+        fleet.close()
+    _assert_churn_invariants(report)
+    assert report.n_requests == 30
+    # the partitioned replica fenced + rejoined at a higher epoch
+    assert report.fenced_reconnects >= 1
+    # metrics surface carries the retire accounting
+    assert "router_replicas_retired_total" in text
