@@ -100,11 +100,18 @@ class FleetRegistry:
     # -- deliberate scale-down (docs/serving.md "Autoscaling") ---------
     def mark_retiring(self, name: str):
         """Flag a replica as deliberately draining (scale-down /
-        graceful shutdown). The flag has NO lease: it must survive the
-        replica's own deregistration so a consumer polling after the
-        lease vanished still classifies the departure as planned. It
-        is cleared by the next :meth:`register` of the same name."""
-        self._repo.add(self._retiring_key(name), "1", replace=True)
+        graceful shutdown). The flag is never renewed like a lease --
+        it must survive the replica's own deregistration so a consumer
+        polling after the lease vanished still classifies the
+        departure as planned -- but it does carry a generous TTL
+        (many lease TTLs) as a backstop: autoscaling never reuses
+        replica names, so without expiry a long-running trial would
+        accumulate retiring/ keys in every :meth:`replicas` scan. It
+        is cleared earlier by whichever comes first: the consumer
+        observing the departure (``FleetRouter._retire_replica``) or
+        a :meth:`register` of the same name."""
+        self._repo.add(self._retiring_key(name), "1", replace=True,
+                       keepalive_ttl=max(300.0, 20.0 * self.lease_ttl))
         logger.info("Fleet replica %s marked retiring.", name)
 
     def clear_retiring(self, name: str):

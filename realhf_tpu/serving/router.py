@@ -360,6 +360,11 @@ class FleetRouter:
         rep.inflight.clear()
         rep.sock.close(0)
         self._replicas.pop(rep.name, None)
+        # the departure has been consumed: drop the leaseless
+        # retiring/ marker so a long-running trial that never reuses
+        # replica names does not accumulate them (its TTL is only the
+        # backstop for routerless consumers)
+        self.registry.clear_retiring(rep.name)
 
     def _mark_lost(self, rep: _Replica, why: str):
         logger.warning("Router: replica %s LOST (%s); failing over "
@@ -538,12 +543,24 @@ class FleetRouter:
             if kind == "cancelled" \
                     and data.get("reason") == "drain_deadline" \
                     and not req.client_cancelled:
+                if req.owner not in (None, rep.name):
+                    # a live hedge twin owns the client's stream; the
+                    # victim's copy going away is pure bookkeeping
+                    return
                 # the replica's drain hit its hard deadline and
                 # force-fenced this request (explicit terminal, never
-                # silent): shop it to a survivor like any transient
-                # bounce -- the client only sees the cancellation when
-                # nobody is left to take it
-                self._on_replica_reject(rep, req, kind, data)
+                # silent). The victim had the request in flight and
+                # may already own the client's stream (its `started`
+                # was forwarded), so the bounce must go through the
+                # failover bookkeeping -- owner cleared, `retrying`
+                # emitted so a streaming client resets, rid parked in
+                # _pending when no candidate is free right now --
+                # otherwise the survivor's `started` would be
+                # mistaken for a hedge race and cancelled, orphaning
+                # the rid until its client-side TTL
+                self._fail_assignment(req, rep.name,
+                                      why="drain_deadline",
+                                      counter="retire_redispatches")
                 return
             if kind in ("rejected", "draining") \
                     and not req.client_cancelled:

@@ -261,9 +261,15 @@ class AutoscaleSignals:
     """One observation of the serving fleet's live load, as consumed
     by :class:`AutoscalePolicy.observe`. All fields are plain numbers
     so policy tests are pure data on a fake clock."""
-    #: requests waiting for a replica (router pending + replica queues)
+    #: requests not yet started anywhere: at minimum the router's
+    #: pending (unassigned) count -- what ``run_serve`` wires, with
+    #: requests queued INSIDE a replica folded into ``inflight`` --
+    #: while in-process harnesses that can read replica queues
+    #: cheaply (scripts/bench_serving.py) aggregate those in too, so
+    #: tune ``up_queue_per_replica`` against the signal actually fed
     queue_depth: int = 0
-    #: requests currently being served fleet-wide
+    #: requests dispatched to a replica fleet-wide (decoding, or
+    #: queued inside it when the feeder cannot see replica queues)
     inflight: int = 0
     #: NEW admission rejections since the previous observation
     #: (backpressure / no_healthy_replica -- a shed request is the

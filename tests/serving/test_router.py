@@ -7,6 +7,7 @@ lease expiry and every router timeout are deterministic. The full
 scripted-schedule drills live in tests/chaos/."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -395,6 +396,99 @@ def test_router_drain_terminates_everything():
         k, d = f.terminal(rid)
         assert k == "rejected" and d["reason"] == "draining"
     finally:
+        f.close()
+
+
+# ----------------------------------------------------------------------
+# scale-down drain-deadline force-fence at the router
+# ----------------------------------------------------------------------
+def _pump_until_redispatched(f, max_tries=400):
+    """Deliver a replica's force-fence terminal to the router BEFORE
+    it observes the lease departure (the racier of the two orders --
+    the other order goes through _retire_replica and is covered by
+    tests/autoscale/test_retire_router.py). Real sockets, so spin on
+    wall-clock, not the fake clock."""
+    for _ in range(max_tries):
+        f.router._pump_replicas()
+        if f.router.stats_counters["retire_redispatches"]:
+            return
+        time.sleep(0.005)
+    raise AssertionError("drain_deadline terminal never redispatched")
+
+
+def test_drain_deadline_fence_after_started_redispatches_cleanly():
+    """A draining replica force-fences a request whose `started` it
+    already emitted -- it owns the client's stream. The bounce must go
+    through the failover bookkeeping (owner cleared, `retrying`
+    emitted, the survivor's own `started` accepted) instead of being
+    mistaken for a hedge race and cancelled, which would orphan the
+    rid until its client-side TTL."""
+    f = Fleet(n=2)
+    victim = None
+    try:
+        rid = f.client.submit(np.array([64, 3], np.int32), ttl=60.0)
+        for _ in range(50):
+            f.step()
+            if any(k == "started" for k, _ in f.events.get(rid, [])):
+                break
+        req = f.router._requests[rid]
+        victim = req.owner
+        assert victim is not None and req.started_fwd
+        srv = f.servers[victim]
+        # stuck decoding: the drain MUST hit its hard deadline
+        srv.scheduler.backend.decode_chunk = lambda key: None
+        srv.begin_drain()
+        assert srv.finish_drain(force=True) == [rid]
+        _pump_until_redispatched(f)
+        assert req.owner is None and not req.started_fwd
+        f.alive.remove(victim)
+        f.run_until_terminal([rid])
+        k, d = f.terminal(rid)           # exactly ONE terminal
+        assert k == "done" and len(d["tokens"]) == 64
+        kinds = [k for k, _ in f.events[rid]]
+        assert "retrying" in kinds       # streaming client reset
+        assert kinds.count("started") == 2
+        st = f.router.stats_counters
+        assert st["retire_redispatches"] == 1
+        assert st["failovers"] == 0 and st["retired"] == 1
+        # the departure has been consumed: retiring marker cleared
+        assert not f.registry.is_retiring(victim)
+    finally:
+        if victim is not None:
+            f.servers[victim].close()
+        f.close()
+
+
+def test_drain_deadline_bounce_parks_pending_without_candidates():
+    """When the force-fence bounce finds no free replica, the rid
+    parks in _pending (bounded by pending_timeout) instead of
+    surfacing a client-visible cancellation; a later scale-up picks
+    it up."""
+    f = Fleet(n=1, pending_timeout=30.0)
+    try:
+        rid = f.client.submit(np.array([48, 3], np.int32), ttl=60.0)
+        for _ in range(50):
+            f.step()
+            if any(k == "started" for k, _ in f.events.get(rid, [])):
+                break
+        srv = f.servers["gen_server/0"]
+        srv.scheduler.backend.decode_chunk = lambda key: None
+        srv.begin_drain()
+        assert srv.finish_drain(force=True) == [rid]
+        _pump_until_redispatched(f)
+        f.alive.remove("gen_server/0")
+        f.step()
+        # nobody can take it: parked for retry, NOT cancelled
+        assert rid in f.router._pending
+        assert not any(k in TERMINAL_KINDS
+                       for k, _ in f.events.get(rid, []))
+        f.spawn("gen_server/1")
+        f.run_until_terminal([rid])
+        k, d = f.terminal(rid)
+        assert k == "done" and len(d["tokens"]) == 48
+        assert f.router.stats_counters["failovers"] == 0
+    finally:
+        f.servers["gen_server/0"].close()
         f.close()
 
 
