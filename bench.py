@@ -790,6 +790,30 @@ def _bench_serving_hotpath():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def _bench_trace_report():
+    """Trace-driven step-time attribution (ISSUE 13) in a CPU-forced
+    subprocess (scripts/analyze_trace.py --demo): a tiny traced
+    inline PPO trial analyzed by obs/analyze.py -- per-step
+    compute/data_fetch/realloc/dispatch/idle attribution summing to
+    the step wall, the critical-path MFC, and goodput."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["REALHF_TPU_TRACE"] = "1"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "analyze_trace.py")
+    r = subprocess.run(
+        [sys.executable, script, "--demo", "--steps", "2"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"analyze_trace exited {r.returncode}: {r.stderr[-500:]}")
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    # the payload wants the aggregates, not every per-step span table
+    report["steps"] = report.get("steps", [])[:4]
+    return report
+
+
 def main():
     headline_only = "--headline-only" in sys.argv[1:]
     use_accel = _accelerator_usable()
@@ -906,6 +930,16 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort phase
         extra["agentic_bench"] = {"error": repr(e)}
     phases_done.append("agentic_bench")
+    _flush_payload(headline, extra, phases_done)
+
+    # Trace analytics (ISSUE 13): where a traced step's wall goes --
+    # attribution, critical-path MFC, goodput -- proving the analyzer
+    # end-to-end on a real (tiny) traced trial.
+    try:
+        extra["trace_report"] = _bench_trace_report()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["trace_report"] = {"error": repr(e)}
+    phases_done.append("trace_report")
     _flush_payload(headline, extra, phases_done)
 
     # Reshard + cross-group sync (north-star metric): best-effort on

@@ -11,6 +11,9 @@ rests on (docs/static_analysis.md):
   layouts, collectives, or name_resolve keys.
 - ``dfg-invariants``: registered experiment DFGs are acyclic, edge-
   and mesh-compatible, with totally ordered weight reallocations.
+- ``obs-metric-name``: literal metric names are snake_case, counters
+  end ``_total``, duration histograms/summaries end
+  ``_secs``/``_seconds``.
 
 CLI: ``python -m realhf_tpu.analysis [--fail-on-new] [--baseline F]
 [--checker NAME] [paths...]`` -- see ``__main__.py``.
@@ -32,6 +35,7 @@ from realhf_tpu.analysis.determinism import DeterminismChecker
 from realhf_tpu.analysis.dfg_invariants import DfgInvariantsChecker
 from realhf_tpu.analysis.finding import Finding  # noqa: F401
 from realhf_tpu.analysis.jax_purity import JaxPurityChecker
+from realhf_tpu.analysis.obs_metrics import ObsMetricNameChecker
 
 #: family name -> checker class, in documentation order
 CHECKER_CLASSES = {
@@ -39,6 +43,7 @@ CHECKER_CLASSES = {
     ConcurrencyChecker.name: ConcurrencyChecker,
     DeterminismChecker.name: DeterminismChecker,
     DfgInvariantsChecker.name: DfgInvariantsChecker,
+    ObsMetricNameChecker.name: ObsMetricNameChecker,
 }
 
 

@@ -1,0 +1,94 @@
+"""obs-metric-name checker: metric naming conventions at call sites.
+
+The metrics registry (``realhf_tpu/obs/metrics.py``) creates metrics
+lazily at the first instrumented call, so a misnamed metric never
+fails fast -- it just pollutes the Prometheus export forever (and a
+``router_latency`` vs ``router_latency_secs`` mismatch silently
+splits one series in two). This checker pins the conventions the
+export relies on, at every call site that passes a LITERAL metric
+name to the one-line instrumentation API (``inc`` / ``set_gauge`` /
+``observe`` / ``observe_hist``) or the registry constructors
+(``counter`` / ``gauge`` / ``summary`` / ``histogram``):
+
+- ``obs-metric-name``: names must be snake_case
+  (``[a-z][a-z0-9_]*``);
+- counters (``inc`` / ``counter``) must end ``_total`` (the
+  Prometheus counter convention every recording rule assumes);
+- histograms/summaries whose name implies a duration (contains
+  ``sec``/``secs``/``seconds``/``latency``/``duration``) must end
+  ``_secs`` or ``_seconds`` so the unit is in the name.
+
+Dynamic names (f-strings, variables) are out of scope -- only
+``ast.Constant`` strings are checked, so the checker never guesses.
+"""
+
+import ast
+import re
+from typing import List, Optional
+
+from realhf_tpu.analysis.core import AstChecker, Module, \
+    enclosing_symbols
+from realhf_tpu.analysis.finding import Finding
+
+#: call name -> metric kind implied by the call
+METRIC_CALLS = {
+    "inc": "counter",
+    "counter": "counter",
+    "set_gauge": "gauge",
+    "gauge": "gauge",
+    "observe": "summary",
+    "summary": "summary",
+    "observe_hist": "histogram",
+    "histogram": "histogram",
+}
+
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_TIMEISH_RE = re.compile(r"sec|latency|duration")
+
+
+def _literal_name(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+class ObsMetricNameChecker(AstChecker):
+    name = "obs-metric-name"
+
+    def check(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        symbols = enclosing_symbols(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) \
+                else (func.id if isinstance(func, ast.Name) else "")
+            kind = METRIC_CALLS.get(attr)
+            if kind is None:
+                continue
+            metric = _literal_name(node)
+            if metric is None:
+                continue  # dynamic names are out of scope
+            problem = None
+            if not _SNAKE_RE.match(metric):
+                problem = (f"metric name {metric!r} is not snake_case "
+                           "([a-z][a-z0-9_]*)")
+            elif kind == "counter" \
+                    and not metric.endswith("_total"):
+                problem = (f"counter {metric!r} must end `_total` "
+                           "(Prometheus counter convention)")
+            elif kind in ("summary", "histogram") \
+                    and _TIMEISH_RE.search(metric) \
+                    and not metric.endswith(("_secs", "_seconds")):
+                problem = (f"{kind} {metric!r} looks like a duration "
+                           "but does not end `_secs`/`_seconds` -- "
+                           "put the unit in the name")
+            if problem is not None:
+                findings.append(self.finding(
+                    module, "obs-metric-name", node, problem,
+                    symbol=symbols.get(node, "")))
+        return findings

@@ -230,6 +230,17 @@ class ServingSpec:
     #: seconds a spawned replica gets to register before the spawn is
     #: written off as failed
     autoscale_spawn_deadline_secs: float = 180.0
+    #: where run_serve reads the router's autoscale signals:
+    #: "zmq" (default; the router's stats worker command) or "http"
+    #: (GET the router's /metrics telemetry endpoint -- the same
+    #: Prometheus text a real scraper sees, resolved through
+    #: names.telemetry; falls back to zmq when unreachable)
+    autoscale_signal_source: str = "zmq"
+    #: which latency figure feeds the scale-up policy: "ewma"
+    #: (default), or "p50"/"p95" from the router_latency_seconds
+    #: histogram (tail latency reacts to stragglers the EWMA smooths
+    #: over)
+    autoscale_latency_signal: str = "ewma"
     # -- resilient fleet mode (docs/serving.md "Fleet, failover &
     # circuit breakers"): a FleetRouter fronts the n_servers replicas;
     # replicas register leases in the fleet registry and clients talk

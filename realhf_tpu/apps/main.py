@@ -155,6 +155,18 @@ def run_trial(spec: ExperimentSpec, recover_mode: str = "disabled",
             timeout=600)
         panel.group_request("start")
         logger.info("All %d workers started.", len(worker_names))
+        # live scrape targets the moment the fleet is up: every worker
+        # has published its telemetry endpoint by now, so Prometheus
+        # can discover real per-worker ports for the run's whole life
+        # (the teardown rewrite is only the postmortem fallback)
+        sd_path = controller.write_scrape_targets(
+            labels=dict(experiment=spec.experiment_name,
+                        trial=spec.trial_name),
+            experiment_name=spec.experiment_name,
+            trial_name=spec.trial_name)
+        if sd_path:
+            logger.info("Prometheus scrape targets written: %s "
+                        "(file_sd_configs).", sd_path)
 
         # watchdog over the whole fleet (master included): catches
         # hung-but-not-dead workers the scheduler still reports as
@@ -307,6 +319,14 @@ def _merge_run_traces():
     if merged:
         logger.info("Chrome trace written: %s (open in Perfetto / "
                     "chrome://tracing).", merged)
+        # the analytic companion to the Perfetto pointer: where the
+        # step wall went, the bottleneck MFC, and who straggled
+        from realhf_tpu.obs import analyze
+        summary = analyze.summarize_path(merged)
+        if summary:
+            logger.info("%s (full report: python "
+                        "scripts/analyze_trace.py %s)", summary,
+                        merged)
 
 
 class _ServeFleetActuator:
@@ -467,6 +487,14 @@ def run_serve(spec: ExperimentSpec,
                     len(worker_names),
                     {w: r.get("address") for w, r in out.items()
                      if isinstance(r, dict)})
+        sd_path = controller.write_scrape_targets(
+            labels=dict(experiment=spec.experiment_name,
+                        trial=spec.trial_name),
+            experiment_name=spec.experiment_name,
+            trial_name=spec.trial_name)
+        if sd_path:
+            logger.info("Prometheus scrape targets written: %s "
+                        "(file_sd_configs).", sd_path)
 
         watchdog = Watchdog(
             spec.experiment_name, spec.trial_name, worker_names,
@@ -547,6 +575,44 @@ def run_serve(spec: ExperimentSpec,
             _last_rej = [0]
             _next_obs = [time.monotonic()
                          + sv.autoscale_interval_secs]
+            signal_source = getattr(sv, "autoscale_signal_source",
+                                    "zmq")
+            latency_signal = getattr(sv, "autoscale_latency_signal",
+                                     "ewma")
+
+            def _router_stats_zmq():
+                return panel.group_request(
+                    "stats", worker_names=["router/0"],
+                    timeout=30)["router/0"]
+
+            def _router_stats_http():
+                """Poll the router's /metrics telemetry endpoint --
+                the same Prometheus text a real scraper sees
+                (docs/observability.md "Scraping the fleet") --
+                resolved through names.telemetry."""
+                import urllib.request
+
+                from realhf_tpu.obs import http as obs_http
+                addr = name_resolve.get(names.telemetry(
+                    spec.experiment_name, spec.trial_name,
+                    "router/0"))
+                with urllib.request.urlopen(f"http://{addr}/metrics",
+                                            timeout=10) as r:
+                    fams = obs_http.parse_prometheus_text(
+                        r.read().decode("utf-8", "replace"))
+                return dict(
+                    pending=obs_http.prom_scalar(
+                        fams, "router_pending", agg="last"),
+                    inflight=obs_http.prom_scalar(
+                        fams, "router_inflight", agg="last"),
+                    rejections=obs_http.prom_scalar(
+                        fams, "router_rejections_total"),
+                    latency_ewma_secs=obs_http.prom_scalar(
+                        fams, "router_latency_ewma_secs", agg="last"),
+                    latency_p50=obs_http.prom_histogram_quantile(
+                        fams, "router_latency_seconds", 0.5),
+                    latency_p95=obs_http.prom_histogram_quantile(
+                        fams, "router_latency_seconds", 0.95))
 
             def _autoscale_tick():
                 actuator.poll_bringup()
@@ -555,9 +621,18 @@ def run_serve(spec: ExperimentSpec,
                     return
                 _next_obs[0] = now + sv.autoscale_interval_secs
                 try:
-                    st = panel.group_request(
-                        "stats", worker_names=["router/0"],
-                        timeout=30)["router/0"]
+                    if signal_source == "http":
+                        try:
+                            st = _router_stats_http()
+                        except Exception as e:  # noqa: BLE001 - the
+                            # ZMQ stats command stays the fallback
+                            logger.warning(
+                                "Autoscale: router /metrics scrape "
+                                "failed (%s); falling back to zmq "
+                                "stats.", e)
+                            st = _router_stats_zmq()
+                    else:
+                        st = _router_stats_zmq()
                 except Exception as e:  # noqa: BLE001 - a missed
                     # observation must not kill supervision
                     logger.warning("Autoscale: router stats "
@@ -565,13 +640,20 @@ def run_serve(spec: ExperimentSpec,
                     return
                 rej = int(st.get("rejections", 0))
                 pending = int(st.get("pending", 0))
+                if latency_signal in ("p50", "p95"):
+                    # tail latency from the router_latency_seconds
+                    # histogram (None until the first completion)
+                    lat = st.get(f"latency_{latency_signal}")
+                    if lat is None:
+                        lat = st.get("latency_ewma_secs")
+                else:
+                    lat = st.get("latency_ewma_secs")
                 sig = AutoscaleSignals(
                     queue_depth=pending,
                     inflight=max(0, int(st.get("inflight", 0))
                                  - pending),
                     rejections=max(0, rej - _last_rej[0]),
-                    latency_secs=float(
-                        st.get("latency_ewma_secs") or 0.0))
+                    latency_secs=float(lat or 0.0))
                 _last_rej[0] = rej
                 autoscaler.step(sig, source="run_serve")
 

@@ -146,6 +146,12 @@ def _report_observability_artifacts():
     if os.path.exists(merged):
         logger.info("Trace timeline: %s (load in https://ui.perfetto.dev"
                     " or chrome://tracing).", merged)
+        from realhf_tpu.obs import analyze
+        summary = analyze.summarize_path(merged)
+        if summary:
+            logger.info("%s (full report: python "
+                        "scripts/analyze_trace.py %s)", summary,
+                        merged)
     elif os.path.isdir(d):
         logger.info("Per-process trace shards under %s (merge with "
                     "realhf_tpu.obs.tracing.merge_traces).", d)

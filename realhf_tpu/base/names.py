@@ -58,6 +58,20 @@ def host_root(experiment_name: str, trial_name: str) -> str:
     return f"{_root(experiment_name, trial_name)}/host/"
 
 
+def telemetry(experiment_name: str, trial_name: str,
+              worker_name: str) -> str:
+    """HTTP telemetry endpoint: each worker (and the inline runner)
+    publishes the ``host:port`` its ``TelemetryServer`` bound
+    (``obs/http.py`` -- /metrics, /healthz, /flight, /statusz) so the
+    pod controller can emit LIVE per-worker Prometheus scrape targets
+    instead of dead per-host ports (``system/pod.py``)."""
+    return f"{_root(experiment_name, trial_name)}/telemetry/{worker_name}"
+
+
+def telemetry_root(experiment_name: str, trial_name: str) -> str:
+    return f"{_root(experiment_name, trial_name)}/telemetry/"
+
+
 def train_progress(experiment_name: str, trial_name: str) -> str:
     """Master-published global step (updated per finished batch): the
     pod controller / harnesses can watch trial progress without a

@@ -13,6 +13,14 @@ Three cooperating pieces (docs/observability.md):
 - :mod:`realhf_tpu.obs.flight` -- a bounded ring of recent events per
   worker, dumped to disk on crashes, preemptions, and worker-lost
   paths for postmortems.
+- :mod:`realhf_tpu.obs.http` -- live HTTP telemetry endpoints
+  (/metrics, /healthz, /flight, /statusz) every worker and the inline
+  runner serve on an ephemeral port published under
+  ``names.telemetry`` (the Prometheus scrape surface).
+- :mod:`realhf_tpu.obs.analyze` -- trace analytics: per-step
+  wall-time attribution, critical-path/bottleneck-MFC, straggler
+  skew, and goodput computed from the merged Chrome trace
+  (``scripts/analyze_trace.py`` is the CLI).
 
 :func:`configure_from_env` is the one call every process entry point
 makes (``worker_base.Worker``, the inline runner, quickstart): it

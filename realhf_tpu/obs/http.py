@@ -1,0 +1,335 @@
+"""Live HTTP telemetry endpoints: the scrape side of the obs layer.
+
+Before this module the metrics registry was only reachable through
+the ZMQ ``metrics`` worker command and the pod controller's
+``file_sd`` output pointed Prometheus at ports nothing listened on.
+:class:`TelemetryServer` closes the loop: a stdlib
+``ThreadingHTTPServer`` (no new dependencies) that every worker
+process and the inline runner start on an ephemeral port, publishing
+the address under ``names.telemetry`` so the pod controller can
+resolve real per-worker scrape targets (``system/pod.py``).
+
+Endpoints (docs/observability.md "Scraping the fleet"):
+
+- ``GET /metrics``  -- Prometheus text exposition of the process
+  default :class:`~realhf_tpu.obs.metrics.MetricsRegistry`.
+- ``GET /healthz``  -- worker liveness JSON (status, heartbeat age,
+  lease/epoch state); HTTP 200 while serving, 503 once draining /
+  preempted / errored, so a probing LB stops sending traffic the
+  moment a drain starts.
+- ``GET /flight``   -- the flight-recorder ring as JSON (a live
+  postmortem preview; the on-crash dump is still the durable copy).
+- ``GET /statusz``  -- one-page process status: metrics snapshot,
+  trace configuration, flight-ring size.
+
+Serving a scrape never touches worker state beyond snapshotting it;
+handlers render under no registry lock (the registry snapshots
+internally) and errors return 500 without taking the process down.
+The server is ON by default (it binds an ephemeral port and costs one
+daemon thread); ``REALHF_TPU_TELEMETRY=0`` opts out,
+``REALHF_TPU_TELEMETRY_PORT`` pins the port.
+
+:func:`parse_prometheus_text` is the matching reader: it parses the
+exposition format back into ``name -> [(labels, value)]`` so the
+``run_serve`` autoscaler can consume a router's ``/metrics`` over
+HTTP exactly as a real Prometheus would.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from realhf_tpu.base import logging
+from realhf_tpu.obs import flight, metrics, tracing
+
+logger = logging.getLogger("obs.http")
+
+TELEMETRY_ENV = "REALHF_TPU_TELEMETRY"
+TELEMETRY_PORT_ENV = "REALHF_TPU_TELEMETRY_PORT"
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: health states that answer 200 (anything else -- draining,
+#: preempted, error, unknown -- answers 503 so probers back off)
+HEALTHY_STATES = ("READY", "RUNNING", "PAUSED")
+
+
+def telemetry_env_enabled(env=None) -> bool:
+    import os
+    env = os.environ if env is None else env
+    return env.get(TELEMETRY_ENV, "1") not in ("0", "off", "false")
+
+
+class TelemetryServer:
+    """One process's HTTP telemetry surface (module doc).
+
+    ``health`` is a zero-arg callable returning the ``/healthz`` JSON
+    dict; its ``"state"`` key decides the HTTP status (200 for
+    :data:`HEALTHY_STATES`, 503 otherwise). Provider exceptions render
+    as ``state="error"`` -- a scrape must never take the worker down.
+    """
+
+    def __init__(self, process_name: str = "proc", *,
+                 port: int = 0, host: str = "",
+                 registry: Optional[metrics.MetricsRegistry] = None,
+                 recorder: Optional[flight.FlightRecorder] = None,
+                 tracer: Optional[tracing.Tracer] = None,
+                 health: Optional[Callable[[], Dict]] = None):
+        self.process_name = process_name
+        self._registry = registry
+        self._recorder = recorder
+        self._tracer = tracer
+        self._health = health
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._requested_port = port
+        self._host = host
+
+    # late binding: tests swap the process defaults per test, so the
+    # server must read them at scrape time, not construction time
+    @property
+    def registry(self) -> metrics.MetricsRegistry:
+        return self._registry or metrics.default_registry()
+
+    @property
+    def recorder(self) -> flight.FlightRecorder:
+        return self._recorder or flight.default_recorder()
+
+    @property
+    def tracer(self) -> tracing.Tracer:
+        return self._tracer or tracing.default_tracer()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "TelemetryServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # scrapes at 1-15s cadence would otherwise spam the log
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    server._route(self)
+                except BrokenPipeError:
+                    pass  # scraper hung up mid-response
+                except Exception as e:  # noqa: BLE001 - a scrape must
+                    # never kill the serving thread
+                    try:
+                        server._respond(self, 500, "text/plain",
+                                        f"internal error: {e!r}\n"
+                                        .encode())
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"telemetry[{self.process_name}]", daemon=True)
+        self._thread.start()
+        logger.info("Telemetry endpoints for %s on port %d "
+                    "(/metrics /healthz /flight /statusz).",
+                    self.process_name, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return 0
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        """``host:port`` as published under ``names.telemetry`` (the
+        advertised host is this box's routable IP, not the bind
+        wildcard)."""
+        from realhf_tpu.base import network
+        return f"{network.gethostip()}:{self.port}"
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+
+    # -- routing --------------------------------------------------------
+    def _respond(self, handler, code: int, content_type: str,
+                 body: bytes):
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _json(self, handler, payload: Dict, code: int = 200):
+        self._respond(handler, code, "application/json",
+                      (json.dumps(payload, default=str) + "\n")
+                      .encode())
+
+    def _route(self, handler):
+        path = handler.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._respond(handler, 200, PROM_CONTENT_TYPE,
+                          self.registry.to_prometheus().encode())
+        elif path == "/healthz":
+            health = self.health_snapshot()
+            state = str(health.get("state", "UNKNOWN"))
+            code = 200 if state in HEALTHY_STATES else 503
+            self._json(handler, health, code=code)
+        elif path == "/flight":
+            events = self.recorder.events()
+            self._json(handler, dict(worker=self.recorder.name,
+                                     n_events=len(events),
+                                     events=events))
+        elif path == "/statusz":
+            tracer = self.tracer
+            self._json(handler, dict(
+                process=self.process_name,
+                time=time.time(),
+                health=self.health_snapshot(),
+                trace=dict(enabled=tracer.enabled, path=tracer.path),
+                flight_events=len(self.recorder),
+                metrics=self.registry.snapshot()))
+        else:
+            self._respond(handler, 404, "text/plain",
+                          b"unknown path (have: /metrics /healthz "
+                          b"/flight /statusz)\n")
+
+    def health_snapshot(self) -> Dict:
+        if self._health is None:
+            return dict(state="RUNNING", process=self.process_name)
+        try:
+            return dict(self._health())
+        except Exception as e:  # noqa: BLE001 - provider bugs must
+            # surface as an unhealthy answer, not a dead endpoint
+            return dict(state="error", error=repr(e),
+                        process=self.process_name)
+
+
+# ----------------------------------------------------------------------
+# Process-default server (one per worker / inline runner).
+# ----------------------------------------------------------------------
+_default: Optional[TelemetryServer] = None
+
+
+def default_server() -> Optional[TelemetryServer]:
+    return _default
+
+
+def start_from_env(process_name: str,
+                   health: Optional[Callable[[], Dict]] = None
+                   ) -> Optional[TelemetryServer]:
+    """Start this process's telemetry endpoints per the env (module
+    doc): returns the running server, or None when opted out
+    (``REALHF_TPU_TELEMETRY=0``) or the bind failed. Never raises --
+    observability must not take a worker down."""
+    global _default
+    import os
+    if not telemetry_env_enabled():
+        return None
+    try:
+        port = int(os.environ.get(TELEMETRY_PORT_ENV, "0") or 0)
+        server = TelemetryServer(process_name, port=port,
+                                 health=health).start()
+    except Exception as e:  # noqa: BLE001
+        logger.warning("Telemetry endpoints disabled for %s: %s",
+                       process_name, e)
+        return None
+    _default = server
+    return server
+
+
+def stop_default():
+    global _default
+    server, _default = _default, None
+    if server is not None:
+        server.stop()
+
+
+# ----------------------------------------------------------------------
+# Prometheus text parsing (the consumer side of /metrics).
+# ----------------------------------------------------------------------
+def _parse_labels(body: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.index("=", i)
+        key = body[i:eq].strip().strip(",")
+        j = body.index('"', eq) + 1
+        val = []
+        while j < n and body[j] != '"':
+            if body[j] == "\\" and j + 1 < n:
+                j += 1
+            val.append(body[j])
+            j += 1
+        out[key] = "".join(val)
+        i = j + 1
+    return out
+
+
+def parse_prometheus_text(text: str
+                          ) -> Dict[str, List[Tuple[Dict[str, str],
+                                                    float]]]:
+    """Parse the exposition format into
+    ``name -> [(labels, value), ...]``. Histogram/summary series keep
+    their ``_bucket``/``_count``/``_sum`` suffixes as distinct names
+    (exactly how Prometheus stores them). Malformed lines are skipped
+    -- a half-written scrape must not fail the consumer."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labels_body, value_part = rest.rsplit("}", 1)
+                labels = _parse_labels(labels_body)
+            else:
+                name, value_part = line.split(None, 1)
+                labels = {}
+            value = float(value_part.split()[0])
+        except (ValueError, IndexError):
+            continue
+        out.setdefault(name.strip(), []).append((labels, value))
+    return out
+
+
+def prom_scalar(families: Dict[str, List[Tuple[Dict[str, str], float]]],
+                name: str, default: float = 0.0, *,
+                agg: str = "sum") -> float:
+    """One number for a family: ``sum`` across label sets (counters)
+    or ``last`` (single-series gauges)."""
+    series = families.get(name)
+    if not series:
+        return default
+    if agg == "last":
+        return series[-1][1]
+    return sum(v for _, v in series)
+
+
+def prom_histogram_quantile(
+        families: Dict[str, List[Tuple[Dict[str, str], float]]],
+        name: str, q: float) -> Optional[float]:
+    """``histogram_quantile(q, ...)`` over a scraped histogram family:
+    merges every ``{name}_bucket`` series (summing counts per ``le``)
+    and interpolates, i.e. the fleet-wide quantile estimate a real
+    Prometheus would compute."""
+    buckets = families.get(f"{name}_bucket")
+    if not buckets:
+        return None
+    by_le: Dict[float, float] = {}
+    for labels, value in buckets:
+        le = labels.get("le", "")
+        bound = float("inf") if le in ("+Inf", "inf") else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + value
+    pairs = sorted(by_le.items())
+    bounds = [b for b, _ in pairs if b != float("inf")]
+    cum = [c for _, c in pairs]
+    counts = [cum[0]] + [cum[i] - cum[i - 1]
+                         for i in range(1, len(cum))]
+    return metrics.quantile_from_buckets(bounds, counts, q)

@@ -222,6 +222,38 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0,
                    300.0, 1000.0)
 
 
+def quantile_from_buckets(buckets: Sequence[float],
+                          counts: Sequence[int], q: float,
+                          observed_max: Optional[float] = None
+                          ) -> Optional[float]:
+    """Prometheus-style ``histogram_quantile``: linear interpolation
+    inside the bucket the q-th observation falls into. ``counts`` is
+    per-bucket (NOT cumulative), with the trailing overflow bucket --
+    ``len(counts) == len(buckets) + 1``. A quantile landing in the
+    overflow bucket returns ``observed_max`` when known, else the last
+    finite bound (exactly Prometheus' behavior). None when empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    target = q * total
+    cum = 0.0
+    for i, le in enumerate(buckets):
+        prev_cum = cum
+        cum += counts[i]
+        if cum >= target:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            est = le if counts[i] == 0 \
+                else lo + (le - lo) * (target - prev_cum) / counts[i]
+            # interpolation can overshoot the largest observation
+            # (the within-bucket distribution is unknown); when the
+            # true max is known, no quantile can exceed it
+            return min(est, observed_max) \
+                if observed_max is not None else est
+    return observed_max if observed_max is not None \
+        else (buckets[-1] if buckets else None)
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -267,6 +299,34 @@ class Histogram(_Metric):
             out.append(f"{self.name}_sum{_prom_labels(key)} "
                        f"{accum[key]['sum']:g}")
         return out
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Estimated q-quantile for one label set (all observations
+        when ``labels`` is empty and only one set exists -- otherwise
+        the counts of every label set are merged)."""
+        with self._lock:
+            if labels:
+                counts = self._counts.get(_label_key(labels))
+                acc = self._accum.get(_label_key(labels))
+                if counts is None:
+                    return None
+                counts = list(counts)
+                observed_max = acc.max if acc and acc.count else None
+            else:
+                if not self._counts:
+                    return None
+                counts = [0] * (len(self.buckets) + 1)
+                observed_max = None
+                for k, v in self._counts.items():
+                    for i, c in enumerate(v):
+                        counts[i] += c
+                    acc = self._accum[k]
+                    if acc.count:
+                        observed_max = acc.max \
+                            if observed_max is None \
+                            else max(observed_max, acc.max)
+        return quantile_from_buckets(self.buckets, counts, q,
+                                     observed_max=observed_max)
 
     def snapshot_value(self):
         with self._lock:
@@ -323,6 +383,11 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float, **labels):
         self.summary(name).observe(value, **labels)
+
+    def observe_hist(self, name: str, value: float, **labels):
+        """Bucketed observation (quantile-capable; ``observe`` is the
+        count/sum/min/max summary)."""
+        self.histogram(name).observe(value, **labels)
 
     # -- exports ---------------------------------------------------------
     def snapshot(self) -> Dict[str, Dict]:
@@ -385,6 +450,19 @@ class MetricsRegistry:
                               process=self.process_name,
                               metrics=self.snapshot()))
 
+    def flush_final(self):
+        """Unconditional final snapshot (marked ``final``) for clean
+        exits: ``maybe_flush`` only fires on the interval, so a short
+        run -- the inline runner, quickstart, a worker exiting between
+        intervals -- would otherwise end with its last gauge values
+        never persisted. Cheap no-op without a JSONL sink."""
+        if self._jsonl_path is None:
+            return
+        self._last_snapshot = time.monotonic()
+        self._write_line(dict(ts=time.time(), kind="snapshot",
+                              final=True, process=self.process_name,
+                              metrics=self.snapshot()))
+
 
 # ----------------------------------------------------------------------
 # Module-level default registry + convenience API.
@@ -414,6 +492,10 @@ def observe(name: str, value: float, **labels):
     _default.observe(name, value, **labels)
 
 
+def observe_hist(name: str, value: float, **labels):
+    _default.observe_hist(name, value, **labels)
+
+
 def event(name: str, **fields) -> Dict:
     return _default.event(name, **fields)
 
@@ -428,6 +510,10 @@ def to_prometheus() -> str:
 
 def maybe_flush():
     _default.maybe_flush()
+
+
+def flush_final():
+    _default.flush_final()
 
 
 def metrics_file_path(process_name: str,

@@ -817,6 +817,10 @@ class FleetRouter:
                 else 0.2 * lat + 0.8 * self.latency_ewma_secs
             metrics.set_gauge("router_latency_ewma_secs",
                               self.latency_ewma_secs)
+            # bucketed companion: p50/p95 for stats()/the autoscale
+            # policy, and the histogram a Prometheus scrape of
+            # /metrics turns into histogram_quantile()
+            metrics.observe_hist("router_latency_seconds", lat)
         self._forward(req, kind, data)
         metrics.inc("router_terminals_total", kind=kind)
         self._done[req.rid] = kind
@@ -897,12 +901,19 @@ class FleetRouter:
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:
+        # quantiles from the router_latency_seconds histogram (None
+        # until the first completed request): the autoscale policy can
+        # key on tail latency instead of the EWMA
+        hist = metrics.default_registry().histogram(
+            "router_latency_seconds")
         return dict(
             self.stats_counters,
             pending=len(self._pending),
             inflight=len(self._requests),
             draining=self._draining,
             latency_ewma_secs=self.latency_ewma_secs,
+            latency_p50=hist.quantile(0.5),
+            latency_p95=hist.quantile(0.95),
             replicas={
                 name: dict(epoch=rep.epoch, lost=rep.lost,
                            retiring=rep.retiring,

@@ -351,6 +351,14 @@ class RolloutServer:
             data = ev.data
             if ev.kind == "done":
                 r = data["result"]
+                # replica-side end-to-end latency (queue wait +
+                # serve), bucketed so a /metrics scrape yields
+                # per-replica quantiles (docs/observability.md)
+                metrics.observe_hist(
+                    "serve_request_seconds",
+                    float(r.queued_secs or 0.0)
+                    + float(r.serve_secs or 0.0),
+                    server=self.server_name)
                 data = dict(tokens=r.tokens, logprobs=r.logprobs,
                             no_eos=r.no_eos,
                             weight_version=r.weight_version,

@@ -122,6 +122,20 @@ class GenServerWorker(worker_base.Worker):
             return self.rollout_server.stats()
         return super()._handle_command(cmd, kwargs)
 
+    def _health_extra(self) -> Dict:
+        """Serving fields for /healthz (obs/http.py): drain state
+        (flips the endpoint to 503/DRAINING the moment a drain
+        starts), the fleet lease's fencing epoch, weight version, and
+        load figures."""
+        rs = getattr(self, "rollout_server", None)
+        if rs is None:
+            return {}
+        return dict(draining=bool(rs._draining),
+                    fencing_epoch=rs.fencing_epoch,
+                    weight_version=rs.weight_sync.version,
+                    queue_depth=len(rs.queue),
+                    live_slots=rs.scheduler.n_live)
+
     def _preempt_hook(self, grace: float):
         """Drain-on-preempt (docs/serving.md "Shutdown"): on a
         preemption notice the server stops admitting, bounces queued
@@ -222,6 +236,21 @@ class RouterWorker(worker_base.Worker):
         if cmd == "probe":
             return dict(alive=self.router.probe(**(kwargs or {})))
         return super()._handle_command(cmd, kwargs)
+
+    def _health_extra(self) -> Dict:
+        router = getattr(self, "router", None)
+        if router is None:
+            return {}
+        replicas = router._replicas
+        return dict(draining=bool(router._draining),
+                    pending=len(router._pending),
+                    inflight=len(router._requests),
+                    replicas_live=sum(1 for r in replicas.values()
+                                      if not r.lost),
+                    replicas_healthy=sum(
+                        1 for r in replicas.values()
+                        if not r.lost and not r.retiring
+                        and r.breaker.allow()))
 
     def _preempt_hook(self, grace: float):
         budget = max(0.0, min(self._drain_timeout, grace * 0.8))

@@ -43,6 +43,20 @@ class InlineRunner:
         # span timeline the distributed runtime emits (one process)
         obs.configure_from_env("inline", experiment=spec.experiment_name,
                                trial=spec.trial_name)
+        # live telemetry endpoints, same surface as any worker
+        # (obs/http.py; REALHF_TPU_TELEMETRY=0 opts out)
+        from realhf_tpu.base import name_resolve, names
+        from realhf_tpu.obs import http as obs_http
+        self.telemetry = obs_http.start_from_env(
+            "inline", health=self._telemetry_health)
+        if self.telemetry is not None:
+            try:
+                name_resolve.add(
+                    names.telemetry(spec.experiment_name,
+                                    spec.trial_name, "inline"),
+                    self.telemetry.address, replace=True)
+            except Exception:  # noqa: BLE001 - discovery is advisory
+                pass
         seeding.set_random_seed(spec.seed)
 
         # Recovery (reference recover_mode resume, base/recover.py +
@@ -115,6 +129,10 @@ class InlineRunner:
             # skipping already prevents data re-consumption)
             dl = self._recover_info.dataloader_state or {}
             self._start_epoch_step = int(dl.get("epoch_step", 0))
+
+    def _telemetry_health(self):
+        return dict(worker="inline", state="RUNNING",
+                    global_step=self.global_step)
 
     # -- compat accessors (tests + callers use these) -------------------
     @property
@@ -256,4 +274,14 @@ class InlineRunner:
             if merged:
                 logger.info("Chrome trace written: %s (open in "
                             "Perfetto / chrome://tracing).", merged)
+                from realhf_tpu.obs import analyze
+                summary = analyze.summarize_path(merged)
+                if summary:
+                    logger.info("%s (full report: python "
+                                "scripts/analyze_trace.py %s)",
+                                summary, merged)
+        # final metrics snapshot: the poll-loop interval flush never
+        # runs here, so a short run would exit with buffered/last
+        # gauge values unpersisted
+        metrics.flush_final()
         return last_stats

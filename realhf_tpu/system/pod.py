@@ -222,7 +222,10 @@ def build_pod_manifest(experiment_name: str, trial_name: str, *,
 
 def scrape_targets(hosts: Sequence[HostSpec],
                    labels: Optional[Dict[str, str]] = None) -> List[Dict]:
-    """Prometheus ``file_sd_configs`` entries, one per host."""
+    """Prometheus ``file_sd_configs`` entries, one per host -- the
+    MANIFEST view (planned ports). Prefer
+    :func:`resolve_scrape_targets` for a running trial: workers
+    publish the ports they actually bound."""
     out = []
     for h in sorted(hosts, key=lambda h: h.host_id):
         lab = dict(host=h.host_id)
@@ -233,18 +236,59 @@ def scrape_targets(hosts: Sequence[HostSpec],
     return out
 
 
+def resolve_scrape_targets(experiment_name: str, trial_name: str,
+                           labels: Optional[Dict[str, str]] = None
+                           ) -> List[Dict]:
+    """LIVE per-worker Prometheus ``file_sd_configs`` entries resolved
+    from the telemetry registry: every worker's ``TelemetryServer``
+    (obs/http.py) publishes the ``host:port`` it actually bound under
+    ``names.telemetry``, so -- unlike the manifest's planned per-host
+    ports -- a GET against each target here reaches a process that
+    answers. Each entry carries a ``worker`` label (and ``host`` when
+    the worker published its host domain). Never raises; a worker
+    that vanished between listing and reading is skipped."""
+    root = names.telemetry_root(experiment_name, trial_name)
+    try:
+        keys = name_resolve.find_subtree(root) or []
+    except Exception:  # noqa: BLE001 - discovery is best effort
+        return []
+    out: List[Dict] = []
+    for key in sorted(keys):
+        worker = key[len(root):] if key.startswith(root) \
+            else key.rsplit("/telemetry/", 1)[-1]
+        try:
+            address = str(name_resolve.get(key))
+        except Exception:  # noqa: BLE001 - raced a departing worker
+            continue
+        lab = dict(worker=worker)
+        try:
+            lab["host"] = str(name_resolve.get(names.worker_host(
+                experiment_name, trial_name, worker)))
+        except Exception:  # noqa: BLE001 - single-host runs publish
+            # no host domain
+            pass
+        lab.update(labels or {})
+        out.append(dict(targets=[address],
+                        labels={k: lab[k] for k in sorted(lab)}))
+    return out
+
+
+def write_target_entries(entries: Sequence[Dict], path: str) -> str:
+    """Atomically write ``file_sd_configs`` entries to ``path``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(list(entries), f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
 def write_scrape_targets(hosts: Sequence[HostSpec], path: str,
                          labels: Optional[Dict[str, str]] = None) -> str:
     """Write the per-host scrape-target file (Prometheus file-based
     service discovery) so the obs stack deploys alongside the pod."""
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(scrape_targets(hosts, labels), f, indent=1,
-                  sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
-    return path
+    return write_target_entries(scrape_targets(hosts, labels), path)
 
 
 # ----------------------------------------------------------------------
@@ -483,16 +527,34 @@ class PodController:
                 for i, h in enumerate(self.hosts())]
 
     def write_scrape_targets(self, path: Optional[str] = None,
-                             labels: Optional[Dict[str, str]] = None
+                             labels: Optional[Dict[str, str]] = None,
+                             experiment_name: Optional[str] = None,
+                             trial_name: Optional[str] = None
                              ) -> Optional[str]:
-        """Per-host Prometheus scrape-target file under this run's obs
-        dir (default); never raises -- teardown must not mask the
-        trial's outcome."""
+        """Prometheus scrape-target file under this run's obs dir
+        (default). Targets come from the LIVE telemetry registry
+        (:func:`resolve_scrape_targets` -- per-worker ports real HTTP
+        servers bound, with ``worker``/``host`` labels) whenever any
+        worker has published one; only when the registry is empty
+        (pre-bring-up, or a teardown after every worker exited) does
+        it fall back to the manifest's planned per-host ports. Never
+        raises -- teardown must not mask the trial's outcome."""
         try:
             if path is None:
                 from realhf_tpu.base import constants
                 path = os.path.join(constants.run_log_path(), "obs",
                                     SCRAPE_TARGETS_NAME)
+            entries: List[Dict] = []
+            try:
+                from realhf_tpu.base import constants
+                exp = experiment_name or constants.experiment_name()
+                trial = trial_name or constants.trial_name()
+                entries = resolve_scrape_targets(exp, trial,
+                                                 labels=labels)
+            except Exception:  # noqa: BLE001 - run constants unset
+                entries = []
+            if entries:
+                return write_target_entries(entries, path)
             return write_scrape_targets(self.host_specs(), path,
                                         labels=labels)
         except Exception as e:  # noqa: BLE001 - teardown best effort

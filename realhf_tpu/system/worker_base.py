@@ -81,6 +81,9 @@ class WorkerServer:
         name_resolve.add(
             names.worker_key(experiment_name, trial_name, worker_name),
             f"tcp://{host}:{port}", replace=True)
+        #: last published status (the /healthz surface reads it
+        #: without a name_resolve round-trip)
+        self.status: Optional[WorkerServerStatus] = None
         # host failure domain (system/pod.py): a pod launch injects
         # REALHF_TPU_HOST_ID per host; republish it so the master-side
         # watchdog can attribute whole-host losses as ONE HOST_LOST
@@ -135,10 +138,17 @@ class WorkerServer:
         swallowed (the next beat retries)."""
         self._beat_hooks.append(fn)
 
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last beat this process published (the
+        /healthz liveness figure; None before the first beat)."""
+        last = getattr(self, "_last_beat_at", None)
+        return None if last is None else time.monotonic() - last
+
     def beat(self):
         """Publish one heartbeat: ``"<wall-ts>:<boot-id>"`` (wall
         clock, not monotonic: the watchdog lives in another process;
         the boot id fences incarnations)."""
+        self._last_beat_at = time.monotonic()
         try:
             name_resolve.add(
                 self._hb_key, f"{time.time():.3f}:{self.boot_id}",
@@ -186,6 +196,7 @@ class WorkerServer:
                            self.worker_name, e)
 
     def set_status(self, status: WorkerServerStatus):
+        self.status = status
         name_resolve.add(
             names.worker_status(self._exp, self._trial, self.worker_name),
             status.value, replace=True, delete_on_exit=False)
@@ -295,6 +306,25 @@ class Worker:
         self._preempt_deadline: Optional[float] = None
         self._preempt_grace: Optional[float] = None
         self._preempt_hook_ran = False
+        # live HTTP telemetry endpoints (obs/http.py): /metrics,
+        # /healthz, /flight, /statusz on an ephemeral port, published
+        # under names.telemetry so the pod controller resolves real
+        # per-worker Prometheus scrape targets (started LAST: the
+        # health provider reads the state initialized above). Opt-out:
+        # REALHF_TPU_TELEMETRY=0. Never fatal.
+        from realhf_tpu.obs import http as obs_http
+        self.telemetry = obs_http.start_from_env(
+            worker_name, health=self._telemetry_health)
+        if self.telemetry is not None:
+            try:
+                name_resolve.add(
+                    names.telemetry(experiment_name, trial_name,
+                                    worker_name),
+                    self.telemetry.address, replace=True)
+            except Exception as e:  # noqa: BLE001 - scrape discovery
+                # is advisory; the endpoints still answer directly
+                logger.warning("Telemetry publish failed for %s: %s",
+                               worker_name, e)
 
     # -- subclass API ---------------------------------------------------
     def _configure(self, config: Any):
@@ -312,6 +342,37 @@ class Worker:
         poll loop (never the signal handler) with ``grace`` seconds
         left: model workers emergency-save a durable checkpoint,
         serving workers drain (docs/serving.md)."""
+
+    def _health_extra(self) -> Dict:
+        """Subclass hook: extra /healthz fields. A truthy
+        ``draining`` key flips the reported state to DRAINING (-> HTTP
+        503) while the worker is otherwise RUNNING, so probers stop
+        sending traffic the moment a serving drain starts."""
+        return {}
+
+    def _telemetry_health(self) -> Dict:
+        """The /healthz payload (obs/http.py): worker status,
+        heartbeat age, incarnation/host identity, plus whatever the
+        subclass adds (lease/epoch state for serving workers)."""
+        status = self.server.status
+        state = status.value if status is not None else "UNKNOWN"
+        if self.preempted:
+            state = WorkerServerStatus.PREEMPTED.value
+        try:
+            extra = dict(self._health_extra() or {})
+        except Exception as e:  # noqa: BLE001 - a subclass bug must
+            # degrade the answer, not kill the endpoint
+            extra = dict(health_extra_error=repr(e))
+        if extra.pop("draining", False) and state == "RUNNING":
+            state = "DRAINING"
+        return dict(
+            worker=self.worker_name, state=state,
+            status=status.value if status is not None else None,
+            running=self._running,
+            preempted=self.preempted,
+            heartbeat_age_secs=self.server.heartbeat_age(),
+            boot_id=self.server.boot_id,
+            host_id=self.server.host_id, **extra)
 
     # -- preemption -----------------------------------------------------
     @property
@@ -471,10 +532,16 @@ class Worker:
                 tracing.flush()
             self._exit_hook()
             tracing.flush()
+            # final snapshot: maybe_flush is interval-gated, so a
+            # short-lived worker would exit with its last gauge
+            # values never persisted
+            metrics.flush_final()
             self.server.stop_heartbeat()
             self.server.set_status(
                 WorkerServerStatus.PREEMPTED if self.preempted
                 else WorkerServerStatus.COMPLETED)
+            if self.telemetry is not None:
+                self.telemetry.stop()
         except Exception as e:
             # terminal status (not the beacon) is the liveness signal
             # from here on; the watchdog treats ERROR/COMPLETED as
@@ -482,6 +549,7 @@ class Worker:
             # FIRST: the ring of recent events is the postmortem.
             flight.dump(reason=f"worker ERROR exit: {e!r}")
             tracing.flush()
+            metrics.flush_final()
             self.server.stop_heartbeat()
             self.server.set_status(WorkerServerStatus.ERROR)
             raise

@@ -47,6 +47,7 @@ REQUIRED_DIRS = (
     "tests/search",
     "tests/serving",
     "tests/system",
+    "tests/telemetry",
 )
 
 #: the committed graft-lint baseline; its presence marks a tree where

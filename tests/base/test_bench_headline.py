@@ -60,6 +60,8 @@ def test_headline_only_prints_and_skips_nonheadline_phases(
                         forbidden("async"))
     monkeypatch.setattr(bench_mod, "_bench_agentic",
                         forbidden("agentic"))
+    monkeypatch.setattr(bench_mod, "_bench_trace_report",
+                        forbidden("trace_report"))
     monkeypatch.setattr(sys, "argv", ["bench.py", "--headline-only"])
     bench_mod.main()
     assert ran == []
@@ -107,6 +109,9 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
                         spy("async", ret={"async_speedup": 1.1}))
     monkeypatch.setattr(bench_mod, "_bench_agentic",
                         spy("agentic", ret={"serving": {}}))
+    monkeypatch.setattr(bench_mod, "_bench_trace_report",
+                        spy("trace_report",
+                            ret={"n_steps": 2, "goodput": 0.8}))
     monkeypatch.setattr(
         bench_mod, "_reshard_metrics",
         spy("reshard",
@@ -124,18 +129,21 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
     assert seen_phases["serving"][-1] == "pipeline_schedules"
     assert seen_phases["async"][-1] == "serving_bench"
     assert seen_phases["agentic"][-1] == "async_bench"
-    assert seen_phases["reshard"][-1] == "agentic_bench"
+    assert seen_phases["trace_report"][-1] == "agentic_bench"
+    assert seen_phases["reshard"][-1] == "trace_report"
     assert seen_phases["sft"][-1] == "reshard"
 
     final = _read_payload()
     assert final["phases_done"] == [
         "ppo_headline", "kernel_disposition", "pipeline_schedules",
-        "serving_bench", "async_bench", "agentic_bench", "reshard",
-        "sft", "overhead_probe"]
+        "serving_bench", "async_bench", "agentic_bench",
+        "trace_report", "reshard", "sft", "overhead_probe"]
     assert final["extra"]["pipeline_schedule_bench"] == {"stages": 4}
     assert final["extra"]["serving_bench"] == {"shared": {}}
     assert final["extra"]["async_bench"] == {"async_speedup": 1.1}
     assert final["extra"]["agentic_bench"] == {"serving": {}}
+    assert final["extra"]["trace_report"] == {"n_steps": 2,
+                                              "goodput": 0.8}
     assert final["extra"]["sft_mfu"] == 0.5
     # final stdout line is the full headline record
     out_lines = [l for l in capsys.readouterr().out.splitlines()
@@ -161,6 +169,9 @@ def test_nonheadline_phase_failure_never_voids_headline(
                         lambda: {"async_speedup": 1.0})
     monkeypatch.setattr(bench_mod, "_bench_agentic",
                         lambda: {"serving": {}})
+    # the trace_report phase honors the same property: its failure
+    # degrades to an error note, never voids the headline
+    monkeypatch.setattr(bench_mod, "_bench_trace_report", boom)
     monkeypatch.setattr(bench_mod, "bench_sft",
                         lambda on_tpu: {"sft_mfu": 0.5})
     monkeypatch.setattr(bench_mod, "_reshard_metrics",
@@ -169,6 +180,7 @@ def test_nonheadline_phase_failure_never_voids_headline(
     bench_mod.main()
     payload = _read_payload()
     assert "error" in payload["extra"]["pipeline_schedule_bench"]
+    assert "error" in payload["extra"]["trace_report"]
     assert payload["phases_done"][-1] == "overhead_probe"
 
 

@@ -302,3 +302,20 @@ def test_quickstart_ppo_trace_e2e(tmp_path, monkeypatch):
     assert doc["n_events"] >= 10
     kinds = {e["kind"] for e in doc["events"]}
     assert "fault" in kinds and "request" in kinds
+
+    # --- trace analytics on the real merged trace (ISSUE 13) ---------
+    # the analyzer reconstructs the steps, the attribution components
+    # sum to each step's wall, and a critical-path MFC is named
+    from realhf_tpu.obs import analyze
+    report = analyze.analyze_path(merged)
+    assert report["n_steps"] >= 2
+    for step in report["steps"]:
+        assert sum(step["attribution"].values()) == pytest.approx(
+            step["wall_secs"], abs=1e-6)
+        assert step["attribution"]["compute"] > 0
+    assert report["bottleneck_mfc"] is not None
+    assert 0 < report["goodput"] <= 1.0
+    assert report["stragglers"], report
+    # the same report renders as the teardown one-liner
+    assert analyze.one_line_summary(report).startswith(
+        "trace report: ")

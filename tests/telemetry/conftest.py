@@ -1,0 +1,20 @@
+"""Telemetry suite fixtures: fresh process-default obs singletons per
+test (tracer / metrics registry / flight recorder / telemetry HTTP
+server) -- the telemetry plane is process-global by design, so state
+must never leak between tests."""
+
+import pytest
+
+from realhf_tpu.obs import flight, http, metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_defaults():
+    tracing.reset_default()
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+    http.stop_default()
+    tracing.reset_default()
+    metrics.reset_default()
+    flight.reset_default()

@@ -1,0 +1,193 @@
+"""Tier-1 coverage for the live HTTP telemetry plane (obs/http.py):
+all four endpoints on an ephemeral port, content types, the
+healthz drain flip, concurrent scrapes, the Prometheus text
+parser round-trip, and the bucket->quantile estimator. All
+single-process and sub-second -- the multi-process fleet scrape is
+the slow-marked e2e in test_scrape_e2e.py."""
+
+import json
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from realhf_tpu.obs import flight, http, metrics
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+@pytest.fixture()
+def server():
+    state = {"state": "RUNNING", "worker": "tw/0",
+             "heartbeat_age_secs": 0.1}
+    srv = http.TelemetryServer("tw/0", health=lambda: dict(state))
+    srv.start()
+    yield srv, state
+    srv.stop()
+
+
+def test_metrics_endpoint_serves_prometheus_text(server):
+    srv, _ = server
+    metrics.inc("demo_requests_total", route="a")
+    metrics.set_gauge("demo_queue_depth", 7)
+    metrics.observe_hist("demo_latency_seconds", 0.2)
+    code, headers, body = _get(srv.port, "/metrics")
+    assert code == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "version=0.0.4" in headers["Content-Type"]
+    assert 'demo_requests_total{route="a"} 1' in body
+    assert "demo_queue_depth 7" in body
+    assert "demo_latency_seconds_bucket" in body
+    assert "# TYPE demo_latency_seconds histogram" in body
+
+
+def test_healthz_flips_state_on_drain(server):
+    srv, state = server
+    code, headers, body = _get(srv.port, "/healthz")
+    assert code == 200
+    assert headers["Content-Type"].startswith("application/json")
+    doc = json.loads(body)
+    assert doc["state"] == "RUNNING" and doc["worker"] == "tw/0"
+    # the drain flip: a non-healthy state answers 503 so probing LBs
+    # stop routing the moment a drain starts
+    state["state"] = "DRAINING"
+    code, _, body = _get(srv.port, "/healthz")
+    assert code == 503
+    assert json.loads(body)["state"] == "DRAINING"
+    # a broken provider degrades to an unhealthy answer, not a crash
+    srv._health = lambda: 1 / 0
+    code, _, body = _get(srv.port, "/healthz")
+    assert code == 503
+    assert json.loads(body)["state"] == "error"
+
+
+def test_flight_and_statusz(server):
+    srv, _ = server
+    flight.record("request", handle="train_step")
+    flight.record("reply", handle="train_step")
+    code, _, body = _get(srv.port, "/flight")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["n_events"] == 2
+    assert doc["events"][0]["kind"] == "request"
+
+    metrics.inc("demo_requests_total")
+    code, _, body = _get(srv.port, "/statusz")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["process"] == "tw/0"
+    assert doc["flight_events"] == 2
+    assert doc["trace"]["enabled"] is False
+    assert "demo_requests_total" in doc["metrics"]
+    assert doc["health"]["state"] == "RUNNING"
+
+
+def test_unknown_path_is_404(server):
+    srv, _ = server
+    code, _, _ = _get(srv.port, "/nope")
+    assert code == 404
+
+
+def test_concurrent_scrapes(server):
+    srv, _ = server
+    metrics.inc("demo_requests_total")
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        results = list(ex.map(
+            lambda _: _get(srv.port, "/metrics"), range(16)))
+    assert all(code == 200 for code, _, _ in results)
+    assert all("demo_requests_total 1" in body
+               for _, _, body in results)
+
+
+def test_parse_prometheus_roundtrip():
+    metrics.inc("rt_requests_total", route="a", code="200")
+    metrics.inc("rt_requests_total", 2, route="b", code="500")
+    metrics.set_gauge("rt_depth", 3.5)
+    metrics.observe_hist("rt_latency_seconds", 0.01)
+    metrics.observe_hist("rt_latency_seconds", 0.3)
+    fams = http.parse_prometheus_text(metrics.to_prometheus())
+    assert http.prom_scalar(fams, "rt_requests_total") == 3
+    assert http.prom_scalar(fams, "rt_depth", agg="last") == 3.5
+    series = dict()
+    for labels, value in fams["rt_requests_total"]:
+        series[(labels["route"], labels["code"])] = value
+    assert series == {("a", "200"): 1.0, ("b", "500"): 2.0}
+    # histogram family: bucket counts survive, quantile computable
+    q95 = http.prom_histogram_quantile(fams, "rt_latency_seconds",
+                                       0.95)
+    assert q95 is not None and 0.01 < q95 <= 0.5
+    # unknowns and garbage degrade, never raise
+    assert http.prom_scalar(fams, "missing", default=-1) == -1
+    assert http.parse_prometheus_text("garbage {{{\n# ok\n") == {}
+
+
+def test_quantile_from_buckets():
+    # 3 observations, one per finite bucket
+    assert metrics.quantile_from_buckets(
+        [1.0, 2.0, 4.0], [1, 1, 1, 0], 0.5) == pytest.approx(1.5)
+    assert metrics.quantile_from_buckets(
+        [1.0, 2.0, 4.0], [1, 1, 1, 0], 1.0) == pytest.approx(4.0)
+    # overflow bucket: the observed max wins when known
+    assert metrics.quantile_from_buckets(
+        [1.0], [0, 3], 0.9, observed_max=7.5) == pytest.approx(7.5)
+    assert metrics.quantile_from_buckets([1.0], [0, 0], 0.5) is None
+    # Histogram.quantile end-to-end
+    h = metrics.default_registry().histogram("q_seconds")
+    for v in (0.02, 0.02, 0.3, 0.3):
+        h.observe(v)
+    q50 = h.quantile(0.5)
+    assert 0.005 < q50 <= 0.1
+    assert h.quantile(0.99) <= 0.5
+    assert metrics.default_registry().histogram("empty_h") \
+        .quantile(0.5) is None
+
+
+def test_start_from_env_opt_out(monkeypatch):
+    monkeypatch.setenv(http.TELEMETRY_ENV, "0")
+    assert http.start_from_env("tw/1") is None
+    monkeypatch.setenv(http.TELEMETRY_ENV, "1")
+    srv = http.start_from_env("tw/1")
+    try:
+        assert srv is not None and srv.port > 0
+        assert http.default_server() is srv
+        code, _, _ = _get(srv.port, "/healthz")
+        assert code == 200  # default provider reports RUNNING
+    finally:
+        http.stop_default()
+
+
+def test_worker_publishes_telemetry_and_healthz_tracks_status():
+    """The worker_base wiring: constructing a Worker starts the
+    telemetry endpoints and publishes host:port under
+    names.telemetry; /healthz mirrors the worker's published status
+    and flips to 503 on preemption (the drain path)."""
+    from realhf_tpu.base import name_resolve, names
+    from realhf_tpu.system.worker_base import Worker
+
+    w = Worker("texp", "t0", "tw/2")
+    try:
+        assert w.telemetry is not None
+        addr = name_resolve.get(names.telemetry("texp", "t0", "tw/2"))
+        assert addr.endswith(f":{w.telemetry.port}")
+        code, _, body = _get(w.telemetry.port, "/healthz")
+        doc = json.loads(body)
+        assert code == 200 and doc["state"] == "READY"
+        assert doc["boot_id"] == w.server.boot_id
+        assert doc["heartbeat_age_secs"] is not None
+        # preemption (the drain entry point) flips the endpoint
+        w.notice_preemption(grace=30.0, reason="test")
+        code, _, body = _get(w.telemetry.port, "/healthz")
+        assert code == 503
+        assert json.loads(body)["state"] == "PREEMPTED"
+    finally:
+        w.server.stop_heartbeat()
+        if w.telemetry is not None:
+            w.telemetry.stop()
