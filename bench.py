@@ -790,6 +790,27 @@ def _bench_serving_hotpath():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def _bench_kv_pool():
+    """Paged-KV memory bench in a CPU-forced subprocess
+    (scripts/bench_serving.py --kv-pool): max concurrent sequences
+    and KV-bytes-per-live-slot under ONE fixed byte budget, dense
+    windows vs the block-granular pool, fp32 vs int8 (ISSUE 14
+    acceptance: >= 2x concurrency, >= 1.8x bytes/token)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("REALHF_TPU_FORCE_PALLAS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "bench_serving.py")
+    r = subprocess.run(
+        [sys.executable, script, "--kv-pool"],
+        env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench_serving --kv-pool exited {r.returncode}: "
+            f"{r.stderr[-500:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])["kv_pool"]
+
+
 def _bench_trace_report():
     """Trace-driven step-time attribution (ISSUE 13) in a CPU-forced
     subprocess (scripts/analyze_trace.py --demo): a tiny traced
@@ -909,6 +930,16 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort phase
         extra["serving_bench"] = {"error": repr(e)}
     phases_done.append("serving_bench")
+    _flush_payload(headline, extra, phases_done)
+
+    # Paged KV pool (ISSUE 14): decode-memory lever of ROADMAP #4 --
+    # concurrency under a fixed KV byte budget (paged vs dense) and
+    # int8 bytes-per-token, measured at the allocator.
+    try:
+        extra["kv_pool_bench"] = _bench_kv_pool()
+    except Exception as e:  # noqa: BLE001 - best-effort phase
+        extra["kv_pool_bench"] = {"error": repr(e)}
+    phases_done.append("kv_pool_bench")
     _flush_payload(headline, extra, phases_done)
 
     # Async RLHF overlap (ISSUE 10): generation streaming into the

@@ -177,6 +177,25 @@ class ServingSpec:
     gconfig: dict = dataclasses.field(default_factory=dict)
     #: send incremental token deltas after every decode chunk
     stream_tokens: bool = True
+    # -- paged KV pool (docs/perf.md "Paged KV & quantization"):
+    # replace the dense per-slot [cache_len] KV windows with one
+    # block-granular device pool (engine/kv_pool.py) shared with the
+    # radix prefix cache. Decode memory then tracks ACTUAL tokens, so
+    # concurrency is bounded by blocks, not worst-case windows, and
+    # admission backpressure rides pool free blocks.
+    paged_kv: bool = False
+    #: KV storage dtype: None = the model's compute dtype (dense
+    #: semantics); "fp32"/"bf16" set the storage dtype; "int8" stores
+    #: quantized rows + per-row scales (requires/implies paged_kv --
+    #: dequant-on-read lives in the pool gather path).
+    kv_cache_dtype: Optional[str] = None
+    #: tokens per pool block (the allocation granule; internal
+    #: fragmentation is < 1 block per sequence)
+    kv_block_len: int = 16
+    #: total pool blocks; None sizes the pool at dense parity
+    #: (n_slots * ceil(cache_len / kv_block_len)) -- shrink it to
+    #: trade worst-case headroom for more decode slots per byte
+    kv_pool_blocks: Optional[int] = None
     # -- serving hot path (docs/serving.md "Prefix cache &
     # speculative decoding") --------------------------------------
     #: byte budget for the radix prefix/KV cache (host memory):

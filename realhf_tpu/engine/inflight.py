@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from realhf_tpu.base import logging
+from realhf_tpu.engine import kv_pool as _kvp
 from realhf_tpu.models import transformer as T
 from realhf_tpu.models.config import TransformerConfig
 from realhf_tpu.obs import tracing
@@ -73,6 +74,13 @@ class FinishedSequence:
     #: export_kv=True)`` -- the serving scheduler publishes them into
     #: the radix prefix cache (serving/prefix_cache.py)
     kv: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    #: paged backends (``harvest(export_blocks=True)``): the KV pool
+    #: blocks holding this sequence's rows, each carrying ONE extra
+    #: pool reference owned by the receiver -- publish them into the
+    #: pooled prefix cache (which increfs what it keeps), then
+    #: ``pool.free(blocks)``. ``n_rows`` = valid token rows covered.
+    blocks: Optional[Tuple[int, ...]] = None
+    n_rows: int = 0
 
 
 class InflightBatchingGenerator:
@@ -88,7 +96,9 @@ class InflightBatchingGenerator:
                  eos_token_id: Optional[int], pad_token_id: int,
                  chunk_size: int = 32, moe_constraint=None,
                  mesh=None, attention_fn=None,
-                 spec_decode_k: int = 0, drafter=None):
+                 spec_decode_k: int = 0, drafter=None,
+                 kv_pool=None, kv_cache_dtype: Optional[str] = None,
+                 bucket_pair_cap: int = 24):
         if not gconfig.force_no_logits_mask:
             raise ValueError(
                 "inflight batching does not produce the PPO logits "
@@ -103,6 +113,53 @@ class InflightBatchingGenerator:
         self.chunk = chunk_size
         self.cache_len = T.round_cache_len(
             max_prompt_len + gconfig.max_new_tokens)
+        # ---- KV substrate: dense per-slot windows (default) or the
+        # block-granular paged pool (engine/kv_pool.py) --------------
+        self.kv_pool = kv_pool
+        if kv_cache_dtype is not None \
+                and kv_cache_dtype not in _kvp.KV_CACHE_DTYPES:
+            raise ValueError(
+                f"kv_cache_dtype must be one of {_kvp.KV_CACHE_DTYPES}")
+        if kv_cache_dtype == "int8" and kv_pool is None:
+            raise ValueError(
+                "kv_cache_dtype='int8' requires a paged KV pool "
+                "(dequant-on-read lives in the pool gather path); "
+                "pass kv_pool=KVPool(..., dtype='int8').")
+        if kv_pool is not None:
+            if kv_pool.cfg is None:
+                raise ValueError("paged decoding needs a device-"
+                                 "backed KVPool (not host_only)")
+            self._blen = kv_pool.block_len
+            self._max_blocks = -(-self.cache_len // self._blen)
+            self._slot_blocks: List[List[int]] = [
+                [] for _ in range(n_slots)]
+            self._bt_host = np.zeros((n_slots, self._max_blocks),
+                                     np.int32)
+            self._bt_dev = None  # refreshed lazily on table changes
+            #: upper bound of window rows a slot may have written
+            #: (exact at fill/spec-round/harvest, +chunk per plain
+            #: decode chunk) -- capacity reservation never needs a
+            #: blocking device readback
+            self._slot_rows_ub = [0] * n_slots
+            self._slot_prompt_n = [0] * n_slots
+            self._paged_fill_jit = jax.jit(functools.partial(
+                _paged_prefill, cfg, moe_constraint, attention_fn,
+                kv_pool.meta))
+            self._paged_suffix_jit = jax.jit(functools.partial(
+                _paged_prefill_suffix, cfg, moe_constraint,
+                kv_pool.meta))
+            self._paged_decode_jit = jax.jit(functools.partial(
+                _paged_decode_chunk, cfg, gconfig, eos_token_id,
+                pad_token_id, chunk_size, moe_constraint, mesh,
+                kv_pool.meta))
+            self._paged_verify_jit = None  # built with spec below
+        #: distinct (donor, suffix) bucket pairs the partial-prefill
+        #: path has compiled; capped (satellite: the (c_b, s_b)
+        #: ladder product is 81 pairs -- silent unbounded jit-cache
+        #: growth without this)
+        self.bucket_pair_cap = int(bucket_pair_cap)
+        self._bucket_pairs = set()
+        self._bucket_cap_warned = False
         # jax.jit retraces per prompt-bucket shape on its own; one
         # jitted function covers every bucket.
         self._prefill = jax.jit(functools.partial(
@@ -136,10 +193,25 @@ class InflightBatchingGenerator:
             self._verify = jax.jit(functools.partial(
                 _verify_chunk, cfg, gconfig, eos_token_id,
                 self._spec_k, moe_constraint))
+            if self.kv_pool is not None:
+                self._paged_verify_jit = jax.jit(functools.partial(
+                    _paged_verify, cfg, gconfig, eos_token_id,
+                    self._spec_k, moe_constraint, self.kv_pool.meta))
 
         nm = gconfig.max_new_tokens
+        if self.kv_pool is not None:
+            # paged: the pool owns the KV rows; per-slot state keeps
+            # only the write index ("length" in window coordinates --
+            # compacted, so row j holds token j and validity is just
+            # j < length)
+            kv_state = dict(length=jnp.zeros((n_slots,), jnp.int32))
+        else:
+            dense_dt = {None: None, "fp32": jnp.float32,
+                        "bf16": jnp.bfloat16}[kv_cache_dtype]
+            kv_state = dict(cache=T.init_kv_cache(
+                cfg, n_slots, self.cache_len, dtype=dense_dt))
         self.state = dict(
-            cache=T.init_kv_cache(cfg, n_slots, self.cache_len),
+            **kv_state,
             last_hidden=jnp.zeros((n_slots, cfg.hidden_dim),
                                   jnp.dtype(cfg.compute_dtype)),
             prompt_len=jnp.zeros((n_slots,), jnp.int32),
@@ -161,7 +233,8 @@ class InflightBatchingGenerator:
         #: surface: a 95%-cached prompt must compile/pay the SUFFIX
         #: bucket, not the full-prompt one)
         self.last_fill: Dict = {}
-        self.fill_stats = dict(prefill_tokens=0, prefill_tokens_saved=0)
+        self.fill_stats = dict(prefill_tokens=0, prefill_tokens_saved=0,
+                               bucket_pairs=0, bucket_pairs_capped=0)
         self.spec_stats = dict(rounds=0)
 
         self._decode_chunk = jax.jit(functools.partial(
@@ -191,12 +264,94 @@ class InflightBatchingGenerator:
         (greedy only) the chunk runs speculative verify rounds
         instead: each round drafts k tokens per slot on the host
         (prompt lookup) and verifies them in ONE forward, emitting
-        1..k+1 tokens per live slot per device call."""
+        1..k+1 tokens per live slot per device call.
+
+        Paged backends reserve pool blocks for the chunk's worst-case
+        growth FIRST (host arithmetic, no device sync) and may raise
+        :class:`~realhf_tpu.engine.kv_pool.KVPoolOOM` -- the serving
+        scheduler relieves pool pressure (prefix-cache eviction, then
+        sequence eviction) and retries."""
         if self._spec_k > 0 and self.n_live:
             self._spec_chunk()
+        elif self.kv_pool is not None:
+            self._paged_chunk(key)
         else:
             self.state = self._decode_chunk(self.params, self.state,
                                             key)
+
+    # -- paged-mode internals (engine/kv_pool.py) ----------------------
+    def _win_for(self, need: int) -> int:
+        """Gather-window length for the paged compute path: the
+        maximum live length rounded up on the cache-row multiple, so
+        the chunk compiles O(cache_len / 128) window shapes -- one
+        per bucket, as the dense path does -- instead of one per
+        distinct length."""
+        if need <= 0:
+            return 0
+        m = T._CACHE_LEN_MULTIPLE
+        return min(self.cache_len, -(-need // m) * m)
+
+    def _bt_device(self):
+        if self._bt_dev is None:
+            self._bt_dev = jax.device_put(self._bt_host)
+        return self._bt_dev
+
+    def _ensure_capacity(self, growth: int) -> int:
+        """Reserve pool blocks so every live slot can append up to
+        ``growth`` rows without a mid-chunk allocation (block tables
+        are frozen inside jit). Raises :class:`KVPoolOOM` on
+        exhaustion; earlier slots keep their new reservations (they
+        are real and freed at harvest). Returns the gather-window
+        length covering the post-chunk worst case."""
+        nm = self.g.max_new_tokens
+        need_max = 0
+        for slot in range(self.n_slots):
+            if self._slot_req[slot] < 0:
+                continue
+            n = self._slot_prompt_n[slot]
+            cap_rows = min(self._slot_rows_ub[slot] + growth,
+                           n + nm, self.cache_len)
+            have = len(self._slot_blocks[slot])
+            need = self.kv_pool.blocks_for_rows(cap_rows) - have
+            if need > 0:
+                new = self.kv_pool.alloc(need)  # may raise KVPoolOOM
+                self._slot_blocks[slot].extend(new)
+                self._bt_host[slot, have:have + len(new)] = new
+                self._bt_dev = None
+            self._slot_rows_ub[slot] = cap_rows
+            need_max = max(need_max, cap_rows)
+        return self._win_for(need_max)
+
+    def _paged_chunk(self, key):
+        win = self._ensure_capacity(self.chunk)
+        if win == 0:
+            return
+        warange = jnp.arange(win, dtype=jnp.int32)
+        arrays, self.state = self._paged_decode_jit(
+            self.params, self.kv_pool.arrays(), self.state,
+            self._bt_device(), warange, key)
+        self.kv_pool.update(arrays)
+
+    def kv_pool_stats(self) -> Dict:
+        """Pool accounting plus this generator's own row usage; the
+        serving scheduler adds the prefix cache's rows on top to get
+        the pool-wide fragmentation ratio."""
+        s = self.kv_pool.stats()
+        s["rows_in_use"] = sum(
+            self._slot_rows_ub[i] for i in range(self.n_slots)
+            if self._slot_req[i] >= 0)
+        return s
+
+    def admission_blocks_needed(self, prompt_len: int,
+                                cached_len: int = 0) -> int:
+        """Free-list blocks a fill of this shape will consume
+        (aliased prefix blocks are shared, not allocated), plus one
+        headroom block for the first decode chunk. The scheduler
+        admission gate compares this against the pool's free count."""
+        c = max(0, min(int(cached_len), int(prompt_len) - 1))
+        c -= c % self._blen
+        return (self.kv_pool.blocks_for_rows(prompt_len)
+                - c // self._blen + 1)
 
     def _spec_chunk(self):
         """ceil(chunk / (k+1)) verify rounds == the plain chunk's
@@ -229,8 +384,25 @@ class InflightBatchingGenerator:
                 break
             with tracing.span("serve:spec_verify", n_live=n_live,
                               k=self._spec_k):
-                self.state = self._verify(self.params, self.state,
-                                          jnp.asarray(drafts))
+                if self.kv_pool is not None:
+                    # the per-round host view gives EXACT lengths --
+                    # tighten the row upper bounds before reserving
+                    # this round's worst-case growth (k+1 rows/slot)
+                    for slot in range(self.n_slots):
+                        if self._slot_req[slot] >= 0:
+                            self._slot_rows_ub[slot] = (
+                                self._slot_prompt_n[slot]
+                                + int(host["emitted"][slot]))
+                    win = self._ensure_capacity(self._spec_k + 1)
+                    warange = jnp.arange(win, dtype=jnp.int32)
+                    arrays, self.state = self._paged_verify_jit(
+                        self.params, self.kv_pool.arrays(),
+                        self.state, self._bt_device(), warange,
+                        jnp.asarray(drafts))
+                    self.kv_pool.update(arrays)
+                else:
+                    self.state = self._verify(self.params, self.state,
+                                              jnp.asarray(drafts))
             self.spec_stats["rounds"] += 1
 
     def swap_params(self, params):
@@ -243,9 +415,17 @@ class InflightBatchingGenerator:
     def release_slot(self, slot: int):
         """Abort the sequence in ``slot`` (cancellation/eviction): the
         slot immediately becomes free and the partial output is
-        dropped."""
+        dropped. Paged backends return the slot's pool blocks to the
+        free list (aliased prefix blocks just drop one reference)."""
         self._slot_req[slot] = -1
         self._slot_prompt[slot] = None
+        if self.kv_pool is not None and self._slot_blocks[slot]:
+            self.kv_pool.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._bt_host[slot, :] = 0
+            self._bt_dev = None
+            self._slot_rows_ub[slot] = 0
+            self._slot_prompt_n[slot] = 0
         self.state["active"] = self.state["active"].at[slot].set(False)
 
     def _host_view(self) -> Dict[str, np.ndarray]:
@@ -285,7 +465,8 @@ class InflightBatchingGenerator:
                          host["out_logprobs"][slot, :n])
         return out
 
-    def harvest(self, export_kv: bool = False) -> List[FinishedSequence]:
+    def harvest(self, export_kv: bool = False,
+                export_blocks: bool = False) -> List[FinishedSequence]:
         """Collect every finished sequence and free its slot (one
         bundled host transfer, not four per finished slot).
 
@@ -294,7 +475,14 @@ class InflightBatchingGenerator:
         fetch and attaches them as ``FinishedSequence.kv`` so the
         serving scheduler can publish them into the radix prefix
         cache. This is a full slot-cache D2H -- only ask for it when a
-        prefix cache is actually configured."""
+        prefix cache is actually configured.
+
+        ``export_blocks=True`` (paged backends only) attaches each
+        finished slot's pool block ids instead -- ZERO device
+        transfer: publication into the pooled prefix cache is pure
+        refcount bookkeeping. Each listed block carries one extra
+        pool reference owned by the caller, who must
+        ``kv_pool.free(fs.blocks)`` once done publishing."""
         out: List[FinishedSequence] = []
         if self.n_live == 0:
             return out
@@ -314,22 +502,69 @@ class InflightBatchingGenerator:
                 spec_proposed=int(host["spec_proposed"][slot]),
                 spec_accepted=int(host["spec_accepted"][slot])))
             slots.append(slot)
+        if export_blocks and slots:
+            if self.kv_pool is None:
+                raise ValueError(
+                    "export_blocks requires a paged (KV-pool) backend")
+            for fs, slot in zip(out, slots):
+                blocks = tuple(self._slot_blocks[slot])
+                self.kv_pool.incref(blocks)  # receiver-owned refs
+                fs.blocks = blocks
+                fs.n_rows = (self._slot_prompt_n[slot]
+                             + int(host["emitted"][slot]))
         if export_kv and slots:
-            idx = jnp.asarray(slots)
-            cache = self.state["cache"]
-            kv = jax.device_get(dict(k=cache["k"][:, idx],
-                                     v=cache["v"][:, idx],
-                                     valid=cache["valid"][idx]))
-            for i, fs in enumerate(out):
-                # valid rows in row order ARE token order: donor
-                # prefix rows, then the left-padded suffix's real
-                # tail, then sequentially appended decode rows
-                rows = np.flatnonzero(kv["valid"][i])
-                fs.kv = (np.ascontiguousarray(kv["k"][:, i][:, :, rows, :]),
-                         np.ascontiguousarray(kv["v"][:, i][:, :, rows, :]))
+            if self.kv_pool is not None:
+                self._export_pool_kv(out, slots, host)
+            else:
+                idx = jnp.asarray(slots)
+                cache = self.state["cache"]
+                kv = jax.device_get(dict(k=cache["k"][:, idx],
+                                         v=cache["v"][:, idx],
+                                         valid=cache["valid"][idx]))
+                for i, fs in enumerate(out):
+                    # valid rows in row order ARE token order: donor
+                    # prefix rows, then the left-padded suffix's real
+                    # tail, then sequentially appended decode rows
+                    rows = np.flatnonzero(kv["valid"][i])
+                    fs.kv = (np.ascontiguousarray(
+                                 kv["k"][:, i][:, :, rows, :]),
+                             np.ascontiguousarray(
+                                 kv["v"][:, i][:, :, rows, :]))
         for slot in slots:
             self.release_slot(slot)
         return out
+
+    def _export_pool_kv(self, out: List[FinishedSequence],
+                        slots: List[int], host):
+        """Paged counterpart of the dense KV export: one bundled D2H
+        of every finished slot's pool rows, dequantized on the host
+        for int8 pools (the host radix cache stores values)."""
+        blen = self._blen
+        flats, counts = [], []
+        for slot in slots:
+            rows = (self._slot_prompt_n[slot]
+                    + int(host["emitted"][slot]))
+            w = np.arange(rows)
+            flats.append(self._bt_host[slot, w // blen] * blen
+                         + w % blen)
+            counts.append(rows)
+        all_rows = np.concatenate(flats) if flats else np.zeros(0, int)
+        arrays = self.kv_pool.arrays()
+        fetch = dict(k=arrays["k"][:, :, all_rows],
+                     v=arrays["v"][:, :, all_rows])
+        if self.kv_pool.meta.quant:
+            fetch["ks"] = arrays["k_scale"][:, :, all_rows]
+            fetch["vs"] = arrays["v_scale"][:, :, all_rows]
+        got = jax.device_get(fetch)
+        k, v = got["k"], got["v"]
+        if self.kv_pool.meta.quant:
+            k = k.astype(np.float32) * got["ks"][..., None]
+            v = v.astype(np.float32) * got["vs"][..., None]
+        off = 0
+        for fs, rows in zip(out, counts):
+            fs.kv = (np.ascontiguousarray(k[:, :, off:off + rows, :]),
+                     np.ascontiguousarray(v[:, :, off:off + rows, :]))
+            off += rows
 
     @property
     def max_prompt_len(self) -> int:
@@ -341,13 +576,18 @@ class InflightBatchingGenerator:
     # ------------------------------------------------------------------
     def fill_slot(self, slot: int, request_id: int,
                   prompt: np.ndarray, cached_len: int = 0,
-                  prefix_kv=None):
+                  prefix_kv=None, cached_blocks=None):
         """Prefill ``prompt`` into ``slot``. With ``cached_len > 0``
         the first ``cached_len`` positions are seeded from ``prefix_kv``
         (``(k, v)``, each ``[nl, nkv, >=cached_len, hd]`` host arrays
         from the radix prefix cache) and ONLY the uncached suffix runs
         the forward -- bucketed by SUFFIX length, so a 95%-hit request
-        compiles and pays the small bucket, not the full-prompt one."""
+        compiles and pays the small bucket, not the full-prompt one.
+
+        Paged backends take ``cached_blocks`` (pool block ids from the
+        POOLED prefix cache) instead of ``prefix_kv``: whole cached
+        blocks are aliased into the slot's block table -- a refcount
+        bump, zero KV copy -- and only the suffix runs the forward."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = len(prompt)
         max_prompt = self.max_prompt_len
@@ -355,6 +595,17 @@ class InflightBatchingGenerator:
             raise ValueError(
                 f"prompt of {n} tokens exceeds max_prompt_len "
                 f"{max_prompt}")
+        if self.kv_pool is not None:
+            if prefix_kv is not None:
+                raise ValueError(
+                    "paged backends alias pool blocks; pass "
+                    "cached_blocks (not prefix_kv)")
+            self._fill_slot_paged(slot, request_id, prompt,
+                                  int(cached_len), cached_blocks)
+            return
+        if cached_blocks is not None:
+            raise ValueError("cached_blocks requires a paged (KV-"
+                             "pool) backend")
         c = int(cached_len)
         if c > 0 and prefix_kv is None:
             raise ValueError("cached_len > 0 requires prefix_kv")
@@ -377,6 +628,8 @@ class InflightBatchingGenerator:
             # the whole hit away
             smaller = [b for b in _PARTIAL_BUCKETS if b < c_b]
             c = smaller[-1] if smaller else 0
+        if c > 0 and not self._pair_admit(c_b, s_b):
+            c = 0  # compile-cache cap: fall back to full prefill
         if c <= 0:
             lp = min(_bucket(n), max_prompt)
             ids = np.full((1, lp), self.pad, np.int32)
@@ -426,6 +679,124 @@ class InflightBatchingGenerator:
             self.fill_stats["prefill_tokens_saved"] += c
         self._slot_req[slot] = request_id
         self._slot_prompt[slot] = prompt
+
+    def _pair_admit(self, c_b: int, s_b: int) -> bool:
+        """Admission to the partial-prefill compile cache (satellite:
+        the ``(c_b, s_b)`` ladder product is 81 shapes -- each one a
+        full jit compile -- and nothing bounded it). Known pairs pass;
+        new pairs past ``bucket_pair_cap`` fall back to full prefill
+        with one explicit warning, counted in ``fill_stats``."""
+        pair = (c_b, s_b)
+        if pair in self._bucket_pairs:
+            return True
+        if len(self._bucket_pairs) >= self.bucket_pair_cap:
+            if not self._bucket_cap_warned:
+                logger.warning(
+                    "partial-prefill compile cache hit its cap (%d "
+                    "distinct (donor, suffix) bucket pairs); further "
+                    "new shapes fall back to full prefill instead of "
+                    "growing the jit cache unboundedly. Raise "
+                    "bucket_pair_cap if the traffic mix really needs "
+                    "more shapes.", self.bucket_pair_cap)
+                self._bucket_cap_warned = True
+            self.fill_stats["bucket_pairs_capped"] += 1
+            return False
+        self._bucket_pairs.add(pair)
+        self.fill_stats["bucket_pairs"] = len(self._bucket_pairs)
+        return True
+
+    def _fill_slot_paged(self, slot: int, request_id: int,
+                         prompt: np.ndarray, cached_len: int,
+                         cached_blocks):
+        """Paged fill: alias whole cached blocks (refcount bump, zero
+        copy), allocate own blocks for the rest of the window, then
+        run either the full prefill or the suffix forward, scattering
+        the computed rows into the pool. May raise
+        :class:`~realhf_tpu.engine.kv_pool.KVPoolOOM`."""
+        n = len(prompt)
+        blen = self._blen
+        # whole-block aliasing only: a partial tail block would be
+        # appended into by this sequence and corrupt the shared copy,
+        # so the hit is trimmed to the block boundary (< blen tokens
+        # of re-prefill, by construction)
+        c = max(0, min(int(cached_len), n - 1))
+        c -= c % blen
+        c_b = s_b = 0
+        if c > 0 and cached_blocks is None:
+            raise ValueError(
+                "cached_len > 0 requires cached_blocks on a paged "
+                "backend")
+        if c > 0:
+            c_b = _bucket(c, _PARTIAL_BUCKETS)
+            s_b = _bucket(n - c, _PARTIAL_BUCKETS)
+            if not self._pair_admit(c_b, s_b):
+                c = 0
+        n_alias = c // blen
+        if c > 0 and len(cached_blocks) < n_alias:
+            raise ValueError(
+                f"cached_blocks covers {len(cached_blocks)} block(s) "
+                f"but cached_len {c} spans {n_alias}")
+        own = self.kv_pool.alloc(
+            self.kv_pool.blocks_for_rows(n) - n_alias)
+        alias = [int(b) for b in cached_blocks[:n_alias]] if c > 0 \
+            else []
+        if alias:
+            self.kv_pool.incref(alias)
+        blocks = alias + own
+        self._slot_blocks[slot] = blocks
+        self._bt_host[slot, :] = 0
+        self._bt_host[slot, :len(blocks)] = blocks
+        self._bt_dev = None
+        self._slot_rows_ub[slot] = n
+        self._slot_prompt_n[slot] = n
+        # bind BEFORE the forward so a failure below leaves a state
+        # release_slot() fully cleans up (blocks included)
+        self._slot_req[slot] = request_id
+        self._slot_prompt[slot] = prompt
+        bt_row = self._bt_host[slot]
+        if c <= 0:
+            lp = min(_bucket(n), self.max_prompt_len)
+            ids = np.full((1, lp), self.pad, np.int32)
+            seg = np.zeros((1, lp), np.int32)
+            pos = np.zeros((1, lp), np.int32)
+            ids[0, lp - n:] = prompt          # left padding
+            seg[0, lp - n:] = 1
+            pos[0, lp - n:] = np.arange(n)
+            warange = np.arange(lp, dtype=np.int32)
+            with tracing.span("serve:prefill", slot=slot,
+                              prompt_len=n, bucket=lp, paged=True):
+                dev = jax.device_put((ids, seg, pos, bt_row, warange))
+                arrays, self.state = self._paged_fill_jit(
+                    self.params, self.kv_pool.arrays(), self.state,
+                    jnp.int32(slot), *dev)
+            self.kv_pool.update(arrays)
+            self.last_fill = dict(bucket=lp, prompt_len=n,
+                                  cached_len=0, prefilled=n)
+            self.fill_stats["prefill_tokens"] += n
+        else:
+            s = n - c
+            ids = np.full((1, s_b), self.pad, np.int32)
+            seg = np.zeros((1, s_b), np.int32)
+            pos = np.zeros((1, s_b), np.int32)
+            ids[0, s_b - s:] = prompt[c:]        # left padding within
+            seg[0, s_b - s:] = 1                 # the suffix window
+            pos[0, s_b - s:] = c + np.arange(s)
+            warange_c = np.arange(c_b, dtype=np.int32)
+            with tracing.span("serve:prefill", slot=slot,
+                              prompt_len=n, bucket=s_b, cached_len=c,
+                              paged=True):
+                dev = jax.device_put(
+                    (ids, seg, pos, bt_row, warange_c, np.int32(c)))
+                ids_d, seg_d, pos_d, bt_d, wc_d, c_d = dev
+                arrays, self.state = self._paged_suffix_jit(
+                    self.params, self.kv_pool.arrays(), self.state,
+                    jnp.int32(slot), bt_d, wc_d, c_d, ids_d, seg_d,
+                    pos_d)
+            self.kv_pool.update(arrays)
+            self.last_fill = dict(bucket=s_b, prompt_len=n,
+                                  cached_len=c, prefilled=s)
+            self.fill_stats["prefill_tokens"] += s
+            self.fill_stats["prefill_tokens_saved"] += c
 
     # ------------------------------------------------------------------
     def generate_all(self, prompts: List[np.ndarray], key: jax.Array
@@ -657,6 +1028,180 @@ def _prefill_suffix_into_slot(cfg, cache_len, moe_constraint, params,
     new["spec_proposed"] = state["spec_proposed"].at[slot].set(0)
     new["spec_accepted"] = state["spec_accepted"].at[slot].set(0)
     return new
+
+
+# ----------------------------------------------------------------------
+# paged (KV-pool) jitted pieces: gather the live window from the pool,
+# run the SAME dense compute above on it, scatter written rows back.
+# The compute path is therefore byte-identical math to the dense one
+# (the fp32 bit-exactness guarantee); the pool only changes where rows
+# LIVE, not how they are used. One gather/scatter pair per device call
+# (chunk / verify round / fill), amortized over the chunk's steps.
+# ----------------------------------------------------------------------
+def _paged_window(meta, pool, bt, warange, length, cdt):
+    """(flat_rows [B, win], dense cache dict) for the pool-backed
+    window: row ``j < length[b]`` of sequence ``b`` is valid (windows
+    are compacted -- token ``j`` lives at window row ``j``)."""
+    rows = _kvp.window_rows(bt, warange, meta.block_len)
+    k, v = _kvp.pool_gather(meta, pool, rows, cdt)
+    valid = warange[None, :] < length[:, None]
+    return rows, dict(k=k, v=v, valid=valid, length=length)
+
+
+def _scatter_written(meta, pool, rows, cache, len0, m, mask_extra=None):
+    """Write back the rows a chunk appended: window rows
+    ``[len0, len0 + m)`` per sequence, masked to the actually-written
+    count. Rolled-back (spec-rejected) rows scatter too -- they are
+    invalid by ``length`` and will be overwritten, but their block is
+    already owned, so this is harmless and keeps the mask simple."""
+    win = rows.shape[1]
+    j = jnp.arange(m, dtype=jnp.int32)[None, :]
+    wrows = jnp.clip(len0[:, None] + j, 0, win - 1)
+    mask = (len0[:, None] + j) < win
+    if mask_extra is not None:
+        mask = mask & mask_extra
+    idx = wrows[None, :, None, :, None]
+    kw = jnp.take_along_axis(cache["k"], idx, axis=3)
+    vw = jnp.take_along_axis(cache["v"], idx, axis=3)
+    flat = jnp.take_along_axis(rows, wrows, axis=1)
+    return _kvp.pool_scatter(meta, pool, flat, kw, vw, mask)
+
+
+def _paged_decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, mesh,
+                        meta, params, pool, state, bt, warange, key):
+    """Paged decode chunk: gather -> dense ``_decode_chunk`` -> scatter
+    the <= ``chunk`` new rows per slot back into the pool."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    rows, cache = _paged_window(meta, pool, bt, warange,
+                                state["length"], cdt)
+    st = {k2: v2 for k2, v2 in state.items() if k2 != "length"}
+    st["cache"] = cache
+    st = _decode_chunk(cfg, g, eos, pad, chunk, moe_constraint, mesh,
+                       params, st, key)
+    cache = st.pop("cache")
+    len0 = state["length"]
+    len1 = cache["length"]
+    j = jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    written = j < (len1 - len0)[:, None]
+    pool = _scatter_written(meta, pool, rows, cache, len0, chunk,
+                            mask_extra=written)
+    st["length"] = len1
+    return pool, st
+
+
+def _paged_verify(cfg, g, eos, k_spec, moe_constraint, meta, params,
+                  pool, state, bt, warange, drafts):
+    """Paged speculative round: gather -> dense ``_verify_chunk`` ->
+    scatter the round's <= k+1 rows per live slot back."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    rows, cache = _paged_window(meta, pool, bt, warange,
+                                state["length"], cdt)
+    st = {k2: v2 for k2, v2 in state.items() if k2 != "length"}
+    st["cache"] = cache
+    st = _verify_chunk(cfg, g, eos, k_spec, moe_constraint, params,
+                       st, drafts)
+    cache = st.pop("cache")
+    live = (state["active"] & state["unfinished"]
+            & (state["emitted"] < g.max_new_tokens))
+    pool = _scatter_written(meta, pool, rows, cache, state["length"],
+                            1 + k_spec, mask_extra=live[:, None])
+    st["length"] = cache["length"]
+    return pool, st
+
+
+def _paged_prefill(cfg, moe_constraint, attention_fn, meta, params,
+                   pool, state, slot, ids, seg, pos, bt_row, warange):
+    """Full prefill into pool blocks. The batch-1 forward is the SAME
+    left-padded bucketed ``T.prefill`` the dense path runs; its rows
+    are then COMPACTED on scatter (window row ``p`` holds token ``p``)
+    so every sequence shares the position->block-offset invariant the
+    radix cache's whole-block aliasing depends on."""
+    hidden, pcache = T.prefill(cfg, params, ids, seg, pos,
+                               attention_fn=attention_fn,
+                               moe_constraint=moe_constraint)
+    lp = ids.shape[1]
+    blen = meta.block_len
+    n = (seg[0] != 0).sum().astype(jnp.int32)
+    # prefill put token p at row lp - n + p (left padding); strip it
+    src = jnp.clip(warange + (lp - n), 0, pcache["k"].shape[3] - 1)
+    kc = pcache["k"][:, 0][:, :, src]            # [nl, nkv, lp, hd]
+    vc = pcache["v"][:, 0][:, :, src]
+    rows = (bt_row[warange // blen] * blen + warange % blen)[None, :]
+    mask = (warange < n)[None, :]
+    pool = _kvp.pool_scatter(meta, pool, rows, kc[:, None],
+                             vc[:, None], mask)
+    new = dict(state)
+    new["length"] = state["length"].at[slot].set(n)
+    new["last_hidden"] = state["last_hidden"].at[slot].set(hidden[0, -1])
+    new["prompt_len"] = state["prompt_len"].at[slot].set(n)
+    new["emitted"] = state["emitted"].at[slot].set(0)
+    new["active"] = state["active"].at[slot].set(True)
+    new["unfinished"] = state["unfinished"].at[slot].set(True)
+    new["hit_eos"] = state["hit_eos"].at[slot].set(False)
+    new["out_tokens"] = state["out_tokens"].at[slot].set(
+        jnp.full((state["out_tokens"].shape[1],), 0, jnp.int32))
+    new["out_logprobs"] = state["out_logprobs"].at[slot].set(0.0)
+    new["spec_proposed"] = state["spec_proposed"].at[slot].set(0)
+    new["spec_accepted"] = state["spec_accepted"].at[slot].set(0)
+    return pool, new
+
+
+def _paged_prefill_suffix(cfg, moe_constraint, meta, params, pool,
+                          state, slot, bt_row, warange_c, c, ids, seg,
+                          pos):
+    """Partial prefill after whole-block aliasing: the donor rows are
+    ALREADY in the slot's table (rows [0, c) -- a refcount bump put
+    them there, no copy); gather them into a local window, run the
+    suffix through :func:`_extend_rows` at window rows [c, c + s),
+    and scatter only the suffix rows back. One compile per
+    (donor-bucket, suffix-bucket) pair, same ladder as dense."""
+    blen = meta.block_len
+    c_b = warange_c.shape[0]
+    s_b = ids.shape[1]
+    cdt = jnp.dtype(cfg.compute_dtype)
+    drows = (bt_row[warange_c // blen] * blen
+             + warange_c % blen)[None, :]
+    dk, dv = _kvp.pool_gather(meta, pool, drows, cdt)
+    nl, _, nkv, _, hd = dk.shape
+    local_k = jnp.concatenate(
+        [dk, jnp.zeros((nl, 1, nkv, s_b, hd), cdt)], axis=3)
+    local_v = jnp.concatenate(
+        [dv, jnp.zeros((nl, 1, nkv, s_b, hd), cdt)], axis=3)
+    valid0 = jnp.concatenate(
+        [(warange_c < c)[None, :], jnp.zeros((1, s_b), bool)], axis=1)
+    s = (seg[0] != 0).sum().astype(jnp.int32)
+    lane = jnp.arange(s_b, dtype=jnp.int32)
+    wrow = jnp.clip(c + lane - (s_b - s), 0,
+                    c_b + s_b - 1)[None, :]       # suffix target rows
+    tok_mask = seg != 0
+    hidden, lk, lv = _extend_rows(cfg, moe_constraint, params,
+                                  local_k, local_v, valid0, ids, pos,
+                                  wrow, tok_mask)
+    # window coords == local coords for the suffix (donor is [0, c)
+    # in both): read the written lanes back out and scatter them into
+    # the slot's own (freshly allocated, block-aligned) pool rows
+    idx = wrow[None, :, None, :, None]
+    kw = jnp.take_along_axis(lk, idx, axis=3)
+    vw = jnp.take_along_axis(lv, idx, axis=3)
+    flat = bt_row[wrow[0] // blen] * blen + wrow[0] % blen
+    pool = _kvp.pool_scatter(meta, pool, flat[None, :], kw, vw,
+                             tok_mask)
+    plen = (c + s).astype(jnp.int32)
+    new = dict(state)
+    new["length"] = state["length"].at[slot].set(plen)
+    new["last_hidden"] = state["last_hidden"].at[slot].set(
+        hidden[0, -1])
+    new["prompt_len"] = state["prompt_len"].at[slot].set(plen)
+    new["emitted"] = state["emitted"].at[slot].set(0)
+    new["active"] = state["active"].at[slot].set(True)
+    new["unfinished"] = state["unfinished"].at[slot].set(True)
+    new["hit_eos"] = state["hit_eos"].at[slot].set(False)
+    new["out_tokens"] = state["out_tokens"].at[slot].set(
+        jnp.full((state["out_tokens"].shape[1],), 0, jnp.int32))
+    new["out_logprobs"] = state["out_logprobs"].at[slot].set(0.0)
+    new["spec_proposed"] = state["spec_proposed"].at[slot].set(0)
+    new["spec_accepted"] = state["spec_accepted"].at[slot].set(0)
+    return pool, new
 
 
 def _verify_chunk(cfg, g, eos, k_spec, moe_constraint, params, state,

@@ -104,30 +104,60 @@ class ContinuousScheduler:
         self.weight_sync = weight_sync or WeightSync()
         self.max_staleness = max_staleness
         self.stream_tokens = stream_tokens
-        # radix prefix/KV reuse (serving/prefix_cache.py): only
-        # engaged when the backend implements the prefix fill + KV
-        # export extensions (InflightBatchingGenerator does; minimal
-        # test fakes may not)
+        # radix prefix/KV reuse (serving/prefix_cache.py). Two
+        # substrate pairings are engaged, the rest degrade with a
+        # warning:
+        # - POOLED cache + paged backend (shared engine/kv_pool.py
+        #   pool): prefix hits alias blocks, publication is refcount
+        #   bookkeeping, eviction relieves decode OOM pressure;
+        # - host-copy cache + dense backend implementing the prefix
+        #   fill + KV export extensions (the pre-pool flow).
         self.prefix_cache = prefix_cache
-        self._prefix_capable = (
-            prefix_cache is not None
-            and getattr(backend, "supports_prefix_fill", False))
-        if prefix_cache is not None and not self._prefix_capable:
-            logger.warning(
-                "prefix cache configured but backend %s lacks "
-                "supports_prefix_fill; running without reuse.",
-                type(backend).__name__)
+        self._pooled = bool(getattr(prefix_cache, "is_pooled", False))
+        backend_pool = getattr(backend, "kv_pool", None)
+        if prefix_cache is None:
+            self._prefix_capable = False
+        elif self._pooled:
+            self._prefix_capable = backend_pool is not None
+            if not self._prefix_capable:
+                logger.warning(
+                    "pooled prefix cache configured but backend %s "
+                    "has no kv_pool; running without reuse.",
+                    type(backend).__name__)
+            elif prefix_cache.pool is not backend_pool:
+                raise ValueError(
+                    "prefix cache and backend must share ONE KVPool "
+                    "-- that sharing is the point of the pool")
+        else:
+            self._prefix_capable = (
+                getattr(backend, "supports_prefix_fill", False)
+                and backend_pool is None)
+            if not self._prefix_capable:
+                logger.warning(
+                    "prefix cache configured but backend %s %s; "
+                    "running without reuse.", type(backend).__name__,
+                    "is paged (use PooledPrefixCache)"
+                    if backend_pool is not None
+                    else "lacks supports_prefix_fill")
         self._clock = clock
         self._active: Dict[int, _ActiveSeq] = {}  # int_id -> seq
         self._by_slot: Dict[int, int] = {}        # slot -> int_id
         self._next_id = 0
+        #: one-deep holding slot for a request popped from the queue
+        #: that the KV pool cannot admit yet (admission is gated on
+        #: free blocks, not slots): retried first next step, so pool
+        #: backpressure defers work instead of dropping it
+        self._parked = None
+        self.last_pool_stats: Optional[Dict] = None
         self.stats = dict(prefills=0, decode_chunks=0, decode_steps=0,
                           tokens_out=0, finished=0, expired=0, stale=0,
                           cancelled=0, swaps=0, fill_failed=0,
                           sequential_equiv_steps=0,
                           prefix_hits=0, prefix_misses=0,
                           prefix_evictions=0, prefix_tokens_saved=0,
-                          spec_proposed=0, spec_accepted=0)
+                          spec_proposed=0, spec_accepted=0,
+                          kv_oom_evictions=0, kv_relief_blocks=0,
+                          kv_parked=0)
 
     def _count(self, key: str, n: int = 1):
         """Bump a scheduler counter AND its mirror in the process
@@ -143,15 +173,27 @@ class ContinuousScheduler:
         return len(self._active)
 
     def idle(self) -> bool:
-        return not self._active and len(self.queue) == 0
+        return (not self._active and len(self.queue) == 0
+                and self._parked is None)
 
     def active_rids(self) -> List[str]:
         return [s.req.rid for s in self._active.values()]
 
+    def take_parked(self):
+        """Hand back the pool-backpressure holding slot (the server's
+        drain bounces it alongside the queued requests)."""
+        req, self._parked = self._parked, None
+        return [req] if req is not None else []
+
     # ------------------------------------------------------------------
     def cancel(self, rid: str) -> bool:
         """Abort an ACTIVE sequence (queued ones are cancelled at the
-        queue). Frees the slot immediately."""
+        queue; a pool-parked one counts too). Frees the slot
+        immediately."""
+        if self._parked is not None and self._parked.rid == rid:
+            self._parked = None
+            self._count("cancelled")
+            return True
         for int_id, seq in list(self._active.items()):
             if seq.req.rid == rid:
                 self._evict(int_id)
@@ -209,11 +251,26 @@ class ContinuousScheduler:
                 events.append(ServeEvent("stale", seq.req.rid,
                                          self._stale_info(seq, version)))
 
-        # 3. admission: prefill queued requests into free slots
+        # 3. admission: prefill queued requests into free slots.
+        #    Paged backends gate on POOL FREE BLOCKS, not just slots:
+        #    a request the pool cannot take is parked (backpressure,
+        #    retried next step after evict-to-pool relief) instead of
+        #    consuming a slot it cannot fill.
         if admit:
             for slot in self.backend.free_slots():
-                req = self.queue.pop()
+                req, self._parked = self._parked, None
                 if req is None:
+                    req = self.queue.pop()
+                if req is None:
+                    break
+                if req.deadline is not None and req.deadline <= now:
+                    # expired while parked (queue.pop filters its own)
+                    self._count("expired")
+                    events.append(ServeEvent("expired", req.rid))
+                    continue
+                if not self._pool_admissible(req):
+                    self._parked = req
+                    self._count("kv_parked")
                     break
                 req.started_at = now
                 int_id = self._next_id
@@ -248,18 +305,28 @@ class ContinuousScheduler:
             with tracing.span("serve:decode_chunk",
                               n_live=len(self._active),
                               weight_version=version):
-                self.backend.decode_chunk(key)
+                self._decode_with_relief(key, events)
             self._count("decode_chunks")
             self._count("decode_steps", self.backend.chunk)
 
-        # 5. harvest + streaming deltas (KV export only when a prefix
-        #    cache is there to receive the publication)
-        harvested = self.backend.harvest(export_kv=True) \
-            if self._prefix_capable else self.backend.harvest()
+        # 5. harvest + streaming deltas. Pooled caches take BLOCK IDS
+        #    (publication = refcount bookkeeping, zero device
+        #    transfer); host caches take the bundled KV download; no
+        #    cache, no export.
+        if self._prefix_capable and self._pooled:
+            harvested = self.backend.harvest(export_blocks=True)
+        elif self._prefix_capable:
+            harvested = self.backend.harvest(export_kv=True)
+        else:
+            harvested = self.backend.harvest()
         for fs in harvested:
             seq = self._active.pop(fs.request_id, None)
             if seq is None:
-                continue  # evicted this very step
+                # evicted this very step; still release the receiver-
+                # owned block refs a pooled export attached
+                if self._pooled and getattr(fs, "blocks", None):
+                    self.backend.kv_pool.free(fs.blocks)
+                continue
             self._by_slot.pop(seq.slot, None)
             self._count("tokens_out", len(fs.tokens))
             self._count("sequential_equiv_steps", len(fs.tokens))
@@ -298,14 +365,101 @@ class ContinuousScheduler:
                              logprobs=logprobs[seq.streamed:],
                              offset=seq.streamed)))
                     seq.streamed = len(tokens)
+        self._update_pool_gauges()
         return events
+
+    # ------------------------------------------------------------------
+    # KV-pool pressure management (docs/serving.md "Admission &
+    # KV-pool backpressure")
+    # ------------------------------------------------------------------
+    def _pool_admissible(self, req: GenRequest) -> bool:
+        """Admit while blocks remain: a paged backend names the
+        free-list blocks a fill of this prompt will take; when the
+        pool is short, evict-to-pool (unpinned prefix-cache blocks)
+        runs BEFORE the request is parked."""
+        if getattr(self.backend, "kv_pool", None) is None:
+            return True
+        need = self.backend.admission_blocks_needed(len(req.prompt))
+        pool = self.backend.kv_pool
+        if pool.n_free >= need:
+            return True
+        self._relieve_pool(need - pool.n_free)
+        return pool.n_free >= need
+
+    def _relieve_pool(self, shortfall: int) -> int:
+        """Return KV blocks to the pool by evicting unpinned prefix-
+        cache nodes (LRU): cold cached prefixes are the one reserve
+        that costs nothing live to give back."""
+        if (shortfall <= 0 or not self._pooled
+                or not self._prefix_capable):
+            return 0
+        freed = self.prefix_cache.evict_blocks(shortfall)
+        if freed:
+            self._count("kv_relief_blocks", freed)
+            self._count("prefix_evictions")
+        return freed
+
+    def _decode_with_relief(self, key, events: List[ServeEvent]):
+        """Run the decode chunk, relieving KV-pool OOM pressure in
+        escalation order: prefix-cache eviction first (evict-to-pool),
+        then -- only when the cache has nothing left to give -- evict
+        the YOUNGEST live sequence with an explicit ``rejected
+        (reason=kv_oom)`` terminal (harvest-reject). Each loop
+        iteration frees blocks or removes a sequence, so it
+        terminates."""
+        from realhf_tpu.engine.kv_pool import KVPoolOOM
+        while True:
+            try:
+                self.backend.decode_chunk(key)
+                return
+            except KVPoolOOM as e:
+                if self._relieve_pool(max(1, e.shortfall)):
+                    continue
+                if not self._active:
+                    return
+                int_id = max(self._active)
+                seq = self._active[int_id]
+                self._evict(int_id)
+                self._count("kv_oom_evictions")
+                logger.warning(
+                    "KV pool exhausted mid-decode and the prefix "
+                    "cache is dry; evicted youngest sequence %s.",
+                    seq.req.rid)
+                events.append(ServeEvent(
+                    "rejected", seq.req.rid,
+                    dict(reason="kv_oom", retry_after=None)))
+
+    def _update_pool_gauges(self):
+        """Surface the pool through the PR 13 telemetry plane:
+        bytes in use, free blocks, and the internal fragmentation
+        ratio (1 - live rows / rows the in-use blocks could hold,
+        counting both tenants' rows)."""
+        stats_fn = getattr(self.backend, "kv_pool_stats", None)
+        if stats_fn is None \
+                or getattr(self.backend, "kv_pool", None) is None:
+            return
+        s = stats_fn()
+        rows = s.get("rows_in_use", 0)
+        if self._pooled and self._prefix_capable:
+            rows += getattr(self.prefix_cache, "rows", 0)
+        cap_rows = s["blocks_in_use"] * s["block_len"]
+        frag = 1.0 - rows / cap_rows if cap_rows else 0.0
+        frag = min(1.0, max(0.0, frag))
+        obs_metrics.set_gauge("serving_kv_pool_bytes_in_use",
+                              s["bytes_in_use"])
+        obs_metrics.set_gauge("serving_kv_pool_blocks_free",
+                              s["blocks_free"])
+        obs_metrics.set_gauge("serving_kv_pool_frag_ratio", frag)
+        self.last_pool_stats = dict(s, frag_ratio=round(frag, 4))
 
     # ------------------------------------------------------------------
     def _fill_slot(self, slot: int, int_id: int, req: GenRequest):
         """Prefill a request into a slot, consulting the radix prefix
-        cache first: on a hit, the donor KV seeds the slot and only
-        the uncached suffix runs the forward. The donor pin lives for
-        exactly the match->fill window."""
+        cache first: on a hit, the donor seeds the slot (pooled: the
+        cached blocks are ALIASED into the slot's block table; host:
+        the donor KV is copied in) and only the uncached suffix runs
+        the forward. The donor pin lives for exactly the match->fill
+        window."""
         if not self._prefix_capable:
             self.backend.fill_slot(slot, int_id, req.prompt)
             return
@@ -317,9 +471,15 @@ class ContinuousScheduler:
             if m.cached_len > 0:
                 self._count("prefix_hits")
                 self._count("prefix_tokens_saved", m.cached_len)
-                self.backend.fill_slot(slot, int_id, req.prompt,
-                                       cached_len=m.cached_len,
-                                       prefix_kv=(m.k, m.v))
+                if self._pooled:
+                    self.backend.fill_slot(
+                        slot, int_id, req.prompt,
+                        cached_len=m.cached_len,
+                        cached_blocks=list(m.blocks))
+                else:
+                    self.backend.fill_slot(slot, int_id, req.prompt,
+                                           cached_len=m.cached_len,
+                                           prefix_kv=(m.k, m.v))
             else:
                 self._count("prefix_misses")
                 self.backend.fill_slot(slot, int_id, req.prompt)
@@ -329,7 +489,30 @@ class ContinuousScheduler:
     def _publish_kv(self, seq: _ActiveSeq, fs, version: int):
         """Credit a finished sequence's KV back to the prefix cache.
         Skipped when the sequence lived through a weight swap: its
-        rows mix weight versions and must not seed future requests."""
+        rows mix weight versions and must not seed future requests.
+        Pooled flow: ``fs.blocks`` carry receiver-owned pool refs --
+        the cache increfs what it keeps, then the refs are ALWAYS
+        freed here, publication or not."""
+        if self._pooled:
+            blocks = getattr(fs, "blocks", None)
+            if not self._prefix_capable or blocks is None:
+                return
+            try:
+                if seq.version_start == version:
+                    ev0 = self.prefix_cache.stats["evictions"]
+                    self.prefix_cache.insert(
+                        np.concatenate(
+                            [np.asarray(seq.req.prompt, np.int64),
+                             np.asarray(fs.tokens, np.int64)]),
+                        blocks=blocks)
+                    ev = self.prefix_cache.stats["evictions"] - ev0
+                    if ev:
+                        self._count("prefix_evictions", ev)
+            finally:
+                self.backend.kv_pool.free(blocks)
+            obs_metrics.set_gauge("serving_prefix_bytes",
+                                  self.prefix_cache.bytes_used)
+            return
         if (not self._prefix_capable or getattr(fs, "kv", None) is None
                 or seq.version_start != version):
             return

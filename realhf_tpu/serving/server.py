@@ -433,6 +433,8 @@ class RolloutServer:
         if self._fleet is not None:
             self._fleet.mark_retiring(self.server_name)
         bounced = self.queue.start_drain()
+        # a request parked on KV-pool backpressure is queued work too
+        bounced += self.scheduler.take_parked()
         for req in bounced:
             self._send(req.rid, "draining", {})
         return len(bounced)
@@ -511,6 +513,8 @@ class RolloutServer:
                    draining=self._draining)
         if self.scheduler.prefix_cache is not None:
             out["prefix_cache"] = self.scheduler.prefix_cache.snapshot()
+        if self.scheduler.last_pool_stats is not None:
+            out["kv_pool"] = dict(self.scheduler.last_pool_stats)
         return out
 
 

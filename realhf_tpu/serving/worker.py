@@ -62,13 +62,46 @@ class GenServerWorker(worker_base.Worker):
         # decoding"): REALHF_TPU_SPEC_K overrides the spec for drills
         spec_k = int(os.environ.get("REALHF_TPU_SPEC_K",
                                     sv.spec_decode_k))
+        # paged KV pool (docs/perf.md "Paged KV & quantization"):
+        # int8 implies the pool -- dequant-on-read lives in its
+        # gather path
+        kv_pool = None
+        paged = sv.paged_kv or sv.kv_cache_dtype == "int8"
+        if paged:
+            from realhf_tpu.engine.kv_pool import KVPool
+            from realhf_tpu.models import transformer as T
+            cache_len = T.round_cache_len(
+                sv.max_prompt_len + gconfig.max_new_tokens)
+            n_blocks = sv.kv_pool_blocks or sv.n_slots * (
+                -(-cache_len // sv.kv_block_len))
+            kv_pool = KVPool(self.model.config, n_blocks,
+                             sv.kv_block_len,
+                             dtype=sv.kv_cache_dtype or "fp32")
+            logger.info(
+                "KV pool: %d blocks x %d tokens (%d bytes, dtype=%s) "
+                "for %d slots.", n_blocks, sv.kv_block_len,
+                n_blocks * kv_pool.block_bytes, kv_pool.dtype,
+                sv.n_slots)
         backend = InflightBatchingGenerator(
             self.model.config, self.model.engine.params, gconfig,
             n_slots=sv.n_slots, max_prompt_len=sv.max_prompt_len,
             eos_token_id=sv.eos_token_id, pad_token_id=sv.pad_token_id,
-            chunk_size=sv.chunk_size, spec_decode_k=spec_k)
-        prefix_cache = RadixPrefixCache(sv.prefix_cache_bytes) \
-            if sv.prefix_cache_bytes > 0 else None
+            chunk_size=sv.chunk_size, spec_decode_k=spec_k,
+            kv_pool=kv_pool,
+            kv_cache_dtype=None if paged else sv.kv_cache_dtype)
+        if sv.prefix_cache_bytes <= 0:
+            prefix_cache = None
+        elif kv_pool is not None:
+            # the pool is the one KV allocator BOTH tenants share:
+            # cached prefixes are pool blocks, hits alias them into
+            # slot tables, eviction relieves decode OOM pressure
+            from realhf_tpu.serving.prefix_cache import (
+                PooledPrefixCache,
+            )
+            prefix_cache = PooledPrefixCache(kv_pool,
+                                             sv.prefix_cache_bytes)
+        else:
+            prefix_cache = RadixPrefixCache(sv.prefix_cache_bytes)
         # fleet mode: register this replica under a keepalive lease so
         # the FleetRouter discovers it (and fails its work over the
         # moment the lease lapses)

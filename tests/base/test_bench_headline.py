@@ -56,6 +56,8 @@ def test_headline_only_prints_and_skips_nonheadline_phases(
                         forbidden("pipeline"))
     monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
                         forbidden("serving"))
+    monkeypatch.setattr(bench_mod, "_bench_kv_pool",
+                        forbidden("kv_pool"))
     monkeypatch.setattr(bench_mod, "_bench_async",
                         forbidden("async"))
     monkeypatch.setattr(bench_mod, "_bench_agentic",
@@ -105,6 +107,9 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
                         spy("pipeline", ret={"stages": 4}))
     monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
                         spy("serving", ret={"shared": {}}))
+    monkeypatch.setattr(bench_mod, "_bench_kv_pool",
+                        spy("kv_pool",
+                            ret={"max_concurrent_improvement": 2.5}))
     monkeypatch.setattr(bench_mod, "_bench_async",
                         spy("async", ret={"async_speedup": 1.1}))
     monkeypatch.setattr(bench_mod, "_bench_agentic",
@@ -127,7 +132,8 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
     assert seen_phases["pipeline"] == ["ppo_headline",
                                        "kernel_disposition"]
     assert seen_phases["serving"][-1] == "pipeline_schedules"
-    assert seen_phases["async"][-1] == "serving_bench"
+    assert seen_phases["kv_pool"][-1] == "serving_bench"
+    assert seen_phases["async"][-1] == "kv_pool_bench"
     assert seen_phases["agentic"][-1] == "async_bench"
     assert seen_phases["trace_report"][-1] == "agentic_bench"
     assert seen_phases["reshard"][-1] == "trace_report"
@@ -136,10 +142,13 @@ def test_partial_payload_flushed_before_each_nonheadline_phase(
     final = _read_payload()
     assert final["phases_done"] == [
         "ppo_headline", "kernel_disposition", "pipeline_schedules",
-        "serving_bench", "async_bench", "agentic_bench",
-        "trace_report", "reshard", "sft", "overhead_probe"]
+        "serving_bench", "kv_pool_bench", "async_bench",
+        "agentic_bench", "trace_report", "reshard", "sft",
+        "overhead_probe"]
     assert final["extra"]["pipeline_schedule_bench"] == {"stages": 4}
     assert final["extra"]["serving_bench"] == {"shared": {}}
+    assert final["extra"]["kv_pool_bench"] == {
+        "max_concurrent_improvement": 2.5}
     assert final["extra"]["async_bench"] == {"async_speedup": 1.1}
     assert final["extra"]["agentic_bench"] == {"serving": {}}
     assert final["extra"]["trace_report"] == {"n_steps": 2,
@@ -165,6 +174,8 @@ def test_nonheadline_phase_failure_never_voids_headline(
     monkeypatch.setattr(bench_mod, "_bench_pipeline_schedules", boom)
     monkeypatch.setattr(bench_mod, "_bench_serving_hotpath",
                         lambda: {"shared": {}})
+    monkeypatch.setattr(bench_mod, "_bench_kv_pool",
+                        lambda: {"ok": True})
     monkeypatch.setattr(bench_mod, "_bench_async",
                         lambda: {"async_speedup": 1.0})
     monkeypatch.setattr(bench_mod, "_bench_agentic",

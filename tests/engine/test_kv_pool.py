@@ -1,0 +1,373 @@
+"""Paged KV pool tests (ISSUE 14).
+
+Two tiers in one module: pure-host allocator/quantization properties
+(sub-second), and tiny-model regressions proving the load-bearing
+guarantee -- paged fp32 greedy decode (prefix aliasing on and off,
+speculative decoding on and off) emits tokens and logprobs matching
+the dense-window path, because the paged jit wrappers run the SAME
+dense compute on a gathered window. The model tests share one dense
+reference via a module fixture to keep compile count (and the tier-1
+budget) down; the broader eos/slot matrix is ``-m slow``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from realhf_tpu.engine.inflight import InflightBatchingGenerator
+from realhf_tpu.engine.kv_pool import (
+    KVPool,
+    KVPoolOOM,
+    _quantize_rows,
+    int8_roundtrip_error_bound,
+)
+from realhf_tpu.models import transformer as T
+from realhf_tpu.models.config import TransformerConfig
+from realhf_tpu.ops.sampling import GenerationHyperparameters
+
+CFG = TransformerConfig(
+    n_layers=2, n_kv_heads=2, n_q_heads=4, hidden_dim=32,
+    intermediate_dim=64, vocab_size=97, apply_rotary=True,
+    layer_norm_type="rms", mlp_type="llama", use_attention_bias=False,
+    use_attn_proj_bias=False, use_mlp_bias=False,
+    activation_function="silu", compute_dtype="float32")
+
+NM = 8  # max_new_tokens; max_prompt 24 -> cache_len 32 (one bucket)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _gen(params, pool=None, spec_k=0, n_slots=2, eos=1, cap=24):
+    g = GenerationHyperparameters(
+        max_new_tokens=NM, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+    return InflightBatchingGenerator(
+        CFG, params, g, n_slots=n_slots, max_prompt_len=24,
+        eos_token_id=eos, pad_token_id=0, chunk_size=4,
+        spec_decode_k=spec_k, kv_pool=pool, bucket_pair_cap=cap)
+
+
+def _prompts():
+    rng = np.random.default_rng(0)
+    # 24 == the prefill bucket (hole-free: dense and paged windows are
+    # byte-identical), plus odd lengths exercising the left-pad strip
+    return [rng.integers(2, CFG.vocab_size, size=n).astype(np.int32)
+            for n in (24, 10, 17)]
+
+
+@pytest.fixture(scope="module")
+def dense_ref(params):
+    """The dense-path greedy reference every paged variant must
+    match."""
+    return _gen(params).generate_all(_prompts(), jax.random.PRNGKey(7))
+
+
+# ----------------------------------------------------------------------
+# host-side allocator
+# ----------------------------------------------------------------------
+def test_alloc_free_refcount_and_reserved_block():
+    pool = KVPool.host_only(8, 4, bytes_per_row=16)
+    a = pool.alloc(3)
+    assert len(a) == 3 and 0 not in a  # block 0 is scratch, reserved
+    assert pool.n_free == 5
+    pool.incref(a[:1])
+    pool.free(a)
+    assert pool.n_free == 7  # a[0] still referenced
+    assert pool.ref(a[0]) == 1
+    pool.free(a[:1])
+    assert pool.n_free == 8
+    with pytest.raises(ValueError):
+        pool.free(a[:1])  # double free
+    with pytest.raises(ValueError):
+        pool.incref([a[0]])  # unallocated
+
+
+def test_alloc_oom_is_all_or_nothing():
+    pool = KVPool.host_only(4, 4)
+    pool.alloc(3)
+    with pytest.raises(KVPoolOOM) as ei:
+        pool.alloc(2)
+    assert ei.value.shortfall == 1
+    assert pool.n_free == 1  # nothing was taken
+    assert pool.stats()["oom"] == 1
+
+
+def test_stats_and_blocks_for_rows():
+    pool = KVPool.host_only(10, 8, bytes_per_row=4)
+    assert pool.blocks_for_rows(0) == 0
+    assert pool.blocks_for_rows(1) == 1
+    assert pool.blocks_for_rows(8) == 1
+    assert pool.blocks_for_rows(9) == 2
+    pool.alloc(4)
+    s = pool.stats()
+    assert s["blocks_in_use"] == 4
+    assert s["bytes_in_use"] == 4 * 8 * 4
+    assert s["blocks_free"] == 6
+
+
+def test_fragmentation_property_random_churn():
+    """Allocator invariants under seeded random churn: conservation
+    (free + held == total), no id ever handed out twice concurrently,
+    refcounts drive the free list exactly."""
+    rng = np.random.default_rng(3)
+    pool = KVPool.host_only(32, 4)
+    held = {}  # id -> refcount we hold
+    for _ in range(400):
+        op = rng.random()
+        if op < 0.45:
+            n = int(rng.integers(1, 5))
+            try:
+                got = pool.alloc(n)
+            except KVPoolOOM:
+                assert pool.n_free < n
+                continue
+            assert not (set(got) & set(held))  # never double-handed
+            for b in got:
+                held[b] = 1
+        elif op < 0.75 and held:
+            b = int(rng.choice(list(held)))
+            pool.free([b])
+            held[b] -= 1
+            if held[b] == 0:
+                del held[b]
+        elif held:
+            b = int(rng.choice(list(held)))
+            pool.incref([b])
+            held[b] += 1
+        assert pool.n_free + len(held) == pool.n_blocks
+        for b, r in held.items():
+            assert pool.ref(b) == r
+    pool.free([b for b, r in held.items() for _ in range(r)])
+    assert pool.n_free == pool.n_blocks
+
+
+def test_int8_roundtrip_error_within_bound():
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 3.0, size=(2, 4, 6, 16)).astype(np.float32)
+    q, scale = _quantize_rows(x)
+    dq = np.asarray(q, np.float32) * np.asarray(scale)[..., None]
+    err = np.max(np.abs(dq - x))
+    assert err <= int8_roundtrip_error_bound(x)
+    # zero rows quantize to exactly zero, no NaNs
+    q0, s0 = _quantize_rows(np.zeros((1, 1, 1, 8), np.float32))
+    assert np.all(np.asarray(q0) == 0) and np.all(np.asarray(s0) == 0)
+
+
+def test_device_pool_rejects_bad_dtype_and_host_only_guard():
+    with pytest.raises(ValueError):
+        KVPool(None, 4, 4, dtype="fp16")
+    pool = KVPool.host_only(4, 4)
+    with pytest.raises(RuntimeError):
+        pool.arrays()
+
+
+# ----------------------------------------------------------------------
+# paged vs dense bit-exactness (tiny model)
+# ----------------------------------------------------------------------
+def test_paged_fp32_bit_exact_vs_dense(params, dense_ref):
+    pool = KVPool(CFG, n_blocks=16, block_len=8, dtype="fp32")
+    gen = _gen(params, pool)
+    out = gen.generate_all(_prompts(), jax.random.PRNGKey(7))
+    for a, b in zip(dense_ref, out):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_allclose(a.logprobs, b.logprobs,
+                                   rtol=0, atol=1e-6)
+        assert a.no_eos == b.no_eos
+    # every block returned to the free list after harvest
+    assert pool.n_free == pool.n_blocks
+
+
+def test_paged_spec_decode_bit_exact_vs_dense(params, dense_ref):
+    """The existing greedy-exact spec guarantee holds on the pool
+    backend: paged + speculative == dense plain, token for token."""
+    pool = KVPool(CFG, n_blocks=16, block_len=8)
+    gen = _gen(params, pool, spec_k=3)
+    out = gen.generate_all(_prompts(), jax.random.PRNGKey(7))
+    assert gen.spec_stats["rounds"] > 0
+    for a, b in zip(dense_ref, out):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+        np.testing.assert_allclose(a.logprobs, b.logprobs,
+                                   rtol=1e-5, atol=1e-6)
+    assert pool.n_free == pool.n_blocks
+
+
+def test_paged_prefix_alias_bit_exact(params, dense_ref):
+    """Prefix-cache-on path: a harvested sequence's blocks aliased
+    into a new slot (whole-block spans, zero KV copy) decode exactly
+    like a cache-less fill of the same prompt."""
+    rng = np.random.default_rng(5)
+    common = rng.integers(2, 97, size=16).astype(np.int32)
+    p1 = np.concatenate([common,
+                         rng.integers(2, 97, size=8).astype(np.int32)])
+    p2 = np.concatenate([common,
+                         rng.integers(2, 97, size=8).astype(np.int32)])
+    pool = KVPool(CFG, n_blocks=16, block_len=8)
+    gen = _gen(params, pool, n_slots=1)
+    ref = _gen(params, n_slots=1).generate_all(
+        [p2], jax.random.PRNGKey(0))[0]
+
+    gen.fill_slot(0, 0, p1)
+    for _ in range(3):
+        gen.decode_chunk(jax.random.PRNGKey(0))
+    fin = gen.harvest(export_blocks=True)[0]
+    assert fin.blocks and fin.n_rows >= len(p1)
+    # alias the two full common blocks into the next fill
+    gen.fill_slot(0, 1, p2, cached_len=16,
+                  cached_blocks=list(fin.blocks))
+    assert gen.last_fill["cached_len"] == 16
+    assert gen.last_fill["bucket"] < 24  # paid the suffix bucket
+    assert gen.fill_stats["prefill_tokens_saved"] == 16
+    for _ in range(3):
+        gen.decode_chunk(jax.random.PRNGKey(0))
+    got = gen.harvest()[0]
+    pool.free(fin.blocks)  # receiver-owned refs from export_blocks
+    np.testing.assert_array_equal(ref.tokens, got.tokens)
+    np.testing.assert_allclose(ref.logprobs, got.logprobs,
+                               rtol=0, atol=1e-6)
+    assert pool.n_free == pool.n_blocks
+
+
+def test_paged_int8_within_tolerance(params, dense_ref):
+    """int8 KV (per-row scales, dequant-on-read) stays close to the
+    fp32 stream on the tiny model: most tokens agree, and logprobs on
+    the agreeing prefix stay within a loose bound."""
+    pool = KVPool(CFG, n_blocks=16, block_len=8, dtype="int8")
+    gen = _gen(params, pool)
+    out = gen.generate_all(_prompts(), jax.random.PRNGKey(7))
+    agree = total = 0
+    for a, b in zip(dense_ref, out):
+        n = min(len(a.tokens), len(b.tokens))
+        total += n
+        eq = a.tokens[:n] == b.tokens[:n]
+        div = int(np.argmin(eq)) if not eq.all() else n
+        agree += div
+        if div:
+            assert np.max(np.abs(a.logprobs[:div]
+                                 - b.logprobs[:div])) < 0.25
+    assert total > 0 and agree / total >= 0.75
+    assert pool.n_free == pool.n_blocks
+
+
+def test_block_table_grows_lazily_and_oom_raises(params):
+    pool = KVPool(CFG, n_blocks=4, block_len=8)  # 32 rows total
+    gen = _gen(params, pool, n_slots=2, eos=None)
+    p = np.arange(2, 18, dtype=np.int32)  # 16 tokens = 2 blocks
+    gen.fill_slot(0, 0, p)
+    assert len(gen._slot_blocks[0]) == 2
+    gen.decode_chunk(jax.random.PRNGKey(0))  # +4 tokens -> 3rd block
+    assert len(gen._slot_blocks[0]) == 3
+    # a 1-block fill takes the last free block; its growth then OOMs
+    gen.fill_slot(1, 1, p[:8])
+    with pytest.raises(KVPoolOOM):
+        gen.decode_chunk(jax.random.PRNGKey(1))
+    gen.release_slot(0)
+    gen.decode_chunk(jax.random.PRNGKey(1))  # relief freed blocks
+    gen.release_slot(1)
+    assert pool.n_free == pool.n_blocks
+
+
+def test_admission_blocks_needed_arithmetic(params):
+    pool = KVPool(CFG, n_blocks=8, block_len=8)
+    gen = _gen(params, pool)
+    assert gen.admission_blocks_needed(16) == 3  # 2 blocks + headroom
+    assert gen.admission_blocks_needed(17) == 4
+    # an aliased whole-block prefix is shared, not allocated
+    assert gen.admission_blocks_needed(17, cached_len=16) == 2
+    s = gen.kv_pool_stats()
+    assert s["rows_in_use"] == 0 and s["blocks_free"] == 8
+
+
+def test_pair_admit_accounting_unit(params):
+    """Satellite accounting, no compiles: known pairs pass, new pairs
+    past the cap are refused (counted, one warning), refusal never
+    unregisters a known pair."""
+    gen = _gen(params, cap=2)
+    assert gen._pair_admit(16, 16)
+    assert gen._pair_admit(16, 32)
+    assert gen.fill_stats["bucket_pairs"] == 2
+    assert not gen._pair_admit(32, 32)
+    assert not gen._pair_admit(64, 16)
+    assert gen.fill_stats["bucket_pairs_capped"] == 2
+    assert gen._pair_admit(16, 16)  # known pair still admitted
+    assert gen.fill_stats["bucket_pairs"] == 2
+
+
+def test_bucket_pair_cap_falls_back_to_full_prefill(params):
+    """Satellite end-to-end: with the compile cache capped out, a
+    prefix-hit fill runs the FULL-prefill path (cached_len 0) instead
+    of compiling a new (donor, suffix) shape."""
+    pool = KVPool(CFG, n_blocks=8, block_len=8)
+    gen = _gen(params, pool, n_slots=1, cap=0)
+    p = np.arange(2, 26, dtype=np.int32)  # 24 tokens
+    gen.fill_slot(0, 0, p)
+    blocks = list(gen._slot_blocks[0])
+    pool.incref(blocks)
+    gen.release_slot(0)
+    gen.fill_slot(0, 1, p, cached_len=16, cached_blocks=blocks)
+    assert gen.last_fill["cached_len"] == 0  # fell back
+    assert gen.fill_stats["bucket_pairs_capped"] == 1
+    assert gen.fill_stats["bucket_pairs"] == 0
+    gen.release_slot(0)
+    pool.free(blocks)
+    assert pool.n_free == pool.n_blocks
+
+
+def test_bucket_pairs_counted_in_fill_stats(params):
+    """The admitted path records its compiled pair count (audit
+    surface for the jit-cache bound)."""
+    pool = KVPool(CFG, n_blocks=16, block_len=8)
+    gen = _gen(params, pool, n_slots=1)
+    p = np.arange(2, 26, dtype=np.int32)
+    gen.fill_slot(0, 0, p)
+    fin = gen.harvest()  # not finished; no-op
+    assert fin == []
+    blocks = list(gen._slot_blocks[0])
+    pool.incref(blocks)
+    gen.release_slot(0)
+    gen.fill_slot(0, 1, p, cached_len=16, cached_blocks=blocks)
+    assert gen.fill_stats["bucket_pairs"] == 1
+    assert (16, 16) in gen._bucket_pairs
+    gen.release_slot(0)
+    pool.free(blocks)
+    assert pool.n_free == pool.n_blocks
+
+
+def test_paged_rejects_wrong_donor_kind(params):
+    pool = KVPool(CFG, n_blocks=8, block_len=8)
+    gen = _gen(params, pool)
+    p = np.arange(2, 20, dtype=np.int32)
+    with pytest.raises(ValueError, match="cached_blocks"):
+        gen.fill_slot(0, 0, p, cached_len=8,
+                      prefix_kv=(np.zeros(1), np.zeros(1)))
+    dense = _gen(params)
+    with pytest.raises(ValueError, match="paged"):
+        dense.fill_slot(0, 0, p, cached_len=8, cached_blocks=[1])
+    g = GenerationHyperparameters(
+        max_new_tokens=NM, min_new_tokens=1, greedy=True,
+        force_no_logits_mask=True)
+    with pytest.raises(ValueError, match="int8"):
+        InflightBatchingGenerator(
+            CFG, params, g, n_slots=1, max_prompt_len=24,
+            eos_token_id=1, pad_token_id=0, kv_cache_dtype="int8")
+
+
+@pytest.mark.slow
+def test_paged_mixed_traffic_matrix(params, dense_ref):
+    """Broader matrix: 3 slots, eos on/off, interleaved harvests --
+    paged stays token-identical to dense throughout."""
+    for eos in (None, 1):
+        prompts = _prompts() * 2
+        base = _gen(params, eos=eos, n_slots=3).generate_all(
+            prompts, jax.random.PRNGKey(11))
+        pool = KVPool(CFG, n_blocks=24, block_len=8)
+        out = _gen(params, pool, eos=eos, n_slots=3).generate_all(
+            prompts, jax.random.PRNGKey(11))
+        for a, b in zip(base, out):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+            np.testing.assert_allclose(a.logprobs, b.logprobs,
+                                       rtol=0, atol=1e-6)
+        assert pool.n_free == pool.n_blocks

@@ -33,23 +33,35 @@ def params():
     return T.init_params(CFG, jax.random.PRNGKey(0))
 
 
-def _serve_requests(params, prompts, *, prefix_cache_bytes, spec_k=0):
+def _serve_requests(params, prompts, *, prefix_cache_bytes, spec_k=0,
+                    paged=False):
     """Serve `prompts` sequentially through a real RolloutServer on a
-    thread; returns the list of (tokens, logprobs) in order."""
+    thread; returns the list of (tokens, logprobs) in order. With
+    ``paged=True`` the backend runs on a KV pool and the prefix cache
+    (if any) is the pooled, block-aliasing one."""
     from realhf_tpu.engine.inflight import InflightBatchingGenerator
-    from realhf_tpu.serving.prefix_cache import RadixPrefixCache
+    from realhf_tpu.engine.kv_pool import KVPool
+    from realhf_tpu.serving.prefix_cache import (
+        PooledPrefixCache,
+        RadixPrefixCache,
+    )
     from realhf_tpu.serving.request_queue import RequestQueue
     from realhf_tpu.serving.server import RolloutClient, RolloutServer
 
     g = GenerationHyperparameters(
         max_new_tokens=6, min_new_tokens=1, greedy=True,
         force_no_logits_mask=True)
+    pool = KVPool(CFG, n_blocks=24, block_len=16) if paged else None
     backend = InflightBatchingGenerator(
         CFG, params, g, n_slots=2, max_prompt_len=64,
         eos_token_id=1, pad_token_id=0, chunk_size=4,
-        spec_decode_k=spec_k)
-    cache = RadixPrefixCache(prefix_cache_bytes) \
-        if prefix_cache_bytes > 0 else None
+        spec_decode_k=spec_k, kv_pool=pool)
+    if prefix_cache_bytes <= 0:
+        cache = None
+    elif paged:
+        cache = PooledPrefixCache(pool, prefix_cache_bytes)
+    else:
+        cache = RadixPrefixCache(prefix_cache_bytes)
     srv = RolloutServer(backend, server_name="t/0",
                         queue=RequestQueue(max_depth=16, n_slots=2),
                         prefix_cache=cache, seed=0)
@@ -103,6 +115,32 @@ def test_cache_disabled_run_matches_cache_enabled(params):
     assert "prefix_cache" in st_on and "prefix_cache" not in st_off
 
 
+def test_paged_pool_server_matches_dense(params):
+    """ISSUE 14 acceptance at the server level: the paged backend with
+    the POOLED prefix cache (block aliasing) serves shared-prefix
+    traffic with exactly the dense cache-less server's tokens and
+    logprobs, while actually reusing whole blocks."""
+    rng = np.random.default_rng(3)
+    common = rng.integers(2, 90, size=32).astype(np.int32)
+    prompts = [np.concatenate([common,
+                               rng.integers(2, 90, size=5)
+                               .astype(np.int32)])
+               for _ in range(3)]
+    dense, _ = _serve_requests(params, prompts, prefix_cache_bytes=0)
+    paged, st = _serve_requests(params, prompts,
+                                prefix_cache_bytes=1 << 20,
+                                paged=True)
+    for (ta, la), (tb, lb) in zip(dense, paged):
+        np.testing.assert_array_equal(ta, tb)
+        np.testing.assert_allclose(la, lb, rtol=0, atol=1e-5)
+    assert st["prefix_hits"] >= 1
+    # whole-block aliasing: savings are block-aligned (block_len 16)
+    assert st["prefix_tokens_saved"] >= 32
+    assert st["prefix_tokens_saved"] % 16 == 0
+    assert st["kv_pool"]["blocks_in_use"] >= 1
+    assert st["prefix_cache"]["pooled"] is True
+
+
 def test_spec_decode_over_the_wire_matches_plain(params):
     """Spec decoding composes with the serving stack: same tokens as
     the plain server, and per-request accept stats ride the done
@@ -130,6 +168,21 @@ def _run_bench(extra_args, timeout):
         capture_output=True, text=True, timeout=timeout)
     assert r.returncode == 0, r.stderr[-800:]
     return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_bench_kv_pool_scenario_meets_acceptance():
+    """The ISSUE 14 acceptance numbers, measured by the harness: >= 2x
+    concurrent sequences under the same KV byte budget on mixed
+    traffic, and >= 1.8x further bytes-per-token from int8."""
+    out = _run_bench(["--kv-pool", "--kv-requests", "16"], timeout=600)
+    b = out["kv_pool"]
+    assert b["ok"] is True
+    assert b["max_concurrent_improvement"] >= 2.0
+    assert b["int8_bytes_per_token_reduction"] >= 1.8
+    assert b["dense"]["max_concurrent"] == b["config"]["dense_slots"]
+    assert b["paged_fp32"]["kv_bytes_per_live_slot"] \
+        < b["dense"]["kv_bytes_per_live_slot"]
 
 
 @pytest.mark.slow

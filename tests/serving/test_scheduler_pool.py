@@ -1,0 +1,226 @@
+"""ContinuousScheduler x paged KV pool (ISSUE 14): admission gated on
+free blocks (park, don't drop), evict-to-pool relief before
+harvest-reject on decode OOM, pooled prefix publication/aliasing as
+pure refcount bookkeeping, pool gauges on the telemetry plane, and
+the host-cache overcommit satellite. All on ``FakeSlotBackend`` with
+a ``KVPool.host_only`` allocator -- the real arithmetic, no model."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.engine.kv_pool import KVPool
+from realhf_tpu.obs import flight
+from realhf_tpu.obs import metrics as obs_metrics
+from realhf_tpu.serving.prefix_cache import (
+    OVERCOMMIT_EVENT,
+    PooledPrefixCache,
+    RadixPrefixCache,
+)
+from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
+from realhf_tpu.serving.scheduler import ContinuousScheduler
+
+
+def _mk(n_blocks=8, block_len=4, n_slots=4, chunk=4, cache_blocks=8,
+        prefix=True):
+    pool = KVPool.host_only(n_blocks, block_len, bytes_per_row=8)
+    backend = FakeSlotBackend(n_slots=n_slots, chunk=chunk,
+                              kv_pool=pool)
+    cache = PooledPrefixCache(pool,
+                              cache_blocks * pool.block_bytes) \
+        if prefix else None
+    queue = RequestQueue(max_depth=64, n_slots=n_slots)
+    sched = ContinuousScheduler(backend, queue, prefix_cache=cache)
+    return pool, backend, cache, queue, sched
+
+
+def _req(rid, need_tokens, prompt_len, fill=3):
+    p = np.full(prompt_len, fill, np.int64)
+    p[0] = need_tokens
+    return GenRequest(rid=rid, prompt=p)
+
+
+def _drain(sched, queue, steps=32):
+    events = []
+    for _ in range(steps):
+        events += sched.step(None)
+        if sched.idle() and len(queue) == 0:
+            break
+    return events
+
+
+def test_admission_parks_on_block_shortage_then_serves():
+    pool, backend, _, queue, sched = _mk(n_blocks=4, prefix=False)
+    # each 8-row prompt needs 2 blocks (+1 headroom at the gate):
+    # the second request must wait for the first to finish
+    assert queue.submit(_req("a", 8, 8)).accepted
+    assert queue.submit(_req("b", 8, 8)).accepted
+    ev = sched.step(None)
+    kinds_a = [e.kind for e in ev if e.rid == "a"]
+    assert "started" in kinds_a and "done" not in kinds_a
+    assert sched.stats["kv_parked"] == 1
+    assert sched._parked is not None and sched._parked.rid == "b"
+    events = _drain(sched, queue)
+    done = [e.rid for e in events if e.kind == "done"]
+    assert sorted(done) == ["a", "b"]  # parked, not dropped
+    assert pool.n_free == pool.n_blocks
+
+
+def test_decode_oom_relieves_cache_before_rejecting():
+    pool, backend, cache, queue, sched = _mk(n_blocks=6, n_slots=2)
+    # seed the cache with a cold 2-block node the relief can evict
+    cold = pool.alloc(2)
+    cache.insert(np.arange(100, 108), blocks=cold)
+    pool.free(cold)
+    assert pool.n_free == 4
+    # one sequence: 8-row prompt (2 blocks) + 12 tokens -> 5 blocks
+    assert queue.submit(_req("a", 12, 8)).accepted
+    events = _drain(sched, queue)
+    assert [e.rid for e in events if e.kind == "done"] == ["a"]
+    assert sched.stats["kv_relief_blocks"] >= 1  # evict-to-pool ran
+    assert sched.stats["kv_oom_evictions"] == 0  # no harvest-reject
+    # the cold node was the one evicted ("a"'s own publish remains)
+    m = cache.match(np.arange(100, 108), max_len=7)
+    assert m.cached_len == 0
+    cache.release(m.handle)
+
+
+def test_decode_oom_rejects_youngest_when_cache_dry():
+    # both admit (1 block each + headroom) then grow into each other:
+    # the admission gate is a watermark, not a worst-case reservation
+    pool, backend, cache, queue, sched = _mk(n_blocks=6, n_slots=2,
+                                             prefix=False)
+    assert queue.submit(_req("old", 16, 4)).accepted
+    assert queue.submit(_req("young", 16, 4)).accepted
+    events = _drain(sched, queue)
+    rejected = [e for e in events if e.kind == "rejected"]
+    assert [e.rid for e in rejected] == ["young"]
+    assert rejected[0].data["reason"] == "kv_oom"
+    assert [e.rid for e in events if e.kind == "done"] == ["old"]
+    assert sched.stats["kv_oom_evictions"] == 1
+    assert pool.n_free == pool.n_blocks
+
+
+def test_pooled_publish_then_alias_and_refcounts():
+    pool, backend, cache, queue, sched = _mk(n_blocks=16)
+    assert queue.submit(_req("a", 4, 8)).accepted
+    _drain(sched, queue)
+    assert cache.stats["inserts"] == 1
+    held = 16 - pool.n_free  # blocks the tree kept
+    assert held > 0
+    # identical prompt: whole-block aliasing, zero-copy fill
+    assert queue.submit(_req("b", 4, 8)).accepted
+    _drain(sched, queue)
+    assert sched.stats["prefix_hits"] == 1
+    assert sched.stats["prefix_tokens_saved"] >= pool.block_len
+    cached_fills = [c for (_, _, c) in backend.fills if c > 0]
+    assert cached_fills and cached_fills[0] % pool.block_len == 0
+    # generator refs all released; only the tree still holds blocks
+    # (b's identical sequence was already fully covered -> no growth)
+    assert pool.n_free == pool.n_blocks - held
+    sched.prefix_cache.clear()
+    assert pool.n_free == pool.n_blocks
+
+
+def test_swap_flushes_pooled_cache_blocks_back_to_pool():
+    from realhf_tpu.serving.weight_sync import WeightSync
+    ws = WeightSync()
+    pool = KVPool.host_only(8, 4, bytes_per_row=8)
+    backend = FakeSlotBackend(n_slots=2, chunk=4, kv_pool=pool)
+    cache = PooledPrefixCache(pool, 8 * pool.block_bytes)
+    queue = RequestQueue(max_depth=8, n_slots=2)
+    sched = ContinuousScheduler(backend, queue, weight_sync=ws,
+                                prefix_cache=cache)
+    queue.submit(_req("a", 4, 8))
+    _drain(sched, queue)
+    assert cache.n_nodes == 1 and pool.n_free < pool.n_blocks
+    ws.push("v1", 1)
+    sched.poll_weights()
+    assert cache.n_nodes == 0
+    assert pool.n_free == pool.n_blocks  # blocks back in the pool
+
+
+def test_pool_gauges_on_telemetry_plane():
+    obs_metrics.reset_default()
+    pool, backend, cache, queue, sched = _mk(n_blocks=8)
+    queue.submit(_req("a", 4, 8))
+    sched.step(None)
+    snap = obs_metrics.snapshot()
+    for name in ("serving_kv_pool_bytes_in_use",
+                 "serving_kv_pool_blocks_free",
+                 "serving_kv_pool_frag_ratio"):
+        assert name in snap, name
+    assert sched.last_pool_stats is not None
+    assert 0.0 <= sched.last_pool_stats["frag_ratio"] <= 1.0
+    free = list(snap["serving_kv_pool_blocks_free"]["values"]
+                .values())[0]
+    assert free == pool.n_free
+
+
+def test_cancel_and_drain_cover_parked_request():
+    pool, backend, cache, queue, sched = _mk(n_blocks=4, prefix=False)
+    queue.submit(_req("a", 8, 8))
+    queue.submit(_req("b", 8, 8))
+    sched.step(None)
+    assert sched._parked.rid == "b"
+    assert not sched.idle()
+    assert sched.cancel("b") is True
+    assert sched._parked is None
+    queue.submit(_req("c", 8, 8))
+    sched.step(None)
+    assert sched._parked.rid == "c"
+    taken = sched.take_parked()
+    assert [r.rid for r in taken] == ["c"]
+    assert sched.take_parked() == []
+
+
+def test_mismatched_pool_pairing_rejected_and_degraded():
+    pool = KVPool.host_only(8, 4)
+    other = KVPool.host_only(8, 4)
+    backend = FakeSlotBackend(n_slots=2, chunk=4, kv_pool=pool)
+    queue = RequestQueue(max_depth=8, n_slots=2)
+    with pytest.raises(ValueError, match="ONE KVPool"):
+        ContinuousScheduler(backend, queue,
+                            prefix_cache=PooledPrefixCache(other, 64))
+    # pooled cache + non-paged backend degrades (no reuse), loudly
+    plain = FakeSlotBackend(n_slots=2, chunk=4)
+    sched = ContinuousScheduler(
+        plain, queue, prefix_cache=PooledPrefixCache(pool, 64))
+    assert sched._prefix_capable is False
+    # host cache + paged backend degrades too
+    sched2 = ContinuousScheduler(
+        backend, RequestQueue(max_depth=8, n_slots=2),
+        prefix_cache=RadixPrefixCache(1024))
+    assert sched2._prefix_capable is False
+
+
+def test_host_cache_overcommit_gauge_and_flight_event():
+    """Satellite: transient budget overcommit while pins are
+    outstanding is surfaced -- gauge always, flight event past 2x."""
+    obs_metrics.reset_default()
+    flight.reset_default()
+    cache = RadixPrefixCache(capacity_bytes=10_000)
+    k = np.zeros((1, 1, 64, 8), np.float32)  # 2 KiB per tensor
+    cache.insert(np.arange(64), k, k)        # 4 KiB, within budget
+    m = cache.match(np.arange(64), max_len=63)  # pin the node
+    cache.capacity_bytes = 1_000             # pressure arrives
+    cache._evict_to_budget()
+    snap = obs_metrics.snapshot()
+    over = list(snap["serving_prefix_overcommit_bytes"]["values"]
+                .values())[0]
+    assert over == cache.bytes_used - 1_000
+    evs = [e for e in flight._default.events()
+           if e["kind"] == OVERCOMMIT_EVENT]
+    assert len(evs) == 1  # deduped while the episode persists
+    cache._evict_to_budget()
+    assert len([e for e in flight._default.events()
+                if e["kind"] == OVERCOMMIT_EVENT]) == 1
+    assert cache.stats["overcommit_events"] == 1
+    assert cache.snapshot()["overcommit_bytes"] == over
+    # releasing the pin lets eviction run; gauge drops to 0, re-armed
+    cache.release(m.handle)
+    assert cache.bytes_used <= cache.capacity_bytes
+    assert cache._overcommit_alarmed is False
+    snap = obs_metrics.snapshot()
+    assert list(snap["serving_prefix_overcommit_bytes"]["values"]
+                .values())[0] == 0
