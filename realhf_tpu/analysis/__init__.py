@@ -1,22 +1,33 @@
 """graft-lint: framework-aware static analysis for realhf_tpu.
 
-Four checker families guard the invariants the runtime's correctness
+Checker families guard the invariants the runtime's correctness
 rests on (docs/static_analysis.md):
 
 - ``jax-purity``: no host syncs / impure calls under JAX tracing, no
   per-iteration host transfers in decode hot paths.
 - ``concurrency``: no blocking calls under locks, no unsynchronized
   cross-thread fields, no unjoined non-daemon threads.
+- ``lockorder``: interprocedural lock discipline over the project
+  call graph -- lock-order cycles (deadlocks) and transitively
+  blocking calls while a lock is held.
 - ``collective-determinism``: no unordered iteration feeding sharding
   layouts, collectives, or name_resolve keys.
+- ``lifecycle``: paired-operation discipline (KV-pool blocks, prefix
+  pins, sockets, threads, staged checkpoints) on every CFG exit
+  path, including exceptional ones.
+- ``terminal``: exactly-once terminal delivery in the serving
+  protocol handlers -- no rid retired from a live table without a
+  terminal event, no route dropped before its send succeeded.
 - ``dfg-invariants``: registered experiment DFGs are acyclic, edge-
   and mesh-compatible, with totally ordered weight reallocations.
 - ``obs-metric-name``: literal metric names are snake_case, counters
   end ``_total``, duration histograms/summaries end
   ``_secs``/``_seconds``.
+- ``obs-catalog``: the docs/observability.md metric catalog and the
+  instrumented call sites agree, in both directions.
 
 CLI: ``python -m realhf_tpu.analysis [--fail-on-new] [--baseline F]
-[--checker NAME] [paths...]`` -- see ``__main__.py``.
+[--checker NAME] [--diff REF] [paths...]`` -- see ``__main__.py``.
 """
 
 from realhf_tpu.analysis.baseline import (  # noqa: F401
@@ -24,9 +35,12 @@ from realhf_tpu.analysis.baseline import (  # noqa: F401
     load_baseline,
     write_baseline,
 )
+from realhf_tpu.analysis.cache import AnalysisCache  # noqa: F401
 from realhf_tpu.analysis.concurrency import ConcurrencyChecker
 from realhf_tpu.analysis.core import (  # noqa: F401
+    ENGINE_VERSION,
     AstChecker,
+    GraphChecker,
     Module,
     ProjectChecker,
     run_analysis,
@@ -35,15 +49,23 @@ from realhf_tpu.analysis.determinism import DeterminismChecker
 from realhf_tpu.analysis.dfg_invariants import DfgInvariantsChecker
 from realhf_tpu.analysis.finding import Finding  # noqa: F401
 from realhf_tpu.analysis.jax_purity import JaxPurityChecker
+from realhf_tpu.analysis.lifecycle import LifecycleChecker
+from realhf_tpu.analysis.lockorder import LockOrderChecker
+from realhf_tpu.analysis.obs_catalog import ObsCatalogChecker
 from realhf_tpu.analysis.obs_metrics import ObsMetricNameChecker
+from realhf_tpu.analysis.terminal import TerminalChecker
 
 #: family name -> checker class, in documentation order
 CHECKER_CLASSES = {
     JaxPurityChecker.name: JaxPurityChecker,
     ConcurrencyChecker.name: ConcurrencyChecker,
+    LockOrderChecker.name: LockOrderChecker,
     DeterminismChecker.name: DeterminismChecker,
+    LifecycleChecker.name: LifecycleChecker,
+    TerminalChecker.name: TerminalChecker,
     DfgInvariantsChecker.name: DfgInvariantsChecker,
     ObsMetricNameChecker.name: ObsMetricNameChecker,
+    ObsCatalogChecker.name: ObsCatalogChecker,
 }
 
 

@@ -3,15 +3,22 @@
 ::
 
     python -m realhf_tpu.analysis [paths...]
-        [--checker NAME ...]        # default: all four families
+        [--checker NAME ...]        # default: all families
         [--baseline FILE]           # default: scripts/lint_baseline.json
         [--fail-on-new]             # exit 1 only on findings beyond
                                     # the baseline
         [--write-baseline]          # accept the current findings
         [--format text|json]
         [--no-dfg]                  # skip the import-time DFG pass
+        [--diff [REF]]              # only report on files changed vs
+                                    # a git ref (default HEAD); skips
+                                    # the project-wide passes -- the
+                                    # fast pre-commit mode
+        [--no-cache] [--cache-dir D]
 
 Default paths: the ``realhf_tpu`` package under the current directory.
+Results are cached under ``.graft_lint_cache/`` (content-hash keyed;
+see docs/static_analysis.md "Caching") unless ``--no-cache``.
 Exit codes: 0 = clean (or informational run), 1 = new findings with
 ``--fail-on-new``, 2 = usage error.
 """
@@ -19,18 +26,49 @@ Exit codes: 0 = clean (or informational run), 1 = new findings with
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from realhf_tpu.analysis import (
     CHECKER_CLASSES,
+    ENGINE_VERSION,
+    AnalysisCache,
+    ProjectChecker,
     all_checkers,
     diff_against_baseline,
     load_baseline,
     run_analysis,
     write_baseline,
 )
+from realhf_tpu.analysis.cache import CACHE_DIR_NAME
 
 DEFAULT_BASELINE = os.path.join("scripts", "lint_baseline.json")
+
+
+def _changed_files(ref: str, within):
+    """Repo-relative .py files changed vs ``ref`` (committed diff +
+    working tree + untracked), filtered to the scan paths."""
+    out = set()
+    for argv in (["git", "diff", "--name-only", ref, "--", "*.py"],
+                 ["git", "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py"]):
+        proc = subprocess.run(argv, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"`{' '.join(argv)}` failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        out.update(ln.strip() for ln in proc.stdout.splitlines()
+                   if ln.strip())
+    roots = [os.path.normpath(p) for p in within]
+    picked = []
+    for f in sorted(out):
+        norm = os.path.normpath(f)
+        if not os.path.exists(norm):
+            continue  # deleted files have nothing to lint
+        if any(norm == r or norm.startswith(r + os.sep)
+               for r in roots):
+            picked.append(norm)
+    return picked
 
 
 def main(argv=None) -> int:
@@ -56,6 +94,17 @@ def main(argv=None) -> int:
     ap.add_argument("--no-dfg", action="store_true",
                     help="skip the import-time dfg-invariants pass "
                          "(e.g. scanning a fixture tree)")
+    ap.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="only report on .py files changed vs the git "
+                         "ref (default HEAD); the call graph still "
+                         "spans the whole package, but project-wide "
+                         "passes (dfg-invariants, obs-catalog) are "
+                         "skipped")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the on-disk result cache")
+    ap.add_argument("--cache-dir", default=CACHE_DIR_NAME,
+                    help=f"cache location (default {CACHE_DIR_NAME})")
     args = ap.parse_args(argv)
 
     try:
@@ -73,7 +122,26 @@ def main(argv=None) -> int:
         print(f"no such path(s): {missing}", file=sys.stderr)
         return 2
 
-    findings = run_analysis(paths, checkers)
+    project_paths = None
+    if args.diff is not None:
+        # fast pre-commit mode: report on changed files only; the
+        # whole-project import-time passes don't decompose per file
+        checkers = [c for c in checkers
+                    if not isinstance(c, ProjectChecker)]
+        try:
+            changed = _changed_files(args.diff, paths)
+        except (OSError, RuntimeError) as e:
+            print(f"--diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            print(f"graft-lint: no changed .py files vs {args.diff}.")
+            return 0
+        project_paths, paths = paths, changed
+
+    cache = None if args.no_cache else AnalysisCache(
+        args.cache_dir, ENGINE_VERSION)
+    findings = run_analysis(paths, checkers,
+                            project_paths=project_paths, cache=cache)
 
     if args.write_baseline:
         write_baseline(args.baseline, findings)

@@ -1,0 +1,115 @@
+"""AST+result cache for graft-lint (docs/static_analysis.md).
+
+The full gate must stay cheap on a 1-vCPU box, so analysis results
+persist under ``.graft_lint_cache/`` (gitignored) between runs:
+
+- **per-file findings** key on ``(relpath, content sha1, checker)`` --
+  an unchanged file re-runs nothing, an edited file re-runs only
+  itself;
+- **whole-tree findings** (interprocedural families and cacheable
+  project checkers) key on a stamp over every scanned file's content
+  hash -- any edit re-runs those families over the tree, an unchanged
+  tree skips them (and skips parsing) entirely;
+- ``ENGINE_VERSION`` (:mod:`realhf_tpu.analysis.core`) is part of the
+  payload: a version bump discards the whole cache.
+
+Content hashes -- not mtimes -- are the key: reading+hashing a file
+is cheap next to parsing and checking it, and hashes cannot go stale
+on coarse filesystem timestamps. The cache is a single pickle; a
+corrupt or unreadable file silently degrades to a cold run (a cache
+must never break the gate).
+"""
+
+import os
+import pickle
+import tempfile
+from typing import Dict, List, Optional
+
+from realhf_tpu.analysis.finding import Finding
+
+CACHE_DIR_NAME = ".graft_lint_cache"
+_CACHE_FILE = "results.pkl"
+
+
+class AnalysisCache:
+    """Findings cache for one analysis run (see module doc)."""
+
+    def __init__(self, dir_path: str, engine_version: int):
+        self.dir_path = dir_path
+        self.engine_version = engine_version
+        self.path = os.path.join(dir_path, _CACHE_FILE)
+        self.stats = dict(file_hits=0, file_misses=0,
+                          project_hit=False, loaded=False)
+        self._dirty = False
+        self._data = self._load()
+
+    # ------------------------------------------------------------------
+    def _load(self) -> Dict:
+        empty = {"engine": self.engine_version, "local": {},
+                 "project": {"stamp": None, "by_checker": {}}}
+        try:
+            with open(self.path, "rb") as f:
+                data = pickle.load(f)
+        except (OSError, pickle.PickleError, EOFError, AttributeError,
+                ImportError, IndexError):
+            return empty
+        if not isinstance(data, dict) \
+                or data.get("engine") != self.engine_version:
+            return empty
+        self.stats["loaded"] = True
+        return data
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(self.dir_path, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.dir_path,
+                                       suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(self._data, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except OSError:
+            pass  # a cache that cannot write is just a cold cache
+
+    # ------------------------------------------------------------------
+    def get_local(self, relpath: str, sha: str,
+                  checker: str) -> Optional[List[Finding]]:
+        entry = self._data["local"].get(relpath)
+        if entry is None or entry.get("sha") != sha:
+            self.stats["file_misses"] += 1
+            return None
+        hit = entry["by_checker"].get(checker)
+        if hit is None:
+            self.stats["file_misses"] += 1
+            return None
+        self.stats["file_hits"] += 1
+        return hit
+
+    def put_local(self, relpath: str, sha: str, checker: str,
+                  findings: List[Finding]) -> None:
+        entry = self._data["local"].get(relpath)
+        if entry is None or entry.get("sha") != sha:
+            entry = {"sha": sha, "by_checker": {}}
+            self._data["local"][relpath] = entry
+        entry["by_checker"][checker] = list(findings)
+        self._dirty = True
+
+    # ------------------------------------------------------------------
+    def get_project(self, stamp: str,
+                    checker: str) -> Optional[List[Finding]]:
+        proj = self._data["project"]
+        if proj.get("stamp") != stamp:
+            return None
+        return proj["by_checker"].get(checker)
+
+    def put_project(self, stamp: str, checker: str,
+                    findings: List[Finding]) -> None:
+        proj = self._data["project"]
+        if proj.get("stamp") != stamp:
+            self._data["project"] = proj = {"stamp": stamp,
+                                            "by_checker": {}}
+        proj["by_checker"][checker] = list(findings)
+        self._dirty = True

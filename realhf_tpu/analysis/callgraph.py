@@ -1,0 +1,277 @@
+"""Project-wide call graph for graft-lint v2.
+
+Builds, from the parsed modules of one analysis run, a map of every
+top-level function and method in the scanned tree plus a best-effort
+resolver from call sites to those definitions. Resolution is
+deliberately conservative -- a call it cannot pin to exactly one
+project definition is simply unresolved (checkers treat unresolved
+calls as opaque):
+
+- ``self.m(...)`` / ``cls.m(...)``: method of the lexically enclosing
+  class, walking project-resolvable base classes;
+- ``name(...)``: a module-level function of the same module, or a
+  ``from x import name`` symbol; a class name resolves to its
+  ``__init__``;
+- ``alias.attr(...)`` / ``pkg.mod.func(...)``: through ``import``
+  aliases (collected from the whole module -- function-level imports
+  count) to another scanned module's function, or ``Class.method``;
+- everything else (arbitrary object attributes, subscripts, calls on
+  call results) is unresolved.
+
+``ProjectIndex.reaches`` answers the transitive questions the
+interprocedural checkers ask ("does anything this function calls,
+up to depth N, satisfy this predicate?") and returns the call chain
+as evidence.
+"""
+
+import ast
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from realhf_tpu.analysis.cfg import _walk_no_nested
+from realhf_tpu.analysis.core import Module, dotted_name
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One project function/method definition."""
+    qual: str                 # "pkg.mod:Class.meth" or "pkg.mod:func"
+    module: str               # dotted module name
+    relpath: str
+    cls: Optional[str]        # class key "pkg.mod:Class" for methods
+    node: ast.AST
+
+    @property
+    def name(self) -> str:
+        return self.qual.split(":", 1)[1]
+
+
+def module_name(relpath: str) -> str:
+    """'realhf_tpu/serving/server.py' -> 'realhf_tpu.serving.server';
+    package __init__ files name the package itself."""
+    parts = relpath[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "__main__"
+
+
+class ProjectIndex:
+    """Call-graph index over one set of parsed modules."""
+
+    def __init__(self, modules: List[Module]):
+        self.modules: Dict[str, Module] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        #: class key -> {"methods": {name: qual}, "bases": [dotted]}
+        self.classes: Dict[str, Dict] = {}
+        #: module -> names bound at module top level (lock identity)
+        self.module_globals: Dict[str, set] = {}
+        #: module -> alias -> ("module", dotted) | ("symbol", mod, nm)
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        self._callees: Dict[str, Tuple[str, ...]] = {}
+        self._calls: Dict[str, List[ast.Call]] = {}
+        for m in modules:
+            self._index_module(m)
+
+    # -- construction --------------------------------------------------
+    def _index_module(self, m: Module):
+        mod = module_name(m.relpath)
+        self.modules[mod] = m
+        imps: Dict[str, Tuple] = {}
+        package = mod if m.relpath.endswith("/__init__.py") \
+            else mod.rpartition(".")[0]
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    imps[name] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = package.split(".") if package else []
+                    up = up[: len(up) - (node.level - 1)] \
+                        if node.level > 1 else up
+                    base = ".".join(up + ([base] if base else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    name = alias.asname or alias.name
+                    imps[name] = ("symbol", base, alias.name)
+        self.imports[mod] = imps
+        self.module_globals[mod] = {
+            t.id
+            for stmt in m.tree.body
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign))
+            for t in (stmt.targets if isinstance(stmt, ast.Assign)
+                      else [stmt.target])
+            if isinstance(t, ast.Name)}
+        for stmt in m.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{mod}:{stmt.name}"
+                self.funcs[qual] = FuncInfo(qual, mod, m.relpath,
+                                            None, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                key = f"{mod}:{stmt.name}"
+                methods = {}
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qual = f"{mod}:{stmt.name}.{sub.name}"
+                        self.funcs[qual] = FuncInfo(
+                            qual, mod, m.relpath, key, sub)
+                        methods[sub.name] = qual
+                self.classes[key] = dict(
+                    methods=methods,
+                    bases=[dotted_name(b) for b in stmt.bases])
+
+    # -- symbol resolution ---------------------------------------------
+    def _resolve_symbol(self, mod: str, name: str):
+        """A bare name in ``mod`` -> ("func", qual) | ("class", key) |
+        ("module", dotted) | None."""
+        if f"{mod}:{name}" in self.funcs:
+            return ("func", f"{mod}:{name}")
+        if f"{mod}:{name}" in self.classes:
+            return ("class", f"{mod}:{name}")
+        imp = self.imports.get(mod, {}).get(name)
+        if imp is None:
+            return None
+        if imp[0] == "module":
+            return ("module", imp[1])
+        _, src_mod, src_name = imp
+        if f"{src_mod}.{src_name}" in self.modules:
+            return ("module", f"{src_mod}.{src_name}")
+        if src_mod in self.modules and src_mod != mod:
+            return self._resolve_symbol(src_mod, src_name)
+        return None
+
+    def _resolve_method(self, cls_key: str, name: str,
+                        _seen=None) -> Optional[str]:
+        _seen = _seen or set()
+        if cls_key in _seen:
+            return None
+        _seen.add(cls_key)
+        cls = self.classes.get(cls_key)
+        if cls is None:
+            return None
+        qual = cls["methods"].get(name)
+        if qual is not None:
+            return qual
+        mod = cls_key.split(":", 1)[0]
+        for base in cls["bases"]:
+            base_key = self._resolve_class(mod, base)
+            if base_key is not None:
+                found = self._resolve_method(base_key, name, _seen)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve_class(self, mod: str, dotted: str) -> Optional[str]:
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        sym = self._resolve_symbol(mod, parts[0])
+        if sym is None:
+            return None
+        kind, target = sym
+        if kind == "class" and len(parts) == 1:
+            return target
+        if kind == "module" and len(parts) >= 2:
+            sub_mod = ".".join([target] + parts[1:-1])
+            if f"{sub_mod}:{parts[-1]}" in self.classes:
+                return f"{sub_mod}:{parts[-1]}"
+        return None
+
+    # -- call resolution -----------------------------------------------
+    def resolve_call(self, call: ast.Call,
+                     scope: FuncInfo) -> Optional[str]:
+        """Qual of the project function a call targets, or None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            sym = self._resolve_symbol(scope.module, func.id)
+            if sym is None:
+                return None
+            kind, target = sym
+            if kind == "func":
+                return target
+            if kind == "class":
+                return self.classes[target]["methods"].get("__init__")
+            return None
+        dotted = dotted_name(func)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if parts[0] in ("self", "cls") and scope.cls is not None:
+            if len(parts) == 2:
+                return self._resolve_method(scope.cls, parts[1])
+            return None  # self.obj.m(...): attribute types unknown
+        if len(parts) < 2:
+            return None
+        sym = self._resolve_symbol(scope.module, parts[0])
+        if sym is None:
+            return None
+        kind, target = sym
+        if kind == "class" and len(parts) == 2:
+            return self._resolve_method(target, parts[1])
+        if kind == "module":
+            mod = ".".join([target] + parts[1:-1])
+            if f"{mod}:{parts[-1]}" in self.funcs:
+                return f"{mod}:{parts[-1]}"
+            if len(parts) >= 3:
+                mod2 = ".".join([target] + parts[1:-2])
+                cls_key = f"{mod2}:{parts[-2]}"
+                if cls_key in self.classes:
+                    return self._resolve_method(cls_key, parts[-1])
+        return None
+
+    # -- graph queries -------------------------------------------------
+    def calls_in(self, qual: str) -> List[ast.Call]:
+        """Raw call nodes of a function, nested defs excluded."""
+        cached = self._calls.get(qual)
+        if cached is None:
+            info = self.funcs[qual]
+            cached = [n for part in
+                      (info.node.body if hasattr(info.node, "body")
+                       else [])
+                      for n in _walk_no_nested(part)
+                      if isinstance(n, ast.Call)]
+            self._calls[qual] = cached
+        return cached
+
+    def callees(self, qual: str) -> Tuple[str, ...]:
+        cached = self._callees.get(qual)
+        if cached is None:
+            info = self.funcs[qual]
+            out = []
+            for call in self.calls_in(qual):
+                target = self.resolve_call(call, info)
+                if target is not None and target != qual \
+                        and target not in out:
+                    out.append(target)
+            cached = tuple(out)
+            self._callees[qual] = cached
+        return cached
+
+    def reaches(self, qual: str, pred: Callable[[str], bool],
+                max_depth: int = 4) -> Optional[List[str]]:
+        """BFS the call graph from ``qual`` (exclusive) up to
+        ``max_depth`` edges; returns the first call chain
+        ``[qual, ..., hit]`` whose tip satisfies ``pred``, else
+        None."""
+        frontier = [[qual]]
+        seen = {qual}
+        for _ in range(max_depth):
+            nxt = []
+            for chain in frontier:
+                for callee in self.callees(chain[-1]):
+                    if callee in seen:
+                        continue
+                    seen.add(callee)
+                    new_chain = chain + [callee]
+                    if pred(callee):
+                        return new_chain
+                    nxt.append(new_chain)
+            frontier = nxt
+            if not frontier:
+                break
+        return None

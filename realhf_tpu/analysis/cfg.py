@@ -1,0 +1,373 @@
+"""Per-function control-flow graphs with exceptional edges.
+
+The substrate of graft-lint v2's path-sensitive checkers
+(docs/static_analysis.md "The CFG/call-graph engine"). ``build_cfg``
+turns one ``ast.FunctionDef`` into a :class:`CFG` of per-statement
+nodes with two edge kinds:
+
+- ``normal``: ordinary fall-through / branch / loop edges;
+- ``exc``: an exception escaping the statement. Only statements that
+  contain a call, a ``raise``, or an ``assert`` get one (attribute
+  and subscript errors exist but modelling them drowns every checker
+  in noise), and the edge carries the PRE-state of the statement --
+  whatever the statement would have done is considered not to have
+  happened.
+
+Exits are explicit nodes: ``normal_exit`` (return / fall off the
+end) and ``raise_exit`` (an exception leaving the function). What the
+builder models precisely:
+
+- ``try``/``except``/``else``/``finally``: body statements edge to a
+  handler-dispatch node; an unmatched exception continues through the
+  ``finally`` body (duplicated for the exceptional path) to the outer
+  exception target; ``return``/``break``/``continue`` jumping out of
+  a ``try`` run every enclosing ``finally`` body first (duplicated
+  per jump site, like the bytecode compiler does).
+- loops: header -> body -> header back-edge, ``break``/``continue``,
+  and no fall-through exit edge for a literal ``while True`` without
+  a break.
+- ``with``: the header (context-manager construction) may raise; the
+  body shares the surrounding exception target. ``__exit__`` is not
+  modelled -- checkers treat ``with``-managed resources as safe by
+  construction.
+
+Nested ``def``/``class``/``lambda`` bodies are opaque single
+statements: their code runs at some other time, on some other path.
+"""
+
+import ast
+import dataclasses
+from typing import Iterable, List, Optional, Tuple
+
+#: edge kinds. TRUE/FALSE mark the two arms of an ``if``/loop header
+#: so flow-sensitive checkers can refine state per branch (e.g. the
+#: lifecycle family's ``if sock is not None: sock.close()`` guard);
+#: checkers that don't care treat them like NORMAL.
+NORMAL = "normal"
+TRUE = "true"
+FALSE = "false"
+EXC = "exc"
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node: a statement, or a virtual entry/exit/dispatch."""
+    idx: int
+    stmt: Optional[ast.stmt]
+    label: str = ""
+    succs: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0)
+
+
+class CFG:
+    """Control-flow graph of one function."""
+
+    def __init__(self, func):
+        self.func = func
+        self.nodes: List[Node] = []
+        self.entry: int = self._new(None, "entry")
+        self.normal_exit: int = self._new(None, "normal_exit")
+        self.raise_exit: int = self._new(None, "raise_exit")
+
+    def _new(self, stmt, label="") -> int:
+        n = Node(idx=len(self.nodes), stmt=stmt, label=label)
+        self.nodes.append(n)
+        return n.idx
+
+    def _edge(self, frm: int, to: int, kind: str):
+        pair = (to, kind)
+        if pair not in self.nodes[frm].succs:
+            self.nodes[frm].succs.append(pair)
+
+    def preds(self):
+        """node idx -> list of (pred idx, kind)."""
+        out = {n.idx: [] for n in self.nodes}
+        for n in self.nodes:
+            for to, kind in n.succs:
+                out[to].append((n.idx, kind))
+        return out
+
+
+def _walk_no_nested(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class/
+    lambda bodies (their statements run elsewhere)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def exec_parts(stmt: ast.stmt):
+    """The sub-ASTs that execute AT a statement's own CFG node: the
+    header expression for compound statements (their bodies are
+    separate nodes), decorators/defaults for a nested ``def``, the
+    whole statement otherwise."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # the def statement itself only evaluates decorators and
+        # argument defaults; the body runs elsewhere
+        return list(stmt.decorator_list) + [
+            d for d in (stmt.args.defaults + stmt.args.kw_defaults)
+            if d is not None]
+    return [stmt]
+
+
+def may_raise(stmt: ast.stmt) -> bool:
+    """Whether the statement gets an exceptional edge."""
+    for root in exec_parts(stmt):
+        for node in _walk_no_nested(root):
+            if isinstance(node, (ast.Call, ast.Raise, ast.Assert)):
+                return True
+    return False
+
+
+# ----------------------------------------------------------------------
+# frames: the lexical stack a jump (return/break/continue) unwinds
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class _FinallyFrame:
+    body: list            # the finally suite (re-built per jump site)
+    ctx: "_Ctx"           # context the finally body itself runs under
+
+
+@dataclasses.dataclass
+class _LoopFrame:
+    brk: list             # collected (node, kind) preds of `break`
+    cont: int             # loop header idx for `continue`
+
+
+@dataclasses.dataclass
+class _Ctx:
+    exc: int              # where an escaping exception goes
+    frames: tuple = ()    # innermost LAST
+
+
+class _Builder:
+    def __init__(self, func):
+        self.cfg = CFG(func)
+
+    def build(self) -> CFG:
+        ctx = _Ctx(exc=self.cfg.raise_exit)
+        ends = self._seq(self.cfg.func.body,
+                         [(self.cfg.entry, NORMAL)], ctx)
+        self._connect(ends, self.cfg.normal_exit)
+        return self.cfg
+
+    # ------------------------------------------------------------------
+    def _connect(self, preds, to: int):
+        for frm, kind in preds:
+            self.cfg._edge(frm, to, kind)
+
+    def _stmt_node(self, s: ast.stmt, preds, ctx: _Ctx) -> int:
+        n = self.cfg._new(s)
+        self._connect(preds, n)
+        if may_raise(s):
+            self.cfg._edge(n, ctx.exc, EXC)
+        return n
+
+    def _seq(self, stmts, preds, ctx: _Ctx):
+        for s in stmts:
+            if not preds:
+                break  # unreachable code after return/raise/...
+            preds = self._stmt(s, preds, ctx)
+        return preds
+
+    # ------------------------------------------------------------------
+    def _unwind_finallies(self, preds, ctx: _Ctx,
+                          stop_at: Optional[_LoopFrame] = None):
+        """Run every enclosing ``finally`` body (innermost first) a
+        jump crosses; ``stop_at`` bounds the unwind at a loop frame
+        (break/continue stay inside their loop's outer finallies)."""
+        for frame in reversed(ctx.frames):
+            if frame is stop_at:
+                return preds, frame
+            if isinstance(frame, _LoopFrame):
+                continue
+            entry = self.cfg._new(None, "finally")
+            self._connect(preds, entry)
+            preds = self._seq(frame.body, [(entry, NORMAL)], frame.ctx)
+        return preds, None
+
+    def _innermost_loop(self, ctx: _Ctx) -> Optional[_LoopFrame]:
+        for frame in reversed(ctx.frames):
+            if isinstance(frame, _LoopFrame):
+                return frame
+        return None
+
+    # ------------------------------------------------------------------
+    def _stmt(self, s: ast.stmt, preds, ctx: _Ctx):
+        if isinstance(s, ast.Return):
+            n = self._stmt_node(s, preds, ctx)
+            out, _ = self._unwind_finallies([(n, NORMAL)], ctx)
+            self._connect(out, self.cfg.normal_exit)
+            return []
+        if isinstance(s, ast.Raise):
+            n = self.cfg._new(s)
+            self._connect(preds, n)
+            self.cfg._edge(n, ctx.exc, EXC)
+            return []
+        if isinstance(s, ast.Break):
+            n = self._stmt_node(s, preds, ctx)
+            loop = self._innermost_loop(ctx)
+            out, frame = self._unwind_finallies([(n, NORMAL)], ctx,
+                                                stop_at=loop)
+            if frame is not None:
+                frame.brk.extend(out)
+            return []
+        if isinstance(s, ast.Continue):
+            n = self._stmt_node(s, preds, ctx)
+            loop = self._innermost_loop(ctx)
+            out, frame = self._unwind_finallies([(n, NORMAL)], ctx,
+                                                stop_at=loop)
+            if frame is not None:
+                self._connect(out, frame.cont)
+            return []
+        if isinstance(s, ast.If):
+            hdr = self._stmt_node(s, preds, ctx)
+            body_ends = self._seq(s.body, [(hdr, TRUE)], ctx)
+            else_ends = self._seq(s.orelse, [(hdr, FALSE)], ctx) \
+                if s.orelse else [(hdr, FALSE)]
+            return body_ends + else_ends
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(s, preds, ctx)
+        if isinstance(s, ast.Try):
+            return self._try(s, preds, ctx)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            hdr = self._stmt_node(s, preds, ctx)
+            return self._seq(s.body, [(hdr, NORMAL)], ctx)
+        if isinstance(s, ast.Match):
+            hdr = self._stmt_node(s, preds, ctx)
+            ends = [(hdr, NORMAL)]  # no case may match
+            for case in s.cases:
+                ends += self._seq(case.body, [(hdr, NORMAL)], ctx)
+            return ends
+        # simple statements (incl. nested def/class: opaque)
+        n = self._stmt_node(s, preds, ctx)
+        return [(n, NORMAL)]
+
+    def _loop(self, s, preds, ctx: _Ctx):
+        hdr = self._stmt_node(s, preds, ctx)
+        frame = _LoopFrame(brk=[], cont=hdr)
+        body_ctx = _Ctx(exc=ctx.exc, frames=ctx.frames + (frame,))
+        body_ends = self._seq(s.body, [(hdr, TRUE)], body_ctx)
+        self._connect(body_ends, hdr)
+        ends = list(frame.brk)
+        infinite = (isinstance(s, ast.While)
+                    and isinstance(s.test, ast.Constant)
+                    and s.test.value is True)
+        if not infinite:
+            ends.append((hdr, FALSE))
+        if s.orelse:
+            ends = self._seq(s.orelse, ends, ctx)
+        return ends
+
+    def _try(self, s: ast.Try, preds, ctx: _Ctx):
+        outer_frames = ctx.frames
+        if s.finalbody:
+            fin_ctx = _Ctx(exc=ctx.exc, frames=outer_frames)
+            # exceptional copy of the finally body: runs, then the
+            # exception continues to the outer target
+            fin_exc_entry = self.cfg._new(None, "finally")
+            fin_exc_ends = self._seq(s.finalbody,
+                                     [(fin_exc_entry, NORMAL)], fin_ctx)
+            for frm, kind in fin_exc_ends:
+                self.cfg._edge(frm, ctx.exc, EXC)
+            exc_after_handlers = fin_exc_entry
+            frames = outer_frames + (
+                _FinallyFrame(body=s.finalbody, ctx=fin_ctx),)
+        else:
+            exc_after_handlers = ctx.exc
+            frames = outer_frames
+
+        if s.handlers:
+            dispatch = self.cfg._new(None, "except-dispatch")
+            body_exc = dispatch
+        else:
+            dispatch = None
+            body_exc = exc_after_handlers
+
+        body_ctx = _Ctx(exc=body_exc, frames=frames)
+        body_ends = self._seq(s.body, preds, body_ctx)
+        if s.orelse:
+            # else runs only on normal body completion; its exceptions
+            # skip the handlers
+            else_ctx = _Ctx(exc=exc_after_handlers, frames=frames)
+            body_ends = self._seq(s.orelse, body_ends, else_ctx)
+
+        ends = list(body_ends)
+        if dispatch is not None:
+            # statements that may raise inside the body edge here; the
+            # dispatch itself may fail to match any handler -- unless
+            # a handler is catch-all (bare / Exception / BaseException;
+            # Exception counts pragmatically: flagging every cleanup
+            # handler for the KeyboardInterrupt window is pure noise)
+            if not any(_catches_all(h) for h in s.handlers):
+                self.cfg._edge(dispatch, exc_after_handlers, EXC)
+            h_ctx = _Ctx(exc=exc_after_handlers, frames=frames)
+            for handler in s.handlers:
+                ends += self._seq(handler.body, [(dispatch, EXC)],
+                                  h_ctx)
+        if s.finalbody:
+            fin_ctx = _Ctx(exc=ctx.exc, frames=outer_frames)
+            entry = self.cfg._new(None, "finally")
+            self._connect(ends, entry)
+            ends = self._seq(s.finalbody, [(entry, NORMAL)], fin_ctx)
+        return ends
+
+
+def _catches_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        parts = []
+        while isinstance(n, ast.Attribute):
+            parts.append(n.attr)
+            n = n.value
+        if isinstance(n, ast.Name):
+            parts.append(n.id)
+        if parts and parts[0] in ("BaseException", "Exception"):
+            return True
+    return False
+
+
+def build_cfg(func) -> CFG:
+    """CFG for one ``ast.FunctionDef`` / ``ast.AsyncFunctionDef``."""
+    return _Builder(func).build()
+
+
+def iter_functions(tree: ast.AST):
+    """Yield every (qualname, FunctionDef) in the module, including
+    methods; nested defs are yielded as their own units too."""
+    def visit(node, qual):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cq = f"{qual}.{child.name}" if qual else child.name
+                yield cq, child
+                yield from visit(child, cq)
+            elif isinstance(child, ast.ClassDef):
+                cq = f"{qual}.{child.name}" if qual else child.name
+                yield from visit(child, cq)
+            else:
+                yield from visit(child, qual)
+    yield from visit(tree, "")

@@ -1,24 +1,40 @@
 """graft-lint engine: shared visitor core and checker registry.
 
 AST checkers subclass :class:`AstChecker` and get one parsed
-:class:`Module` per file; project checkers subclass
-:class:`ProjectChecker` and run once per invocation (the
-dfg-invariants pass imports experiment registries instead of reading
-syntax). ``run_analysis`` walks the requested paths, applies per-file
-suppressions, and returns the surviving findings sorted by location.
+:class:`Module` per file; :class:`GraphChecker` subclasses
+additionally receive a project-wide
+:class:`~realhf_tpu.analysis.callgraph.ProjectIndex` (built over
+``project_paths`` -- the whole package even when only a subset of
+files is being reported on) before their per-file ``check`` runs;
+project checkers subclass :class:`ProjectChecker` and run once per
+invocation (the dfg-invariants pass imports experiment registries
+instead of reading syntax). ``run_analysis`` walks the requested
+paths, applies per-file suppressions, and returns the surviving
+findings sorted by location.
+
+Results are cacheable (:mod:`realhf_tpu.analysis.cache`): per-file
+findings key on the file's content hash, interprocedural and
+cacheable project findings key on a whole-tree stamp, and
+``ENGINE_VERSION`` invalidates everything when the engine itself
+changes behavior.
 """
 
 import ast
 import dataclasses
+import hashlib
 import os
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from realhf_tpu.analysis.finding import Finding
 from realhf_tpu.analysis.suppress import Suppressions
 
+#: bump when checker/engine semantics change: every cache entry keyed
+#: on an older version is discarded
+ENGINE_VERSION = 2
+
 #: directories never scanned (generated trees, VCS, caches)
 SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
-             ".claude"}
+             ".claude", ".graft_lint_cache"}
 
 
 @dataclasses.dataclass
@@ -35,8 +51,16 @@ class Module:
         try:
             with open(path, "r", encoding="utf-8") as f:
                 source = f.read()
+        except OSError:
+            return None
+        return cls.from_source(path, root, source)
+
+    @classmethod
+    def from_source(cls, path: str, root: str,
+                    source: str) -> Optional["Module"]:
+        try:
             tree = ast.parse(source, filename=path)
-        except (OSError, SyntaxError, ValueError):
+        except (SyntaxError, ValueError):
             return None  # unparseable files are not lint findings
         rel = os.path.relpath(path, root).replace(os.sep, "/")
         return cls(path=path, relpath=rel, source=source, tree=tree,
@@ -68,10 +92,32 @@ class AstChecker:
             message=message, symbol=symbol)
 
 
+class GraphChecker(AstChecker):
+    """Per-file checker that needs the whole-project call graph.
+
+    ``prepare(index)`` is called once per run with the
+    :class:`~realhf_tpu.analysis.callgraph.ProjectIndex` built over
+    every scanned file; ``check(module)`` then reports findings for
+    one file at a time. Findings are cached against the whole-tree
+    stamp (any file change re-runs the family)."""
+
+    def prepare(self, index) -> None:
+        self.index = index
+
+
 class ProjectChecker:
     """Base of import-time (whole-project) checkers."""
 
     name: str = ""
+    #: True when ``check_project`` is a pure function of the scanned
+    #: tree (cacheable under the tree stamp); import-time passes that
+    #: execute project code stay False
+    cacheable: bool = False
+
+    def stamp_extra(self, root: str) -> str:
+        """Extra cache-stamp material (e.g. a doc file's content hash)
+        for cacheable checkers whose inputs go beyond the .py tree."""
+        return ""
 
     def check_project(self, root: str) -> List[Finding]:
         raise NotImplementedError
@@ -104,33 +150,163 @@ def _in_package(relpath: str) -> bool:
     return relpath == "realhf_tpu" or relpath.startswith("realhf_tpu/")
 
 
+def _sha1(data: str) -> str:
+    return hashlib.sha1(data.encode("utf-8", "replace")).hexdigest()
+
+
 def run_analysis(
     paths: Sequence[str],
     checkers: Sequence[object],
     root: Optional[str] = None,
     on_file: Optional[Callable[[str], None]] = None,
+    project_paths: Optional[Sequence[str]] = None,
+    cache=None,
 ) -> List[Finding]:
     """Run ``checkers`` over ``paths``; returns unsuppressed findings
-    sorted by (path, line, code)."""
+    sorted by (path, line, code).
+
+    ``project_paths`` (default: ``paths``) names the tree the
+    interprocedural call graph is built over -- pass the full package
+    when ``paths`` is a changed-files subset (``--diff``). ``cache``
+    is an optional :class:`~realhf_tpu.analysis.cache.AnalysisCache`.
+    """
     root = os.path.abspath(root or os.getcwd())
     ast_checkers = [c for c in checkers if isinstance(c, AstChecker)]
+    graph_checkers = [c for c in ast_checkers
+                      if isinstance(c, GraphChecker)]
+    local_checkers = [c for c in ast_checkers
+                      if not isinstance(c, GraphChecker)]
     project_checkers = [c for c in checkers
                         if isinstance(c, ProjectChecker)]
+
+    scan_files = list(iter_python_files(paths, root))
+    if project_paths is not None:
+        all_files = list(iter_python_files(project_paths, root))
+        for p in scan_files:
+            if p not in all_files:
+                all_files.append(p)
+    else:
+        all_files = list(scan_files)
+
+    # read + hash every involved file once; unreadable files drop out
+    sources: Dict[str, str] = {}
+    shas: Dict[str, str] = {}
+    for path in list(all_files):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sources[path] = f.read()
+        except OSError:
+            all_files.remove(path)
+            if path in scan_files:
+                scan_files.remove(path)
+            continue
+        shas[path] = _sha1(sources[path])
+
+    def rel(path: str) -> str:
+        return os.path.relpath(path, root).replace(os.sep, "/")
+
+    full_scan = set(scan_files) == set(all_files)
+    scan_rels = {rel(p) for p in scan_files}
+
+    # whole-tree stamp: any content change re-runs the graph families
+    stamp_parts = [f"{rel(p)}:{shas[p]}" for p in sorted(all_files)]
+    for c in project_checkers:
+        extra = c.stamp_extra(root)
+        if extra:
+            stamp_parts.append(f"{c.name}:{extra}")
+    stamp = _sha1("\n".join(stamp_parts))
+
+    stamped_checkers = list(graph_checkers) + [
+        c for c in project_checkers if c.cacheable]
+    cached_stamped: Dict[str, List[Finding]] = {}
+    if cache is not None:
+        for c in stamped_checkers:
+            hit = cache.get_project(stamp, c.name)
+            if hit is None:
+                cached_stamped = {}
+                break
+            cached_stamped[c.name] = hit
+        cache.stats["project_hit"] = (
+            bool(stamped_checkers) and len(cached_stamped)
+            == len(stamped_checkers))
+    stamped_hit = (cache is not None and stamped_checkers
+                   and len(cached_stamped) == len(stamped_checkers))
+    run_graph = bool(graph_checkers) and not stamped_hit
+
+    # parse what this run actually needs
+    modules: Dict[str, Module] = {}
+
+    def module_for(path: str) -> Optional[Module]:
+        if path not in modules:
+            modules[path] = Module.from_source(path, root,
+                                               sources[path])
+        return modules[path]
+
+    if run_graph:
+        from realhf_tpu.analysis.callgraph import ProjectIndex
+        parsed = [m for m in (module_for(p) for p in all_files)
+                  if m is not None]
+        index = ProjectIndex(parsed)
+        for c in graph_checkers:
+            c.prepare(index)
+
     findings: List[Finding] = []
-    for path in iter_python_files(paths, root):
+    graph_fresh: Dict[str, List[Finding]] = {
+        c.name: [] for c in graph_checkers}
+    for path in scan_files:
         if on_file is not None:
             on_file(path)
-        module = Module.parse(path, root)
-        if module is None:
-            continue
-        for checker in ast_checkers:
-            if (_in_package(module.relpath)
-                    and not checker.applies_to(module.relpath)):
+        relpath = rel(path)
+        in_pkg = _in_package(relpath)
+
+        def want(checker) -> bool:
+            return not in_pkg or checker.applies_to(relpath)
+
+        pending = []
+        for checker in local_checkers:
+            hit = None if cache is None else cache.get_local(
+                relpath, shas[path], checker.name)
+            if hit is not None:
+                findings.extend(hit)
+            else:
+                pending.append(checker)
+        if pending or run_graph:
+            module = module_for(path)
+            if module is None:
                 continue
-            findings.extend(
-                module.suppressions.filter(checker.check(module)))
+            for checker in pending:
+                result = module.suppressions.filter(
+                    checker.check(module)) if want(checker) else []
+                findings.extend(result)
+                if cache is not None:
+                    cache.put_local(relpath, shas[path], checker.name,
+                                    result)
+            if run_graph:
+                for checker in graph_checkers:
+                    result = module.suppressions.filter(
+                        checker.check(module)) if want(checker) else []
+                    findings.extend(result)
+                    graph_fresh[checker.name].extend(result)
+        if stamped_hit:
+            for c in graph_checkers:
+                findings.extend(f for f in cached_stamped[c.name]
+                                if f.path == relpath)
+
     for checker in project_checkers:
-        findings.extend(checker.check_project(root))
+        if checker.cacheable and stamped_hit:
+            findings.extend(f for f in cached_stamped[checker.name]
+                            if full_scan or f.path in scan_rels)
+            continue
+        result = checker.check_project(root)
+        findings.extend(result)
+        if (cache is not None and checker.cacheable and full_scan):
+            cache.put_project(stamp, checker.name, result)
+    if cache is not None and run_graph and full_scan:
+        for name, fs in graph_fresh.items():
+            cache.put_project(stamp, name, fs)
+    if cache is not None:
+        cache.save()
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code,
                                  f.message))
     return findings

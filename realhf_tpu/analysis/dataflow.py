@@ -1,0 +1,56 @@
+"""Shared worklist-dataflow framework over analysis CFGs.
+
+A small forward engine the path-sensitive checker families
+(``lifecycle``, ``terminal``) share. States are immutable; a checker
+supplies
+
+- ``init``: the state entering the function;
+- ``transfer(node, state, kind) -> state``: the effect of executing
+  one statement node along an out-edge of the given kind (NORMAL;
+  TRUE/FALSE for the two arms of a branch header; EXC for the edge an
+  escaping exception takes -- the checker decides which of the
+  statement's effects "happened" on each kind; virtual nodes pass
+  state through);
+- ``join(a, b) -> state``: the merge at control-flow confluences.
+
+``join`` must be monotone over a finite lattice -- the engine
+iterates to fixpoint and returns the in-state of every reachable
+node.
+"""
+
+from typing import Callable, Dict
+
+from realhf_tpu.analysis.cfg import CFG
+
+
+def run_forward(
+    cfg: CFG,
+    init,
+    transfer: Callable,
+    join: Callable,
+    max_iter: int = 100000,
+) -> Dict[int, object]:
+    """Fixpoint forward analysis; returns node idx -> in-state for
+    every node reachable from the entry."""
+    in_states: Dict[int, object] = {cfg.entry: init}
+    work = [cfg.entry]
+    iters = 0
+    while work:
+        iters += 1
+        if iters > max_iter:  # safety valve; lattices here are tiny
+            break
+        idx = work.pop()
+        state = in_states[idx]
+        node = cfg.nodes[idx]
+        post: Dict[str, object] = {}  # per-edge-kind, computed lazily
+        for to, kind in node.succs:
+            if kind not in post:
+                post[kind] = transfer(node, state, kind)
+            out = post[kind]
+            prev = in_states.get(to)
+            merged = out if prev is None else join(prev, out)
+            if prev is None or merged != prev:
+                in_states[to] = merged
+                if to not in work:
+                    work.append(to)
+    return in_states

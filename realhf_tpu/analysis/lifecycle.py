@@ -1,0 +1,486 @@
+"""lifecycle checker: paired-operation discipline on every CFG path.
+
+The runtime is full of acquire/release protocols whose unpaired use
+is a slow leak or a refcount corruption: KV-pool block refs, prefix-
+cache pins, ZMQ sockets, threads, staged checkpoints. This family
+proves, per function and per CFG exit path, that a locally-owned
+resource is released exactly once:
+
+- ``lifecycle-unreleased``: some NORMAL path (return / fall-off-end)
+  exits with the resource still held.
+- ``lifecycle-leak-on-raise``: normal paths release, but a path where
+  an exception escapes between acquire and release leaks it (the fix
+  is ``try/finally`` or an ``except: release; raise``).
+- ``lifecycle-double-release``: a path releases the same resource
+  twice (refcount corruption for pool blocks, ``ZMQError`` for
+  sockets).
+
+What counts as acquire/release comes from the declarative
+:data:`PAIRINGS` registry (docs/static_analysis.md "Pairing
+registry"); adding a protocol is one table row. The analysis only
+tracks resources bound to LOCAL variables whose ownership provably
+stays in the function:
+
+- ``with``-managed acquires are safe by construction and ignored;
+- returning/yielding the resource, storing it on an attribute or into
+  a container, aliasing it, or passing it to an unresolved call all
+  ESCAPE (ownership moved -- someone else releases);
+- passing it to a project function that (transitively, via the call
+  graph) performs the pairing's release counts as the release;
+- ``if v: v.close()`` / ``if v is None: ...`` guards are understood
+  via branch refinement (the not-held arm drops the resource), so
+  the ``v = None; if cond: v = acquire()`` idiom does not
+  false-positive.
+
+Daemon threads (``daemon=True``) are exempt from the
+``Thread.start``/``join`` pairing -- detaching is their design.
+"""
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from realhf_tpu.analysis.cfg import (
+    EXC,
+    FALSE,
+    TRUE,
+    _walk_no_nested,
+    build_cfg,
+    exec_parts,
+    iter_functions,
+)
+from realhf_tpu.analysis.core import (
+    GraphChecker,
+    Module,
+    dotted_name,
+)
+from realhf_tpu.analysis.dataflow import run_forward
+from realhf_tpu.analysis.finding import Finding
+
+#: resource states; per-variable lattice element = frozenset of these.
+#: ESCAPED is absorbing: ownership left the function, nothing more to
+#: prove (and an ``incref`` of an already-escaped local must not
+#: restart tracking -- the escapee owns those refs).
+HELD = "H"
+RELEASED = "R"
+ESCAPED = "E"
+
+
+@dataclasses.dataclass(frozen=True)
+class Pairing:
+    """One acquire/release protocol row.
+
+    ``mode``:
+
+    - ``result``: the acquire's return value is the resource
+      (``blocks = pool.alloc(n)``; ``sock = ctx.socket(...)``);
+    - ``receiver``: a method call on a ctor-produced local is the
+      acquire (``t.start()`` after ``t = threading.Thread(...)``),
+      gated on ``ctor_re``;
+    - ``arg``: the acquire's first argument is the (already-local)
+      resource (``pool.incref(blocks)``).
+
+    A release is a call whose attribute is in ``release_methods``
+    with the resource as receiver (``sock.close()``) or argument
+    (``pool.free(blocks)``; attribute access like ``m.handle``
+    included), or a resolved project call that transitively performs
+    one with the resource as an argument.
+    """
+    label: str
+    mode: str
+    acquire_methods: Tuple[str, ...]
+    release_methods: Tuple[str, ...]
+    receiver_re: str = ""
+    ctor_re: str = ""
+
+
+PAIRINGS: Tuple[Pairing, ...] = (
+    Pairing("kv-pool-blocks", "result", ("alloc",), ("free",),
+            receiver_re=r"pool"),
+    Pairing("kv-pool-blocks", "arg", ("incref",), ("free",),
+            receiver_re=r"pool"),
+    Pairing("prefix-pin", "result", ("match",), ("release",),
+            receiver_re=r"cache|prefix"),
+    Pairing("zmq-socket", "result", ("socket",), ("close",),
+            receiver_re=r"ctx|context"),
+    Pairing("thread-join", "receiver", ("start",), ("join",),
+            ctor_re=r"(?:^|\.)Thread$"),
+    Pairing("staged-ckpt", "result", ("begin",),
+            ("commit", "abort"), receiver_re=r"ckpt|writer|manager|mgr"),
+)
+
+_ALL_ACQUIRE_METHODS = frozenset(
+    m for p in PAIRINGS for m in p.acquire_methods)
+
+#: builtins that only read their argument (no ownership transfer)
+_NEUTRAL_CALLS = {
+    "len", "bool", "str", "repr", "print", "sorted", "min", "max",
+    "sum", "any", "all", "enumerate", "range", "isinstance", "float",
+    "int", "id", "type", "iter", "zip", "hash", "format",
+}
+
+
+def _occurs(var: str, node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in _walk_no_nested(node))
+
+
+def _null_test(test: ast.AST) -> Optional[Tuple[str, str]]:
+    """Recognize ``v`` / ``not v`` / ``v is None`` / ``v is not
+    None`` -> (var, edge kind on which the var is NOT held)."""
+    if isinstance(test, ast.Name):
+        return test.id, FALSE
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and isinstance(test.operand, ast.Name):
+        return test.operand.id, TRUE
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name) \
+            and len(test.comparators) == 1 \
+            and isinstance(test.comparators[0], ast.Constant) \
+            and test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return test.left.id, TRUE
+        if isinstance(test.ops[0], ast.IsNot):
+            return test.left.id, FALSE
+    return None
+
+
+#: shared with the terminal family: what executes AT a node
+_exec_parts = exec_parts
+
+
+class LifecycleChecker(GraphChecker):
+    name = "lifecycle"
+
+    def __init__(self):
+        self.index = None
+        #: (qual, pairing label) -> contains a release-form call
+        self._release_summaries: Dict[Tuple[str, str], bool] = {}
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith((
+            "realhf_tpu/engine/", "realhf_tpu/serving/",
+            "realhf_tpu/system/", "realhf_tpu/base/",
+            "realhf_tpu/apps/", "realhf_tpu/agentic/"))
+
+    # ------------------------------------------------------------------
+    def check(self, module: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        for qualname, fn in iter_functions(module.tree):
+            findings.extend(self._check_function(module, qualname, fn))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _scope_info(self, module: Module, qualname: str):
+        if self.index is None:
+            from realhf_tpu.analysis.callgraph import ProjectIndex
+            self.index = ProjectIndex([module])
+        from realhf_tpu.analysis.callgraph import module_name
+        mod = module_name(module.relpath)
+        return self.index.funcs.get(f"{mod}:{qualname}")
+
+    def _callee_releases(self, call: ast.Call, scope,
+                         pairing: Pairing) -> bool:
+        """Does the call resolve to a project function that
+        (transitively) performs a release-form call of this
+        pairing?"""
+        if scope is None or self.index is None:
+            return False
+        target = self.index.resolve_call(call, scope)
+        if target is None:
+            return False
+
+        def releases(qual: str) -> bool:
+            key = (qual, pairing.label)
+            cached = self._release_summaries.get(key)
+            if cached is None:
+                info = self.index.funcs.get(qual)
+                cached = info is not None and any(
+                    isinstance(c.func, ast.Attribute)
+                    and c.func.attr in pairing.release_methods
+                    for c in self.index.calls_in(qual))
+                self._release_summaries[key] = cached
+            return cached
+
+        if releases(target):
+            return True
+        return self.index.reaches(target, releases,
+                                  max_depth=3) is not None
+
+    # ------------------------------------------------------------------
+    def _check_function(self, module: Module, qualname: str,
+                        fn) -> List[Finding]:
+        body_mod = ast.Module(body=fn.body, type_ignores=[])
+        # cheap prefilter: any acquire-method attribute at all?
+        if not any(isinstance(n, ast.Attribute)
+                   and n.attr in _ALL_ACQUIRE_METHODS
+                   for n in _walk_no_nested(body_mod)):
+            return []
+        scope = self._scope_info(module, qualname)
+        cfg = build_cfg(fn)
+
+        # lexical ctor map for receiver-mode pairings (threads)
+        ctor_vars: Dict[str, bool] = {}
+        for n in _walk_no_nested(body_mod):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            cname = dotted_name(n.value.func)
+            for p in PAIRINGS:
+                if p.mode == "receiver" and p.ctor_re \
+                        and re.search(p.ctor_re, cname):
+                    daemon = next((kw.value for kw in n.value.keywords
+                                   if kw.arg == "daemon"), None)
+                    trackable = daemon is None or (
+                        isinstance(daemon, ast.Constant)
+                        and daemon.value is False)
+                    ctor_vars[n.targets[0].id] = trackable
+
+        # pass 1: acquire sites -> the variables this function owns
+        acquire_sites: Dict[str, Tuple[Pairing, ast.AST, str]] = {}
+        node_acquires: Dict[int, List[Tuple[str, Pairing, str]]] = {}
+        for node in cfg.nodes:
+            if node.stmt is None:
+                continue
+            acq = self._node_acquires(node.stmt, ctor_vars)
+            if acq:
+                node_acquires[node.idx] = acq
+                for var, pairing, recv in acq:
+                    acquire_sites.setdefault(
+                        var, (pairing, node.stmt, recv))
+        if not acquire_sites:
+            return []
+        var_pairing = {v: p for v, (p, _s, _r)
+                       in acquire_sites.items()}
+
+        # pass 2: release/escape events against the owned variables
+        node_events: Dict[int, Dict] = {}
+        for node in cfg.nodes:
+            if node.stmt is None:
+                continue
+            ev = self._node_event(node.stmt, var_pairing,
+                                  node_acquires.get(node.idx, []),
+                                  scope)
+            if ev is not None:
+                node_events[node.idx] = ev
+
+        def transfer(node, state, kind):
+            # On the EXC edge, releases and escapes still count (the
+            # anti-false-positive direction: a raising `free`/`close`
+            # or container-store is treated as having taken effect)
+            # but acquires do not -- a raising acquire acquired
+            # nothing, which is exactly what leak-on-raise needs.
+            ev = node_events.get(node.idx)
+            out = state
+            if ev is not None:
+                out = dict(out)
+                for var, _call in ev["releases"]:
+                    st = out.get(var)
+                    if st:
+                        # per-path: escaped stays escaped (the
+                        # escapee owns the release), held/released
+                        # become released
+                        out[var] = frozenset(
+                            ESCAPED if s == ESCAPED else RELEASED
+                            for s in st)
+                for var in ev["escapes"]:
+                    # unconditional: an escape BEFORE the acquire
+                    # (e.g. stored in a node, then incref'd) must
+                    # block arg-mode tracking too
+                    out[var] = frozenset({ESCAPED})
+                if kind != EXC:
+                    for var, pairing, _recv in ev["acquires"]:
+                        if pairing.mode == "arg" and ESCAPED in \
+                                out.get(var, frozenset()):
+                            continue  # the escapee owns those refs
+                        out[var] = frozenset({HELD})
+            stmt = node.stmt
+            if kind != EXC and stmt is not None \
+                    and isinstance(stmt, (ast.If, ast.While)):
+                nt = _null_test(stmt.test)
+                if nt is not None and nt[0] in out and kind == nt[1]:
+                    out = dict(out)
+                    out.pop(nt[0], None)
+            return out
+
+        def join(a, b):
+            if a == b:
+                return a
+            out = dict(a)
+            for var, st in b.items():
+                out[var] = out.get(var, frozenset()) | st
+            return out
+
+        in_states = run_forward(cfg, {}, transfer, join)
+
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+
+        def report(code: str, var: str, extra: str):
+            if (code, var) in reported:
+                return
+            reported.add((code, var))
+            pairing, site, recv = acquire_sites[var]
+            src = f"`{recv}.{pairing.acquire_methods[0]}(...)`" \
+                if recv else f"`.{pairing.acquire_methods[0]}()`"
+            findings.append(self.finding(
+                module, code, site,
+                f"`{var}` acquired via {src} {extra} "
+                f"(pairing: {pairing.label}; release with "
+                f"{'/'.join(pairing.release_methods)})",
+                symbol=qualname))
+
+        # double release: a release applied to an already-released var
+        for node in cfg.nodes:
+            ev = node_events.get(node.idx)
+            state = in_states.get(node.idx)
+            if ev is None or state is None:
+                continue
+            for var, _call in ev["releases"]:
+                if state.get(var) == frozenset({RELEASED}):
+                    report("lifecycle-double-release", var,
+                           "is released twice on some path")
+        normal_in = in_states.get(cfg.normal_exit, {})
+        raise_in = in_states.get(cfg.raise_exit, {})
+        for var, st in sorted(normal_in.items()):
+            if HELD in st:
+                report("lifecycle-unreleased", var,
+                       "may reach a return with the resource still "
+                       "held")
+        for var, st in sorted(raise_in.items()):
+            if HELD in st and HELD not in normal_in.get(var, set()):
+                report("lifecycle-leak-on-raise", var,
+                       "leaks when an exception escapes before the "
+                       "release (wrap in try/finally)")
+        return findings
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _node_acquires(stmt: ast.stmt, ctor_vars: Dict[str, bool]
+                       ) -> List[Tuple[str, Pairing, str]]:
+        """Acquire events of one statement node. Only whole-statement
+        shapes count (``v = recv.meth(...)`` / ``v.start()`` /
+        ``recv.incref(v)``); acquires inside ``with`` items or nested
+        expressions are context-managed or escaped anyway."""
+        out: List[Tuple[str, Pairing, str]] = []
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute):
+            attr = stmt.value.func.attr
+            recv = dotted_name(stmt.value.func.value)
+            for p in PAIRINGS:
+                if p.mode == "result" and attr in p.acquire_methods \
+                        and recv and re.search(p.receiver_re, recv,
+                                               re.IGNORECASE):
+                    out.append((stmt.targets[0].id, p, recv))
+                    break
+        elif isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Call) \
+                and isinstance(stmt.value.func, ast.Attribute):
+            call = stmt.value
+            attr = call.func.attr
+            for p in PAIRINGS:
+                if p.mode == "receiver" and attr in p.acquire_methods \
+                        and isinstance(call.func.value, ast.Name) \
+                        and ctor_vars.get(call.func.value.id):
+                    out.append((call.func.value.id, p, ""))
+                    break
+                if p.mode == "arg" and attr in p.acquire_methods \
+                        and call.args \
+                        and isinstance(call.args[0], ast.Name):
+                    recv = dotted_name(call.func.value)
+                    if recv and re.search(p.receiver_re, recv,
+                                          re.IGNORECASE):
+                        out.append((call.args[0].id, p, recv))
+                        break
+        return out
+
+    def _node_event(self, stmt: ast.stmt,
+                    var_pairing: Dict[str, Pairing],
+                    acquires: List[Tuple[str, Pairing, str]],
+                    scope) -> Optional[Dict]:
+        parts = _exec_parts(stmt)
+        if not parts:
+            return None
+        releases: List[Tuple[str, ast.Call]] = []
+        escapes: Set[str] = set()
+        acquired_here = {v for v, _p, _r in acquires}
+
+        # which owned vars occur in the executing parts at all?
+        present = {v for v in var_pairing
+                   if any(_occurs(v, part) for part in parts)}
+        if not present and not acquires:
+            return None
+
+        for part in parts:
+            for n in _walk_no_nested(part):
+                if isinstance(n, ast.Call):
+                    self._classify_call(n, present, acquired_here,
+                                        var_pairing, scope,
+                                        releases, escapes)
+                elif isinstance(n, (ast.Yield, ast.YieldFrom)) \
+                        and getattr(n, "value", None) is not None:
+                    escapes |= {v for v in present
+                                if _occurs(v, n.value)}
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            escapes |= {v for v in present if _occurs(v, stmt.value)}
+        elif isinstance(stmt, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)) \
+                and getattr(stmt, "value", None) is not None \
+                and not acquires:
+            escapes |= {v for v in present if _occurs(v, stmt.value)}
+        elif isinstance(stmt, ast.Delete):
+            escapes |= {t.id for t in stmt.targets
+                        if isinstance(t, ast.Name) and t.id in present}
+        elif isinstance(stmt, ast.Raise):
+            escapes |= {v for v in present if _occurs(v, stmt)}
+
+        released_vars = {v for v, _c in releases}
+        escapes -= released_vars | acquired_here
+        if not (acquires or releases or escapes):
+            return None
+        return dict(acquires=acquires, releases=releases,
+                    escapes=escapes)
+
+    def _classify_call(self, call: ast.Call, present: Set[str],
+                       acquired_here: Set[str],
+                       var_pairing: Dict[str, Pairing], scope,
+                       releases: List, escapes: Set[str]) -> None:
+        """Sort one call's owned-variable uses into release / escape /
+        neutral (receiver method calls and read-only builtins)."""
+        func = call.func
+        arg_vars: Set[str] = set()
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in _walk_no_nested(a):
+                if isinstance(n, ast.Name) and n.id in present:
+                    arg_vars.add(n.id)
+        arg_vars -= acquired_here
+
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv_var = func.value.id \
+                if isinstance(func.value, ast.Name) else None
+            if recv_var in present \
+                    and attr in var_pairing[recv_var].release_methods:
+                releases.append((recv_var, call))
+            handled = set()
+            for v in sorted(arg_vars):
+                if attr in var_pairing[v].release_methods:
+                    releases.append((v, call))
+                    handled.add(v)
+            arg_vars -= handled
+            if recv_var in present:
+                return  # method call on the resource itself: neutral
+        elif isinstance(func, ast.Name) and func.id in _NEUTRAL_CALLS:
+            return
+        # remaining argument uses: a resolved project callee that
+        # releases the pairing counts as the release; anything else
+        # takes ownership (escape)
+        for v in sorted(arg_vars):
+            if self._callee_releases(call, scope, var_pairing[v]):
+                releases.append((v, call))
+            else:
+                escapes.add(v)

@@ -1,0 +1,276 @@
+"""lockorder checker: global lock-acquisition ordering, closed over
+the call graph.
+
+PR 3's ``conc-lock-blocking`` sees one function at a time; this
+family sees the whole package. From every ``with <lock>:`` scope it
+derives
+
+- a **lock identity**: ``self._x`` locks key on the enclosing class
+  (``mod:Class._x`` -- every instance of the class orders its locks
+  the same way), module-level locks on ``mod:name``, and function
+  locals on ``mod:func.name``;
+- **ordering edges**: lock B acquired (lexically, or inside any
+  function reachable through the call graph, depth-bounded) while
+  lock A is held adds the edge A -> B.
+
+Two rules:
+
+- ``conc-lock-cycle``: the global ordering graph has a cycle -- two
+  threads taking the same locks in opposite orders deadlock. Each
+  cycle is reported once, at its lexicographically-first witness
+  acquisition, naming the full cycle.
+- ``conc-lock-blocking`` (interprocedural extension): while a lock is
+  held, a call to a project function that TRANSITIVELY performs a
+  blocking operation (``time.sleep``, subprocess, ZMQ send/recv,
+  socket connect/accept, ``name_resolve.wait``) -- the same stall the
+  direct rule catches, hidden one or more calls deep. The direct
+  (same-function) case stays with the ``concurrency`` family; this
+  rule only fires when the blocking call is in a callee, and names
+  the call chain.
+
+Unresolvable lock expressions (``self.obj.locks[k]`` subscripts,
+calls) are skipped entirely -- no identity, no edge, no guess.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from realhf_tpu.analysis.core import GraphChecker, Module, dotted_name
+from realhf_tpu.analysis.finding import Finding
+
+_LOCKISH = re.compile(r"lock|mutex", re.IGNORECASE)
+
+#: transitive blocking triggers: exact dotted calls...
+_BLOCKING_CALLS = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "subprocess.Popen", "name_resolve.wait",
+    "name_resolve.get_subtree", "socket.create_connection",
+}
+#: ... and method names unambiguous enough to trust on any receiver
+#: (bare ``join``/``wait`` stay out: str.join / Event.wait-with-
+#: timeout would drown the rule in noise)
+_BLOCKING_METHODS = {
+    "send_multipart", "send_pyobj", "send_string", "send_json",
+    "recv", "recv_multipart", "recv_pyobj", "recv_string",
+    "recv_json", "accept",
+}
+
+
+def _lock_expr_key(expr: ast.AST, mod: str, cls: Optional[str],
+                   func: str, module_globals) -> Optional[str]:
+    """Canonical identity of a lock expression, or None when the
+    expression cannot be pinned to one lock object."""
+    dotted = dotted_name(expr)
+    if not dotted or not _LOCKISH.search(dotted):
+        return None
+    parts = dotted.split(".")
+    if parts[0] == "self":
+        if cls is None or len(parts) != 2:
+            return None
+        return f"{mod}:{cls}.{parts[1]}"
+    if len(parts) == 1:
+        if parts[0] in module_globals:
+            return f"{mod}:{parts[0]}"  # one lock per module
+        return f"{mod}:{func}.{parts[0]}"
+    return f"{mod}:{dotted}"
+
+
+class LockOrderChecker(GraphChecker):
+    name = "lockorder"
+
+    def __init__(self):
+        self.index = None
+        self._blocking_summaries: Dict[str, Optional[str]] = {}
+        #: lock graph: edge (A, B) -> witness (relpath, node, symbol)
+        self._edges: Dict[Tuple[str, str], Tuple] = {}
+        #: per-module findings computed once for the whole project
+        self._by_module: Optional[Dict[str, List[Finding]]] = None
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith((
+            "realhf_tpu/system/", "realhf_tpu/serving/",
+            "realhf_tpu/base/", "realhf_tpu/apps/",
+            "realhf_tpu/parallel/", "realhf_tpu/engine/",
+            "realhf_tpu/obs/"))
+
+    # ------------------------------------------------------------------
+    def prepare(self, index) -> None:
+        self.index = index
+        self._by_module = None
+        self._edges = {}
+        self._blocking_summaries = {}
+
+    def check(self, module: Module) -> List[Finding]:
+        if self.index is None:
+            from realhf_tpu.analysis.callgraph import ProjectIndex
+            self.index = ProjectIndex([module])
+        if self._by_module is None:
+            self._by_module = self._analyze_project()
+        return self._by_module.get(module.relpath, [])
+
+    # ------------------------------------------------------------------
+    def _direct_blocking(self, qual: str) -> Optional[str]:
+        """Name of a blocking call the function performs directly."""
+        for call in self.index.calls_in(qual):
+            nm = dotted_name(call.func)
+            if nm in _BLOCKING_CALLS:
+                return nm
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in _BLOCKING_METHODS:
+                return f".{call.func.attr}"
+        return None
+
+    def _blocking_chain(self, qual: str,
+                        max_depth: int = 4) -> Optional[List[str]]:
+        """Call chain from ``qual`` (inclusive) to a function with a
+        direct blocking call, or None."""
+        def blocks(q: str) -> bool:
+            if q not in self._blocking_summaries:
+                self._blocking_summaries[q] = self._direct_blocking(q)
+            return self._blocking_summaries[q] is not None
+
+        if blocks(qual):
+            return [qual]
+        chain = self.index.reaches(qual, blocks, max_depth=max_depth)
+        return chain
+
+    # ------------------------------------------------------------------
+    def _analyze_project(self) -> Dict[str, List[Finding]]:
+        # sweep every indexed function once: collect lexical lock
+        # scopes, ordering edges, and interprocedural blocking calls
+        by_module: Dict[str, List[Finding]] = {}
+        #: qual -> locks acquired anywhere inside (for interproc
+        #: ordering edges); computed in the same sweep
+        acquired_in: Dict[str, Set[str]] = {}
+        #: (holder qual, held key, call node, callee qual) to check
+        #: for interprocedural blocking/acquisition
+        held_calls: List[Tuple] = []
+
+        for qual in sorted(self.index.funcs):
+            info = self.index.funcs[qual]
+            mod, cls, fname = info.module, info.cls, info.name
+            cls_name = cls.split(":", 1)[1] if cls else None
+            acquired: Set[str] = set()
+            module_rel = info.relpath
+            mod_globals = self.index.module_globals.get(mod, set())
+
+            def visit(node, held: Tuple[str, ...]):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef, ast.Lambda)):
+                    return
+                new_held = held
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    keys = []
+                    for item in node.items:
+                        key = _lock_expr_key(item.context_expr, mod,
+                                             cls_name, fname,
+                                             mod_globals)
+                        if key is not None:
+                            keys.append((key, item.context_expr))
+                    for key, expr in keys:
+                        acquired.add(key)
+                        for h in held:
+                            if h != key:
+                                self._edges.setdefault(
+                                    (h, key),
+                                    (module_rel, expr, qual))
+                        new_held = new_held + (key,)
+                if isinstance(node, ast.Call) and held:
+                    callee = self.index.resolve_call(node, info)
+                    if callee is not None:
+                        for h in held:
+                            held_calls.append((qual, h, node, callee,
+                                               module_rel))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, new_held)
+
+            for stmt in info.node.body:
+                visit(stmt, ())
+            acquired_in[qual] = acquired
+
+        # interprocedural closure: locks acquired by (transitive)
+        # callees order after the held lock; blocking callees report
+        def transitive_locks(qual: str, depth: int = 3,
+                             _seen=None) -> Set[str]:
+            _seen = _seen if _seen is not None else set()
+            if qual in _seen or depth < 0:
+                return set()
+            _seen.add(qual)
+            out = set(acquired_in.get(qual, ()))
+            for callee in self.index.callees(qual):
+                out |= transitive_locks(callee, depth - 1, _seen)
+            return out
+
+        reported_blocking: Set[Tuple[str, str, str]] = set()
+        for holder, held_key, call, callee, module_rel in held_calls:
+            for lock in sorted(transitive_locks(callee)):
+                if lock != held_key:
+                    self._edges.setdefault(
+                        (held_key, lock), (module_rel, call, holder))
+            chain = self._blocking_chain(callee)
+            if chain is not None:
+                key = (holder, held_key, callee)
+                if key in reported_blocking:
+                    continue
+                reported_blocking.add(key)
+                what = self._blocking_summaries.get(chain[-1]) or "?"
+                via = " -> ".join(q.split(":", 1)[1] for q in chain)
+                by_module.setdefault(module_rel, []).append(Finding(
+                    checker=self.name, code="conc-lock-blocking",
+                    path=module_rel,
+                    line=getattr(call, "lineno", 0),
+                    col=getattr(call, "col_offset", 0),
+                    message=(f"call to `{via}` while holding "
+                             f"`{held_key}`: it transitively performs "
+                             f"blocking `{what}` -- a stalled peer "
+                             "then stalls every thread contending "
+                             "for the lock"),
+                    symbol=holder.split(":", 1)[1]))
+
+        # cycle detection over the ordering graph
+        for cycle in self._find_cycles():
+            edge = (cycle[0], cycle[1 % len(cycle)])
+            witness = self._edges.get(edge)
+            if witness is None:
+                continue
+            module_rel, node, qual = witness
+            pretty = " -> ".join(cycle + [cycle[0]])
+            by_module.setdefault(module_rel, []).append(Finding(
+                checker=self.name, code="conc-lock-cycle",
+                path=module_rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                message=(f"lock-order cycle {pretty}: two threads "
+                         "taking these locks in opposite orders "
+                         "deadlock; pick one global order"),
+                symbol=qual.split(":", 1)[1]))
+        for rel in by_module:
+            by_module[rel].sort(key=lambda f: (f.line, f.code))
+        return by_module
+
+    # ------------------------------------------------------------------
+    def _find_cycles(self) -> List[List[str]]:
+        """Elementary cycles of the lock graph, canonicalized (each
+        reported once, rotated to start at its smallest key)."""
+        graph: Dict[str, Set[str]] = {}
+        for a, b in self._edges:
+            graph.setdefault(a, set()).add(b)
+        cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, cur: str, path: List[str],
+                seen: Set[str]):
+            for nxt in sorted(graph.get(cur, ())):
+                if nxt == start and len(path) > 1:
+                    i = path.index(min(path))
+                    cycles.add(tuple(path[i:] + path[:i]))
+                elif nxt not in seen and len(path) < 8:
+                    seen.add(nxt)
+                    dfs(start, nxt, path + [nxt], seen)
+                    seen.discard(nxt)
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return [list(c) for c in sorted(cycles)]

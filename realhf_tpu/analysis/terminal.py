@@ -1,0 +1,251 @@
+"""terminal checker: exactly-once terminal delivery in the serving
+protocol handlers.
+
+The serving wire contract (docs/serving.md "The request lifecycle")
+says a request retired from a live routing table must leave behind
+exactly one terminal event, and the route/owner entry may only be
+dropped AFTER the terminal send succeeded -- the PR 2 and PR 12
+postmortems ("route dropped only after send succeeds", "owner left
+pointing at drained replica") are both instances. This family checks
+every CFG path of the handlers in ``serving/{scheduler,router,
+server}.py``:
+
+- ``proto-missing-terminal``: a path retires an rid from a live table
+  (:data:`LIVE_TABLES`: ``_routes`` / ``_requests`` / ``_pending``
+  via ``pop``/``remove``/``discard``/``clear``/``del``) and reaches
+  the function's normal exit without any terminal-ish call on that
+  path -- the client waits forever on a stream nobody owns.
+- ``proto-drop-before-send``: the only terminal on the path happens
+  AFTER the retire -- if the send fails, the terminal is lost for
+  good because the route is already gone. Send first, drop the route
+  only on success (``server.py:_send`` is the canonical shape).
+
+"Terminal-ish" is resolved interprocedurally: a raw socket send
+(``send_multipart`` & friends, or ``send`` on a socket-named
+receiver), one of the :data:`TERMINAL_HELPERS` by name, or any
+project call that transitively reaches a raw send through the call
+graph.
+
+Scheduler-side slot/parked retirement is NOT checked here: those
+retire through helpers (``_evict``, ``take_parked``) whose terminals
+are emitted by their callers against the returned value -- a
+contract the per-function path analysis cannot see
+(docs/static_analysis.md "What the engine cannot resolve").
+Deliberate silent drops (fence flushes) carry inline disables with
+their justification.
+"""
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from realhf_tpu.analysis.cfg import (
+    EXC,
+    _walk_no_nested,
+    build_cfg,
+    iter_functions,
+)
+from realhf_tpu.analysis.core import GraphChecker, Module, dotted_name
+from realhf_tpu.analysis.finding import Finding
+
+#: attributes holding rid -> route/request state the protocol owes a
+#: terminal for
+LIVE_TABLES = ("_routes", "_requests", "_pending")
+#: mutations that retire an entry from a live table
+RETIRE_METHODS = ("pop", "remove", "discard", "clear")
+#: unambiguous raw send primitives
+RAW_SEND_ATTRS = ("send_multipart", "send_pyobj", "send_string",
+                  "send_json")
+#: ``.send(...)`` counts only on a receiver that is plainly a socket
+SOCKETISH = ("sock", "front", "socket")
+#: helper names that deliver terminals (fallback when the call graph
+#: cannot resolve the callee)
+TERMINAL_HELPERS = ("_send", "_reply", "_forward", "_finish",
+                    "_deliver", "_fail_assignment", "_send_ident",
+                    "_bounce")
+
+_SCOPE_FILES = ("realhf_tpu/serving/scheduler.py",
+                "realhf_tpu/serving/router.py",
+                "realhf_tpu/serving/server.py")
+
+
+def _is_raw_send(call: ast.Call) -> bool:
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr in RAW_SEND_ATTRS:
+        return True
+    if func.attr == "send":
+        recv = dotted_name(func.value).lower()
+        return any(s in recv for s in SOCKETISH)
+    return False
+
+
+def _retire_tables(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(table attr, node) for every live-table retirement in the
+    subtree."""
+    out: List[Tuple[str, ast.AST]] = []
+    for n in _walk_no_nested(tree):
+        if isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in RETIRE_METHODS:
+            recv = dotted_name(n.func.value)
+            last = recv.rsplit(".", 1)[-1] if recv else ""
+            if last in LIVE_TABLES:
+                out.append((last, n))
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript):
+                    recv = dotted_name(t.value)
+                    last = recv.rsplit(".", 1)[-1] if recv else ""
+                    if last in LIVE_TABLES:
+                        out.append((last, t))
+    return out
+
+
+class TerminalChecker(GraphChecker):
+    name = "terminal"
+
+    def __init__(self):
+        self.index = None
+        self._send_summaries: Dict[str, bool] = {}
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in _SCOPE_FILES
+
+    # ------------------------------------------------------------------
+    def check(self, module: Module) -> List[Finding]:
+        if self.index is None:
+            from realhf_tpu.analysis.callgraph import ProjectIndex
+            self.index = ProjectIndex([module])
+        findings: List[Finding] = []
+        for qualname, fn in iter_functions(module.tree):
+            findings.extend(self._check_function(module, qualname, fn))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _resolves_to_send(self, call: ast.Call, scope) -> bool:
+        if self.index is None or scope is None:
+            return False
+        target = self.index.resolve_call(call, scope)
+        if target is None:
+            return False
+
+        def sends(qual: str) -> bool:
+            cached = self._send_summaries.get(qual)
+            if cached is None:
+                info = self.index.funcs.get(qual)
+                cached = info is not None and any(
+                    _is_raw_send(c) for c in self.index.calls_in(qual))
+                self._send_summaries[qual] = cached
+            return cached
+
+        if sends(target):
+            return True
+        return self.index.reaches(target, sends,
+                                  max_depth=4) is not None
+
+    def _is_terminal_call(self, call: ast.Call, scope) -> bool:
+        if _is_raw_send(call):
+            return True
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        if name in TERMINAL_HELPERS:
+            return True
+        return self._resolves_to_send(call, scope)
+
+    # ------------------------------------------------------------------
+    def _check_function(self, module: Module, qualname: str,
+                        fn) -> List[Finding]:
+        body_mod = ast.Module(body=fn.body, type_ignores=[])
+        if not _retire_tables(body_mod):
+            return []
+        scope = None
+        if self.index is not None:
+            from realhf_tpu.analysis.callgraph import module_name
+            mod = module_name(module.relpath)
+            scope = self.index.funcs.get(f"{mod}:{qualname}")
+
+        from realhf_tpu.analysis.dataflow import run_forward
+        from realhf_tpu.analysis.lifecycle import _exec_parts
+
+        cfg = build_cfg(fn)
+        # node idx -> (retires [(table, ast node)], is_terminal)
+        node_info: Dict[int, Tuple[List, bool]] = {}
+        for node in cfg.nodes:
+            if node.stmt is None:
+                continue
+            retires: List[Tuple[str, ast.AST]] = []
+            terminal = False
+            for part in _exec_parts(node.stmt):
+                retires.extend(_retire_tables(part))
+                for n in _walk_no_nested(part):
+                    if isinstance(n, ast.Call) \
+                            and self._is_terminal_call(n, scope):
+                        terminal = True
+            if retires or terminal:
+                node_info[node.idx] = (retires, terminal)
+
+        if not any(retires for retires, _t in node_info.values()):
+            return []
+
+        # state: (unterm: some path here has no terminal yet,
+        #         bad: frozenset of retire node idxs that happened on
+        #              such a path and saw no terminal since)
+        init = (True, frozenset())
+
+        def transfer(node, state, kind):
+            if kind == EXC:
+                return state  # the statement didn't happen
+            unterm, bad = state
+            info = node_info.get(node.idx)
+            if info is None:
+                return state
+            retires, terminal = info
+            if retires and unterm:
+                bad = bad | {node.idx}
+            if terminal:
+                return (False, frozenset())
+            return (unterm, bad)
+
+        def join(a, b):
+            return (a[0] or b[0], a[1] | b[1])
+
+        in_states = run_forward(cfg, init, transfer, join)
+
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, int]] = set()
+
+        def report(code: str, node_idx: int, msg: str):
+            if (code, node_idx) in reported:
+                return
+            reported.add((code, node_idx))
+            retires, _t = node_info[node_idx]
+            table, where = retires[0]
+            findings.append(self.finding(
+                module, code, where, msg.format(table=table),
+                symbol=qualname))
+
+        # drop-before-send: a terminal fires while retires are open
+        for node in cfg.nodes:
+            info = node_info.get(node.idx)
+            state = in_states.get(node.idx)
+            if info is None or state is None or not info[1]:
+                continue
+            for idx in sorted(state[1]):
+                report(
+                    "proto-drop-before-send", idx,
+                    "`{table}` entry retired BEFORE the terminal "
+                    "send on this path -- a failed send then loses "
+                    "the terminal for good; send first, drop the "
+                    "route only on success")
+        exit_state = in_states.get(cfg.normal_exit)
+        if exit_state is not None:
+            for idx in sorted(exit_state[1]):
+                report(
+                    "proto-missing-terminal", idx,
+                    "path retires an rid from `{table}` but emits no "
+                    "terminal event before returning -- the client "
+                    "waits forever; send done/rejected/cancelled/"
+                    "bounce exactly once")
+        return findings

@@ -89,9 +89,16 @@ class FakeSlotBackend:
             n_alias = c // pool.block_len
             own = pool.alloc(pool.blocks_for_rows(len(prompt))
                              - n_alias)  # may raise KVPoolOOM
-            alias = [int(b) for b in (cached_blocks or [])[:n_alias]]
-            if alias:
-                pool.incref(alias)
+            try:
+                alias = [int(b)
+                         for b in (cached_blocks or [])[:n_alias]]
+                if alias:
+                    pool.incref(alias)
+            except BaseException:
+                # mirror the real backend: a bad alias chain must not
+                # leak the fresh blocks (nothing references them yet)
+                pool.free(own)
+                raise
             self._blocks[slot] = alias + own
             self._plens[slot] = len(prompt)
         self._slots[slot] = [int_id, int(prompt[0]), 0]

@@ -738,10 +738,17 @@ class InflightBatchingGenerator:
                 f"but cached_len {c} spans {n_alias}")
         own = self.kv_pool.alloc(
             self.kv_pool.blocks_for_rows(n) - n_alias)
-        alias = [int(b) for b in cached_blocks[:n_alias]] if c > 0 \
-            else []
-        if alias:
-            self.kv_pool.incref(alias)
+        try:
+            alias = [int(b) for b in cached_blocks[:n_alias]] \
+                if c > 0 else []
+            if alias:
+                self.kv_pool.incref(alias)
+        except BaseException:
+            # a bad alias chain (stale cached block id) must not leak
+            # the freshly-allocated blocks: nothing references them
+            # yet, so release_slot could never reclaim them
+            self.kv_pool.free(own)
+            raise
         blocks = alias + own
         self._slot_blocks[slot] = blocks
         self._bt_host[slot, :] = 0

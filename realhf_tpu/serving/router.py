@@ -264,7 +264,13 @@ class FleetRouter:
 
     def _connect(self, info: ReplicaInfo) -> object:
         sock = self._ctx.socket(zmq.DEALER)
-        sock.connect(info.address)
+        try:
+            sock.connect(info.address)
+        except BaseException:
+            # a malformed replica address must not leak the socket
+            # (graft-lint lifecycle-leak-on-raise)
+            sock.close(0)
+            raise
         return sock
 
     def _refresh_fleet(self, force: bool = False):

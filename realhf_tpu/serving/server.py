@@ -270,7 +270,12 @@ class RolloutServer:
             self.scheduler.cancel(rid)
             dropped += 1
         with self._routes_lock:
-            self._routes.clear()
+            # deliberate terminal-less retirement: a FENCED replica
+            # must deliver nothing -- the router already failed this
+            # work over, and a late terminal from here would be a
+            # duplicate (docs/serving.md "Fleet, failover & circuit
+            # breakers")
+            self._routes.clear()  # graft-lint: disable=proto-missing-terminal
         for sp in self._request_spans.values():
             sp.set_attribute("outcome", "fenced")
             sp.finish()

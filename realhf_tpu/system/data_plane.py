@@ -245,7 +245,13 @@ class DataClient:
             cached[1].close(0)
             del self._socks[worker_name]
         s = self._ctx.socket(zmq.REQ)
-        s.connect(addr)
+        try:
+            s.connect(addr)
+        except BaseException:
+            # a bad registered address must not leak the socket
+            # (graft-lint lifecycle-leak-on-raise)
+            s.close(0)
+            raise
         self._socks[worker_name] = (addr, s)
         return s
 

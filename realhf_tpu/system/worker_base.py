@@ -227,7 +227,13 @@ class WorkerControlPanel:
             addr = name_resolve.wait(
                 names.worker_key(self._exp, self._trial, w), timeout=timeout)
             s = self._ctx.socket(zmq.REQ)
-            s.connect(addr)
+            try:
+                s.connect(addr)
+            except BaseException:
+                # a bad resolved address must not leak the socket
+                # (graft-lint lifecycle-leak-on-raise)
+                s.close(0)
+                raise
             self._socks[w] = s
 
     def group_request(self, command: str,

@@ -26,6 +26,7 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 #: Directories the default (tests/) run must collect at least one
 #: test from. A deleted/renamed suite -- or one whose conftest-level
@@ -96,18 +97,28 @@ def check_collection(args=None, cwd=None):
 def run_lint_gate(cwd=None):
     """Returns (ok: bool, report: str): graft-lint in --fail-on-new
     mode. New findings (vs scripts/lint_baseline.json) print as
-    ``NEW path:line:col: checker-code: message``."""
+    ``NEW path:line:col: checker-code: message``. The gate reports
+    its wall time -- results cache under ``.graft_lint_cache/``
+    (content-hash keyed), so warm runs must stay cheap; the
+    tier-1 budget test (tests/analysis/test_cache_diff.py) pins the
+    bound."""
     cwd = cwd or os.getcwd()
     if not os.path.exists(os.path.join(cwd, LINT_BASELINE)):
         return True, "Lint gate skipped (no lint baseline here)."
+    t0 = time.monotonic()
     proc = subprocess.run(
         [sys.executable, "-m", "realhf_tpu.analysis", "--fail-on-new",
          "--baseline", LINT_BASELINE],
         capture_output=True, text=True, cwd=cwd)
+    dt = time.monotonic() - t0
     out = (proc.stdout + proc.stderr).strip()
     if proc.returncode == 0:
-        return True, f"Lint gate OK. {out.splitlines()[-1] if out else ''}"
-    return False, f"Lint gate FAILED (new findings vs baseline):\n{out}"
+        tail = out.splitlines()[-1] if out else ""
+        return True, (f"Lint gate OK in {dt:.1f}s. {tail}\n"
+                      "(tip: `python -m realhf_tpu.analysis --diff "
+                      "HEAD` lints only your changed files)")
+    return False, (f"Lint gate FAILED in {dt:.1f}s (new findings vs "
+                   f"baseline):\n{out}")
 
 
 def main():

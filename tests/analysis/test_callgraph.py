@@ -1,0 +1,121 @@
+"""Call-graph construction and conservative call resolution."""
+
+import ast
+import textwrap
+
+from realhf_tpu.analysis.callgraph import ProjectIndex, module_name
+from realhf_tpu.analysis.core import Module
+
+
+def mod_of(relpath, src, root="/r"):
+    src = textwrap.dedent(src)
+    return Module(path=f"{root}/{relpath}", relpath=relpath,
+                  source=src, tree=ast.parse(src),
+                  suppressions=None)
+
+
+def calls_of(index, qual):
+    info = index.funcs[qual]
+    return {index.resolve_call(c, info)
+            for c in index.calls_in(qual)}
+
+
+UTIL = """
+    def helper(x):
+        return x
+
+    def blocker():
+        import time
+        time.sleep(1)
+
+    class Base:
+        def common(self):
+            return 1
+"""
+
+MAIN = """
+    from pkg.util import Base, helper
+    import pkg.util as util
+
+    def top(x):
+        return helper(x)
+
+    class C(Base):
+        def m(self):
+            return self.other()
+
+        def other(self):
+            util.blocker()
+            return self.common()
+
+        def dynamic(self, obj):
+            return obj.whatever()
+"""
+
+
+def make_index():
+    return ProjectIndex([
+        mod_of("pkg/util.py", UTIL),
+        mod_of("pkg/main.py", MAIN),
+    ])
+
+
+# ----------------------------------------------------------------------
+def test_module_name():
+    assert module_name("pkg/util.py") == "pkg.util"
+    assert module_name("pkg/__init__.py") == "pkg"
+    assert module_name("mod.py") == "mod"
+
+
+def test_from_import_and_alias_resolution():
+    idx = make_index()
+    assert calls_of(idx, "pkg.main:top") == {"pkg.util:helper"}
+    assert "pkg.util:blocker" in calls_of(idx, "pkg.main:C.other")
+
+
+def test_self_method_and_base_class_resolution():
+    idx = make_index()
+    assert calls_of(idx, "pkg.main:C.m") == {"pkg.main:C.other"}
+    # self.common() resolves through the imported base class
+    assert "pkg.util:Base.common" in calls_of(idx, "pkg.main:C.other")
+
+
+def test_unknown_receiver_is_unresolved():
+    idx = make_index()
+    assert calls_of(idx, "pkg.main:C.dynamic") == {None}
+
+
+def test_reaches_returns_chain_and_respects_depth():
+    idx = make_index()
+
+    def is_blocker(q):
+        return q == "pkg.util:blocker"
+
+    chain = idx.reaches("pkg.main:C.m", is_blocker, max_depth=3)
+    assert chain == ["pkg.main:C.m", "pkg.main:C.other",
+                     "pkg.util:blocker"]
+    assert idx.reaches("pkg.main:C.m", is_blocker, max_depth=1) is None
+
+
+def test_relative_import_resolution():
+    idx = ProjectIndex([
+        mod_of("pkg/util.py", UTIL),
+        mod_of("pkg/rel.py", """
+            from .util import helper
+
+            def go(x):
+                return helper(x)
+        """),
+    ])
+    assert calls_of(idx, "pkg.rel:go") == {"pkg.util:helper"}
+
+
+def test_module_globals_collected():
+    idx = ProjectIndex([mod_of("pkg/locks.py", """
+        import threading
+        big_lock = threading.Lock()
+
+        def f():
+            pass
+    """)])
+    assert "big_lock" in idx.module_globals["pkg.locks"]

@@ -1,0 +1,206 @@
+"""CFG builder: exceptional edges, finally duplication, loops."""
+
+import ast
+import textwrap
+
+from realhf_tpu.analysis.cfg import (
+    EXC,
+    FALSE,
+    TRUE,
+    build_cfg,
+    iter_functions,
+    may_raise,
+)
+
+
+def cfg_of(src):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in tree.body
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    return build_cfg(fn)
+
+
+def node_named(cfg, fragment):
+    """The node whose statement matches `fragment` most tightly (a
+    compound header's unparse contains its whole body)."""
+    matches = [n for n in cfg.nodes
+               if n.stmt is not None
+               and fragment in ast.unparse(n.stmt)]
+    if not matches:
+        raise AssertionError(f"no node matching {fragment!r}")
+    return min(matches, key=lambda n: len(ast.unparse(n.stmt)))
+
+
+def path_exists(cfg, frm, to, avoid=()):
+    """DFS: is `to` reachable from `frm` without touching `avoid`?"""
+    avoid = set(avoid)
+    seen, stack = set(), [frm]
+    while stack:
+        cur = stack.pop()
+        if cur == to:
+            return True
+        if cur in seen or cur in avoid:
+            continue
+        seen.add(cur)
+        stack.extend(t for t, _k in cfg.nodes[cur].succs)
+    return False
+
+
+# ----------------------------------------------------------------------
+def test_straight_line_and_exc_edges():
+    cfg = cfg_of("""
+        def f(x):
+            a = x + 1
+            b = g(a)
+            return b
+    """)
+    add = node_named(cfg, "a = x + 1")
+    call = node_named(cfg, "b = g(a)")
+    # pure arithmetic: no exceptional edge; the call: one
+    assert all(k != EXC for _t, k in add.succs)
+    assert (cfg.raise_exit, EXC) in call.succs
+    assert path_exists(cfg, cfg.entry, cfg.normal_exit)
+
+
+def test_if_branches_are_kinded_and_join():
+    cfg = cfg_of("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+    """)
+    hdr = node_named(cfg, "if x:")
+    kinds = {k for _t, k in hdr.succs}
+    assert TRUE in kinds and FALSE in kinds
+    ret = node_named(cfg, "return a")
+    assert path_exists(cfg, node_named(cfg, "a = 1").idx, ret.idx)
+    assert path_exists(cfg, node_named(cfg, "a = 2").idx, ret.idx)
+
+
+def test_while_loop_back_edge_break_and_infinite():
+    cfg = cfg_of("""
+        def f(x):
+            while x > 0:
+                x -= 1
+            return x
+    """)
+    hdr = node_named(cfg, "while x > 0:")
+    body = node_named(cfg, "x -= 1")
+    assert (hdr.idx, "normal") in [(t, k) for t, k in body.succs]
+    assert path_exists(cfg, hdr.idx, cfg.normal_exit)
+
+    # `while True` with no break has no fall-through exit
+    cfg2 = cfg_of("""
+        def f(x):
+            while True:
+                x += 1
+    """)
+    assert not path_exists(cfg2, cfg2.entry, cfg2.normal_exit)
+
+    cfg3 = cfg_of("""
+        def f(x):
+            while True:
+                if x:
+                    break
+            return x
+    """)
+    assert path_exists(cfg3, cfg3.entry, cfg3.normal_exit)
+
+
+def test_return_inside_try_runs_finally():
+    cfg = cfg_of("""
+        def f(res):
+            try:
+                if res.bad:
+                    return None
+                use(res)
+            finally:
+                res.release()
+    """)
+    ret = node_named(cfg, "return None")
+    # the early return cannot reach the exit without the finally body
+    releases = [n.idx for n in cfg.nodes
+                if n.stmt is not None
+                and "res.release()" in ast.unparse(n.stmt)]
+    assert len(releases) >= 2  # duplicated per path (return/exc/normal)
+    assert not path_exists(cfg, ret.idx, cfg.normal_exit,
+                           avoid=releases)
+
+
+def test_exception_in_try_reaches_finally_then_raise_exit():
+    cfg = cfg_of("""
+        def f(res):
+            try:
+                use(res)
+            finally:
+                res.release()
+    """)
+    use = node_named(cfg, "use(res)")
+    releases = [n.idx for n in cfg.nodes
+                if n.stmt is not None
+                and "res.release" in ast.unparse(n.stmt)]
+    assert path_exists(cfg, use.idx, cfg.raise_exit)
+    assert not path_exists(cfg, use.idx, cfg.raise_exit,
+                           avoid=releases)
+
+
+def test_typed_handler_keeps_unmatched_path_catchall_removes_it():
+    typed = cfg_of("""
+        def f(s):
+            try:
+                risky(s)
+            except ValueError:
+                s.close()
+                raise
+            return s
+    """)
+    risky = node_named(typed, "risky(s)")
+    closes = [n.idx for n in typed.nodes
+              if n.stmt is not None
+              and "s.close" in ast.unparse(n.stmt)]
+    # a non-ValueError escapes without running the handler
+    assert path_exists(typed, risky.idx, typed.raise_exit,
+                       avoid=closes)
+
+    catchall = cfg_of("""
+        def f(s):
+            try:
+                risky(s)
+            except BaseException:
+                s.close()
+                raise
+            return s
+    """)
+    risky2 = node_named(catchall, "risky(s)")
+    closes2 = [n.idx for n in catchall.nodes
+               if n.stmt is not None
+               and "s.close" in ast.unparse(n.stmt)]
+    assert path_exists(catchall, risky2.idx, catchall.raise_exit)
+    assert not path_exists(catchall, risky2.idx, catchall.raise_exit,
+                           avoid=closes2)
+
+
+def test_may_raise_ignores_nested_defs():
+    stmt = ast.parse(textwrap.dedent("""
+        def outer():
+            def inner():
+                risky()
+            x = 1
+    """)).body[0]
+    nested_def, assign = stmt.body
+    assert not may_raise(nested_def)
+    assert not may_raise(assign)
+    assert may_raise(ast.parse("assert x").body[0])
+
+
+def test_iter_functions_yields_methods_and_nested():
+    tree = ast.parse(textwrap.dedent("""
+        def top(): pass
+        class C:
+            def m(self):
+                def inner(): pass
+    """))
+    quals = {q for q, _fn in iter_functions(tree)}
+    assert quals == {"top", "C.m", "C.m.inner"}

@@ -1,0 +1,243 @@
+"""lifecycle checker: TP + TN fixtures for every rule code."""
+
+import textwrap
+
+from realhf_tpu.analysis.lifecycle import LifecycleChecker
+
+
+def check(make_module, src, relpath="fixtures/mod.py"):
+    module = make_module(textwrap.dedent(src), relpath)
+    return LifecycleChecker().check(module)
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_unreleased_on_fall_off_end(make_module, codes_of):
+    fs = check(make_module, """
+        def serve(ctx):
+            sock = ctx.socket(1)
+            sock.bind("tcp://*:0")
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+    assert "`sock`" in fs[0].message and fs[0].symbol == "serve"
+
+
+def test_unreleased_on_early_return_branch(make_module, codes_of):
+    fs = check(make_module, """
+        def fill(pool, n):
+            blocks = pool.alloc(n)
+            if n > 4:
+                return None
+            pool.free(blocks)
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+
+
+def test_leak_on_raise_between_acquire_and_release(make_module,
+                                                   codes_of):
+    fs = check(make_module, """
+        def fill(pool, n):
+            blocks = pool.alloc(n)
+            validate(n)
+            pool.free(blocks)
+    """)
+    assert codes_of(fs) == ["lifecycle-leak-on-raise"]
+
+
+def test_double_release(make_module, codes_of):
+    fs = check(make_module, """
+        def twice(ctx):
+            sock = ctx.socket(1)
+            sock.close()
+            sock.close()
+    """)
+    assert "lifecycle-double-release" in codes_of(fs)
+
+
+def test_thread_started_never_joined(make_module, codes_of):
+    fs = check(make_module, """
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            fn()
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+
+
+def test_staged_ckpt_commit_missing_on_branch(make_module, codes_of):
+    fs = check(make_module, """
+        def save(mgr, data):
+            writer = mgr.begin(1)
+            if not data:
+                return None
+            writer.commit()
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+
+
+def test_prefix_pin_released_only_on_hit_path(make_module, codes_of):
+    fs = check(make_module, """
+        def fill(cache, prompt):
+            m = cache.match(prompt)
+            if m.cached_len:
+                seed(m.cached_len)
+                cache.release(m.handle)
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+
+
+# ----------------------------------------------------------------------
+# true negatives
+# ----------------------------------------------------------------------
+def test_try_finally_release_is_clean(make_module):
+    assert check(make_module, """
+        def fill(cache, prompt, backend):
+            m = cache.match(prompt)
+            try:
+                backend.fill(prompt, m.cached_len)
+            finally:
+                cache.release(m.handle)
+    """) == []
+
+
+def test_except_baseexception_cleanup_is_clean(make_module):
+    assert check(make_module, """
+        def connect(ctx, addr):
+            sock = ctx.socket(1)
+            try:
+                sock.connect(addr)
+            except BaseException:
+                sock.close()
+                raise
+            return sock
+    """) == []
+
+
+def test_escapes_are_not_leaks(make_module):
+    assert check(make_module, """
+        def give_back(ctx):
+            a = ctx.socket(1)
+            return a
+
+        def pass_on(ctx, registry):
+            b = ctx.socket(2)
+            registry.adopt(b)
+
+        def stash(ctx, bag):
+            c = ctx.socket(3)
+            bag["c"] = c
+    """) == []
+
+
+def test_second_acquire_may_leak_the_first(make_module, codes_of):
+    """A later acquire raising leaks the earlier resource -- the
+    multi-resource window needs try protection too."""
+    fs = check(make_module, """
+        def make_pair(ctx):
+            a = ctx.socket(1)
+            b = ctx.socket(2)
+            return a, b
+    """)
+    assert codes_of(fs) == ["lifecycle-leak-on-raise"]
+    assert "`a`" in fs[0].message
+
+
+def test_attribute_targets_are_not_tracked(make_module):
+    assert check(make_module, """
+        class S:
+            def __init__(self, ctx):
+                self._sock = ctx.socket(1)
+    """) == []
+
+
+def test_with_managed_acquire_is_clean(make_module):
+    assert check(make_module, """
+        def f(pool):
+            with pool.alloc(4) as blocks:
+                use(blocks)
+    """) == []
+
+
+def test_daemon_thread_is_exempt(make_module):
+    assert check(make_module, """
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+    """) == []
+
+
+def test_thread_joined_is_clean(make_module):
+    assert check(make_module, """
+        import threading
+
+        def run(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """) == []
+
+
+def test_release_via_resolved_helper(make_module):
+    assert check(make_module, """
+        def close_quietly(sock):
+            sock.close()
+
+        def f(ctx):
+            s = ctx.socket(1)
+            close_quietly(s)
+    """) == []
+
+
+def test_null_guard_refinement(make_module):
+    assert check(make_module, """
+        def f(ctx, flag):
+            s = None
+            if flag:
+                s = ctx.socket(1)
+            if s is not None:
+                s.close()
+    """) == []
+
+
+def test_incref_of_escaped_local_not_retracked(make_module):
+    # the prefix-cache insert shape: the node owns the refs, the
+    # incref backs the node's reference, not a local obligation
+    assert check(make_module, """
+        def insert(pool, blocks, node):
+            keep = tuple(blocks)
+            node.attach(keep)
+            pool.incref(keep)
+    """) == []
+
+
+def test_incref_of_fresh_local_is_tracked(make_module, codes_of):
+    fs = check(make_module, """
+        def borrow(pool, blocks, flag):
+            mine = list(blocks)
+            pool.incref(mine)
+            if flag:
+                return 0
+            pool.free(mine)
+            return 1
+    """)
+    assert codes_of(fs) == ["lifecycle-unreleased"]
+
+
+def test_suppression_on_acquire_line(make_module):
+    """Findings anchor to the acquire statement, so the disable
+    directive on that line suppresses them (the path that leaks may
+    be far away -- the acquire is the stable coordinate)."""
+    src = textwrap.dedent("""
+        def serve(ctx):
+            sock = ctx.socket(1)  # graft-lint: disable=lifecycle-unreleased
+            sock.bind("tcp://*:0")
+    """)
+    module = make_module(src)
+    raw = LifecycleChecker().check(module)
+    assert [f.code for f in raw] == ["lifecycle-unreleased"]
+    assert module.suppressions.filter(raw) == []

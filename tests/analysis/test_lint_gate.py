@@ -157,6 +157,98 @@ def test_cli_unknown_checker_is_usage_error(tmp_path):
     assert ei.value.code == 2
 
 
+BAD_LIFECYCLE = textwrap.dedent("""
+    def serve(ctx):
+        sock = ctx.socket(1)
+        sock.bind("tcp://*:0")
+""")
+BAD_TERMINAL = textwrap.dedent("""
+    class S:
+        def forget(self, rid):
+            self._routes.pop(rid, None)
+""")
+BAD_LOCKORDER = textwrap.dedent("""
+    class C:
+        def f(self):
+            with self.lock_a:
+                with self.lock_b:
+                    pass
+
+        def g(self):
+            with self.lock_b:
+                with self.lock_a:
+                    pass
+""")
+
+
+def test_cli_fails_on_v2_families_naming_file_line_code(
+        tmp_path, capsys, monkeypatch):
+    """Acceptance for the CFG-engine families: seeded-bad fixtures
+    make the CLI exit 1, naming file:line and rule code -- and an
+    obs-catalog fixture package does the same for the drift pass."""
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    (fix / "life_mod.py").write_text(BAD_LIFECYCLE)
+    (fix / "term_mod.py").write_text(BAD_TERMINAL)
+    (fix / "lock_mod.py").write_text(BAD_LOCKORDER)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "### Catalog\n\n| Metric | Type |\n|---|---|\n"
+        "| `stale_total` | counter |\n")
+    (tmp_path / "realhf_tpu").mkdir()
+    (tmp_path / "realhf_tpu" / "mod.py").write_text(
+        'def f(metrics):\n    metrics.inc("undocumented_total")\n')
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main([str(fix), "--no-dfg", "--fail-on-new",
+                    "--no-cache",
+                    "--baseline", str(tmp_path / "baseline.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for fname, code in (
+            ("life_mod.py", "lifecycle-unreleased"),
+            ("term_mod.py", "proto-missing-terminal"),
+            ("lock_mod.py", "conc-lock-cycle"),
+            ("mod.py", "obs-catalog-drift"),
+            ("observability.md", "obs-catalog-drift")):
+        line = next((ln for ln in out.splitlines()
+                     if fname in ln and code in ln), None)
+        assert line is not None, (fname, code, out)
+        assert line.startswith("NEW ")
+        assert int(line.split(":")[1]) > 0, line
+
+
+def test_cfg_finding_fingerprints_survive_line_shifts(tmp_path):
+    """Baseline-ratchet stability: CFG-derived findings move lines
+    when unrelated code is inserted above, but their fingerprints
+    (code+path+symbol+message) must not churn."""
+    from realhf_tpu.analysis import all_checkers as mk
+
+    def findings_of(prefix):
+        for name, src in (("life_mod.py", BAD_LIFECYCLE),
+                          ("term_mod.py", BAD_TERMINAL),
+                          ("lock_mod.py", BAD_LOCKORDER)):
+            (tmp_path / name).write_text(prefix + src)
+        return run_analysis(
+            [str(tmp_path)],
+            mk(["lifecycle", "terminal", "lockorder"]),
+            root=str(tmp_path))
+
+    before = findings_of("")
+    after = findings_of("# shifted\n" * 7 + "\n")
+    assert len(before) == len(after) == 3
+    for a, b in zip(before, after):
+        assert b.line == a.line + 8
+        assert a.fingerprint == b.fingerprint
+
+
+def test_family_name_suppresses_v2_codes(tmp_path):
+    from realhf_tpu.analysis import all_checkers as mk
+    (tmp_path / "mod.py").write_text(
+        "# graft-lint: disable-file=terminal\n" + BAD_TERMINAL)
+    assert run_analysis([str(tmp_path)], mk(["terminal"]),
+                        root=str(tmp_path)) == []
+
+
 # ----------------------------------------------------------------------
 def test_repo_is_lint_clean(monkeypatch, capsys):
     """THE tier-1 acceptance gate: the analyzer runs clean (zero new

@@ -1,0 +1,178 @@
+"""lockorder checker: deadlock cycles + interprocedural blocking."""
+
+import ast
+import textwrap
+
+from realhf_tpu.analysis.core import Module, run_analysis
+from realhf_tpu.analysis.lockorder import LockOrderChecker
+from realhf_tpu.analysis.suppress import Suppressions
+
+
+def run(tmp_path, files):
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_analysis([str(tmp_path)], [LockOrderChecker()],
+                        root=str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+def test_lexical_lock_cycle_in_one_class(tmp_path):
+    fs = run(tmp_path, {"mod.py": """
+        class C:
+            def f(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+            def g(self):
+                with self.lock_b:
+                    with self.lock_a:
+                        pass
+    """})
+    assert [f.code for f in fs] == ["conc-lock-cycle"]
+    assert "lock_a" in fs[0].message and "lock_b" in fs[0].message
+
+
+def test_consistent_order_is_clean(tmp_path):
+    assert run(tmp_path, {"mod.py": """
+        class C:
+            def f(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+            def g(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+    """}) == []
+
+
+def test_interprocedural_cycle_through_helper(tmp_path):
+    """f holds A and calls a helper that takes B; g nests B->A
+    lexically -- the cycle only exists through the call graph."""
+    fs = run(tmp_path, {"mod.py": """
+        class C:
+            def helper(self):
+                with self.lock_b:
+                    pass
+
+            def f(self):
+                with self.lock_a:
+                    self.helper()
+
+            def g(self):
+                with self.lock_b:
+                    with self.lock_a:
+                        pass
+    """})
+    assert [f.code for f in fs] == ["conc-lock-cycle"]
+
+
+def test_module_level_lock_identity_spans_functions(tmp_path):
+    fs = run(tmp_path, {"mod.py": """
+        import threading
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def f():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def g():
+            with lock_b:
+                with lock_a:
+                    pass
+    """})
+    assert [f.code for f in fs] == ["conc-lock-cycle"]
+
+
+def test_interprocedural_blocking_under_lock(tmp_path):
+    fs = run(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def slow(self):
+                time.sleep(1)
+
+            def f(self):
+                with self.lock:
+                    self.slow()
+    """})
+    assert [f.code for f in fs] == ["conc-lock-blocking"]
+    assert "slow" in fs[0].message and "time.sleep" in fs[0].message
+    assert fs[0].symbol == "C.f"
+
+
+def test_direct_blocking_left_to_concurrency_family(tmp_path):
+    """The same-function case is the old checker's; lockorder only
+    reports blocking hidden behind a resolved call."""
+    assert run(tmp_path, {"mod.py": """
+        import time
+
+        class C:
+            def f(self):
+                with self.lock:
+                    time.sleep(1)
+    """}) == []
+
+
+def test_blocking_through_two_hops_names_the_chain(tmp_path):
+    fs = run(tmp_path, {
+        "pkg/wire.py": """
+            def push(sock, payload):
+                sock.send_multipart(payload)
+        """,
+        "pkg/ctrl.py": """
+            from pkg.wire import push
+
+            class C:
+                def relay(self, payload):
+                    push(self.sock, payload)
+
+                def f(self, payload):
+                    with self.state_lock:
+                        self.relay(payload)
+        """,
+    })
+    assert [f.code for f in fs] == ["conc-lock-blocking"]
+    assert "relay" in fs[0].message and "push" in fs[0].message
+
+
+def test_unresolvable_lock_exprs_are_skipped(tmp_path):
+    assert run(tmp_path, {"mod.py": """
+        class C:
+            def f(self, role):
+                with self._locks[role]:
+                    with self.other_lock:
+                        pass
+
+            def g(self):
+                with self.other_lock:
+                    with self._locks["actor"]:
+                        pass
+    """}) == []
+
+
+def test_fingerprint_survives_line_shift(tmp_path):
+    src = """
+        class C:
+            def f(self):
+                with self.lock_a:
+                    with self.lock_b:
+                        pass
+
+            def g(self):
+                with self.lock_b:
+                    with self.lock_a:
+                        pass
+    """
+    fs1 = run(tmp_path, {"mod.py": src})
+    fs2 = run(tmp_path, {"mod.py": "# a new leading comment\n\n"
+                         + textwrap.dedent(src)})
+    assert len(fs1) == len(fs2) == 1
+    assert fs1[0].line != fs2[0].line
+    assert fs1[0].fingerprint == fs2[0].fingerprint

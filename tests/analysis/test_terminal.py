@@ -1,0 +1,133 @@
+"""terminal checker: exactly-once terminal delivery fixtures."""
+
+import textwrap
+
+from realhf_tpu.analysis.terminal import TerminalChecker
+
+
+def check(make_module, src, relpath="fixtures/server.py"):
+    module = make_module(textwrap.dedent(src), relpath)
+    return TerminalChecker().check(module)
+
+
+# ----------------------------------------------------------------------
+# true positives
+# ----------------------------------------------------------------------
+def test_retire_without_terminal(make_module, codes_of):
+    fs = check(make_module, """
+        class S:
+            def forget(self, rid):
+                self._routes.pop(rid, None)
+    """)
+    assert codes_of(fs) == ["proto-missing-terminal"]
+    assert fs[0].symbol == "S.forget" and "_routes" in fs[0].message
+
+
+def test_clear_without_terminal(make_module, codes_of):
+    fs = check(make_module, """
+        class S:
+            def flush(self):
+                self._requests.clear()
+    """)
+    assert codes_of(fs) == ["proto-missing-terminal"]
+
+
+def test_drop_before_send(make_module, codes_of):
+    fs = check(make_module, """
+        class S:
+            def bad(self, rid, ident, payload):
+                self._routes.pop(rid, None)
+                self._sock.send_multipart([ident, payload])
+    """)
+    assert codes_of(fs) == ["proto-drop-before-send"]
+
+
+def test_retire_without_terminal_on_one_branch(make_module, codes_of):
+    fs = check(make_module, """
+        class S:
+            def finish(self, rid, ok):
+                if ok:
+                    self._send(rid, "done", {})
+                    self._requests.pop(rid, None)
+                else:
+                    self._requests.pop(rid, None)
+    """)
+    assert codes_of(fs) == ["proto-missing-terminal"]
+
+
+# ----------------------------------------------------------------------
+# true negatives
+# ----------------------------------------------------------------------
+def test_send_then_drop_is_the_good_shape(make_module):
+    assert check(make_module, """
+        class S:
+            def deliver(self, rid, ident, payload):
+                self._sock.send_multipart([ident, payload])
+                self._routes.pop(rid, None)
+    """) == []
+
+
+def test_helper_name_counts_as_terminal(make_module):
+    assert check(make_module, """
+        class S:
+            def finish(self, rid):
+                self._send(rid, "done", {})
+                self._requests.pop(rid, None)
+                if rid in self._pending:
+                    self._pending.remove(rid)
+    """) == []
+
+
+def test_interprocedural_send_resolution(make_module):
+    """`emit` is NOT in the helper-name registry -- it only counts
+    because the call graph resolves it to a raw socket send."""
+    assert check(make_module, """
+        class S:
+            def emit(self, ident, kind, rid, data):
+                self._front.send_multipart([ident])
+
+            def finish(self, rid, ident):
+                self.emit(ident, "done", rid, {})
+                self._requests.pop(rid, None)
+    """) == []
+
+
+def test_unrelated_tables_not_tracked(make_module):
+    assert check(make_module, """
+        class S:
+            def bookkeeping(self, rid, rep):
+                self._done.pop(rid, None)
+                rep.inflight.discard(rid)
+                self._events.pop(rid, None)
+    """) == []
+
+
+def test_suppression_with_justification(make_module):
+    src = textwrap.dedent("""
+        class S:
+            def fence(self):
+                # deliberate: failover owns the terminals
+                self._routes.clear()  # graft-lint: disable=proto-missing-terminal
+    """)
+    module = make_module(src, "fixtures/server.py")
+    checker = TerminalChecker()
+    raw = checker.check(module)
+    assert [f.code for f in raw] == ["proto-missing-terminal"]
+    assert module.suppressions.filter(raw) == []
+
+
+def test_package_scope_is_limited_to_protocol_files(make_module):
+    src = """
+        class S:
+            def forget(self, rid):
+                self._routes.pop(rid, None)
+    """
+    checker = TerminalChecker()
+    assert checker.applies_to("realhf_tpu/serving/router.py")
+    assert checker.applies_to("realhf_tpu/serving/server.py")
+    assert checker.applies_to("realhf_tpu/serving/scheduler.py")
+    assert not checker.applies_to("realhf_tpu/serving/fleet.py")
+    assert not checker.applies_to("realhf_tpu/system/buffer.py")
+    # outside the package every file is fair game (fixture trees)
+    fs = check(make_module, src, relpath="anywhere/mod.py")
+    assert [f.code for f in fs] == ["proto-missing-terminal"]
