@@ -65,6 +65,7 @@ TERMINAL_HELPERS = ("_send", "_reply", "_forward", "_finish",
 
 _SCOPE_FILES = ("realhf_tpu/serving/scheduler.py",
                 "realhf_tpu/serving/router.py",
+                "realhf_tpu/serving/router_shard.py",
                 "realhf_tpu/serving/server.py")
 
 

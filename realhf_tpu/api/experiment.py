@@ -289,6 +289,21 @@ class ServingSpec:
     #: system prompt across every replica. 0 disables (pure
     #: least-loaded). Health/breaker/fencing gates always win.
     router_affinity_prefix_len: int = 16
+    # -- sharded router plane (docs/serving.md "Sharded router
+    # plane"): with n_routers > 1, that many RouterWorker shards
+    # split rid space by consistent hash; each holds its own
+    # lease/epoch and a shard death re-homes its range to survivors.
+    n_routers: int = 1
+    # -- chunked weight distribution (docs/serving.md "Chunked weight
+    # distribution"): content-hashed chunk pushes over a relay tree
+    # instead of full-copy unicast per replica.
+    #: max raw bytes packed per chunk
+    weight_push_chunk_bytes: int = 4 << 20
+    #: wire encoding for pushed chunks: "raw" or "int8" (per-row
+    #: symmetric quantization, reusing the paged-KV helpers)
+    weight_push_encoding: str = "raw"
+    #: relay-tree fanout; 0 = unicast (root pushes to every replica)
+    weight_push_fanout: int = 2
 
 
 @dataclasses.dataclass

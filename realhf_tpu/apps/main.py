@@ -460,7 +460,13 @@ def run_serve(spec: ExperimentSpec,
     # tolerated -- the router fails their in-flight work over -- as
     # long as the router itself and at least one replica survive.
     fleet = bool(getattr(sv, "fleet_router", False))
-    worker_names = gen_names + (["router/0"] if fleet else [])
+    # sharded router plane (docs/serving.md "Sharded router plane"):
+    # n_routers > 1 runs that many RouterWorker shards splitting rid
+    # space by consistent hash; a single shard keeps the classic
+    # singleton router (and its loss stays fatal)
+    n_routers = max(1, int(getattr(sv, "n_routers", 1))) if fleet else 0
+    router_names = [f"router/{i}" for i in range(n_routers)]
+    worker_names = gen_names + router_names
     sched = make_scheduler("local")
     controller = PodController(sched)
     name_resolve.clear_subtree(
@@ -470,16 +476,16 @@ def run_serve(spec: ExperimentSpec,
             controller.submit(f"gen_server/{i}",
                               _worker_cmd("gen_server", i, spec),
                               env=env)
-        if fleet:
-            controller.submit("router/0", _worker_cmd("router", 0, spec),
+        for i, rname in enumerate(router_names):
+            controller.submit(rname, _worker_cmd("router", i, spec),
                               env=env)
         panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
         panel.connect(worker_names, timeout=120)
         configs = {f"gen_server/{i}": dict(config=dict(
             spec_path=path, server_index=i))
             for i in range(sv.n_servers)}
-        if fleet:
-            configs["router/0"] = dict(config=dict(spec_path=path))
+        for rname in router_names:
+            configs[rname] = dict(config=dict(spec_path=path))
         out = panel.group_request_varied("configure", configs,
                                          timeout=600)
         panel.group_request("start")
@@ -503,11 +509,25 @@ def run_serve(spec: ExperimentSpec,
         end = None if duration is None else time.monotonic() + duration
         deadline = time.monotonic() + timeout
         dead_servers = set()
+        dead_routers = set()
         autoscaler = None
 
         def _tolerable(w: str) -> bool:
             # in fleet mode a replica death is survivable until the
-            # last replica goes; the router's loss is always fatal
+            # last replica goes. With a SHARDED router plane (N > 1)
+            # a router shard death is survivable too -- survivors
+            # adopt its hash range -- until the last shard goes; a
+            # singleton router's loss stays fatal.
+            if fleet and w in router_names:
+                if n_routers < 2:
+                    return False
+                if w not in dead_routers:
+                    dead_routers.add(w)
+                    logger.warning(
+                        "Router shard %s died; ring re-homes to %d "
+                        "surviving shard(s).", w,
+                        n_routers - len(dead_routers))
+                return len(dead_routers) < n_routers
             if not (fleet and w in gen_names):
                 return False
             if w not in dead_servers:
@@ -580,39 +600,70 @@ def run_serve(spec: ExperimentSpec,
             latency_signal = getattr(sv, "autoscale_latency_signal",
                                      "ewma")
 
+            def _live_routers():
+                return [r for r in router_names
+                        if r not in dead_routers]
+
+            def _merge_router_stats(shards):
+                """Aggregate per-shard router stats into one fleet
+                view: load figures SUM across shards, latency takes
+                the worst shard (the autoscale policy keys on the
+                tail, and a single hot shard is real pressure)."""
+                shards = [s for s in shards if isinstance(s, dict)]
+                if not shards:
+                    raise RuntimeError("no router stats available")
+                out = dict(
+                    pending=sum(int(s.get("pending") or 0)
+                                for s in shards),
+                    inflight=sum(int(s.get("inflight") or 0)
+                                 for s in shards),
+                    rejections=sum(int(s.get("rejections") or 0)
+                                   for s in shards))
+                for k in ("latency_ewma_secs", "latency_p50",
+                          "latency_p95"):
+                    vals = [s.get(k) for s in shards
+                            if s.get(k) is not None]
+                    out[k] = max(vals) if vals else None
+                return out
+
             def _router_stats_zmq():
-                return panel.group_request(
-                    "stats", worker_names=["router/0"],
-                    timeout=30)["router/0"]
+                live = _live_routers()
+                replies = panel.group_request(
+                    "stats", worker_names=live, timeout=30)
+                return _merge_router_stats(
+                    [replies.get(r) for r in live])
 
             def _router_stats_http():
-                """Poll the router's /metrics telemetry endpoint --
-                the same Prometheus text a real scraper sees
-                (docs/observability.md "Scraping the fleet") --
-                resolved through names.telemetry."""
+                """Poll each router shard's /metrics telemetry
+                endpoint -- the same Prometheus text a real scraper
+                sees (docs/observability.md "Scraping the fleet") --
+                resolved through names.telemetry, then aggregate."""
                 import urllib.request
 
                 from realhf_tpu.obs import http as obs_http
-                addr = name_resolve.get(names.telemetry(
-                    spec.experiment_name, spec.trial_name,
-                    "router/0"))
-                with urllib.request.urlopen(f"http://{addr}/metrics",
-                                            timeout=10) as r:
-                    fams = obs_http.parse_prometheus_text(
-                        r.read().decode("utf-8", "replace"))
-                return dict(
-                    pending=obs_http.prom_scalar(
-                        fams, "router_pending", agg="last"),
-                    inflight=obs_http.prom_scalar(
-                        fams, "router_inflight", agg="last"),
-                    rejections=obs_http.prom_scalar(
-                        fams, "router_rejections_total"),
-                    latency_ewma_secs=obs_http.prom_scalar(
-                        fams, "router_latency_ewma_secs", agg="last"),
-                    latency_p50=obs_http.prom_histogram_quantile(
-                        fams, "router_latency_seconds", 0.5),
-                    latency_p95=obs_http.prom_histogram_quantile(
-                        fams, "router_latency_seconds", 0.95))
+                shards = []
+                for rname in _live_routers():
+                    addr = name_resolve.get(names.telemetry(
+                        spec.experiment_name, spec.trial_name, rname))
+                    with urllib.request.urlopen(
+                            f"http://{addr}/metrics", timeout=10) as r:
+                        fams = obs_http.parse_prometheus_text(
+                            r.read().decode("utf-8", "replace"))
+                    shards.append(dict(
+                        pending=obs_http.prom_scalar(
+                            fams, "router_pending", agg="last"),
+                        inflight=obs_http.prom_scalar(
+                            fams, "router_inflight", agg="last"),
+                        rejections=obs_http.prom_scalar(
+                            fams, "router_rejections_total"),
+                        latency_ewma_secs=obs_http.prom_scalar(
+                            fams, "router_latency_ewma_secs",
+                            agg="last"),
+                        latency_p50=obs_http.prom_histogram_quantile(
+                            fams, "router_latency_seconds", 0.5),
+                        latency_p95=obs_http.prom_histogram_quantile(
+                            fams, "router_latency_seconds", 0.95)))
+                return _merge_router_stats(shards)
 
             def _autoscale_tick():
                 actuator.poll_bringup()

@@ -17,10 +17,15 @@ from realhf_tpu.serving.request_queue import (  # noqa: F401
     Priority,
     RequestQueue,
 )
+from realhf_tpu.serving.ring import Ring, rehomed  # noqa: F401
 from realhf_tpu.serving.router import (  # noqa: F401
     BreakerState,
     CircuitBreaker,
     FleetRouter,
+)
+from realhf_tpu.serving.router_shard import (  # noqa: F401
+    ShardedRolloutClient,
+    ShardedRouter,
 )
 from realhf_tpu.serving.scheduler import (  # noqa: F401
     ContinuousScheduler,
@@ -32,5 +37,10 @@ from realhf_tpu.serving.server import (  # noqa: F401
     RolloutResult,
     RolloutServer,
     rollout_server_key,
+)
+from realhf_tpu.serving.weight_dist import (  # noqa: F401
+    ChunkedWeightReceiver,
+    WeightDistributor,
+    relay_tree,
 )
 from realhf_tpu.serving.weight_sync import WeightSync  # noqa: F401

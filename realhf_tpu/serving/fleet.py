@@ -20,7 +20,8 @@ anything older.
 """
 
 import dataclasses
-from typing import Dict, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 from realhf_tpu.base import logging, name_resolve, names
 
@@ -61,12 +62,17 @@ class FleetRegistry:
 
     def __init__(self, experiment_name: str, trial_name: str, *,
                  lease_ttl: float = 5.0,
-                 repo: Optional[name_resolve.NameRecordRepository] = None):
+                 repo: Optional[name_resolve.NameRecordRepository] = None,
+                 clock: Callable[[], float] = time.monotonic):
         if lease_ttl <= 0:
             raise ValueError(f"lease_ttl must be > 0, got {lease_ttl}")
         self.lease_ttl = lease_ttl
         self._root = fleet_root(experiment_name, trial_name)
         self._repo = repo if repo is not None else name_resolve.default()
+        self._clock = clock
+        #: retiring keys first observed orphaned (replica lease gone)
+        #: at this clock time -- gc_retiring sweeps them past a grace
+        self._retiring_orphaned: Dict[str, float] = {}
 
     # -- key layout ----------------------------------------------------
     # replicas/ holds the leased entries; epochs/ the persistent
@@ -80,6 +86,21 @@ class FleetRegistry:
 
     def _retiring_key(self, name: str) -> str:
         return f"{self._root}/retiring/{name}"
+
+    # routers/ + router_epochs/: the sharded router plane's own leased
+    # membership (serving/router_shard.py), same value format as
+    # replicas/ so one parser serves both subtrees
+    def _router_key(self, name: str) -> str:
+        return f"{self._root}/routers/{name}"
+
+    def _router_epoch_key(self, name: str) -> str:
+        return f"{self._root}/router_epochs/{name}"
+
+    # journal/: per-rid re-dispatch records a router shard writes on
+    # admission and clears on terminal delivery; survivors adopt a
+    # dead shard's entries (docs/serving.md "Sharded router plane")
+    def _journal_key(self, rid: str) -> str:
+        return f"{self._root}/journal/{rid}"
 
     # ------------------------------------------------------------------
     def register(self, name: str, address: str) -> int:
@@ -181,3 +202,136 @@ class FleetRegistry:
             return int(self._repo.get(self._epoch_key(name)))
         except (name_resolve.NameEntryNotFoundError, ValueError):
             return None
+
+    # -- router plane (docs/serving.md "Sharded router plane") ---------
+    # Router shards are fleet members too: same leased registration,
+    # same persistent fencing epochs, a separate subtree so replica
+    # listings and router listings never mix.
+    def register_router(self, name: str, address: str) -> int:
+        """(Re-)register a router shard; returns its NEW fencing
+        epoch. Clients and peer routers derive the consistent-hash
+        ring (serving/ring.py) from the live routers/ subtree."""
+        epoch = self._repo.register_with_epoch(
+            self._router_key(name),
+            lambda e: f"{e}:{address}",
+            epoch_name=self._router_epoch_key(name),
+            keepalive_ttl=self.lease_ttl)
+        logger.info("Router shard %s registered at %s (epoch %d, "
+                    "lease %.1fs).", name, address, epoch,
+                    self.lease_ttl)
+        return epoch
+
+    def renew_router(self, name: str):
+        """Refresh a router shard's lease; raises LeaseLostError when
+        it already expired (the shard is fenced: survivors are
+        adopting its hash range, so it must flush undelivered state
+        and re-register before routing again)."""
+        try:
+            self._repo.touch(self._router_key(name))
+        except name_resolve.NameEntryNotFoundError:
+            raise LeaseLostError(
+                f"Router {name}: lease expired (ttl="
+                f"{self.lease_ttl:.1f}s); flush and re-register for "
+                "a new fencing epoch before routing.") from None
+
+    def deregister_router(self, name: str):
+        try:
+            self._repo.delete(self._router_key(name))
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+    def routers(self) -> Dict[str, ReplicaInfo]:
+        """Live (unexpired) router shards as {name: ReplicaInfo}."""
+        root = f"{self._root}/routers"
+        out: Dict[str, ReplicaInfo] = {}
+        for key in self._repo.find_subtree(root):
+            name = key[len(root) + 1:] if key.startswith(root + "/") \
+                else key
+            try:
+                raw = self._repo.get(key)
+            except name_resolve.NameEntryNotFoundError:
+                continue  # expired between walk and read
+            try:
+                epoch_s, address = str(raw).split(":", 1)
+                out[name] = ReplicaInfo(name=name, address=address,
+                                        epoch=int(epoch_s))
+            except ValueError:
+                logger.warning("Fleet registry: malformed router "
+                               "entry %s=%r ignored.", key, raw)
+        return out
+
+    def router_epoch_of(self, name: str) -> Optional[int]:
+        try:
+            return int(self._repo.get(self._router_epoch_key(name)))
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None
+
+    # -- in-flight rid journal -----------------------------------------
+    def journal_rid(self, rid: str, payload: str):
+        """Record an admitted rid's re-dispatch envelope. The TTL is a
+        backstop only (a request outliving it merely loses journal
+        coverage -- the client's own resubmission still recovers it);
+        the owning shard clears the entry on terminal delivery."""
+        self._repo.add(self._journal_key(rid), payload, replace=True,
+                       keepalive_ttl=max(60.0, 20.0 * self.lease_ttl))
+
+    def clear_rid(self, rid: str):
+        try:
+            self._repo.delete(self._journal_key(rid))
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+    def journal(self) -> Dict[str, str]:
+        """All live journal entries as {rid: payload}."""
+        root = f"{self._root}/journal"
+        out: Dict[str, str] = {}
+        for key in self._repo.find_subtree(root):
+            rid = key[len(root) + 1:] if key.startswith(root + "/") \
+                else key
+            try:
+                out[rid] = str(self._repo.get(key))
+            except name_resolve.NameEntryNotFoundError:
+                continue
+        return out
+
+    # ------------------------------------------------------------------
+    def gc_retiring(self, grace: Optional[float] = None) -> List[str]:
+        """Sweep retiring/ markers whose replica has already departed.
+
+        A retiring key is normally cleared by whichever router
+        observes the departure; when NO router ever does (routerless
+        autoscale, or the router died first), the key used to linger
+        until its generous TTL backstop. This sweep deletes markers
+        whose replica lease has been gone for at least ``grace``
+        (default ``2 * lease_ttl`` -- long enough that every consumer
+        polling on the lease cadence has classified the departure as
+        planned). Wired into ``AutoscaleController.step`` so repeated
+        scale-down cycles never accumulate keys. Returns the swept
+        names."""
+        grace = 2.0 * self.lease_ttl if grace is None else grace
+        now = self._clock()
+        live = set(self.replicas())
+        rroot = f"{self._root}/retiring"
+        present = set()
+        swept: List[str] = []
+        for key in self._repo.find_subtree(rroot):
+            name = key[len(rroot) + 1:] if key.startswith(rroot + "/") \
+                else key
+            present.add(name)
+            if name in live:
+                # still draining: not orphaned, reset any observation
+                self._retiring_orphaned.pop(name, None)
+                continue
+            first = self._retiring_orphaned.setdefault(name, now)
+            if now - first >= grace:
+                self.clear_retiring(name)
+                self._retiring_orphaned.pop(name, None)
+                swept.append(name)
+        # drop observations for keys that vanished on their own
+        for name in list(self._retiring_orphaned):
+            if name not in present:
+                self._retiring_orphaned.pop(name, None)
+        if swept:
+            logger.info("Fleet registry: swept %d consumed retiring "
+                        "marker(s): %s.", len(swept), swept)
+        return swept

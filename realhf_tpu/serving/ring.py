@@ -1,0 +1,101 @@
+"""Consistent-hash ring: rid ownership across router shards.
+
+The sharded router plane (docs/serving.md "Sharded router plane")
+assigns every request id to exactly one ``RouterWorker`` shard by
+consistent hashing over the set of live router names published in the
+:class:`~realhf_tpu.serving.fleet.FleetRegistry`. Everything here is a
+PURE function of ``(rid, sorted router names)``:
+
+- every participant (routers, clients, drills) computes the same owner
+  from the same registry snapshot, with no coordination round;
+- when a router dies, only the hash ranges it owned re-home -- rids
+  owned by survivors never move (the classic consistent-hashing
+  minimal-disruption property, asserted by a property test in
+  ``tests/serving/test_ring.py``);
+- re-homing is deterministic: survivors independently agree on who
+  adopts each orphaned rid.
+
+Hashing uses sha1, never Python's ``hash()``: ownership must be stable
+across processes and interpreter restarts (PYTHONHASHSEED).
+"""
+
+import bisect
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: virtual nodes per router: smooths the range split so N routers own
+#: ~1/N of rid space each (stddev shrinks with sqrt of vnodes)
+DEFAULT_VNODES = 64
+
+
+def _point(key: str) -> int:
+    """Stable 64-bit ring position for a key."""
+    return int.from_bytes(
+        hashlib.sha1(key.encode("utf-8")).digest()[:8], "big")
+
+
+def ring_points(names: Sequence[str],
+                n_vnodes: int = DEFAULT_VNODES
+                ) -> List[Tuple[int, str]]:
+    """Sorted ``(point, router_name)`` vnode list for a router set."""
+    pts: List[Tuple[int, str]] = []
+    for name in sorted(set(names)):
+        for v in range(n_vnodes):
+            pts.append((_point(f"{name}#{v}"), name))
+    pts.sort()
+    return pts
+
+
+class Ring:
+    """Immutable ownership view over one registry snapshot."""
+
+    def __init__(self, names: Sequence[str],
+                 n_vnodes: int = DEFAULT_VNODES):
+        self.names: Tuple[str, ...] = tuple(sorted(set(names)))
+        self.n_vnodes = n_vnodes
+        self._points = ring_points(self.names, n_vnodes)
+        self._keys = [p for p, _ in self._points]
+
+    def __bool__(self) -> bool:
+        return bool(self.names)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Ring) and self.names == other.names \
+            and self.n_vnodes == other.n_vnodes
+
+    def __hash__(self):
+        return hash((self.names, self.n_vnodes))
+
+    def owner_of(self, rid: str) -> Optional[str]:
+        """The router owning ``rid`` (None on an empty ring): first
+        vnode clockwise from the rid's hash point, wrapping at 0."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._keys, _point(rid))
+        if i == len(self._points):
+            i = 0
+        return self._points[i][1]
+
+    def partition(self, rids: Sequence[str]) -> Dict[str, List[str]]:
+        """Group rids by owner (owners with no rids are omitted)."""
+        out: Dict[str, List[str]] = {}
+        for rid in rids:
+            owner = self.owner_of(rid)
+            if owner is not None:
+                out.setdefault(owner, []).append(rid)
+        return out
+
+
+def rehomed(before: Sequence[str], after: Sequence[str],
+            rids: Sequence[str],
+            n_vnodes: int = DEFAULT_VNODES) -> Dict[str, str]:
+    """``{rid: new_owner}`` for every rid whose owner changed between
+    the two router sets -- the deterministic re-home plan survivors
+    agree on after a membership change."""
+    b, a = Ring(before, n_vnodes), Ring(after, n_vnodes)
+    out: Dict[str, str] = {}
+    for rid in rids:
+        ob, oa = b.owner_of(rid), a.owner_of(rid)
+        if oa is not None and ob != oa:
+            out[rid] = oa
+    return out

@@ -1,0 +1,665 @@
+"""Sharded router plane: N-way FleetRouter shards with lease/epoch
+failover (docs/serving.md "Sharded router plane").
+
+One :class:`~realhf_tpu.serving.router.FleetRouter` is a single point
+of failure and a throughput ceiling: every request funnels through its
+one front socket, and ``apps.main.run_serve`` treats its loss as
+fatal. This module splits the plane into N :class:`ShardedRouter`
+shards that divide the rid space by consistent hash
+(``serving/ring.py``) over a ring published in the
+:class:`~realhf_tpu.serving.fleet.FleetRegistry`:
+
+- **Ownership**: each rid has exactly one owning shard,
+  ``Ring.owner_of(rid)`` over the live ``routers/`` subtree. A submit
+  arriving at a non-owner is bounced with a ``wrong_owner`` reply
+  naming the owner; :class:`ShardedRolloutClient` re-resolves and
+  resubmits (never more than a bounce or two once views converge).
+- **Lease/epoch**: every shard holds its own leased registration with
+  a persistent fencing epoch (``FleetRegistry.register_router``,
+  reusing ``register_with_epoch``). A shard whose lease lapses is
+  FENCED: it flushes all undelivered state WITHOUT terminals (its
+  range was re-homed; a late send would be a duplicate) and
+  re-registers under a new epoch before routing again.
+- **Re-home**: an admitted rid is journaled in the registry
+  (``journal/<rid>`` -> owner + re-dispatch envelope, cleared on
+  terminal delivery). When a shard's lease vanishes, each survivor
+  adopts the journaled rids that now hash to it and re-dispatches
+  them with the existing ``retried_from``/at-most-once ``_done``
+  machinery. The adopted request has no client connection yet, so a
+  terminal arriving first is PARKED and handed over when the client's
+  resubmission re-attaches -- exactly-once delivery survives a router
+  SIGKILL mid-burst (``scripts/chaos_drill.py --scenario
+  router_kill``).
+- **Replica-side idempotency**: a survivor may re-dispatch a rid to
+  the replica that is still generating it for the dead shard;
+  ``RolloutServer`` re-attaches the route to the newest submitter
+  instead of double-queueing, so the work continues and the terminal
+  flows to the live shard.
+
+Every retire-from-``_requests``/``_pending`` path here is covered by
+the graft-lint ``terminal`` checker (docs/static_analysis.md); the
+deliberate terminal-less fence flush carries its inline disable.
+"""
+
+import base64
+import collections
+import pickle
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import zmq
+
+from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics, tracing
+from realhf_tpu.serving.fleet import FleetRegistry, LeaseLostError
+from realhf_tpu.serving.request_queue import Priority
+from realhf_tpu.serving.ring import Ring
+from realhf_tpu.serving.router import FleetRouter, _RouterRequest
+from realhf_tpu.serving.server import TERMINAL_KINDS, RolloutResult
+
+logger = logging.getLogger("serving.router_shard", "system")
+
+
+def encode_journal(owner: str, prompt, priority: int,
+                   ttl: Optional[float], min_wv: int) -> str:
+    """Journal value: ``owner|base64(pickle(envelope))``. The envelope
+    carries everything a survivor needs to re-dispatch the rid; the
+    ttl is the ORIGINAL budget (an adopter restarts it -- failover
+    must not shrink a request's remaining time to zero)."""
+    env = dict(prompt=np.asarray(prompt, np.int32).tolist(),
+               priority=int(priority), ttl=ttl, min_wv=int(min_wv))
+    return owner + "|" + base64.b64encode(
+        pickle.dumps(env)).decode("ascii")
+
+
+def decode_journal(payload: str):
+    """-> (owner, envelope dict); raises ValueError on malformed."""
+    owner, b64 = payload.split("|", 1)
+    return owner, pickle.loads(base64.b64decode(b64))
+
+
+class ShardedRouter(FleetRouter):
+    """One shard of the sharded router plane (module docstring)."""
+
+    def __init__(self, registry: FleetRegistry, *,
+                 router_name: str = "router/0",
+                 ring_vnodes: int = 64,
+                 chaos=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 **kw):
+        # each shard publishes its rendezvous key under its OWN name
+        # (the singleton key "router" belongs to unsharded mode)
+        kw.setdefault("publish_name", router_name)
+        self._ring = Ring([router_name], n_vnodes=ring_vnodes)
+        self.ring_vnodes = ring_vnodes
+        self._router_infos: Dict[str, object] = {}
+        self._fenced = False
+        #: fenced with no way back: a NEWER incarnation of this name
+        #: registered (higher epoch) -- re-registering would start an
+        #: epoch war, so this shard stays quiet forever
+        self._superseded = False
+        self._last_ring_poll = -1e9
+        self._journal_sweep_due = True
+        #: sweep the journal every Nth ring poll even without a
+        #: membership change: catches stragglers a racing sweep
+        #: skipped (e.g. an entry disowned by a recovering shard)
+        self._sweep_every = 10
+        self._ring_polls = 0
+        #: terminals for adopted rids whose client has not re-attached
+        #: yet: handed over on resubmission, bounded like _done
+        self._parked: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        self._parked_cap = 2048
+        super().__init__(registry, router_name=router_name,
+                         chaos=chaos, clock=clock, **kw)
+        self.stats_counters.update(
+            wrong_owner=0, reattached=0, adopted=0,
+            parked_terminals=0, router_fences=0)
+        self.router_epoch = registry.register_router(router_name,
+                                                     self.address)
+        self._router_lease_renewed = self._clock()
+        self._refresh_ring(force=True)
+
+    # -- lease / fencing -----------------------------------------------
+    def _router_lease_upkeep(self):
+        """Renew this shard's lease on a ttl/3 cadence; on loss,
+        fence: flush undelivered state terminal-lessly (survivors
+        adopted the range) and re-register under a fresh epoch."""
+        if self._superseded:
+            return  # permanently quiet: a newer incarnation owns us
+        if self._chaos is not None \
+                and self._chaos.partitioned(self.router_name):
+            return  # registry unreachable: the lease decays
+        now = self._clock()
+        if not self._fenced:
+            if now - self._router_lease_renewed \
+                    < self.registry.lease_ttl / 3.0:
+                return
+            try:
+                self.registry.renew_router(self.router_name)
+                self._router_lease_renewed = now
+                return
+            except LeaseLostError:
+                self._fence("lease expired")
+        # fenced: drop pre-fence state, then rejoin at a new epoch.
+        # The post-rejoin journal sweep re-adopts any of OUR journaled
+        # rids a survivor has not claimed yet, so the flush loses no
+        # request for good.
+        dropped = self._flush_fenced_router()
+        self.router_epoch = self.registry.register_router(
+            self.router_name, self.address)
+        self._router_lease_renewed = self._clock()
+        self._fenced = False
+        self._journal_sweep_due = True
+        logger.warning(
+            "Router shard %s was fenced: %d request(s) dropped "
+            "(re-homed by survivors); re-registered with epoch %d.",
+            self.router_name, dropped, self.router_epoch)
+
+    def _fence(self, why: str, permanent: bool = False):
+        if permanent and not self._superseded:
+            self._superseded = True
+            # a superseded zombie never delivers again: flush now so
+            # nothing lingers waiting for an upkeep that won't rejoin
+            if not self._fenced:
+                self._fenced = True
+                self.stats_counters["router_fences"] += 1
+                metrics.inc("router_shard_fenced_total",
+                            router=self.router_name)
+            self._flush_fenced_router()
+            logger.warning("Router shard %s FENCED permanently (%s).",
+                           self.router_name, why)
+            return
+        if self._fenced:
+            return
+        self._fenced = True
+        self.stats_counters["router_fences"] += 1
+        metrics.inc("router_shard_fenced_total",
+                    router=self.router_name)
+        logger.warning("Router shard %s FENCED (%s): going quiet "
+                       "until re-registration.", self.router_name, why)
+
+    def _flush_fenced_router(self) -> int:
+        """Drop every tracked request WITHOUT terminal events: a
+        fenced shard must deliver nothing -- its hash range was
+        re-homed to survivors, and a late terminal from here would be
+        a duplicate of the adopter's."""
+        n = len(self._requests)
+        for rep in self._replicas.values():
+            rep.inflight.clear()
+        # deliberate terminal-less retirement (fence flush, same
+        # contract as RolloutServer._flush_fenced): the adopting
+        # survivor owes the client its single terminal, not us
+        self._requests.clear()  # graft-lint: disable=proto-missing-terminal
+        self._pending.clear()  # graft-lint: disable=proto-missing-terminal
+        metrics.inc("router_shard_fenced_dropped_total", amount=n,
+                    router=self.router_name)
+        return n
+
+    # -- ring membership / adoption ------------------------------------
+    def _refresh_ring(self, force: bool = False):
+        now = self._clock()
+        if not force and now - self._last_ring_poll \
+                < self.fleet_poll_interval:
+            return
+        if self._chaos is not None \
+                and self._chaos.partitioned(self.router_name):
+            return
+        self._last_ring_poll = now
+        routers = self.registry.routers()
+        self._router_infos = routers
+        me = routers.get(self.router_name)
+        if me is not None and me.epoch > self.router_epoch:
+            # someone re-registered our name at a higher epoch: WE are
+            # the zombie incarnation -- quiet forever, never rejoin
+            self._fence("superseded by epoch %d" % me.epoch,
+                        permanent=True)
+            return
+        names = set(routers)
+        if not self._fenced:
+            # our own lease may have lapsed without us noticing yet;
+            # upkeep will fence us, but until then we still route
+            names.add(self.router_name)
+        new_ring = Ring(sorted(names), n_vnodes=self.ring_vnodes)
+        if new_ring != self._ring:
+            logger.info("Router shard %s: ring now %s.",
+                        self.router_name, list(new_ring.names))
+            self._ring = new_ring
+            self._journal_sweep_due = True
+        metrics.set_gauge("router_shard_ring_size",
+                          len(self._ring.names))
+        self._ring_polls += 1
+        if not self._fenced and (
+                self._journal_sweep_due
+                or self._ring_polls % self._sweep_every == 0):
+            self._journal_sweep_due = False
+            self._adopt_orphans(set(routers))
+
+    def _adopt_orphans(self, live_routers: set):
+        """Adopt journaled rids whose recorded owner is no longer in
+        the ring and whose hash range now lands here; re-dispatch them
+        with the standard ``retried_from`` failover machinery. The
+        client's resubmission (it re-resolves when its target leaves
+        the ring) re-attaches the delivery path."""
+        try:
+            entries = self.registry.journal()
+        except Exception as e:  # noqa: BLE001 - registry hiccups must
+            # not kill the routing loop; the sweep re-arms
+            logger.warning("Router shard %s: journal sweep failed: "
+                           "%s", self.router_name, e)
+            self._journal_sweep_due = True
+            return
+        now = self._clock()
+        for rid, payload in sorted(entries.items()):
+            try:
+                owner, env = decode_journal(payload)
+            except Exception:  # noqa: BLE001 - malformed entries are
+                # skipped, never fatal
+                continue
+            if rid in self._requests or rid in self._done:
+                continue  # tracked here; _finish clears the journal
+            if owner != self.router_name and owner in live_routers:
+                continue  # its owner is alive and serving it
+            if self._ring.owner_of(rid) != self.router_name:
+                if owner == self.router_name:
+                    # we journaled it but fenced-flushed it, and the
+                    # ring re-homed it elsewhere meanwhile: DISOWN the
+                    # entry (owner "" is never live) so the ring
+                    # owner's periodic sweep adopts it
+                    try:
+                        self.registry.journal_rid(
+                            rid, "" + payload[payload.index("|"):])
+                    except Exception:  # noqa: BLE001
+                        pass
+                continue
+            ttl = env.get("ttl")
+            req = _RouterRequest(
+                rid=rid, ident=None,
+                # a journaled prompt is a plain Python list; this is a
+                # host-side conversion, not a device sync
+                prompt=np.asarray(env["prompt"], np.int32),  # graft-lint: disable=purity-sync-in-loop
+                priority=int(env.get("priority", 0)),
+                min_weight_version=int(env.get("min_wv", 0)),
+                trace=None, created_at=now,
+                deadline=None if ttl is None else now + ttl,
+                last_event_at=now,
+                retried_from=[owner or "<disowned>"])
+            self._requests[rid] = req
+            self._pending.append(rid)
+            self._journal(req)  # re-home the journal entry to us
+            self.stats_counters["adopted"] += 1
+            metrics.inc("router_shard_adopted_total",
+                        router=self.router_name)
+            logger.info("Router shard %s adopted rid %s from dead "
+                        "shard %s.", self.router_name, rid, owner)
+
+    def _journal(self, req: _RouterRequest):
+        ttl = None if req.deadline is None \
+            else max(0.05, req.deadline - req.created_at)
+        try:
+            self.registry.journal_rid(
+                req.rid,
+                encode_journal(self.router_name, req.prompt,
+                               req.priority, ttl,
+                               req.min_weight_version))
+        except Exception as e:  # noqa: BLE001 - journaling is a
+            # durability upgrade, not an admission gate
+            logger.warning("Router shard %s: journal write for %s "
+                           "failed: %s", self.router_name, req.rid, e)
+
+    # -- routing loop --------------------------------------------------
+    def route_step(self, poll_timeout: float = 0.0) -> int:
+        self._router_lease_upkeep()
+        self._refresh_ring()
+        handled = super().route_step(poll_timeout)
+        metrics.set_gauge("router_shard_inflight",
+                          len(self._requests),
+                          router=self.router_name)
+        return handled
+
+    def _handle_client(self, ident: bytes, msg: tuple):
+        if self._fenced:
+            return  # a fenced shard answers nothing (clients re-resolve)
+        kind = msg[0]
+        if kind == "submit":
+            rid = msg[1]
+            if rid in self._done:
+                parked = self._parked.pop(rid, None)
+                if parked is not None:
+                    # the adopted rid finished before its client
+                    # re-attached: hand over the single terminal now
+                    k, d = parked
+                    self._send_ident(ident, k, rid, d)
+                else:
+                    self.stats_counters["stale_events"] += 1
+                return
+            req = self._requests.get(rid)
+            if req is not None:
+                if req.ident != ident:
+                    # failover re-attach: the client re-resolved to us
+                    # (we adopted the rid, or its old connection died)
+                    req.ident = ident
+                    self.stats_counters["reattached"] += 1
+                    metrics.inc("router_shard_reattached_total",
+                                router=self.router_name)
+                    self._reply(ident, "accepted", rid,
+                                dict(reattached=True))
+                return
+            owner = self._ring.owner_of(rid)
+            if owner is not None and owner != self.router_name:
+                info = self._router_infos.get(owner)
+                self.stats_counters["wrong_owner"] += 1
+                metrics.inc("router_shard_wrong_owner_total",
+                            router=self.router_name)
+                self._reply(ident, "wrong_owner", rid, dict(
+                    owner=owner,
+                    address=getattr(info, "address", None),
+                    ring=list(self._ring.names)))
+                return
+            super()._handle_client(ident, msg)
+            accepted = self._requests.get(rid)
+            if accepted is not None:
+                self._journal(accepted)
+            return
+        super()._handle_client(ident, msg)
+
+    # -- delivery ------------------------------------------------------
+    def _send_ident(self, ident, kind: str, rid: str, data: dict):
+        if self._fenced:
+            return  # fenced late sends deliver NOTHING
+        if ident is None:
+            # adopted rid, client not re-attached yet: park terminals
+            # (intermediate events are droppable; the client replays
+            # from `accepted` after re-attach)
+            if kind in TERMINAL_KINDS:
+                self._parked[rid] = (kind, data)
+                self.stats_counters["parked_terminals"] += 1
+                metrics.inc("router_shard_parked_terminals_total",
+                            router=self.router_name)
+                while len(self._parked) > self._parked_cap:
+                    self._parked.popitem(last=False)
+            return
+        super()._send_ident(ident, kind, rid, data)
+
+    def _send_replica(self, rname: str, envelope: tuple) -> bool:
+        if self._fenced:
+            return False  # a fenced shard dispatches nothing either
+        return super()._send_replica(rname, envelope)
+
+    def _finish(self, req, kind: str, data: dict,
+                from_replica: Optional[str]):
+        first = req.rid not in self._done
+        super()._finish(req, kind, data, from_replica)
+        if first:
+            self.registry.clear_rid(req.rid)
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        if not self._closed and not self._fenced:
+            self.registry.deregister_router(self.router_name)
+        super().close()
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(router_epoch=self.router_epoch,
+                   fenced=self._fenced,
+                   ring=list(self._ring.names),
+                   parked=len(self._parked))
+        return out
+
+
+# ----------------------------------------------------------------------
+class _ClientRequest:
+    __slots__ = ("prompt", "priority", "ttl", "min_wv", "target",
+                 "target_epoch", "bounces", "submitted_at")
+
+    def __init__(self, prompt, priority, ttl, min_wv, target, now):
+        self.prompt = prompt
+        self.priority = priority
+        self.ttl = ttl
+        self.min_wv = min_wv
+        self.target = target
+        #: the target shard's fencing epoch at submit time: an epoch
+        #: bump means the shard fenced (flushing its in-flight state)
+        #: and rejoined, so the rid must be resubmitted even though
+        #: the name never left the ring
+        self.target_epoch: Optional[int] = None
+        self.bounces = 0
+        self.submitted_at = now
+
+
+class ShardedRolloutClient:
+    """Client for the sharded router plane.
+
+    Discovers router shards through the :class:`FleetRegistry`, routes
+    each rid to its ring owner, follows ``wrong_owner`` bounces, and
+    -- the failover path -- resubmits any in-flight rid whose target
+    shard left the ring. The resubmission is idempotent end to end:
+    the adopting shard re-attaches the rid (or replays its parked
+    terminal), and a replica already generating it re-attaches its
+    route rather than double-queueing.
+
+    Single-threaded like :class:`RolloutClient`; terminals are
+    surfaced exactly as received (NO client-side dedupe -- the
+    protocol owes exactly-once, and the chaos drill checks it here).
+    """
+
+    def __init__(self, registry: FleetRegistry, *,
+                 ring_poll_interval: float = 0.25,
+                 max_bounces: int = 8,
+                 clock: Callable[[], float] = time.monotonic):
+        self.registry = registry
+        self.ring_poll_interval = ring_poll_interval
+        self.max_bounces = max_bounces
+        self._clock = clock
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+        self._addresses: Dict[str, str] = {}
+        self._epochs: Dict[str, int] = {}
+        self._ring = Ring([])
+        self._last_ring_poll = -1e9
+        self._inflight: Dict[str, _ClientRequest] = {}
+        self._events: Dict[str, List[tuple]] = {}
+        self.stats = dict(submits=0, bounces=0, resubmits=0,
+                          give_ups=0)
+
+    # -- discovery -----------------------------------------------------
+    def _refresh_ring(self, force: bool = False):
+        now = self._clock()
+        if not force and now - self._last_ring_poll \
+                < self.ring_poll_interval:
+            return
+        self._last_ring_poll = now
+        routers = self.registry.routers()
+        for name, info in routers.items():
+            if self._addresses.get(name) != info.address:
+                old = self._socks.pop(name, None)
+                if old is not None:
+                    old.close(0)
+                sock = self._ctx.socket(zmq.DEALER)
+                try:
+                    sock.connect(info.address)
+                except BaseException:
+                    sock.close(0)
+                    raise
+                self._socks[name] = sock
+                self._addresses[name] = info.address
+        for name in list(self._socks):
+            if name not in routers:
+                self._socks.pop(name).close(0)
+                self._addresses.pop(name, None)
+        self._epochs = {n: info.epoch for n, info in routers.items()}
+        self._ring = Ring(sorted(routers))
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        """Block (real time) until at least one router shard is
+        registered. Returns readiness; never raises on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._refresh_ring(force=True)
+            if self._ring:
+                return True
+            time.sleep(0.1)
+        return False
+
+    # -- submission ----------------------------------------------------
+    def _send_to(self, target: str, payload: tuple) -> bool:
+        sock = self._socks.get(target)
+        if sock is None:
+            return False
+        try:
+            sock.send(pickle.dumps(payload))
+            return True
+        except zmq.ZMQError as e:
+            logger.warning("Sharded client: send to %s failed: %s",
+                           target, e)
+            return False
+
+    def _submit_to(self, target: Optional[str], rid: str,
+                   creq: _ClientRequest) -> bool:
+        if target is None or target not in self._socks:
+            target = self._ring.owner_of(rid)
+        if target is None or not self._send_to(
+                target, ("submit", rid, creq.prompt, creq.priority,
+                         creq.ttl, creq.min_wv, tracing.inject())):
+            return False
+        creq.target = target
+        creq.target_epoch = self._epochs.get(target)
+        return True
+
+    def submit(self, prompt, priority: int = Priority.BATCH,
+               ttl: Optional[float] = None,
+               rid: Optional[str] = None,
+               min_weight_version: int = 0) -> str:
+        rid = rid or uuid.uuid4().hex
+        self._refresh_ring()
+        if not self._ring:
+            self._refresh_ring(force=True)
+        if not self._ring:
+            raise RuntimeError(
+                "ShardedRolloutClient.submit: no router shards "
+                "registered (wait_ready first).")
+        creq = _ClientRequest(np.asarray(prompt, np.int32),
+                              int(priority), ttl,
+                              int(min_weight_version), None,
+                              self._clock())
+        self._events.setdefault(rid, [])
+        self._inflight[rid] = creq
+        self.stats["submits"] += 1
+        self._submit_to(self._ring.owner_of(rid), rid, creq)
+        return rid
+
+    def cancel(self, rid: str):
+        creq = self._inflight.get(rid)
+        target = creq.target if creq is not None else None
+        self._send_to(target or next(iter(self._socks), ""),
+                      ("cancel", rid))
+
+    # -- event pump ----------------------------------------------------
+    def _on_msg(self, kind: str, rid: str, data: dict):
+        if kind == "wrong_owner":
+            self.stats["bounces"] += 1
+            creq = self._inflight.get(rid)
+            if creq is None:
+                return
+            creq.bounces += 1
+            if creq.bounces > self.max_bounces:
+                # ring views refuse to converge: surface a terminal
+                # instead of bouncing forever
+                self.stats["give_ups"] += 1
+                self._inflight.pop(rid, None)
+                self._events.setdefault(rid, []).append(
+                    ("rejected", dict(reason="ring_unstable")))
+                return
+            self._refresh_ring(force=True)
+            self._submit_to(data.get("owner"), rid, creq)
+            return
+        self._events.setdefault(rid, []).append((kind, data))
+        if kind in TERMINAL_KINDS:
+            self._inflight.pop(rid, None)
+
+    def _check_failover(self):
+        """Resubmit in-flight rids whose target shard left the ring
+        -- or fenced and rejoined under a HIGHER epoch (its in-flight
+        state was flushed; the rejoined shard re-adopts the rid from
+        the journal and parks its terminal until this resubmission
+        re-attaches). The at-most-once machinery downstream makes the
+        resubmission safe."""
+        if not self._inflight:
+            return
+        names = set(self._ring.names)
+        for rid, creq in list(self._inflight.items()):
+            gone = creq.target is None or creq.target not in names
+            fenced = (not gone and creq.target_epoch is not None
+                      and self._epochs.get(creq.target)
+                      != creq.target_epoch)
+            if gone or fenced:
+                if self._submit_to(self._ring.owner_of(rid), rid,
+                                   creq):
+                    self.stats["resubmits"] += 1
+
+    def _pump(self, timeout: float = 0.0) -> bool:
+        self._refresh_ring()
+        self._check_failover()
+        got = False
+        waited = False
+        while True:
+            progressed = False
+            for name, sock in list(self._socks.items()):
+                try:
+                    while sock.poll(0):
+                        kind, rid, data = pickle.loads(sock.recv())
+                        self._on_msg(kind, rid, data)
+                        got = progressed = True
+                except zmq.ZMQError as e:
+                    logger.warning("Sharded client: recv from %s "
+                                   "failed: %s", name, e)
+            if progressed:
+                continue
+            if got or waited or timeout <= 0 or not self._socks:
+                return got
+            # one blocking wait across all router sockets
+            poller = zmq.Poller()
+            for sock in self._socks.values():
+                poller.register(sock, zmq.POLLIN)
+            poller.poll(timeout * 1000)
+            waited = True
+
+    # -- harvest -------------------------------------------------------
+    def poll_results(self, timeout: float = 0.0) -> List[RolloutResult]:
+        """Non-blocking harvest of terminal outcomes, mirroring
+        ``RolloutClient.poll_results``."""
+        self._pump(timeout)
+        out: List[RolloutResult] = []
+        for rid in list(self._events):
+            terminal = next(
+                ((k, d) for k, d in self._events[rid]
+                 if k in TERMINAL_KINDS), None)
+            if terminal is not None:
+                del self._events[rid]
+                out.append(RolloutResult(
+                    rid=rid, status=terminal[0], data=terminal[1]))
+        return out
+
+    def result(self, rid: str, timeout: float = 60.0) -> RolloutResult:
+        """Block (real time) until ``rid`` reaches a terminal state."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._pump(min(0.05, max(0.0,
+                                     deadline - time.monotonic())))
+            q = self._events.get(rid, [])
+            terminal = next(((k, d) for k, d in q
+                             if k in TERMINAL_KINDS), None)
+            if terminal is not None:
+                self._events.pop(rid, None)
+                return RolloutResult(rid=rid, status=terminal[0],
+                                     data=terminal[1])
+        raise TimeoutError(
+            f"No terminal for request {rid} within {timeout}s.")
+
+    def close(self):
+        for sock in self._socks.values():
+            sock.close(0)
+        self._socks.clear()

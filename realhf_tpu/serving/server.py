@@ -316,6 +316,25 @@ class RolloutServer:
                 self._reply(ident, "rejected", rid,
                             dict(reason="draining", retry_after=None))
                 return
+            with self._routes_lock:
+                known = rid in self._routes
+                if known:
+                    # duplicate submit of a rid still queued/serving
+                    # here: a router-shard failover re-dispatch (the
+                    # adopting shard re-sends rids its dead peer had
+                    # in flight). Re-attach the delivery route to the
+                    # newest submitter instead of double-queueing --
+                    # the work continues once and its terminal flows
+                    # to the live shard (docs/serving.md "Sharded
+                    # router plane").
+                    self._routes[rid] = ident
+            if known:
+                metrics.inc("serving_reattached_total",
+                            server=self.server_name)
+                self._reply(ident, "accepted", rid,
+                            dict(reattached=True,
+                                 queue_depth=len(self.queue)))
+                return
             req = GenRequest(
                 rid=rid, prompt=np.asarray(prompt, np.int32),
                 priority=Priority(priority),

@@ -1,0 +1,443 @@
+"""Chunked, deduplicated, tree-fanned weight distribution.
+
+Replaces ``WeightSync``'s full-copy unicast push path between a
+trainer and N serving replicas (docs/serving.md "Chunked weight
+distribution"):
+
+- **Chunking**: the param tree is flattened to ``"/"``-joined leaf
+  paths and greedily packed into byte-bounded chunks of whole leaves.
+  Chunk identity (``cid``) is a pure function of the leaf paths it
+  covers, so the same tree always chunks the same way.
+- **Dedup**: each chunk carries a content digest of its RAW leaf
+  bytes. The distributor remembers, per receiver, the digest last
+  acknowledged for every cid and skips chunks the receiver already
+  holds -- a no-op re-push transfers (almost) nothing, and a
+  fine-tuning step that only touched some layers transfers only
+  those chunks.
+- **Encoding**: chunks may be int8-encoded on the wire (per-row
+  symmetric quantization, reusing the paged-KV helpers from
+  ``engine/kv_pool.py``); digests are computed pre-encoding so dedup
+  is encoding-agnostic.
+- **Relay tree**: receivers are arranged in a deterministic
+  ``fanout``-ary heap-shaped tree derived from the registry's sorted
+  receiver names. Payloads hop root -> relay -> subtree, so a full
+  update reaches N replicas in O(log N) pipelined hops instead of N
+  serialized unicasts; :meth:`PushReport.modeled_latency` converts
+  the measured per-edge bytes into the virtual-clock completion time
+  under a link-speed model (``scripts/bench_serving.py
+  --weight-dist`` reports both shapes). A relay node that fails
+  mid-push is routed around: its orphaned subtree is re-parented to
+  the root and pushed directly.
+- **Resync**: a receiver that lost state (restart, missing base)
+  reports ``missing`` paths; the distributor forgets its dedup map
+  and re-sends everything direct.
+
+The receiving side (:class:`ChunkedWeightReceiver`) assembles leaves
+and hands a complete tree to ``WeightSync.push(..., copy=False)`` --
+decode always materializes fresh buffers, so ownership transfers
+safely (see the ``owns_params`` contract there).
+"""
+
+import dataclasses
+import hashlib
+import time
+from collections.abc import Mapping
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics
+from realhf_tpu.serving.weight_sync import WeightSync
+
+logger = logging.getLogger("serving.weight_dist", "system")
+
+#: leaves smaller than this stay raw under int8 encoding: biases and
+#: norm scales are tiny, precision-sensitive, and not worth the 4x
+INT8_MIN_LEAF_ELEMS = 1024
+
+
+# -- param tree <-> flat paths -----------------------------------------
+def flatten_params(params) -> Dict[str, np.ndarray]:
+    """Flatten a nested-Mapping param tree to ``{"a/b/c": leaf}``.
+
+    Only nested Mappings (dicts / FrozenDicts) are supported -- the
+    restriction is what lets a receiver rebuild the tree from paths
+    alone, with no pickled treedef on the wire. Keys must not contain
+    ``"/"``."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(node, prefix: str):
+        if isinstance(node, Mapping):
+            for k in sorted(node):
+                if "/" in str(k):
+                    raise ValueError(
+                        f"flatten_params: key {k!r} contains '/' "
+                        "(reserved as the path separator).")
+                walk(node[k], f"{prefix}/{k}" if prefix else str(k))
+            return
+        if prefix == "":
+            raise TypeError("flatten_params: root must be a Mapping.")
+        flat[prefix] = np.asarray(node)
+
+    walk(params, "")
+    return flat
+
+
+def unflatten_params(flat: Mapping) -> dict:
+    """Inverse of :func:`flatten_params`."""
+    root: dict = {}
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
+# -- chunking ----------------------------------------------------------
+def _leaf_nbytes(x: np.ndarray) -> int:
+    return int(np.asarray(x).nbytes)
+
+
+def chunk_paths(flat: Mapping, max_chunk_bytes: int
+                ) -> List[Tuple[str, ...]]:
+    """Greedily pack sorted leaf paths into chunks of at most
+    ``max_chunk_bytes`` of raw payload (a single oversized leaf gets
+    a chunk of its own). Deterministic given the tree shape."""
+    groups: List[Tuple[str, ...]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for path in sorted(flat):
+        nb = _leaf_nbytes(flat[path])
+        if cur and cur_bytes + nb > max_chunk_bytes:
+            groups.append(tuple(cur))
+            cur, cur_bytes = [], 0
+        cur.append(path)
+        cur_bytes += nb
+    if cur:
+        groups.append(tuple(cur))
+    return groups
+
+
+def chunk_id(paths: Sequence[str]) -> str:
+    """Stable chunk identity: a function of the leaf paths only (NOT
+    their contents -- contents live in the digest)."""
+    h = hashlib.sha1()
+    for p in paths:
+        h.update(p.encode("utf-8"))
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+def chunk_digest(paths: Sequence[str], flat: Mapping) -> str:
+    """Content digest over the RAW (pre-encoding) leaf bytes, shapes,
+    and dtypes: dedup compares digests, so it is encoding-agnostic
+    and survives a receiver holding an int8-decoded copy."""
+    h = hashlib.sha1()
+    for p in paths:
+        leaf = np.ascontiguousarray(flat[p])
+        h.update(p.encode("utf-8"))
+        h.update(str(leaf.dtype).encode())
+        h.update(str(leaf.shape).encode())
+        h.update(leaf.tobytes())
+    return h.hexdigest()
+
+
+# -- per-leaf wire encoding --------------------------------------------
+def _encode_leaf(leaf: np.ndarray, encoding: str) -> dict:
+    leaf = np.ascontiguousarray(leaf)
+    if (encoding == "int8" and leaf.dtype.kind == "f"
+            and leaf.ndim >= 1 and leaf.size >= INT8_MIN_LEAF_ELEMS
+            and leaf.shape[-1] > 1):
+        # reuse the paged-KV per-row symmetric int8 helpers (PR 14):
+        # rows are the leading axes, quantized along the last
+        from realhf_tpu.engine.kv_pool import _quantize_rows
+        import jax.numpy as jnp
+        q, scale = _quantize_rows(jnp.asarray(leaf))
+        return dict(enc="int8", dtype=str(leaf.dtype),
+                    shape=leaf.shape,
+                    q=np.asarray(q), scale=np.asarray(scale))
+    return dict(enc="raw", dtype=str(leaf.dtype), shape=leaf.shape,
+                data=leaf)
+
+
+def _decode_leaf(enc: dict) -> np.ndarray:
+    if enc["enc"] == "raw":
+        # copy even when the dtype already matches: an in-process
+        # transport hands over the SENDER'S array object, and the
+        # receiver installs via WeightSync.push(copy=False) -- without
+        # a copy here the trainer's next in-place update would corrupt
+        # the installed weights (a wire transport copies incidentally;
+        # the owns_params contract must not depend on the transport)
+        return np.array(enc["data"], dtype=np.dtype(enc["dtype"]),
+                        copy=True)
+    if enc["enc"] == "int8":
+        q = np.asarray(enc["q"], np.float32)
+        scale = np.asarray(enc["scale"], np.float32)[..., None]
+        return (q * scale).astype(np.dtype(enc["dtype"])).reshape(
+            enc["shape"])
+    raise ValueError(f"Unknown leaf encoding {enc['enc']!r}.")
+
+
+def _encoded_nbytes(enc: dict) -> int:
+    if enc["enc"] == "raw":
+        return _leaf_nbytes(enc["data"])
+    return _leaf_nbytes(enc["q"]) + _leaf_nbytes(enc["scale"])
+
+
+@dataclasses.dataclass
+class Chunk:
+    cid: str
+    digest: str
+    paths: Tuple[str, ...]
+    leaves: Dict[str, dict]   # path -> encoded leaf
+    nbytes: int               # wire bytes (post-encoding)
+
+
+def encode_chunk(paths: Sequence[str], flat: Mapping,
+                 encoding: str = "raw") -> Chunk:
+    leaves = {p: _encode_leaf(flat[p], encoding) for p in paths}
+    return Chunk(cid=chunk_id(paths),
+                 digest=chunk_digest(paths, flat),
+                 paths=tuple(paths), leaves=leaves,
+                 nbytes=sum(_encoded_nbytes(e) for e in leaves.values()))
+
+
+# -- relay tree --------------------------------------------------------
+def relay_tree(root: str, receivers: Sequence[str],
+               fanout: int = 2) -> List[Tuple[str, str]]:
+    """Deterministic ``(sender, receiver)`` edges of a heap-shaped
+    ``fanout``-ary relay tree over the SORTED receiver names: position
+    ``i``'s children are positions ``fanout*i+1 .. fanout*i+fanout``,
+    with the root feeding positions ``0 .. fanout-1``. ``fanout <= 0``
+    degenerates to unicast (root sends to everyone). Edges come out in
+    BFS send order, which is also the pipelined send schedule
+    :meth:`PushReport.modeled_latency` prices."""
+    names = sorted(receivers)
+    if fanout <= 0:
+        return [(root, r) for r in names]
+    edges: List[Tuple[str, str]] = []
+    for i, name in enumerate(names):
+        if i < fanout:
+            edges.append((root, name))
+        else:
+            edges.append((names[(i - fanout) // fanout], name))
+    # BFS order == index order for the heap layout
+    return edges
+
+
+@dataclasses.dataclass
+class PushReport:
+    """What one :meth:`WeightDistributor.push` actually moved."""
+    version: int
+    root: str
+    chunks_total: int
+    #: per-edge ``(sender, receiver, wire_bytes, n_chunks)`` in send
+    #: order; dedup already applied, so bytes are what really moved
+    edges: List[Tuple[str, str, int, int]]
+    chunks_sent: int = 0
+    dedup_hits: int = 0
+    bytes_sent: int = 0
+    relay_hops: int = 0          # edges whose sender is not the root
+    fallback_directs: int = 0    # edges re-parented after relay death
+    failed: List[str] = dataclasses.field(default_factory=list)
+    resyncs: List[str] = dataclasses.field(default_factory=list)
+    wall_secs: float = 0.0
+
+    def dedup_ratio(self) -> float:
+        """addressed chunks / transferred chunks (>1 once dedup ever
+        skips anything; inf for a fully deduplicated no-op re-push)."""
+        addressed = self.chunks_sent + self.dedup_hits
+        if self.chunks_sent == 0:
+            return float("inf") if addressed else 1.0
+        return addressed / self.chunks_sent
+
+    def modeled_latency(self, bytes_per_sec: float = 1e9,
+                        per_send_overhead: float = 1e-3) -> float:
+        """Virtual-clock completion time of this push's send schedule
+        under a simple link model: each node owns one outgoing link
+        and serializes its sends (in edge order); a receiver can start
+        relaying only after its own payload fully arrived. Computed
+        from the MEASURED post-dedup per-edge bytes, this is what
+        makes the tree-vs-unicast comparison honest on a single
+        machine: unicast costs ``O(N)`` serialized sends at the root,
+        the relay tree pipelines to ``O(log N)`` depth."""
+        ready: Dict[str, float] = {self.root: 0.0}
+        link_free: Dict[str, float] = {}
+        done = 0.0
+        for sender, receiver, nbytes, _nc in self.edges:
+            start = max(ready.get(sender, 0.0),
+                        link_free.get(sender, 0.0))
+            finish = start + per_send_overhead + nbytes / bytes_per_sec
+            link_free[sender] = finish
+            ready[receiver] = max(ready.get(receiver, 0.0), finish)
+            done = max(done, finish)
+        return done
+
+
+class WeightDistributor:
+    """Sender side: chunk, dedup, and fan a weight push out over the
+    relay tree (module docstring).
+
+    ``transport(sender, receiver, message) -> reply`` delivers one
+    receiver's payload and returns its acknowledgement (``{"ok": True}``
+    or ``{"ok": False, "missing": [...]}``); raising marks the
+    receiver failed and re-parents its subtree to the root. The
+    ``sender`` attribution is the relay schedule -- in-process
+    transports (drills, benches) execute it literally, while the
+    zmq/worker transport issues the sends in the same pipelined order.
+    """
+
+    def __init__(self, root: str = "trainer", *,
+                 fanout: int = 2,
+                 max_chunk_bytes: int = 4 << 20,
+                 encoding: str = "raw",
+                 clock: Callable[[], float] = time.perf_counter):
+        if encoding not in ("raw", "int8"):
+            raise ValueError(f"Unknown encoding {encoding!r} "
+                             "(expected 'raw' or 'int8').")
+        self.root = root
+        self.fanout = fanout
+        self.max_chunk_bytes = max_chunk_bytes
+        self.encoding = encoding
+        self._clock = clock
+        #: receiver -> {cid: digest} last acknowledged
+        self._seen: Dict[str, Dict[str, str]] = {}
+
+    def forget(self, receiver: str):
+        """Drop the dedup map for a receiver (restart / resync): the
+        next push sends it everything."""
+        self._seen.pop(receiver, None)
+
+    def push(self, params, version: int, receivers: Sequence[str],
+             transport: Callable[[str, str, dict], Optional[dict]],
+             ) -> PushReport:
+        t0 = self._clock()
+        flat = flatten_params(params)
+        chunks = [encode_chunk(paths, flat, self.encoding)
+                  for paths in chunk_paths(flat, self.max_chunk_bytes)]
+        manifest = [(c.cid, c.digest) for c in chunks]
+        edges = relay_tree(self.root, receivers, self.fanout)
+        report = PushReport(version=version, root=self.root,
+                            chunks_total=len(chunks), edges=[])
+        failed: set = set()
+        for sender, receiver in edges:
+            if sender in failed:
+                sender = self.root  # re-parent the orphaned subtree
+                report.fallback_directs += 1
+                metrics.inc("weight_push_fallback_directs_total")
+            seen = self._seen.setdefault(receiver, {})
+            need = [c for c in chunks if seen.get(c.cid) != c.digest]
+            hits = len(chunks) - len(need)
+            nbytes = sum(c.nbytes for c in need)
+            message = dict(version=version, manifest=manifest,
+                           chunks=need, sender=sender)
+            try:
+                reply = transport(sender, receiver, message) or {}
+            except Exception as e:  # noqa: BLE001 - a dead relay is
+                # routed around, never fatal to the push
+                logger.warning("Weight push: receiver %s failed (%s);"
+                               " subtree falls back to direct push.",
+                               receiver, e)
+                failed.add(receiver)
+                report.failed.append(receiver)
+                self.forget(receiver)
+                continue
+            if not reply.get("ok", True):
+                # receiver lost state (restart / missing base): wipe
+                # its dedup map and re-send everything direct
+                self.forget(receiver)
+                seen = self._seen.setdefault(receiver, {})
+                need, hits = list(chunks), 0
+                nbytes = sum(c.nbytes for c in need)
+                message = dict(version=version, manifest=manifest,
+                               chunks=need, sender=self.root)
+                report.resyncs.append(receiver)
+                metrics.inc("weight_push_resyncs_total")
+                try:
+                    reply = transport(self.root, receiver, message) \
+                        or {}
+                except Exception:  # noqa: BLE001
+                    failed.add(receiver)
+                    report.failed.append(receiver)
+                    self.forget(receiver)
+                    continue
+                if not reply.get("ok", True):
+                    failed.add(receiver)
+                    report.failed.append(receiver)
+                    self.forget(receiver)
+                    continue
+            for c in need:
+                seen[c.cid] = c.digest
+            report.edges.append((sender, receiver, nbytes, len(need)))
+            report.chunks_sent += len(need)
+            report.dedup_hits += hits
+            report.bytes_sent += nbytes
+            if sender != self.root:
+                report.relay_hops += 1
+        report.wall_secs = max(0.0, self._clock() - t0)
+        metrics.inc("weight_push_chunks_total",
+                    amount=report.chunks_sent)
+        metrics.inc("weight_push_dedup_hits_total",
+                    amount=report.dedup_hits)
+        metrics.inc("weight_push_relay_hops_total",
+                    amount=report.relay_hops)
+        metrics.inc("weight_push_bytes_total",
+                    amount=report.bytes_sent)
+        metrics.observe_hist("weight_swap_latency_seconds",
+                             report.wall_secs)
+        return report
+
+
+class ChunkedWeightReceiver:
+    """Receiver side: accumulate decoded chunks and install complete
+    trees into a :class:`WeightSync` mailbox.
+
+    Holds the last-decoded leaf set between pushes, so a dedup'd push
+    (chunks skipped because this receiver already acknowledged them)
+    still installs a FULL tree. When the manifest references a chunk
+    this receiver never held (restart, eviction), :meth:`apply`
+    answers ``ok=False`` with the missing cids and the distributor
+    resyncs it."""
+
+    def __init__(self, weight_sync: WeightSync):
+        self.weight_sync = weight_sync
+        self._leaves: Dict[str, np.ndarray] = {}
+        #: cid -> (digest, paths) for everything currently held
+        self._held: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        self.installs = 0
+
+    def apply(self, message: dict) -> dict:
+        version = int(message["version"])
+        for c in message.get("chunks", []):
+            self._leaves.update(
+                {p: _decode_leaf(enc) for p, enc in c.leaves.items()})
+            self._held[c.cid] = (c.digest, c.paths)
+        missing = [cid for cid, digest in message["manifest"]
+                   if self._held.get(cid, ("",))[0] != digest]
+        if missing:
+            return dict(ok=False, missing=missing)
+        want = {cid for cid, _ in message["manifest"]}
+        live_paths = set()
+        for cid in want:
+            live_paths.update(self._held[cid][1])
+        # drop leaves/chunks the new manifest no longer references
+        # (a resharded tree must not resurrect stale leaves)
+        for cid in [c for c in self._held if c not in want]:
+            del self._held[cid]
+        for p in [p for p in self._leaves if p not in live_paths]:
+            del self._leaves[p]
+        params = unflatten_params(self._leaves)
+        try:
+            # decode materialized fresh buffers: ownership transfers
+            self.weight_sync.push(params, version, copy=False)
+            self.installs += 1
+        except ValueError:
+            # stale/duplicate version (reordered relay delivery): the
+            # newer weights already won; acknowledge and move on
+            logger.info("Chunked receiver: dropping stale weight "
+                        "push v%d (installed v%d).", version,
+                        self.weight_sync.version)
+        return dict(ok=True, version=version)

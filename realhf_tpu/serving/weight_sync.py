@@ -16,6 +16,26 @@ from realhf_tpu.base import logging
 logger = logging.getLogger("serving.weight_sync")
 
 
+def _snapshot_tree(params):
+    """Deep-copy every array leaf of a param tree (lazy jax import so
+    the mailbox stays importable without an accelerator stack). A
+    jax.Array leaf is immutable but may be DONATED by the caller's
+    next jitted step, invalidating its buffer; ``jnp.array(x,
+    copy=True)`` pins our own buffer either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def snap(x):
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
+        if isinstance(x, jnp.ndarray):
+            return jnp.array(x, copy=True)
+        return x  # scalars / static aux data
+
+    return jax.tree.map(snap, params)
+
+
 class WeightSync:
     """Thread-safe pending-weights mailbox. At most one pending swap is
     held: a newer push overwrites an older one that was never
@@ -39,10 +59,21 @@ class WeightSync:
         with self._lock:
             return self._pending[0] if self._pending else None
 
-    def push(self, params, version: int):
+    def push(self, params, version: int, copy: bool = True):
         """Offer new weights. ``version`` must exceed both the
         installed and any pending version (monotonic -- a stale push
-        indicates a reordered delivery and is refused loudly)."""
+        indicates a reordered delivery and is refused loudly).
+
+        Ownership contract: with ``copy=True`` (the default) the
+        mailbox snapshots every leaf, so the caller remains free to
+        mutate -- or hand to a donating jit -- its own tree right
+        after ``push`` returns; the pending swap cannot be corrupted
+        underneath the scheduler. Pass ``copy=False`` ONLY when the
+        caller transfers ownership of freshly materialized arrays it
+        will never touch again (e.g. ``ChunkedWeightReceiver``, whose
+        decode step already allocates new buffers)."""
+        if copy:
+            params = _snapshot_tree(params)
         with self._lock:
             floor = max(self._version,
                         self._pending[0] if self._pending else -1)

@@ -150,6 +150,8 @@ class GenServerWorker(worker_base.Worker):
             return self.rollout_server.stats()
         if cmd == "update_weights":
             return self._update_weights(**(kwargs or {}))
+        if cmd == "update_weights_chunks":
+            return self._update_weights_chunks(**(kwargs or {}))
         if cmd == "drain":
             self.rollout_server.drain(timeout=self._drain_timeout)
             return self.rollout_server.stats()
@@ -194,6 +196,20 @@ class GenServerWorker(worker_base.Worker):
         return dict(pending_version=version,
                     installed_version=self.rollout_server.weight_sync.version)
 
+    def _update_weights_chunks(self, message: Dict) -> Dict:
+        """Chunked weight push (docs/serving.md "Chunked weight
+        distribution"): apply one ``WeightDistributor`` payload. The
+        receiver keeps leaf state between pushes, so a dedup'd push
+        still installs a full tree; a missing-base reply makes the
+        distributor resync this replica with a direct full push."""
+        if getattr(self, "_chunk_receiver", None) is None:
+            from realhf_tpu.serving.weight_dist import (
+                ChunkedWeightReceiver,
+            )
+            self._chunk_receiver = ChunkedWeightReceiver(
+                self.rollout_server.weight_sync)
+        return self._chunk_receiver.apply(message)
+
     def _exit_hook(self):
         if getattr(self, "rollout_server", None) is not None:
             self.rollout_server.drain(timeout=self._drain_timeout)
@@ -233,8 +249,7 @@ class RouterWorker(worker_base.Worker):
                 "experiments/serve_exp.py).")
         registry = FleetRegistry(spec.experiment_name, spec.trial_name,
                                  lease_ttl=sv.lease_ttl_secs)
-        self.router = FleetRouter(
-            registry,
+        router_kw = dict(
             router_name=self.worker_name,
             experiment_name=spec.experiment_name,
             trial_name=spec.trial_name,
@@ -247,6 +262,16 @@ class RouterWorker(worker_base.Worker):
             breaker_cooldown=sv.router_breaker_cooldown_secs,
             affinity_prefix_len=sv.router_affinity_prefix_len,
             fleet_poll_interval=min(0.5, sv.lease_ttl_secs / 4.0))
+        if getattr(sv, "n_routers", 1) > 1:
+            # sharded router plane (docs/serving.md "Sharded router
+            # plane"): this shard registers its own lease/epoch in the
+            # registry and owns a consistent-hash slice of rid space;
+            # clients discover the ring through the registry
+            # (ShardedRolloutClient), so no singleton rendezvous key
+            from realhf_tpu.serving.router_shard import ShardedRouter
+            self.router = ShardedRouter(registry, **router_kw)
+        else:
+            self.router = FleetRouter(registry, **router_kw)
         self._drain_timeout = sv.drain_timeout_secs
         logger.info("Router %s configured: lease_ttl=%.1fs hedge=%s "
                     "breaker=%d/%.1fs.", self.worker_name,

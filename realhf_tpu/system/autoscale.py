@@ -227,6 +227,12 @@ class AutoscaleController:
                     "%.0fs; force-stopping.", name,
                     self.retire_deadline_secs)
                 self.actuator.reap(name)
+        if self.registry is not None \
+                and hasattr(self.registry, "gc_retiring"):
+            # sweep consumed retiring/ markers every tick so repeated
+            # scale-down cycles never accumulate them when no router
+            # observes the departure (FleetRegistry.gc_retiring)
+            self.registry.gc_retiring()
 
     def _scale_up(self, decision: ScaleDecision, ctx: Dict):
         name = f"{self.name_prefix}/{self._next_index}"

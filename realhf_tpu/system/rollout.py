@@ -165,8 +165,18 @@ class RolloutController:
             sid, prompt = item
             ci = self._rr % len(self.clients)
             self._rr += 1
-            rid = self.clients[ci].submit(
-                np.asarray(prompt, np.int32), ttl=self._ttl)
+            try:
+                rid = self.clients[ci].submit(
+                    np.asarray(prompt, np.int32), ttl=self._ttl)
+            except (RuntimeError, OSError) as e:
+                # transient submission failure (e.g. a sharded router
+                # plane mid-re-home with no shard registered yet): the
+                # prompt goes back in line rather than being lost, and
+                # the pump retries on a later tick
+                logger.warning("Rollout pump: submit failed (%s); "
+                               "requeueing prompt.", e)
+                self._requeue.append((sid, prompt))
+                break
             self._pending[rid] = (sid, np.asarray(prompt, np.int32), ci)
             self.submitted += 1
             n += 1

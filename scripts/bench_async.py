@@ -235,18 +235,15 @@ def run_ppo_loop(runner, stack, *, mode, steps, train_bs, gen_bs,
                     continue
                 # actor trained: hot-swap the fresh weights into the
                 # server (monotonic version = the actor's step count).
-                # Push a COPY: the trainer DONATES its param buffers
-                # on the next optimizer step, and the server must
-                # keep decoding on this version until it swaps.
+                # WeightSync.push snapshots the tree itself (the
+                # owns_params contract), so the trainer is free to
+                # DONATE its param buffers on the next optimizer step.
                 train_steps += 1
                 step_times.append(time.monotonic())
                 if busy_before or ctl.inflight > 0:
                     overlapped += 1
-                import jax.numpy as jnp
-                import jax as _jax
-                stack.weight_sync.push(
-                    _jax.tree.map(jnp.array, actor.engine.params),
-                    actor.version.global_step)
+                stack.weight_sync.push(actor.engine.params,
+                                       actor.version.global_step)
                 curve.append(dict(
                     step=train_steps,
                     task_reward=out.get("task_reward"),

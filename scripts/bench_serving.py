@@ -758,6 +758,145 @@ def run_bursty(args) -> dict:
               "and the bounded rejection rate"))
 
 
+# ----------------------------------------------------------------------
+# Chunked weight distribution bench (docs/serving.md "Chunked weight
+# distribution"): swap latency vs replica count for the O(log N) relay
+# tree against O(N) unicast, dedup ratio on no-op / partial re-pushes,
+# and the int8 wire encoding's size/accuracy trade.
+def run_weight_dist(args) -> dict:
+    import numpy as np
+
+    from realhf_tpu.engine.kv_pool import int8_roundtrip_error_bound
+    from realhf_tpu.obs import metrics
+    from realhf_tpu.serving.weight_dist import (
+        ChunkedWeightReceiver,
+        WeightDistributor,
+    )
+    from realhf_tpu.serving.weight_sync import WeightSync
+
+    metrics.reset_default()
+    rng = np.random.default_rng(0)
+    dim, n_layers = args.wd_dim, args.wd_layers
+
+    def make_params():
+        return dict(model={
+            f"layer_{i:02d}": dict(
+                kernel=rng.standard_normal(
+                    (dim, dim)).astype(np.float32),
+                bias=np.zeros((dim,), np.float32))
+            for i in range(n_layers)})
+
+    def fleet(n):
+        return {f"gen_server/{i}": ChunkedWeightReceiver(WeightSync())
+                for i in range(n)}
+
+    def transport_for(receivers):
+        def transport(sender, receiver, message):
+            return receivers[receiver].apply(message)
+        return transport
+
+    params = make_params()
+    replica_counts = sorted(
+        int(x) for x in args.wd_replicas.split(","))
+    chunk_bytes = args.wd_chunk_kb << 10
+    sweep = []
+    for n in replica_counts:
+        row = dict(replicas=n)
+        for shape, fanout in (("tree", args.wd_fanout), ("unicast", 0)):
+            receivers = fleet(n)
+            dist = WeightDistributor(
+                "trainer", fanout=fanout, max_chunk_bytes=chunk_bytes)
+            rep = dist.push(params, 1, sorted(receivers),
+                            transport_for(receivers))
+            assert not rep.failed and not rep.resyncs
+            assert all(r.weight_sync.pending_version == 1
+                       for r in receivers.values())
+            row[shape] = dict(
+                modeled_latency_ms=round(
+                    rep.modeled_latency() * 1e3, 3),
+                bytes_sent=rep.bytes_sent,
+                relay_hops=rep.relay_hops,
+                chunks_sent=rep.chunks_sent)
+        row["speedup"] = round(
+            row["unicast"]["modeled_latency_ms"]
+            / row["tree"]["modeled_latency_ms"], 3)
+        sweep.append(row)
+
+    # dedup: a no-op re-push moves no chunk bytes; a push that only
+    # touched one layer moves only that layer's chunks
+    receivers = fleet(max(replica_counts))
+    dist = WeightDistributor("trainer", fanout=args.wd_fanout,
+                             max_chunk_bytes=chunk_bytes)
+    first = dist.push(params, 1, sorted(receivers),
+                      transport_for(receivers))
+    noop = dist.push(params, 2, sorted(receivers),
+                     transport_for(receivers))
+    params["model"]["layer_00"]["kernel"] = \
+        params["model"]["layer_00"]["kernel"] + np.float32(0.25)
+    partial = dist.push(params, 3, sorted(receivers),
+                        transport_for(receivers))
+    dedup = dict(
+        first_push_chunks=first.chunks_sent,
+        noop_repush=dict(chunks_sent=noop.chunks_sent,
+                         bytes_sent=noop.bytes_sent,
+                         dedup_ratio=noop.dedup_ratio()),
+        one_layer_touched=dict(chunks_sent=partial.chunks_sent,
+                               bytes_sent=partial.bytes_sent,
+                               dedup_ratio=round(
+                                   partial.dedup_ratio(), 3)))
+
+    # int8 wire encoding: size win + error within the quantizer bound
+    receivers = fleet(2)
+    dist8 = WeightDistributor("trainer", fanout=args.wd_fanout,
+                              max_chunk_bytes=chunk_bytes,
+                              encoding="int8")
+    rep8 = dist8.push(params, 1, ["gen_server/0", "gen_server/1"],
+                      transport_for(receivers))
+    raw_bytes = sum(
+        leaf.nbytes for lay in params["model"].values()
+        for leaf in lay.values()) * 2  # two receivers
+    recv = receivers["gen_server/0"]
+    err_ok = True
+    max_rel_err = 0.0
+    for i in range(n_layers):
+        orig = params["model"][f"layer_{i:02d}"]["kernel"]
+        got = recv._leaves[f"model/layer_{i:02d}/kernel"]
+        bound = float(int8_roundtrip_error_bound(orig))
+        err = float(np.max(np.abs(orig - got)))
+        err_ok = err_ok and err <= bound
+        max_rel_err = max(max_rel_err, err / max(bound, 1e-12))
+    int8 = dict(bytes_sent=rep8.bytes_sent, raw_bytes=raw_bytes,
+                compression=round(raw_bytes / rep8.bytes_sent, 3),
+                error_within_bound=err_ok,
+                max_err_vs_bound=round(max_rel_err, 4))
+
+    # acceptance: the tree beats unicast once there is fan-out to
+    # exploit, and its latency growth is SUB-LINEAR in replica count
+    lo, hi = sweep[0], sweep[-1]
+    growth = (hi["tree"]["modeled_latency_ms"]
+              / lo["tree"]["modeled_latency_ms"])
+    linear = hi["replicas"] / lo["replicas"]
+    ok = (all(r["speedup"] > 1.0 for r in sweep
+              if r["replicas"] >= 4)
+          and growth < 0.75 * linear
+          and noop.dedup_ratio() > 1.0
+          and partial.dedup_ratio() > 1.0
+          and int8["compression"] > 2.0 and err_ok)
+    return dict(
+        params_mb=round(sum(
+            leaf.nbytes for lay in params["model"].values()
+            for leaf in lay.values()) / 2**20, 2),
+        fanout=args.wd_fanout, chunk_kb=args.wd_chunk_kb,
+        sweep=sweep,
+        tree_latency_growth=round(growth, 3),
+        linear_growth=linear,
+        dedup=dedup, int8=int8, ok=ok,
+        note=("modeled_latency prices the MEASURED post-dedup "
+              "per-edge bytes under a serialized-sender link model: "
+              "unicast is O(N) at the root, the relay tree pipelines "
+              "to O(log N) depth"))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=4)
@@ -810,7 +949,23 @@ def main(argv=None):
                          "fleet to drain back down")
     ap.add_argument("--rejection-bound", type=float, default=None,
                     help="exit 1 when the rejection rate exceeds this")
+    # -- chunked weight distribution bench -----------------------------
+    ap.add_argument("--weight-dist", action="store_true",
+                    help="run the chunked weight-distribution bench "
+                         "(relay tree vs unicast swap latency, dedup "
+                         "ratio, int8 wire encoding) instead of the "
+                         "hot-path scenarios")
+    ap.add_argument("--wd-replicas", default="2,4,8,16",
+                    help="comma list of replica counts to sweep")
+    ap.add_argument("--wd-layers", type=int, default=8)
+    ap.add_argument("--wd-dim", type=int, default=256)
+    ap.add_argument("--wd-fanout", type=int, default=2)
+    ap.add_argument("--wd-chunk-kb", type=int, default=256)
     args = ap.parse_args(argv)
+    if args.weight_dist:
+        out = dict(weight_dist=run_weight_dist(args))
+        print(json.dumps(out))
+        return 0 if out["weight_dist"]["ok"] else 1
     if args.kv_pool:
         out = dict(kv_pool=run_kv_pool(args))
         print(json.dumps(out))

@@ -52,6 +52,10 @@ from realhf_tpu.obs import metrics  # noqa: E402
 from realhf_tpu.serving.fleet import FleetRegistry  # noqa: E402
 from realhf_tpu.serving.request_queue import RequestQueue  # noqa: E402
 from realhf_tpu.serving.router import FleetRouter  # noqa: E402
+from realhf_tpu.serving.router_shard import (  # noqa: E402
+    ShardedRolloutClient,
+    ShardedRouter,
+)
 from realhf_tpu.serving.server import (  # noqa: E402
     TERMINAL_KINDS,
     RolloutClient,
@@ -94,10 +98,13 @@ class DrillEvent:
 @dataclasses.dataclass
 class DrillRequest:
     """One scripted client request: submitted at ``tick``, needing
-    ``need`` decode tokens, with an optional ttl."""
+    ``need`` decode tokens, with an optional ttl. A fixed ``rid``
+    makes the request's ring owner deterministic in sharded-router
+    drills (ring placement is a pure function of the rid)."""
     tick: int
     need: int = 24
     ttl: Optional[float] = 120.0
+    rid: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -109,6 +116,11 @@ class Delivery:
     from_replica: Optional[str]
     replica_lost: bool = False
     epoch_stale: bool = False
+    #: the delivering router was FENCED at finish time -- a fenced
+    #: shard's sends must never reach a client, so any True here is a
+    #: violation (sharded plane only; always False for the singleton)
+    router_fenced: bool = False
+    router: str = "router/0"
 
 
 @dataclasses.dataclass
@@ -133,6 +145,10 @@ class DrillReport:
         default_factory=dict)
     ticks: int = 0
     router_stats: dict = dataclasses.field(default_factory=dict)
+    #: router_kill scenario only: the kill instant, the rids the dead
+    #: shard held in flight, and how long re-homing them took (ms of
+    #: simulated time from SIGKILL to the last such rid's terminal)
+    router_kill: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -152,13 +168,14 @@ class DrillReport:
             retire_redispatches=self.retire_redispatches,
             drain_abandoned=self.drain_abandoned,
             server_fence_drops=self.server_fence_drops,
-            breaker_transitions=self.breaker_transitions)
+            breaker_transitions=self.breaker_transitions,
+            router_kill=self.router_kill)
 
 
-class _RecordingRouter(FleetRouter):
-    """FleetRouter that records every terminal delivery together with
-    the state of the replica it came from -- the fencing invariant is
-    checked on exactly what the client was sent."""
+class _RecordingMixin:
+    """Records every terminal delivery together with the state of the
+    replica it came from -- the fencing invariant is checked on
+    exactly what the client was sent."""
 
     def __init__(self, *a, drill_clock=None, **kw):
         self.deliveries: List[Delivery] = []
@@ -178,8 +195,18 @@ class _RecordingRouter(FleetRouter):
                 replica_lost=bool(rep is not None and rep.lost),
                 epoch_stale=bool(
                     rep is not None and live is not None
-                    and live.epoch != rep.epoch)))
+                    and live.epoch != rep.epoch),
+                router_fenced=bool(getattr(self, "_fenced", False)),
+                router=getattr(self, "router_name", "router/0")))
         super()._finish(req, kind, data, from_replica)
+
+
+class _RecordingRouter(_RecordingMixin, FleetRouter):
+    pass
+
+
+class _RecordingShardedRouter(_RecordingMixin, ShardedRouter):
+    pass
 
 
 class DrillFleet:
@@ -190,7 +217,8 @@ class DrillFleet:
                  dt: float = 0.05, net_faults: str = "",
                  hedge_delay: Optional[float] = None,
                  backend_factory=None,
-                 router_kwargs: Optional[dict] = None):
+                 router_kwargs: Optional[dict] = None,
+                 n_routers: int = 1):
         self.clock = DrillClock()
         self.dt = dt
         self.n_slots, self.chunk = n_slots, chunk
@@ -232,9 +260,27 @@ class DrillFleet:
                   probe_timeout=1.0, hedge_delay=hedge_delay,
                   affinity_prefix_len=0)
         kw.update(router_kwargs or {})
-        self.router = _RecordingRouter(
-            self.registry, router_name="router/0", chaos=self.chaos,
-            clock=self.clock, drill_clock=self.clock, **kw)
+        self.n_routers = n_routers
+        self.routers: Dict[str, FleetRouter] = {}
+        self.routers_alive: List[str] = []
+        #: set by router_die(): the kill instant + the rids the victim
+        #: held in flight, for the re-home latency computation
+        self.router_kill: dict = {}
+        if n_routers <= 1:
+            self.router = _RecordingRouter(
+                self.registry, router_name="router/0",
+                chaos=self.chaos, clock=self.clock,
+                drill_clock=self.clock, **kw)
+            self.routers["router/0"] = self.router
+            self.routers_alive.append("router/0")
+        else:
+            for i in range(n_routers):
+                rn = f"router/{i}"
+                self.routers[rn] = _RecordingShardedRouter(
+                    self.registry, router_name=rn, chaos=self.chaos,
+                    clock=self.clock, drill_clock=self.clock, **kw)
+                self.routers_alive.append(rn)
+            self.router = self.routers["router/0"]
         self.clients: List[RolloutClient] = []
         self.events: Dict[str, List[tuple]] = {}
 
@@ -284,6 +330,22 @@ class DrillFleet:
         self.retiring[name] = self._tick + (
             drain_ticks or self.drain_deadline_ticks)
 
+    def router_die(self, name: str):
+        """SIGKILL a router shard: its socket vanishes mid-burst, no
+        deregistration, its lease decays and survivors adopt its hash
+        range via the journal (docs/serving.md "Sharded router
+        plane")."""
+        r = self.routers[name]
+        self.router_kill = dict(
+            router=name, t_ms=int(self.clock.t * 1000),
+            inflight=sorted(r._requests))
+        # a crash never deregisters: fence the shard locally so
+        # close() skips the graceful deregistration path, exactly
+        # like a SIGKILL'd process whose lease simply decays
+        r._fenced = True
+        r.close()
+        self.routers_alive.remove(name)
+
     def apply(self, ev: DrillEvent):
         if ev.action == "die":
             self.die(ev.target)
@@ -296,14 +358,21 @@ class DrillFleet:
         elif ev.action == "retire":
             self.retire(ev.target, drain_ticks=int(ev.seconds / self.dt)
                         if ev.seconds else 0)
+        elif ev.action == "router_die":
+            self.router_die(ev.target)
         else:
             raise ValueError(f"Unknown drill action {ev.action!r} "
                              "(know: die, revive, partition, spawn, "
-                             "retire)")
+                             "retire, router_die)")
 
     # -- lockstep drill loop -------------------------------------------
-    def client(self) -> RolloutClient:
-        c = RolloutClient(self.router.address)
+    def client(self):
+        if self.n_routers > 1:
+            c = ShardedRolloutClient(self.registry,
+                                     ring_poll_interval=self.dt,
+                                     clock=self.clock)
+        else:
+            c = RolloutClient(self.router.address)
         self.clients.append(c)
         return c
 
@@ -320,7 +389,8 @@ class DrillFleet:
     def step(self):
         self._tick += 1
         self.clock.advance(self.dt)
-        self.router.route_step(poll_timeout=0.002)
+        for rn in list(self.routers_alive):
+            self.routers[rn].route_step(poll_timeout=0.002)
         for name in list(self.alive):
             self.servers[name].serve_step(poll_timeout=0.002)
         # advance scale-down drains: a retiring replica finishes when
@@ -340,12 +410,33 @@ class DrillFleet:
                 self.retired.append(name)
         self._pump_clients()
 
+    # -- cross-shard views ---------------------------------------------
+    def all_deliveries(self) -> List[Delivery]:
+        out: List[Delivery] = []
+        for r in self.routers.values():
+            out.extend(r.deliveries)
+        return sorted(out, key=lambda d: d.tick)
+
+    def agg_counters(self) -> Dict[str, int]:
+        agg: Dict[str, int] = {}
+        for r in self.routers.values():
+            for k, v in r.stats_counters.items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def router_stats(self) -> dict:
+        if self.n_routers <= 1:
+            return self.router.stats()
+        return {rn: self.routers[rn].stats()
+                for rn in self.routers_alive}
+
     def close(self):
         for c in self.clients:
             c.close()
         for name in list(self.alive):
             self.servers[name].close()
-        self.router.close()
+        for rn in list(self.routers):
+            self.routers[rn].close()
 
 
 def run_drill(fleet: DrillFleet, requests: List[DrillRequest],
@@ -374,7 +465,8 @@ def run_drill(fleet: DrillFleet, requests: List[DrillRequest],
             fleet.apply(ev)
         for r in by_tick_req.get(tick, ()):
             prompt = np.array([r.need, 3, 5], np.int32)
-            rids.append(client.submit(prompt, ttl=r.ttl))
+            kw = dict(rid=r.rid) if r.rid else {}
+            rids.append(client.submit(prompt, ttl=r.ttl, **kw))
         fleet.step()
         report.ticks = tick + 1
         if (tick > max(last_submit, last_event)
@@ -392,17 +484,32 @@ def run_drill(fleet: DrillFleet, requests: List[DrillRequest],
             report.duplicate_rids.append(rid)
         else:
             report.outcomes[ts[0]] = report.outcomes.get(ts[0], 0) + 1
+    deliveries = fleet.all_deliveries()
     report.fenced_deliveries = [
-        dataclasses.asdict(d) for d in fleet.router.deliveries
-        if d.replica_lost or d.epoch_stale]
-    sc = fleet.router.stats_counters
+        dataclasses.asdict(d) for d in deliveries
+        if d.replica_lost or d.epoch_stale or d.router_fenced]
+    sc = fleet.agg_counters()
     report.failovers = sc["failovers"]
     report.hedges = sc["hedges"]
     report.hedge_wins = sc["hedge_wins"]
     report.fenced_reconnects = sc["fenced_reconnects"]
     report.retired = list(fleet.retired)
     report.retire_redispatches = sc["retire_redispatches"]
-    report.router_stats = fleet.router.stats()
+    report.router_stats = fleet.router_stats()
+    if fleet.router_kill:
+        kill = dict(fleet.router_kill)
+        victims = set(kill.get("inflight", ()))
+        t0 = kill["t_ms"]
+        rehomed = [d.tick for d in deliveries
+                   if d.rid in victims and d.tick >= t0]
+        kill["n_inflight"] = len(victims)
+        kill["rehome_ms"] = (max(rehomed) - t0) if rehomed else -1
+        kill["adopted"] = sc.get("adopted", 0)
+        for c in fleet.clients:
+            if hasattr(c, "stats"):
+                kill["client"] = dict(c.stats)
+                break
+        report.router_kill = kill
     snap = metrics.snapshot()
     drops = snap.get("serving_fenced_dropped_total", {})
     report.server_fence_drops = float(sum(
@@ -498,7 +605,41 @@ def churn_scenario(scale: float = 1.0):
     return fleet, requests, schedule
 
 
-SCENARIOS = dict(standard=standard_scenario, churn=churn_scenario)
+#: router_kill: re-home must complete within this much SIMULATED time
+#: after the SIGKILL (lease decay ~2s + journal sweep + re-decode)
+ROUTER_KILL_REHOME_DEADLINE_MS = 6000
+
+
+def router_kill_scenario(scale: float = 1.0):
+    """Sharded-router-plane acceptance drill (docs/serving.md
+    "Sharded router plane"): TWO router shards split the rid ring;
+    one is SIGKILLed mid-burst (socket gone, no deregistration, lease
+    decays). The survivor must adopt the dead shard's journaled hash
+    range and every in-flight rid must still reach EXACTLY ONE
+    terminal at the client -- with nothing delivered by the fenced
+    corpse, and the re-home completing within the deadline. Fixed
+    rids keep ring placement (and so the kill's blast radius)
+    deterministic."""
+    n_req = max(12, int(24 * scale))
+    need = max(16, int(24 * scale))
+    # a DENSE burst -- one submit per tick -- so the kill lands with
+    # work in flight on both shards
+    requests = [DrillRequest(tick=2 + i, need=need,
+                             rid=f"burst-{i:04d}")
+                for i in range(n_req)]
+    kill_tick = 2 + n_req // 2
+    schedule = [
+        DrillEvent(tick=kill_tick, action="router_die",
+                   target="router/1"),
+    ]
+    fleet = DrillFleet(n_replicas=3, lease_ttl=2.0, dt=0.05,
+                       n_routers=2,
+                       router_kwargs=dict(response_timeout=4.0))
+    return fleet, requests, schedule
+
+
+SCENARIOS = dict(standard=standard_scenario, churn=churn_scenario,
+                 router_kill=router_kill_scenario)
 
 
 def main(argv=None) -> int:
@@ -529,6 +670,25 @@ def main(argv=None) -> int:
             print(f"CHURN FAILED: retired replicas tripped breakers: "
                   f"{dirty}", file=sys.stderr)
             out["retired_breaker_violations"] = dirty
+            print(json.dumps(out, indent=2, default=str))
+            return 1
+    if args.scenario == "router_kill":
+        # scenario-specific invariants: the kill must actually have
+        # caught requests in flight on the victim (else the drill
+        # proved nothing), and re-homing them must beat the deadline
+        rk = report.router_kill
+        problems = []
+        if rk.get("n_inflight", 0) < 1:
+            problems.append("kill caught no in-flight requests")
+        rehome = rk.get("rehome_ms", -1)
+        if not 0 <= rehome <= ROUTER_KILL_REHOME_DEADLINE_MS:
+            problems.append(
+                f"re-home took {rehome}ms "
+                f"(deadline {ROUTER_KILL_REHOME_DEADLINE_MS}ms)")
+        if problems:
+            print(f"ROUTER_KILL FAILED: {'; '.join(problems)}",
+                  file=sys.stderr)
+            out["router_kill_violations"] = problems
             print(json.dumps(out, indent=2, default=str))
             return 1
     if args.json:

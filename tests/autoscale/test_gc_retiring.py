@@ -1,0 +1,145 @@
+"""retiring/ marker garbage collection (ISSUE 16 satellite): when no
+router ever observes a departure (routerless autoscale, or the router
+died first), ``FleetRegistry.gc_retiring`` sweeps markers whose
+replica lease has been gone past a grace period -- and
+``AutoscaleController.step`` runs the sweep every poll, so repeated
+scale-down cycles never accumulate keys."""
+
+import pytest
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.obs import flight, metrics
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.system.autoscale import AutoscaleController, \
+    ReplicaActuator
+from realhf_tpu.system.elastic import AutoscalePolicy, AutoscaleSignals
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    metrics.reset_default()
+    flight.reset_default()
+    yield
+
+
+def make_registry(clock, lease_ttl=2.0):
+    repo = MemoryNameRecordRepository(clock=clock)
+    return FleetRegistry("e", "t", lease_ttl=lease_ttl, repo=repo,
+                         clock=clock)
+
+
+def _retiring_names(registry):
+    root = f"{registry._root}/retiring"
+    return sorted(k[len(root) + 1:]
+                  for k in registry._repo.find_subtree(root))
+
+
+def test_orphaned_marker_swept_after_grace():
+    clock = Clock()
+    registry = make_registry(clock)
+    registry.register("gen_server/0", "a")
+    registry.mark_retiring("gen_server/0")
+    registry.deregister("gen_server/0")  # departed, marker orphaned
+    assert registry.gc_retiring() == []  # first pass only OBSERVES
+    clock.advance(3.9)                   # grace = 2 * lease_ttl = 4
+    assert registry.gc_retiring() == []
+    clock.advance(0.2)
+    assert registry.gc_retiring() == ["gen_server/0"]
+    assert not registry.is_retiring("gen_server/0")
+    assert _retiring_names(registry) == []
+
+
+def test_still_draining_replica_is_not_swept():
+    clock = Clock()
+    registry = make_registry(clock)
+    registry.register("gen_server/0", "a")
+    registry.mark_retiring("gen_server/0")
+    for _ in range(5):
+        clock.advance(1.0)
+        registry.renew("gen_server/0")   # still draining, lease alive
+        assert registry.gc_retiring() == []
+    assert registry.is_retiring("gen_server/0")
+    # once it actually departs, the grace clock starts FROM the
+    # departure observation, not from mark_retiring
+    registry.deregister("gen_server/0")
+    registry.gc_retiring()
+    clock.advance(4.1)
+    assert registry.gc_retiring() == ["gen_server/0"]
+
+
+def test_repeated_cycles_never_accumulate():
+    """The leak this satellite closes: N mark/deregister cycles used
+    to leave N keys until the TTL backstop."""
+    clock = Clock()
+    registry = make_registry(clock)
+    for i in range(10):
+        name = f"gen_server/{i}"
+        registry.register(name, "a")
+        registry.mark_retiring(name)
+        registry.deregister(name)
+        registry.gc_retiring()           # observe
+        clock.advance(4.1)
+        registry.gc_retiring()           # sweep
+        assert len(_retiring_names(registry)) == 0, i
+
+
+class _Actuator(ReplicaActuator):
+    def __init__(self, registry):
+        self.registry = registry
+        self.dead = set()
+
+    def spawn(self, name):
+        self.registry.register(name, "tcp://x")
+
+    def retire(self, name):
+        # an abrupt retire: the process exits without any router
+        # clearing the retiring marker
+        self.registry.deregister(name)
+        self.dead.add(name)
+
+    def gone(self, name):
+        return name in self.dead
+
+    def reap(self, name):
+        self.dead.add(name)
+
+
+def test_controller_step_sweeps_orphans():
+    clock = Clock()
+    registry = make_registry(clock, lease_ttl=2.0)
+    names = ["gen_server/0", "gen_server/1"]
+    for n in names:
+        registry.register(n, "tcp://seed")
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=4, up_queue_per_replica=2,
+        consecutive_up=2, down_idle_per_replica=4.0,
+        consecutive_down=2, cooldown_secs=5.0, clock=clock)
+    ctl = AutoscaleController(
+        policy, _Actuator(registry), registry, initial=names,
+        spawn_deadline_secs=30.0, retire_deadline_secs=20.0,
+        clock=clock)
+    idle = AutoscaleSignals(queue_depth=0, inflight=0)
+    saw_marker = False
+    for _ in range(20):
+        clock.advance(1.0)
+        for n in list(registry.replicas()):
+            registry.renew(n)
+        ctl.step(idle)
+        saw_marker = saw_marker or bool(_retiring_names(registry))
+    # the scale-down happened, its marker existed transiently ...
+    assert ctl.n_replicas == 1
+    assert saw_marker
+    # ... and the controller's own polling swept it: no manual
+    # gc_retiring call anywhere in this test
+    assert _retiring_names(registry) == []

@@ -125,3 +125,67 @@ def test_server_fence_flush_and_rejoin():
     finally:
         server._fleet = None
         server.close()
+
+
+# -- router-plane membership (ISSUE 16: sharded router plane) ----------
+def test_router_register_renew_expire_fence(reg):
+    registry, clock = reg
+    e1 = registry.register_router("router/0", "tcp://r:1")
+    assert e1 == 1
+    info = registry.routers()["router/0"]
+    assert info.address == "tcp://r:1" and info.epoch == 1
+    clock.advance(1.5)
+    registry.renew_router("router/0")
+    clock.advance(2.5)  # silent past the ttl: fenced
+    assert registry.routers() == {}
+    with pytest.raises(LeaseLostError):
+        registry.renew_router("router/0")
+    # re-registration bumps the fencing epoch -- survivors that
+    # adopted the dead shard's range can tell old sends from new
+    assert registry.register_router("router/0", "tcp://r:2") == 2
+    assert registry.router_epoch_of("router/0") == 2
+
+
+def test_router_and_replica_subtrees_are_disjoint(reg):
+    registry, _ = reg
+    registry.register("gen_server/0", "a")
+    registry.register_router("router/0", "b")
+    assert list(registry.replicas()) == ["gen_server/0"]
+    assert list(registry.routers()) == ["router/0"]
+    registry.deregister_router("router/0")
+    registry.deregister_router("router/0")  # idempotent
+    assert registry.routers() == {}
+    assert list(registry.replicas()) == ["gen_server/0"]
+
+
+def test_router_epochs_survive_departure_and_stay_monotone(reg):
+    registry, _ = reg
+    for want in (1, 2, 3):
+        assert registry.register_router("router/1", "x") == want
+        registry.deregister_router("router/1")
+    assert registry.router_epoch_of("router/1") == 3
+    assert registry.router_epoch_of("router/9") is None
+
+
+# -- in-flight rid journal ---------------------------------------------
+def test_journal_write_read_clear(reg):
+    registry, _ = reg
+    registry.journal_rid("rid-1", "router/0|payload")
+    registry.journal_rid("rid-2", "router/1|payload")
+    assert registry.journal() == {"rid-1": "router/0|payload",
+                                  "rid-2": "router/1|payload"}
+    # re-journal overwrites (the adopting shard re-owns the rid)
+    registry.journal_rid("rid-1", "router/1|payload2")
+    assert registry.journal()["rid-1"] == "router/1|payload2"
+    registry.clear_rid("rid-1")
+    registry.clear_rid("rid-1")  # idempotent
+    assert registry.journal() == {"rid-2": "router/1|payload"}
+
+
+def test_journal_ttl_backstop(reg):
+    """A rid outliving the (generous) TTL merely loses journal
+    coverage; it must never pin registry state forever."""
+    registry, clock = reg
+    registry.journal_rid("rid-old", "router/0|p")
+    clock.advance(20.0 * registry.lease_ttl + 61.0)
+    assert registry.journal() == {}

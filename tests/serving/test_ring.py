@@ -1,0 +1,96 @@
+"""Consistent-hash ring (serving/ring.py): the pure placement
+function under the sharded router plane (docs/serving.md "Sharded
+router plane"). Placement must be deterministic across processes
+(sha1, never Python hash()), balanced enough to be useful, and --
+the property failover correctness rests on -- MINIMALLY disruptive:
+removing one shard re-homes only the rids it owned."""
+
+import pytest
+
+from realhf_tpu.serving.ring import Ring, rehomed, ring_points
+
+
+def _rids(n):
+    return [f"rid-{i:05d}" for i in range(n)]
+
+
+def test_empty_ring_owns_nothing():
+    r = Ring([])
+    assert not r
+    assert r.owner_of("anything") is None
+
+
+def test_single_owner_owns_everything():
+    r = Ring(["router/0"])
+    assert all(r.owner_of(x) == "router/0" for x in _rids(50))
+
+
+def test_deterministic_and_order_insensitive():
+    a = Ring(["router/2", "router/0", "router/1"])
+    b = Ring(["router/0", "router/1", "router/2"])
+    assert a.names == b.names == ("router/0", "router/1", "router/2")
+    for rid in _rids(200):
+        assert a.owner_of(rid) == b.owner_of(rid)
+    # pure function of (names, vnodes): a rebuilt ring agrees
+    c = Ring(["router/0", "router/1", "router/2"])
+    assert [c.owner_of(r) for r in _rids(200)] \
+        == [a.owner_of(r) for r in _rids(200)]
+
+
+def test_vnodes_spread_points():
+    pts = ring_points(["router/0"], n_vnodes=64)
+    assert len(pts) == 64
+    assert len({p for p, _ in pts}) == 64  # sha1 points distinct
+
+
+def test_partition_covers_and_balances():
+    names = [f"router/{i}" for i in range(4)]
+    ring = Ring(names)
+    rids = _rids(2000)
+    parts = ring.partition(rids)
+    got = [r for chunk in parts.values() for r in chunk]
+    assert sorted(got) == sorted(rids)  # total, no duplicates
+    # crude balance: no shard owns more than half of everything
+    assert max(len(v) for v in parts.values()) < len(rids) // 2
+
+
+def test_minimal_disruption_on_removal():
+    """The failover property: dropping one shard moves ONLY the rids
+    that shard owned; everything else keeps its owner."""
+    names = [f"router/{i}" for i in range(4)]
+    before = Ring(names)
+    after = Ring([n for n in names if n != "router/2"])
+    rids = _rids(1000)
+    owned_by_dead = {r for r in rids
+                     if before.owner_of(r) == "router/2"}
+    moved = {r for r in rids
+             if before.owner_of(r) != after.owner_of(r)}
+    assert moved == owned_by_dead
+    plan = rehomed(names, [n for n in names if n != "router/2"], rids)
+    assert set(plan) == owned_by_dead
+    # every re-homed rid lands on its new ring owner
+    assert all(after.owner_of(r) == o for r, o in plan.items())
+
+
+def test_minimal_disruption_on_addition():
+    names = [f"router/{i}" for i in range(3)]
+    before = Ring(names)
+    after = Ring(names + ["router/3"])
+    rids = _rids(1000)
+    moved = {r for r in rids
+             if before.owner_of(r) != after.owner_of(r)}
+    # everything that moved, moved TO the new shard
+    assert all(after.owner_of(r) == "router/3" for r in moved)
+    # and the new shard got a non-trivial share
+    assert moved
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 8])
+def test_rehome_deterministic_across_rebuilds(n):
+    """Survivors independently agree on the re-home plan: the plan is
+    a pure function of the (unordered) membership sets."""
+    names = [f"router/{i}" for i in range(n)]
+    rids = _rids(300)
+    a = rehomed(names, names[:-1], rids)
+    b = rehomed(list(reversed(names)), names[:-1], rids)
+    assert a == b

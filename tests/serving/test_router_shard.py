@@ -1,0 +1,323 @@
+"""Sharded router plane (serving/router_shard.py): wrong_owner
+bounces, lease-expiry fencing (a fenced shard's late sends deliver
+NOTHING), journal adoption after a shard death with exactly-once
+terminals, parked-terminal handover, and the epoch race (two
+contenders for one name -> one winner, the loser permanently quiet).
+
+In-process fleets on ``FakeSlotBackend`` with an injected fake clock,
+mirroring tests/serving/test_router.py; the full SIGKILL-mid-burst
+drill runs in tests/chaos/test_router_kill_drill.py."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.base.name_resolve import MemoryNameRecordRepository
+from realhf_tpu.base.testing import FakeSlotBackend
+from realhf_tpu.obs import metrics
+from realhf_tpu.serving.fleet import FleetRegistry
+from realhf_tpu.serving.request_queue import RequestQueue
+from realhf_tpu.serving.ring import Ring
+from realhf_tpu.serving.router_shard import (
+    ShardedRolloutClient,
+    ShardedRouter,
+    decode_journal,
+    encode_journal,
+)
+from realhf_tpu.serving.server import TERMINAL_KINDS, RolloutServer
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_default()
+    yield
+
+
+def rid_owned_by(ring: Ring, owner: str, prefix: str = "rid") -> str:
+    """A deterministic rid that hashes to ``owner`` on ``ring``."""
+    for i in range(10_000):
+        rid = f"{prefix}-{i:05d}"
+        if ring.owner_of(rid) == owner:
+            return rid
+    raise AssertionError(f"no rid found for {owner}")
+
+
+class ShardFleet:
+    """N router shards over M replicas, lockstep on a fake clock."""
+
+    def __init__(self, n_routers=2, n_replicas=2, lease_ttl=2.0,
+                 dt=0.05, **router_kwargs):
+        self.clock = Clock()
+        self.dt = dt
+        self.repo = MemoryNameRecordRepository(clock=self.clock)
+        self.registry = FleetRegistry("e", "t", lease_ttl=lease_ttl,
+                                      repo=self.repo)
+        self.servers = {}
+        self.alive = []
+        for i in range(n_replicas):
+            self.spawn(f"gen_server/{i}")
+        kw = dict(fleet_poll_interval=dt, dispatch_timeout=1.0,
+                  response_timeout=5.0, pending_timeout=30.0,
+                  breaker_failures=2, breaker_cooldown=1.0,
+                  probe_timeout=1.0, affinity_prefix_len=0)
+        kw.update(router_kwargs)
+        self.routers = {}
+        self.routers_alive = []
+        for i in range(n_routers):
+            rn = f"router/{i}"
+            self.routers[rn] = ShardedRouter(
+                self.registry, router_name=rn, clock=self.clock, **kw)
+            self.routers_alive.append(rn)
+        for r in self.routers.values():
+            r._refresh_ring(force=True)  # see the full shard set
+        self.client = ShardedRolloutClient(
+            self.registry, ring_poll_interval=dt, clock=self.clock)
+        self.client._refresh_ring(force=True)
+        self.events = {}
+
+    def spawn(self, name):
+        srv = RolloutServer(
+            FakeSlotBackend(n_slots=2, chunk=4), server_name=name,
+            queue=RequestQueue(max_depth=32, n_slots=2,
+                               clock=self.clock),
+            fleet=self.registry, clock=self.clock,
+            seed=len(self.servers))
+        self.servers[name] = srv
+        self.alive.append(name)
+        return srv
+
+    def router_die(self, name):
+        r = self.routers[name]
+        r._fenced = True  # crash: no graceful deregistration
+        r.close()
+        self.routers_alive.remove(name)
+
+    def step(self, dt=None):
+        self.clock.advance(dt if dt is not None else self.dt)
+        for rn in list(self.routers_alive):
+            self.routers[rn].route_step(poll_timeout=0.002)
+        for name in list(self.alive):
+            self.servers[name].serve_step(poll_timeout=0.002)
+        while self.client._pump(0.002):
+            pass
+        for rid, q in self.client._events.items():
+            while q:
+                self.events.setdefault(rid, []).append(q.pop(0))
+
+    def terminals(self, rid):
+        return [(k, d) for k, d in self.events.get(rid, [])
+                if k in TERMINAL_KINDS]
+
+    def run_until_terminal(self, rids, max_steps=600):
+        for _ in range(max_steps):
+            self.step()
+            if all(self.terminals(r) for r in rids):
+                return
+        missing = [r for r in rids if not self.terminals(r)]
+        raise AssertionError(f"no terminal for {missing}")
+
+    def close(self):
+        self.client.close()
+        for name in self.alive:
+            self.servers[name].close()
+        for rn in list(self.routers):
+            self.routers[rn].close()
+
+
+# ----------------------------------------------------------------------
+def test_journal_roundtrip():
+    payload = encode_journal("router/1", [5, 3, 2], 1, 12.5, 7)
+    owner, env = decode_journal(payload)
+    assert owner == "router/1"
+    assert env == dict(prompt=[5, 3, 2], priority=1, ttl=12.5,
+                       min_wv=7)
+
+
+def test_shards_split_ownership_and_route(tmp_path):
+    f = ShardFleet(n_routers=2)
+    try:
+        ring = f.routers["router/0"]._ring
+        assert ring.names == ("router/0", "router/1")
+        r0 = rid_owned_by(ring, "router/0")
+        r1 = rid_owned_by(ring, "router/1")
+        a = f.client.submit(np.array([8, 3, 5], np.int32), rid=r0)
+        b = f.client.submit(np.array([8, 4, 6], np.int32), rid=r1)
+        f.run_until_terminal([a, b])
+        assert f.terminals(a)[0][0] == "done"
+        assert f.terminals(b)[0][0] == "done"
+        # each shard served exactly its own rid: no cross-talk
+        assert f.routers["router/0"].stats_counters["requests"] == 1
+        assert f.routers["router/1"].stats_counters["requests"] == 1
+        assert f.client.stats["bounces"] == 0
+    finally:
+        f.close()
+
+
+def test_wrong_owner_bounce_resolves():
+    """A submit landing on a non-owner (stale client ring) is bounced
+    with the owner's coordinates and completes after re-resolution."""
+    f = ShardFleet(n_routers=2)
+    try:
+        ring = f.routers["router/0"]._ring
+        rid = rid_owned_by(ring, "router/1")
+        # freeze the client on a stale single-shard ring so the first
+        # send goes to the WRONG shard (cadence suppresses refresh)
+        f.client._refresh_ring(force=True)
+        f.client._ring = Ring(["router/0"])
+        got = f.client.submit(np.array([8, 3, 5], np.int32), rid=rid)
+        assert got == rid
+        f.run_until_terminal([rid])
+        assert [k for k, _ in f.terminals(rid)] == ["done"]
+        assert f.client.stats["bounces"] >= 1
+        assert f.routers["router/0"].stats_counters["wrong_owner"] == 1
+    finally:
+        f.close()
+
+
+def test_router_death_adoption_exactly_once():
+    """Kill one of two shards with requests in flight: the survivor
+    adopts the journaled rids, the client re-resolves, and every rid
+    reaches exactly one terminal."""
+    f = ShardFleet(n_routers=2, n_replicas=3, lease_ttl=2.0,
+                   response_timeout=4.0)
+    try:
+        ring = f.routers["router/0"]._ring
+        rids = [rid_owned_by(ring, "router/1", prefix=f"kill{i}")
+                for i in range(3)]
+        rids += [rid_owned_by(ring, "router/0", prefix="keep")]
+        for i, rid in enumerate(rids):
+            f.client.submit(np.array([24, 3 + i, 5], np.int32),
+                            rid=rid, ttl=60.0)
+        f.step()  # let the submits land + dispatch begin
+        victim_inflight = set(f.routers["router/1"]._requests)
+        assert victim_inflight, "kill must catch work in flight"
+        f.router_die("router/1")
+        f.run_until_terminal(rids, max_steps=800)
+        for rid in rids:
+            assert [k for k, _ in f.terminals(rid)] == ["done"], rid
+        sc = f.routers["router/0"].stats_counters
+        assert sc["adopted"] >= 1
+        # the journal is cleared once terminals land: nothing leaks
+        assert f.registry.journal() == {}
+    finally:
+        f.close()
+
+
+def test_fenced_shard_delivers_nothing_then_recovers():
+    """Lease expiry fences the shard: its in-flight state is flushed
+    WITHOUT terminals and nothing reaches the client while fenced.
+    The rejoin re-adopts the shard's own journal entries, so the
+    request still completes -- exactly once."""
+    f = ShardFleet(n_routers=1, n_replicas=1, lease_ttl=2.0)
+    try:
+        r = f.routers["router/0"]
+        rid = f.client.submit(np.array([24, 3, 5], np.int32),
+                              rid="fence-rid", ttl=60.0)
+        f.step()
+        assert rid in r._requests
+        # silence the renewals past the ttl: the next upkeep fences,
+        # flushes terminal-lessly, rejoins at a fresh epoch, and
+        # re-adopts the shard's own journal entries in one pass
+        f.clock.advance(5.0)
+        epoch_before = r.router_epoch
+        events_before = len(f.events.get(rid, []))
+        r.route_step(poll_timeout=0.002)
+        assert r.stats_counters["router_fences"] == 1
+        assert r.router_epoch > epoch_before
+        assert r.stats_counters["adopted"] == 1
+        # the pre-fence client route was flushed with the state: the
+        # re-adopted request has NO delivery path yet, and nothing
+        # reached the client from the fence/rejoin cycle
+        assert r._requests[rid].ident is None
+        assert r._requests[rid].retried_from == ["router/0"]
+        while f.client._pump(0.002):
+            pass
+        assert len(f.client._events.get(rid, [])) == 0
+        assert len(f.events.get(rid, [])) == events_before
+        # the client observes the epoch bump, resubmits, re-attaches,
+        # and the rid completes -- exactly once
+        f.run_until_terminal([rid], max_steps=600)
+        assert [k for k, _ in f.terminals(rid)] == ["done"]
+        assert f.client.stats["resubmits"] >= 1
+    finally:
+        f.close()
+
+
+def test_parked_terminal_handed_over_on_resubmit():
+    """A terminal that lands while the adopted rid has no client
+    route is parked, then handed over when the client resubmits."""
+    f = ShardFleet(n_routers=1, n_replicas=1, lease_ttl=2.0)
+    try:
+        r = f.routers["router/0"]
+        # adopt a journaled rid directly (as if its owner died): the
+        # request has ident=None until some client re-attaches
+        rid = "parked-rid"
+        f.registry.journal_rid(rid, encode_journal(
+            "router/9", [8, 3, 5], 0, 60.0, 0))
+        r._journal_sweep_due = True
+        r._refresh_ring(force=True)
+        assert rid in r._requests
+        assert r._requests[rid].ident is None
+        # run the fleet WITHOUT a client submission: terminal parks
+        for _ in range(200):
+            f.step()
+            if r.stats_counters["parked_terminals"]:
+                break
+        assert r.stats_counters["parked_terminals"] == 1
+        assert rid in r._parked
+        assert not f.events.get(rid)  # client saw nothing yet
+        # the client resubmits (its failover path): parked terminal
+        # is delivered immediately, exactly once
+        f.client.submit(np.array([8, 3, 5], np.int32), rid=rid)
+        f.run_until_terminal([rid])
+        assert [k for k, _ in f.terminals(rid)] == ["done"]
+        assert rid not in r._parked
+    finally:
+        f.close()
+
+
+def test_epoch_race_one_winner():
+    """Two contenders register the same shard name after a lease
+    lapse: the later registration takes the higher epoch, and the
+    earlier incarnation permanently fences itself on observing it."""
+    f = ShardFleet(n_routers=1, n_replicas=1, lease_ttl=2.0)
+    try:
+        old = f.routers["router/0"]
+        e1 = old.router_epoch
+        # a replacement process claims the name (higher epoch)
+        new = ShardedRouter(
+            f.registry, router_name="router/0", clock=f.clock,
+            fleet_poll_interval=f.dt, dispatch_timeout=1.0,
+            response_timeout=5.0, pending_timeout=30.0,
+            breaker_failures=2, breaker_cooldown=1.0,
+            probe_timeout=1.0, affinity_prefix_len=0)
+        f.routers["router/0-new"] = new  # closed by f.close()
+        assert new.router_epoch > e1
+        # consumers resolve the NEW address
+        assert f.registry.routers()["router/0"].address == new.address
+        # the zombie observes the higher epoch and goes quiet forever
+        old._refresh_ring(force=True)
+        assert old._superseded and old._fenced
+        addr_before = f.registry.routers()["router/0"].address
+        for _ in range(80):
+            f.clock.advance(f.dt)
+            old.route_step(poll_timeout=0.0)
+            new.route_step(poll_timeout=0.0)
+        # the zombie never re-registered over the winner
+        assert f.registry.routers()["router/0"].address == addr_before
+        assert f.registry.routers()["router/0"].epoch \
+            == new.router_epoch
+        # late sends from the superseded incarnation deliver nothing
+        assert old._send_replica("gen_server/0", ("x",)) is False
+    finally:
+        f.close()

@@ -1,0 +1,254 @@
+"""Chunked, deduplicated, tree-fanned weight distribution
+(serving/weight_dist.py; docs/serving.md "Chunked weight
+distribution"): flatten/chunk roundtrip, per-receiver content dedup,
+int8 wire encoding within the quantizer's error bound, relay-tree
+shape, relay-failure fallback to direct push, and receiver resync."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.engine.kv_pool import int8_roundtrip_error_bound
+from realhf_tpu.obs import metrics
+from realhf_tpu.serving.weight_dist import (
+    Chunk,
+    ChunkedWeightReceiver,
+    WeightDistributor,
+    chunk_digest,
+    chunk_id,
+    chunk_paths,
+    encode_chunk,
+    flatten_params,
+    relay_tree,
+    unflatten_params,
+)
+from realhf_tpu.serving.weight_sync import WeightSync
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset_default()
+    yield
+
+
+def make_params(seed=0, dim=64, n_layers=3):
+    rng = np.random.default_rng(seed)
+    return dict(model={
+        f"layer_{i}": dict(
+            kernel=rng.standard_normal((dim, dim)).astype(np.float32),
+            bias=rng.standard_normal((dim,)).astype(np.float32))
+        for i in range(n_layers)})
+
+
+def make_fleet(n):
+    return {f"gen_server/{i}": ChunkedWeightReceiver(WeightSync())
+            for i in range(n)}
+
+
+def transport_for(receivers, fail=(), log=None):
+    def transport(sender, receiver, message):
+        if log is not None:
+            log.append((sender, receiver,
+                        len(message["chunks"])))
+        if receiver in fail:
+            raise ConnectionError(f"{receiver} is dead")
+        return receivers[receiver].apply(message)
+    return transport
+
+
+# -- flatten / chunk ---------------------------------------------------
+def test_flatten_roundtrip():
+    params = make_params()
+    flat = flatten_params(params)
+    assert all("/" in p for p in flat)
+    back = unflatten_params(flat)
+    assert sorted(flatten_params(back)) == sorted(flat)
+    np.testing.assert_array_equal(
+        back["model"]["layer_0"]["kernel"],
+        params["model"]["layer_0"]["kernel"])
+
+
+def test_flatten_rejects_slash_keys_and_non_mapping_root():
+    with pytest.raises(ValueError):
+        flatten_params({"a/b": np.zeros(2)})
+    with pytest.raises(TypeError):
+        flatten_params(np.zeros(2))
+
+
+def test_chunk_paths_respects_budget_and_is_deterministic():
+    params = make_params(dim=32, n_layers=6)
+    flat = flatten_params(params)
+    groups = chunk_paths(flat, max_chunk_bytes=32 * 32 * 4 * 2)
+    assert sorted(p for g in groups for p in g) == sorted(flat)
+    for g in groups:
+        nbytes = sum(flat[p].nbytes for p in g)
+        assert nbytes <= 32 * 32 * 4 * 2 or len(g) == 1
+    assert groups == chunk_paths(flat, max_chunk_bytes=32 * 32 * 4 * 2)
+
+
+def test_chunk_identity_vs_digest():
+    params = make_params()
+    flat = flatten_params(params)
+    paths = tuple(sorted(flat))[:2]
+    cid1, dig1 = chunk_id(paths), chunk_digest(paths, flat)
+    # same paths, changed contents: identity stable, digest moves
+    flat2 = dict(flat)
+    flat2[paths[0]] = flat[paths[0]] + 1.0
+    assert chunk_id(paths) == cid1
+    assert chunk_digest(paths, flat2) != dig1
+
+
+def test_encode_chunk_roundtrips_raw():
+    flat = flatten_params(make_params())
+    paths = tuple(sorted(flat))
+    c = encode_chunk(paths, flat, "raw")
+    assert isinstance(c, Chunk) and c.nbytes > 0
+    recv = ChunkedWeightReceiver(WeightSync())
+    recv.apply(dict(version=1, manifest=[(c.cid, c.digest)],
+                    chunks=[c], sender="trainer"))
+    for p in paths:
+        np.testing.assert_array_equal(recv._leaves[p], flat[p])
+        # the receiver owns its buffers even over an IN-PROCESS
+        # transport (it installs with copy=False): never an alias of
+        # the sender's array
+        assert not np.shares_memory(recv._leaves[p], flat[p]), p
+
+
+def test_int8_encoding_smaller_and_within_bound():
+    rng = np.random.default_rng(1)
+    flat = {"w": rng.standard_normal((64, 64)).astype(np.float32),
+            "b": rng.standard_normal((8,)).astype(np.float32)}
+    c8 = encode_chunk(tuple(sorted(flat)), flat, "int8")
+    craw = encode_chunk(tuple(sorted(flat)), flat, "raw")
+    assert c8.nbytes < craw.nbytes
+    assert c8.digest == craw.digest  # dedup is encoding-agnostic
+    assert c8.leaves["w"]["enc"] == "int8"
+    assert c8.leaves["b"]["enc"] == "raw"  # tiny leaf stays raw
+    recv = ChunkedWeightReceiver(WeightSync())
+    recv.apply(dict(version=1, manifest=[(c8.cid, c8.digest)],
+                    chunks=[c8], sender="trainer"))
+    err = np.max(np.abs(recv._leaves["w"] - flat["w"]))
+    assert err <= float(int8_roundtrip_error_bound(flat["w"]))
+    np.testing.assert_array_equal(recv._leaves["b"], flat["b"])
+
+
+# -- relay tree --------------------------------------------------------
+def test_relay_tree_shape():
+    names = [f"r/{i}" for i in range(7)]
+    edges = relay_tree("root", names, fanout=2)
+    assert len(edges) == 7
+    senders = [s for s, _ in edges]
+    # root feeds the first `fanout` positions, then the heap layout
+    assert senders[:2] == ["root", "root"]
+    assert senders[2:4] == ["r/0", "r/0"]
+    assert senders[4:6] == ["r/1", "r/1"]
+    assert senders[6] == "r/2"
+    # every receiver appears exactly once
+    assert sorted(r for _, r in edges) == sorted(names)
+    # unicast degenerate form
+    assert relay_tree("root", names, fanout=0) \
+        == [("root", n) for n in sorted(names)]
+
+
+def test_push_installs_everywhere_and_dedups_repush():
+    params = make_params()
+    receivers = make_fleet(5)
+    # one 64x64 fp32 kernel is ~16 KiB: a 20 KB budget forces one
+    # chunk per layer, so partial dedup is observable below
+    dist = WeightDistributor("trainer", fanout=2,
+                             max_chunk_bytes=20_000)
+    rep = dist.push(params, 1, sorted(receivers),
+                    transport_for(receivers))
+    assert not rep.failed and not rep.resyncs
+    assert rep.relay_hops > 0
+    assert rep.chunks_sent == rep.chunks_total * 5
+    for r in receivers.values():
+        assert r.weight_sync.pending_version == 1
+        assert r.installs == 1
+    # no-op re-push: full dedup, zero bytes, but a FULL tree installs
+    rep2 = dist.push(params, 2, sorted(receivers),
+                     transport_for(receivers))
+    assert rep2.chunks_sent == 0 and rep2.bytes_sent == 0
+    assert rep2.dedup_ratio() == float("inf")
+    for r in receivers.values():
+        assert r.weight_sync.pending_version == 2
+    # touch one layer: only its chunks move
+    params["model"]["layer_1"]["kernel"] += 0.5
+    rep3 = dist.push(params, 3, sorted(receivers),
+                     transport_for(receivers))
+    assert 0 < rep3.chunks_sent < rep.chunks_sent
+    assert rep3.dedup_ratio() > 1.0
+
+
+def test_modeled_latency_tree_beats_unicast():
+    params = make_params()
+    names = [f"gen_server/{i}" for i in range(16)]
+    lat = {}
+    for shape, fanout in (("tree", 2), ("unicast", 0)):
+        receivers = {n: ChunkedWeightReceiver(WeightSync())
+                     for n in names}
+        dist = WeightDistributor("trainer", fanout=fanout)
+        rep = dist.push(params, 1, names, transport_for(receivers))
+        lat[shape] = rep.modeled_latency()
+    assert lat["tree"] < lat["unicast"]
+
+
+def test_relay_failure_falls_back_to_direct():
+    """A dead relay's subtree is re-parented to the root; only the
+    dead node misses the push."""
+    params = make_params()
+    receivers = make_fleet(7)
+    names = sorted(receivers)
+    # gen_server/0 relays for 1 and 2 under fanout=2: kill it
+    dist = WeightDistributor("trainer", fanout=2)
+    log = []
+    rep = dist.push(params, 1, names,
+                    transport_for(receivers,
+                                  fail={"gen_server/0"}, log=log))
+    assert rep.failed == ["gen_server/0"]
+    assert rep.fallback_directs >= 2  # its two children re-parented
+    for n, r in receivers.items():
+        if n != "gen_server/0":
+            assert r.weight_sync.pending_version == 1, n
+    # the orphaned children were pushed FROM the root
+    assert ("trainer", "gen_server/2", rep.chunks_total) in log
+    # next push: the dead node's dedup map was forgotten, so a
+    # revived receiver gets a full resend
+    receivers["gen_server/0"] = ChunkedWeightReceiver(WeightSync())
+    rep2 = dist.push(params, 2, names, transport_for(receivers))
+    assert not rep2.failed
+    assert rep2.chunks_sent == rep.chunks_total  # only the revived one
+    assert receivers["gen_server/0"].weight_sync.pending_version == 2
+
+
+def test_receiver_resync_on_lost_state():
+    """A receiver that lost its held chunks answers ok=False with the
+    missing cids; the distributor wipes its dedup map and re-sends
+    everything direct."""
+    params = make_params()
+    receivers = make_fleet(2)
+    dist = WeightDistributor("trainer", fanout=2)
+    dist.push(params, 1, sorted(receivers), transport_for(receivers))
+    # simulate a restart: the receiver forgets everything, while the
+    # distributor still believes it holds every chunk
+    receivers["gen_server/1"] = ChunkedWeightReceiver(WeightSync())
+    rep = dist.push(params, 2, sorted(receivers),
+                    transport_for(receivers))
+    assert rep.resyncs == ["gen_server/1"]
+    assert rep.chunks_sent == rep.chunks_total  # full resend, one node
+    assert receivers["gen_server/1"].weight_sync.pending_version == 2
+
+
+def test_stale_version_push_is_tolerated():
+    """Reordered relay delivery: an older version arriving after a
+    newer one installed is acknowledged and dropped, not fatal."""
+    params = make_params()
+    recv = ChunkedWeightReceiver(WeightSync(version=0))
+    flat = flatten_params(params)
+    paths = tuple(sorted(flat))
+    c = encode_chunk(paths, flat, "raw")
+    msg = dict(manifest=[(c.cid, c.digest)], chunks=[c],
+               sender="trainer")
+    assert recv.apply(dict(msg, version=5))["ok"]
+    assert recv.apply(dict(msg, version=3))["ok"]  # stale: dropped
+    assert recv.weight_sync.pending_version == 5
+    assert recv.installs == 1

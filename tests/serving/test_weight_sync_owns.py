@@ -1,0 +1,80 @@
+"""Regression tests for the WeightSync ownership contract
+(docs/serving.md "Chunked weight distribution", owns_params): after
+``push(params, v)`` returns, the caller may freely mutate -- or hand
+to a donating jit -- its own tree without corrupting the pending
+swap. Guards against reintroducing the old aliasing behaviour where
+the mailbox held the trainer's live buffers."""
+
+import numpy as np
+import pytest
+
+from realhf_tpu.serving.weight_sync import WeightSync
+
+
+def make_tree():
+    return dict(model=dict(
+        kernel=np.arange(16, dtype=np.float32).reshape(4, 4),
+        bias=np.zeros(4, dtype=np.float32)))
+
+
+def test_push_snapshots_numpy_leaves():
+    ws = WeightSync()
+    tree = make_tree()
+    want = {k: v.copy() for k, v in tree["model"].items()}
+    ws.push(tree, 1)
+    # trainer keeps training: in-place mutation of its own buffers
+    tree["model"]["kernel"] += 100.0
+    tree["model"]["bias"][:] = -1.0
+    installed = {}
+    assert ws.poll(installed.update) == 1
+    np.testing.assert_array_equal(installed["model"]["kernel"],
+                                  want["kernel"])
+    np.testing.assert_array_equal(installed["model"]["bias"],
+                                  want["bias"])
+    # and the snapshot is not aliased to the caller's buffers
+    assert not np.shares_memory(installed["model"]["kernel"],
+                                tree["model"]["kernel"])
+
+
+def test_push_snapshots_jax_leaves_against_donation():
+    jnp = pytest.importorskip("jax.numpy")
+    ws = WeightSync()
+    leaf = jnp.arange(8, dtype=jnp.float32)
+    ws.push(dict(w=leaf), 1)
+    # simulate the trainer donating its buffer on the next step
+    leaf.delete()
+    installed = {}
+    assert ws.poll(installed.update) == 1
+    np.testing.assert_array_equal(
+        np.asarray(installed["w"]),
+        np.arange(8, dtype=np.float32))
+
+
+def test_copy_false_transfers_ownership():
+    """The ChunkedWeightReceiver path: freshly materialized arrays are
+    handed over without a second copy, so mutation DOES show through
+    -- which is exactly why copy=False is reserved for callers that
+    never touch the tree again."""
+    ws = WeightSync()
+    tree = make_tree()
+    ws.push(tree, 1, copy=False)
+    installed = {}
+    ws.poll(installed.update)
+    assert np.shares_memory(installed["model"]["kernel"],
+                            tree["model"]["kernel"])
+
+
+def test_stale_push_refused_and_pending_overwrite():
+    ws = WeightSync(version=3)
+    with pytest.raises(ValueError):
+        ws.push(make_tree(), 3)  # not newer than installed
+    ws.push(make_tree(), 4)
+    with pytest.raises(ValueError):
+        ws.push(make_tree(), 4)  # not newer than pending
+    t5 = make_tree()
+    t5["model"]["kernel"] += 1.0
+    ws.push(t5, 5)  # newer push replaces the un-installed v4
+    installed = {}
+    assert ws.poll(installed.update) == 5
+    assert ws.version == 5 and ws.swaps_installed == 1
+    assert ws.poll(installed.update) is None
