@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from realhf_tpu.serving import protocol
 from realhf_tpu.serving.server import RolloutResult
 
 
@@ -84,7 +85,7 @@ class LocalRolloutBackend:
         self.generated += len(outs)
         self.batches += 1
         return [
-            RolloutResult(rid=rid, status="done", data=dict(
+            RolloutResult(rid=rid, status=protocol.DONE, data=dict(
                 tokens=np.asarray(o.tokens, np.int32),
                 logprobs=np.asarray(o.logprobs, np.float32),
                 no_eos=bool(o.no_eos), weight_version=version))

@@ -25,6 +25,15 @@ rests on (docs/static_analysis.md):
   ``_secs``/``_seconds``.
 - ``obs-catalog``: the docs/observability.md metric catalog and the
   instrumented call sites agree, in both directions.
+- ``wire``: every serving-plane send/handle site uses kinds, payload
+  fields, reasons and request arities declared in
+  ``serving/protocol.py``, and the declared protocol is fully
+  emitted, fully handled, and FSM-covered in both directions.
+- ``model``: bounded explicit-state model checking of the declared
+  failover state machines against the guard profile extracted from
+  ``serving/router_shard.py`` -- exactly-once terminals, no
+  fenced-epoch delivery, journal drained, no parked-forever
+  terminal, each violation reported with a replayable trace.
 
 CLI: ``python -m realhf_tpu.analysis [--fail-on-new] [--baseline F]
 [--checker NAME] [--diff REF] [paths...]`` -- see ``__main__.py``.
@@ -47,6 +56,7 @@ from realhf_tpu.analysis.core import (  # noqa: F401
 )
 from realhf_tpu.analysis.determinism import DeterminismChecker
 from realhf_tpu.analysis.dfg_invariants import DfgInvariantsChecker
+from realhf_tpu.analysis.explore import ModelChecker
 from realhf_tpu.analysis.finding import Finding  # noqa: F401
 from realhf_tpu.analysis.jax_purity import JaxPurityChecker
 from realhf_tpu.analysis.lifecycle import LifecycleChecker
@@ -54,6 +64,7 @@ from realhf_tpu.analysis.lockorder import LockOrderChecker
 from realhf_tpu.analysis.obs_catalog import ObsCatalogChecker
 from realhf_tpu.analysis.obs_metrics import ObsMetricNameChecker
 from realhf_tpu.analysis.terminal import TerminalChecker
+from realhf_tpu.analysis.wire import WireChecker
 
 #: family name -> checker class, in documentation order
 CHECKER_CLASSES = {
@@ -66,6 +77,8 @@ CHECKER_CLASSES = {
     DfgInvariantsChecker.name: DfgInvariantsChecker,
     ObsMetricNameChecker.name: ObsMetricNameChecker,
     ObsCatalogChecker.name: ObsCatalogChecker,
+    WireChecker.name: WireChecker,
+    ModelChecker.name: ModelChecker,
 }
 
 

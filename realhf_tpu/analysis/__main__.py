@@ -12,8 +12,11 @@
         [--no-dfg]                  # skip the import-time DFG pass
         [--diff [REF]]              # only report on files changed vs
                                     # a git ref (default HEAD); skips
-                                    # the project-wide passes -- the
-                                    # fast pre-commit mode
+                                    # the project-wide passes except
+                                    # the ones that declare the
+                                    # changed files relevant (wire,
+                                    # model) -- the fast pre-commit
+                                    # mode
         [--no-cache] [--cache-dir D]
 
 Default paths: the ``realhf_tpu`` package under the current directory.
@@ -98,9 +101,11 @@ def main(argv=None) -> int:
                     metavar="REF",
                     help="only report on .py files changed vs the git "
                          "ref (default HEAD); the call graph still "
-                         "spans the whole package, but project-wide "
-                         "passes (dfg-invariants, obs-catalog) are "
-                         "skipped")
+                         "spans the whole package, and project-wide "
+                         "passes are skipped unless they declare the "
+                         "changed files relevant (wire runs on "
+                         "serving/ edits, model on router_shard.py "
+                         "edits)")
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the on-disk result cache")
     ap.add_argument("--cache-dir", default=CACHE_DIR_NAME,
@@ -124,10 +129,6 @@ def main(argv=None) -> int:
 
     project_paths = None
     if args.diff is not None:
-        # fast pre-commit mode: report on changed files only; the
-        # whole-project import-time passes don't decompose per file
-        checkers = [c for c in checkers
-                    if not isinstance(c, ProjectChecker)]
         try:
             changed = _changed_files(args.diff, paths)
         except (OSError, RuntimeError) as e:
@@ -136,6 +137,13 @@ def main(argv=None) -> int:
         if not changed:
             print(f"graft-lint: no changed .py files vs {args.diff}.")
             return 0
+        # fast pre-commit mode: report on changed files only; the
+        # whole-project import-time passes don't decompose per file
+        # and are skipped -- except the narrow-scope ones (wire,
+        # model) that declare the changed files relevant
+        checkers = [c for c in checkers
+                    if not isinstance(c, ProjectChecker)
+                    or c.diff_relevant(changed)]
         project_paths, paths = paths, changed
 
     cache = None if args.no_cache else AnalysisCache(

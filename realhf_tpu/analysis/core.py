@@ -119,6 +119,15 @@ class ProjectChecker:
         for cacheable checkers whose inputs go beyond the .py tree."""
         return ""
 
+    def diff_relevant(self, changed: Sequence[str]) -> bool:
+        """Whether ``--diff`` mode should still run this checker for
+        the given changed repo-relative paths. Default False: most
+        project passes don't decompose per file and are skipped in
+        the fast pre-commit mode. Cacheable checkers with a narrow
+        scope (wire, model) override this so protocol edits are
+        checked before they are committed."""
+        return False
+
     def check_project(self, root: str) -> List[Finding]:
         raise NotImplementedError
 

@@ -1,0 +1,209 @@
+"""Explicit-state explorer for the fleet model (graft-verify).
+
+Deterministic bounded breadth-first search over
+:class:`~realhf_tpu.analysis.model.FleetModel`: actions are
+enumerated in sorted order, states are deduped on their (hashable,
+frozen) value, and the search carries parent pointers so every
+violation comes with a minimal-length action trace a human can replay
+against the runtime. Two invariant families:
+
+- safety (``FleetModel.safety_violations``) is checked on every
+  state as it is first reached;
+- quiescence (``FleetModel.quiescence_violations``) is checked on
+  states with no enabled action -- the liveness proxy: "nothing can
+  move and the protocol still owes something".
+
+``ModelChecker`` wraps one tier-1-scope exploration of the *real*
+``serving/router_shard.py`` (guards extracted from its source, see
+:func:`~realhf_tpu.analysis.model.extract_guards`) as a cacheable
+project checker in the lint gate: a refactor that silently drops one
+of the failover guards turns into a lint finding carrying the
+counterexample trace.
+"""
+
+import dataclasses
+import hashlib
+import os
+from collections import deque
+from typing import List, Optional, Tuple
+
+from realhf_tpu.analysis.core import ProjectChecker
+from realhf_tpu.analysis.finding import Finding
+from realhf_tpu.analysis.model import (
+    TIER1_CONFIG,
+    FleetModel,
+    ModelConfig,
+    extract_guards,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    invariant: str
+    message: str
+    #: action names from the initial state to the violating state
+    trace: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    states: int
+    transitions: int
+    max_depth: int
+    violations: List[Violation]
+    #: True when a bound (max_states / max_depth) cut the search
+    #: short -- "no violations" is then a bounded claim, not a proof
+    truncated: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> str:
+        verdict = "ok" if self.ok \
+            else f"{len(self.violations)} violation(s)"
+        extra = " (TRUNCATED)" if self.truncated else ""
+        return (f"{self.states} states, {self.transitions} "
+                f"transitions, depth {self.max_depth}: "
+                f"{verdict}{extra}")
+
+
+def explore(model: FleetModel, max_states: int = 200_000,
+            max_depth: int = 64,
+            stop_at_first: bool = True) -> ExploreResult:
+    """Exhaust the model's state space within the given bounds."""
+    init = model.initial()
+    parents = {init: None}  # state -> (parent state, action name)
+    queue = deque([(init, 0)])
+    transitions = 0
+    deepest = 0
+    violations: List[Violation] = []
+    truncated = False
+
+    def _trace(state) -> Tuple[str, ...]:
+        out = []
+        while True:
+            link = parents[state]
+            if link is None:
+                return tuple(reversed(out))
+            state, action = link
+            out.append(action)
+
+    for err in model.safety_violations(init):
+        violations.append(Violation(err.split(":")[0], err, ()))
+
+    while queue:
+        if len(parents) > max_states:
+            truncated = True
+            break
+        state, depth = queue.popleft()
+        deepest = max(deepest, depth)
+        succ = model.actions(state)
+        transitions += len(succ)
+        if not succ:
+            for err in model.quiescence_violations(state):
+                violations.append(Violation(
+                    err.split(":")[0], err, _trace(state)))
+                if stop_at_first:
+                    return ExploreResult(len(parents), transitions,
+                                         deepest, violations)
+            continue
+        if depth >= max_depth:
+            truncated = True
+            continue
+        for action, nxt in succ:
+            if nxt in parents:
+                continue
+            parents[nxt] = (state, action)
+            for err in model.safety_violations(nxt):
+                violations.append(Violation(
+                    err.split(":")[0], err,
+                    _trace(state) + (action,)))
+                if stop_at_first:
+                    return ExploreResult(len(parents), transitions,
+                                         deepest + 1, violations)
+            queue.append((nxt, depth + 1))
+
+    return ExploreResult(len(parents), transitions, deepest,
+                         violations, truncated=truncated)
+
+
+def check_source(source: str,
+                 config: ModelConfig = TIER1_CONFIG,
+                 max_states: int = 200_000,
+                 max_depth: int = 64) -> ExploreResult:
+    """Extract the guard profile from router_shard-shaped source and
+    exhaust the resulting model."""
+    guards = extract_guards(source)
+    cfg = dataclasses.replace(config, guards=guards)
+    return explore(FleetModel(cfg), max_states=max_states,
+                   max_depth=max_depth)
+
+
+# ----------------------------------------------------------------------
+# Lint-gate integration
+# ----------------------------------------------------------------------
+
+_SHARD_REL = os.path.join("realhf_tpu", "serving", "router_shard.py")
+
+
+class ModelChecker(ProjectChecker):
+    """Model-check the real failover plane inside the lint gate.
+
+    Tier-1 scope (1 shard x 1 replica x 1 rid, full fault budget) is
+    exhausted in well under a second and already exposes every guard
+    the :class:`~realhf_tpu.analysis.model.GuardProfile` tracks; the
+    2x2x2 scope runs in the slow test tier. Cacheable: reruns only
+    when router_shard.py (or this analysis code) changes.
+    """
+
+    name = "model"
+    cacheable = True
+
+    def __init__(self, config: ModelConfig = TIER1_CONFIG,
+                 max_states: int = 200_000, max_depth: int = 64):
+        self.config = config
+        self.max_states = max_states
+        self.max_depth = max_depth
+
+    def diff_relevant(self, changed) -> bool:
+        rel = _SHARD_REL.replace(os.sep, "/")
+        return any(c.replace(os.sep, "/") == rel for c in changed)
+
+    def stamp_extra(self, root: str) -> str:
+        h = hashlib.sha1()
+        h.update(repr(self.config).encode())
+        try:
+            with open(os.path.join(root, _SHARD_REL),
+                      encoding="utf-8") as f:
+                h.update(f.read().encode())
+        except OSError:
+            h.update(b"missing")
+        return h.hexdigest()
+
+    def check_project(self, root: str) -> List[Finding]:
+        path = os.path.join(root, _SHARD_REL)
+        if not os.path.exists(path):
+            return []
+        rel = _SHARD_REL.replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            result = check_source(source, self.config,
+                                  max_states=self.max_states,
+                                  max_depth=self.max_depth)
+        except SyntaxError:
+            return []  # the per-file passes already flag this
+        findings = []
+        for v in result.violations:
+            trace = " -> ".join(v.trace) or "<initial state>"
+            findings.append(Finding(
+                checker=self.name, code="model-" + v.invariant,
+                path=rel, line=0, col=0,
+                message=(f"model checking the failover plane at "
+                         f"scope {self.config.n_shards}x"
+                         f"{self.config.n_replicas}x"
+                         f"{self.config.n_rids} found: {v.message};"
+                         f" trace: {trace}"),
+                symbol=v.invariant))
+        return findings

@@ -1,0 +1,690 @@
+"""Bounded model of the sharded serving/failover plane (graft-verify).
+
+``serving/protocol.py`` declares the wire protocol and its state
+machines; this module turns the *failover semantics* of
+``serving/router_shard.py`` into a small explicit-state transition
+system that ``analysis/explore.py`` exhaustively explores at small
+scope under a fault model (message loss/dup/reorder on the submit
+path, shard SIGKILL, lease decay, same-name re-registration with an
+epoch bump).
+
+The model is tied to the code it abstracts through
+:func:`extract_guards`: an AST pass over the real
+``router_shard.py`` source that detects whether each load-bearing
+guard is present -- the PR 16 epoch-bump resubmit in
+``ShardedRolloutClient._check_failover``, terminal parking for
+unattached adopted rids in ``_send_ident``, the fenced-send gate, the
+parked-terminal handover in ``_handle_client``, and the journal
+adoption sweep. Each missing guard flips the corresponding
+:class:`GuardProfile` flag, and the explorer then finds the concrete
+interleaving the guard was protecting against (the killer regression:
+drop the epoch comparison and the checker reproduces the
+parked-forever-terminal liveness hole PR 16 fixed).
+
+Deliberate abstractions (documented, not bugs):
+
+- ``wrong_owner`` bouncing and priorities are elided; the ring maps
+  each rid to a deterministic home among the *active* shards.
+- No TTLs/timeouts: a submit lost before any shard journals the rid
+  is the training loop's requeue problem (``system/rollout.py``),
+  not a protocol-delivery hole, so quiescence only flags rids whose
+  terminal was *produced* but can never reach an open client.
+- Message loss is physical: a send fails synchronously (``_send_to``
+  returns False, so the client never commits ``target_epoch``) or an
+  in-flight message dies because its peer connection is down (target
+  fenced/crashed). TCP does not silently eat acknowledged sends to a
+  live peer.
+- Intermediate events (accepted/started/tokens) are elided; only
+  terminal delivery is tracked, which is what the invariants govern.
+
+Invariants (see docs/static_analysis.md "Model checking"):
+
+- ``exactly-once-terminal`` (safety): a client never harvests a
+  second terminal for a rid.
+- ``no-fenced-delivery`` (safety): nothing sent by a fenced shard
+  incarnation reaches a client.
+- ``journal-drained`` (quiescence): once nothing can move, no
+  journal entry survives for a closed rid.
+- ``terminal-delivered`` (quiescence): once nothing can move, no rid
+  has a produced terminal while its client is still open
+  (no-parked-forever-terminal).
+"""
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+# ----------------------------------------------------------------------
+# Guard extraction: tie the model to the real source
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardProfile:
+    """Which load-bearing failover guards the scanned source carries.
+
+    All flags are extracted syntactically (presence of the guarding
+    construct inside the named method); a missing method extracts as
+    False -- the model then explores the weakened system.
+    """
+
+    #: PR 16: ``_check_failover`` compares the recorded
+    #: ``target_epoch`` against the registry's current epoch, so a
+    #: fence-and-rejoin (name never left the ring) still triggers a
+    #: client resubmit.
+    client_epoch_resubmit: bool = True
+    #: ``_send_ident`` parks terminals for adopted rids whose client
+    #: has not re-attached (``ident is None``) instead of dropping
+    #: them.
+    terminal_parking: bool = True
+    #: ``_send_ident`` returns without sending while fenced.
+    fenced_send_guard: bool = True
+    #: ``_handle_client`` hands a parked terminal over on the
+    #: re-attaching submit.
+    parked_handover: bool = True
+    #: ``_adopt_orphans`` exists: journaled rids of dead/fenced
+    #: owners are re-adopted by the ring owner.
+    journal_adoption: bool = True
+    #: ``_on_msg`` drops events for rids whose terminal already
+    #: surfaced (the ``_closed`` tombstones): exactly-once at the
+    #: harvest boundary over an at-least-once wire.
+    client_terminal_dedupe: bool = True
+
+
+def _method_index(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _mentions_attr(node: ast.AST, attr: str) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr == attr
+               for n in ast.walk(node))
+
+
+def extract_guards(source: str) -> GuardProfile:
+    """Scan (router_shard-shaped) source for the failover guards."""
+    tree = ast.parse(source)
+    methods = _method_index(tree)
+
+    epoch = False
+    fn = methods.get("_check_failover")
+    if fn is not None:
+        epoch = any(isinstance(n, ast.Compare)
+                    and _mentions_attr(n, "target_epoch")
+                    for n in ast.walk(fn))
+
+    parking = False
+    fence_gate = False
+    fn = methods.get("_send_ident")
+    if fn is not None:
+        parking = any(
+            isinstance(n, ast.Assign)
+            and any(isinstance(t, ast.Subscript)
+                    and _mentions_attr(t, "_parked")
+                    for t in n.targets)
+            for n in ast.walk(fn))
+        fence_gate = any(
+            isinstance(n, ast.If) and _mentions_attr(n.test, "_fenced")
+            and any(isinstance(b, ast.Return) for b in n.body)
+            for n in ast.walk(fn))
+
+    dedupe = False
+    fn = methods.get("_on_msg")
+    if fn is not None:
+        dedupe = any(
+            isinstance(n, ast.Compare)
+            and any(isinstance(op, ast.In) for op in n.ops)
+            and _mentions_attr(n, "_closed")
+            for n in ast.walk(fn))
+
+    handover = False
+    fn = methods.get("_handle_client")
+    if fn is not None:
+        handover = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "pop"
+            and _mentions_attr(n.func.value, "_parked")
+            for n in ast.walk(fn))
+
+    return GuardProfile(
+        client_epoch_resubmit=epoch,
+        terminal_parking=parking,
+        fenced_send_guard=fence_gate,
+        parked_handover=handover,
+        journal_adoption="_adopt_orphans" in methods,
+        client_terminal_dedupe=dedupe)
+
+
+# ----------------------------------------------------------------------
+# Model configuration
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Scope and fault budgets of one exploration."""
+
+    n_shards: int = 1
+    n_replicas: int = 1
+    n_rids: int = 1
+    #: lease decays / SIGKILLs, shared budget (each also permits one
+    #: same-name re-registration via the rejoin action)
+    crashes: int = 1
+    #: submit-path message losses (send_to returning False)
+    drops: int = 1
+    #: submit-path duplicates (resubmission races)
+    dups: int = 1
+    #: client failover resubmissions per rid
+    resubmits_per_rid: int = 2
+    #: model process death (parked/done state lost) in addition to
+    #: lease decay (in-memory state survives)
+    sigkill: bool = True
+    guards: GuardProfile = GuardProfile()
+
+    def shard_names(self) -> Tuple[str, ...]:
+        return tuple(f"s{i}" for i in range(self.n_shards))
+
+    def replica_names(self) -> Tuple[str, ...]:
+        return tuple(f"g{i}" for i in range(self.n_replicas))
+
+    def rids(self) -> Tuple[str, ...]:
+        return tuple(f"r{i}" for i in range(self.n_rids))
+
+
+#: tier-1 scope: the lint gate explores this exhaustively in well
+#: under a second; the PR 16 hole already manifests here.
+TIER1_CONFIG = ModelConfig(n_shards=1, n_replicas=1, n_rids=1)
+
+#: the ISSUE's full small scope, exhaustive behind ``-m slow``
+FULL_CONFIG = ModelConfig(n_shards=2, n_replicas=2, n_rids=2)
+
+
+# ----------------------------------------------------------------------
+# State (immutable -- states are dict keys in the explorer)
+# ----------------------------------------------------------------------
+
+#: client status values
+INIT, INFLIGHT, CLOSED = "init", "inflight", "closed"
+#: shard request stages
+PENDING, DISPATCHED = "pending", "dispatched"
+#: shard statuses
+ACTIVE, FENCED = "active", "fenced"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardState:
+    status: str = ACTIVE
+    epoch: int = 1
+    #: rid -> (stage, attached): attached means the client route is
+    #: known (ident is not None)
+    requests: Tuple[Tuple[str, Tuple[str, bool]], ...] = ()
+    done: FrozenSet[str] = frozenset()
+    #: rid -> parked terminal kind
+    parked: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientState:
+    status: str = INIT
+    target: str = ""
+    target_epoch: int = 0
+    terminals: int = 0
+    #: late terminals the harvest-boundary tombstones swallowed
+    dup_suppressed: int = 0
+    fenced_deliveries: int = 0
+    resubmits: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetState:
+    shards: Tuple[Tuple[str, ShardState], ...]
+    #: replica -> ((rid, owner shard), ...): generating rids
+    replicas: Tuple[Tuple[str, Tuple[Tuple[str, str], ...]], ...]
+    clients: Tuple[Tuple[str, ClientState], ...]
+    #: registry journal: rid -> owning shard name
+    journal: Tuple[Tuple[str, str], ...]
+    #: in-flight bags (sorted tuples: delivery order is
+    #: nondeterministic, which models reordering for free)
+    submits: Tuple[Tuple[str, str], ...]            # (target, rid)
+    dispatches: Tuple[Tuple[str, str, str], ...]    # (shard, rep, rid)
+    repl_events: Tuple[Tuple[str, str, str], ...]   # (shard, rep, rid)
+    #: shard -> client terminals: (sender, rid, kind,
+    #: fenced_send) -- the sender tag exists so a SIGKILL can reap
+    #: the dead incarnation's unflushed zmq send queue
+    events: Tuple[Tuple[str, str, str, bool], ...]
+    crashes_left: int = 0
+    drops_left: int = 0
+    dups_left: int = 0
+
+
+def _tset(pairs, key, value):
+    d = dict(pairs)
+    d[key] = value
+    return tuple(sorted(d.items()))
+
+
+def _tdel(pairs, key):
+    d = dict(pairs)
+    d.pop(key, None)
+    return tuple(sorted(d.items()))
+
+
+def _bag_add(bag, msg):
+    # multiplicity is capped at 2: delivery of these messages is
+    # idempotent, so a third identical copy in flight reaches no
+    # state two copies cannot -- without the cap, timeout/retry
+    # cycles would grow the bags (and the state space) unboundedly
+    if bag.count(msg) >= 2:
+        return bag
+    return tuple(sorted(bag + (msg,)))
+
+
+def _bag_remove(bag, msg):
+    out = list(bag)
+    out.remove(msg)
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# The model
+# ----------------------------------------------------------------------
+
+
+class FleetModel:
+    """Transition system over :class:`FleetState`.
+
+    The explorer drives it through :meth:`initial`, :meth:`actions`
+    (sorted ``(name, successor)`` pairs -- sorted enumeration keeps
+    runs deterministic), :meth:`safety_violations` (checked on every
+    reached state) and :meth:`quiescence_violations` (checked on
+    states with no enabled action).
+    """
+
+    def __init__(self, config: ModelConfig = TIER1_CONFIG):
+        self.config = config
+        self.guards = config.guards
+
+    # -- setup ---------------------------------------------------------
+    def initial(self) -> FleetState:
+        cfg = self.config
+        return FleetState(
+            shards=tuple((s, ShardState())
+                         for s in cfg.shard_names()),
+            replicas=tuple((g, ()) for g in cfg.replica_names()),
+            clients=tuple((r, ClientState()) for r in cfg.rids()),
+            journal=(), submits=(), dispatches=(), repl_events=(),
+            events=(),
+            crashes_left=cfg.crashes, drops_left=cfg.drops,
+            dups_left=cfg.dups)
+
+    def _owner_of(self, rid: str, shards) -> Optional[str]:
+        """Deterministic ring owner among the *active* shards."""
+        active = [n for n, s in shards if s.status == ACTIVE]
+        if not active:
+            return None
+        return active[int(rid[1:]) % len(active)]
+
+    # -- actions -------------------------------------------------------
+    def actions(self, st: FleetState
+                ) -> List[Tuple[str, FleetState]]:
+        out: List[Tuple[str, FleetState]] = []
+        shards = dict(st.shards)
+        clients = dict(st.clients)
+
+        for rid, c in st.clients:
+            if c.status == INIT:
+                nxt = self._submit(st, rid, c)
+                if nxt is not None:
+                    out.append((f"submit({rid})", nxt))
+                if st.drops_left > 0:
+                    # failed initial send: _submit_to returns False,
+                    # so target stays unset and the failover poll
+                    # retries (loss never commits client state)
+                    out.append((f"submit_fail({rid})",
+                                dataclasses.replace(
+                                    st,
+                                    drops_left=st.drops_left - 1,
+                                    clients=_tset(
+                                        st.clients, rid,
+                                        dataclasses.replace(
+                                            c, status=INFLIGHT)))))
+            elif c.status == INFLIGHT:
+                nxt = self._client_failover(st, rid, c)
+                if nxt is not None:
+                    out.append((f"failover_poll({rid})", nxt))
+
+        for msg in sorted(set(st.submits)):
+            out.append((f"deliver_submit{msg}",
+                        self._deliver_submit(st, msg)))
+            if st.drops_left > 0 and shards[msg[0]].status != ACTIVE:
+                # in-flight loss is a transport property: a message
+                # dies only when its peer connection is down (the
+                # target fenced/crashed under it)
+                out.append((f"drop_submit{msg}", dataclasses.replace(
+                    st, submits=_bag_remove(st.submits, msg),
+                    drops_left=st.drops_left - 1)))
+            if st.dups_left > 0:
+                out.append((f"dup_submit{msg}", dataclasses.replace(
+                    st, submits=_bag_add(st.submits, msg),
+                    dups_left=st.dups_left - 1)))
+
+        for sname, sh in st.shards:
+            if sh.status == ACTIVE:
+                for rid, (stage, att) in sh.requests:
+                    if stage == PENDING:
+                        for rep, _ in st.replicas:
+                            out.append((
+                                f"dispatch({sname},{rep},{rid})",
+                                self._dispatch(st, sname, rid, rep)))
+                    else:
+                        # router.py's dispatch/response timeout:
+                        # _fail_assignment returns the rid to pending
+                        # and it shops for a replica again (the
+                        # client-visible `retrying` is elided)
+                        reqs = dict(sh.requests)
+                        reqs[rid] = (PENDING, att)
+                        out.append((
+                            f"response_timeout({sname},{rid})",
+                            dataclasses.replace(
+                                st, shards=_tset(
+                                    st.shards, sname,
+                                    dataclasses.replace(
+                                        sh, requests=tuple(
+                                            sorted(reqs.items())))))))
+                if st.crashes_left > 0:
+                    out.append((f"lease_lose({sname})",
+                                self._fence(st, sname,
+                                            lose_memory=False)))
+                    if self.config.sigkill:
+                        out.append((f"sigkill({sname})",
+                                    self._fence(st, sname,
+                                                lose_memory=True)))
+                if self.guards.journal_adoption:
+                    nxt = self._sweep(st, sname)
+                    if nxt is not None:
+                        out.append((f"sweep({sname})", nxt))
+            else:
+                out.append((f"rejoin({sname})",
+                            self._rejoin(st, sname)))
+
+        for msg in sorted(set(st.dispatches)):
+            out.append((f"deliver_dispatch{msg}",
+                        self._deliver_dispatch(st, msg)))
+        for rep, gen in st.replicas:
+            for rid, owner in gen:
+                out.append((f"replica_done({rep},{rid})",
+                            self._replica_done(st, rep, rid, owner)))
+        for msg in sorted(set(st.repl_events)):
+            out.append((f"deliver_repl_event{msg}",
+                        self._deliver_repl_event(st, msg)))
+        for msg in sorted(set(st.events)):
+            out.append((f"deliver_event{msg}",
+                        self._deliver_event(st, msg)))
+
+        # a successor identical to the state is a disabled no-op, not
+        # a transition (quiescence = no action CHANGES anything)
+        out = [(n, s) for n, s in out if s != st]
+        out.sort(key=lambda p: p[0])
+        return out
+
+    # -- client side ---------------------------------------------------
+    def _submit(self, st, rid, c) -> Optional[FleetState]:
+        owner = self._owner_of(rid, st.shards)
+        if owner is None:
+            return None
+        epoch = dict(st.shards)[owner].epoch
+        return dataclasses.replace(
+            st,
+            submits=_bag_add(st.submits, (owner, rid)),
+            clients=_tset(st.clients, rid, dataclasses.replace(
+                c, status=INFLIGHT, target=owner,
+                target_epoch=epoch)))
+
+    def _client_failover(self, st, rid, c) -> Optional[FleetState]:
+        """The ShardedRolloutClient._check_failover poll: resubmit
+        when the target left the registry, or -- with the PR 16 guard
+        -- when its fencing epoch moved."""
+        if c.resubmits >= self.config.resubmits_per_rid:
+            return None
+        shards = dict(st.shards)
+        target = shards.get(c.target)
+        gone = target is None or target.status != ACTIVE
+        bumped = (not gone and self.guards.client_epoch_resubmit
+                  and target.epoch != c.target_epoch)
+        if not gone and not bumped:
+            return None
+        owner = self._owner_of(rid, st.shards)
+        if owner is None:
+            return None
+        return dataclasses.replace(
+            st,
+            submits=_bag_add(st.submits, (owner, rid)),
+            clients=_tset(st.clients, rid, dataclasses.replace(
+                c, target=owner,
+                target_epoch=shards[owner].epoch,
+                resubmits=c.resubmits + 1)))
+
+    def _deliver_event(self, st, msg) -> FleetState:
+        _sender, rid, kind, fenced_send = msg
+        c = dict(st.clients)[rid]
+        st = dataclasses.replace(
+            st, events=_bag_remove(st.events, msg))
+        if c.status == CLOSED and self.guards.client_terminal_dedupe:
+            # harvest-boundary tombstone: the duplicate is counted,
+            # never surfaced
+            return dataclasses.replace(
+                st, clients=_tset(st.clients, rid,
+                                  dataclasses.replace(
+                                      c, dup_suppressed=c.dup_suppressed
+                                      + 1)))
+        return dataclasses.replace(
+            st, clients=_tset(st.clients, rid, dataclasses.replace(
+                c, status=CLOSED, terminals=c.terminals + 1,
+                fenced_deliveries=c.fenced_deliveries
+                + (1 if fenced_send else 0))))
+
+    # -- shard side ----------------------------------------------------
+    def _deliver_submit(self, st, msg) -> FleetState:
+        target, rid = msg
+        st = dataclasses.replace(
+            st, submits=_bag_remove(st.submits, msg))
+        shards = dict(st.shards)
+        sh = shards.get(target)
+        if sh is None or sh.status != ACTIVE:
+            return st  # a fenced shard answers nothing
+        reqs = dict(sh.requests)
+        if rid in sh.done:
+            parked = dict(sh.parked)
+            if self.guards.parked_handover and rid in parked:
+                kind = parked.pop(rid)
+                return dataclasses.replace(
+                    st,
+                    events=_bag_add(st.events,
+                                    (target, rid, kind, False)),
+                    shards=_tset(st.shards, target,
+                                 dataclasses.replace(
+                                     sh, parked=tuple(
+                                         sorted(parked.items())))))
+            return st  # stale duplicate
+        if rid in reqs:
+            stage, _att = reqs[rid]
+            reqs[rid] = (stage, True)  # failover re-attach
+            return dataclasses.replace(
+                st, shards=_tset(st.shards, target,
+                                 dataclasses.replace(
+                                     sh, requests=tuple(
+                                         sorted(reqs.items())))))
+        reqs[rid] = (PENDING, True)
+        return dataclasses.replace(
+            st,
+            journal=_tset(st.journal, rid, target),
+            shards=_tset(st.shards, target, dataclasses.replace(
+                sh, requests=tuple(sorted(reqs.items())))))
+
+    def _dispatch(self, st, sname, rid, rep) -> FleetState:
+        sh = dict(st.shards)[sname]
+        reqs = dict(sh.requests)
+        reqs[rid] = (DISPATCHED, reqs[rid][1])
+        return dataclasses.replace(
+            st,
+            dispatches=_bag_add(st.dispatches, (sname, rep, rid)),
+            shards=_tset(st.shards, sname, dataclasses.replace(
+                sh, requests=tuple(sorted(reqs.items())))))
+
+    def _fence(self, st, sname, lose_memory: bool) -> FleetState:
+        """Lease decay (in-memory parked/done survive the fence) or
+        SIGKILL (they do not); both flush the request table
+        terminal-lessly -- the journal is the durable record."""
+        sh = dict(st.shards)[sname]
+        sh = dataclasses.replace(
+            sh, status=FENCED, requests=(),
+            done=frozenset() if lose_memory else sh.done,
+            parked=() if lose_memory else sh.parked)
+        st = dataclasses.replace(
+            st, crashes_left=st.crashes_left - 1,
+            shards=_tset(st.shards, sname, sh))
+        if not lose_memory:
+            return st
+        # SIGKILL: zmq queues its outbound messages in process
+        # memory, so the dead incarnation's unflushed client events
+        # and replica dispatches die with it; replica replies
+        # addressed to its DEALER identity become unroutable. (A
+        # lease decay leaves the process -- and its sockets --
+        # alive, so nothing is reaped.)
+        return dataclasses.replace(
+            st,
+            events=tuple(m for m in st.events if m[0] != sname),
+            dispatches=tuple(m for m in st.dispatches
+                             if m[0] != sname),
+            repl_events=tuple(m for m in st.repl_events
+                              if m[0] != sname))
+
+    def _rejoin(self, st, sname) -> FleetState:
+        """Same-name re-registration at a bumped fencing epoch."""
+        sh = dict(st.shards)[sname]
+        sh = dataclasses.replace(sh, status=ACTIVE,
+                                 epoch=sh.epoch + 1)
+        return dataclasses.replace(
+            st, shards=_tset(st.shards, sname, sh))
+
+    def _sweep(self, st, sname) -> Optional[FleetState]:
+        """Journal adoption: the active ring owner re-adopts
+        journaled rids whose recorded owner cannot deliver them."""
+        shards = dict(st.shards)
+        sh = shards[sname]
+        reqs = dict(sh.requests)
+        journal = dict(st.journal)
+        adopted = False
+        for rid, owner in sorted(journal.items()):
+            if rid in reqs or rid in sh.done:
+                continue
+            owner_sh = shards.get(owner)
+            owner_live = (owner_sh is not None
+                          and owner_sh.status == ACTIVE)
+            if owner != sname and owner_live:
+                continue
+            if self._owner_of(rid, st.shards) != sname:
+                continue
+            reqs[rid] = (PENDING, False)  # ident unknown until
+            journal[rid] = sname          # the client re-attaches
+            adopted = True
+        if not adopted:
+            return None
+        return dataclasses.replace(
+            st,
+            journal=tuple(sorted(journal.items())),
+            shards=_tset(st.shards, sname, dataclasses.replace(
+                sh, requests=tuple(sorted(reqs.items())))))
+
+    def _deliver_repl_event(self, st, msg) -> FleetState:
+        sname, rep, rid = msg
+        st = dataclasses.replace(
+            st, repl_events=_bag_remove(st.repl_events, msg))
+        sh = dict(st.shards)[sname]
+        if sh.status != ACTIVE:
+            if self.guards.fenced_send_guard:
+                return st  # fenced late sends deliver NOTHING
+            # missing fence gate: the stale incarnation delivers
+            return dataclasses.replace(
+                st, events=_bag_add(st.events,
+                                    (sname, rid, "done", True)))
+        reqs = dict(sh.requests)
+        if rid not in reqs:
+            return st  # stale event for a flushed/finished rid
+        _stage, attached = reqs.pop(rid)
+        sh = dataclasses.replace(
+            sh, requests=tuple(sorted(reqs.items())),
+            done=sh.done | {rid})
+        st = dataclasses.replace(
+            st, journal=_tdel(st.journal, rid),
+            shards=_tset(st.shards, sname, sh))
+        if attached:
+            return dataclasses.replace(
+                st, events=_bag_add(st.events,
+                                    (sname, rid, "done", False)))
+        if self.guards.terminal_parking:
+            parked = dict(sh.parked)
+            parked[rid] = "done"
+            return dataclasses.replace(
+                st, shards=_tset(st.shards, sname,
+                                 dataclasses.replace(
+                                     sh, parked=tuple(
+                                         sorted(parked.items())))))
+        return st  # no parking guard: the terminal is dropped
+
+    # -- replica side --------------------------------------------------
+    def _deliver_dispatch(self, st, msg) -> FleetState:
+        sname, rep, rid = msg
+        gen = dict(dict(st.replicas)[rep])
+        gen[rid] = sname  # (re-)attach to the latest dispatcher
+        return dataclasses.replace(
+            st,
+            dispatches=_bag_remove(st.dispatches, msg),
+            replicas=_tset(st.replicas, rep,
+                           tuple(sorted(gen.items()))))
+
+    def _replica_done(self, st, rep, rid, owner) -> FleetState:
+        gen = dict(dict(st.replicas)[rep])
+        gen.pop(rid)
+        return dataclasses.replace(
+            st,
+            repl_events=_bag_add(st.repl_events, (owner, rep, rid)),
+            replicas=_tset(st.replicas, rep,
+                           tuple(sorted(gen.items()))))
+
+    # -- invariants ----------------------------------------------------
+    def safety_violations(self, st: FleetState) -> List[str]:
+        out = []
+        for rid, c in st.clients:
+            if c.terminals > 1:
+                out.append(
+                    f"exactly-once-terminal: client harvested "
+                    f"{c.terminals} terminals for {rid}")
+            if c.fenced_deliveries > 0:
+                out.append(
+                    f"no-fenced-delivery: a fenced shard "
+                    f"incarnation delivered a terminal for {rid}")
+        return out
+
+    def quiescence_violations(self, st: FleetState) -> List[str]:
+        out = []
+        clients = dict(st.clients)
+        finished = set()
+        for _sname, sh in st.shards:
+            finished |= sh.done
+        for rid, c in clients.items():
+            if c.status != CLOSED and rid in finished:
+                out.append(
+                    f"terminal-delivered: quiescent with a produced "
+                    f"terminal for {rid} the open client can never "
+                    "receive (parked-forever / dropped)")
+        for rid, owner in st.journal:
+            if clients[rid].status == CLOSED:
+                out.append(
+                    f"journal-drained: quiescent with a journal "
+                    f"entry for closed rid {rid} (owner {owner})")
+        return out

@@ -1,0 +1,467 @@
+"""wire checker: every serving send site matches the declared protocol.
+
+``serving/protocol.py`` is the normative declaration of the rollout
+wire protocol (kinds, frame schemas, reason strings, state machines).
+This project checker is the enforcement arm -- the obs-catalog
+pattern applied to the protocol. Per call site it checks that
+
+- the event/request kind is spelled as a ``protocol.*`` constant, not
+  a raw string literal (``wire-literal-kind``), and resolves to a
+  declared kind (``wire-undeclared-kind``);
+- a literal payload dict only sets declared frame fields
+  (``wire-undeclared-field``) and a literal ``reason=`` is in the
+  frame's declared reason set (``wire-undeclared-reason``);
+- a positional request tuple has the declared arity
+  (``wire-request-arity``).
+
+Project-wide it cross-checks emitters vs handlers in BOTH directions:
+
+- a declared kind no code site emits (``wire-unemitted-kind``), and
+  its sharper variant: a state-machine transition riding a kind with
+  no emit site (``wire-fsm-no-site``);
+- a dispatchable kind no code site switches on
+  (``wire-unhandled-kind``) -- a ``kind in TERMINAL_KINDS``
+  membership test handles every terminal at once;
+- a rid-scoped event kind no declared state machine rides
+  (``wire-fsm-uncovered-kind``), plus any internal inconsistency of
+  the machines themselves (``wire-fsm-invalid``).
+
+Resolution is conservative: only string constants, ``protocol.X``
+attributes, and names from-imported out of the protocol module are
+resolved; dynamic kinds (``ev.kind`` forwarded verbatim) are out of
+scope -- the checker never guesses.
+
+Known intentional envelope: the scheduler's internal
+``ServeEvent(done, rid, dict(result=...))`` is unpacked by
+``RolloutServer._deliver`` into the declared ``done`` frame before it
+reaches the wire; ``INTERNAL_ENVELOPE_FIELDS`` whitelists it.
+"""
+
+import ast
+import hashlib
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from realhf_tpu.analysis.core import (
+    ProjectChecker,
+    enclosing_symbols,
+    iter_python_files,
+)
+from realhf_tpu.analysis.finding import Finding
+from realhf_tpu.serving import protocol
+
+#: emit helpers: callee name -> (kind arg index, data arg index).
+#: Covers the server/router/shard send paths and the scheduler's
+#: ServeEvent constructor (see docs/serving.md "Wire protocol").
+EMIT_CALLS: Dict[str, Tuple[int, int]] = {
+    "_send": (1, 2),
+    "_reply": (1, 3),
+    "_forward": (1, 2),
+    "_send_ident": (1, 3),
+    "_finish": (1, 2),
+    "ServeEvent": (0, 2),
+}
+
+#: extra payload keys allowed at specific emit sites: internal
+#: envelopes unpacked before they reach the wire.
+INTERNAL_ENVELOPE_FIELDS: Dict[str, Set[str]] = {
+    # scheduler -> server: _deliver() explodes the FinishedRollout
+    # into the declared `done` frame fields.
+    protocol.DONE: {"result"},
+}
+
+#: names whose membership tests handle every terminal kind at once
+_TERMINAL_TUPLE_NAMES = ("TERMINAL_KINDS",)
+
+#: comparison partners that mark a string compare as a kind dispatch
+_KIND_VAR_NAMES = ("kind", "k", "status", "ev_kind")
+
+
+def _resolve_kind(node: ast.AST, imports: Dict[str, str]
+                  ) -> Tuple[Optional[str], bool]:
+    """(kind string, was a raw literal) for one kind expression.
+
+    Resolves string constants, ``protocol.X`` attributes, and names
+    from-imported out of the protocol module; everything else yields
+    ``(None, False)`` -- dynamic, out of scope.
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "protocol":
+        val = getattr(protocol, node.attr, None)
+        if isinstance(val, str):
+            return val, False
+        return None, False
+    if isinstance(node, ast.Name) and node.id in imports:
+        return imports[node.id], False
+    return None, False
+
+
+def _protocol_imports(tree: ast.AST) -> Dict[str, str]:
+    """local name -> kind string, for names from-imported out of the
+    protocol module (or re-exported through serving.server)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or not node.module:
+            continue
+        if not (node.module.endswith("protocol")
+                or node.module.endswith("serving.server")):
+            continue
+        for alias in node.names:
+            val = getattr(protocol, alias.name, None)
+            if isinstance(val, str):
+                out[alias.asname or alias.name] = val
+    return out
+
+
+def _dict_items(node: ast.AST
+                ) -> Optional[List[Tuple[str, ast.AST]]]:
+    """(key, value expr) pairs of a literal dict construct --
+    ``{...}`` with constant keys or a ``dict(...)`` keyword call --
+    else None (dynamic payload, out of scope)."""
+    if isinstance(node, ast.Dict):
+        items = []
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                return None
+            items.append((k.value, v))
+        return items
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "dict" and not node.args:
+        items = []
+        for kw in node.keywords:
+            if kw.arg is None:
+                return None  # **splat
+            items.append((kw.arg, kw.value))
+        return items
+    return None
+
+
+def _callee_name(call: ast.Call) -> str:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+class WireChecker(ProjectChecker):
+    name = "wire"
+    cacheable = True
+
+    def __init__(self, package: str = os.path.join("realhf_tpu",
+                                                   "serving")):
+        self.package = package
+
+    def diff_relevant(self, changed) -> bool:
+        scope = self.package.replace(os.sep, "/") + "/"
+        return any(c.replace(os.sep, "/").startswith(scope)
+                   for c in changed)
+
+    def stamp_extra(self, root: str) -> str:
+        # the declarations live in the imported protocol module, not
+        # the scanned tree -- stamp its source so editing the
+        # protocol invalidates cached runs over unchanged files.
+        try:
+            with open(protocol.__file__, encoding="utf-8") as f:
+                return hashlib.sha1(f.read().encode()).hexdigest()
+        except OSError:
+            return "protocol-missing"
+
+    # ------------------------------------------------------------------
+    def check_project(self, root: str) -> List[Finding]:
+        pkg_abs = os.path.join(root, self.package)
+        if not os.path.isdir(pkg_abs):
+            return []
+        findings: List[Finding] = []
+        emitted: Set[str] = set()
+        handled: Set[str] = set()
+        has_declaration = False
+        for path in iter_python_files([pkg_abs], root):
+            if os.path.basename(path) == "protocol.py":
+                has_declaration = True
+                continue  # the declaration itself, not a use site
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError, ValueError):
+                continue
+            self._check_file(tree, rel, findings, emitted, handled)
+        # exhaustiveness only means something against the real tree;
+        # a fixture package without the declaration file gets the
+        # per-site rules only
+        if has_declaration:
+            findings.extend(self._cross_check(emitted, handled))
+        return findings
+
+    # -- per-file pass -------------------------------------------------
+    def _check_file(self, tree: ast.AST, rel: str,
+                    findings: List[Finding], emitted: Set[str],
+                    handled: Set[str]) -> None:
+        imports = _protocol_imports(tree)
+        symbols = enclosing_symbols(tree)
+        comparator_tuples: Set[int] = set()
+        call_arg_tuples: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare):
+                self._scan_compare(node, rel, imports, symbols,
+                                   findings, handled,
+                                   comparator_tuples)
+            elif isinstance(node, ast.Call):
+                for arg in list(node.args) + [kw.value for kw
+                                              in node.keywords]:
+                    if isinstance(arg, ast.Tuple):
+                        call_arg_tuples.add(id(arg))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._scan_emit_call(node, rel, imports, symbols,
+                                     findings, emitted)
+            elif isinstance(node, ast.Tuple) \
+                    and id(node) not in comparator_tuples:
+                self._scan_emit_tuple(node, rel, imports, symbols,
+                                      findings, emitted,
+                                      call_arg=id(node)
+                                      in call_arg_tuples)
+
+    def _scan_compare(self, node: ast.Compare, rel: str,
+                      imports: Dict[str, str],
+                      symbols: Dict[ast.AST, str],
+                      findings: List[Finding], handled: Set[str],
+                      comparator_tuples: Set[int]) -> None:
+        sides = [node.left] + list(node.comparators)
+        membership = any(isinstance(op, (ast.In, ast.NotIn))
+                         for op in node.ops)
+        for side in sides:
+            if isinstance(side, ast.Tuple):
+                comparator_tuples.add(id(side))
+                for elt in side.elts:
+                    kind, literal = _resolve_kind(elt, imports)
+                    if kind in protocol.ALL_KINDS:
+                        handled.add(kind)
+                        if literal:
+                            self._literal_finding(
+                                elt, kind, rel, symbols.get(node, ""),
+                                findings)
+                continue
+            if membership and isinstance(side, (ast.Name,
+                                                ast.Attribute)):
+                name = side.id if isinstance(side, ast.Name) \
+                    else side.attr
+                if name in _TERMINAL_TUPLE_NAMES:
+                    handled.update(protocol.TERMINAL_KINDS)
+                    continue
+            kind, literal = _resolve_kind(side, imports)
+            if kind not in protocol.ALL_KINDS:
+                continue
+            if literal and not self._kindish_partner(sides, side):
+                continue  # unrelated string compare
+            handled.add(kind)
+            if literal:
+                self._literal_finding(side, kind, rel,
+                                      symbols.get(node, ""), findings)
+
+    @staticmethod
+    def _kindish_partner(sides: List[ast.AST],
+                         literal_side: ast.AST) -> bool:
+        """Some other side of the compare is a kind-carrying variable
+        (``kind``/``k``/``status``/``.kind``) -- guards the literal
+        rule against unrelated string comparisons."""
+        for other in sides:
+            if other is literal_side:
+                continue
+            name = ""
+            if isinstance(other, ast.Name):
+                name = other.id
+            elif isinstance(other, ast.Attribute):
+                name = other.attr
+            if name in _KIND_VAR_NAMES:
+                return True
+        return False
+
+    # -- emit sites ----------------------------------------------------
+    def _scan_emit_call(self, node: ast.Call, rel: str,
+                        imports: Dict[str, str],
+                        symbols: Dict[ast.AST, str],
+                        findings: List[Finding],
+                        emitted: Set[str]) -> None:
+        callee = _callee_name(node)
+        spec = EMIT_CALLS.get(callee)
+        if spec is None:
+            return
+        kind_idx, data_idx = spec
+        if len(node.args) <= kind_idx:
+            return
+        kind, literal = _resolve_kind(node.args[kind_idx], imports)
+        if kind is None:
+            return  # dynamic kind forwarded verbatim
+        symbol = symbols.get(node, "")
+        if literal:
+            self._literal_finding(node, kind, rel, symbol, findings)
+        if kind not in protocol.FRAMES:
+            findings.append(Finding(
+                checker=self.name, code="wire-undeclared-kind",
+                path=rel, line=node.lineno, col=node.col_offset,
+                message=(f"`{callee}` emits kind `{kind}`, which "
+                         "serving/protocol.py does not declare -- "
+                         "add a Frame or fix the kind"),
+                symbol=symbol))
+            return
+        emitted.add(kind)
+        if len(node.args) > data_idx:
+            self._check_payload(node.args[data_idx], kind, rel,
+                                node, symbol, imports, findings)
+
+    def _scan_emit_tuple(self, node: ast.Tuple, rel: str,
+                         imports: Dict[str, str],
+                         symbols: Dict[ast.AST, str],
+                         findings: List[Finding],
+                         emitted: Set[str],
+                         call_arg: bool = True) -> None:
+        """Positional wire tuples: ``(submit, rid, ...)`` request
+        envelopes and ``(kind, [rid,] data)`` event pairs queued for
+        delivery. A raw-literal head only counts when the tuple is
+        a call argument (being sent somewhere) -- otherwise
+        ``__slots__``-style string tuples would false-positive."""
+        if not node.elts:
+            return
+        kind, literal = _resolve_kind(node.elts[0], imports)
+        if kind is None:
+            return
+        if literal and not call_arg:
+            return
+        symbol = symbols.get(node, "")
+        if kind in protocol.REQUESTS:
+            if literal:
+                self._literal_finding(node, kind, rel, symbol,
+                                      findings)
+            emitted.add(kind)
+            req = protocol.REQUESTS[kind]
+            arity = len(node.elts)
+            if not req.min_arity <= arity <= req.max_arity:
+                findings.append(Finding(
+                    checker=self.name, code="wire-request-arity",
+                    path=rel, line=node.lineno, col=node.col_offset,
+                    message=(f"`{kind}` request tuple has arity "
+                             f"{arity}, declared "
+                             f"{req.min_arity}..{req.max_arity} "
+                             f"{req.doc}"),
+                    symbol=symbol))
+            return
+        if kind in protocol.FRAMES:
+            if literal:
+                self._literal_finding(node, kind, rel, symbol,
+                                      findings)
+            emitted.add(kind)
+            for elt in node.elts[1:]:
+                if _dict_items(elt) is not None:
+                    self._check_payload(elt, kind, rel, node,
+                                        symbol, imports, findings)
+
+    def _check_payload(self, data_node: ast.AST, kind: str, rel: str,
+                       site: ast.AST, symbol: str,
+                       imports: Dict[str, str],
+                       findings: List[Finding]) -> None:
+        items = _dict_items(data_node)
+        if items is None:
+            return  # dynamic payload, out of scope
+        fr = protocol.FRAMES[kind]
+        allowed = fr.fields | INTERNAL_ENVELOPE_FIELDS.get(kind,
+                                                           set())
+        for key, value in items:
+            if key not in allowed:
+                findings.append(Finding(
+                    checker=self.name, code="wire-undeclared-field",
+                    path=rel, line=site.lineno, col=site.col_offset,
+                    message=(f"`{kind}` payload sets field "
+                             f"`{key}`, not declared in its Frame "
+                             "-- declare it or drop it"),
+                    symbol=symbol))
+            if key == "reason" and fr.reasons:
+                reason, _ = _resolve_kind(value, imports)
+                if reason is not None \
+                        and reason not in fr.reasons:
+                    findings.append(Finding(
+                        checker=self.name,
+                        code="wire-undeclared-reason",
+                        path=rel, line=site.lineno,
+                        col=site.col_offset,
+                        message=(f"`{kind}` reason `{reason}` is "
+                                 "not in the frame's declared "
+                                 "reason set"),
+                        symbol=symbol))
+
+    def _literal_finding(self, node: ast.AST, kind: str, rel: str,
+                         symbol: str,
+                         findings: List[Finding]) -> None:
+        findings.append(Finding(
+            checker=self.name, code="wire-literal-kind",
+            path=rel,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=(f"wire kind `{kind}` spelled as a raw string "
+                     "-- use the serving/protocol.py constant "
+                     "(one source of truth)"),
+            symbol=symbol))
+
+    # -- project-wide cross-check --------------------------------------
+    def _cross_check(self, emitted: Set[str],
+                     handled: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        proto_rel = "realhf_tpu/serving/protocol.py"
+        fsm_kinds = protocol.declared_fsm_kinds()
+        for m in protocol.MACHINES:
+            for err in m.validate():
+                findings.append(Finding(
+                    checker=self.name, code="wire-fsm-invalid",
+                    path=proto_rel, line=0, col=0,
+                    message=f"state machine inconsistency: {err}",
+                    symbol=m.name))
+        for kind in protocol.ALL_KINDS:
+            if kind not in emitted:
+                machines = sorted(m.name for m in protocol.MACHINES
+                                  if kind in m.kinds())
+                if machines:
+                    findings.append(Finding(
+                        checker=self.name, code="wire-fsm-no-site",
+                        path=proto_rel, line=0, col=0,
+                        message=(f"state machine(s) "
+                                 f"{', '.join(machines)} ride kind "
+                                 f"`{kind}` but no serving/ code "
+                                 "site emits it"),
+                        symbol=kind))
+                else:
+                    findings.append(Finding(
+                        checker=self.name,
+                        code="wire-unemitted-kind",
+                        path=proto_rel, line=0, col=0,
+                        message=(f"declared kind `{kind}` has no "
+                                 "emit site in serving/ -- dead "
+                                 "declaration or renamed kind"),
+                        symbol=kind))
+            fr = protocol.FRAMES.get(kind)
+            dispatchable = fr.dispatch if fr is not None else True
+            if dispatchable and kind not in handled:
+                findings.append(Finding(
+                    checker=self.name, code="wire-unhandled-kind",
+                    path=proto_rel, line=0, col=0,
+                    message=(f"kind `{kind}` is declared "
+                             "dispatchable but no serving/ code "
+                             "site switches on it -- emitted into "
+                             "the void"),
+                    symbol=kind))
+            if fr is not None and fr.rid_scoped \
+                    and kind not in fsm_kinds:
+                findings.append(Finding(
+                    checker=self.name,
+                    code="wire-fsm-uncovered-kind",
+                    path=proto_rel, line=0, col=0,
+                    message=(f"rid-scoped event kind `{kind}` is "
+                             "ridden by no declared state machine "
+                             "-- declare the transition it drives"),
+                    symbol=kind))
+        return findings

@@ -1,0 +1,389 @@
+"""The serving wire protocol, declared as data (graft-verify).
+
+Every message on the rollout wire -- client to server/router and back
+-- is a pickled tuple. Requests are positional: ``(kind, ...)``;
+events are ``(kind, rid, data)`` with a dict payload. This module is
+the single normative declaration of that protocol: the event-kind
+constants, the per-kind frame schemas (allowed payload fields and
+reason strings), and the three state machines the runtime implements
+(per-rid client view, router-request lifecycle, shard lifecycle).
+
+The runtime (``serving/{server,router,router_shard,scheduler}.py``)
+imports its kinds and reasons from here instead of spelling string
+literals; the ``wire`` checker (``analysis/wire.py``) statically
+cross-checks every send site against these declarations in both
+directions, and the bounded model checker (``analysis/model.py`` +
+``analysis/explore.py``) exhaustively explores the declared state
+machines under a fault model. docs/serving.md points here; a change
+to the protocol starts in this file.
+
+Nothing here imports anything heavier than ``dataclasses`` -- the
+static-analysis stack must be able to import it without pulling in
+zmq/jax.
+"""
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+# ----------------------------------------------------------------------
+# Request kinds (client -> server/router; positional tuples)
+# ----------------------------------------------------------------------
+SUBMIT = "submit"
+CANCEL = "cancel"
+PING = "ping"
+
+# ----------------------------------------------------------------------
+# Event kinds (server/router -> client; ``(kind, rid, data)``)
+# ----------------------------------------------------------------------
+ACCEPTED = "accepted"
+STARTED = "started"
+TOKENS = "tokens"
+RETRYING = "retrying"
+WRONG_OWNER = "wrong_owner"
+PONG = "pong"
+DONE = "done"
+REJECTED = "rejected"
+STALE = "stale"
+EXPIRED = "expired"
+CANCELLED = "cancelled"
+DRAINING = "draining"
+
+#: reply kinds that end a request's stream (the server drops its
+#: client route after sending one of these; clients key their
+#: harvest loops on membership here)
+TERMINAL_KINDS = (DONE, REJECTED, STALE, EXPIRED, CANCELLED, DRAINING)
+
+# ----------------------------------------------------------------------
+# Reason strings (the ``reason=`` field of rejected/expired/cancelled
+# and the failover ``why`` carried by ``retrying``)
+# ----------------------------------------------------------------------
+# admission verdicts (serving/request_queue.py)
+REASON_DRAINING = "draining"
+REASON_EXPIRED = "expired"
+REASON_PROMPT_TOO_LONG = "prompt_too_long"
+REASON_WEIGHTS_BEHIND = "weights_behind"
+REASON_BACKPRESSURE = "backpressure"
+# scheduler-side rejections (serving/scheduler.py)
+REASON_FILL_FAILED = "fill_failed"
+REASON_KV_OOM = "kv_oom"
+# router-side verdicts (serving/router.py)
+REASON_NO_HEALTHY_REPLICA = "no_healthy_replica"
+REASON_ROUTER_DRAIN = "router_drain"
+# replica drain force-fence (server.finish_drain) -- doubles as the
+# failover ``why`` when the router re-shops the victim's request
+REASON_DRAIN_DEADLINE = "drain_deadline"
+# sharded-client give-up after too many wrong_owner bounces
+REASON_RING_UNSTABLE = "ring_unstable"
+
+# failover ``why`` strings (router._fail_assignment -> ``retrying``)
+WHY_REREGISTERED = "re-registered"
+WHY_LEASE_EXPIRED = "lease expired"
+WHY_WATCHDOG_LOST = "watchdog LOST"
+WHY_RETIRED = "retired"
+WHY_DISPATCH_TIMEOUT = "dispatch timeout"
+WHY_RESPONSE_TIMEOUT = "response timeout"
+
+#: admission rejections every replica would decide identically --
+#: the router forwards them instead of shopping the request around
+DETERMINISTIC_REJECT_REASONS = (REASON_PROMPT_TOO_LONG, REASON_EXPIRED)
+
+REJECT_REASONS = frozenset({
+    REASON_DRAINING, REASON_EXPIRED, REASON_PROMPT_TOO_LONG,
+    REASON_WEIGHTS_BEHIND, REASON_BACKPRESSURE, REASON_FILL_FAILED,
+    REASON_KV_OOM, REASON_NO_HEALTHY_REPLICA, REASON_RING_UNSTABLE,
+})
+RETRY_REASONS = frozenset({
+    WHY_REREGISTERED, WHY_LEASE_EXPIRED, WHY_WATCHDOG_LOST,
+    WHY_RETIRED, WHY_DISPATCH_TIMEOUT, WHY_RESPONSE_TIMEOUT,
+    REASON_DRAIN_DEADLINE,
+})
+
+
+# ----------------------------------------------------------------------
+# Frame schemas
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One client->server positional frame: ``(kind, *payload)``."""
+    kind: str
+    #: tuple arity bounds, *including* the leading kind
+    min_arity: int
+    max_arity: int
+    doc: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """One server->client event frame: ``(kind, rid, data)``."""
+    kind: str
+    #: every data key any emitter may set (the wire checker flags
+    #: undeclared keys at literal send sites)
+    fields: FrozenSet[str] = frozenset()
+    #: allowed values of ``data["reason"]`` (empty = no reason field)
+    reasons: FrozenSet[str] = frozenset()
+    terminal: bool = False
+    #: some code site must switch on this kind (``kind == X`` or a
+    #: TERMINAL_KINDS membership test). False for kinds streamed to
+    #: the client verbatim with no dispatch site -- intentionally
+    #: undispatched, which the wire checker then does not flag.
+    dispatch: bool = True
+    #: carries a per-request rid (False only for pong, whose rid is
+    #: empty); the FSM cross-check applies to rid-scoped kinds only
+    rid_scoped: bool = True
+    doc: str = ""
+
+
+REQUESTS: Dict[str, Request] = {r.kind: r for r in (
+    Request(SUBMIT, 6, 7,
+            doc="(rid, prompt, priority, ttl, min_weight_version"
+                "[, trace_ctx])"),
+    Request(CANCEL, 2, 2, doc="(rid,)"),
+    Request(PING, 1, 1, doc="()"),
+)}
+
+FRAMES: Dict[str, Frame] = {f.kind: f for f in (
+    Frame(ACCEPTED, fields=frozenset({"reattached", "queue_depth"}),
+          doc="admission ack; reattached=True on a failover/duplicate"
+              " re-attach"),
+    Frame(STARTED, fields=frozenset({"weight_version"}),
+          doc="entered a decode slot"),
+    Frame(TOKENS,
+          fields=frozenset({"tokens", "logprobs", "offset"}),
+          doc="incremental streaming delta"),
+    Frame(RETRYING,
+          fields=frozenset({"retried_from", "reason"}),
+          reasons=RETRY_REASONS, dispatch=False,
+          doc="failover: the token stream restarts on another "
+              "replica; streamed to the client verbatim (no dispatch "
+              "site -- stream consumers reset their accumulation)"),
+    Frame(WRONG_OWNER,
+          fields=frozenset({"owner", "address", "ring"}),
+          doc="shard bounce: resubmit to the named ring owner"),
+    Frame(PONG, rid_scoped=False,
+          doc="health-probe reply (rid is empty)"),
+    Frame(DONE, terminal=True,
+          fields=frozenset({
+              "tokens", "logprobs", "no_eos", "weight_version",
+              "weight_version_final", "queued_secs", "serve_secs",
+              "spec_proposed", "spec_accepted", "retried_from"}),
+          doc="finished; data carries the FinishedRollout fields"),
+    Frame(REJECTED, terminal=True,
+          fields=frozenset({"reason", "retry_after", "error",
+                            "retried_from"}),
+          reasons=REJECT_REASONS,
+          doc="refused at admission, by the backend, or by the "
+              "router when no replica can take it"),
+    Frame(STALE, terminal=True,
+          fields=frozenset({"weight_version", "current_version",
+                            "max_staleness", "retried_from"}),
+          doc="finished/evicted beyond the staleness bound"),
+    Frame(EXPIRED, terminal=True,
+          fields=frozenset({"reason", "retried_from"}),
+          reasons=frozenset({REASON_ROUTER_DRAIN}),
+          doc="deadline passed (reason=router_drain when a draining "
+              "router expires leftovers)"),
+    Frame(CANCELLED, terminal=True,
+          fields=frozenset({"reason", "retried_from"}),
+          reasons=frozenset({REASON_DRAIN_DEADLINE}),
+          doc="client cancel ack, or a drain past its hard deadline "
+              "force-fencing in-flight work (reason=drain_deadline)"),
+    Frame(DRAINING, terminal=True,
+          fields=frozenset({"retried_from"}),
+          doc="queued request bounced back by a draining replica"),
+)}
+
+EVENT_KINDS = tuple(FRAMES)
+REQUEST_KINDS = tuple(REQUESTS)
+ALL_KINDS = REQUEST_KINDS + EVENT_KINDS
+
+assert TERMINAL_KINDS == tuple(k for k in EVENT_KINDS
+                               if FRAMES[k].terminal)
+
+
+def is_terminal(kind: str) -> bool:
+    return kind in TERMINAL_KINDS
+
+
+def frame(kind: str) -> Frame:
+    return FRAMES[kind]
+
+
+def validate_event(kind: str, data: dict) -> List[str]:
+    """Violations of the declared schema for one event frame (empty
+    list = conformant). Runtime-usable (chaos drills, tests) and the
+    ground truth the wire checker enforces statically."""
+    f = FRAMES.get(kind)
+    if f is None:
+        return [f"undeclared event kind {kind!r}"]
+    errs = [f"{kind}: undeclared field {k!r}"
+            for k in sorted(set(data) - f.fields)]
+    reason = data.get("reason")
+    if reason is not None and f.reasons and reason not in f.reasons:
+        errs.append(f"{kind}: undeclared reason {reason!r}")
+    return errs
+
+
+# ----------------------------------------------------------------------
+# State machines
+# ----------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    src: str
+    dst: str
+    #: the wire event kind this transition rides on ("" = internal
+    #: action; ``label`` then names it)
+    kind: str = ""
+    label: str = ""
+    guard: str = ""
+
+    def __post_init__(self):
+        if not self.kind and not self.label:
+            raise ValueError(f"transition {self.src}->{self.dst} "
+                             "needs a kind or a label")
+
+
+@dataclasses.dataclass(frozen=True)
+class StateMachine:
+    name: str
+    initial: str
+    states: Tuple[str, ...]
+    transitions: Tuple[Transition, ...]
+    doc: str = ""
+
+    def validate(self) -> List[str]:
+        """Internal-consistency violations (empty = well-formed)."""
+        errs = []
+        if self.initial not in self.states:
+            errs.append(f"{self.name}: initial state "
+                        f"{self.initial!r} undeclared")
+        for t in self.transitions:
+            for s in (t.src, t.dst):
+                if s not in self.states:
+                    errs.append(f"{self.name}: transition "
+                                f"{t.src}->{t.dst} uses undeclared "
+                                f"state {s!r}")
+            if t.kind and t.kind not in FRAMES \
+                    and t.kind not in REQUESTS:
+                errs.append(f"{self.name}: transition {t.src}->"
+                            f"{t.dst} rides undeclared kind "
+                            f"{t.kind!r}")
+        return errs
+
+    def kinds(self) -> FrozenSet[str]:
+        return frozenset(t.kind for t in self.transitions if t.kind)
+
+    def successors(self, state: str) -> List[Transition]:
+        return [t for t in self.transitions if t.src == state]
+
+
+def _terminal_closes(states) -> Tuple[Transition, ...]:
+    """Every live state reaches ``closed`` on every terminal kind --
+    terminals may arrive at any point in the stream (drain bounces,
+    router expiry, failover rejections)."""
+    return tuple(Transition(s, "closed", kind=k)
+                 for s in states for k in TERMINAL_KINDS)
+
+
+#: what one client observes for one rid, submit to terminal
+CLIENT_REQUEST = StateMachine(
+    name="client-request",
+    initial="submitted",
+    states=("submitted", "accepted", "streaming", "closed"),
+    transitions=(
+        Transition("submitted", "accepted", kind=ACCEPTED),
+        Transition("submitted", "submitted", kind=WRONG_OWNER,
+                   guard="resubmit to the named ring owner "
+                         "(bounded by max_bounces)"),
+        Transition("submitted", "submitted", label="resubmit",
+                   guard="target shard left the ring OR its fencing "
+                         "epoch bumped (PR 16)"),
+        Transition("accepted", "accepted", kind=ACCEPTED,
+                   guard="hedge twin / failover re-attach duplicate"),
+        Transition("accepted", "streaming", kind=STARTED),
+        Transition("streaming", "streaming", kind=TOKENS),
+        Transition("streaming", "accepted", kind=RETRYING,
+                   guard="failover: reset token accumulation; a new "
+                         "started re-opens the stream"),
+    ) + _terminal_closes(("submitted", "accepted", "streaming")),
+    doc="Consumed by RolloutClient / ShardedRolloutClient; exactly "
+        "one transition into `closed` per rid (exactly-once "
+        "terminal).")
+
+#: one _RouterRequest inside a FleetRouter / ShardedRouter shard
+ROUTER_REQUEST = StateMachine(
+    name="router-request",
+    initial="pending",
+    states=("pending", "dispatched", "accepted", "streaming",
+            "finished"),
+    transitions=(
+        Transition("pending", "dispatched", label="dispatch",
+                   guard="a healthy replica exists (least-loaded, "
+                         "prefix-affinity preferred)"),
+        Transition("dispatched", "accepted", kind=ACCEPTED),
+        Transition("dispatched", "pending", label="fail_assignment",
+                   guard="dispatch timeout / replica lost or "
+                         "re-registered / retired"),
+        Transition("accepted", "streaming", kind=STARTED),
+        Transition("accepted", "pending", kind=REJECTED,
+                   guard="transient reason (backpressure, draining, "
+                         "weights_behind): shop to another replica"),
+        Transition("accepted", "pending", kind=DRAINING,
+                   guard="replica drain bounce: shop to a survivor"),
+        Transition("streaming", "streaming", kind=TOKENS),
+        Transition("streaming", "pending", label="fail_assignment",
+                   guard="owner lost mid-stream; emits `retrying` to "
+                         "the client"),
+        Transition("pending", "finished", kind=REJECTED,
+                   guard="no_healthy_replica past pending_timeout, "
+                         "or deterministic reject forwarded"),
+    ) + tuple(Transition(s, "finished", kind=k)
+              for s in ("pending", "dispatched", "accepted",
+                        "streaming")
+              for k in TERMINAL_KINDS)
+    + (Transition("finished", "finished", label="dedupe",
+                  guard="late twin terminals count as duplicates "
+                        "against _done, never delivered"),),
+    doc="_finish is the ONLY path into `finished` and runs at most "
+        "once per rid (at-most-once delivery); every other retire "
+        "path is a fence flush carrying its lint disable.")
+
+#: one ShardedRouter incarnation, register to retire/supersede
+SHARD_LIFECYCLE = StateMachine(
+    name="shard-lifecycle",
+    initial="active",
+    states=("active", "fenced", "superseded", "retired"),
+    transitions=(
+        Transition("active", "fenced", label="lease_lost",
+                   guard="renew_router raised LeaseLostError, or a "
+                         "chaos partition let the lease decay"),
+        Transition("fenced", "active", label="re_register",
+                   guard="new fencing epoch; journal sweep re-adopts "
+                         "rids no survivor claimed"),
+        Transition("active", "superseded", label="superseded",
+                   guard="a HIGHER epoch registered under our own "
+                         "name: we are the zombie, quiet forever"),
+        Transition("fenced", "superseded", label="superseded"),
+        Transition("active", "retired", label="drain",
+                   guard="planned departure: leftovers expire with "
+                         "reason=router_drain, lease released"),
+    ),
+    doc="A fenced shard sends NOTHING (fence flush is terminal-less "
+        "by design); only `active` dispatches or delivers.")
+
+MACHINES: Tuple[StateMachine, ...] = (CLIENT_REQUEST, ROUTER_REQUEST,
+                                      SHARD_LIFECYCLE)
+
+
+def machine(name: str) -> Optional[StateMachine]:
+    for m in MACHINES:
+        if m.name == name:
+            return m
+    return None
+
+
+def declared_fsm_kinds() -> FrozenSet[str]:
+    """Every wire kind some declared state machine rides on."""
+    out: FrozenSet[str] = frozenset()
+    for m in MACHINES:
+        out |= m.kinds()
+    return out

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from realhf_tpu.base import logging
+from realhf_tpu.serving import protocol
 
 logger = logging.getLogger("serving.request_queue")
 
@@ -100,24 +101,27 @@ class RequestQueue:
         with self._lock:
             if self._draining:
                 self.stats["rejected"] += 1
-                return AdmissionVerdict(False, reason="draining")
+                return AdmissionVerdict(
+                    False, reason=protocol.REASON_DRAINING)
             if req.deadline is not None and req.deadline <= now:
                 self.stats["rejected"] += 1
-                return AdmissionVerdict(False, reason="expired")
+                return AdmissionVerdict(
+                    False, reason=protocol.REASON_EXPIRED)
             if (self.max_prompt_len is not None
                     and len(req.prompt) > self.max_prompt_len):
                 self.stats["rejected"] += 1
-                return AdmissionVerdict(False, reason="prompt_too_long")
+                return AdmissionVerdict(
+                    False, reason=protocol.REASON_PROMPT_TOO_LONG)
             if req.min_weight_version > current_weight_version:
                 self.stats["rejected"] += 1
                 return AdmissionVerdict(
-                    False, reason="weights_behind",
+                    False, reason=protocol.REASON_WEIGHTS_BEHIND,
                     retry_after=self._service_ema)
             depth = sum(len(q) for q in self._by_class.values())
             if depth >= self.max_depth:
                 self.stats["rejected"] += 1
                 return AdmissionVerdict(
-                    False, reason="backpressure",
+                    False, reason=protocol.REASON_BACKPRESSURE,
                     retry_after=self._retry_after(depth))
             self._by_class[Priority(req.priority)].append(req)
             self.stats["submitted"] += 1

@@ -49,8 +49,10 @@ import zmq
 from realhf_tpu.base import fault_injection, logging, name_resolve, \
     network, retry
 from realhf_tpu.obs import metrics
+from realhf_tpu.serving import protocol
 from realhf_tpu.serving.fleet import FleetRegistry, ReplicaInfo
-from realhf_tpu.serving.server import TERMINAL_KINDS, rollout_server_key
+from realhf_tpu.serving.protocol import TERMINAL_KINDS
+from realhf_tpu.serving.server import rollout_server_key
 
 logger = logging.getLogger("serving.router", "system")
 
@@ -308,7 +310,7 @@ class FleetRouter:
                 self.stats_counters["fenced_reconnects"] += 1
                 metrics.inc("router_fenced_reconnects_total",
                             replica=name)
-                self._failover_replica(rep, why="re-registered")
+                self._failover_replica(rep, why=protocol.WHY_REREGISTERED)
                 rep.sock.close(0)
                 rep.sock = self._connect(info)
                 rep.address, rep.epoch = info.address, info.epoch
@@ -325,7 +327,7 @@ class FleetRouter:
                     # breaker trip, no failover accounting
                     self._retire_replica(rep)
                 else:
-                    self._mark_lost(rep, why="lease expired")
+                    self._mark_lost(rep, why=protocol.WHY_LEASE_EXPIRED)
         n_healthy = sum(1 for r in self._replicas.values()
                         if not r.lost and not r.retiring
                         and r.breaker.allow())
@@ -341,7 +343,7 @@ class FleetRouter:
         fallback) recovers anything it leaves behind."""
         rep = self._replicas.get(name)
         if rep is not None and not rep.lost and not rep.retiring:
-            self._mark_lost(rep, why="watchdog LOST")
+            self._mark_lost(rep, why=protocol.WHY_WATCHDOG_LOST)
 
     def _retire_replica(self, rep: _Replica):
         """Planned departure (docs/serving.md "Autoscaling"): the
@@ -361,7 +363,8 @@ class FleetRouter:
             req = self._requests.get(rid)
             if req is None:
                 continue
-            self._fail_assignment(req, rep.name, why="retired",
+            self._fail_assignment(req, rep.name,
+                                  why=protocol.WHY_RETIRED,
                                   counter="retire_redispatches")
         rep.inflight.clear()
         rep.sock.close(0)
@@ -426,7 +429,7 @@ class FleetRouter:
 
     def _handle_client(self, ident: bytes, msg: tuple):
         kind = msg[0]
-        if kind == "submit":
+        if kind == protocol.SUBMIT:
             _, rid, prompt, priority, ttl, min_wv = msg[:6]
             trace = msg[6] if len(msg) > 6 else None
             now = self._clock()
@@ -437,15 +440,17 @@ class FleetRouter:
                 return
             if self._draining:
                 self.stats_counters["rejections"] += 1
-                self._reply(ident, "rejected", rid,
-                            dict(reason="draining", retry_after=None))
+                self._reply(ident, protocol.REJECTED, rid,
+                            dict(reason=protocol.REASON_DRAINING,
+                                 retry_after=None))
                 return
             if len(self._requests) >= self.max_pending:
                 self.stats_counters["rejections"] += 1
                 metrics.inc("router_rejections_total",
                             reason="backpressure")
-                self._reply(ident, "rejected", rid,
-                            dict(reason="backpressure", retry_after=1.0))
+                self._reply(ident, protocol.REJECTED, rid,
+                            dict(reason=protocol.REASON_BACKPRESSURE,
+                                 retry_after=1.0))
                 return
             req = _RouterRequest(
                 rid=rid, ident=ident,
@@ -459,19 +464,19 @@ class FleetRouter:
             self._pending.append(rid)
             self.stats_counters["requests"] += 1
             metrics.inc("router_requests_total")
-        elif kind == "cancel":
+        elif kind == protocol.CANCEL:
             rid = msg[1]
             req = self._requests.get(rid)
             if req is None:
                 return
             req.client_cancelled = True
             if not req.assigned:
-                self._finish(req, "cancelled", {}, from_replica=None)
+                self._finish(req, protocol.CANCELLED, {}, from_replica=None)
             else:
                 for rname in list(req.assigned):
-                    self._send_replica(rname, ("cancel", rid))
-        elif kind == "ping":
-            self._reply(ident, "pong", "", {})
+                    self._send_replica(rname, (protocol.CANCEL, rid))
+        elif kind == protocol.PING:
+            self._reply(ident, protocol.PONG, "", {})
         else:
             logger.warning("Router: unknown client message kind %r.",
                            kind)
@@ -501,7 +506,7 @@ class FleetRouter:
         # any traffic proves the replica's serve loop is alive
         rep.breaker.record_success()
         rep.probe_sent_at = None
-        if kind == "pong":
+        if kind == protocol.PONG:
             return
         req = self._requests.get(rid)
         if req is None:
@@ -517,13 +522,13 @@ class FleetRouter:
                             replica=rep.name)
             return
         req.last_event_at = self._clock()
-        if kind == "accepted":
+        if kind == protocol.ACCEPTED:
             req.accepted.add(rep.name)
             if not req.accepted_fwd:
                 req.accepted_fwd = True
                 self._forward(req, kind, data)
             return
-        if kind == "started":
+        if kind == protocol.STARTED:
             if req.owner is None:
                 req.owner = rep.name
                 if not req.started_fwd:
@@ -532,9 +537,9 @@ class FleetRouter:
             elif req.owner != rep.name:
                 # hedge race: someone else leads; cancel this copy
                 req.losers.add(rep.name)
-                self._send_replica(rep.name, ("cancel", rid))
+                self._send_replica(rep.name, (protocol.CANCEL, rid))
             return
-        if kind == "tokens":
+        if kind == protocol.TOKENS:
             if req.owner is None:
                 req.owner = rep.name
             if req.owner == rep.name:
@@ -543,11 +548,12 @@ class FleetRouter:
         if kind in TERMINAL_KINDS:
             rep.inflight.discard(rid)
             req.assigned.pop(rep.name, None)
-            if kind == "cancelled" and rep.name in req.losers \
+            if kind == protocol.CANCELLED and rep.name in req.losers \
                     and not req.client_cancelled:
                 return  # a hedge loser acking our cancel: bookkeeping
-            if kind == "cancelled" \
-                    and data.get("reason") == "drain_deadline" \
+            if kind == protocol.CANCELLED \
+                    and data.get("reason") \
+                    == protocol.REASON_DRAIN_DEADLINE \
                     and not req.client_cancelled:
                 if req.owner not in (None, rep.name):
                     # a live hedge twin owns the client's stream; the
@@ -564,11 +570,12 @@ class FleetRouter:
                 # otherwise the survivor's `started` would be
                 # mistaken for a hedge race and cancelled, orphaning
                 # the rid until its client-side TTL
-                self._fail_assignment(req, rep.name,
-                                      why="drain_deadline",
-                                      counter="retire_redispatches")
+                self._fail_assignment(
+                    req, rep.name,
+                    why=protocol.REASON_DRAIN_DEADLINE,
+                    counter="retire_redispatches")
                 return
-            if kind in ("rejected", "draining") \
+            if kind in (protocol.REJECTED, protocol.DRAINING) \
                     and not req.client_cancelled:
                 self._on_replica_reject(rep, req, kind, data)
                 return
@@ -581,10 +588,12 @@ class FleetRouter:
     def _on_replica_reject(self, rep: _Replica, req: _RouterRequest,
                            kind: str, data: dict):
         reason = data.get("reason", kind)
-        if reason in ("prompt_too_long", "expired"):
+        if reason in protocol.DETERMINISTIC_REJECT_REASONS:
             # deterministic verdicts every replica would agree on:
             # forward, do not shop around
-            self._finish(req, "rejected" if kind == "rejected" else kind,
+            self._finish(req,
+                         protocol.REJECTED if kind == protocol.REJECTED
+                         else kind,
                          data, from_replica=rep.name)
             return
         # transient (backpressure / draining / weights_behind): try
@@ -632,8 +641,8 @@ class FleetRouter:
         now = self._clock()
         ttl = None if req.deadline is None \
             else max(0.05, req.deadline - now)
-        env = ("submit", req.rid, req.prompt, req.priority, ttl,
-               req.min_weight_version, req.trace)
+        env = (protocol.SUBMIT, req.rid, req.prompt, req.priority,
+               ttl, req.min_weight_version, req.trace)
         if not self._send_replica(rep.name, env):
             return False
         req.assigned[rep.name] = now
@@ -664,8 +673,9 @@ class FleetRouter:
             if now - req.created_at > self.pending_timeout:
                 metrics.inc("router_rejections_total",
                             reason="no_healthy_replica")
-                self._finish(req, "rejected",
-                             dict(reason="no_healthy_replica",
+                self._finish(req, protocol.REJECTED,
+                             dict(reason=
+                                  protocol.REASON_NO_HEALTHY_REPLICA,
                                   retry_after=self.breaker_cooldown),
                              from_replica=None)
                 continue
@@ -708,7 +718,7 @@ class FleetRouter:
             # the replacement replica re-generates from the prompt,
             # and its own `started` is forwarded again
             req.started_fwd = False
-            self._forward(req, "retrying",
+            self._forward(req, protocol.RETRYING,
                           dict(retried_from=list(req.retried_from),
                                reason=why))
         if not self._dispatch(req) and not req.assigned \
@@ -720,9 +730,9 @@ class FleetRouter:
         for req in list(self._requests.values()):
             if req.deadline is not None and now >= req.deadline:
                 for rname in list(req.assigned):
-                    self._send_replica(rname, ("cancel", req.rid))
+                    self._send_replica(rname, (protocol.CANCEL, req.rid))
                 metrics.inc("router_expired_total")
-                self._finish(req, "expired", {}, from_replica=None)
+                self._finish(req, protocol.EXPIRED, {}, from_replica=None)
                 continue
             for rname, at in list(req.assigned.items()):
                 if rname not in req.accepted \
@@ -731,8 +741,9 @@ class FleetRouter:
                     if rep is not None:
                         rep.breaker.record_failure()
                         rep.inflight.discard(req.rid)
-                    self._fail_assignment(req, rname,
-                                          why="dispatch timeout")
+                    self._fail_assignment(
+                        req, rname,
+                        why=protocol.WHY_DISPATCH_TIMEOUT)
             if (self.response_timeout is not None and req.assigned
                     and now - req.last_event_at > self.response_timeout):
                 # accepted but gone quiet (e.g. a dropped terminal
@@ -742,9 +753,10 @@ class FleetRouter:
                     if rep is not None:
                         rep.breaker.record_failure()
                         rep.inflight.discard(req.rid)
-                    self._send_replica(rname, ("cancel", req.rid))
-                    self._fail_assignment(req, rname,
-                                          why="response timeout")
+                    self._send_replica(rname, (protocol.CANCEL, req.rid))
+                    self._fail_assignment(
+                        req, rname,
+                        why=protocol.WHY_RESPONSE_TIMEOUT)
 
     def _maybe_hedge(self, now: float):
         if self.hedge_delay is None:
@@ -772,7 +784,7 @@ class FleetRouter:
             if br.ready_to_probe():
                 br.half_open()
                 rep.probe_sent_at = now
-                self._send_replica(rep.name, ("ping",))
+                self._send_replica(rep.name, (protocol.PING,))
             elif (br.state is BreakerState.HALF_OPEN
                   and rep.probe_sent_at is not None
                   and now - rep.probe_sent_at > self.probe_timeout):
@@ -812,9 +824,9 @@ class FleetRouter:
                 and from_replica != req.primary:
             self.stats_counters["hedge_wins"] += 1
             metrics.inc("router_hedge_wins_total")
-        if kind == "rejected":
+        if kind == protocol.REJECTED:
             self.stats_counters["rejections"] += 1
-        elif kind == "done":
+        elif kind == protocol.DONE:
             # end-to-end latency EWMA: the autoscale policy's
             # latency signal (docs/serving.md "Autoscaling")
             lat = max(0.0, self._clock() - req.created_at)
@@ -834,7 +846,7 @@ class FleetRouter:
             self._done.pop(next(iter(self._done)))
         for rname in list(req.assigned):
             if rname != from_replica:
-                self._send_replica(rname, ("cancel", req.rid))
+                self._send_replica(rname, (protocol.CANCEL, req.rid))
             rep = self._replicas.get(rname)
             if rep is not None:
                 rep.inflight.discard(req.rid)
@@ -858,14 +870,14 @@ class FleetRouter:
             sock = self._ctx.socket(zmq.DEALER)
             try:
                 sock.connect(info.address)
-                sock.send(pickle.dumps(("ping",)))
+                sock.send(pickle.dumps((protocol.PING,)))
                 while not att.cancelled.is_set():
                     if att.deadline is not None \
                             and time.monotonic() >= att.deadline:
                         raise TimeoutError(f"probe {name}: deadline")
                     if sock.poll(25):
                         kind = pickle.loads(sock.recv())[0]
-                        if kind == "pong":
+                        if kind == protocol.PONG:
                             return True
                 raise TimeoutError(f"probe {name}: cancelled")
             finally:
@@ -892,8 +904,9 @@ class FleetRouter:
             self.route_step(poll_timeout=0.01)
         for req in list(self._requests.values()):
             for rname in list(req.assigned):
-                self._send_replica(rname, ("cancel", req.rid))
-            self._finish(req, "expired", dict(reason="router_drain"),
+                self._send_replica(rname, (protocol.CANCEL, req.rid))
+            self._finish(req, protocol.EXPIRED,
+                         dict(reason=protocol.REASON_ROUTER_DRAIN),
                          from_replica=None)
 
     def close(self):

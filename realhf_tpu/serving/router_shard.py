@@ -53,11 +53,13 @@ import zmq
 
 from realhf_tpu.base import logging
 from realhf_tpu.obs import metrics, tracing
+from realhf_tpu.serving import protocol
 from realhf_tpu.serving.fleet import FleetRegistry, LeaseLostError
 from realhf_tpu.serving.request_queue import Priority
 from realhf_tpu.serving.ring import Ring
+from realhf_tpu.serving.protocol import TERMINAL_KINDS
 from realhf_tpu.serving.router import FleetRouter, _RouterRequest
-from realhf_tpu.serving.server import TERMINAL_KINDS, RolloutResult
+from realhf_tpu.serving.server import RolloutResult
 
 logger = logging.getLogger("serving.router_shard", "system")
 
@@ -142,7 +144,7 @@ class ShardedRouter(FleetRouter):
                 self._router_lease_renewed = now
                 return
             except LeaseLostError:
-                self._fence("lease expired")
+                self._fence(protocol.WHY_LEASE_EXPIRED)
         # fenced: drop pre-fence state, then rejoin at a new epoch.
         # The post-rejoin journal sweep re-adopts any of OUR journaled
         # rids a survivor has not claimed yet, so the flush loses no
@@ -323,7 +325,7 @@ class ShardedRouter(FleetRouter):
         if self._fenced:
             return  # a fenced shard answers nothing (clients re-resolve)
         kind = msg[0]
-        if kind == "submit":
+        if kind == protocol.SUBMIT:
             rid = msg[1]
             if rid in self._done:
                 parked = self._parked.pop(rid, None)
@@ -344,7 +346,7 @@ class ShardedRouter(FleetRouter):
                     self.stats_counters["reattached"] += 1
                     metrics.inc("router_shard_reattached_total",
                                 router=self.router_name)
-                    self._reply(ident, "accepted", rid,
+                    self._reply(ident, protocol.ACCEPTED, rid,
                                 dict(reattached=True))
                 return
             owner = self._ring.owner_of(rid)
@@ -353,7 +355,7 @@ class ShardedRouter(FleetRouter):
                 self.stats_counters["wrong_owner"] += 1
                 metrics.inc("router_shard_wrong_owner_total",
                             router=self.router_name)
-                self._reply(ident, "wrong_owner", rid, dict(
+                self._reply(ident, protocol.WRONG_OWNER, rid, dict(
                     owner=owner,
                     address=getattr(info, "address", None),
                     ring=list(self._ring.names)))
@@ -441,9 +443,14 @@ class ShardedRolloutClient:
     terminal), and a replica already generating it re-attaches its
     route rather than double-queueing.
 
-    Single-threaded like :class:`RolloutClient`; terminals are
-    surfaced exactly as received (NO client-side dedupe -- the
-    protocol owes exactly-once, and the chaos drill checks it here).
+    Single-threaded like :class:`RolloutClient`. The wire is
+    at-least-once under fence/crash faults (a resubmission can race a
+    terminal already in flight, and a restarted shard has no memory
+    of pre-crash deliveries -- the bounded model checker in
+    ``analysis/model.py`` derives both races), so exactly-once is
+    enforced HERE, at the harvest boundary: the first terminal per
+    rid wins, later ones are suppressed and counted in
+    ``stats["dup_terminals"]`` where the chaos drill can see them.
     """
 
     def __init__(self, registry: FleetRegistry, *,
@@ -462,8 +469,13 @@ class ShardedRolloutClient:
         self._last_ring_poll = -1e9
         self._inflight: Dict[str, _ClientRequest] = {}
         self._events: Dict[str, List[tuple]] = {}
+        #: rids whose terminal was already surfaced: late duplicates
+        #: (failover regeneration) are dropped, not re-delivered
+        self._closed: "collections.OrderedDict[str, bool]" = \
+            collections.OrderedDict()
+        self._closed_cap = 4096
         self.stats = dict(submits=0, bounces=0, resubmits=0,
-                          give_ups=0)
+                          give_ups=0, dup_terminals=0)
 
     # -- discovery -----------------------------------------------------
     def _refresh_ring(self, force: bool = False):
@@ -522,8 +534,10 @@ class ShardedRolloutClient:
         if target is None or target not in self._socks:
             target = self._ring.owner_of(rid)
         if target is None or not self._send_to(
-                target, ("submit", rid, creq.prompt, creq.priority,
-                         creq.ttl, creq.min_wv, tracing.inject())):
+                target, (protocol.SUBMIT, rid, creq.prompt,
+                         creq.priority,
+                         creq.ttl, creq.min_wv,
+                         tracing.inject())):
             return False
         creq.target = target
         creq.target_epoch = self._epochs.get(target)
@@ -555,11 +569,11 @@ class ShardedRolloutClient:
         creq = self._inflight.get(rid)
         target = creq.target if creq is not None else None
         self._send_to(target or next(iter(self._socks), ""),
-                      ("cancel", rid))
+                      (protocol.CANCEL, rid))
 
     # -- event pump ----------------------------------------------------
     def _on_msg(self, kind: str, rid: str, data: dict):
-        if kind == "wrong_owner":
+        if kind == protocol.WRONG_OWNER:
             self.stats["bounces"] += 1
             creq = self._inflight.get(rid)
             if creq is None:
@@ -571,14 +585,25 @@ class ShardedRolloutClient:
                 self.stats["give_ups"] += 1
                 self._inflight.pop(rid, None)
                 self._events.setdefault(rid, []).append(
-                    ("rejected", dict(reason="ring_unstable")))
+                    (protocol.REJECTED,
+                     dict(reason=protocol.REASON_RING_UNSTABLE)))
                 return
             self._refresh_ring(force=True)
             self._submit_to(data.get("owner"), rid, creq)
             return
+        if rid in self._closed:
+            # exactly-once at the harvest boundary: this rid already
+            # surfaced its terminal; a failover resubmission raced it
+            # and the fleet regenerated
+            if kind in TERMINAL_KINDS:
+                self.stats["dup_terminals"] += 1
+            return
         self._events.setdefault(rid, []).append((kind, data))
         if kind in TERMINAL_KINDS:
             self._inflight.pop(rid, None)
+            self._closed[rid] = True
+            while len(self._closed) > self._closed_cap:
+                self._closed.popitem(last=False)
 
     def _check_failover(self):
         """Resubmit in-flight rids whose target shard left the ring

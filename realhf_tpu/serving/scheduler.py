@@ -39,6 +39,7 @@ import numpy as np
 from realhf_tpu.base import logging
 from realhf_tpu.obs import metrics as obs_metrics
 from realhf_tpu.obs import tracing
+from realhf_tpu.serving import protocol
 from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
 from realhf_tpu.serving.weight_sync import WeightSync
 
@@ -244,11 +245,11 @@ class ContinuousScheduler:
                     and seq.req.deadline <= now):
                 self._evict(int_id)
                 self._count("expired")
-                events.append(ServeEvent("expired", seq.req.rid))
+                events.append(ServeEvent(protocol.EXPIRED, seq.req.rid))
             elif self._is_stale(seq, version):
                 self._evict(int_id)
                 self._count("stale")
-                events.append(ServeEvent("stale", seq.req.rid,
+                events.append(ServeEvent(protocol.STALE, seq.req.rid,
                                          self._stale_info(seq, version)))
 
         # 3. admission: prefill queued requests into free slots.
@@ -266,7 +267,7 @@ class ContinuousScheduler:
                 if req.deadline is not None and req.deadline <= now:
                     # expired while parked (queue.pop filters its own)
                     self._count("expired")
-                    events.append(ServeEvent("expired", req.rid))
+                    events.append(ServeEvent(protocol.EXPIRED, req.rid))
                     continue
                 if not self._pool_admissible(req):
                     self._parked = req
@@ -285,15 +286,15 @@ class ContinuousScheduler:
                     self.backend.release_slot(slot)
                     self._count("fill_failed")
                     events.append(ServeEvent(
-                        "rejected", req.rid,
-                        dict(reason="fill_failed", error=str(e),
-                             retry_after=None)))
+                        protocol.REJECTED, req.rid,
+                        dict(reason=protocol.REASON_FILL_FAILED,
+                             error=str(e), retry_after=None)))
                     continue
                 self._active[int_id] = _ActiveSeq(
                     int_id, slot, req, version_start=version)
                 self._by_slot[slot] = int_id
                 self._count("prefills")
-                events.append(ServeEvent("started", req.rid,
+                events.append(ServeEvent(protocol.STARTED, req.rid,
                                          dict(weight_version=version)))
 
         # 4. one decode chunk over every live slot
@@ -336,7 +337,7 @@ class ContinuousScheduler:
             self._publish_kv(seq, fs, version)
             if self._is_stale(seq, version):
                 self._count("stale")
-                events.append(ServeEvent("stale", seq.req.rid,
+                events.append(ServeEvent(protocol.STALE, seq.req.rid,
                                          self._stale_info(seq, version)))
                 continue
             self._count("finished")
@@ -350,7 +351,7 @@ class ContinuousScheduler:
                                 - seq.req.submitted_at),
                 serve_secs=max(0.0, now - (seq.req.started_at or now)))
             self.queue.note_service_time(now - seq.req.submitted_at)
-            events.append(ServeEvent("done", seq.req.rid,
+            events.append(ServeEvent(protocol.DONE, seq.req.rid,
                                      dict(result=out)))
         if self.stream_tokens:
             # one bundled device fetch for every live slot -- a
@@ -360,7 +361,7 @@ class ContinuousScheduler:
                 tokens, logprobs = snaps[seq.slot]
                 if len(tokens) > seq.streamed:
                     events.append(ServeEvent(
-                        "tokens", seq.req.rid,
+                        protocol.TOKENS, seq.req.rid,
                         dict(tokens=tokens[seq.streamed:],
                              logprobs=logprobs[seq.streamed:],
                              offset=seq.streamed)))
@@ -426,8 +427,9 @@ class ContinuousScheduler:
                     "cache is dry; evicted youngest sequence %s.",
                     seq.req.rid)
                 events.append(ServeEvent(
-                    "rejected", seq.req.rid,
-                    dict(reason="kv_oom", retry_after=None)))
+                    protocol.REJECTED, seq.req.rid,
+                    dict(reason=protocol.REASON_KV_OOM,
+                         retry_after=None)))
 
     def _update_pool_gauges(self):
         """Surface the pool through the PR 13 telemetry plane:

@@ -41,6 +41,11 @@ import zmq
 from realhf_tpu.base import fault_injection, logging, name_resolve, \
     names, network
 from realhf_tpu.obs import metrics, tracing
+from realhf_tpu.serving import protocol
+from realhf_tpu.serving.protocol import TERMINAL_KINDS  # noqa: F401
+# ^ re-exported for compatibility: the kinds, frame schemas, and
+# state machines are declared in serving/protocol.py (normative;
+# enforced by the `wire` checker in analysis/wire.py)
 from realhf_tpu.serving.request_queue import (
     AdmissionVerdict,
     GenRequest,
@@ -51,11 +56,6 @@ from realhf_tpu.serving.scheduler import ContinuousScheduler, ServeEvent
 from realhf_tpu.serving.weight_sync import WeightSync
 
 logger = logging.getLogger("serving.server", "system")
-
-#: reply kinds that end a request's stream (the server drops its
-#: client route after sending one of these)
-TERMINAL_KINDS = ("done", "rejected", "stale", "expired", "cancelled",
-                  "draining")
 
 
 def rollout_server_key(experiment_name: str, trial_name: str,
@@ -189,7 +189,7 @@ class RolloutServer:
             # nothing, so no scheduler step would ever run the poll
             self.scheduler.poll_weights()
         for req in self.queue.take_expired():
-            self._send(req.rid, "expired", {})
+            self._send(req.rid, protocol.EXPIRED, {})
         return handled
 
     def serve_forever(self, stop_event, poll_timeout: float = 0.02,
@@ -304,7 +304,7 @@ class RolloutServer:
 
     def _handle(self, ident: bytes, msg: tuple):
         kind = msg[0]
-        if kind == "submit":
+        if kind == protocol.SUBMIT:
             # 7th element (optional, newer clients): trace-context
             # carrier injected by RolloutClient.submit -- the serving
             # request span parents there, so the client's timeline and
@@ -313,8 +313,9 @@ class RolloutServer:
             trace_ctx = msg[6] if len(msg) > 6 else None
             now = self._clock()
             if self._draining:
-                self._reply(ident, "rejected", rid,
-                            dict(reason="draining", retry_after=None))
+                self._reply(ident, protocol.REJECTED, rid,
+                            dict(reason=protocol.REASON_DRAINING,
+                                 retry_after=None))
                 return
             with self._routes_lock:
                 known = rid in self._routes
@@ -331,7 +332,7 @@ class RolloutServer:
             if known:
                 metrics.inc("serving_reattached_total",
                             server=self.server_name)
-                self._reply(ident, "accepted", rid,
+                self._reply(ident, protocol.ACCEPTED, rid,
                             dict(reattached=True,
                                  queue_depth=len(self.queue)))
                 return
@@ -352,20 +353,20 @@ class RolloutServer:
                         rid=rid, server=self.server_name,
                         priority=int(priority),
                         prompt_len=len(req.prompt))
-                self._reply(ident, "accepted", rid,
+                self._reply(ident, protocol.ACCEPTED, rid,
                             dict(queue_depth=len(self.queue)))
             else:
                 metrics.inc("serving_rejections_total",
                             reason=verdict.reason or "unknown")
-                self._reply(ident, "rejected", rid,
+                self._reply(ident, protocol.REJECTED, rid,
                             dict(reason=verdict.reason,
                                  retry_after=verdict.retry_after))
-        elif kind == "cancel":
+        elif kind == protocol.CANCEL:
             rid = msg[1]
             if self.queue.cancel(rid) or self.scheduler.cancel(rid):
-                self._send(rid, "cancelled", {})
-        elif kind == "ping":
-            self._reply(ident, "pong", "", {})
+                self._send(rid, protocol.CANCELLED, {})
+        elif kind == protocol.PING:
+            self._reply(ident, protocol.PONG, "", {})
         else:
             logger.warning("Unknown client message kind %r.", kind)
 
@@ -373,7 +374,7 @@ class RolloutServer:
     def _deliver(self, events: List[ServeEvent]):
         for ev in events:
             data = ev.data
-            if ev.kind == "done":
+            if ev.kind == protocol.DONE:
                 r = data["result"]
                 # replica-side end-to-end latency (queue wait +
                 # serve), bucketed so a /metrics scrape yields
@@ -460,7 +461,7 @@ class RolloutServer:
         # a request parked on KV-pool backpressure is queued work too
         bounced += self.scheduler.take_parked()
         for req in bounced:
-            self._send(req.rid, "draining", {})
+            self._send(req.rid, protocol.DRAINING, {})
         return len(bounced)
 
     def finish_drain(self, force: bool = False) -> List[str]:
@@ -475,8 +476,8 @@ class RolloutServer:
         if force:
             for rid in self.scheduler.active_rids():
                 self.scheduler.cancel(rid)
-                self._send(rid, "cancelled",
-                           dict(reason="drain_deadline"))
+                self._send(rid, protocol.CANCELLED,
+                           dict(reason=protocol.REASON_DRAIN_DEADLINE))
                 abandoned.append(rid)
             if abandoned:
                 from realhf_tpu.obs import flight
@@ -552,7 +553,7 @@ class RolloutResult:
 
     @property
     def ok(self) -> bool:
-        return self.status == "done"
+        return self.status == protocol.DONE
 
     @property
     def tokens(self) -> Optional[np.ndarray]:
@@ -606,13 +607,13 @@ class RolloutClient:
         # the server parents its serve:request span there, stitching
         # client and server into one timeline
         self._sock.send(pickle.dumps(
-            ("submit", rid, np.asarray(prompt, np.int32),
+            (protocol.SUBMIT, rid, np.asarray(prompt, np.int32),
              int(priority), ttl, min_weight_version,
              tracing.inject())))
         return rid
 
     def cancel(self, rid: str):
-        self._sock.send(pickle.dumps(("cancel", rid)))
+        self._sock.send(pickle.dumps((protocol.CANCEL, rid)))
 
     def abandon(self, rid: str):
         """Cancel AND forget: drop the request's local event state and
@@ -626,16 +627,16 @@ class RolloutClient:
         self._abandoned[rid] = True
         while len(self._abandoned) > self._abandoned_cap:
             self._abandoned.popitem(last=False)
-        self._sock.send(pickle.dumps(("cancel", rid)))
+        self._sock.send(pickle.dumps((protocol.CANCEL, rid)))
 
     def ping(self, timeout: float = 10.0) -> bool:
-        self._sock.send(pickle.dumps(("ping",)))
+        self._sock.send(pickle.dumps((protocol.PING,)))
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if not self._pump(deadline - time.monotonic()):
                 break
             q = self._events.get("", [])
-            if any(k == "pong" for k, _ in q):
+            if any(k == protocol.PONG for k, _ in q):
                 q.clear()
                 return True
         return False

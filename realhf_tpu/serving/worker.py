@@ -174,7 +174,9 @@ class GenServerWorker(worker_base.Worker):
     def _preempt_hook(self, grace: float):
         """Drain-on-preempt (docs/serving.md "Shutdown"): on a
         preemption notice the server stops admitting, bounces queued
-        requests with "draining", and finishes (or cancels) in-flight
+        requests with ``protocol.DRAINING`` (the wire kinds and
+        reasons are declared in serving/protocol.py, which is
+        normative), and finishes (or cancels) in-flight
         sequences inside the grace window -- clients see terminal
         events, never a socket that silently vanished. The remaining
         grace after the drain lets late fetches of the final events

@@ -201,9 +201,10 @@ class RolloutController:
                     continue
                 sid, prompt, _ci = ref
                 if not res.ok:
-                    # rejected (backpressure), draining, expired,
-                    # server-side stale eviction: the prompt goes back
-                    # in line
+                    # any non-``done`` terminal from
+                    # serving/protocol.py (rejected, draining,
+                    # expired, stale, cancelled): the prompt goes
+                    # back in line
                     self._requeue.append((sid, prompt))
                     self.resubmits += 1
                     metrics.inc("serving_rollout_resubmits_total",

@@ -28,6 +28,17 @@ Usage::
 Exit code 0 iff every invariant holds. ``tests/chaos/`` runs a
 scaled-down drill in tier-1 and the full acceptance scenario under
 ``-m slow``.
+
+The drill scenarios and the model checker's fault model
+(``realhf_tpu/analysis/model.py``) cover the same fault classes from
+two sides -- the drill replays ONE scripted schedule against the
+real runtime, the checker exhausts ALL interleavings of an abstract
+fleet at small scope; docs/static_analysis.md "Model checking the
+failover plane" keeps the scenario <-> fault-model table. Invariant
+2 is at-most-once at the HARVEST boundary: under fence/crash faults
+the wire itself is at-least-once, and the sharded client suppresses
+late duplicates, counting them in ``stats["dup_terminals"]``
+(surfaced in the router_kill report as ``client.dup_terminals``).
 """
 
 import argparse
@@ -56,8 +67,8 @@ from realhf_tpu.serving.router_shard import (  # noqa: E402
     ShardedRolloutClient,
     ShardedRouter,
 )
+from realhf_tpu.serving.protocol import TERMINAL_KINDS  # noqa: E402
 from realhf_tpu.serving.server import (  # noqa: E402
-    TERMINAL_KINDS,
     RolloutClient,
     RolloutServer,
 )

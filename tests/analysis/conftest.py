@@ -1,13 +1,82 @@
 """Shared fixtures for the graft-lint test suite: build a parsed
 Module straight from inline source (true-positive / true-negative
-fixtures live next to the assertions that read them)."""
+fixtures live next to the assertions that read them), plus the
+router_shard mutants the model-checker regression tests replay."""
 
 import ast
+import os
 
 import pytest
 
 from realhf_tpu.analysis.core import Module
 from realhf_tpu.analysis.suppress import Suppressions
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# The PR-16 failover fix: resubmit when the target shard fenced and
+# rejoined under a higher epoch, not only when it left the ring. The
+# mutant reverts exactly that -- the model checker must rediscover
+# the parked-forever liveness hole it originally fixed.
+_EPOCH_GUARD = """\
+            gone = creq.target is None or creq.target not in names
+            fenced = (not gone and creq.target_epoch is not None
+                      and self._epochs.get(creq.target)
+                      != creq.target_epoch)
+            if gone or fenced:"""
+_EPOCH_MUTATED = """\
+            gone = creq.target is None or creq.target not in names
+            if gone:"""
+
+# The harvest-boundary exactly-once tombstone in
+# ShardedRolloutClient._on_msg; the mutant drops it, reverting the
+# client to trusting the wire for exactly-once.
+_DEDUPE_GUARD = """\
+        if rid in self._closed:
+            # exactly-once at the harvest boundary: this rid already
+            # surfaced its terminal; a failover resubmission raced it
+            # and the fleet regenerated
+            if kind in TERMINAL_KINDS:
+                self.stats["dup_terminals"] += 1
+            return
+        self._events.setdefault(rid, []).append((kind, data))
+        if kind in TERMINAL_KINDS:
+            self._inflight.pop(rid, None)
+            self._closed[rid] = True
+            while len(self._closed) > self._closed_cap:
+                self._closed.popitem(last=False)"""
+_DEDUPE_MUTATED = """\
+        self._events.setdefault(rid, []).append((kind, data))
+        if kind in TERMINAL_KINDS:
+            self._inflight.pop(rid, None)"""
+
+
+def _mutate(source: str, old: str, new: str) -> str:
+    mutated = source.replace(old, new)
+    assert mutated != source, (
+        "mutation anchor drifted out of router_shard.py -- update "
+        "the fixture strings in tests/analysis/conftest.py")
+    return mutated
+
+
+@pytest.fixture(scope="session")
+def shard_source():
+    path = os.path.join(REPO_ROOT, "realhf_tpu", "serving",
+                        "router_shard.py")
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+@pytest.fixture
+def epoch_mutant():
+    """source -> source with the PR-16 epoch-bump resubmit reverted."""
+    return lambda src: _mutate(src, _EPOCH_GUARD, _EPOCH_MUTATED)
+
+
+@pytest.fixture
+def dedupe_mutant():
+    """source -> source without the harvest-boundary tombstones."""
+    return lambda src: _mutate(src, _DEDUPE_GUARD, _DEDUPE_MUTATED)
 
 
 @pytest.fixture

@@ -10,6 +10,8 @@ from realhf_tpu.analysis import ENGINE_VERSION, all_checkers
 from realhf_tpu.analysis.__main__ import main as lint_main
 from realhf_tpu.analysis.cache import AnalysisCache
 from realhf_tpu.analysis.core import run_analysis
+from realhf_tpu.analysis.explore import ModelChecker
+from realhf_tpu.analysis.wire import WireChecker
 
 REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", ".."))
@@ -119,6 +121,59 @@ def test_diff_mode_reports_only_changed_files(tmp_path, monkeypatch,
     assert rc == 0  # informational mode
     assert "fresh.py" in out and "lifecycle-unreleased" in out
     assert "old.py" not in out  # unchanged file not re-reported
+
+
+def test_diff_mode_retains_wire_checker(tmp_path, monkeypatch,
+                                        capsys):
+    # project-wide passes are normally skipped in --diff mode, but
+    # wire declares serving/ edits relevant: a literal wire kind
+    # introduced after the commit must still be reported
+    pkg = tmp_path / "realhf_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "shard.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (pkg / "shard.py").write_text(textwrap.dedent("""
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, "accepted", rid, {})
+    """))
+    monkeypatch.chdir(tmp_path)
+    rc = lint_main(["--diff", "HEAD", "--no-cache"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "wire-literal-kind" in out
+
+
+def test_wire_and_model_project_results_cached(tmp_path):
+    pkg = tmp_path / "realhf_tpu" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "shard.py").write_text(textwrap.dedent("""
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, "accepted", rid, {})
+    """))
+    cdir = str(tmp_path / ".cache")
+
+    def run(cache):
+        return run_analysis([str(tmp_path / "realhf_tpu")],
+                            [WireChecker(), ModelChecker()],
+                            root=str(tmp_path), cache=cache)
+
+    cold = run(AnalysisCache(cdir, ENGINE_VERSION))
+    assert [f.code for f in cold] == ["wire-literal-kind"]
+    warm_cache = AnalysisCache(cdir, ENGINE_VERSION)
+    warm = run(warm_cache)
+    assert [f.to_json() for f in warm] == [f.to_json() for f in cold]
+    assert warm_cache.stats["project_hit"] is True
+    # editing the scanned tree invalidates the stamp
+    (pkg / "shard.py").write_text("x = 2\n")
+    edited_cache = AnalysisCache(cdir, ENGINE_VERSION)
+    edited = run(edited_cache)
+    assert edited_cache.stats["project_hit"] is False
+    assert edited == []
 
 
 def test_diff_mode_clean_when_nothing_changed(tmp_path, monkeypatch,

@@ -1,0 +1,106 @@
+"""Fleet model: guard extraction from router_shard-shaped source
+(TP on HEAD, TN per deleted guard) and the model's own mechanics --
+determinism of action enumeration and state hashing."""
+
+import dataclasses
+
+from realhf_tpu.analysis.model import (
+    TIER1_CONFIG,
+    FleetModel,
+    GuardProfile,
+    extract_guards,
+)
+
+
+# ----------------------------------------------------------------------
+# guard extraction
+# ----------------------------------------------------------------------
+def test_head_source_has_every_guard(shard_source):
+    g = extract_guards(shard_source)
+    assert g == GuardProfile(
+        client_epoch_resubmit=True,
+        terminal_parking=True,
+        fenced_send_guard=True,
+        parked_handover=True,
+        journal_adoption=True,
+        client_terminal_dedupe=True,
+    )
+
+
+def test_empty_source_has_no_guards():
+    g = extract_guards("x = 1\n")
+    assert g == GuardProfile(
+        client_epoch_resubmit=False,
+        terminal_parking=False,
+        fenced_send_guard=False,
+        parked_handover=False,
+        journal_adoption=False,
+        client_terminal_dedupe=False,
+    )
+
+
+def test_epoch_mutant_drops_only_that_guard(shard_source,
+                                            epoch_mutant):
+    head = extract_guards(shard_source)
+    mut = extract_guards(epoch_mutant(shard_source))
+    assert mut.client_epoch_resubmit is False
+    assert dataclasses.replace(mut, client_epoch_resubmit=True) \
+        == head
+
+
+def test_dedupe_mutant_drops_only_that_guard(shard_source,
+                                             dedupe_mutant):
+    head = extract_guards(shard_source)
+    mut = extract_guards(dedupe_mutant(shard_source))
+    assert mut.client_terminal_dedupe is False
+    assert dataclasses.replace(mut, client_terminal_dedupe=True) \
+        == head
+
+
+def test_unparseable_source_raises():
+    # ModelChecker.check_project catches this and defers to the
+    # per-file syntax diagnostics; extract_guards itself propagates
+    import pytest
+    with pytest.raises(SyntaxError):
+        extract_guards("def broken(:\n")
+
+
+# ----------------------------------------------------------------------
+# model mechanics
+# ----------------------------------------------------------------------
+def test_initial_state_is_hashable_and_safe(shard_source):
+    cfg = dataclasses.replace(TIER1_CONFIG,
+                              guards=extract_guards(shard_source))
+    model = FleetModel(cfg)
+    init = model.initial()
+    assert hash(init) == hash(model.initial())
+    assert init == model.initial()
+    assert model.safety_violations(init) == []
+
+
+def test_actions_deterministic_and_sorted(shard_source):
+    cfg = dataclasses.replace(TIER1_CONFIG,
+                              guards=extract_guards(shard_source))
+    model = FleetModel(cfg)
+    st = model.initial()
+    first = model.actions(st)
+    second = model.actions(st)
+    assert [a for a, _ in first] == [a for a, _ in second]
+    assert [s for _, s in first] == [s for _, s in second]
+    names = [a for a, _ in first]
+    assert names == sorted(names)
+
+
+def test_successors_differ_from_source_state(shard_source):
+    # no-op self-loops are filtered: every successor is a new state
+    cfg = dataclasses.replace(TIER1_CONFIG,
+                              guards=extract_guards(shard_source))
+    model = FleetModel(cfg)
+    frontier = [model.initial()]
+    for _ in range(3):
+        nxt = []
+        for st in frontier:
+            for _, succ in model.actions(st):
+                assert succ != st
+                nxt.append(succ)
+        frontier = nxt[:8]

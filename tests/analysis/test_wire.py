@@ -1,0 +1,243 @@
+"""wire checker: per-site protocol conformance (TP/TN per rule) and
+the emitter/handler cross-check, against inline fixture packages;
+the real serving/ tree must be clean."""
+
+import os
+import textwrap
+
+import pytest
+
+from realhf_tpu.analysis.wire import WireChecker
+from realhf_tpu.serving import protocol
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run_wire(tmp_path, files, with_declaration=False):
+    """Write ``files`` into a fixture package and run the checker.
+
+    ``with_declaration`` drops a marker ``protocol.py`` into the tree
+    so the project-wide cross-check runs (it is suppressed on fixture
+    trees that lack the declaration file).
+    """
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    if with_declaration:
+        (pkg / "protocol.py").write_text("# declaration marker\n")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return WireChecker(package="pkg").check_project(str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# per-site rules
+# ----------------------------------------------------------------------
+def test_literal_kind_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"shard.py": """
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, "accepted", rid, {})
+    """})
+    assert "wire-literal-kind" in codes_of(findings)
+
+
+def test_protocol_constant_clean(tmp_path):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving import protocol
+
+        class S:
+            def go(self, ident, rid, depth):
+                self._send_ident(ident, protocol.ACCEPTED, rid,
+                                 dict(queue_depth=depth))
+    """})
+    assert findings == []
+
+
+def test_from_imported_constant_clean(tmp_path):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving.protocol import ACCEPTED
+
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, ACCEPTED, rid, {})
+    """})
+    assert findings == []
+
+
+def test_dynamic_kind_out_of_scope(tmp_path):
+    findings = run_wire(tmp_path, {"shard.py": """
+        class S:
+            def fwd(self, ident, ev, rid):
+                self._send_ident(ident, ev.kind, rid, ev.data)
+    """})
+    assert findings == []
+
+
+def test_undeclared_kind_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving import protocol
+
+        class S:
+            def go(self, ident, rid):
+                self._send(ident, "bogus_event", {})
+    """})
+    assert "wire-undeclared-kind" in codes_of(findings)
+
+
+def test_undeclared_field_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving import protocol
+
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, protocol.ACCEPTED, rid,
+                                 dict(queue_depth=1, typo_field=2))
+    """})
+    assert codes_of(findings) == ["wire-undeclared-field"]
+
+
+def test_internal_envelope_whitelisted(tmp_path):
+    # scheduler -> server internal envelope: `result` is not a done
+    # frame field, but _deliver unpacks it before the wire
+    findings = run_wire(tmp_path, {"sched.py": """
+        from realhf_tpu.serving import protocol
+
+        def emit(out):
+            return ServeEvent(protocol.DONE, out.rid,
+                              dict(result=out))
+    """})
+    assert findings == []
+
+
+def test_undeclared_reason_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving import protocol
+
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(ident, protocol.REJECTED, rid,
+                                 dict(reason="not_a_real_reason"))
+    """})
+    assert codes_of(findings) == ["wire-undeclared-reason"]
+
+
+def test_declared_reason_clean(tmp_path):
+    findings = run_wire(tmp_path, {"shard.py": """
+        from realhf_tpu.serving import protocol
+
+        class S:
+            def go(self, ident, rid):
+                self._send_ident(
+                    ident, protocol.REJECTED, rid,
+                    dict(reason=protocol.REASON_BACKPRESSURE))
+    """})
+    assert findings == []
+
+
+def test_request_arity_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"client.py": """
+        from realhf_tpu.serving import protocol
+
+        class C:
+            def cancel(self, rid):
+                self._send_to(self.target,
+                              (protocol.CANCEL, rid, "extra"))
+    """})
+    assert codes_of(findings) == ["wire-request-arity"]
+
+
+def test_request_arity_clean(tmp_path):
+    findings = run_wire(tmp_path, {"client.py": """
+        from realhf_tpu.serving import protocol
+
+        class C:
+            def cancel(self, rid):
+                self._send_to(self.target, (protocol.CANCEL, rid))
+    """})
+    assert findings == []
+
+
+def test_slots_tuple_not_flagged(tmp_path):
+    # a literal-headed tuple that is NOT a call argument (e.g.
+    # __slots__) must not trip the literal-kind rule even when its
+    # first element collides with a kind name
+    findings = run_wire(tmp_path, {"state.py": """
+        class R:
+            __slots__ = ("done", "stale", "tokens")
+    """})
+    assert findings == []
+
+
+def test_literal_in_kind_compare_flagged(tmp_path, codes_of):
+    findings = run_wire(tmp_path, {"pump.py": """
+        def on_msg(kind, data):
+            if kind == "done":
+                return True
+    """})
+    assert codes_of(findings) == ["wire-literal-kind"]
+
+
+def test_unrelated_string_compare_clean(tmp_path):
+    findings = run_wire(tmp_path, {"cfg.py": """
+        def pick(mode):
+            if mode == "done":
+                return 1
+    """})
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# project-wide cross-check
+# ----------------------------------------------------------------------
+def test_cross_check_fires_on_empty_tree(tmp_path, codes_of):
+    # declaration present but nothing emits/handles anything: every
+    # FSM-ridden kind is site-less and every dispatchable kind is
+    # unhandled
+    findings = run_wire(tmp_path, {"empty.py": "x = 1\n"},
+                        with_declaration=True)
+    codes = set(codes_of(findings))
+    assert "wire-fsm-no-site" in codes
+    assert "wire-unhandled-kind" in codes
+    by_symbol = {f.symbol for f in findings
+                 if f.code == "wire-fsm-no-site"}
+    assert protocol.DONE in by_symbol
+
+
+def test_cross_check_suppressed_without_declaration(tmp_path):
+    findings = run_wire(tmp_path, {"empty.py": "x = 1\n"})
+    assert findings == []
+
+
+def test_terminal_membership_handles_all_terminals(tmp_path,
+                                                   codes_of):
+    # `kind in TERMINAL_KINDS` must count as handling every terminal:
+    # no wire-unhandled-kind for done/rejected/... from this tree
+    findings = run_wire(tmp_path, {"pump.py": """
+        from realhf_tpu.serving.protocol import TERMINAL_KINDS
+
+        def on_msg(kind, data):
+            if kind in TERMINAL_KINDS:
+                return "closed"
+    """}, with_declaration=True)
+    unhandled = {f.symbol for f in findings
+                 if f.code == "wire-unhandled-kind"}
+    assert protocol.DONE not in unhandled
+    assert protocol.REJECTED not in unhandled
+
+
+def test_real_serving_tree_is_clean():
+    assert WireChecker().check_project(REPO_ROOT) == []
+
+
+# ----------------------------------------------------------------------
+# --diff integration
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("changed,expect", [
+    (["realhf_tpu/serving/router_shard.py"], True),
+    (["realhf_tpu/serving/protocol.py"], True),
+    (["realhf_tpu/system/rollout.py"], False),
+    ([], False),
+])
+def test_diff_relevant_scope(changed, expect):
+    assert WireChecker().diff_relevant(changed) is expect
