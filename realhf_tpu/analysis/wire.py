@@ -60,6 +60,9 @@ EMIT_CALLS: Dict[str, Tuple[int, int]] = {
     "_send_ident": (1, 3),
     "_finish": (1, 2),
     "ServeEvent": (0, 2),
+    # gateway -> browser: one SSE frame per wire event
+    # (serving/gateway.py `_sse_event(wfile, kind, data)`)
+    "_sse_event": (1, 2),
 }
 
 #: extra payload keys allowed at specific emit sites: internal

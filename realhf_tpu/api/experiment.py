@@ -304,6 +304,32 @@ class ServingSpec:
     weight_push_encoding: str = "raw"
     #: relay-tree fanout; 0 = unicast (root pushes to every replica)
     weight_push_fanout: int = 2
+    # -- HTTP front door (docs/serving.md "Front door"): a
+    # GatewayWorker serving OpenAI-compatible streaming
+    # ``/v1/completions`` over SSE, fronting the router plane with
+    # per-tenant quotas, SLO classes, and deadline-aware shedding.
+    gateway: bool = False
+    #: TCP port for the gateway's HTTP listener; 0 = OS-assigned (the
+    #: bound address is published via name_resolve either way)
+    gateway_port: int = 0
+    #: default per-tenant token-bucket refill rate (requests/second)
+    #: and burst capacity; tenants absent from ``gateway_tenants``
+    #: get these
+    gateway_tenant_rate: float = 50.0
+    gateway_tenant_burst: float = 100.0
+    #: per-tenant overrides: ``{tenant: {"rate": .., "burst": ..}}``
+    gateway_tenants: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
+    #: SLO budgets (seconds): a request without an explicit
+    #: ``deadline_secs`` gets its class's budget as the deadline the
+    #: shed decision evaluates against
+    gateway_interactive_slo_secs: float = 2.0
+    gateway_batch_slo_secs: float = 30.0
+    #: brownout level 2+ trims ``max_tokens`` down to this
+    gateway_trim_max_new_tokens: int = 32
+    #: seconds the gateway waits on a wire stream before closing the
+    #: HTTP request with an ``expired`` terminal
+    gateway_stream_timeout_secs: float = 120.0
 
 
 @dataclasses.dataclass

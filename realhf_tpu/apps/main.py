@@ -466,7 +466,12 @@ def run_serve(spec: ExperimentSpec,
     # singleton router (and its loss stays fatal)
     n_routers = max(1, int(getattr(sv, "n_routers", 1))) if fleet else 0
     router_names = [f"router/{i}" for i in range(n_routers)]
-    worker_names = gen_names + router_names
+    # HTTP front door (docs/serving.md "Front door"): a GatewayWorker
+    # exposing /v1/completions over SSE ahead of the router plane.
+    # A singleton like the classic router: its loss is fatal.
+    gateway_names = ["gateway/0"] if getattr(sv, "gateway", False) \
+        else []
+    worker_names = gen_names + router_names + gateway_names
     sched = make_scheduler("local")
     controller = PodController(sched)
     name_resolve.clear_subtree(
@@ -479,12 +484,15 @@ def run_serve(spec: ExperimentSpec,
         for i, rname in enumerate(router_names):
             controller.submit(rname, _worker_cmd("router", i, spec),
                               env=env)
+        for i, gname in enumerate(gateway_names):
+            controller.submit(gname, _worker_cmd("gateway", i, spec),
+                              env=env)
         panel = WorkerControlPanel(spec.experiment_name, spec.trial_name)
         panel.connect(worker_names, timeout=120)
         configs = {f"gen_server/{i}": dict(config=dict(
             spec_path=path, server_index=i))
             for i in range(sv.n_servers)}
-        for rname in router_names:
+        for rname in router_names + gateway_names:
             configs[rname] = dict(config=dict(spec_path=path))
         out = panel.group_request_varied("configure", configs,
                                          timeout=600)

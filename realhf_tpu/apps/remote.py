@@ -49,6 +49,10 @@ def main_worker(args):
         from realhf_tpu.serving.worker import RouterWorker
         cls = RouterWorker
         name = f"router/{args.index}"
+    elif args.worker_type == "gateway":
+        from realhf_tpu.serving.worker import GatewayWorker
+        cls = GatewayWorker
+        name = f"gateway/{args.index}"
     else:
         raise ValueError(args.worker_type)
     cls(args.experiment_name, args.trial_name, name).run()
@@ -60,7 +64,7 @@ def main():
     w = sub.add_parser("worker")
     w.add_argument("--worker_type", required=True,
                    choices=["model_worker", "master_worker",
-                            "gen_server", "router"])
+                            "gen_server", "router", "gateway"])
     w.add_argument("--index", type=int, default=0)
     w.add_argument("--experiment_name", required=True)
     w.add_argument("--trial_name", required=True)

@@ -51,6 +51,65 @@ TELEMETRY_PORT_ENV = "REALHF_TPU_TELEMETRY_PORT"
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: per-connection socket timeout: a scraper that connects and then
+#: stalls (or trickles bytes) must not pin a handler thread forever.
+#: ``BaseHTTPRequestHandler`` honors the class attribute by calling
+#: ``settimeout`` on the connection.
+REQUEST_TIMEOUT_SECS = 30.0
+#: request-line / total-header byte bounds, far below the stdlib's
+#: 64 KiB-per-line / 100-header ceilings: telemetry requests are tiny
+#: (``GET /metrics``), so anything larger is garbage or abuse.
+MAX_REQUEST_LINE_BYTES = 8192
+MAX_HEADER_BYTES = 16384
+
+
+class BoundedRequestHandler(BaseHTTPRequestHandler):
+    """A ``BaseHTTPRequestHandler`` hardened for unattended serving:
+    per-connection timeout, bounded request line, bounded total header
+    bytes. Shared by the telemetry endpoints here and the serving
+    gateway (``serving/gateway.py``) -- both sit on the same stdlib
+    HTTP plane and face the same stalled/abusive-client hazards."""
+
+    timeout = REQUEST_TIMEOUT_SECS
+    max_request_line = MAX_REQUEST_LINE_BYTES
+    max_header_bytes = MAX_HEADER_BYTES
+
+    def handle_one_request(self):
+        """Stdlib flow with tighter bounds: 414 on an oversized
+        request line, 431 on oversized headers, connection close on a
+        read timeout (the stalled-scraper case)."""
+        try:
+            self.raw_requestline = self.rfile.readline(
+                self.max_request_line + 1)
+            if len(self.raw_requestline) > self.max_request_line:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414)
+                self.close_connection = True
+                return
+            if not self.raw_requestline:
+                self.close_connection = True
+                return
+            if not self.parse_request():
+                return  # parse_request already sent the error
+            header_bytes = sum(len(k) + len(v) + 4
+                               for k, v in self.headers.items())
+            if header_bytes > self.max_header_bytes:
+                self.send_error(431)
+                self.close_connection = True
+                return
+            mname = "do_" + self.command
+            if not hasattr(self, mname):
+                self.send_error(
+                    501, f"Unsupported method ({self.command!r})")
+                return
+            getattr(self, mname)()
+            self.wfile.flush()
+        except TimeoutError as e:
+            self.log_error("request timed out: %r", e)
+            self.close_connection = True
+
 #: health states that answer 200 (anything else -- draining,
 #: preempted, error, unknown -- answers 503 so probers back off)
 HEALTHY_STATES = ("READY", "RUNNING", "PAUSED")
@@ -105,7 +164,7 @@ class TelemetryServer:
     def start(self) -> "TelemetryServer":
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(BoundedRequestHandler):
             # scrapes at 1-15s cadence would otherwise spam the log
             def log_message(self, fmt, *args):
                 pass

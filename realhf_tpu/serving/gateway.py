@@ -1,0 +1,885 @@
+"""Million-user HTTP front door: ``/v1/completions`` over SSE.
+
+Real user traffic at the ROADMAP scale arrives as HTTP, not as the
+custom ZMQ wire ``serving/server.py`` speaks. :class:`GatewayServer`
+is the production ingress: an OpenAI-compatible streaming completions
+endpoint on the same stdlib ``ThreadingHTTPServer`` plane as the
+telemetry endpoints (``obs/http.py``), fronting the FleetRouter /
+sharded router plane through ordinary :class:`RolloutClient`\\ s.
+
+The robustness machinery is the point, not the plumbing
+(docs/serving.md "Front door"):
+
+- **Per-tenant token buckets** (:class:`TokenBucket`, injectable
+  clock, no sleeps): a flooding tenant exhausts its own
+  ``rejected(reason=quota)`` budget, never another tenant's latency.
+- **SLO classes**: the request's ``slo`` field
+  (``interactive``/``batch``, declared in
+  ``protocol.GATEWAY_SLO_CLASSES``) maps onto the PR 2 admission
+  queue's priority classes, so latency-bound traffic overtakes
+  throughput-bound traffic end to end.
+- **Deadline-aware shedding BEFORE dispatch**: a request that cannot
+  meet its deadline given the current queue depth and the latency p95
+  from the PR 13 histograms is rejected ``429 Retry-After``
+  (``reason=deadline_unmeetable``) instead of burning a decode slot
+  producing an answer nobody will wait for.
+- **Brownout ladder** (:class:`BrownoutLadder`) under sustained
+  overload: shed batch first, then trim ``max_tokens``, interactive
+  last -- graceful degradation instead of collapse.
+
+Exactly-once terminal on the HTTP surface: a shed request's 4xx/5xx
+reply IS its terminal (the router never sees the rid); an admitted
+request relays exactly the wire terminal the client-request state
+machine guarantees (``protocol.GATEWAY_REQUEST``). Status mapping is
+declared in ``protocol.GATEWAY_HTTP_STATUS`` /
+``GATEWAY_REJECT_STATUS``; the graft-lint wire checker covers the SSE
+emit sites (``_sse_event``) like any other send path.
+
+Every decision is measured on the telemetry plane:
+``serving_gateway_*`` and ``tenant_*`` metrics (catalog:
+docs/observability.md).
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics as obs_metrics
+from realhf_tpu.obs.http import (
+    BoundedRequestHandler,
+    parse_prometheus_text,
+    prom_histogram_quantile,
+    prom_scalar,
+)
+from realhf_tpu.serving import protocol
+
+logger = logging.getLogger("serving.gateway")
+
+#: completion request bodies are prompts + knobs, not uploads
+MAX_BODY_BYTES = 1 << 20
+
+#: service-seconds fallback while the latency histogram is empty
+DEFAULT_SERVICE_SECS = 1.0
+
+# Brownout ladder rungs (shed cheapest traffic first, interactive
+# absolutely last -- docs/serving.md "Front door").
+LEVEL_NORMAL = 0
+LEVEL_SHED_BATCH = 1
+LEVEL_TRIM = 2
+LEVEL_SHED_ALL = 3
+
+
+# ----------------------------------------------------------------------
+# Token buckets (per-tenant admission quota)
+# ----------------------------------------------------------------------
+class TokenBucket:
+    """Classic token bucket on an injectable clock.
+
+    ``rate`` tokens/second refill up to ``burst`` capacity; a take
+    that cannot be covered fails immediately with a
+    :meth:`retry_after` hint (no sleeping, no background thread --
+    refill is computed lazily from clock deltas, so tests drive it
+    with a fake clock).
+    """
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._level = float(burst)
+        self._stamp = clock()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float):
+        if now > self._stamp:
+            self._level = min(
+                self.burst, self._level + (now - self._stamp) * self.rate)
+        self._stamp = now
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            self._refill(self._clock())
+            if self._level >= n:
+                self._level -= n
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            self._refill(self._clock())
+            return self._level
+
+    def retry_after(self, n: float = 1.0) -> float:
+        """Seconds until a take of ``n`` could succeed."""
+        with self._lock:
+            self._refill(self._clock())
+            short = n - self._level
+            if short <= 0:
+                return 0.0
+            if self.rate <= 0:
+                return float("inf")
+            return short / self.rate
+
+
+# ----------------------------------------------------------------------
+# Load estimation (queue depth + latency p95 -> expected wait)
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class LoadSnapshot:
+    """What the shed decision sees: backlog and service speed."""
+    queue_depth: int = 0
+    n_slots: int = 1
+    p95_secs: Optional[float] = None
+    #: optional per-priority-class backlog (priority int -> waiting
+    #: count); lets the wait estimate honor the admission queue's
+    #: strict class ordering. None = only the total is known.
+    depth_by_class: Optional[Dict[int, int]] = None
+
+    def depth_ahead(self, priority: Optional[int] = None) -> int:
+        """Backlog an arrival of ``priority`` actually waits behind:
+        the admission queue serves classes strictly in order, so an
+        interactive request jumps every batch entry. Without
+        per-class depths, the total is the conservative answer."""
+        if priority is None or self.depth_by_class is None:
+            return self.queue_depth
+        return sum(n for p, n in self.depth_by_class.items()
+                   if p <= priority)
+
+    def estimated_wait(self, priority: Optional[int] = None) -> float:
+        """Expected queue-to-done seconds for a NEW arrival of
+        ``priority`` (None = worst case): the backlog ahead of it
+        drains ``n_slots`` wide at ~p95 per sequence, plus the
+        request's own service time."""
+        p95 = self.p95_secs if self.p95_secs else DEFAULT_SERVICE_SECS
+        return p95 * (self.depth_ahead(priority)
+                      / max(1, self.n_slots) + 1.0)
+
+
+class RouterLoadProbe:
+    """LoadSnapshot from a router's ``/metrics`` endpoint (the PR 13
+    telemetry plane): queue depth from the ``router_pending`` /
+    ``router_inflight`` gauges, p95 from the
+    ``router_latency_seconds`` histogram buckets -- exactly what a
+    real Prometheus would compute. ``fetch`` returns the exposition
+    text (or None); results are cached for ``cache_secs`` so a
+    request storm does not turn into a scrape storm."""
+
+    def __init__(self, fetch: Callable[[], Optional[str]], *,
+                 n_slots: int = 1, cache_secs: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._fetch = fetch
+        self.n_slots = max(1, n_slots)
+        self._cache_secs = cache_secs
+        self._clock = clock
+        self._cached = LoadSnapshot(n_slots=self.n_slots)
+        self._stamp: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def __call__(self) -> LoadSnapshot:
+        now = self._clock()
+        with self._lock:
+            if self._stamp is not None \
+                    and now - self._stamp < self._cache_secs:
+                return self._cached
+            self._stamp = now
+        try:
+            text = self._fetch()
+        except Exception as e:  # noqa: BLE001 - a failed scrape must
+            # not fail admission; the stale snapshot is still sane
+            logger.warning("Gateway load probe failed: %r", e)
+            text = None
+        if text is None:
+            return self._cached
+        fams = parse_prometheus_text(text)
+        depth = prom_scalar(fams, "router_pending", agg="last") \
+            + prom_scalar(fams, "router_inflight", agg="last")
+        snap = LoadSnapshot(
+            queue_depth=int(depth), n_slots=self.n_slots,
+            p95_secs=prom_histogram_quantile(
+                fams, "router_latency_seconds", 0.95))
+        with self._lock:
+            self._cached = snap
+        return snap
+
+
+# ----------------------------------------------------------------------
+# Brownout ladder
+# ----------------------------------------------------------------------
+class BrownoutLadder:
+    """Hysteretic overload ladder: pressure (estimated wait over the
+    interactive SLO) sustained above ``up_pressure`` for
+    ``sustain_secs`` climbs one rung; pressure below
+    ``down_pressure`` for ``cool_secs`` descends one. The rungs
+    (module constants): 0 normal, 1 shed batch, 2 also trim
+    ``max_tokens``, 3 shed interactive too -- the last resort.
+    Injectable clock, no threads."""
+
+    def __init__(self, *, up_pressure: float = 1.0,
+                 down_pressure: float = 0.5,
+                 sustain_secs: float = 1.0, cool_secs: float = 3.0,
+                 max_level: int = LEVEL_SHED_ALL,
+                 clock: Callable[[], float] = time.monotonic):
+        self.up_pressure = up_pressure
+        self.down_pressure = down_pressure
+        self.sustain_secs = sustain_secs
+        self.cool_secs = cool_secs
+        self.max_level = max_level
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.level = LEVEL_NORMAL
+        self._hot_since: Optional[float] = None
+        self._cool_since: Optional[float] = None
+
+    def observe(self, pressure: float) -> int:
+        """Feed one pressure sample; returns the (possibly new)
+        level. Climbing re-arms the sustain timer so each rung needs
+        its own sustained evidence."""
+        now = self._clock()
+        with self._lock:
+            if pressure > self.up_pressure:
+                self._cool_since = None
+                if self._hot_since is None:
+                    self._hot_since = now
+                elif now - self._hot_since >= self.sustain_secs \
+                        and self.level < self.max_level:
+                    self.level += 1
+                    self._hot_since = now
+                    logger.warning(
+                        "Gateway brownout escalated to level %d "
+                        "(pressure %.2f).", self.level, pressure)
+            elif pressure < self.down_pressure:
+                self._hot_since = None
+                if self._cool_since is None:
+                    self._cool_since = now
+                elif now - self._cool_since >= self.cool_secs \
+                        and self.level > LEVEL_NORMAL:
+                    self.level -= 1
+                    self._cool_since = now
+                    logger.info("Gateway brownout eased to level %d.",
+                                self.level)
+            else:
+                self._hot_since = None
+                self._cool_since = None
+            obs_metrics.set_gauge("serving_gateway_brownout_level",
+                                  self.level)
+            return self.level
+
+
+# ----------------------------------------------------------------------
+# Admission policy (quota -> brownout -> deadline feasibility)
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class GatewayVerdict:
+    """One admission decision; mirrors the queue's AdmissionVerdict
+    with the gateway's extra outputs (priority, trimmed budget,
+    resolved absolute deadline)."""
+    accepted: bool
+    reason: str = ""
+    retry_after: Optional[float] = None
+    priority: int = 1
+    max_new_tokens: Optional[int] = None
+    deadline: Optional[float] = None
+
+
+class GatewayPolicy:
+    """The front door's brain: per-tenant token buckets, SLO-class
+    mapping, brownout ladder, and deadline-aware shedding, all on one
+    injectable clock. ``load_probe`` is any zero-arg callable
+    returning a :class:`LoadSnapshot` (:class:`RouterLoadProbe` in
+    production, a stub in tests/benches)."""
+
+    def __init__(self, *, tenants: Optional[Dict[str, Dict]] = None,
+                 default_rate: float = 50.0,
+                 default_burst: float = 100.0,
+                 interactive_slo_secs: float = 2.0,
+                 batch_slo_secs: float = 30.0,
+                 trim_max_new_tokens: int = 32,
+                 load_probe: Optional[Callable[[], LoadSnapshot]] = None,
+                 brownout: Optional[BrownoutLadder] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._tenant_cfg = dict(tenants or {})
+        self.default_rate = default_rate
+        self.default_burst = default_burst
+        self.interactive_slo_secs = interactive_slo_secs
+        self.batch_slo_secs = batch_slo_secs
+        self.trim_max_new_tokens = trim_max_new_tokens
+        self._load_probe = load_probe
+        self._clock = clock
+        self.brownout = brownout or BrownoutLadder(clock=clock)
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        self.stats = dict(admitted=0, shed=0, trimmed=0)
+
+    # -- tenants -------------------------------------------------------
+    def bucket(self, tenant: str) -> TokenBucket:
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                cfg = self._tenant_cfg.get(tenant, {})
+                b = self._buckets[tenant] = TokenBucket(
+                    rate=float(cfg.get("rate", self.default_rate)),
+                    burst=float(cfg.get("burst", self.default_burst)),
+                    clock=self._clock)
+            return b
+
+    def tenants_snapshot(self) -> Dict[str, Dict]:
+        """The per-tenant quota surface (``GET /gateway/tenants``)."""
+        with self._lock:
+            buckets = dict(self._buckets)
+        return {t: dict(rate=b.rate, burst=b.burst,
+                        available=round(b.available(), 3))
+                for t, b in sorted(buckets.items())}
+
+    # -- decision ------------------------------------------------------
+    def load(self) -> LoadSnapshot:
+        if self._load_probe is None:
+            return LoadSnapshot()
+        return self._load_probe()
+
+    def slo_budget(self, slo: str) -> float:
+        if slo == protocol.GATEWAY_SLO_INTERACTIVE:
+            return self.interactive_slo_secs
+        return self.batch_slo_secs
+
+    def admit(self, tenant: str, slo: str, *,
+              deadline: Optional[float] = None,
+              max_new_tokens: Optional[int] = None,
+              cost: float = 1.0) -> GatewayVerdict:
+        """Decide one request. Gate order: tenant quota (a flooding
+        tenant is turned away even when the fleet is idle), brownout
+        ladder (global overload sheds whole classes), deadline
+        feasibility (queue depth x p95 says the answer would arrive
+        too late). Shedding happens BEFORE any token reaches the
+        router."""
+        now = self._clock()
+        priority = protocol.GATEWAY_SLO_CLASSES[slo]
+        obs_metrics.inc("serving_gateway_requests_total",
+                        tenant=tenant, slo=slo)
+        snap = self.load()
+        # the ladder keys on SYSTEM pressure (total backlog vs the
+        # interactive budget); feasibility keys on the CLASS-aware
+        # wait -- an interactive arrival jumps the batch backlog in
+        # the admission queue, so only same-or-higher-class entries
+        # delay it. Without that split, pure deadline shedding would
+        # invert the SLO order and starve the tight class first.
+        est_total = snap.estimated_wait()
+        est_wait = snap.estimated_wait(priority)
+        level = self.brownout.observe(
+            est_total / max(1e-6, self.interactive_slo_secs))
+        if deadline is None:
+            deadline = now + self.slo_budget(slo)
+
+        bucket = self.bucket(tenant)
+        if not bucket.take(cost):
+            return self._shed(tenant, slo, protocol.REASON_QUOTA,
+                              retry_after=bucket.retry_after(cost))
+        obs_metrics.set_gauge("tenant_quota_remaining",
+                              bucket.available(), tenant=tenant)
+
+        if level >= LEVEL_SHED_BATCH and priority > 0:
+            return self._shed(tenant, slo, protocol.REASON_BROWNOUT,
+                              retry_after=est_wait)
+        if level >= LEVEL_SHED_ALL:
+            return self._shed(tenant, slo, protocol.REASON_BROWNOUT,
+                              retry_after=est_wait)
+
+        if max_new_tokens is not None and level >= LEVEL_TRIM \
+                and max_new_tokens > self.trim_max_new_tokens:
+            max_new_tokens = self.trim_max_new_tokens
+            self.stats["trimmed"] += 1
+            obs_metrics.inc("serving_gateway_trimmed_total")
+
+        if now + est_wait > deadline:
+            return self._shed(
+                tenant, slo, protocol.REASON_DEADLINE_UNMEETABLE,
+                retry_after=max(0.05, est_wait))
+
+        self.stats["admitted"] += 1
+        return GatewayVerdict(True, priority=priority,
+                              max_new_tokens=max_new_tokens,
+                              deadline=deadline)
+
+    def _shed(self, tenant: str, slo: str, reason: str, *,
+              retry_after: Optional[float]) -> GatewayVerdict:
+        self.stats["shed"] += 1
+        obs_metrics.inc("serving_gateway_shed_total",
+                        slo=slo, reason=reason)
+        obs_metrics.inc("tenant_shed_total",
+                        tenant=tenant, reason=reason)
+        return GatewayVerdict(
+            False, reason=reason, retry_after=retry_after,
+            priority=protocol.GATEWAY_SLO_CLASSES[slo])
+
+
+# ----------------------------------------------------------------------
+# SSE framing
+# ----------------------------------------------------------------------
+SSE_DONE_SENTINEL = b"data: [DONE]\n\n"
+
+
+def _json_default(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return str(obj)
+
+
+def sse_format(event: str, data: Dict) -> bytes:
+    """One SSE frame: ``event: <kind>`` + one JSON ``data:`` line."""
+    payload = json.dumps(data, separators=(",", ":"),
+                         default=_json_default)
+    return f"event: {event}\ndata: {payload}\n\n".encode()
+
+
+def sse_parse(text: str) -> List[Tuple[str, object]]:
+    """Parse an SSE stream back into ``(event, data)`` pairs -- the
+    round-trip counterpart of :func:`sse_format`, used by the tests,
+    the bench harness, and any Python consumer. JSON data decodes to
+    its object; non-JSON data (the OpenAI ``[DONE]`` sentinel) comes
+    back as the raw string with an empty event name."""
+    out: List[Tuple[str, object]] = []
+    event = ""
+    data_lines: List[str] = []
+    for line in list(text.splitlines()) + [""]:
+        if line == "":
+            if data_lines:
+                raw = "\n".join(data_lines)
+                try:
+                    payload = json.loads(raw)
+                except ValueError:
+                    payload = raw
+                out.append((event, payload))
+            event, data_lines = "", []
+        elif line.startswith("event:"):
+            event = line[len("event:"):].strip()
+        elif line.startswith("data:"):
+            data_lines.append(line[len("data:"):].strip())
+        # comment / id / retry fields are ignored
+    return out
+
+
+# ----------------------------------------------------------------------
+# The HTTP server
+# ----------------------------------------------------------------------
+class GatewayServer:
+    """OpenAI-compatible completions ingress (module doc).
+
+    ``client_factory`` builds one RolloutClient-shaped object
+    (``submit/stream/abandon/close``) per concurrent request; clients
+    are pooled and reused serially across handler threads (checkout /
+    checkin around each request -- a ZMQ DEALER socket tolerates
+    serial cross-thread use under a lock's memory barrier, never
+    concurrent use).
+
+    Endpoints: ``POST /v1/completions`` (SSE when ``stream`` is true,
+    one JSON body otherwise), ``GET /gateway/tenants`` (quota
+    surface), ``GET /gateway/stats``, ``GET /healthz``.
+    """
+
+    def __init__(self, client_factory: Callable[[], object], *,
+                 policy: Optional[GatewayPolicy] = None,
+                 port: int = 0, host: str = "",
+                 process_name: str = "gateway",
+                 encode: Optional[Callable[[str], np.ndarray]] = None,
+                 stream_timeout: float = 120.0,
+                 model_name: str = "realhf-tpu",
+                 clock: Callable[[], float] = time.monotonic):
+        self._client_factory = client_factory
+        self.policy = policy or GatewayPolicy(clock=clock)
+        self.process_name = process_name
+        self._requested_port = port
+        self._host = host
+        self._encode = encode or _byte_level_encode
+        self.stream_timeout = stream_timeout
+        self.model_name = model_name
+        self._clock = clock
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._pool: List[object] = []
+        self._pool_lock = threading.Lock()
+        self._draining = False
+        self.stats = dict(http_requests=0, streams=0, terminals=0)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "GatewayServer":
+        server = self
+
+        class Handler(BoundedRequestHandler):
+            # the front door serves users, not scrapers: slightly
+            # longer patience for slow readers of long SSE streams
+            timeout = 60.0
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                server._safe(self, server._route_get)
+
+            def do_POST(self):
+                server._safe(self, server._route_post)
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"gateway[{self.process_name}]", daemon=True)
+        self._thread.start()
+        logger.info("Gateway %s serving /v1/completions on port %d.",
+                    self.process_name, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return 0
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        from realhf_tpu.base import network
+        return f"{network.gethostip()}:{self.port}"
+
+    def start_drain(self):
+        """Refuse all future admissions (503 draining); in-flight
+        streams run to their terminals."""
+        self._draining = True
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for client in pool:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+
+    # -- client pool ----------------------------------------------------
+    def _checkout(self):
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._client_factory()
+
+    def _checkin(self, client):
+        with self._pool_lock:
+            self._pool.append(client)
+
+    # -- plumbing -------------------------------------------------------
+    def _safe(self, handler, route):
+        self.stats["http_requests"] += 1
+        try:
+            route(handler)
+        except BrokenPipeError:
+            pass  # user hung up mid-stream
+        except Exception as e:  # noqa: BLE001 - one bad request must
+            # never take the front door down
+            logger.error("Gateway handler error: %r", e)
+            try:
+                self._error(handler, 500, "internal",
+                            reason="internal_error", detail=repr(e))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _respond(self, handler, code: int, content_type: str,
+                 body: bytes, extra_headers: Tuple = ()):
+        handler.send_response(code)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _json(self, handler, payload: Dict, code: int = 200,
+              extra_headers: Tuple = ()):
+        self._respond(handler, code, "application/json",
+                      (json.dumps(payload, default=_json_default)
+                       + "\n").encode(), extra_headers)
+
+    def _error(self, handler, code: int, err_type: str, *,
+               reason: str = "", retry_after: Optional[float] = None,
+               detail: str = ""):
+        headers: List[Tuple[str, str]] = []
+        if code in protocol.GATEWAY_RETRYABLE_STATUS \
+                and retry_after is not None \
+                and retry_after != float("inf"):
+            headers.append(("Retry-After",
+                            str(max(1, int(-(-retry_after // 1))))))
+        body = dict(error=dict(type=err_type, reason=reason,
+                               retry_after=retry_after))
+        if detail:
+            body["error"]["detail"] = detail
+        self._json(handler, body, code=code,
+                   extra_headers=tuple(headers))
+
+    # -- routing --------------------------------------------------------
+    def _route_get(self, handler):
+        path = handler.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/healthz":
+            state = "DRAINING" if self._draining else "RUNNING"
+            self._json(handler, dict(state=state,
+                                     process=self.process_name),
+                       code=503 if self._draining else 200)
+        elif path == "/gateway/tenants":
+            self._json(handler, self.policy.tenants_snapshot())
+        elif path == "/gateway/stats":
+            self._json(handler, dict(
+                gateway=dict(self.stats),
+                policy=dict(self.policy.stats),
+                brownout_level=self.policy.brownout.level))
+        else:
+            self._respond(handler, 404, "text/plain",
+                          b"unknown path (have: /v1/completions "
+                          b"/gateway/tenants /gateway/stats "
+                          b"/healthz)\n")
+
+    def _route_post(self, handler):
+        path = handler.path.split("?", 1)[0].rstrip("/")
+        if path != "/v1/completions":
+            self._respond(handler, 404, "text/plain",
+                          b"unknown path (POST /v1/completions)\n")
+            return
+        self._handle_completion(handler)
+
+    # -- the completions endpoint --------------------------------------
+    def _read_body(self, handler) -> Optional[Dict]:
+        try:
+            length = int(handler.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            length = -1
+        if length <= 0:
+            self._error(handler, 400, "invalid_request",
+                        reason="missing_body")
+            return None
+        if length > MAX_BODY_BYTES:
+            self._error(handler, 413, "invalid_request",
+                        reason="body_too_large")
+            return None
+        raw = handler.rfile.read(length)
+        try:
+            body = json.loads(raw)
+        except ValueError:
+            self._error(handler, 400, "invalid_request",
+                        reason="malformed_json")
+            return None
+        if not isinstance(body, dict):
+            self._error(handler, 400, "invalid_request",
+                        reason="malformed_json")
+            return None
+        return body
+
+    def _prompt_tokens(self, handler,
+                       body: Dict) -> Optional[np.ndarray]:
+        prompt = body.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            return self._encode(prompt)
+        if isinstance(prompt, list) and prompt \
+                and all(isinstance(t, int) for t in prompt):
+            return np.asarray(prompt, np.int32)
+        self._error(handler, 400, "invalid_request",
+                    reason="missing_prompt",
+                    detail="prompt must be a non-empty string or a "
+                           "list of token ids")
+        return None
+
+    def _handle_completion(self, handler):
+        body = self._read_body(handler)
+        if body is None:
+            return
+        tenant = str(body.get("user")
+                     or handler.headers.get("X-Tenant") or "anon")
+        slo = str(body.get("slo") or protocol.GATEWAY_SLO_INTERACTIVE)
+        if slo not in protocol.GATEWAY_SLO_CLASSES:
+            self._error(handler, 400, "invalid_request",
+                        reason="unknown_slo_class",
+                        detail=f"have: {sorted(protocol.GATEWAY_SLO_CLASSES)}")
+            return
+        prompt = self._prompt_tokens(handler, body)
+        if prompt is None:
+            return
+        if self._draining:
+            self._error(
+                handler,
+                protocol.gateway_status(protocol.REJECTED,
+                                        protocol.REASON_DRAINING),
+                "overloaded", reason=protocol.REASON_DRAINING,
+                retry_after=30.0)
+            return
+        max_new = body.get("max_tokens")
+        max_new = int(max_new) if max_new is not None else None
+        deadline_secs = body.get("deadline_secs")
+        now = self._clock()
+        deadline = (now + float(deadline_secs)
+                    if deadline_secs is not None else None)
+
+        verdict = self.policy.admit(tenant, slo, deadline=deadline,
+                                    max_new_tokens=max_new)
+        if not verdict.accepted:
+            # the shed reply is this request's exactly-once terminal:
+            # nothing was submitted, nothing else will ever answer it
+            self._error(
+                handler,
+                protocol.gateway_status(protocol.REJECTED,
+                                        verdict.reason),
+                "overloaded", reason=verdict.reason,
+                retry_after=verdict.retry_after)
+            return
+
+        ttl = None
+        if verdict.deadline is not None:
+            ttl = max(0.001, verdict.deadline - now)
+        client = self._checkout()
+        try:
+            from realhf_tpu.serving.request_queue import Priority
+            rid = client.submit(prompt,
+                                priority=Priority(verdict.priority),
+                                ttl=ttl)
+            if bool(body.get("stream", True)):
+                self._stream_response(handler, client, rid, tenant,
+                                      slo, now)
+            else:
+                self._json_response(handler, client, rid, tenant,
+                                    slo, now, prompt)
+        finally:
+            self._checkin(client)
+
+    # -- response paths -------------------------------------------------
+    def _sse_event(self, wfile, kind: str, data: Dict):
+        wfile.write(sse_format(kind, data))
+
+    def _event_stream(self, client, rid: str):
+        """``(kind, data)`` events up to the terminal.
+        ``RolloutClient.stream`` when the client has one; a
+        terminal-only client (``ShardedRolloutClient``) degrades to a
+        single terminal event -- the SSE contract (one declared
+        terminal, then ``[DONE]``) holds either way."""
+        stream = getattr(client, "stream", None)
+        if stream is not None:
+            yield from stream(rid, timeout=self.stream_timeout)
+            return
+        result = client.result(rid, timeout=self.stream_timeout)
+        yield result.status, result.data
+
+    @staticmethod
+    def _abandon(client, rid: str):
+        getattr(client, "abandon", client.cancel)(rid)
+
+    def _account_terminal(self, tenant: str, slo: str, kind: str,
+                          started: float):
+        self.stats["terminals"] += 1
+        obs_metrics.inc("serving_gateway_terminals_total", kind=kind)
+        obs_metrics.observe_hist("serving_gateway_latency_seconds",
+                                 self._clock() - started, slo=slo)
+
+    def _stream_response(self, handler, client, rid: str, tenant: str,
+                         slo: str, started: float):
+        self.stats["streams"] += 1
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-store")
+        # no Content-Length: connection close delimits the stream
+        handler.end_headers()
+        terminal = None
+        try:
+            for kind, data in self._event_stream(client, rid):
+                self._sse_event(handler.wfile, kind, data)
+                if kind in protocol.TERMINAL_KINDS:
+                    terminal = kind
+        except TimeoutError:
+            # the wire went quiet past the stream budget: close the
+            # request with an explicit declared terminal instead of a
+            # socket that silently vanishes
+            self._abandon(client, rid)
+            terminal = protocol.EXPIRED
+            self._sse_event(handler.wfile, protocol.EXPIRED, {})
+        except BrokenPipeError:
+            # user hung up: cancel server-side work and suppress late
+            # events; the HTTP stream needs no terminal (no reader)
+            self._abandon(client, rid)
+            self._account_terminal(tenant, slo, protocol.CANCELLED,
+                                   started)
+            raise
+        handler.wfile.write(SSE_DONE_SENTINEL)
+        handler.close_connection = True
+        self._account_terminal(tenant, slo,
+                               terminal or protocol.EXPIRED, started)
+
+    def _json_response(self, handler, client, rid: str, tenant: str,
+                       slo: str, started: float,
+                       prompt: np.ndarray):
+        try:
+            result = client.result(rid, timeout=self.stream_timeout)
+            kind, data = result.status, result.data
+        except TimeoutError:
+            self._abandon(client, rid)
+            kind, data = protocol.EXPIRED, {}
+        status = protocol.gateway_status(kind, data.get("reason"))
+        self._account_terminal(tenant, slo, kind, started)
+        if kind != protocol.DONE:
+            self._error(handler, status, "terminal", reason=str(
+                data.get("reason") or kind),
+                retry_after=data.get("retry_after"))
+            return
+        tokens = list(np.asarray(data.get("tokens", ())).tolist())
+        self._json(handler, dict(
+            id=rid, object="text_completion", model=self.model_name,
+            choices=[dict(
+                index=0, tokens=tokens,
+                finish_reason="length" if data.get("no_eos")
+                else "stop")],
+            usage=dict(prompt_tokens=int(len(prompt)),
+                       completion_tokens=len(tokens),
+                       total_tokens=int(len(prompt)) + len(tokens)),
+            weight_version=data.get("weight_version"),
+        ), code=status)
+
+
+def _byte_level_encode(text: str) -> np.ndarray:
+    """Tokenizer-free prompt encoding: UTF-8 bytes as token ids.
+    Deployments with a real tokenizer inject their own ``encode``."""
+    return np.frombuffer(text.encode("utf-8"),
+                         dtype=np.uint8).astype(np.int32)
+
+
+# ----------------------------------------------------------------------
+# Deployment helpers (apps/main.run_serve wiring)
+# ----------------------------------------------------------------------
+def gateway_http_key(experiment_name: str, trial_name: str,
+                     name: str = "gateway/0") -> str:
+    """name_resolve key the gateway's HTTP address is published
+    under (the front-door analog of ``rollout_server_key``)."""
+    from realhf_tpu.base import names
+    return (names.trial_root(experiment_name, trial_name)
+            + f"/gateway_http/{name}")
+
+
+def telemetry_metrics_fetch(experiment_name: str, trial_name: str,
+                            worker_name: str,
+                            timeout: float = 5.0
+                            ) -> Callable[[], Optional[str]]:
+    """A :class:`RouterLoadProbe` fetcher reading ``worker_name``'s
+    ``/metrics`` telemetry endpoint through ``names.telemetry`` --
+    the same path the run_serve autoscaler scrapes."""
+    def fetch() -> Optional[str]:
+        import urllib.request
+
+        from realhf_tpu.base import name_resolve, names
+        addr = name_resolve.get(names.telemetry(
+            experiment_name, trial_name, worker_name))
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=timeout) as r:
+            return r.read().decode("utf-8", "replace")
+    return fetch

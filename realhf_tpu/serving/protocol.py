@@ -74,6 +74,15 @@ REASON_ROUTER_DRAIN = "router_drain"
 REASON_DRAIN_DEADLINE = "drain_deadline"
 # sharded-client give-up after too many wrong_owner bounces
 REASON_RING_UNSTABLE = "ring_unstable"
+# HTTP gateway admission verdicts (serving/gateway.py): per-tenant
+# token-bucket exhaustion, deadline-infeasibility shedding (the
+# request cannot finish before its deadline given queue depth and the
+# latency p95), and brownout-ladder load shedding under sustained
+# overload. All three fire BEFORE dispatch -- the router never sees
+# the rid, and the HTTP error reply is the exactly-once terminal.
+REASON_QUOTA = "quota"
+REASON_DEADLINE_UNMEETABLE = "deadline_unmeetable"
+REASON_BROWNOUT = "brownout"
 
 # failover ``why`` strings (router._fail_assignment -> ``retrying``)
 WHY_REREGISTERED = "re-registered"
@@ -91,6 +100,7 @@ REJECT_REASONS = frozenset({
     REASON_DRAINING, REASON_EXPIRED, REASON_PROMPT_TOO_LONG,
     REASON_WEIGHTS_BEHIND, REASON_BACKPRESSURE, REASON_FILL_FAILED,
     REASON_KV_OOM, REASON_NO_HEALTHY_REPLICA, REASON_RING_UNSTABLE,
+    REASON_QUOTA, REASON_DEADLINE_UNMEETABLE, REASON_BROWNOUT,
 })
 RETRY_REASONS = frozenset({
     WHY_REREGISTERED, WHY_LEASE_EXPIRED, WHY_WATCHDOG_LOST,
@@ -370,8 +380,40 @@ SHARD_LIFECYCLE = StateMachine(
     doc="A fenced shard sends NOTHING (fence flush is terminal-less "
         "by design); only `active` dispatches or delivers.")
 
+#: one HTTP request through the gateway front door
+#: (serving/gateway.py): the admission ladder either sheds it with an
+#: HTTP error BEFORE dispatch (that reply is its exactly-once
+#: terminal -- the router never sees the rid) or maps it onto the
+#: client-request machine via a RolloutClient submit.
+GATEWAY_REQUEST = StateMachine(
+    name="gateway-request",
+    initial="received",
+    states=("received", "dispatched", "streaming", "closed"),
+    transitions=(
+        Transition("received", "closed", label="shed",
+                   guard="admission shed before dispatch (quota / "
+                         "deadline_unmeetable / brownout / "
+                         "backpressure): the 4xx/5xx reply with "
+                         "Retry-After is the one terminal"),
+        Transition("received", "dispatched", label="dispatch",
+                   guard="admitted: submitted to the router under "
+                         "its SLO class's queue priority"),
+        Transition("dispatched", "dispatched", kind=ACCEPTED),
+        Transition("dispatched", "streaming", kind=STARTED),
+        Transition("streaming", "streaming", kind=TOKENS),
+        Transition("streaming", "dispatched", kind=RETRYING,
+                   guard="router failover restarted the token "
+                         "stream; the SSE consumer resets its "
+                         "accumulation"),
+    ) + tuple(Transition(s, "closed", kind=k)
+              for s in ("dispatched", "streaming")
+              for k in TERMINAL_KINDS),
+    doc="Consumed by GatewayServer: exactly one terminal per HTTP "
+        "request -- either the shed reply or the relayed wire "
+        "terminal, never both.")
+
 MACHINES: Tuple[StateMachine, ...] = (CLIENT_REQUEST, ROUTER_REQUEST,
-                                      SHARD_LIFECYCLE)
+                                      SHARD_LIFECYCLE, GATEWAY_REQUEST)
 
 
 def machine(name: str) -> Optional[StateMachine]:
@@ -387,3 +429,55 @@ def declared_fsm_kinds() -> FrozenSet[str]:
     for m in MACHINES:
         out |= m.kinds()
     return out
+
+
+# ----------------------------------------------------------------------
+# Gateway surface (serving/gateway.py): HTTP mapping of the wire
+# ----------------------------------------------------------------------
+#: SLO class names accepted in the gateway's ``slo`` request field,
+#: mapped onto the PR 2 admission-queue priority ints
+#: (``serving/request_queue.py`` Priority: INTERACTIVE=0, BATCH=1).
+#: ROLLOUT (2) is trainer-internal producer traffic and is NOT
+#: reachable through the front door.
+GATEWAY_SLO_INTERACTIVE = "interactive"
+GATEWAY_SLO_BATCH = "batch"
+GATEWAY_SLO_CLASSES: Dict[str, int] = {
+    GATEWAY_SLO_INTERACTIVE: 0,
+    GATEWAY_SLO_BATCH: 1,
+}
+
+#: terminal kind -> HTTP status of the gateway's reply (the
+#: non-streaming path's status line; the SSE path has already sent
+#: 200 and carries the terminal as its last event). 499 is the
+#: client-closed-request convention; 504 marks a deadline that passed
+#: after admission.
+GATEWAY_HTTP_STATUS: Dict[str, int] = {
+    DONE: 200,
+    REJECTED: 429,
+    STALE: 409,
+    EXPIRED: 504,
+    CANCELLED: 499,
+    DRAINING: 503,
+}
+
+#: reason-level overrides of the REJECTED default: client errors are
+#: 400 (retrying verbatim cannot help), capacity/lifecycle refusals
+#: are 503; everything else keeps 429 + Retry-After (pace yourself).
+GATEWAY_REJECT_STATUS: Dict[str, int] = {
+    REASON_PROMPT_TOO_LONG: 400,
+    REASON_DRAINING: 503,
+    REASON_NO_HEALTHY_REPLICA: 503,
+    REASON_RING_UNSTABLE: 503,
+}
+
+#: statuses whose reply must carry a ``Retry-After`` header
+GATEWAY_RETRYABLE_STATUS = (429, 503)
+
+assert set(GATEWAY_HTTP_STATUS) == set(TERMINAL_KINDS)
+
+
+def gateway_status(kind: str, reason: Optional[str] = None) -> int:
+    """HTTP status for one terminal ``(kind, reason)`` pair."""
+    if kind == REJECTED and reason in GATEWAY_REJECT_STATUS:
+        return GATEWAY_REJECT_STATUS[reason]
+    return GATEWAY_HTTP_STATUS.get(kind, 200)

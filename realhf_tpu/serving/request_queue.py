@@ -23,9 +23,21 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from realhf_tpu.base import logging
+from realhf_tpu.obs import metrics as obs_metrics
 from realhf_tpu.serving import protocol
 
 logger = logging.getLogger("serving.request_queue")
+
+
+def count_expired(req: "GenRequest", n: int = 1):
+    """Bump the per-class deadline-expiry counter
+    (``serving_expired_total{class}``): every path that turns a
+    request into the declared ``expired`` terminal -- queue shunt
+    here, parked/active eviction in the scheduler -- attributes the
+    loss to its admission class, so an SLO dashboard can tell
+    interactive misses from batch absorption."""
+    obs_metrics.inc("serving_expired_total", n,
+                    **{"class": Priority(req.priority).name})
 
 
 class Priority(enum.IntEnum):
@@ -151,6 +163,7 @@ class RequestQueue:
                     if req.deadline is not None and req.deadline <= now:
                         self._expired.append(req)
                         self.stats["expired"] += 1
+                        count_expired(req)
                         continue
                     self.stats["popped"] += 1
                     return req

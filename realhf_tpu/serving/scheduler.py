@@ -40,7 +40,11 @@ from realhf_tpu.base import logging
 from realhf_tpu.obs import metrics as obs_metrics
 from realhf_tpu.obs import tracing
 from realhf_tpu.serving import protocol
-from realhf_tpu.serving.request_queue import GenRequest, RequestQueue
+from realhf_tpu.serving.request_queue import (
+    GenRequest,
+    RequestQueue,
+    count_expired,
+)
 from realhf_tpu.serving.weight_sync import WeightSync
 
 logger = logging.getLogger("serving.scheduler")
@@ -168,6 +172,14 @@ class ContinuousScheduler:
         self.stats[key] += n
         obs_metrics.inc(f"serving_{key}_total", n)
 
+    def _count_expired(self, req: GenRequest):
+        """Deadline expiry keeps the ``stats`` mirror but carries the
+        admission class on the metric
+        (``serving_expired_total{class}``), matching the queue-side
+        shunt in ``request_queue.pop``."""
+        self.stats["expired"] += 1
+        count_expired(req)
+
     # ------------------------------------------------------------------
     @property
     def n_live(self) -> int:
@@ -244,7 +256,7 @@ class ContinuousScheduler:
             if (seq.req.deadline is not None
                     and seq.req.deadline <= now):
                 self._evict(int_id)
-                self._count("expired")
+                self._count_expired(seq.req)
                 events.append(ServeEvent(protocol.EXPIRED, seq.req.rid))
             elif self._is_stale(seq, version):
                 self._evict(int_id)
@@ -266,7 +278,7 @@ class ContinuousScheduler:
                     break
                 if req.deadline is not None and req.deadline <= now:
                     # expired while parked (queue.pop filters its own)
-                    self._count("expired")
+                    self._count_expired(req)
                     events.append(ServeEvent(protocol.EXPIRED, req.rid))
                     continue
                 if not self._pool_admissible(req):

@@ -322,3 +322,133 @@ class RouterWorker(worker_base.Worker):
         if getattr(self, "router", None) is not None:
             self.router.drain(timeout=self._drain_timeout)
             self.router.close()
+
+
+class GatewayWorker(worker_base.Worker):
+    """The HTTP front door in the worker stack (docs/serving.md
+    "Front door"): one :class:`~realhf_tpu.serving.gateway.
+    GatewayServer` exposing OpenAI-compatible streaming
+    ``/v1/completions`` over SSE, fronting the router plane with
+    per-tenant quotas, SLO classes, and deadline-aware shedding.
+
+    The HTTP server runs on its own daemon threads; the worker's poll
+    loop only keeps the heartbeat/watchdog plumbing fed and reports
+    request throughput. Extra commands: ``stats`` (gateway + policy +
+    brownout view), ``drain`` (refuse new admissions with 503).
+    """
+
+    def _configure(self, config: Dict):
+        from realhf_tpu.api.experiment import ExperimentSpec
+        from realhf_tpu.base import name_resolve
+        from realhf_tpu.serving.gateway import (
+            BrownoutLadder,
+            GatewayPolicy,
+            GatewayServer,
+            RouterLoadProbe,
+            gateway_http_key,
+            telemetry_metrics_fetch,
+        )
+
+        with open(config["spec_path"], "rb") as f:
+            spec: ExperimentSpec = pickle.load(f)
+        self.spec = spec
+        constants.set_experiment_trial_names(spec.experiment_name,
+                                             spec.trial_name)
+        sv = spec.serving
+        if sv is None:
+            raise ValueError(
+                "GatewayWorker needs ExperimentSpec.serving (see "
+                "experiments/serve_exp.py).")
+
+        # one RolloutClient-shaped backend per pooled connection:
+        # sharded plane -> ShardedRolloutClient (ring discovery +
+        # failover), fleet -> the router, single server -> direct
+        fleet = bool(sv.fleet_router)
+        sharded = fleet and getattr(sv, "n_routers", 1) > 1
+        if sharded:
+            from realhf_tpu.serving.fleet import FleetRegistry
+            from realhf_tpu.serving.router_shard import (
+                ShardedRolloutClient,
+            )
+
+            def client_factory():
+                return ShardedRolloutClient(FleetRegistry(
+                    spec.experiment_name, spec.trial_name,
+                    lease_ttl=sv.lease_ttl_secs))
+        else:
+            from realhf_tpu.serving.server import RolloutClient
+            upstream = "router/0" if fleet else "rollout/0"
+
+            def client_factory():
+                return RolloutClient(
+                    experiment_name=spec.experiment_name,
+                    trial_name=spec.trial_name,
+                    server_name=upstream)
+
+        # the shed decision reads the router plane's own telemetry
+        # (queue depth gauges + latency p95) -- no new signal path
+        load_probe = None
+        if fleet:
+            load_probe = RouterLoadProbe(
+                telemetry_metrics_fetch(spec.experiment_name,
+                                        spec.trial_name, "router/0"),
+                n_slots=sv.n_servers * sv.n_slots)
+        policy = GatewayPolicy(
+            tenants=dict(sv.gateway_tenants),
+            default_rate=sv.gateway_tenant_rate,
+            default_burst=sv.gateway_tenant_burst,
+            interactive_slo_secs=sv.gateway_interactive_slo_secs,
+            batch_slo_secs=sv.gateway_batch_slo_secs,
+            trim_max_new_tokens=sv.gateway_trim_max_new_tokens,
+            load_probe=load_probe,
+            brownout=BrownoutLadder())
+        self.gateway = GatewayServer(
+            client_factory, policy=policy,
+            port=sv.gateway_port, process_name=self.worker_name,
+            stream_timeout=sv.gateway_stream_timeout_secs).start()
+        name_resolve.add(
+            gateway_http_key(spec.experiment_name, spec.trial_name,
+                             self.worker_name),
+            self.gateway.address, replace=True)
+        self._drain_timeout = sv.drain_timeout_secs
+        self._last_requests = 0
+        logger.info("Gateway %s serving on %s (fleet=%s sharded=%s).",
+                    self.worker_name, self.gateway.address, fleet,
+                    sharded)
+        return dict(address=self.gateway.address)
+
+    def _poll(self) -> worker_base.PollResult:
+        n = self.gateway.stats["http_requests"] - self._last_requests
+        self._last_requests += n
+        return worker_base.PollResult(sample_count=n,
+                                      batch_count=1 if n else 0)
+
+    def _handle_command(self, cmd: str, kwargs: Dict) -> Any:
+        if cmd == "stats":
+            return dict(gateway=dict(self.gateway.stats),
+                        policy=dict(self.gateway.policy.stats),
+                        brownout_level=self.gateway.policy.brownout
+                        .level)
+        if cmd == "drain":
+            self.gateway.start_drain()
+            return dict(self.gateway.stats)
+        return super()._handle_command(cmd, kwargs)
+
+    def _health_extra(self) -> Dict:
+        gw = getattr(self, "gateway", None)
+        if gw is None:
+            return {}
+        return dict(draining=bool(gw._draining),
+                    http_requests=gw.stats["http_requests"],
+                    streams=gw.stats["streams"],
+                    brownout_level=gw.policy.brownout.level)
+
+    def _preempt_hook(self, grace: float):
+        logger.warning("Gateway %s preempted: refusing new "
+                       "admissions.", self.worker_name)
+        self.gateway.start_drain()
+
+    def _exit_hook(self):
+        if getattr(self, "gateway", None) is not None:
+            self.gateway.start_drain()
+            self.gateway.stop()

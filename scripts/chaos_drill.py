@@ -649,8 +649,139 @@ def router_kill_scenario(scale: float = 1.0):
     return fleet, requests, schedule
 
 
+def run_gateway_overload(scale: float = 1.0,
+                         max_ticks: int = 5000) -> dict:
+    """Gateway-overload drill (docs/serving.md "Front door"): a
+    ``GatewayPolicy`` on the drill's fake clock fronts the real
+    2-replica fleet while a 2x-overload submit schedule hammers it.
+    Invariants:
+
+    1. A shed request's HTTP reject IS its one and only terminal --
+       and it NEVER reaches a replica (zero upstream submissions).
+    2. Every admitted request reaches exactly one wire terminal, and
+       every replica delivery belongs to an admitted rid.
+    3. The drill actually exercised the shed paths: quota AND
+       overload (brownout/deadline) sheds both fired, and the
+       brownout ladder climbed.
+    """
+    from realhf_tpu.serving import gateway as gw
+    from realhf_tpu.serving import protocol
+    from realhf_tpu.serving.request_queue import Priority
+
+    n_req = max(20, int(60 * scale))
+    fleet = DrillFleet(n_replicas=2, n_slots=2, chunk=4, dt=0.05)
+    client = fleet.client()
+    outstanding: Dict[str, int] = {}  # admitted rid -> priority
+
+    def probe():
+        by_class: Dict[int, int] = {}
+        for prio in outstanding.values():
+            by_class[prio] = by_class.get(prio, 0) + 1
+        return gw.LoadSnapshot(queue_depth=len(outstanding),
+                               n_slots=4, p95_secs=1.0,
+                               depth_by_class=by_class)
+
+    policy = gw.GatewayPolicy(
+        # one abusive tenant exercises the quota shed even while the
+        # fleet still has room
+        tenants=dict(flood=dict(rate=0.0, burst=2.0)),
+        default_rate=1000.0, default_burst=1000.0,
+        interactive_slo_secs=2.0, batch_slo_secs=8.0,
+        load_probe=probe,
+        brownout=gw.BrownoutLadder(sustain_secs=0.5, cool_secs=30.0,
+                                   max_level=gw.LEVEL_TRIM,
+                                   clock=fleet.clock),
+        clock=fleet.clock)
+
+    admitted: Dict[str, dict] = {}  # rid -> {tenant, slo}
+    shed: List[dict] = []  # each carries its ONE terminal: the reason
+    max_level = 0
+    tenants = ["alice", "bob", "flood"]
+
+    def terminals_of(rid):
+        return [k for k, _ in fleet.events.get(rid, [])
+                if k in TERMINAL_KINDS]
+
+    i = 0
+    last_submit_tick = 0
+    for tick in range(max_ticks):
+        # 2 submissions per tick vs ~0.7/tick fleet capacity: a
+        # sustained >2x overload on the fake clock
+        for _ in range(2):
+            if tick < 2 or i >= n_req:
+                break
+            tenant = tenants[i % len(tenants)]
+            slo = (protocol.GATEWAY_SLO_INTERACTIVE if i % 2 == 0
+                   else protocol.GATEWAY_SLO_BATCH)
+            v = policy.admit(tenant, slo)
+            if v.accepted:
+                rid = client.submit(
+                    np.array([16, 3, 5], np.int32),
+                    priority=Priority(v.priority),
+                    ttl=(v.deadline - fleet.clock.t
+                         if v.deadline is not None else None))
+                admitted[rid] = dict(tenant=tenant, slo=slo)
+                outstanding[rid] = v.priority
+            else:
+                shed.append(dict(tenant=tenant, slo=slo,
+                                 terminals=[v.reason]))
+            i += 1
+            last_submit_tick = tick
+        fleet.step()
+        max_level = max(max_level, policy.brownout.level)
+        for rid in list(outstanding):
+            if terminals_of(rid):
+                del outstanding[rid]
+        if i >= n_req and tick > last_submit_tick \
+                and all(terminals_of(r) for r in admitted):
+            break
+
+    fleet.close()
+
+    terminals = {r: terminals_of(r) for r in admitted}
+    delivered_rids = {d.rid for d in fleet.all_deliveries()}
+    shed_reasons: Dict[str, int] = {}
+    for s in shed:
+        shed_reasons[s["terminals"][0]] = \
+            shed_reasons.get(s["terminals"][0], 0) + 1
+    problems = []
+    bad_admitted = {r: ts for r, ts in terminals.items()
+                    if len(ts) != 1}
+    if bad_admitted:
+        problems.append(
+            f"admitted without exactly one terminal: {bad_admitted}")
+    if any(len(s["terminals"]) != 1 for s in shed):
+        problems.append("a shed request grew a second terminal")
+    # nothing shed ever reached the wire or a replica: submissions
+    # happen only on admit, and every delivery maps to an admitted rid
+    if len(admitted) + len(shed) != n_req:
+        problems.append("request accounting does not add up")
+    leaked = delivered_rids - set(admitted)
+    if leaked:
+        problems.append(f"replica deliveries for unadmitted rids: "
+                        f"{sorted(leaked)}")
+    if shed_reasons.get(protocol.REASON_QUOTA, 0) < 1:
+        problems.append("quota shed never fired")
+    if (shed_reasons.get(protocol.REASON_BROWNOUT, 0)
+            + shed_reasons.get(
+                protocol.REASON_DEADLINE_UNMEETABLE, 0)) < 1:
+        problems.append("overload shed never fired")
+    if max_level < 1:
+        problems.append("brownout ladder never climbed")
+    outcomes: Dict[str, int] = {}
+    for ts in terminals.values():
+        for k in ts:
+            outcomes[k] = outcomes.get(k, 0) + 1
+    return dict(ok=not problems, n_requests=n_req,
+                admitted=len(admitted), shed=len(shed),
+                shed_reasons=shed_reasons, outcomes=outcomes,
+                max_brownout_level=max_level,
+                problems=problems)
+
+
 SCENARIOS = dict(standard=standard_scenario, churn=churn_scenario,
-                 router_kill=router_kill_scenario)
+                 router_kill=router_kill_scenario,
+                 gateway_overload=run_gateway_overload)
 
 
 def main(argv=None) -> int:
@@ -663,6 +794,17 @@ def main(argv=None) -> int:
                     help="print the full report as JSON")
     args = ap.parse_args(argv)
     metrics.reset_default()
+    if args.scenario == "gateway_overload":
+        # self-contained runner: the gateway fronts the fleet, so the
+        # generic request/schedule replay does not apply
+        out = run_gateway_overload(scale=args.scale,
+                                   max_ticks=args.max_ticks)
+        print(json.dumps(out, indent=2, default=str))
+        if not out["ok"]:
+            print("GATEWAY_OVERLOAD FAILED: "
+                  + "; ".join(out["problems"]), file=sys.stderr)
+            return 1
+        return 0
     fleet, requests, schedule = SCENARIOS[args.scenario](
         scale=args.scale)
     try:

@@ -40,6 +40,7 @@ REQUIRED_DIRS = (
     "tests/base",
     "tests/chaos",
     "tests/engine",
+    "tests/gateway",
     "tests/observability",
     "tests/ops",
     "tests/parallel",

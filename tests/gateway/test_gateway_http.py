@@ -1,0 +1,323 @@
+"""The front door end to end over real HTTP (serving/gateway.py
+GatewayServer) against a scripted in-process client: SSE happy path,
+429/Retry-After propagation from queue backpressure, draining, the
+quota surfaces, and the exactly-one-terminal invariant. The
+sustained-overload scenario is slow-marked."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from realhf_tpu.serving import gateway, protocol
+from realhf_tpu.serving.gateway import (
+    BrownoutLadder,
+    GatewayPolicy,
+    GatewayServer,
+    LoadSnapshot,
+)
+
+
+class FakeRolloutClient:
+    """RolloutClient-shaped stub: scripted event streams, submission
+    ledger (a shed request must never appear here)."""
+
+    def __init__(self, script=None):
+        # rid -> list of (kind, data); default: a 2-token completion
+        self.script = script or {}
+        self.submitted = []
+        self.abandoned = []
+        self.closed = False
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def default_events(self):
+        return [
+            (protocol.ACCEPTED, dict(queue_depth=0)),
+            (protocol.STARTED, dict(weight_version=1)),
+            (protocol.TOKENS, dict(tokens=[7, 8], offset=0)),
+            (protocol.DONE, dict(tokens=[7, 8], no_eos=False,
+                                 weight_version=1)),
+        ]
+
+    def submit(self, prompt, priority=None, ttl=None, **kw):
+        with self._lock:
+            rid = f"rid{self._n}"
+            self._n += 1
+            self.submitted.append(dict(rid=rid, prompt=list(prompt),
+                                       priority=int(priority),
+                                       ttl=ttl))
+        return rid
+
+    def stream(self, rid, timeout=None):
+        yield from self.script.get(rid, self.default_events())
+
+    def result(self, rid, timeout=None):
+        events = self.script.get(rid, self.default_events())
+        kind, data = events[-1]
+
+        class R:
+            pass
+
+        r = R()
+        r.rid, r.status, r.data = rid, kind, data
+        return r
+
+    def abandon(self, rid):
+        self.abandoned.append(rid)
+
+    def cancel(self, rid):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture()
+def front(request):
+    client = FakeRolloutClient()
+    srv = GatewayServer(lambda: client).start()
+    yield srv, client
+    srv.stop()
+
+
+def _post(port, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json",
+                 **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_sse_happy_path_has_exactly_one_terminal(front):
+    srv, client = front
+    code, headers, body = _post(srv.port, dict(
+        prompt="hello", user="t1", stream=True))
+    assert code == 200
+    assert headers["Content-Type"].startswith("text/event-stream")
+    events = gateway.sse_parse(body)
+    kinds = [e for e, _ in events]
+    assert kinds[:2] == [protocol.ACCEPTED, protocol.STARTED]
+    terminals = [k for k in kinds if k in protocol.TERMINAL_KINDS]
+    assert terminals == [protocol.DONE]
+    assert events[-1] == ("", "[DONE]")
+    assert len(client.submitted) == 1
+
+
+def test_non_stream_json_response(front):
+    srv, client = front
+    code, _, body = _post(srv.port, dict(
+        prompt=[1, 2, 3], user="t1", stream=False))
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["object"] == "text_completion"
+    assert doc["choices"][0]["tokens"] == [7, 8]
+    assert doc["usage"]["prompt_tokens"] == 3
+    assert client.submitted[0]["prompt"] == [1, 2, 3]
+
+
+def test_queue_backpressure_terminal_becomes_429_retry_after(front):
+    srv, client = front
+    client.script["rid0"] = [
+        (protocol.REJECTED, dict(reason="backpressure",
+                                 retry_after=3.2))]
+    code, headers, body = _post(srv.port, dict(
+        prompt="x", user="t1", stream=False))
+    assert code == 429
+    assert headers["Retry-After"] == "4"  # ceil(3.2)
+    assert json.loads(body)["error"]["reason"] == "backpressure"
+
+
+def test_shed_request_never_reaches_the_wire(front):
+    srv, client = front
+    srv.policy._tenant_cfg["flood"] = dict(rate=0.0, burst=1)
+    ok, _, _ = _post(srv.port, dict(prompt="a", user="flood",
+                                    stream=False))
+    assert ok == 200
+    code, headers, body = _post(srv.port, dict(
+        prompt="a", user="flood", stream=False))
+    assert code == 429
+    assert json.loads(body)["error"]["reason"] == protocol.REASON_QUOTA
+    # the shed reply was the request's ONLY terminal: nothing was
+    # submitted upstream for it
+    assert len(client.submitted) == 1
+
+
+def test_slo_class_maps_to_queue_priority(front):
+    srv, client = front
+    _post(srv.port, dict(prompt="a", user="t",
+                         slo=protocol.GATEWAY_SLO_INTERACTIVE,
+                         stream=False))
+    _post(srv.port, dict(prompt="a", user="t",
+                         slo=protocol.GATEWAY_SLO_BATCH,
+                         stream=False))
+    assert client.submitted[0]["priority"] == 0
+    assert client.submitted[1]["priority"] == 1
+    # the SLO budget became a wire TTL so queue-side deadline expiry
+    # covers admitted requests too
+    assert client.submitted[0]["ttl"] == pytest.approx(
+        srv.policy.interactive_slo_secs, abs=0.5)
+
+
+def test_draining_gateway_answers_503(front):
+    srv, client = front
+    srv.start_drain()
+    code, headers, body = _post(srv.port, dict(prompt="a", user="t"))
+    assert code == 503
+    assert json.loads(body)["error"]["reason"] \
+        == protocol.REASON_DRAINING
+    assert "Retry-After" in headers
+    assert client.submitted == []
+    code, doc = _get(srv.port, "/gateway/stats")
+    assert code == 200
+
+
+def test_bad_requests_are_400(front):
+    srv, _ = front
+    for body in (dict(user="t"), dict(prompt="", user="t"),
+                 dict(prompt="x", slo="platinum")):
+        code, _, _ = _post(srv.port, body)
+        assert code == 400
+
+
+def test_tenant_and_stats_surfaces(front):
+    srv, _ = front
+    _post(srv.port, dict(prompt="a", user="alice", stream=False))
+    _post(srv.port, dict(prompt="a", user="bob", stream=False))
+    code, tenants = _get(srv.port, "/gateway/tenants")
+    assert code == 200 and set(tenants) == {"alice", "bob"}
+    assert tenants["alice"]["available"] < tenants["alice"]["burst"]
+    _, stats = _get(srv.port, "/gateway/stats")
+    assert stats["policy"]["admitted"] == 2
+    assert stats["gateway"]["terminals"] == 2
+
+
+def test_stream_timeout_closes_with_expired_terminal():
+    class SilentClient(FakeRolloutClient):
+        def stream(self, rid, timeout=None):
+            yield protocol.ACCEPTED, dict(queue_depth=0)
+            raise TimeoutError(rid)
+
+    client = SilentClient()
+    srv = GatewayServer(lambda: client).start()
+    try:
+        _, _, body = _post(srv.port, dict(prompt="x", user="t",
+                                          stream=True))
+        kinds = [e for e, _ in gateway.sse_parse(body)]
+        terminals = [k for k in kinds
+                     if k in protocol.TERMINAL_KINDS]
+        assert terminals == [protocol.EXPIRED]
+        assert client.abandoned == ["rid0"]
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_sustained_overload_sheds_batch_protects_interactive():
+    """Sustained 2x overload end to end over HTTP: the brownout
+    ladder climbs, batch absorbs the loss, interactive keeps being
+    admitted, and every request -- shed or served -- gets exactly
+    one terminal. Real wall clock drives the ladder (slow-marked);
+    the probe snapshot is scripted so the pressure phases are
+    deterministic."""
+    import time
+
+    snap = {"s": LoadSnapshot(queue_depth=0, n_slots=2,
+                              p95_secs=0.1)}
+    policy = GatewayPolicy(
+        interactive_slo_secs=0.5, batch_slo_secs=60.0,
+        default_rate=1000.0, default_burst=1000.0,
+        load_probe=lambda: snap["s"],
+        # interactive-last, made absolute: cap the ladder below
+        # SHED_ALL so sustained pressure can never shed interactive
+        brownout=BrownoutLadder(sustain_secs=0.1, cool_secs=60.0,
+                                max_level=gateway.LEVEL_TRIM))
+    client = FakeRolloutClient()
+    srv = GatewayServer(lambda: client, policy=policy).start()
+    results = []
+    lock = threading.Lock()
+
+    def fire(slo, **extra):
+        code, _, body = _post(srv.port, dict(
+            prompt="x", user=f"{slo}-tenant", slo=slo, stream=True,
+            **extra))
+        if code == 200:
+            kinds = [e for e, _ in gateway.sse_parse(body)]
+            terms = [k for k in kinds
+                     if k in protocol.TERMINAL_KINDS]
+        else:
+            terms = [json.loads(body)["error"]["reason"]]
+        with lock:
+            results.append((slo, code, terms))
+
+    try:
+        # -- phase 1: 2x-sustained overload. A 40-deep backlog over
+        # 2 slots at p95=0.1s means ~2.1s estimated wait -- 4x the
+        # interactive SLO -- held across repeated admissions long
+        # enough for the ladder to climb past SHED_BATCH. Explicit
+        # generous deadlines isolate the ladder from the deadline
+        # gate.
+        snap["s"] = LoadSnapshot(queue_depth=40, n_slots=2,
+                                 p95_secs=0.1)
+        n_phase1 = 0
+        deadline = time.monotonic() + 10.0
+        while policy.brownout.level < gateway.LEVEL_SHED_BATCH:
+            assert time.monotonic() < deadline, \
+                "ladder never climbed under scripted overload"
+            fire(protocol.GATEWAY_SLO_INTERACTIVE, deadline_secs=30)
+            n_phase1 += 1
+            time.sleep(0.06)
+        assert policy.brownout.level >= gateway.LEVEL_SHED_BATCH
+
+        # -- phase 2: mixed traffic under the established brownout
+        threads = []
+        for _ in range(20):
+            for slo in (protocol.GATEWAY_SLO_INTERACTIVE,
+                        protocol.GATEWAY_SLO_BATCH):
+                t = threading.Thread(
+                    target=fire, args=(slo,),
+                    kwargs=dict(deadline_secs=30))
+                t.start()
+                threads.append(t)
+        for t in threads:
+            t.join(30)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        srv.stop()
+
+    # exactly one terminal (an HTTP reject IS the terminal) per
+    # request, shed or served
+    assert all(len(terms) == 1 for _, _, terms in results)
+    phase2 = results[n_phase1:]
+    by_slo = {s: [r for r in phase2 if r[0] == s]
+              for s in (protocol.GATEWAY_SLO_INTERACTIVE,
+                        protocol.GATEWAY_SLO_BATCH)}
+    inter_ok = sum(1 for _, c, _ in by_slo["interactive"]
+                   if c == 200)
+    batch_ok = sum(1 for _, c, _ in by_slo["batch"] if c == 200)
+    batch_shed = [r for r in by_slo["batch"] if r[1] != 200]
+    # batch absorbs the loss; interactive keeps flowing
+    assert batch_shed and len(batch_shed) == 20 - batch_ok
+    assert all(terms == [protocol.REASON_BROWNOUT]
+               for _, _, terms in batch_shed)
+    assert inter_ok > batch_ok
+    # goodput beats a no-QoS front door that admits nothing under
+    # the same overload verdict: served interactive requests > 0
+    assert inter_ok > 0
+    # nothing shed ever reached the wire: one submission per 200
+    n_200 = sum(1 for _, c, _ in results if c == 200)
+    assert len(client.submitted) == n_200
+    assert len(client.submitted) < len(results)

@@ -1,0 +1,184 @@
+"""The shed decision table (serving/gateway.py GatewayPolicy) and
+the brownout ladder, driven on a fake clock with a stubbed load
+probe -- every verdict's reason is one of the declared
+``protocol.REJECT_REASONS``."""
+
+import pytest
+
+from realhf_tpu.serving import protocol
+from realhf_tpu.serving.gateway import (
+    LEVEL_NORMAL,
+    LEVEL_SHED_ALL,
+    LEVEL_SHED_BATCH,
+    LEVEL_TRIM,
+    BrownoutLadder,
+    GatewayPolicy,
+    LoadSnapshot,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_policy(clk, *, load=None, ladder=None, **kw):
+    snap = load or LoadSnapshot(queue_depth=0, n_slots=4,
+                                p95_secs=0.1)
+    return GatewayPolicy(
+        load_probe=lambda: snap,
+        brownout=ladder or BrownoutLadder(clock=clk),
+        clock=clk, **kw)
+
+
+def test_idle_interactive_is_admitted_with_slo_deadline():
+    clk = FakeClock(100.0)
+    p = make_policy(clk, interactive_slo_secs=2.0)
+    v = p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE)
+    assert v.accepted
+    assert v.priority == 0
+    assert v.deadline == pytest.approx(102.0)
+
+
+def test_batch_maps_to_lower_priority_class():
+    clk = FakeClock()
+    p = make_policy(clk)
+    v = p.admit("t1", protocol.GATEWAY_SLO_BATCH)
+    assert v.accepted and v.priority == 1
+
+
+def test_quota_exhaustion_sheds_with_declared_reason():
+    clk = FakeClock()
+    p = make_policy(clk, tenants={"greedy": dict(rate=1.0, burst=2)})
+    assert p.admit("greedy", protocol.GATEWAY_SLO_BATCH).accepted
+    assert p.admit("greedy", protocol.GATEWAY_SLO_BATCH).accepted
+    v = p.admit("greedy", protocol.GATEWAY_SLO_BATCH)
+    assert not v.accepted
+    assert v.reason == protocol.REASON_QUOTA
+    assert v.reason in protocol.REJECT_REASONS
+    assert v.retry_after == pytest.approx(1.0)
+
+
+def test_quota_is_per_tenant():
+    clk = FakeClock()
+    p = make_policy(clk, tenants={"greedy": dict(rate=1.0, burst=1)})
+    assert p.admit("greedy", protocol.GATEWAY_SLO_BATCH).accepted
+    assert not p.admit("greedy", protocol.GATEWAY_SLO_BATCH).accepted
+    # an unrelated tenant still has its full default burst
+    assert p.admit("polite", protocol.GATEWAY_SLO_BATCH).accepted
+
+
+def test_unmeetable_deadline_is_shed_before_dispatch():
+    clk = FakeClock()
+    # 40 queued at p95=1s over 4 slots -> ~11s estimated wait
+    p = make_policy(clk, load=LoadSnapshot(queue_depth=40, n_slots=4,
+                                           p95_secs=1.0))
+    v = p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE,
+                deadline=clk() + 2.0)
+    assert not v.accepted
+    assert v.reason == protocol.REASON_DEADLINE_UNMEETABLE
+    assert v.retry_after is not None and v.retry_after > 0
+
+
+def test_generous_deadline_rides_out_backlog():
+    clk = FakeClock()
+    p = make_policy(clk, load=LoadSnapshot(queue_depth=40, n_slots=4,
+                                           p95_secs=1.0))
+    v = p.admit("t1", protocol.GATEWAY_SLO_BATCH,
+                deadline=clk() + 60.0)
+    assert v.accepted
+
+
+def test_brownout_sheds_batch_first_interactive_last():
+    clk = FakeClock()
+    ladder = BrownoutLadder(clock=clk)
+    ladder.level = LEVEL_SHED_BATCH
+    # generous deadlines so only the ladder can shed
+    p = make_policy(clk, ladder=ladder, batch_slo_secs=1e6,
+                    interactive_slo_secs=1e6)
+    vb = p.admit("t1", protocol.GATEWAY_SLO_BATCH)
+    assert not vb.accepted and vb.reason == protocol.REASON_BROWNOUT
+    assert p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE).accepted
+    ladder.level = LEVEL_SHED_ALL
+    vi = p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE)
+    assert not vi.accepted and vi.reason == protocol.REASON_BROWNOUT
+
+
+def test_trim_level_caps_max_new_tokens():
+    clk = FakeClock()
+    ladder = BrownoutLadder(clock=clk)
+    ladder.level = LEVEL_TRIM
+    p = make_policy(clk, ladder=ladder, trim_max_new_tokens=16,
+                    interactive_slo_secs=1e6)
+    v = p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE,
+                max_new_tokens=512)
+    assert v.accepted and v.max_new_tokens == 16
+    # an already-short request is not inflated
+    v = p.admit("t1", protocol.GATEWAY_SLO_INTERACTIVE,
+                max_new_tokens=8)
+    assert v.accepted and v.max_new_tokens == 8
+
+
+def test_ladder_climbs_only_on_sustained_pressure():
+    clk = FakeClock()
+    lad = BrownoutLadder(sustain_secs=1.0, cool_secs=2.0, clock=clk)
+    assert lad.observe(5.0) == LEVEL_NORMAL  # first hot sample arms
+    clk.advance(0.5)
+    assert lad.observe(5.0) == LEVEL_NORMAL  # not sustained yet
+    clk.advance(0.6)
+    assert lad.observe(5.0) == LEVEL_SHED_BATCH
+    # a blip below the up threshold re-arms the climb
+    assert lad.observe(0.7) == LEVEL_SHED_BATCH
+    clk.advance(5.0)
+    assert lad.observe(5.0) == LEVEL_SHED_BATCH  # re-armed, not 2
+
+
+def test_ladder_cools_one_rung_at_a_time():
+    clk = FakeClock()
+    lad = BrownoutLadder(sustain_secs=1.0, cool_secs=2.0, clock=clk)
+    lad.level = LEVEL_TRIM
+    assert lad.observe(0.1) == LEVEL_TRIM  # arms the cool timer
+    clk.advance(2.5)
+    assert lad.observe(0.1) == LEVEL_SHED_BATCH
+    clk.advance(2.5)
+    assert lad.observe(0.1) == LEVEL_NORMAL
+    clk.advance(10.0)
+    assert lad.observe(0.1) == LEVEL_NORMAL  # floor
+
+
+def test_estimated_wait_scales_with_depth_and_slots():
+    idle = LoadSnapshot(queue_depth=0, n_slots=4, p95_secs=0.5)
+    busy = LoadSnapshot(queue_depth=40, n_slots=4, p95_secs=0.5)
+    assert idle.estimated_wait() == pytest.approx(0.5)
+    assert busy.estimated_wait() == pytest.approx(0.5 * 11)
+    wide = LoadSnapshot(queue_depth=40, n_slots=8, p95_secs=0.5)
+    assert wide.estimated_wait() < busy.estimated_wait()
+
+
+def test_tenants_snapshot_surfaces_quota_state():
+    clk = FakeClock()
+    p = make_policy(clk, tenants={"a": dict(rate=1.0, burst=5)})
+    p.admit("a", protocol.GATEWAY_SLO_BATCH)
+    p.admit("b", protocol.GATEWAY_SLO_BATCH)
+    snap = p.tenants_snapshot()
+    assert snap["a"]["burst"] == 5 and snap["a"]["available"] == 4.0
+    assert snap["b"]["rate"] == p.default_rate
+
+
+def test_gateway_status_mapping_covers_all_terminals():
+    for kind in protocol.TERMINAL_KINDS:
+        assert protocol.gateway_status(kind) \
+            == protocol.GATEWAY_HTTP_STATUS[kind]
+    # reject reasons refine the 429 default
+    assert protocol.gateway_status(
+        protocol.REJECTED, protocol.REASON_QUOTA) == 429
+    assert protocol.gateway_status(
+        protocol.REJECTED, protocol.REASON_DRAINING) == 503
+    assert protocol.gateway_status(
+        protocol.REJECTED, protocol.REASON_PROMPT_TOO_LONG) == 400

@@ -1,0 +1,66 @@
+"""SSE framing round-trip (serving/gateway.py sse_format/sse_parse):
+the gateway's wire->browser encoding must survive its own parser,
+including multi-frame streams, the OpenAI ``[DONE]`` sentinel, and
+numpy payloads."""
+
+import numpy as np
+
+from realhf_tpu.serving import protocol
+from realhf_tpu.serving.gateway import (
+    SSE_DONE_SENTINEL,
+    sse_format,
+    sse_parse,
+)
+
+
+def test_single_frame_roundtrip():
+    raw = sse_format(protocol.TOKENS,
+                     dict(tokens=[1, 2, 3], offset=0))
+    [(event, data)] = sse_parse(raw.decode())
+    assert event == protocol.TOKENS
+    assert data == dict(tokens=[1, 2, 3], offset=0)
+
+
+def test_stream_roundtrip_preserves_order_and_kinds():
+    frames = [
+        (protocol.ACCEPTED, dict(queue_depth=2)),
+        (protocol.STARTED, dict(weight_version=7)),
+        (protocol.TOKENS, dict(tokens=[5], offset=0)),
+        (protocol.TOKENS, dict(tokens=[6], offset=1)),
+        (protocol.DONE, dict(tokens=[5, 6], no_eos=False)),
+    ]
+    raw = b"".join(sse_format(k, d) for k, d in frames)
+    parsed = sse_parse(raw.decode())
+    assert parsed == frames
+
+
+def test_done_sentinel_parses_as_raw_string():
+    raw = sse_format(protocol.DONE, dict(tokens=[])) \
+        + SSE_DONE_SENTINEL
+    parsed = sse_parse(raw.decode())
+    assert parsed[-1] == ("", "[DONE]")
+    assert parsed[0][0] == protocol.DONE
+
+
+def test_numpy_payloads_serialize():
+    raw = sse_format(protocol.TOKENS, dict(
+        tokens=np.array([1, 2], dtype=np.int32),
+        logprobs=np.array([-0.5, -1.0], dtype=np.float32),
+        offset=np.int64(4)))
+    [(_, data)] = sse_parse(raw.decode())
+    assert data["tokens"] == [1, 2]
+    assert data["offset"] == 4
+
+
+def test_parser_ignores_comments_and_unknown_fields():
+    text = (": keepalive\n"
+            "retry: 100\n"
+            "event: done\n"
+            "data: {\"tokens\": []}\n"
+            "\n")
+    assert sse_parse(text) == [(protocol.DONE, dict(tokens=[]))]
+
+
+def test_empty_and_garbage_input():
+    assert sse_parse("") == []
+    assert sse_parse("data: not json\n\n") == [("", "not json")]

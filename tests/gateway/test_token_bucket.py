@@ -1,0 +1,93 @@
+"""Per-tenant token buckets (serving/gateway.py) on a fake clock:
+refill math, burst capacity, retry-after hints -- no sleeps."""
+
+import threading
+
+import pytest
+
+from realhf_tpu.serving.gateway import TokenBucket
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_burst_then_deny():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clk)
+    assert all(b.take() for _ in range(4))
+    assert not b.take()
+    assert b.available() == 0.0
+
+
+def test_refill_is_rate_times_elapsed():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=4.0, clock=clk)
+    for _ in range(4):
+        b.take()
+    clk.advance(1.0)  # +2 tokens
+    assert b.take() and b.take() and not b.take()
+
+
+def test_refill_caps_at_burst():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=3.0, clock=clk)
+    clk.advance(1000.0)
+    assert b.available() == 3.0
+
+
+def test_retry_after_is_shortfall_over_rate():
+    clk = FakeClock()
+    b = TokenBucket(rate=2.0, burst=1.0, clock=clk)
+    assert b.take()
+    assert b.retry_after() == pytest.approx(0.5)
+    clk.advance(0.25)
+    assert b.retry_after() == pytest.approx(0.25)
+    clk.advance(0.25)
+    assert b.retry_after() == 0.0
+
+
+def test_zero_rate_bucket_never_refills():
+    clk = FakeClock()
+    b = TokenBucket(rate=0.0, burst=1.0, clock=clk)
+    assert b.take()
+    clk.advance(1e9)
+    assert not b.take()
+    assert b.retry_after() == float("inf")
+
+
+def test_weighted_take():
+    clk = FakeClock()
+    b = TokenBucket(rate=1.0, burst=10.0, clock=clk)
+    assert b.take(8)
+    assert not b.take(3)
+    assert b.take(2)
+
+
+def test_concurrent_takes_never_overdraw():
+    # burst of exactly 50 tokens, 4 threads racing 25 takes each:
+    # exactly 50 must succeed
+    clk = FakeClock()
+    b = TokenBucket(rate=0.0, burst=50.0, clock=clk)
+    wins = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(25):
+            if b.take():
+                with lock:
+                    wins.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 50

@@ -132,6 +132,52 @@ def test_empty_queue_is_truthy():
     assert (q or None) is q
 
 
+def test_expired_counter_is_labeled_by_admission_class():
+    """Deadline expiry (the declared ``expired`` terminal) attributes
+    the loss to its admission class: an SLO dashboard must tell
+    interactive misses from batch absorption
+    (``serving_expired_total{class}``, docs/observability.md)."""
+    from realhf_tpu.obs import metrics
+    from realhf_tpu.serving import protocol
+
+    metrics.reset_default()
+    clk = Clock()
+    q = RequestQueue(max_depth=8, clock=clk)
+    q.submit(_req("i", priority=Priority.INTERACTIVE, deadline=1.0))
+    q.submit(_req("b1", priority=Priority.BATCH, deadline=1.0))
+    q.submit(_req("b2", priority=Priority.BATCH, deadline=1.0))
+    q.submit(_req("live", priority=Priority.BATCH))
+    clk.t = 2.0
+    assert q.pop().rid == "live"
+    expired = q.take_expired()
+    assert {r.rid for r in expired} == {"i", "b1", "b2"}
+    text = metrics.to_prometheus()
+    assert 'serving_expired_total{class="INTERACTIVE"} 1' in text
+    assert 'serving_expired_total{class="BATCH"} 2' in text
+    # the server turns each taken-expired request into the declared
+    # empty-payload `expired` terminal (server.py serve_step); the
+    # frame schema must accept it
+    assert protocol.validate_event(protocol.EXPIRED, {}) == []
+
+
+def test_scheduler_expiry_paths_share_the_labeled_counter():
+    """Both scheduler expiry sites (active-slot eviction and parked
+    expiry) ride the same per-class counter as the queue shunt --
+    no unlabeled serving_expired_total series remains."""
+    from realhf_tpu.obs import metrics
+    from realhf_tpu.serving.request_queue import count_expired
+
+    metrics.reset_default()
+    count_expired(_req("x", priority=Priority.INTERACTIVE))
+    count_expired(_req("y", priority=Priority.ROLLOUT))
+    text = metrics.to_prometheus()
+    assert 'serving_expired_total{class="INTERACTIVE"} 1' in text
+    assert 'serving_expired_total{class="ROLLOUT"} 1' in text
+    # no unlabeled sample line remains (the TYPE header doesn't count)
+    assert not any(line.startswith("serving_expired_total ")
+                   for line in text.splitlines())
+
+
 def test_server_keeps_caller_provided_empty_queue():
     """The RolloutServer workaround is gone: `queue or ...` now keeps
     the provided (empty) instance."""

@@ -164,6 +164,67 @@ def test_start_from_env_opt_out(monkeypatch):
         http.stop_default()
 
 
+class TestBoundedRequestHandler:
+    """Hardening regression tests (docs/serving.md "Front door"):
+    the telemetry/gateway HTTP plane is exposed to arbitrary
+    clients, so a stalled, oversized, or malformed connection must
+    cost one bounded handler thread, never a wedged server."""
+
+    def test_stalled_connection_is_closed_on_timeout(self, server,
+                                                     monkeypatch):
+        import socket
+        import time as _time
+
+        monkeypatch.setattr(http.BoundedRequestHandler, "timeout",
+                            0.3)
+        srv, _ = server
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=5) as s:
+            # half a request line, then silence: the per-connection
+            # socket timeout must close it, not hold the thread for
+            # the default 30s
+            s.sendall(b"GET /metr")
+            start = _time.monotonic()
+            s.settimeout(5)
+            assert s.recv(1024) == b""  # server-side close
+            assert _time.monotonic() - start < 4.0
+        # the server still answers fresh requests afterwards
+        code, _, _ = _get(srv.port, "/healthz")
+        assert code == 200
+
+    def test_oversized_request_line_is_414(self, server):
+        import socket
+
+        srv, _ = server
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=5) as s:
+            s.sendall(b"GET /" + b"a" * (
+                http.MAX_REQUEST_LINE_BYTES + 64)
+                + b" HTTP/1.1\r\n\r\n")
+            s.settimeout(5)
+            reply = s.recv(4096).decode("latin-1")
+        assert " 414 " in reply.splitlines()[0]
+
+    def test_oversized_headers_are_431(self, server):
+        import socket
+
+        srv, _ = server
+        blob = b"X-Flood: " + b"z" * 4000 + b"\r\n"
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=5) as s:
+            s.sendall(b"GET /healthz HTTP/1.1\r\n"
+                      b"Host: x\r\n" + blob * 5 + b"\r\n")
+            s.settimeout(5)
+            reply = s.recv(4096).decode("latin-1")
+        assert " 431 " in reply.splitlines()[0]
+
+    def test_normal_requests_unaffected_by_bounds(self, server):
+        srv, _ = server
+        metrics.inc("bounded_demo_total")
+        code, _, body = _get(srv.port, "/metrics")
+        assert code == 200 and "bounded_demo_total" in body
+
+
 def test_worker_publishes_telemetry_and_healthz_tracks_status():
     """The worker_base wiring: constructing a Worker starts the
     telemetry endpoints and publishes host:port under
